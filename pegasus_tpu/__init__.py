@@ -1,0 +1,25 @@
+"""pegasus_tpu — a TPU-native distributed key-value store framework.
+
+A from-scratch rebuild of the capabilities of Apache Pegasus
+(reference: /root/reference, apache/incubator-pegasus) designed TPU-first:
+
+- Host control plane (Python/C++): partitioned tables, PacificA-style
+  replication, meta service, clients — the distributed-systems layers.
+- Device data plane (JAX/XLA/Pallas): the per-record predicate hot path
+  (hashkey/sortkey filter matching, TTL-expiry evaluation, partition-hash
+  validation, user-specified compaction rules) evaluated as vectorized
+  kernels over columnar record blocks, instead of the reference's scalar
+  per-record C++ loops (reference: src/server/pegasus_server_impl.cpp:2350,
+  src/server/key_ttl_compaction_filter.h:55).
+
+Subpackages:
+  base     — key/value schemas, crc64 (reference: src/base/)
+  utils    — errors, flags, metrics, fail points (reference: src/utils/)
+  ops      — device record blocks + predicate kernels (the TPU data plane)
+  storage  — LSM storage engine with columnar, device-friendly SST blocks
+  server   — rrdb request handlers (reference: src/server/)
+  client   — client API + partition resolver (reference: src/client/)
+  parallel — device-mesh sharding of multi-partition batch work
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,24 @@
+"""Key/value schemas and hashing (reference: src/base/)."""
+
+from pegasus_tpu.base.crc import crc32, crc64, crc64_batch
+from pegasus_tpu.base.key_schema import (
+    generate_key,
+    generate_next_bytes,
+    restore_key,
+    key_hash,
+    hash_key_hash,
+    check_key_hash,
+    partition_index,
+)
+from pegasus_tpu.base.value_schema import (
+    generate_value,
+    extract_expire_ts,
+    extract_user_data,
+    extract_timetag,
+    update_expire_ts,
+    check_if_ts_expired,
+    check_if_record_expired,
+    generate_timetag,
+    extract_timestamp_from_timetag,
+    epoch_now,
+)

@@ -1,0 +1,107 @@
+"""crc64 / crc32 — bit-compatible with the reference's hashing.
+
+The reference (src/utils/crc.cpp) uses reflected table-driven CRCs with
+~init/~final conventions:
+- crc32: the Castagnoli polynomial (CRC-32C).
+- crc64: a custom rDSN polynomial given as the bit set
+  {63,61,59,58,56,55,52,49,48,47,46,44,41,37,36,34,32,31,28,26,23,22,19,
+   16,13,12,10,9,6,4,3,0} of x^(63-n) coefficients in reflected order
+  (src/utils/crc.cpp:289-295).
+
+crc64(hashkey) is THE routing hash: clients map records to partitions with
+`crc64(hashkey) % partition_count` (src/client/partition_resolver.cpp:48-50)
+and servers validate ownership with `crc64 & partition_version`
+(src/base/pegasus_key_schema.h:176-183) — so this must be bit-identical
+across host Python/numpy, the device kernel (ops/device_crc.py), and any
+client implementation. Golden vectors in tests/test_crc.py were produced by
+running the reference implementation.
+
+Because ~init is applied on entry and ~crc on exit, chaining
+crc(b, init=crc(a)) equals crc(a+b) — both the reference and this
+implementation rely on that streaming property.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+_M64 = (1 << 64) - 1
+_M32 = (1 << 32) - 1
+
+_CRC64_BITS = (63, 61, 59, 58, 56, 55, 52, 49, 48, 47, 46, 44, 41, 37, 36, 34,
+               32, 31, 28, 26, 23, 22, 19, 16, 13, 12, 10, 9, 6, 4, 3, 0)
+CRC64_POLY = 0
+for _n in _CRC64_BITS:
+    CRC64_POLY |= 1 << (63 - _n)
+
+_CRC32_BITS = (28, 27, 26, 25, 23, 22, 20, 19, 18, 14, 13, 11, 10, 9, 8, 6, 0)
+CRC32_POLY = 0
+for _n in _CRC32_BITS:
+    CRC32_POLY |= 1 << (31 - _n)
+
+
+def _make_table(poly: int, width: int) -> list[int]:
+    table = []
+    for i in range(256):
+        k = i
+        for _ in range(8):
+            k = (k >> 1) ^ poly if k & 1 else k >> 1
+        table.append(k)
+    return table
+
+
+_TABLE64 = _make_table(CRC64_POLY, 64)
+_TABLE32 = _make_table(CRC32_POLY, 32)
+
+# numpy copies for the vectorized batch path
+TABLE64_NP = np.array(_TABLE64, dtype=np.uint64)
+TABLE32_NP = np.array(_TABLE32, dtype=np.uint32)
+# split into 32-bit lanes for the device kernel (jax has no uint64 by default)
+TABLE64_LO_NP = (TABLE64_NP & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+TABLE64_HI_NP = (TABLE64_NP >> np.uint64(32)).astype(np.uint32)
+
+
+def crc64(data: bytes, init_crc: int = 0) -> int:
+    """Scalar crc64, parity: dsn::utils::crc64_calc (src/utils/crc.cpp:464)."""
+    crc = ~init_crc & _M64
+    for b in data:
+        crc = _TABLE64[(crc ^ b) & 0xFF] ^ (crc >> 8)
+    return ~crc & _M64
+
+
+def crc32(data: bytes, init_crc: int = 0) -> int:
+    """Scalar crc32 (CRC-32C), parity: dsn::utils::crc32_calc."""
+    crc = ~init_crc & _M32
+    for b in data:
+        crc = _TABLE32[(crc ^ b) & 0xFF] ^ (crc >> 8)
+    return ~crc & _M32
+
+
+def crc64_batch(data: np.ndarray, lengths: np.ndarray,
+                start: np.ndarray | int = 0) -> np.ndarray:
+    """Vectorized crc64 over a batch of byte rows.
+
+    data:    uint8[B, K] padded byte rows
+    lengths: int[B] number of valid bytes per row (from `start`)
+    start:   int or int[B] byte offset where each row's region begins
+
+    Returns uint64[B]. Iterates over byte positions (K_max steps), each step
+    vectorized across the batch — the same loop-order trick the device
+    kernel uses (ops/device_crc.py).
+    """
+    data = np.ascontiguousarray(data, dtype=np.uint8)
+    b, k = data.shape
+    lengths = np.asarray(lengths, dtype=np.int64)
+    starts = np.broadcast_to(np.asarray(start, dtype=np.int64), (b,))
+    crc = np.full(b, _M64, dtype=np.uint64)  # ~0
+    max_len = int(lengths.max()) if b else 0
+    cols = np.arange(b)
+    eight = np.uint64(8)
+    for j in range(max_len):
+        active = j < lengths
+        pos = np.minimum(starts + j, k - 1)
+        byte = data[cols, pos].astype(np.uint64)
+        idx = ((crc ^ byte) & np.uint64(0xFF)).astype(np.int64)
+        nxt = TABLE64_NP[idx] ^ (crc >> eight)
+        crc = np.where(active, nxt, crc)
+    return ~crc

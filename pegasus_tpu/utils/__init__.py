@@ -1,0 +1,4 @@
+"""Utility layer (reference: src/utils/ — L0 of the layer map, SURVEY §1)."""
+
+from pegasus_tpu.utils.errors import ErrorCode, PegasusError, rocksdb_status
+from pegasus_tpu.utils.flags import FLAGS, define_flag, load_config

@@ -1,0 +1,78 @@
+"""Fail points: named code-site fault-injection hooks.
+
+Parity: src/utils/fail_point.h:47,87 — FAIL_POINT_INJECT_F sites that tests
+configure to return a value, raise, or delay; off by default with zero
+overhead on the hot path. Used pervasively in the reference's replica and
+server code (e.g. src/replica/replication_app_base.cpp:289).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, Optional
+
+_SENTINEL = object()
+
+
+class _FailPointRegistry:
+    def __init__(self) -> None:
+        self._actions: Dict[str, Callable[[str], Any]] = {}
+        self._enabled = False
+        self._lock = threading.Lock()
+
+    def setup(self) -> None:
+        self._enabled = True
+
+    def teardown(self) -> None:
+        with self._lock:
+            self._actions.clear()
+        self._enabled = False
+
+    def cfg(self, name: str, action: str) -> None:
+        """Configure an action string, mirroring the reference's mini-language:
+        'off', 'return(<value>)', 'delay(<ms>)', 'raise(<msg>)',
+        '<N>%return(<value>)' is not supported (keep deterministic for tests).
+        """
+        with self._lock:
+            if action == "off":
+                self._actions.pop(name, None)
+                return
+            if action.startswith("return(") and action.endswith(")"):
+                value = action[len("return("):-1]
+                self._actions[name] = lambda _n, v=value: v
+            elif action.startswith("delay(") and action.endswith(")"):
+                ms = float(action[len("delay("):-1])
+                def _delay(_n, ms=ms):
+                    time.sleep(ms / 1000.0)
+                    return _SENTINEL
+                self._actions[name] = _delay
+            elif action.startswith("raise(") and action.endswith(")"):
+                msg = action[len("raise("):-1]
+                def _raise(_n, msg=msg):
+                    raise RuntimeError(f"fail_point({_n}): {msg}")
+                self._actions[name] = _raise
+            else:
+                raise ValueError(f"unknown fail_point action: {action!r}")
+
+    def cfg_callable(self, name: str, fn: Callable[[str], Any]) -> None:
+        with self._lock:
+            self._actions[name] = fn
+
+    def inject(self, name: str) -> Optional[Any]:
+        """Returns None when the point is inactive; otherwise the configured
+        return value (which callers interpret), or raises/delays."""
+        if not self._enabled:
+            return None
+        fn = self._actions.get(name)
+        if fn is None:
+            return None
+        result = fn(name)
+        return None if result is _SENTINEL else result
+
+
+FAIL_POINTS = _FailPointRegistry()
+
+
+def fail_point(name: str) -> Optional[Any]:
+    return FAIL_POINTS.inject(name)

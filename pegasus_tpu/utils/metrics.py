@@ -1,0 +1,201 @@
+"""Metrics: entities × {gauge, counter, volatile counter, percentile}.
+
+Parity: the reference's Kudu-inspired metric library (src/utils/metrics.h:71-135)
+— metric entities (server/table/replica/...) each hold attributed metrics;
+percentiles are computed by nth-element over a bounded sample window
+(p50..p999); snapshots are served as JSON over HTTP /metrics
+(src/http/builtin_http_calls.cpp:280-288). We reproduce the same model
+in-process; the HTTP surface arrives with the server layer.
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+_PERCENTILES = (50.0, 90.0, 95.0, 99.0, 99.9)
+
+
+class Counter:
+    __slots__ = ("_value", "_lock")
+
+    def __init__(self) -> None:
+        self._value = 0
+        self._lock = threading.Lock()
+
+    def increment(self, by: int = 1) -> None:
+        with self._lock:
+            self._value += by
+
+    def value(self) -> int:
+        return self._value
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {"type": "counter", "value": self._value}
+
+
+class VolatileCounter(Counter):
+    """Counter reset on read (reference: metrics.h volatile counter)."""
+
+    def fetch_and_reset(self) -> int:
+        with self._lock:
+            v = self._value
+            self._value = 0
+            return v
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {"type": "volatile_counter", "value": self.fetch_and_reset()}
+
+
+class Gauge:
+    __slots__ = ("_value",)
+
+    def __init__(self, initial: float = 0) -> None:
+        self._value = initial
+
+    def set(self, value: float) -> None:
+        self._value = value
+
+    def value(self) -> float:
+        return self._value
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {"type": "gauge", "value": self._value}
+
+
+class Percentile:
+    """Bounded-window percentile metric (reference: metrics.h:104 percentile
+    via nth-element over a 4096-sample window)."""
+
+    def __init__(self, window: int = 4096) -> None:
+        self._window = window
+        self._samples: List[float] = []
+        self._idx = 0
+        self._lock = threading.Lock()
+
+    def set(self, sample: float) -> None:
+        with self._lock:
+            if len(self._samples) < self._window:
+                self._samples.append(sample)
+            else:
+                self._samples[self._idx] = sample
+                self._idx = (self._idx + 1) % self._window
+
+    def percentile(self, p: float) -> float:
+        with self._lock:
+            if not self._samples:
+                return 0.0
+            s = sorted(self._samples)
+            k = min(len(s) - 1, int(len(s) * p / 100.0))
+            return s[k]
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {
+            "type": "percentile",
+            **{f"p{str(p).rstrip('0').rstrip('.')}": self.percentile(p)
+               for p in _PERCENTILES},
+        }
+
+
+class MetricEntity:
+    """A named entity (server/table/replica/partition) owning metrics.
+
+    Parity: src/utils/metrics.h metric_entity with attributes.
+    """
+
+    def __init__(self, entity_type: str, entity_id: str,
+                 attrs: Optional[Dict[str, str]] = None) -> None:
+        self.entity_type = entity_type
+        self.entity_id = entity_id
+        self.attrs = dict(attrs or {})
+        self._metrics: Dict[str, Any] = {}
+        self._lock = threading.Lock()
+
+    def _get_or_create(self, name: str, factory):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = factory()
+                self._metrics[name] = m
+            return m
+
+    def counter(self, name: str) -> Counter:
+        return self._get_or_create(name, Counter)
+
+    def volatile_counter(self, name: str) -> VolatileCounter:
+        return self._get_or_create(name, VolatileCounter)
+
+    def gauge(self, name: str) -> Gauge:
+        return self._get_or_create(name, Gauge)
+
+    def percentile(self, name: str) -> Percentile:
+        return self._get_or_create(name, Percentile)
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "type": self.entity_type,
+                "id": self.entity_id,
+                "attributes": dict(self.attrs),
+                "metrics": {n: m.snapshot() for n, m in self._metrics.items()},
+            }
+
+
+class MetricRegistry:
+    """Process-global registry of entities (reference: metrics.h:385 registry,
+    JSON snapshot with entity-type/metric filters metrics.h:522-551)."""
+
+    def __init__(self) -> None:
+        self._entities: Dict[Tuple[str, str], MetricEntity] = {}
+        self._lock = threading.Lock()
+
+    def entity(self, entity_type: str, entity_id: str,
+               attrs: Optional[Dict[str, str]] = None) -> MetricEntity:
+        key = (entity_type, entity_id)
+        with self._lock:
+            ent = self._entities.get(key)
+            if ent is None:
+                ent = MetricEntity(entity_type, entity_id, attrs)
+                self._entities[key] = ent
+            return ent
+
+    def snapshot(self, entity_type: Optional[str] = None,
+                 metric_names: Optional[List[str]] = None) -> List[Dict[str, Any]]:
+        with self._lock:
+            entities = list(self._entities.values())
+        out = []
+        for ent in entities:
+            if entity_type is not None and ent.entity_type != entity_type:
+                continue
+            snap = ent.snapshot()
+            if metric_names is not None:
+                snap["metrics"] = {
+                    n: v for n, v in snap["metrics"].items() if n in metric_names
+                }
+            out.append(snap)
+        return out
+
+
+METRICS = MetricRegistry()
+
+
+class LatencyTimer:
+    """Context manager feeding a Percentile with elapsed ns.
+
+    Parity: METRIC_VAR_AUTO_LATENCY in hot paths
+    (src/server/pegasus_server_impl.cpp:422).
+    """
+
+    def __init__(self, percentile: Percentile) -> None:
+        self._p = percentile
+
+    def __enter__(self):
+        self._t0 = time.perf_counter_ns()
+        return self
+
+    def __exit__(self, *exc):
+        self._p.set(time.perf_counter_ns() - self._t0)
+        return False

@@ -1,0 +1,75 @@
+"""Key schema tests (parity: src/base/pegasus_key_schema.h semantics)."""
+
+import pytest
+
+from pegasus_tpu.base.key_schema import (
+    check_key_hash,
+    generate_key,
+    generate_next_bytes,
+    hash_key_hash,
+    key_hash,
+    partition_index,
+    restore_key,
+)
+from pegasus_tpu.base.crc import crc64
+
+
+def test_roundtrip():
+    for hk, sk in [(b"h", b"s"), (b"", b"sort"), (b"hash", b""), (b"", b""),
+                   (b"x" * 300, b"y" * 500)]:
+        key = generate_key(hk, sk)
+        assert key[:2] == len(hk).to_bytes(2, "big")
+        assert restore_key(key) == (hk, sk)
+
+
+def test_too_long_hashkey_rejected():
+    with pytest.raises(ValueError):
+        generate_key(b"x" * 0xFFFF, b"")
+
+
+def test_next_bytes_ordering():
+    # next(hash_key) must be > every key with that hashkey, and
+    # <= the encoding of any later hashkey.
+    hk = b"user1"
+    upper = generate_next_bytes(hk)
+    for sk in [b"", b"a", b"\xff\xff\xff", b"zzzz"]:
+        assert generate_key(hk, sk) < upper
+    assert upper <= generate_key(b"user2", b"")
+
+
+def test_next_bytes_strips_trailing_ff():
+    hk = b"ab\xff"
+    upper = generate_next_bytes(hk)
+    # trailing 0xFF must be stripped and the previous byte incremented
+    assert not upper.endswith(b"\xff")
+    assert generate_key(hk, b"\xff" * 5) < upper
+
+
+def test_next_bytes_with_sortkey():
+    hk, sk = b"h", b"s1"
+    upper = generate_next_bytes(hk, sk)
+    assert generate_key(hk, sk) < upper
+    assert generate_key(hk, sk + b"suffix") < upper
+    assert upper <= generate_key(hk, b"s2")
+
+
+def test_key_hash_uses_hashkey():
+    key = generate_key(b"hashkey_123", b"sortkey")
+    assert key_hash(key) == crc64(b"hashkey_123") == hash_key_hash(b"hashkey_123")
+
+
+def test_key_hash_empty_hashkey_falls_back_to_sortkey():
+    # parity: pegasus_key_schema.h:161-164
+    key = generate_key(b"", b"sortonly")
+    assert key_hash(key) == crc64(b"sortonly")
+
+
+def test_partition_index_and_check():
+    pc = 8
+    hk = b"some_user"
+    idx = partition_index(hk, pc)
+    assert 0 <= idx < pc
+    key = generate_key(hk, b"sk")
+    # partition_version = partition_count - 1 for power-of-two counts
+    assert check_key_hash(key, idx, pc - 1)
+    assert not check_key_hash(key, (idx + 1) % pc, pc - 1)

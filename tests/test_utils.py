@@ -1,0 +1,101 @@
+"""Utility-layer tests: flags, metrics, fail points, token bucket."""
+
+import time
+
+import pytest
+
+from pegasus_tpu.utils.errors import ErrorCode, PegasusError, StorageStatus
+from pegasus_tpu.utils.fail_point import FAIL_POINTS, fail_point
+from pegasus_tpu.utils.flags import FlagRegistry
+from pegasus_tpu.utils.metrics import MetricRegistry
+from pegasus_tpu.utils.token_bucket import TokenBucket, parse_throttle_env
+
+
+def test_flags_define_get_set(tmp_path):
+    reg = FlagRegistry()
+    reg.define("pegasus.server", "rocksdb_block_cache_capacity", 1024,
+               mutable=True)
+    reg.define("replication", "staleness_for_commit", 20, mutable=False,
+               validator=lambda v: v > 0)
+    assert reg.get("pegasus.server", "rocksdb_block_cache_capacity") == 1024
+    reg.set("pegasus.server", "rocksdb_block_cache_capacity", 2048)
+    assert reg.get("pegasus.server", "rocksdb_block_cache_capacity") == 2048
+    with pytest.raises(ValueError):
+        reg.set("replication", "staleness_for_commit", 30)  # immutable
+
+    ini = tmp_path / "config.ini"
+    ini.write_text("[replication]\nstaleness_for_commit = 40\n")
+    reg.load_ini(str(ini))
+    assert reg.get("replication", "staleness_for_commit") == 40
+
+    ini.write_text("[replication]\nstaleness_for_commit = -1\n")
+    with pytest.raises(ValueError):
+        reg.load_ini(str(ini))
+
+
+def test_metrics_entities_and_percentile():
+    reg = MetricRegistry()
+    ent = reg.entity("replica", "1.2", {"table": "temp"})
+    ent.counter("get_requests").increment(5)
+    ent.gauge("sst_count").set(3)
+    p = ent.percentile("get_latency_ns")
+    for v in range(100):
+        p.set(float(v))
+    snap = reg.snapshot(entity_type="replica")
+    assert len(snap) == 1
+    m = snap[0]["metrics"]
+    assert m["get_requests"]["value"] == 5
+    assert m["sst_count"]["value"] == 3
+    assert m["get_latency_ns"]["p50"] == pytest.approx(50.0, abs=2)
+    assert reg.snapshot(entity_type="table") == []
+
+
+def test_volatile_counter_resets():
+    reg = MetricRegistry()
+    c = reg.entity("server", "s1").volatile_counter("qps")
+    c.increment(10)
+    assert c.fetch_and_reset() == 10
+    assert c.value() == 0
+
+
+def test_fail_point_lifecycle():
+    assert fail_point("replica::on_write") is None  # disabled: zero effect
+    FAIL_POINTS.setup()
+    try:
+        FAIL_POINTS.cfg("replica::on_write", "return(ERR_TIMEOUT)")
+        assert fail_point("replica::on_write") == "ERR_TIMEOUT"
+        FAIL_POINTS.cfg("replica::on_write", "off")
+        assert fail_point("replica::on_write") is None
+        FAIL_POINTS.cfg("boom", "raise(injected)")
+        with pytest.raises(RuntimeError):
+            fail_point("boom")
+    finally:
+        FAIL_POINTS.teardown()
+    assert fail_point("boom") is None
+
+
+def test_token_bucket():
+    tb = TokenBucket(rate=1000, burst=10)
+    assert all(tb.try_consume() for _ in range(10))
+    # bucket drained; refill is 1 token/ms
+    ok = tb.try_consume(10)
+    assert not ok
+    delay = tb.consume_or_delay(5)
+    assert delay > 0
+
+
+def test_parse_throttle_env():
+    d, r = parse_throttle_env("2000*delay*100")
+    assert d is not None and d.rate == 2000 and r is None
+    d, r = parse_throttle_env("1000*delay*50,2000*reject*10")
+    assert d.rate == 1000 and r.rate == 2000
+    d, r = parse_throttle_env("100K")
+    assert d.rate == 100_000
+    assert parse_throttle_env("") == (None, None)
+
+
+def test_error_codes():
+    err = PegasusError(ErrorCode.ERR_TIMEOUT, "rpc timed out")
+    assert err.code == ErrorCode.ERR_TIMEOUT
+    assert "ERR_TIMEOUT" in str(err)
+    assert StorageStatus.OK == 0 and StorageStatus.NOT_FOUND == 1

@@ -1,0 +1,76 @@
+"""Value schema tests (parity: src/base/pegasus_value_schema.h)."""
+
+import struct
+
+from pegasus_tpu.base.value_schema import (
+    check_if_record_expired,
+    check_if_ts_expired,
+    epoch_now,
+    expire_ts_from_ttl,
+    extract_expire_ts,
+    extract_timestamp_from_timetag,
+    extract_timetag,
+    extract_user_data,
+    generate_timetag,
+    generate_value,
+    header_length,
+    update_expire_ts,
+)
+
+
+def test_v0_layout():
+    v = generate_value(0, b"payload", expire_ts=12345)
+    assert v[:4] == struct.pack(">I", 12345)
+    assert extract_expire_ts(0, v) == 12345
+    assert extract_user_data(0, v) == b"payload"
+    assert header_length(0) == 4
+
+
+def test_v1_layout():
+    tag = generate_timetag(timestamp_us=1_700_000_000_000_000, cluster_id=5,
+                           deleted=False)
+    v = generate_value(1, b"data", expire_ts=99, timetag=tag)
+    assert extract_expire_ts(1, v) == 99
+    assert extract_timetag(1, v) == tag
+    assert extract_user_data(1, v) == b"data"
+    assert header_length(1) == 12
+
+
+def test_timetag_fields():
+    ts, cid = 123456789012345, 42
+    tag = generate_timetag(ts, cid, True)
+    assert extract_timestamp_from_timetag(tag) == ts
+    assert tag & 1 == 1
+    assert (tag >> 1) & 0x7F == cid
+
+
+def test_expiry_predicate():
+    # parity: expired iff expire_ts > 0 and expire_ts <= now
+    assert not check_if_ts_expired(100, 0)      # no TTL
+    assert not check_if_ts_expired(100, 101)    # future
+    assert check_if_ts_expired(100, 100)        # boundary: expired
+    assert check_if_ts_expired(100, 99)
+
+
+def test_record_expiry_roundtrip():
+    now = epoch_now()
+    live = generate_value(0, b"x", expire_ts=now + 1000)
+    dead = generate_value(0, b"x", expire_ts=max(1, now - 1000))
+    eternal = generate_value(0, b"x", expire_ts=0)
+    assert not check_if_record_expired(0, now, live)
+    assert check_if_record_expired(0, now, dead)
+    assert not check_if_record_expired(0, now, eternal)
+
+
+def test_update_expire_ts():
+    v = generate_value(1, b"abc", expire_ts=5, timetag=77)
+    v2 = update_expire_ts(1, v, 500)
+    assert extract_expire_ts(1, v2) == 500
+    assert extract_timetag(1, v2) == 77
+    assert extract_user_data(1, v2) == b"abc"
+
+
+def test_expire_ts_from_ttl():
+    assert expire_ts_from_ttl(0) == 0
+    assert expire_ts_from_ttl(-5) == 0
+    assert expire_ts_from_ttl(10, now=100) == 110
