@@ -1,0 +1,28 @@
+"""Device data plane: columnar record blocks + vectorized predicate kernels.
+
+This package is the TPU-native replacement for the reference's scalar
+per-record C++ hot loops:
+- scan/multi_get record validation (src/server/pegasus_server_impl.cpp:2350
+  validate_filter, :2382 validate_key_value_for_scan)
+- TTL compaction filtering (src/server/key_ttl_compaction_filter.h:55)
+- user-specified compaction rules (src/server/compaction_filter_rule.h,
+  compaction_operation.h)
+
+Records are laid out as fixed-shape uint8 tensors (keys padded to a bucket
+width, expire_ts decoded into a u32 column) so that an entire block of
+records is evaluated in one XLA program: filter matching, TTL expiry, and
+partition-hash validation all become masked elementwise/window ops.
+"""
+
+from pegasus_tpu.ops.record_block import RecordBlock, build_record_block, next_bucket
+from pegasus_tpu.ops.predicates import (
+    FT_NO_FILTER,
+    FT_MATCH_ANYWHERE,
+    FT_MATCH_PREFIX,
+    FT_MATCH_POSTFIX,
+    FilterSpec,
+    match_filter,
+    ttl_expired,
+    scan_block_predicate,
+)
+from pegasus_tpu.ops.device_crc import crc64_device, key_hash_device

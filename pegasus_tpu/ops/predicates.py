@@ -1,0 +1,180 @@
+"""Vectorized record predicates — the scan/multi_get hot path on device.
+
+Parity with the reference's per-record scalar loop:
+- validate_filter (src/server/pegasus_server_impl.cpp:2350): empty pattern
+  matches everything; a region shorter than the pattern never matches;
+  FT_MATCH_ANYWHERE/PREFIX/POSTFIX substring semantics.
+- validate_key_value_for_scan (:2382): precedence is
+  expired → hash_invalid → filtered → normal.
+- check_if_ts_expired (src/base/pegasus_value_schema.h:113):
+  expired iff 0 < expire_ts <= now.
+
+Filter types are *static* arguments: each of the four types compiles to its
+own XLA program (4 variants max per shape bucket), so FT_NO_FILTER costs
+nothing and PREFIX doesn't pay for the ANYWHERE sliding window.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pegasus_tpu.ops.device_crc import key_hash_device
+from pegasus_tpu.ops.record_block import RecordBlock, next_bucket
+
+# rrdb filter_type values (idl/rrdb.thrift:27-33)
+FT_NO_FILTER = 0
+FT_MATCH_ANYWHERE = 1
+FT_MATCH_PREFIX = 2
+FT_MATCH_POSTFIX = 3
+
+_PATTERN_MIN_WIDTH = 32
+
+
+class FilterSpec(NamedTuple):
+    """A filter pattern padded for device dispatch. `filter_type` stays a
+    Python int (static); pattern bytes + length are device operands."""
+
+    filter_type: int
+    pattern: jax.Array      # uint8[P] padded
+    pattern_len: jax.Array  # int32 scalar
+
+    @staticmethod
+    def make(filter_type: int, pattern: bytes = b"") -> "FilterSpec":
+        width = next_bucket(len(pattern))
+        buf = np.zeros(width, dtype=np.uint8)
+        if pattern:
+            buf[:len(pattern)] = np.frombuffer(pattern, dtype=np.uint8)
+        return FilterSpec(int(filter_type), jnp.asarray(buf),
+                          jnp.asarray(len(pattern), jnp.int32))
+
+    @staticmethod
+    def none() -> "FilterSpec":
+        return FilterSpec.make(FT_NO_FILTER)
+
+
+def match_filter(keys: jax.Array, region_start: jax.Array,
+                 region_len: jax.Array, pattern: jax.Array,
+                 pattern_len: jax.Array, filter_type: int) -> jax.Array:
+    """bool[B]: does each record's byte region match the pattern?
+
+    keys uint8[B, K]; region_start/region_len int32[B] (region within the
+    padded key row); pattern uint8[P]; pattern_len int32; filter_type static.
+    """
+    b, k = keys.shape
+    if filter_type == FT_NO_FILTER:
+        return jnp.ones((b,), dtype=bool)
+
+    p = pattern.shape[0]
+    jp = jnp.arange(p, dtype=jnp.int32)
+    pat_mask = jp < pattern_len                      # bool[P]
+    empty = pattern_len == 0
+    fits = region_len >= pattern_len                 # bool[B]
+
+    if filter_type in (FT_MATCH_PREFIX, FT_MATCH_POSTFIX):
+        if filter_type == FT_MATCH_PREFIX:
+            offs = region_start
+        else:
+            offs = region_start + region_len - pattern_len
+        idx = jnp.clip(offs[:, None] + jp[None, :], 0, k - 1)
+        window = jnp.take_along_axis(keys, idx, axis=1)        # uint8[B, P]
+        eq = (window == pattern[None, :]) | ~pat_mask[None, :]
+        return (eq.all(axis=1) & fits) | empty
+
+    # FT_MATCH_ANYWHERE: AND-accumulate shifted byte compares — O(B*K)
+    # memory per step instead of materializing B*K*P windows. `t` indexes
+    # absolute window-start positions within the padded row; a window is a
+    # real candidate iff it lies inside [region_start, region_start +
+    # region_len - pattern_len].
+    padded = jnp.pad(keys, ((0, 0), (0, p)))
+    window_ok = jnp.ones((b, k), dtype=bool)
+    for j in range(p):  # static unroll over the pattern buffer; XLA fuses
+        cmp = (padded[:, j:j + k] == pattern[j]) | (j >= pattern_len)
+        window_ok = window_ok & cmp
+    t = jnp.arange(k, dtype=jnp.int32)
+    t_ok = ((t[None, :] >= region_start[:, None]) &
+            (t[None, :] <= (region_start + region_len - pattern_len)[:, None]))
+    return (jnp.any(window_ok & t_ok, axis=1) & fits) | empty
+
+
+def ttl_expired(expire_ts: jax.Array, now: jax.Array) -> jax.Array:
+    """bool[B]: expired iff 0 < expire_ts <= now (value_schema.h:113)."""
+    now = jnp.asarray(now, jnp.uint32)
+    return (expire_ts > 0) & (expire_ts <= now)
+
+
+class ScanMasks(NamedTuple):
+    """Per-record outcome masks, mutually exclusive, reference precedence
+    (pegasus_server_impl.cpp:2382): expired → hash_invalid → filtered."""
+
+    keep: jax.Array
+    expired: jax.Array
+    hash_invalid: jax.Array
+    filtered: jax.Array
+
+
+@functools.partial(jax.jit, static_argnames=("hash_filter_type",
+                                             "sort_filter_type",
+                                             "validate_hash"))
+def _scan_block_predicate(keys, key_len, hashkey_len, expire_ts, valid,
+                          now, hash_pattern, hash_pattern_len,
+                          sort_pattern, sort_pattern_len,
+                          pidx, partition_version,
+                          hash_filter_type: int, sort_filter_type: int,
+                          validate_hash: bool) -> ScanMasks:
+    expired = ttl_expired(expire_ts, now) & valid
+
+    if validate_hash:
+        _, lo = key_hash_device(keys, key_len, hashkey_len)
+        pv = jnp.asarray(partition_version, jnp.uint32)
+        hash_ok = (lo & pv) == jnp.asarray(pidx, jnp.uint32)
+    else:
+        hash_ok = jnp.ones_like(valid)
+    hash_invalid = ~hash_ok & valid & ~expired
+
+    hk_ok = match_filter(keys, jnp.full_like(key_len, 2), hashkey_len,
+                         hash_pattern, hash_pattern_len, hash_filter_type)
+    sort_start = 2 + hashkey_len
+    sort_len = key_len - sort_start
+    sk_ok = match_filter(keys, sort_start, sort_len,
+                         sort_pattern, sort_pattern_len, sort_filter_type)
+    filtered = ~(hk_ok & sk_ok) & valid & ~expired & ~hash_invalid
+
+    keep = valid & ~expired & ~hash_invalid & ~filtered
+    return ScanMasks(keep, expired, hash_invalid, filtered)
+
+
+def scan_block_predicate(block: RecordBlock, now,
+                         hash_filter: Optional[FilterSpec] = None,
+                         sort_filter: Optional[FilterSpec] = None,
+                         validate_hash: bool = False,
+                         pidx: int = 0,
+                         partition_version: int = -1) -> ScanMasks:
+    """Evaluate the full scan validation for a record block on device.
+
+    Mirrors validate_key_value_for_scan for a whole block at once. When
+    `validate_hash` and partition_version < 0 or pidx > partition_version,
+    every non-expired record is hash-invalid (the reference checks expiry
+    first, then rejects with kHashInvalid; pegasus_server_impl.cpp:2392-2401).
+    """
+    hash_filter = hash_filter or FilterSpec.none()
+    sort_filter = sort_filter or FilterSpec.none()
+    if validate_hash and (partition_version < 0 or pidx > partition_version):
+        valid = jnp.asarray(block.valid)
+        expired = ttl_expired(jnp.asarray(block.expire_ts),
+                              jnp.asarray(now, jnp.uint32)) & valid
+        zeros = jnp.zeros((block.capacity,), dtype=bool)
+        return ScanMasks(zeros, expired, valid & ~expired, zeros)
+    return _scan_block_predicate(
+        jnp.asarray(block.keys), jnp.asarray(block.key_len),
+        jnp.asarray(block.hashkey_len), jnp.asarray(block.expire_ts),
+        jnp.asarray(block.valid), jnp.asarray(now, jnp.uint32),
+        hash_filter.pattern, hash_filter.pattern_len,
+        sort_filter.pattern, sort_filter.pattern_len,
+        jnp.asarray(pidx, jnp.uint32),
+        jnp.asarray(partition_version & 0xFFFFFFFF, jnp.uint32),
+        hash_filter.filter_type, sort_filter.filter_type, validate_hash)

@@ -1,0 +1,109 @@
+"""Columnar record blocks — the unit of device dispatch.
+
+The reference iterates records one at a time through RocksDB and evaluates
+predicates in scalar C++ (src/server/pegasus_server_impl.cpp:643 hot loop).
+We instead batch records into fixed-shape columnar blocks:
+
+    keys        uint8[capacity, key_width]   encoded keys, zero-padded
+    key_len     int32[capacity]
+    hashkey_len int32[capacity]              decoded from the 2-byte header
+    expire_ts   uint32[capacity]             decoded from the value header
+    valid       bool[capacity]               padding mask
+
+Key widths are bucketed to powers of two (min 32) so the number of distinct
+XLA compilations stays small; `capacity` is chosen by the caller (storage
+blocks use a fixed record count). Values stay host-side — the device only
+needs key bytes and the expiry column for the predicate work, which is the
+TPU-first version of the reference's key/value schema split
+(src/base/pegasus_key_schema.h, pegasus_value_schema.h).
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import NamedTuple, Sequence
+
+import numpy as np
+
+_MIN_WIDTH = 32
+_MAX_WIDTH = 1 << 16
+
+
+class RecordBlock(NamedTuple):
+    """Host (numpy) or device (jax) columnar record block; NamedTuple makes
+    it a pytree so it can flow through jit boundaries unchanged."""
+
+    keys: np.ndarray        # uint8[B, K]
+    key_len: np.ndarray     # int32[B]
+    hashkey_len: np.ndarray  # int32[B]
+    expire_ts: np.ndarray   # uint32[B]
+    valid: np.ndarray       # bool[B]
+
+    @property
+    def capacity(self) -> int:
+        return self.keys.shape[0]
+
+    @property
+    def key_width(self) -> int:
+        return self.keys.shape[1]
+
+    def count(self) -> int:
+        return int(np.asarray(self.valid).sum())
+
+
+def next_bucket(n: int) -> int:
+    """Smallest power-of-two width >= n (>= 32), bounding recompilations."""
+    w = _MIN_WIDTH
+    while w < n:
+        w <<= 1
+    if w > _MAX_WIDTH:
+        raise ValueError(f"key width {n} exceeds maximum {_MAX_WIDTH}")
+    return w
+
+
+def build_record_block(
+    keys: Sequence[bytes],
+    expire_ts: Sequence[int],
+    capacity: int | None = None,
+    key_width: int | None = None,
+) -> RecordBlock:
+    """Pack encoded keys + decoded expire_ts into a padded columnar block."""
+    n = len(keys)
+    if capacity is None:
+        capacity = n
+    if n > capacity:
+        raise ValueError(f"{n} records exceed block capacity {capacity}")
+    max_len = max((len(k) for k in keys), default=2)
+    if key_width is None:
+        key_width = next_bucket(max_len)
+    elif max_len > key_width:
+        raise ValueError(f"key of {max_len} bytes exceeds key_width {key_width}")
+
+    arr = np.zeros((capacity, key_width), dtype=np.uint8)
+    key_len = np.zeros(capacity, dtype=np.int32)
+    hashkey_len = np.zeros(capacity, dtype=np.int32)
+    ets = np.zeros(capacity, dtype=np.uint32)
+    valid = np.zeros(capacity, dtype=bool)
+    for i, k in enumerate(keys):
+        arr[i, :len(k)] = np.frombuffer(k, dtype=np.uint8)
+        key_len[i] = len(k)
+        (hashkey_len[i],) = struct.unpack_from(">H", k)
+        valid[i] = True
+    ets[:n] = np.asarray(list(expire_ts), dtype=np.uint32)
+    return RecordBlock(arr, key_len, hashkey_len, ets, valid)
+
+
+def block_from_columns(keys: np.ndarray, key_len: np.ndarray,
+                       expire_ts: np.ndarray,
+                       valid: np.ndarray | None = None) -> RecordBlock:
+    """Build a block from already-columnar storage (SST blocks are stored in
+    this layout — no per-record host work on the read path)."""
+    keys = np.ascontiguousarray(keys, dtype=np.uint8)
+    key_len = np.asarray(key_len, dtype=np.int32)
+    hashkey_len = (keys[:, 0].astype(np.int32) << 8) | keys[:, 1].astype(np.int32)
+    hashkey_len = np.where(key_len >= 2, hashkey_len, 0)
+    if valid is None:
+        valid = key_len >= 2
+    return RecordBlock(keys, key_len, hashkey_len,
+                       np.asarray(expire_ts, dtype=np.uint32),
+                       np.asarray(valid, dtype=bool))
