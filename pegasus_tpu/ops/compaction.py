@@ -1,0 +1,54 @@
+"""Device compaction filter: TTL + default-TTL rewrite + stale-split drop.
+
+Parity: KeyWithTTLCompactionFilter::Filter
+(src/server/key_ttl_compaction_filter.h:55-121):
+1. default_ttl != 0 and record has no TTL -> rewrite expire_ts to
+   now + default_ttl (value_changed).
+2. user-specified compaction operations may delete / update TTL (the rule
+   kernels live in ops/compaction_rules.py).
+3. drop iff expired(now) after the rewrite, OR the key is stale post-split
+   data: validate_hash and partition_version >= 0 and
+   pidx <= partition_version and crc64-hash doesn't map here
+   (check_if_stale_split_data, :114-121 — note: partition_version < 0 means
+   KEEP here, the opposite of the scan path's reject).
+
+Evaluated for a whole columnar batch in one XLA program, vs the reference's
+per-record scalar Filter() callback during RocksDB compaction.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from pegasus_tpu.ops.device_crc import key_hash_device
+from pegasus_tpu.ops.predicates import ttl_expired
+
+
+@functools.partial(jax.jit, static_argnames=("validate_hash",))
+def compaction_filter_block(keys, key_len, hashkey_len, expire_ts, valid,
+                            now, default_ttl, pidx, partition_version,
+                            validate_hash: bool):
+    """Returns (drop: bool[B], new_expire_ts: uint32[B]).
+
+    `partition_version` must be >= 0 when validate_hash is set (callers gate
+    the pv<0 / pidx>pv cases to keep, mirroring check_if_stale_split_data).
+    """
+    now = jnp.asarray(now, jnp.uint32)
+    default_ttl = jnp.asarray(default_ttl, jnp.uint32)
+
+    new_ets = jnp.where((default_ttl != 0) & (expire_ts == 0),
+                        now + default_ttl, expire_ts)
+    expired = ttl_expired(new_ets, now)
+
+    if validate_hash:
+        _, lo = key_hash_device(keys, key_len, hashkey_len)
+        pv = jnp.asarray(partition_version, jnp.uint32)
+        stale = (lo & pv) != jnp.asarray(pidx, jnp.uint32)
+    else:
+        stale = jnp.zeros_like(valid)
+
+    drop = (expired | stale) & valid
+    return drop, new_ets

@@ -1,0 +1,24 @@
+"""Per-partition storage engine.
+
+The reference embeds RocksDB behind pegasus_server_impl
+(src/server/rocksdb_wrapper.h:51, pegasus_server_impl_init.cpp). We build
+our own LSM engine designed TPU-first: SST blocks are stored *columnar*
+(padded key-byte matrix + expire_ts column + value heap) so the scan and
+compaction hot paths hand whole blocks to the device predicate kernels with
+zero per-record host work.
+
+Components:
+  memtable  — sorted in-memory overlay with tombstones
+  wal       — framed, crc-protected write-ahead log (the "private log"
+              analogue at the storage layer)
+  sstable   — columnar SST read/write
+  lsm       — LSMStore: memtable + L0 runs + L1, flush/compaction, iterators
+  engine    — StorageEngine: write batches with decree watermark discipline
+              (parity: src/server/rocksdb_wrapper.cpp:205, base/meta_store.h)
+"""
+
+from pegasus_tpu.storage.memtable import Memtable, TOMBSTONE
+from pegasus_tpu.storage.wal import WriteAheadLog, WalRecord, OP_PUT, OP_DEL
+from pegasus_tpu.storage.sstable import SSTable, SSTableWriter, BLOCK_CAPACITY
+from pegasus_tpu.storage.lsm import LSMStore
+from pegasus_tpu.storage.engine import StorageEngine, WriteBatchItem

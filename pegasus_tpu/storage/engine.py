@@ -1,0 +1,176 @@
+"""StorageEngine: WAL + LSM with decree watermark discipline.
+
+Parity: src/server/rocksdb_wrapper.{h,cpp} + src/base/meta_store.{h,cpp} —
+every committed write batch atomically carries its decree into engine
+metadata (rocksdb_wrapper.cpp:205 puts `pegasus_last_flushed_decree` into
+the meta CF inside the same WriteBatch), so any flushed/checkpointed state
+knows exactly which decree it contains. Here:
+
+- write_batch(items, decree): one WAL frame (decree-stamped) + memtable
+  apply; last_committed_decree advances.
+- flush(): memtable -> L0 SST whose footer meta records
+  {last_flushed_decree, data_version}; WAL truncates after the SST is
+  durable (replay contract preserved).
+- boot: recover last_flushed_decree = max over SST metas, then replay WAL
+  frames with decree > last_flushed_decree into the memtable.
+- manual_compact(): full merge through the device TTL/stale-split filter
+  (ops/compaction.compaction_filter_block) — the manual-compaction path
+  (src/server/pegasus_manual_compact_service.h:48).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from pegasus_tpu.base.value_schema import epoch_now
+from pegasus_tpu.ops.compaction import compaction_filter_block
+from pegasus_tpu.ops.record_block import build_record_block
+from pegasus_tpu.storage.lsm import LSMStore
+from pegasus_tpu.storage.wal import OP_DEL, OP_PUT, WalRecord, WriteAheadLog
+
+
+@dataclass
+class WriteBatchItem:
+    op: int                 # OP_PUT | OP_DEL
+    key: bytes
+    value: bytes = b""      # full pegasus-encoded value for puts
+    expire_ts: int = 0
+
+
+class StorageEngine:
+    def __init__(self, data_dir: str, data_version: int = 1,
+                 block_capacity: int = 1024) -> None:
+        self.data_dir = data_dir
+        os.makedirs(data_dir, exist_ok=True)
+        self.data_version = data_version
+        self.lsm = LSMStore(os.path.join(data_dir, "sst"),
+                            block_capacity=block_capacity)
+
+        # recover the decree watermark from SST metas; data_version comes
+        # from the table with the NEWEST watermark (an older L1 must not
+        # revert a schema upgrade recorded by a newer L0 flush)
+        self.last_flushed_decree = 0
+        for table in list(self.lsm.l0) + ([self.lsm.l1] if self.lsm.l1 else []):
+            d = int(table.meta.get("last_flushed_decree", 0))
+            if d >= self.last_flushed_decree and "data_version" in table.meta:
+                self.data_version = int(table.meta["data_version"])
+            self.last_flushed_decree = max(self.last_flushed_decree, d)
+        self.last_committed_decree = self.last_flushed_decree
+
+        # replay WAL beyond the flushed watermark
+        self._wal_path = os.path.join(data_dir, "wal.log")
+        for decree, records in WriteAheadLog.replay(self._wal_path):
+            if decree <= self.last_flushed_decree:
+                continue
+            for r in records:
+                if r.op == OP_DEL:
+                    self.lsm.delete(r.key)
+                else:
+                    self.lsm.put(r.key, r.value, r.expire_ts)
+            self.last_committed_decree = max(self.last_committed_decree, decree)
+        self.wal = WriteAheadLog(self._wal_path)
+
+    def close(self) -> None:
+        self.wal.close()
+        self.lsm.close()
+
+    # ---- write path ---------------------------------------------------
+
+    def write_batch(self, items: Sequence[WriteBatchItem], decree: int,
+                    sync: bool = False) -> None:
+        """Apply one decree's mutations atomically (WAL first)."""
+        if decree <= self.last_committed_decree:
+            raise ValueError(
+                f"decree {decree} <= last committed {self.last_committed_decree}")
+        self.wal.append_batch(
+            decree,
+            [WalRecord(i.op, i.key, i.value, i.expire_ts) for i in items],
+            sync=sync)
+        for i in items:
+            if i.op == OP_DEL:
+                self.lsm.delete(i.key)
+            else:
+                self.lsm.put(i.key, i.value, i.expire_ts)
+        self.last_committed_decree = decree
+
+    def flush(self) -> bool:
+        """Memtable -> durable L0 SST stamped with the decree watermark."""
+        table = self.lsm.flush(meta={
+            "last_flushed_decree": self.last_committed_decree,
+            "data_version": self.data_version,
+        })
+        if table is None:
+            return False
+        self.last_flushed_decree = self.last_committed_decree
+        self.wal.truncate()
+        return True
+
+    # ---- read path ----------------------------------------------------
+
+    def get(self, key: bytes) -> Optional[Tuple[bytes, int]]:
+        return self.lsm.get(key)
+
+    def iterate(self, start: bytes = b"", stop: Optional[bytes] = None,
+                reverse: bool = False):
+        return self.lsm.iterate(start, stop, reverse)
+
+    # ---- compaction ---------------------------------------------------
+
+    def manual_compact(self, default_ttl: int = 0, pidx: int = 0,
+                       partition_version: int = -1,
+                       validate_hash: bool = False,
+                       rules_filter=None,
+                       now: Optional[int] = None) -> None:
+        """Full compaction with the device TTL/stale-split filter.
+
+        `rules_filter(keys, expire_ts, now) -> (drop, new_ets)` is the
+        optional user-specified compaction hook (compaction_rules.py),
+        applied after the default-TTL rewrite, before expiry — matching the
+        reference's Filter() ordering (key_ttl_compaction_filter.h:71-90).
+        """
+        now_s = epoch_now() if now is None else now
+        # pv<0 / pidx>pv -> no stale-split dropping (keep), per
+        # check_if_stale_split_data.
+        do_validate = bool(validate_hash and partition_version >= 0
+                           and pidx <= partition_version)
+
+        def record_filter(keys: List[bytes], ets: List[int]):
+            n = len(keys)
+            # Stage 1 — default-TTL rewrite (reference does this FIRST and
+            # hands the rewritten value to the user rules, Filter():72-79).
+            ets_arr = np.asarray(ets, dtype=np.uint32)
+            if default_ttl:
+                ets_arr = np.where(ets_arr == 0,
+                                   np.uint32(now_s + default_ttl), ets_arr)
+            # Stage 2 — user-specified rules see the rewritten TTLs.
+            if rules_filter is not None:
+                rule_drop, ets_arr = rules_filter(keys, ets_arr, now_s)
+                ets_arr = np.asarray(ets_arr, dtype=np.uint32)
+            else:
+                rule_drop = np.zeros(n, dtype=bool)
+            # Stage 3 — expiry + stale-split drop on device (default_ttl=0:
+            # the rewrite already happened; a rule that cleared a TTL must
+            # not be re-stamped).
+            block = build_record_block(keys, ets_arr)
+            drop, new_ets = compaction_filter_block(
+                np.asarray(block.keys), np.asarray(block.key_len),
+                np.asarray(block.hashkey_len), np.asarray(block.expire_ts),
+                np.asarray(block.valid),
+                np.uint32(now_s), np.uint32(0),
+                np.uint32(pidx),
+                np.uint32(max(partition_version, 0)),
+                do_validate)
+            drop = np.asarray(drop)[:n] | rule_drop
+            return drop, np.asarray(new_ets)[:n]
+
+        self.lsm.compact(record_filter=record_filter, meta={
+            "last_flushed_decree": self.last_committed_decree,
+            "data_version": self.data_version,
+            "manual_compact_finish_time": epoch_now(),
+        })
+        self.last_flushed_decree = self.last_committed_decree
+        self.wal.truncate()

@@ -1,0 +1,267 @@
+"""LSMStore: memtable + L0 runs + L1, flush, merge, compaction.
+
+Role parity: the RocksDB instance behind one replica
+(src/server/pegasus_server_impl.cpp:1551 opens the DB; manual compaction
+drives CompactRange, src/server/pegasus_manual_compact_service.h:48).
+
+Shape: two levels. Flushes produce L0 SSTs (overlapping, newest wins);
+full compaction merges memtable + L0 + L1 into a single L1 run, dropping
+tombstones, expired records (device-evaluated TTL predicate), stale
+post-split keys, and applying user-specified compaction rules — the
+bottommost-level semantics the reference relies on for TTL GC
+(src/server/key_ttl_compaction_filter.h:55,91).
+
+Scan merge order: memtable > newest L0 > ... > oldest L0 > L1.
+"""
+
+from __future__ import annotations
+
+import heapq
+import os
+from typing import Callable, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from pegasus_tpu.storage.memtable import Memtable, TOMBSTONE
+from pegasus_tpu.storage.sstable import (
+    BLOCK_CAPACITY,
+    SSTable,
+    SSTableWriter,
+)
+
+# (key, value|None, expire_ts) record triple
+Record = Tuple[bytes, Optional[bytes], int]
+
+
+class LSMStore:
+    def __init__(self, data_dir: str, block_capacity: int = BLOCK_CAPACITY,
+                 l0_compaction_trigger: int = 4) -> None:
+        self.data_dir = data_dir
+        os.makedirs(data_dir, exist_ok=True)
+        self._block_capacity = block_capacity
+        self._l0_trigger = l0_compaction_trigger
+        self.memtable = Memtable()
+        self.l0: List[SSTable] = []   # newest first
+        self.l1: Optional[SSTable] = None
+        self._file_seq = 0
+        self._load_existing()
+
+    # ---- files --------------------------------------------------------
+
+    def _load_existing(self) -> None:
+        l0_files = []
+        l1_file = None
+        l1_file_stale: List[Tuple[int, str]] = []
+        for name in os.listdir(self.data_dir):
+            if name.endswith(".sst"):
+                seq = int(name.split("-")[1].split(".")[0])
+                self._file_seq = max(self._file_seq, seq + 1)
+                if name.startswith("l0-"):
+                    l0_files.append((seq, name))
+                elif name.startswith("l1-"):
+                    if l1_file is None or seq > l1_file[0]:
+                        if l1_file is not None:
+                            l1_file_stale.append(l1_file)
+                        l1_file = (seq, name)
+                    else:
+                        l1_file_stale.append((seq, name))
+            elif name.endswith(".sst.tmp"):
+                # abandoned writer from a crash mid-build
+                os.remove(os.path.join(self.data_dir, name))
+        # Crash-recovery invariant: compaction merges EVERY live file into
+        # the new L1, so any file with seq < newest-L1 seq is an obsolete
+        # compaction input whose removal didn't complete — resurrect-proof
+        # cleanup happens here instead of via a manifest.
+        l1_seq = l1_file[0] if l1_file is not None else -1
+        for seq, name in list(l0_files):
+            if seq < l1_seq:
+                os.remove(os.path.join(self.data_dir, name))
+                l0_files.remove((seq, name))
+        for seq, name in l1_file_stale:
+            os.remove(os.path.join(self.data_dir, name))
+        for seq, name in sorted(l0_files, reverse=True):
+            self.l0.append(SSTable(os.path.join(self.data_dir, name)))
+        if l1_file is not None:
+            self.l1 = SSTable(os.path.join(self.data_dir, l1_file[1]))
+
+    def _next_path(self, level: str) -> str:
+        path = os.path.join(self.data_dir, f"{level}-{self._file_seq}.sst")
+        self._file_seq += 1
+        return path
+
+    def close(self) -> None:
+        for t in self.l0:
+            t.close()
+        if self.l1 is not None:
+            self.l1.close()
+
+    # ---- writes -------------------------------------------------------
+
+    def put(self, key: bytes, value: bytes, expire_ts: int = 0) -> None:
+        self.memtable.put(key, value, expire_ts)
+
+    def delete(self, key: bytes) -> None:
+        self.memtable.delete(key)
+
+    def flush(self, meta: Optional[dict] = None) -> Optional[SSTable]:
+        """Memtable -> new L0 SST carrying `meta` (decree watermark etc.)."""
+        if len(self.memtable) == 0:
+            return None
+        writer = SSTableWriter(self._next_path("l0"),
+                               block_capacity=self._block_capacity, meta=meta)
+        for key, value, ets in self.memtable.items_sorted():
+            if value is TOMBSTONE:
+                writer.add(key, b"", 0, tombstone=True)
+            else:
+                writer.add(key, value, ets)
+        writer.finish()
+        table = SSTable(writer.path)
+        self.l0.insert(0, table)
+        self.memtable = Memtable()
+        return table
+
+    def should_compact(self) -> bool:
+        return len(self.l0) >= self._l0_trigger
+
+    # ---- reads --------------------------------------------------------
+
+    def get(self, key: bytes) -> Optional[Tuple[bytes, int]]:
+        """Visible (value, expire_ts) or None. TTL filtering is the caller's
+        job (reference checks expiry in the handlers, not the engine)."""
+        hit = self.memtable.get(key)
+        if hit is not None:
+            value, ets = hit
+            return None if value is TOMBSTONE else (value, ets)
+        for table in self.l0:
+            hit = table.get(key)
+            if hit is not None:
+                value, ets = hit
+                return None if value is None else (value, ets)
+        if self.l1 is not None:
+            hit = self.l1.get(key)
+            if hit is not None:
+                value, ets = hit
+                return None if value is None else (value, ets)
+        return None
+
+    def iterate(self, start: bytes = b"", stop: Optional[bytes] = None,
+                reverse: bool = False) -> Iterator[Record]:
+        """Merged visible records (tombstones resolved, TTL not applied)."""
+        sources: List[Iterator[Record]] = [
+            self.memtable.iterate(start, stop, reverse)]
+        for table in self.l0:
+            sources.append(table.iterate(start, stop, reverse))
+        if self.l1 is not None:
+            sources.append(self.l1.iterate(start, stop, reverse))
+        return _merge(sources, reverse)
+
+    def sorted_run(self) -> Optional[SSTable]:
+        """The single L1 run when the store is fully compacted and there is
+        no overlay — the device fast path qualifier: scans may then stream
+        L1 blocks columnar to the predicate kernels."""
+        if len(self.memtable) == 0 and not self.l0 and self.l1 is not None:
+            return self.l1
+        return None
+
+    # ---- compaction ---------------------------------------------------
+
+    def compact(
+        self,
+        record_filter: Optional[Callable[..., np.ndarray]] = None,
+        meta: Optional[dict] = None,
+    ) -> None:
+        """Full merge into one L1 run.
+
+        `record_filter(keys: List[bytes], expire_ts: List[int]) ->
+        (drop_mask, new_expire)` is evaluated over columnar batches of
+        merged records — the seam where the device TTL/compaction-rule
+        kernels plug in (engine.StorageEngine wires it). Tombstones always
+        drop (bottommost).
+        """
+        merged = self.iterate()
+        writer = SSTableWriter(self._next_path("l1"),
+                               block_capacity=self._block_capacity, meta=meta)
+        batch_keys: List[bytes] = []
+        batch_vals: List[bytes] = []
+        batch_ets: List[int] = []
+
+        def flush_batch() -> None:
+            if not batch_keys:
+                return
+            if record_filter is not None:
+                drop, new_ets = record_filter(batch_keys, batch_ets)
+                for i, k in enumerate(batch_keys):
+                    if not drop[i]:
+                        writer.add(k, batch_vals[i], int(new_ets[i]))
+            else:
+                for k, v, e in zip(batch_keys, batch_vals, batch_ets):
+                    writer.add(k, v, e)
+            batch_keys.clear()
+            batch_vals.clear()
+            batch_ets.clear()
+
+        for key, value, ets in merged:
+            if value is None:  # tombstone: bottommost level -> drop
+                continue
+            batch_keys.append(key)
+            batch_vals.append(value)
+            batch_ets.append(ets)
+            if len(batch_keys) >= self._block_capacity:
+                flush_batch()
+        flush_batch()
+        writer.finish()
+
+        old_l0, old_l1 = self.l0, self.l1
+        self.l1 = SSTable(writer.path)
+        self.l0 = []
+        self.memtable = Memtable()
+        for t in old_l0:
+            t.close()
+            os.remove(t.path)
+        if old_l1 is not None:
+            old_l1.close()
+            os.remove(old_l1.path)
+
+
+class _HeapEntry:
+    """Heap ordering: key asc (or desc when reverse), then source index asc —
+    so for equal keys the newest source (lowest index) pops first."""
+
+    __slots__ = ("key", "src_idx", "record", "it", "reverse")
+
+    def __init__(self, key, src_idx, record, it, reverse):
+        self.key = key
+        self.src_idx = src_idx
+        self.record = record
+        self.it = it
+        self.reverse = reverse
+
+    def __lt__(self, other: "_HeapEntry") -> bool:
+        if self.key != other.key:
+            return self.key > other.key if self.reverse else self.key < other.key
+        return self.src_idx < other.src_idx
+
+
+def _merge(sources: List[Iterator[Record]], reverse: bool = False
+           ) -> Iterator[Record]:
+    """K-way merge; on duplicate keys the lowest source index (newest) wins;
+    shadowed duplicates are skipped and tombstone winners are dropped."""
+    heap: List[_HeapEntry] = []
+    for src_idx, it in enumerate(sources):
+        first = next(it, None)
+        if first is not None:
+            heap.append(_HeapEntry(first[0], src_idx, first, it, reverse))
+    heapq.heapify(heap)
+    prev_key: Optional[bytes] = None
+    while heap:
+        entry = heapq.heappop(heap)
+        key, value, ets = entry.record
+        if key != prev_key:
+            prev_key = key
+            if value is not None:  # tombstone winners are invisible
+                yield key, value, ets
+        nxt = next(entry.it, None)
+        if nxt is not None:
+            heapq.heappush(heap,
+                           _HeapEntry(nxt[0], entry.src_idx, nxt, entry.it,
+                                      reverse))

@@ -1,15 +1,42 @@
-"""Test configuration: force an 8-device CPU mesh so multi-chip sharding
-paths are exercised without TPU hardware (jax docs pattern:
-xla_force_host_platform_device_count). Must run before jax is imported."""
+"""Test configuration: force CPU with an 8-device virtual mesh.
+
+Two things must happen before any test imports jax functionality:
+
+1. JAX_PLATFORMS=cpu + xla_force_host_platform_device_count=8 so the
+   multi-chip sharding paths run on virtual CPU devices.
+2. De-register the `axon` TPU-tunnel PJRT plugin, which this image's
+   sitecustomize installs at interpreter start. Its get_backend hook
+   initializes the tunnel client even when JAX_PLATFORMS=cpu, and that
+   dials the (single-tenant) TPU pool — tests must never touch the real
+   chip. Removing its backend factory before first backend init keeps the
+   whole test session CPU-only.
+"""
 
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax
+import jax._src.xla_bridge as _xb
+
+# sitecustomize already imported jax with jax_platforms=axon; both the
+# config value and the plugin factory must go. This must FAIL LOUDLY if the
+# private API moves — silently keeping the axon factory would make the whole
+# test session dial the single-tenant TPU pool (observed: >120s hangs).
+jax.config.update("jax_platforms", "cpu")
+for _name in list(_xb._backend_factories):
+    if _name != "cpu":
+        _xb._backend_factories.pop(_name, None)
+_left = [n for n in _xb._backend_factories if n != "cpu"]
+if _left:
+    raise RuntimeError(
+        f"conftest failed to de-register non-cpu jax backends: {_left}; "
+        "tests must not touch the TPU tunnel")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
