@@ -1,0 +1,4 @@
+"""Client API (reference: src/include/pegasus/client.h, src/client_lib/)."""
+
+from pegasus_tpu.client.table import Table
+from pegasus_tpu.client.client import PegasusClient, PegasusScanner, ScanOptions

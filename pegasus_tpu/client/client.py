@@ -1,0 +1,302 @@
+"""PegasusClient: the user-facing API.
+
+Parity: src/include/pegasus/client.h:42 — set/get/del/exist/ttl,
+multi_set/multi_get/multi_get_sortkeys/multi_del, incr, check_and_set,
+check_and_mutate, batch_get, sortkey_count, get_scanner (hashkey-scoped)
+and get_unordered_scanners (full-table scan fan-out, :1164-1180).
+
+Errors surface as integer status codes matching the server (0 = OK,
+1 = NotFound, ...), like the reference's PERR_* mapping.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
+
+from pegasus_tpu.base.key_schema import generate_key, restore_key
+from pegasus_tpu.client.table import Table
+from pegasus_tpu.ops.predicates import FT_NO_FILTER
+from pegasus_tpu.server.partition_server import PartitionServer
+from pegasus_tpu.server.types import (
+    BatchGetRequest,
+    CheckAndMutateRequest,
+    CheckAndMutateResponse,
+    CheckAndSetRequest,
+    CheckAndSetResponse,
+    FullKey,
+    GetScannerRequest,
+    IncrRequest,
+    KeyValue,
+    MultiGetRequest,
+    MultiPutRequest,
+    MultiRemoveRequest,
+    Mutate,
+    SCAN_CONTEXT_ID_COMPLETED,
+    SCAN_CONTEXT_ID_NOT_EXIST,
+)
+from pegasus_tpu.utils.errors import StorageStatus
+
+
+@dataclass
+class ScanOptions:
+    """Parity: pegasus_client::scan_options (client.h)."""
+
+    batch_size: int = 100
+    start_inclusive: bool = True
+    stop_inclusive: bool = False
+    hash_key_filter_type: int = FT_NO_FILTER
+    hash_key_filter_pattern: bytes = b""
+    sort_key_filter_type: int = FT_NO_FILTER
+    sort_key_filter_pattern: bytes = b""
+    no_value: bool = False
+    return_expire_ts: bool = False
+    only_return_count: bool = False
+
+
+class PegasusScanner:
+    """Pages through one or more partitions' scan contexts.
+
+    Parity: pegasus_scanner (client.h:1122) — next() yields
+    (hash_key, sort_key, value) until exhausted.
+    """
+
+    def __init__(self, partitions: List[PartitionServer],
+                 request: GetScannerRequest) -> None:
+        self._partitions = list(partitions)
+        self._request = request
+        self._part_idx = 0
+        self._context_id: Optional[int] = None
+        self._buffer: List[KeyValue] = []
+        self._buf_pos = 0
+        self._last_key: Optional[bytes] = None  # for context-loss restart
+        self.kv_count = 0  # accumulated when only_return_count
+
+    def __iter__(self) -> Iterator[Tuple[bytes, bytes, bytes]]:
+        return self
+
+    def __next__(self) -> Tuple[bytes, bytes, bytes]:
+        while True:
+            if self._buf_pos < len(self._buffer):
+                kv = self._buffer[self._buf_pos]
+                self._buf_pos += 1
+                self._last_key = kv.key
+                hk, sk = restore_key(kv.key)
+                return hk, sk, kv.value
+            if not self._fetch_next_batch():
+                raise StopIteration
+
+    def _fetch_next_batch(self) -> bool:
+        from dataclasses import replace
+
+        while self._part_idx < len(self._partitions):
+            server = self._partitions[self._part_idx]
+            if self._context_id is None:
+                resp = server.on_get_scanner(self._request)
+            else:
+                resp = server.on_scan(self._context_id)
+                if resp.context_id == SCAN_CONTEXT_ID_NOT_EXIST:
+                    # server GC'd the context (5-min expiry): restart past
+                    # the last served key (parity: pegasus_scanner_impl
+                    # reissues get_scanner on context loss)
+                    self._context_id = None
+                    restart = self._request
+                    if self._last_key is not None:
+                        restart = replace(self._request,
+                                          start_key=self._last_key + b"\x00",
+                                          start_inclusive=True)
+                    resp = server.on_get_scanner(restart)
+            if resp.error != int(StorageStatus.OK):
+                raise RuntimeError(f"scan failed: error {resp.error}")
+            if resp.kv_count >= 0:
+                self.kv_count += resp.kv_count
+            self._buffer = resp.kvs
+            self._buf_pos = 0
+            if resp.context_id == SCAN_CONTEXT_ID_COMPLETED:
+                self._part_idx += 1
+                self._context_id = None
+            else:
+                self._context_id = resp.context_id
+            if self._buffer:
+                return True
+        return False
+
+    def close(self) -> None:
+        if self._context_id is not None and self._part_idx < len(self._partitions):
+            self._partitions[self._part_idx].on_clear_scanner(self._context_id)
+            self._context_id = None
+
+
+class PegasusClient:
+    def __init__(self, table: Table) -> None:
+        self._table = table
+
+    # ---- single-record ops --------------------------------------------
+
+    def set(self, hash_key: bytes, sort_key: bytes, value: bytes,
+            ttl_seconds: int = 0) -> int:
+        server = self._table.resolve(hash_key)
+        return server.on_put(generate_key(hash_key, sort_key), value,
+                             ttl_seconds)
+
+    def get(self, hash_key: bytes, sort_key: bytes) -> Tuple[int, bytes]:
+        server = self._table.resolve(hash_key)
+        return server.on_get(generate_key(hash_key, sort_key))
+
+    def delete(self, hash_key: bytes, sort_key: bytes) -> int:
+        server = self._table.resolve(hash_key)
+        return server.on_remove(generate_key(hash_key, sort_key))
+
+    def exist(self, hash_key: bytes, sort_key: bytes) -> bool:
+        return self.get(hash_key, sort_key)[0] == int(StorageStatus.OK)
+
+    def ttl(self, hash_key: bytes, sort_key: bytes) -> Tuple[int, int]:
+        server = self._table.resolve(hash_key)
+        return server.on_ttl(generate_key(hash_key, sort_key))
+
+    def incr(self, hash_key: bytes, sort_key: bytes, increment: int,
+             ttl_seconds: int = 0):
+        server = self._table.resolve(hash_key)
+        return server.on_incr(IncrRequest(
+            generate_key(hash_key, sort_key), increment, ttl_seconds))
+
+    # ---- multi ops ----------------------------------------------------
+
+    def multi_set(self, hash_key: bytes,
+                  kvs: Dict[bytes, bytes] | Sequence[Tuple[bytes, bytes]],
+                  ttl_seconds: int = 0) -> int:
+        items = kvs.items() if isinstance(kvs, dict) else kvs
+        req = MultiPutRequest(hash_key,
+                              [KeyValue(k, v) for k, v in items],
+                              ttl_seconds)
+        return self._table.resolve(hash_key).on_multi_put(req)
+
+    def multi_get(self, hash_key: bytes,
+                  sort_keys: Optional[Sequence[bytes]] = None,
+                  start_sortkey: bytes = b"", stop_sortkey: bytes = b"",
+                  max_kv_count: int = -1, max_kv_size: int = -1,
+                  start_inclusive: bool = True, stop_inclusive: bool = False,
+                  sort_key_filter_type: int = FT_NO_FILTER,
+                  sort_key_filter_pattern: bytes = b"",
+                  no_value: bool = False, reverse: bool = False
+                  ) -> Tuple[int, Dict[bytes, bytes]]:
+        req = MultiGetRequest(
+            hash_key, sort_keys=list(sort_keys or []),
+            max_kv_count=max_kv_count, max_kv_size=max_kv_size,
+            no_value=no_value, start_sortkey=start_sortkey,
+            stop_sortkey=stop_sortkey, start_inclusive=start_inclusive,
+            stop_inclusive=stop_inclusive,
+            sort_key_filter_type=sort_key_filter_type,
+            sort_key_filter_pattern=sort_key_filter_pattern, reverse=reverse)
+        resp = self._table.resolve(hash_key).on_multi_get(req)
+        return resp.error, {kv.key: kv.value for kv in resp.kvs}
+
+    def multi_get_sortkeys(self, hash_key: bytes
+                           ) -> Tuple[int, List[bytes]]:
+        err, kvs = self.multi_get(hash_key, no_value=True)
+        return err, sorted(kvs.keys())
+
+    def multi_del(self, hash_key: bytes, sort_keys: Sequence[bytes]
+                  ) -> Tuple[int, int]:
+        req = MultiRemoveRequest(hash_key, list(sort_keys))
+        return self._table.resolve(hash_key).on_multi_remove(req)
+
+    def batch_get(self, keys: Sequence[Tuple[bytes, bytes]]
+                  ) -> Tuple[int, List[Tuple[bytes, bytes, bytes]]]:
+        """Point-gets across partitions; groups by partition server."""
+        by_server: Dict[int, List[FullKey]] = {}
+        for hk, sk in keys:
+            pidx = self._table.resolve(hk).pidx
+            by_server.setdefault(pidx, []).append(FullKey(hk, sk))
+        out: List[Tuple[bytes, bytes, bytes]] = []
+        for pidx, fks in by_server.items():
+            resp = self._table.partitions[pidx].on_batch_get(
+                BatchGetRequest(fks))
+            if resp.error != int(StorageStatus.OK):
+                return resp.error, []
+            out.extend((d.hash_key, d.sort_key, d.value) for d in resp.data)
+        return int(StorageStatus.OK), out
+
+    def sortkey_count(self, hash_key: bytes) -> Tuple[int, int]:
+        return self._table.resolve(hash_key).on_sortkey_count(hash_key)
+
+    def check_and_set(self, hash_key: bytes, check_sort_key: bytes,
+                      check_type: int, check_operand: bytes,
+                      set_sort_key: bytes, set_value: bytes,
+                      ttl_seconds: int = 0,
+                      return_check_value: bool = False
+                      ) -> CheckAndSetResponse:
+        req = CheckAndSetRequest(
+            hash_key, check_sort_key, check_type, check_operand,
+            set_diff_sort_key=(set_sort_key != check_sort_key),
+            set_sort_key=set_sort_key, set_value=set_value,
+            set_expire_ts_seconds=ttl_seconds,
+            return_check_value=return_check_value)
+        return self._table.resolve(hash_key).on_check_and_set(req)
+
+    def check_and_mutate(self, hash_key: bytes, check_sort_key: bytes,
+                         check_type: int, check_operand: bytes,
+                         mutates: Sequence[Mutate],
+                         return_check_value: bool = False
+                         ) -> CheckAndMutateResponse:
+        req = CheckAndMutateRequest(
+            hash_key, check_sort_key, check_type, check_operand,
+            mutate_list=list(mutates),
+            return_check_value=return_check_value)
+        return self._table.resolve(hash_key).on_check_and_mutate(req)
+
+    # ---- scanners -----------------------------------------------------
+
+    def get_scanner(self, hash_key: bytes, start_sortkey: bytes = b"",
+                    stop_sortkey: bytes = b"",
+                    options: Optional[ScanOptions] = None) -> PegasusScanner:
+        """Ordered scan within one hashkey (single partition)."""
+        from pegasus_tpu.base.key_schema import generate_next_bytes
+
+        opts = options or ScanOptions()
+        start_key = generate_key(hash_key, start_sortkey)
+        if stop_sortkey:
+            stop_key = generate_key(hash_key, stop_sortkey)
+        else:
+            stop_key = generate_next_bytes(hash_key)
+            # stop bound is exclusive of the whole hashkey range; force
+            # stop_inclusive off so _after() isn't applied to it
+            from dataclasses import replace
+            opts = replace(opts, stop_inclusive=False)
+        req = self._make_scan_request(start_key, stop_key, opts)
+        return PegasusScanner([self._table.resolve(hash_key)], req)
+
+    def get_unordered_scanners(self, max_split_count: int,
+                               options: Optional[ScanOptions] = None
+                               ) -> List[PegasusScanner]:
+        """Full-table scan fan-out (parity: client.h:1164): partitions are
+        divided among up to max_split_count scanners the caller can drive
+        in parallel."""
+        if max_split_count < 1:
+            raise ValueError("max_split_count must be >= 1")
+        opts = options or ScanOptions()
+        partitions = self._table.all_partitions()
+        split = min(max_split_count, len(partitions))
+        groups: List[List[PartitionServer]] = [[] for _ in range(split)]
+        for i, p in enumerate(partitions):
+            groups[i % split].append(p)
+        req = self._make_scan_request(b"", b"", opts, full_scan=True)
+        return [PegasusScanner(g, req) for g in groups if g]
+
+    @staticmethod
+    def _make_scan_request(start_key: bytes, stop_key: bytes,
+                           opts: ScanOptions,
+                           full_scan: bool = False) -> GetScannerRequest:
+        return GetScannerRequest(
+            start_key=start_key, stop_key=stop_key,
+            start_inclusive=opts.start_inclusive,
+            stop_inclusive=opts.stop_inclusive,
+            batch_size=opts.batch_size, no_value=opts.no_value,
+            hash_key_filter_type=opts.hash_key_filter_type,
+            hash_key_filter_pattern=opts.hash_key_filter_pattern,
+            sort_key_filter_type=opts.sort_key_filter_type,
+            sort_key_filter_pattern=opts.sort_key_filter_pattern,
+            validate_partition_hash=True,
+            return_expire_ts=opts.return_expire_ts,
+            full_scan=full_scan,
+            only_return_count=opts.only_return_count)

@@ -1,0 +1,57 @@
+"""Table: a partitioned rrdb app.
+
+In-process stand-in for the cluster side of the reference's client stack:
+the partition resolver maps crc64(hashkey) % partition_count to a
+partition (src/client/partition_resolver.cpp:48) and dispatches to that
+partition's primary. Here the "primaries" are local PartitionServer
+instances; the RPC/meta layers (resolver cache, config refresh) take over
+dispatch in the distributed deployment.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+from typing import Dict, List
+
+from pegasus_tpu.base.key_schema import partition_index
+from pegasus_tpu.server.partition_server import PartitionServer
+
+
+class Table:
+    def __init__(self, data_dir: str, app_id: int = 1, app_name: str = "temp",
+                 partition_count: int = 8, data_version: int = 1) -> None:
+        if partition_count < 1:
+            raise ValueError("partition_count must be >= 1")
+        self.data_dir = data_dir
+        self.app_id = app_id
+        self.app_name = app_name
+        self.partition_count = partition_count
+        self.partitions: Dict[int, PartitionServer] = {}
+        for pidx in range(partition_count):
+            self.partitions[pidx] = PartitionServer(
+                os.path.join(data_dir, f"{app_id}.{pidx}"),
+                app_id=app_id, pidx=pidx, partition_count=partition_count,
+                data_version=data_version)
+
+    def resolve(self, hash_key: bytes) -> PartitionServer:
+        return self.partitions[partition_index(hash_key, self.partition_count)]
+
+    def all_partitions(self) -> List[PartitionServer]:
+        return [self.partitions[i] for i in range(self.partition_count)]
+
+    def flush_all(self) -> None:
+        for p in self.all_partitions():
+            p.flush()
+
+    def manual_compact_all(self, default_ttl: int = 0, rules_filter=None) -> None:
+        for p in self.all_partitions():
+            p.manual_compact(default_ttl=default_ttl, rules_filter=rules_filter)
+
+    def close(self) -> None:
+        for p in self.partitions.values():
+            p.close()
+
+    def drop(self) -> None:
+        self.close()
+        shutil.rmtree(self.data_dir, ignore_errors=True)

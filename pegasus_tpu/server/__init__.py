@@ -1,0 +1,32 @@
+"""Server layer: the rrdb storage app (reference: src/server/)."""
+
+from pegasus_tpu.server.partition_server import PartitionServer
+from pegasus_tpu.server.write_service import WriteService, cas_check_passed
+from pegasus_tpu.server.scan_context import ScanContext, ScanContextCache
+from pegasus_tpu.server.read_limiter import RangeReadLimiter
+from pegasus_tpu.server.capacity_units import CapacityUnitCalculator
+from pegasus_tpu.server.types import (
+    BatchGetRequest,
+    BatchGetResponse,
+    CasCheckType,
+    CheckAndMutateRequest,
+    CheckAndMutateResponse,
+    CheckAndSetRequest,
+    CheckAndSetResponse,
+    FullData,
+    FullKey,
+    GetScannerRequest,
+    IncrRequest,
+    IncrResponse,
+    KeyValue,
+    MultiGetRequest,
+    MultiGetResponse,
+    MultiPutRequest,
+    MultiRemoveRequest,
+    Mutate,
+    MutateOperation,
+    SCAN_CONTEXT_ID_COMPLETED,
+    SCAN_CONTEXT_ID_NOT_EXIST,
+    ScanRequest,
+    ScanResponse,
+)

@@ -1,0 +1,36 @@
+"""Capacity-unit metering.
+
+Parity: src/server/capacity_unit_calculator.h:50 — every request bills
+read/write capacity units: 1 CU per started 4KB of key+value bytes
+(min 1 per request), accumulated into per-partition counters.
+"""
+
+from __future__ import annotations
+
+from pegasus_tpu.utils.metrics import MetricEntity
+
+CU_SIZE = 4096
+
+
+def _units(size: int) -> int:
+    return max(1, (size + CU_SIZE - 1) // CU_SIZE)
+
+
+class CapacityUnitCalculator:
+    def __init__(self, entity: MetricEntity) -> None:
+        self._read_cu = entity.counter("recent_read_cu")
+        self._write_cu = entity.counter("recent_write_cu")
+
+    def add_read(self, size: int) -> None:
+        self._read_cu.increment(_units(size))
+
+    def add_write(self, size: int) -> None:
+        self._write_cu.increment(_units(size))
+
+    @property
+    def read_cu(self) -> int:
+        return self._read_cu.value()
+
+    @property
+    def write_cu(self) -> int:
+        return self._write_cu.value()

@@ -1,0 +1,70 @@
+"""Server-held scan contexts with expiry.
+
+Parity: src/server/pegasus_scan_context.h:91 — a paged scan saves its
+iterator state server-side under a context id; the client continues with
+on_scan(context_id) and the server GCs contexts unused for
+FLAGS_rocksdb_scanner_expire_time (5 minutes default,
+pegasus_server_impl.cpp:1362-1388). Our context stores the resume key
+instead of a live iterator (LSM iterators are cheap to re-seek, and this
+keeps no snapshot pinned — a deliberate departure noted in SURVEY §7
+"scan-context lifetime").
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+from pegasus_tpu.server.types import GetScannerRequest
+
+
+@dataclass
+class ScanContext:
+    request: GetScannerRequest
+    resume_key: bytes            # next full key to seek (exclusive of served)
+    stop_key: bytes              # effective exclusive upper bound
+    last_used: float = field(default_factory=time.monotonic)
+
+
+class ScanContextCache:
+    def __init__(self, expire_seconds: float = 300.0) -> None:
+        self._expire = expire_seconds
+        self._contexts: Dict[int, ScanContext] = {}
+        self._ids = itertools.count(1)
+        self._lock = threading.Lock()
+
+    def put(self, ctx: ScanContext) -> int:
+        with self._lock:
+            self._gc_locked()
+            cid = next(self._ids)
+            self._contexts[cid] = ctx
+            return cid
+
+    def take(self, context_id: int) -> Optional[ScanContext]:
+        """Remove and return; callers re-insert (fresh id) when unfinished —
+        same single-use contract as the reference's fetch/store pair."""
+        with self._lock:
+            ctx = self._contexts.pop(context_id, None)
+            if ctx is None:
+                return None
+            if time.monotonic() - ctx.last_used > self._expire:
+                return None
+            ctx.last_used = time.monotonic()
+            return ctx
+
+    def remove(self, context_id: int) -> None:
+        with self._lock:
+            self._contexts.pop(context_id, None)
+
+    def _gc_locked(self) -> None:
+        now = time.monotonic()
+        dead = [cid for cid, ctx in self._contexts.items()
+                if now - ctx.last_used > self._expire]
+        for cid in dead:
+            del self._contexts[cid]
+
+    def __len__(self) -> int:
+        return len(self._contexts)

@@ -1,0 +1,212 @@
+"""rrdb request/response structs.
+
+Parity: idl/rrdb.thrift — same field sets and semantics, as Python
+dataclasses (the wire codec arrives with the RPC layer; these are the
+canonical in-process forms used by servers and clients alike).
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from pegasus_tpu.ops.predicates import (
+    FT_MATCH_ANYWHERE,
+    FT_MATCH_POSTFIX,
+    FT_MATCH_PREFIX,
+    FT_NO_FILTER,
+)
+
+
+class CasCheckType(enum.IntEnum):
+    """idl/rrdb.thrift:35-62."""
+
+    CT_NO_CHECK = 0
+    CT_VALUE_NOT_EXIST = 1
+    CT_VALUE_NOT_EXIST_OR_EMPTY = 2
+    CT_VALUE_EXIST = 3
+    CT_VALUE_NOT_EMPTY = 4
+    CT_VALUE_MATCH_ANYWHERE = 5
+    CT_VALUE_MATCH_PREFIX = 6
+    CT_VALUE_MATCH_POSTFIX = 7
+    CT_VALUE_BYTES_LESS = 8
+    CT_VALUE_BYTES_LESS_OR_EQUAL = 9
+    CT_VALUE_BYTES_EQUAL = 10
+    CT_VALUE_BYTES_GREATER_OR_EQUAL = 11
+    CT_VALUE_BYTES_GREATER = 12
+    CT_VALUE_INT_LESS = 13
+    CT_VALUE_INT_LESS_OR_EQUAL = 14
+    CT_VALUE_INT_EQUAL = 15
+    CT_VALUE_INT_GREATER_OR_EQUAL = 16
+    CT_VALUE_INT_GREATER = 17
+
+
+class MutateOperation(enum.IntEnum):
+    MO_PUT = 0
+    MO_DELETE = 1
+
+
+@dataclass
+class KeyValue:
+    key: bytes                    # sort_key in multi_* responses
+    value: bytes = b""
+    expire_ts_seconds: Optional[int] = None
+
+
+@dataclass
+class MultiPutRequest:
+    hash_key: bytes
+    kvs: List[KeyValue]           # sort_key -> value
+    expire_ts_seconds: int = 0
+
+
+@dataclass
+class MultiRemoveRequest:
+    hash_key: bytes
+    sort_keys: List[bytes]
+
+
+@dataclass
+class MultiGetRequest:
+    hash_key: bytes
+    sort_keys: List[bytes] = field(default_factory=list)
+    max_kv_count: int = -1        # <= 0 means no limit
+    max_kv_size: int = -1
+    no_value: bool = False
+    start_sortkey: bytes = b""
+    stop_sortkey: bytes = b""     # empty = to the last sort key
+    start_inclusive: bool = True
+    stop_inclusive: bool = False
+    sort_key_filter_type: int = FT_NO_FILTER
+    sort_key_filter_pattern: bytes = b""
+    reverse: bool = False
+
+
+@dataclass
+class MultiGetResponse:
+    error: int = 0
+    kvs: List[KeyValue] = field(default_factory=list)
+
+
+@dataclass
+class FullKey:
+    hash_key: bytes
+    sort_key: bytes
+
+
+@dataclass
+class FullData:
+    hash_key: bytes
+    sort_key: bytes
+    value: bytes
+
+
+@dataclass
+class BatchGetRequest:
+    keys: List[FullKey]
+
+
+@dataclass
+class BatchGetResponse:
+    error: int = 0
+    data: List[FullData] = field(default_factory=list)
+
+
+@dataclass
+class IncrRequest:
+    key: bytes                    # full encoded key
+    increment: int
+    expire_ts_seconds: int = 0    # 0 keep, >0 reset, <0 clear
+
+
+@dataclass
+class IncrResponse:
+    error: int = 0
+    new_value: int = 0
+    decree: int = -1
+
+
+@dataclass
+class CheckAndSetRequest:
+    hash_key: bytes
+    check_sort_key: bytes
+    check_type: int
+    check_operand: bytes = b""
+    set_diff_sort_key: bool = False
+    set_sort_key: bytes = b""
+    set_value: bytes = b""
+    set_expire_ts_seconds: int = 0
+    return_check_value: bool = False
+
+
+@dataclass
+class CheckAndSetResponse:
+    error: int = 0
+    check_value_returned: bool = False
+    check_value_exist: bool = False
+    check_value: bytes = b""
+    decree: int = -1
+
+
+@dataclass
+class Mutate:
+    operation: int                # MutateOperation
+    sort_key: bytes
+    value: bytes = b""
+    set_expire_ts_seconds: int = 0
+
+
+@dataclass
+class CheckAndMutateRequest:
+    hash_key: bytes
+    check_sort_key: bytes
+    check_type: int
+    check_operand: bytes = b""
+    mutate_list: List[Mutate] = field(default_factory=list)
+    return_check_value: bool = False
+
+
+@dataclass
+class CheckAndMutateResponse:
+    error: int = 0
+    check_value_returned: bool = False
+    check_value_exist: bool = False
+    check_value: bytes = b""
+    decree: int = -1
+
+
+@dataclass
+class GetScannerRequest:
+    start_key: bytes = b""        # full encoded keys
+    stop_key: bytes = b""
+    start_inclusive: bool = True
+    stop_inclusive: bool = False
+    batch_size: int = 1000
+    no_value: bool = False
+    hash_key_filter_type: int = FT_NO_FILTER
+    hash_key_filter_pattern: bytes = b""
+    sort_key_filter_type: int = FT_NO_FILTER
+    sort_key_filter_pattern: bytes = b""
+    validate_partition_hash: bool = False
+    return_expire_ts: bool = False
+    full_scan: bool = False
+    only_return_count: bool = False
+
+
+@dataclass
+class ScanRequest:
+    context_id: int
+
+
+@dataclass
+class ScanResponse:
+    error: int = 0
+    kvs: List[KeyValue] = field(default_factory=list)
+    context_id: int = -1
+    kv_count: int = -1
+
+
+# scan context ids (parity: src/base/pegasus_const.h SCAN_CONTEXT_ID_*)
+SCAN_CONTEXT_ID_COMPLETED = -1
+SCAN_CONTEXT_ID_NOT_EXIST = -2

@@ -1,0 +1,298 @@
+"""Write service: applies one decree's worth of client writes.
+
+Parity: src/server/pegasus_write_service.{h,cpp} +
+pegasus_write_service_impl.h — batch_prepare/batch_commit produce ONE
+engine write batch per decree; atomic ops (incr / check_and_set /
+check_and_mutate) are read-modify-write evaluated here under the
+single-writer-per-partition invariant (enforced by the partition server's
+write lock, mirroring the reference's per-gpid thread pinning,
+replica_2pc.cpp:115).
+
+Value encoding: every stored value is pegasus-encoded
+([expire_ts][timetag?][user_data], base/pegasus_value_schema.h) and the
+decoded expire_ts additionally rides the engine's columnar expiry column.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import List, Optional, Tuple
+
+from pegasus_tpu.base.key_schema import generate_key
+from pegasus_tpu.base.value_schema import (
+    check_if_ts_expired,
+    epoch_now,
+    expire_ts_from_ttl,
+    extract_user_data,
+    generate_timetag,
+    generate_value,
+)
+from pegasus_tpu.storage.engine import StorageEngine, WriteBatchItem
+from pegasus_tpu.storage.wal import OP_DEL, OP_PUT
+from pegasus_tpu.utils.errors import StorageStatus
+from pegasus_tpu.server.types import (
+    CasCheckType,
+    CheckAndMutateRequest,
+    CheckAndMutateResponse,
+    CheckAndSetRequest,
+    CheckAndSetResponse,
+    IncrRequest,
+    IncrResponse,
+    MultiPutRequest,
+    MultiRemoveRequest,
+    MutateOperation,
+)
+
+_INT64_MIN = -(1 << 63)
+_INT64_MAX = (1 << 63) - 1
+
+
+def cas_check_passed(check_type: int, operand: bytes,
+                     value: Optional[bytes]) -> bool:
+    """Evaluate a cas_check_type against the current check value.
+
+    Parity: pegasus_write_service_impl.h validate_check — `value` is None
+    when the record doesn't exist. Raises ValueError for malformed int
+    compares (mapped to kInvalidArgument by callers).
+    """
+    ct = CasCheckType(check_type)
+    exists = value is not None
+    if ct == CasCheckType.CT_NO_CHECK:
+        return True
+    if ct == CasCheckType.CT_VALUE_NOT_EXIST:
+        return not exists
+    if ct == CasCheckType.CT_VALUE_NOT_EXIST_OR_EMPTY:
+        return not exists or value == b""
+    if ct == CasCheckType.CT_VALUE_EXIST:
+        return exists
+    if ct == CasCheckType.CT_VALUE_NOT_EMPTY:
+        return exists and value != b""
+    if not exists:
+        return False
+    if ct == CasCheckType.CT_VALUE_MATCH_ANYWHERE:
+        return operand in value
+    if ct == CasCheckType.CT_VALUE_MATCH_PREFIX:
+        return value.startswith(operand)
+    if ct == CasCheckType.CT_VALUE_MATCH_POSTFIX:
+        return value.endswith(operand)
+    if ct in (CasCheckType.CT_VALUE_BYTES_LESS,
+              CasCheckType.CT_VALUE_BYTES_LESS_OR_EQUAL,
+              CasCheckType.CT_VALUE_BYTES_EQUAL,
+              CasCheckType.CT_VALUE_BYTES_GREATER_OR_EQUAL,
+              CasCheckType.CT_VALUE_BYTES_GREATER):
+        if ct == CasCheckType.CT_VALUE_BYTES_LESS:
+            return value < operand
+        if ct == CasCheckType.CT_VALUE_BYTES_LESS_OR_EQUAL:
+            return value <= operand
+        if ct == CasCheckType.CT_VALUE_BYTES_EQUAL:
+            return value == operand
+        if ct == CasCheckType.CT_VALUE_BYTES_GREATER_OR_EQUAL:
+            return value >= operand
+        return value > operand
+    # int compares: both sides must parse as int64 (reference uses
+    # buf2int64; failure -> kInvalidArgument)
+    v = _parse_int64(value)
+    o = _parse_int64(operand)
+    if ct == CasCheckType.CT_VALUE_INT_LESS:
+        return v < o
+    if ct == CasCheckType.CT_VALUE_INT_LESS_OR_EQUAL:
+        return v <= o
+    if ct == CasCheckType.CT_VALUE_INT_EQUAL:
+        return v == o
+    if ct == CasCheckType.CT_VALUE_INT_GREATER_OR_EQUAL:
+        return v >= o
+    if ct == CasCheckType.CT_VALUE_INT_GREATER:
+        return v > o
+    raise ValueError(f"unsupported check type {check_type}")
+
+
+def _parse_int64(data: bytes) -> int:
+    s = data.decode("ascii", errors="strict")
+    if not s or s.strip() != s:
+        raise ValueError(f"not an int64: {data!r}")
+    v = int(s)  # raises ValueError on garbage
+    if not (_INT64_MIN <= v <= _INT64_MAX):
+        raise ValueError("int64 out of range")
+    return v
+
+
+class WriteService:
+    """All writes for one partition; the caller (partition server or
+    replica) provides the decree and holds the single-writer lock."""
+
+    def __init__(self, engine: StorageEngine, data_version: int = 1,
+                 cluster_id: int = 1) -> None:
+        self.engine = engine
+        self.data_version = data_version
+        self.cluster_id = cluster_id
+
+    # -- helpers --------------------------------------------------------
+
+    def _make_value(self, user_data: bytes, expire_ts: int) -> bytes:
+        timetag = 0
+        if self.data_version >= 1:
+            timetag = generate_timetag(int(time.time() * 1_000_000),
+                                       self.cluster_id, False)
+        return generate_value(self.data_version, user_data, expire_ts, timetag)
+
+    def _visible_user_data(self, key: bytes,
+                           now: int) -> Optional[bytes]:
+        hit = self.engine.get(key)
+        if hit is None:
+            return None
+        value, ets = hit
+        if check_if_ts_expired(now, ets):
+            return None
+        return extract_user_data(self.data_version, value)
+
+    def _visible(self, key: bytes, now: int
+                 ) -> Optional[Tuple[bytes, int]]:
+        hit = self.engine.get(key)
+        if hit is None:
+            return None
+        value, ets = hit
+        if check_if_ts_expired(now, ets):
+            return None
+        return value, ets
+
+    # -- simple writes --------------------------------------------------
+
+    def put(self, key: bytes, user_data: bytes, expire_ts: int,
+            decree: int) -> int:
+        value = self._make_value(user_data, expire_ts)
+        self.engine.write_batch(
+            [WriteBatchItem(OP_PUT, key, value, expire_ts)], decree)
+        return int(StorageStatus.OK)
+
+    def remove(self, key: bytes, decree: int) -> int:
+        self.engine.write_batch([WriteBatchItem(OP_DEL, key)], decree)
+        return int(StorageStatus.OK)
+
+    def multi_put(self, req: MultiPutRequest, decree: int) -> int:
+        if not req.kvs:
+            return int(StorageStatus.INVALID_ARGUMENT)
+        expire_ts = expire_ts_from_ttl(req.expire_ts_seconds)
+        items = []
+        for kv in req.kvs:
+            key = generate_key(req.hash_key, kv.key)
+            items.append(WriteBatchItem(
+                OP_PUT, key, self._make_value(kv.value, expire_ts), expire_ts))
+        self.engine.write_batch(items, decree)
+        return int(StorageStatus.OK)
+
+    def multi_remove(self, req: MultiRemoveRequest, decree: int
+                     ) -> Tuple[int, int]:
+        """Returns (error, removed_count)."""
+        if not req.sort_keys:
+            return int(StorageStatus.INVALID_ARGUMENT), 0
+        items = [WriteBatchItem(OP_DEL, generate_key(req.hash_key, sk))
+                 for sk in req.sort_keys]
+        self.engine.write_batch(items, decree)
+        return int(StorageStatus.OK), len(items)
+
+    # -- atomic ops -----------------------------------------------------
+
+    def incr(self, req: IncrRequest, decree: int) -> IncrResponse:
+        """Parity: pegasus_write_service_impl.h incr — missing/expired
+        record counts as 0; non-numeric or overflow -> kInvalidArgument;
+        expire_ts_seconds: 0 keeps the old TTL, >0 resets, <0 clears."""
+        now = epoch_now()
+        resp = IncrResponse()
+        old = self._visible(req.key, now)
+        if old is None:
+            old_int, old_ets = 0, 0
+        else:
+            raw, old_ets = old
+            data = extract_user_data(self.data_version, raw)
+            if data == b"":
+                old_int = 0
+            else:
+                try:
+                    old_int = _parse_int64(data)
+                except ValueError:
+                    resp.error = int(StorageStatus.INVALID_ARGUMENT)
+                    return resp
+        new_int = old_int + req.increment
+        if not (_INT64_MIN <= new_int <= _INT64_MAX):
+            resp.error = int(StorageStatus.INVALID_ARGUMENT)
+            resp.new_value = old_int
+            return resp
+        if req.expire_ts_seconds == 0:
+            new_ets = old_ets
+        elif req.expire_ts_seconds > 0:
+            new_ets = expire_ts_from_ttl(req.expire_ts_seconds, now)
+        else:
+            new_ets = 0
+        self.put(req.key, str(new_int).encode(), new_ets, decree)
+        resp.error = int(StorageStatus.OK)
+        resp.new_value = new_int
+        resp.decree = decree
+        return resp
+
+    def check_and_set(self, req: CheckAndSetRequest, decree: int
+                      ) -> CheckAndSetResponse:
+        now = epoch_now()
+        resp = CheckAndSetResponse()
+        check_key = generate_key(req.hash_key, req.check_sort_key)
+        check_value = self._visible_user_data(check_key, now)
+        if req.return_check_value:
+            resp.check_value_returned = True
+            if check_value is not None:
+                resp.check_value_exist = True
+                resp.check_value = check_value
+        try:
+            passed = cas_check_passed(req.check_type, req.check_operand,
+                                      check_value)
+        except ValueError:
+            resp.error = int(StorageStatus.INVALID_ARGUMENT)
+            return resp
+        if not passed:
+            resp.error = int(StorageStatus.TRY_AGAIN)
+            return resp
+        set_sort_key = (req.set_sort_key if req.set_diff_sort_key
+                        else req.check_sort_key)
+        expire_ts = expire_ts_from_ttl(req.set_expire_ts_seconds, now) \
+            if req.set_expire_ts_seconds > 0 else 0
+        self.put(generate_key(req.hash_key, set_sort_key), req.set_value,
+                 expire_ts, decree)
+        resp.error = int(StorageStatus.OK)
+        resp.decree = decree
+        return resp
+
+    def check_and_mutate(self, req: CheckAndMutateRequest, decree: int
+                         ) -> CheckAndMutateResponse:
+        now = epoch_now()
+        resp = CheckAndMutateResponse()
+        if not req.mutate_list:
+            resp.error = int(StorageStatus.INVALID_ARGUMENT)
+            return resp
+        check_key = generate_key(req.hash_key, req.check_sort_key)
+        check_value = self._visible_user_data(check_key, now)
+        if req.return_check_value:
+            resp.check_value_returned = True
+            if check_value is not None:
+                resp.check_value_exist = True
+                resp.check_value = check_value
+        try:
+            passed = cas_check_passed(req.check_type, req.check_operand,
+                                      check_value)
+        except ValueError:
+            resp.error = int(StorageStatus.INVALID_ARGUMENT)
+            return resp
+        if not passed:
+            resp.error = int(StorageStatus.TRY_AGAIN)
+            return resp
+        items = []
+        for m in req.mutate_list:
+            key = generate_key(req.hash_key, m.sort_key)
+            if m.operation == MutateOperation.MO_DELETE:
+                items.append(WriteBatchItem(OP_DEL, key))
+            else:
+                ets = expire_ts_from_ttl(m.set_expire_ts_seconds, now) \
+                    if m.set_expire_ts_seconds > 0 else 0
+                items.append(WriteBatchItem(
+                    OP_PUT, key, self._make_value(m.value, ets), ets))
+        self.engine.write_batch(items, decree)
+        resp.error = int(StorageStatus.OK)
+        resp.decree = decree
+        return resp

@@ -1,0 +1,327 @@
+"""PartitionServer tests: the full rrdb handler surface.
+
+Modeled on the reference's server-layer unit tests
+(src/server/test/pegasus_server_impl_test.cpp) — a real PartitionServer
+against a scratch storage dir.
+"""
+
+import pytest
+
+from pegasus_tpu.base.key_schema import generate_key, restore_key
+from pegasus_tpu.base.value_schema import epoch_now
+from pegasus_tpu.ops.predicates import FT_MATCH_PREFIX
+from pegasus_tpu.server import (
+    BatchGetRequest,
+    CasCheckType,
+    CheckAndMutateRequest,
+    CheckAndSetRequest,
+    FullKey,
+    GetScannerRequest,
+    IncrRequest,
+    KeyValue,
+    MultiGetRequest,
+    MultiPutRequest,
+    MultiRemoveRequest,
+    Mutate,
+    MutateOperation,
+    PartitionServer,
+    SCAN_CONTEXT_ID_COMPLETED,
+    SCAN_CONTEXT_ID_NOT_EXIST,
+)
+from pegasus_tpu.utils.errors import StorageStatus
+
+OK = int(StorageStatus.OK)
+NOT_FOUND = int(StorageStatus.NOT_FOUND)
+INCOMPLETE = int(StorageStatus.INCOMPLETE)
+INVALID = int(StorageStatus.INVALID_ARGUMENT)
+TRY_AGAIN = int(StorageStatus.TRY_AGAIN)
+
+
+@pytest.fixture
+def server(tmp_path):
+    s = PartitionServer(str(tmp_path / "p0"))
+    yield s
+    s.close()
+
+
+def put(s, hk, sk, v, ttl=0):
+    return s.on_put(generate_key(hk, sk), v, ttl)
+
+
+def test_put_get_remove(server):
+    key = generate_key(b"u", b"s")
+    assert server.on_put(key, b"hello") == OK
+    assert server.on_get(key) == (OK, b"hello")
+    assert server.on_remove(key) == OK
+    assert server.on_get(key) == (NOT_FOUND, b"")
+
+
+def test_ttl_visibility(server):
+    key = generate_key(b"u", b"s")
+    server.on_put(key, b"v", ttl_seconds=10_000)
+    err, ttl = server.on_ttl(key)
+    assert err == OK and 9_000 < ttl <= 10_000
+    # eternal record: ttl == -1
+    key2 = generate_key(b"u", b"s2")
+    server.on_put(key2, b"v")
+    assert server.on_ttl(key2) == (OK, -1)
+    # expired record invisible to get
+    key3 = generate_key(b"u", b"s3")
+    server.write_service.put(key3, b"v", epoch_now() - 5,
+                             server._next_decree())
+    assert server.on_get(key3) == (NOT_FOUND, b"")
+    assert server.metrics.counter("abnormal_read_count").value() >= 1
+
+
+def test_multi_put_multi_get_point(server):
+    req = MultiPutRequest(b"hk", [KeyValue(b"s%d" % i, b"v%d" % i)
+                                  for i in range(5)])
+    assert server.on_multi_put(req) == OK
+    resp = server.on_multi_get(MultiGetRequest(
+        b"hk", sort_keys=[b"s1", b"s3", b"nope"]))
+    assert resp.error == OK
+    assert [(kv.key, kv.value) for kv in resp.kvs] == [
+        (b"s1", b"v1"), (b"s3", b"v3")]
+
+
+def test_multi_get_range_and_filters(server):
+    for i in range(20):
+        put(server, b"hk", b"a%02d" % i, b"v%d" % i)
+    for i in range(5):
+        put(server, b"hk", b"b%02d" % i, b"w%d" % i)
+    # range [a05, a10)
+    resp = server.on_multi_get(MultiGetRequest(
+        b"hk", start_sortkey=b"a05", stop_sortkey=b"a10"))
+    assert resp.error == OK
+    assert [kv.key for kv in resp.kvs] == [b"a%02d" % i for i in range(5, 10)]
+    # inclusive stop
+    resp = server.on_multi_get(MultiGetRequest(
+        b"hk", start_sortkey=b"a05", stop_sortkey=b"a10",
+        stop_inclusive=True))
+    assert resp.kvs[-1].key == b"a10"
+    # exclusive start
+    resp = server.on_multi_get(MultiGetRequest(
+        b"hk", start_sortkey=b"a05", stop_sortkey=b"a10",
+        start_inclusive=False))
+    assert resp.kvs[0].key == b"a06"
+    # prefix filter on sortkey
+    resp = server.on_multi_get(MultiGetRequest(
+        b"hk", sort_key_filter_type=FT_MATCH_PREFIX,
+        sort_key_filter_pattern=b"b"))
+    assert [kv.key for kv in resp.kvs] == [b"b%02d" % i for i in range(5)]
+    # reverse returns ascending order of the LAST n
+    resp = server.on_multi_get(MultiGetRequest(b"hk", max_kv_count=3,
+                                               reverse=True))
+    assert [kv.key for kv in resp.kvs] == [b"b02", b"b03", b"b04"]
+
+
+def test_multi_get_incomplete_on_count_limit(server):
+    for i in range(10):
+        put(server, b"hk", b"s%02d" % i, b"v")
+    resp = server.on_multi_get(MultiGetRequest(b"hk", max_kv_count=4))
+    assert resp.error == INCOMPLETE
+    assert len(resp.kvs) == 4
+
+
+def test_multi_get_no_value(server):
+    put(server, b"hk", b"s", b"payload")
+    resp = server.on_multi_get(MultiGetRequest(b"hk", no_value=True))
+    assert resp.kvs[0].value == b""
+
+
+def test_multi_remove(server):
+    for i in range(4):
+        put(server, b"hk", b"s%d" % i, b"v")
+    err, count = server.on_multi_remove(
+        MultiRemoveRequest(b"hk", [b"s0", b"s2"]))
+    assert err == OK and count == 2
+    assert server.on_multi_remove(MultiRemoveRequest(b"hk", []))[0] == INVALID
+    err, n = server.on_sortkey_count(b"hk")
+    assert (err, n) == (OK, 2)
+
+
+def test_batch_get(server):
+    put(server, b"h1", b"s1", b"v1")
+    put(server, b"h2", b"s2", b"v2")
+    resp = server.on_batch_get(BatchGetRequest(
+        [FullKey(b"h1", b"s1"), FullKey(b"h2", b"s2"),
+         FullKey(b"h3", b"nope")]))
+    assert resp.error == OK
+    assert [(d.hash_key, d.value) for d in resp.data] == [
+        (b"h1", b"v1"), (b"h2", b"v2")]
+
+
+def test_incr(server):
+    key = generate_key(b"h", b"cnt")
+    resp = server.on_incr(IncrRequest(key, 5))
+    assert (resp.error, resp.new_value) == (OK, 5)
+    resp = server.on_incr(IncrRequest(key, -2))
+    assert resp.new_value == 3
+    assert server.on_get(key) == (OK, b"3")
+    # non-numeric value -> invalid
+    key2 = generate_key(b"h", b"str")
+    server.on_put(key2, b"abc")
+    assert server.on_incr(IncrRequest(key2, 1)).error == INVALID
+    # overflow -> invalid, value unchanged
+    resp = server.on_incr(IncrRequest(key, (1 << 62)))
+    assert resp.error == OK
+    resp = server.on_incr(IncrRequest(key, (1 << 62)))
+    assert resp.error == INVALID
+    # ttl: reset then clear
+    resp = server.on_incr(IncrRequest(key, 0, expire_ts_seconds=500))
+    assert server.on_ttl(key)[1] > 0
+    server.on_incr(IncrRequest(key, 0, expire_ts_seconds=-1))
+    assert server.on_ttl(key)[1] == -1
+
+
+def test_check_and_set(server):
+    req = CheckAndSetRequest(
+        b"h", b"k1", CasCheckType.CT_VALUE_NOT_EXIST, b"",
+        set_value=b"first")
+    assert server.on_check_and_set(req).error == OK
+    assert server.on_get(generate_key(b"h", b"k1")) == (OK, b"first")
+    # second attempt: NOT_EXIST now fails with TryAgain
+    resp = server.on_check_and_set(req)
+    assert resp.error == TRY_AGAIN
+    # int compare + diff sort key + return check value
+    server.on_put(generate_key(b"h", b"num"), b"42")
+    req2 = CheckAndSetRequest(
+        b"h", b"num", CasCheckType.CT_VALUE_INT_GREATER_OR_EQUAL, b"40",
+        set_diff_sort_key=True, set_sort_key=b"winner", set_value=b"yes",
+        return_check_value=True)
+    resp = server.on_check_and_set(req2)
+    assert resp.error == OK and resp.check_value == b"42"
+    assert server.on_get(generate_key(b"h", b"winner")) == (OK, b"yes")
+    # malformed int operand -> invalid
+    req3 = CheckAndSetRequest(
+        b"h", b"num", CasCheckType.CT_VALUE_INT_LESS, b"xx",
+        set_value=b"no")
+    assert server.on_check_and_set(req3).error == INVALID
+
+
+def test_check_and_mutate(server):
+    server.on_put(generate_key(b"h", b"guard"), b"ready")
+    req = CheckAndMutateRequest(
+        b"h", b"guard", CasCheckType.CT_VALUE_BYTES_EQUAL, b"ready",
+        mutate_list=[
+            Mutate(MutateOperation.MO_PUT, b"a", b"va"),
+            Mutate(MutateOperation.MO_PUT, b"b", b"vb"),
+            Mutate(MutateOperation.MO_DELETE, b"guard"),
+        ])
+    assert server.on_check_and_mutate(req).error == OK
+    assert server.on_get(generate_key(b"h", b"a")) == (OK, b"va")
+    assert server.on_get(generate_key(b"h", b"guard")) == (NOT_FOUND, b"")
+    # failed check mutates nothing
+    req2 = CheckAndMutateRequest(
+        b"h", b"a", CasCheckType.CT_VALUE_BYTES_EQUAL, b"wrong",
+        mutate_list=[Mutate(MutateOperation.MO_DELETE, b"a")])
+    assert server.on_check_and_mutate(req2).error == TRY_AGAIN
+    assert server.on_get(generate_key(b"h", b"a")) == (OK, b"va")
+    # empty mutate list -> invalid
+    req3 = CheckAndMutateRequest(
+        b"h", b"a", CasCheckType.CT_NO_CHECK, b"", mutate_list=[])
+    assert server.on_check_and_mutate(req3).error == INVALID
+
+
+def test_scanner_paging(server):
+    for i in range(25):
+        put(server, b"hk%02d" % (i % 5), b"s%02d" % i, b"v%d" % i)
+    seen = []
+    resp = server.on_get_scanner(GetScannerRequest(batch_size=10))
+    while True:
+        seen.extend(kv.key for kv in resp.kvs)
+        if resp.context_id == SCAN_CONTEXT_ID_COMPLETED:
+            break
+        resp = server.on_scan(resp.context_id)
+        assert resp.error == OK
+    assert len(seen) == 25
+    assert seen == sorted(seen)  # total order over encoded keys
+    # expired/unknown context
+    resp = server.on_scan(99999)
+    assert resp.context_id == SCAN_CONTEXT_ID_NOT_EXIST
+
+
+def test_scanner_filters_and_count(server):
+    for i in range(10):
+        put(server, b"alpha", b"s%d" % i, b"v")
+        put(server, b"beta", b"s%d" % i, b"v")
+    resp = server.on_get_scanner(GetScannerRequest(
+        hash_key_filter_type=FT_MATCH_PREFIX, hash_key_filter_pattern=b"al",
+        batch_size=100))
+    assert len(resp.kvs) == 10
+    assert all(restore_key(kv.key)[0] == b"alpha" for kv in resp.kvs)
+    # count-only scan
+    resp = server.on_get_scanner(GetScannerRequest(only_return_count=True))
+    assert resp.kv_count == 20 and resp.kvs == []
+
+
+def test_scanner_range_bounds(server):
+    for i in range(10):
+        put(server, b"hk", b"s%02d" % i, b"v")
+    start = generate_key(b"hk", b"s03")
+    stop = generate_key(b"hk", b"s07")
+    resp = server.on_get_scanner(GetScannerRequest(
+        start_key=start, stop_key=stop, start_inclusive=False,
+        stop_inclusive=True, batch_size=100))
+    got = [restore_key(kv.key)[1] for kv in resp.kvs]
+    assert got == [b"s04", b"s05", b"s06", b"s07"]
+
+
+def test_scanner_return_expire_ts(server):
+    put(server, b"hk", b"s", b"v", ttl=5000)
+    resp = server.on_get_scanner(GetScannerRequest(return_expire_ts=True,
+                                                   batch_size=10))
+    assert resp.kvs[0].expire_ts_seconds > 0
+
+
+def test_scan_validates_partition_hash(tmp_path):
+    # two partitions of an 8-partition table; each scan only returns
+    # records its partition owns
+    from pegasus_tpu.base.key_schema import partition_index
+    pc = 8
+    servers = {i: PartitionServer(str(tmp_path / f"p{i}"), pidx=i,
+                                  partition_count=pc) for i in range(2)}
+    try:
+        written = {0: 0, 1: 0}
+        for i in range(60):
+            hk = b"user_%d" % i
+            pidx = partition_index(hk, pc)
+            if pidx in servers:
+                servers[pidx].on_put(generate_key(hk, b"s"), b"v")
+                written[pidx] += 1
+        from pegasus_tpu.storage.engine import WriteBatchItem
+        for pidx, s in servers.items():
+            # pretend some stale post-split data: write a foreign key
+            s.engine.write_batch(
+                [WriteBatchItem(0, generate_key(b"foreign_%d" % pidx, b"s"),
+                                b"\x00\x00\x00\x00stale", 0)],
+                s.engine.last_committed_decree + 1)
+            resp = s.on_get_scanner(GetScannerRequest(
+                batch_size=1000, validate_partition_hash=True))
+            assert resp.error == OK
+            keys = [restore_key(kv.key)[0] for kv in resp.kvs]
+            from pegasus_tpu.base.key_schema import partition_index as pi
+            assert all(pi(hk, pc) == pidx for hk in keys)
+    finally:
+        for s in servers.values():
+            s.close()
+
+
+def test_scan_after_flush_and_compact(server):
+    for i in range(30):
+        put(server, b"hk", b"s%02d" % i, b"v%d" % i)
+    server.flush()
+    for i in range(30, 40):
+        put(server, b"hk", b"s%02d" % i, b"v%d" % i)
+    server.manual_compact()
+    err, n = server.on_sortkey_count(b"hk")
+    assert (err, n) == (OK, 40)
+    resp = server.on_multi_get(MultiGetRequest(b"hk"))
+    assert len(resp.kvs) == 40
+
+
+def test_capacity_units_accumulate(server):
+    put(server, b"hk", b"s", b"v" * 5000)  # 2 write CUs
+    assert server.cu.write_cu >= 2
+    server.on_get(generate_key(b"hk", b"s"))
+    assert server.cu.read_cu >= 2
