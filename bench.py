@@ -1,0 +1,188 @@
+#!/usr/bin/env python
+"""YCSB-E-shaped scan benchmark for pegasus_tpu.
+
+Workload (BASELINE.md config #2): a 64-partition table, zipfian-start range
+scans of up to 100 records each, 95% scan / 5% insert, with the realistic
+per-record read predicates Pegasus applies (TTL expiry on every record,
+partition-hash validation) running on the accelerator. 10% of the loaded
+records carry expired TTLs so expiry filtering does real work.
+
+Prints ONE JSON line to stdout:
+    {"metric": ..., "value": ops/sec, "unit": ..., "vs_baseline": ratio}
+vs_baseline = accelerator throughput / XLA-CPU throughput for the same
+workload in the same process (the CPU baseline the reference's scalar C++
+loop competes with — see BASELINE.md "measure CPU baseline").
+
+Env knobs: PEGBENCH_RECORDS (default 100_000), PEGBENCH_OPS (default 300),
+PEGBENCH_PARTITIONS (default 64), PEGBENCH_SEED.
+"""
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+
+def _log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def setup_jax():
+    """Make both the accelerator and CPU platforms available."""
+    import jax
+
+    try:
+        current = jax.config.jax_platforms or ""
+    except AttributeError:
+        current = os.environ.get("JAX_PLATFORMS", "")
+    if current and "cpu" not in current.split(","):
+        jax.config.update("jax_platforms", current + ",cpu")
+    return jax
+
+
+def build_table(tmpdir, n_records, n_partitions, seed):
+    import numpy as np
+
+    from pegasus_tpu.base.value_schema import epoch_now
+    from pegasus_tpu.client import PegasusClient, Table
+
+    rng = np.random.default_rng(seed)
+    table = Table(tmpdir, app_name="bench", partition_count=n_partitions)
+    client = PegasusClient(table)
+    now = epoch_now()
+
+    t0 = time.perf_counter()
+    n_hashkeys = max(1, n_records // 10)
+    # direct write-service loads grouped per partition (bulk-load style)
+    from pegasus_tpu.base.key_schema import generate_key
+    from pegasus_tpu.base.value_schema import generate_value
+    from pegasus_tpu.storage.engine import WriteBatchItem
+    from pegasus_tpu.storage.wal import OP_PUT
+
+    per_server_items = {p.pidx: [] for p in table.all_partitions()}
+    i = 0
+    for h in range(n_hashkeys):
+        hk = b"user%08d" % h
+        server = table.resolve(hk)
+        items = per_server_items[server.pidx]
+        for s in range(10):
+            if i >= n_records:
+                break
+            ets = 0 if rng.random() > 0.10 else max(1, now - 100)
+            value = b"field0=%064d" % i
+            key = generate_key(hk, b"s%02d" % s)
+            items.append(WriteBatchItem(
+                OP_PUT, key, generate_value(1, value, ets), ets))
+            i += 1
+    for p in table.all_partitions():
+        items = per_server_items[p.pidx]
+        for off in range(0, len(items), 1000):
+            p.engine.write_batch(items[off:off + 1000],
+                                 p.engine.last_committed_decree + 1)
+    _log(f"loaded {i} records in {time.perf_counter() - t0:.1f}s")
+
+    t0 = time.perf_counter()
+    table.manual_compact_all()
+    _log(f"compacted in {time.perf_counter() - t0:.1f}s")
+    return table, client
+
+
+def run_scans(table, n_ops, n_partitions, n_hashkeys, seed, record_goal=100,
+              insert_frac=0.05):
+    """95% scans / 5% inserts; returns (ops, records, elapsed_s)."""
+    import numpy as np
+
+    from pegasus_tpu.base.key_schema import generate_key
+    from pegasus_tpu.server.types import GetScannerRequest
+
+    rng = np.random.default_rng(seed)
+    partitions = table.all_partitions()
+    # zipfian-ish partition popularity
+    ranks = rng.permutation(n_partitions)
+    weights = 1.0 / (1.0 + ranks.astype(float))
+    weights /= weights.sum()
+    # zipfian-ish start-key popularity within the loaded keyspace
+    zipf_u = rng.random(n_ops) ** 2.0
+
+    records = 0
+    inserts = 0
+    t0 = time.perf_counter()
+    for op in range(n_ops):
+        if rng.random() < insert_frac:
+            hk = b"user%08d" % int(rng.integers(0, 1 << 30))
+            server = table.resolve(hk)
+            server.on_put(generate_key(hk, b"s00"), b"inserted")
+            inserts += 1
+            continue
+        pidx = int(rng.choice(n_partitions, p=weights))
+        server = partitions[pidx]
+        start_hk = b"user%08d" % int(zipf_u[op] * n_hashkeys)
+        scan_len = int(rng.integers(1, record_goal + 1))
+        resp = server.on_get_scanner(GetScannerRequest(
+            start_key=generate_key(start_hk, b""),
+            batch_size=scan_len,
+            validate_partition_hash=True))
+        records += len(resp.kvs)
+        if resp.context_id >= 0:
+            server.on_clear_scanner(resp.context_id)
+    elapsed = time.perf_counter() - t0
+    return n_ops, records, elapsed
+
+
+def main() -> None:
+    n_records = int(os.environ.get("PEGBENCH_RECORDS", 100_000))
+    n_ops = int(os.environ.get("PEGBENCH_OPS", 300))
+    n_partitions = int(os.environ.get("PEGBENCH_PARTITIONS", 64))
+    seed = int(os.environ.get("PEGBENCH_SEED", 7))
+
+    jax = setup_jax()
+    accel = jax.devices()[0]
+    cpu = jax.local_devices(backend="cpu")[0]
+    _log(f"accelerator: {accel}, baseline: {cpu}")
+
+    with tempfile.TemporaryDirectory(prefix="pegbench") as tmpdir:
+        table, client = build_table(tmpdir, n_records, n_partitions, seed)
+        n_hashkeys = max(1, n_records // 10)
+        def reset_store():
+            # both measured phases start from the identical fully-compacted
+            # state (the 5% inserts during a phase otherwise leave the
+            # store different for the second phase)
+            table.manual_compact_all()
+
+        try:
+            # each phase: reset store -> warmup (compile + populate device
+            # block caches on the fresh files) -> measure
+            with jax.default_device(accel):
+                reset_store()
+                run_scans(table, 20, n_partitions, n_hashkeys, seed + 1, insert_frac=0)
+                ops, recs, accel_s = run_scans(table, n_ops, n_partitions,
+                                               n_hashkeys, seed + 2)
+            accel_qps = ops / accel_s
+            _log(f"accel: {ops} ops / {recs} records in {accel_s:.2f}s "
+                 f"-> {accel_qps:.1f} ops/s, {recs / accel_s:.0f} rec/s")
+
+            # CPU baseline: identical workload, XLA-CPU executes the
+            # predicate programs
+            with jax.default_device(cpu):
+                reset_store()
+                run_scans(table, 20, n_partitions, n_hashkeys, seed + 1, insert_frac=0)
+                ops_c, recs_c, cpu_s = run_scans(table, n_ops, n_partitions,
+                                                 n_hashkeys, seed + 2)
+            cpu_qps = ops_c / cpu_s
+            _log(f"cpu:   {ops_c} ops / {recs_c} records in {cpu_s:.2f}s "
+                 f"-> {cpu_qps:.1f} ops/s")
+
+            print(json.dumps({
+                "metric": "YCSB-E scan ops/sec/chip (64-partition, "
+                          "TTL+hash-validated)",
+                "value": round(accel_qps, 2),
+                "unit": "ops/s",
+                "vs_baseline": round(accel_qps / cpu_qps, 3) if cpu_qps else 0,
+            }))
+        finally:
+            table.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,7 @@
+"""Device-mesh parallelism for multi-partition batch work."""
+
+from pegasus_tpu.parallel.partition_mesh import (
+    PartitionMesh,
+    make_mesh,
+    sharded_scan_step,
+)

@@ -19,6 +19,7 @@ layer drives apply with its own decrees.
 from __future__ import annotations
 
 import threading
+from collections import OrderedDict
 from typing import Iterator, List, Optional, Tuple
 
 import numpy as np
@@ -74,6 +75,18 @@ def _after(key: bytes) -> bytes:
     return key + b"\x00"
 
 
+def _lower_bound(blk, key: bytes) -> int:
+    """First row index in a sorted SST block whose key >= `key`."""
+    lo, hi = 0, blk.count
+    while lo < hi:
+        mid = (lo + hi) // 2
+        if blk.key_at(mid) < key:
+            lo = mid + 1
+        else:
+            hi = mid
+    return lo
+
+
 class PartitionServer:
     def __init__(self, data_dir: str, app_id: int = 1, pidx: int = 0,
                  partition_count: int = 1, data_version: int = 1,
@@ -102,6 +115,11 @@ class PartitionServer:
             {"table": str(app_id), "partition": str(pidx)})
         self.cu = CapacityUnitCalculator(self.metrics)
         self._abnormal_reads = self.metrics.counter("abnormal_read_count")
+        # device-resident block cache: hot SST blocks stay in device memory
+        # across scans (the HBM analogue of RocksDB's block cache), keyed by
+        # (sst path, block offset) which is immutable per file
+        self._device_block_cache: "OrderedDict[tuple, object]" = OrderedDict()
+        self._device_block_cache_cap = 1024
 
     def close(self) -> None:
         self.engine.close()
@@ -239,6 +257,13 @@ class PartitionServer:
         exhausted means the range completed, and resume_key is where a
         follow-up should continue when not exhausted.
         """
+        sorted_run = None if reverse else self.engine.lsm.sorted_run()
+        if sorted_run is not None:
+            return self._columnar_scan(sorted_run, start_key, stop_key, now,
+                                       hash_filter, sort_filter,
+                                       validate_hash, limiter, max_records,
+                                       max_bytes, with_values)
+
         out: List[Tuple[bytes, bytes, int]] = []
         out_bytes = 0
         it = self.engine.iterate(start_key, stop_key, reverse)
@@ -277,6 +302,106 @@ class PartitionServer:
                 exhausted = False
                 break
             if len(batch) < PREDICATE_BATCH:
+                break
+        return out, exhausted, resume_key
+
+    def _columnar_scan(
+        self,
+        sorted_run,
+        start_key: bytes,
+        stop_key: Optional[bytes],
+        now: int,
+        hash_filter: FilterSpec,
+        sort_filter: FilterSpec,
+        validate_hash: bool,
+        limiter: RangeReadLimiter,
+        max_records: int,
+        max_bytes: int,
+        with_values: bool,
+    ) -> Tuple[List[Tuple[bytes, bytes, int]], bool, Optional[bytes]]:
+        """Fast path: the store is one sorted L1 run with no overlay, so SST
+        blocks stream columnar to the device with ZERO per-record host work
+        before the predicate — the TPU-first replacement for the
+        reference's per-record iterator loop. Only returned survivors are
+        materialized per record (response assembly).
+
+        Boundary trimming (records outside [start_key, stop_key)) happens
+        in the same device program via numpy prefix masks computed per
+        block (at most 2 partial blocks per scan).
+        """
+        import jax.numpy as jnp
+
+        from pegasus_tpu.ops.record_block import RecordBlock, block_from_columns
+        from pegasus_tpu.storage.sstable import BLOCK_CAPACITY
+
+        out: List[Tuple[bytes, bytes, int]] = []
+        out_bytes = 0
+        exhausted = True
+        resume_key: Optional[bytes] = None
+        for bm, blk in sorted_run.iter_blocks(start_key, stop_key or None):
+            n = blk.count
+            valid = None
+            # boundary blocks: mask rows outside the range (bisect on the
+            # block's sorted keys — O(log n) key materializations)
+            lo, hi = 0, n
+            if start_key and bm.first_key < start_key:
+                lo = _lower_bound(blk, start_key)
+            if stop_key is not None and bm.last_key >= stop_key:
+                hi = _lower_bound(blk, stop_key)
+            # only in-range rows count against the iteration budget (the
+            # slow path counts per examined record; out-of-range rows in a
+            # boundary block were never "examined")
+            limiter.add_count(hi - lo)
+            # pad to the fixed block capacity so every block shares one
+            # compiled shape per key-width bucket (partial tail blocks must
+            # not each trigger a recompile)
+            cap = max(BLOCK_CAPACITY, n)
+            if lo > 0 or hi < n:
+                valid = np.zeros(cap, dtype=bool)
+                valid[lo:hi] = True
+            # device block cache: keyed by immutable (file, offset)
+            cache_key = (sorted_run.path, bm.offset)
+            dev_block = self._device_block_cache.get(cache_key)
+            if dev_block is None:
+                nb = block_from_columns(blk.keys, blk.key_len, blk.expire_ts)
+                pad = cap - n
+                dev_block = RecordBlock(
+                    jnp.asarray(np.pad(nb.keys, ((0, pad), (0, 0)))),
+                    jnp.asarray(np.pad(nb.key_len, (0, pad))),
+                    jnp.asarray(np.pad(nb.hashkey_len, (0, pad))),
+                    jnp.asarray(np.pad(nb.expire_ts, (0, pad))),
+                    jnp.asarray(np.pad(nb.valid, (0, pad))))
+                self._device_block_cache[cache_key] = dev_block
+                if len(self._device_block_cache) > self._device_block_cache_cap:
+                    self._device_block_cache.popitem(last=False)
+            else:
+                self._device_block_cache.move_to_end(cache_key)
+            block = (dev_block if valid is None
+                     else dev_block._replace(valid=jnp.asarray(valid)))
+            masks = scan_block_predicate(
+                block, now, hash_filter=hash_filter, sort_filter=sort_filter,
+                validate_hash=validate_hash, pidx=self.pidx,
+                partition_version=self.partition_version)
+            expired = int(np.asarray(masks.expired).sum())
+            if expired:
+                self._abnormal_reads.increment(expired)
+            keep = np.asarray(masks.keep)
+            stop_early = False
+            for i in np.flatnonzero(keep):
+                key = blk.key_at(i)
+                data = (extract_user_data(self.data_version, blk.value_at(i))
+                        if with_values else b"")
+                out.append((key, data, int(blk.expire_ts[i])))
+                out_bytes += len(key) + len(data)
+                if ((max_records > 0 and len(out) >= max_records)
+                        or (max_bytes > 0 and out_bytes >= max_bytes)):
+                    resume_key = _after(key)
+                    stop_early = True
+                    break
+            if stop_early or not limiter.valid():
+                if not stop_early:
+                    resume_key = _after(blk.key_at(n - 1))
+                exhausted = False
                 break
         return out, exhausted, resume_key
 
@@ -451,3 +576,6 @@ class PartitionServer:
                 partition_version=self.partition_version,
                 validate_hash=self.validate_partition_hash,
                 rules_filter=rules_filter)
+            # the old L1 file is gone; its cached device blocks can never
+            # hit again — drop them instead of pinning dead HBM
+            self._device_block_cache.clear()
