@@ -1,0 +1,10 @@
+"""Replication framework: PacificA consensus (reference: src/replica/)."""
+
+from pegasus_tpu.replica.mutation import Mutation, WriteOp
+from pegasus_tpu.replica.prepare_list import PrepareList
+from pegasus_tpu.replica.mutation_log import MutationLog
+from pegasus_tpu.replica.replica import (
+    PartitionStatus,
+    Replica,
+    ReplicaConfig,
+)

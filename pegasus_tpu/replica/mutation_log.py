@@ -1,0 +1,115 @@
+"""Private mutation log (per-replica WAL of mutations).
+
+Parity: src/replica/mutation_log.h:70,416 — the decree-ordered private
+log: every prepared mutation is appended before it can be acked, the log
+replays on boot to rebuild the prepare list, learning reads ranges back
+out (mutation_log.h:231), and GC drops everything at or below the durable
+(flushed-to-storage) decree (mutation_log.h:213).
+
+Frame format: [u32 len][u32 crc32][encoded mutation], same torn-tail
+recovery contract as the storage WAL.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+from typing import Iterator, List, Optional
+
+from pegasus_tpu.base.crc import crc32
+from pegasus_tpu.replica.mutation import Mutation
+
+_FRAME = struct.Struct("<II")
+
+
+class MutationLog:
+    def __init__(self, path: str) -> None:
+        self.path = path
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        # one pass: find the valid tail AND the max decree (the decree sits
+        # at a fixed offset in the mutation header — no full decode needed)
+        valid_end, self.max_decree = self._scan(path)
+        if valid_end is not None:
+            with open(path, "r+b") as f:
+                f.truncate(valid_end)
+        self._f = open(path, "ab")
+
+    @staticmethod
+    def _scan(path: str) -> tuple[Optional[int], int]:
+        """Returns (truncate_to | None-if-clean, max_decree)."""
+        if not os.path.exists(path):
+            return None, 0
+        with open(path, "rb") as f:
+            data = f.read()
+        pos = 0
+        max_decree = 0
+        while pos + _FRAME.size <= len(data):
+            length, want = _FRAME.unpack_from(data, pos)
+            end = pos + _FRAME.size + length
+            if end > len(data) or crc32(data[pos + _FRAME.size:end]) != want:
+                return pos, max_decree
+            (decree,) = struct.unpack_from("<Q", data, pos + _FRAME.size + 8)
+            max_decree = max(max_decree, decree)
+            pos = end
+        return (pos if pos < len(data) else None), max_decree
+
+    def append(self, mu: Mutation, sync: bool = False) -> None:
+        blob = mu.encode()
+        self._f.write(_FRAME.pack(len(blob), crc32(blob)))
+        self._f.write(blob)
+        self._f.flush()
+        if sync:
+            os.fsync(self._f.fileno())
+        self.max_decree = max(self.max_decree, mu.decree)
+
+    @staticmethod
+    def replay(path: str) -> Iterator[Mutation]:
+        if not os.path.exists(path):
+            return
+        with open(path, "rb") as f:
+            data = f.read()
+        pos = 0
+        while pos + _FRAME.size <= len(data):
+            length, want = _FRAME.unpack_from(data, pos)
+            end = pos + _FRAME.size + length
+            if end > len(data):
+                return
+            blob = data[pos + _FRAME.size:end]
+            if crc32(blob) != want:
+                return
+            yield Mutation.decode(blob)
+            pos = end
+
+    def read_range(self, start_decree: int,
+                   end_decree: Optional[int] = None) -> List[Mutation]:
+        """Mutations with start_decree <= decree <= end_decree (learning:
+        LT_LOG ships these, replica_learn.cpp:483-508). The log may hold
+        multiple entries per decree (ballot changes); the highest-ballot
+        one wins, matching replay semantics."""
+        best: dict[int, Mutation] = {}
+        for mu in self.replay(self.path):
+            if mu.decree < start_decree:
+                continue
+            if end_decree is not None and mu.decree > end_decree:
+                continue
+            cur = best.get(mu.decree)
+            if cur is None or mu.ballot >= cur.ballot:
+                best[mu.decree] = mu
+        return [best[d] for d in sorted(best)]
+
+    def gc(self, durable_decree: int) -> None:
+        """Drop everything <= durable_decree (rewrite in place)."""
+        keep = [mu for mu in self.replay(self.path)
+                if mu.decree > durable_decree]
+        self._f.close()
+        with open(self.path, "wb") as f:
+            for mu in keep:
+                blob = mu.encode()
+                f.write(_FRAME.pack(len(blob), crc32(blob)))
+                f.write(blob)
+            f.flush()
+            os.fsync(f.fileno())
+        self._f = open(self.path, "ab")
+
+    def close(self) -> None:
+        self._f.close()

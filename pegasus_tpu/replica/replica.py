@@ -1,0 +1,505 @@
+"""Replica: one PacificA participant for one partition.
+
+Parity: src/replica/replica.h + replica_2pc.cpp + replica_config.cpp +
+replica_learn.cpp. Core invariants mirrored:
+
+- Roles PS_PRIMARY / PS_SECONDARY / PS_POTENTIAL_SECONDARY / PS_INACTIVE /
+  PS_ERROR, changed only by ballot-bumping config assignments from meta
+  (here: `assign_config`).
+- Write path (replica_2pc.cpp:113,328): primary assigns decree =
+  max_prepared + 1, prepares locally (prepare list + private log), sends
+  PREPARE to every secondary AND every potential secondary whose learn
+  has reached the prepare-start point; commits when ALL of them ack
+  (PacificA: unanimous ack of the configuration, not majority —
+  `ack_prepare_message` waits for every member; a dead member is removed
+  by reconfiguration, not voted around).
+- Secondaries advance their commit point from the piggy-backed
+  last_committed in each prepare (COMMIT_TO_DECREE_HARD,
+  replica_2pc.cpp:709) and from group checks (replica_check.cpp:212).
+- Reads served by the primary only, gated on a caught-up commit point
+  (replica.cpp:407-426).
+- Learning (replica_learn.cpp:88,361): a potential secondary catches up
+  via LT_LOG (mutations read back from the primary's private log) or
+  LT_APP (checkpoint copy + log tail), then notifies completion and is
+  upgraded by a config change.
+
+Determinism: translate-at-apply for atomic ops is deterministic across
+replicas because the decree order, the mutation's primary-assigned
+timestamp, and the derived `now` are identical everywhere.
+"""
+
+from __future__ import annotations
+
+import enum
+import os
+import shutil
+import time
+from dataclasses import dataclass, field, replace
+from typing import Any, Callable, Dict, List, Optional, Set, Tuple
+
+from pegasus_tpu.base.value_schema import PEGASUS_EPOCH_BEGIN
+from pegasus_tpu.replica.mutation import (
+    ATOMIC_OPS,
+    Mutation,
+    WriteOp,
+)
+from pegasus_tpu.replica.mutation_log import MutationLog
+from pegasus_tpu.replica.prepare_list import (
+    COMMIT_ALL_READY,
+    COMMIT_TO_DECREE_HARD,
+    COMMIT_TO_DECREE_SOFT,
+    PrepareList,
+)
+from pegasus_tpu.rpc.codec import (
+    OP_CAM,
+    OP_CAS,
+    OP_INCR,
+    OP_MULTI_PUT,
+    OP_MULTI_REMOVE,
+    OP_PUT,
+    OP_REMOVE,
+)
+from pegasus_tpu.server.partition_server import PartitionServer
+from pegasus_tpu.utils.errors import ErrorCode
+
+PREPARE_LIST_CAPACITY = 1024
+
+
+class PartitionStatus(enum.IntEnum):
+    INACTIVE = 0
+    ERROR = 1
+    PRIMARY = 2
+    SECONDARY = 3
+    POTENTIAL_SECONDARY = 4
+
+
+@dataclass
+class ReplicaConfig:
+    """Parity: partition_configuration (idl/dsn.layer2.thrift:34-46)."""
+
+    ballot: int
+    primary: str
+    secondaries: List[str] = field(default_factory=list)
+
+
+# learn types (parity: replica_learn.cpp LT_CACHE/LT_LOG/LT_APP)
+LT_LOG = "log"
+LT_APP = "app"
+
+
+class Replica:
+    """One partition's consensus participant. Messages travel through a
+    transport with `send(src, dst, msg_type, payload)`; the owner
+    registers `on_message` as the receive handler."""
+
+    def __init__(self, name: str, data_dir: str, transport,
+                 app_id: int = 1, pidx: int = 0, partition_count: int = 1,
+                 clock: Optional[Callable[[], float]] = None) -> None:
+        self.name = name
+        self.data_dir = data_dir
+        self.transport = transport
+        self.clock = clock or time.time
+        self.server = PartitionServer(
+            os.path.join(data_dir, "app"), app_id=app_id, pidx=pidx,
+            partition_count=partition_count)
+        self.log = MutationLog(os.path.join(data_dir, "plog", "mlog.bin"))
+
+        self.status = PartitionStatus.INACTIVE
+        self.config = ReplicaConfig(ballot=0, primary="", secondaries=[])
+        self.prepare_list = PrepareList(
+            self.server.engine.last_committed_decree, PREPARE_LIST_CAPACITY,
+            self._apply_mutation)
+        # boot: re-prepare logged mutations beyond the applied decree
+        for mu in self.log.replay(self.log.path):
+            if mu.decree > self.prepare_list.last_committed_decree:
+                self.prepare_list.prepare(mu)
+
+        # primary-side state (parity: primary_context, replica_context.h)
+        self._pending_acks: Dict[int, Set[str]] = {}
+        self._client_callbacks: Dict[int, Callable[[List[Any]], None]] = {}
+        self._learners: Dict[str, int] = {}  # learner -> prepare_start decree
+        # callbacks to the control plane (meta); tests wire these
+        self.on_learn_completed: Optional[Callable[[str], None]] = None
+        self.on_replication_error: Optional[Callable[[str, int], None]] = None
+
+    # ---- lifecycle ----------------------------------------------------
+
+    def close(self) -> None:
+        self.log.close()
+        self.server.close()
+
+    @property
+    def ballot(self) -> int:
+        return self.config.ballot
+
+    @property
+    def last_committed_decree(self) -> int:
+        return self.prepare_list.last_committed_decree
+
+    def last_prepared_decree(self) -> int:
+        return self.prepare_list.max_decree()
+
+    # ---- config (driven by meta / tests) ------------------------------
+
+    def assign_config(self, config: ReplicaConfig) -> None:
+        """Parity: replica_config.cpp ballot-gated role changes."""
+        if config.ballot < self.config.ballot:
+            return  # stale proposal
+        self.config = config
+        if config.primary == self.name:
+            if self.status != PartitionStatus.PRIMARY:
+                self.status = PartitionStatus.PRIMARY
+                # a new primary must not carry uncommitted decrees from an
+                # older window beyond what it can now re-propose; reconcile
+                # by re-preparing its own window under the new ballot
+                self._reprepare_window()
+            else:
+                # membership change while primary (e.g. a failed secondary
+                # removed): open decrees stop waiting for ex-members
+                members = set(config.secondaries) | set(self._learners)
+                for decree in sorted(self._pending_acks):
+                    self._pending_acks[decree] &= members
+                for decree in sorted(self._pending_acks):
+                    if not self._pending_acks[decree]:
+                        del self._pending_acks[decree]
+                        self._on_decree_ready(decree)
+        elif self.name in config.secondaries:
+            self.status = PartitionStatus.SECONDARY
+            self._clear_primary_state()
+        else:
+            self.status = PartitionStatus.INACTIVE
+            self._clear_primary_state()
+
+    def _clear_primary_state(self) -> None:
+        self._pending_acks.clear()
+        self._client_callbacks.clear()
+        self._learners.clear()
+
+    def _reprepare_window(self) -> None:
+        """New primary: re-send every prepared-but-uncommitted mutation
+        under its (new) ballot so the group converges (parity: the
+        reconfiguration path re-proposes the open window)."""
+        for d in range(self.last_committed_decree + 1,
+                       self.last_prepared_decree() + 1):
+            mu = self.prepare_list.get_mutation_by_decree(d)
+            if mu is None:
+                continue
+            remu = replace(mu, ballot=self.config.ballot,
+                           last_committed=self.last_committed_decree)
+            self.prepare_list.prepare(remu)
+            self.log.append(remu)
+            targets = self._prepare_targets(remu.decree)
+            self._pending_acks[remu.decree] = set(targets)
+            self._send_prepares(remu)
+            if not targets:
+                self._on_decree_ready(remu.decree)
+
+    # ---- client write path (primary) ----------------------------------
+
+    def client_write(self, ops: List[WriteOp],
+                     callback: Optional[Callable[[List[Any]], None]] = None
+                     ) -> int:
+        """Parity: on_client_write -> init_prepare (replica_2pc.cpp:113,328).
+        Returns the assigned decree, or raises on gate failure."""
+        if self.status != PartitionStatus.PRIMARY:
+            raise RuntimeError(f"{self.name}: not primary")
+        if any(wo.op in ATOMIC_OPS for wo in ops) and len(ops) > 1:
+            raise ValueError("atomic ops cannot batch with other writes")
+        decree = self.last_prepared_decree() + 1
+        mu = Mutation(
+            ballot=self.config.ballot, decree=decree,
+            last_committed=self.last_committed_decree,
+            timestamp_us=int(self.clock() * 1_000_000), ops=ops)
+        self.prepare_list.prepare(mu)
+        self.log.append(mu)
+        if callback is not None:
+            self._client_callbacks[decree] = callback
+        targets = self._prepare_targets(decree)
+        self._pending_acks[decree] = set(targets)
+        self._send_prepares(mu)
+        if not targets:
+            self._on_decree_ready(decree)
+        return decree
+
+    def _prepare_targets(self, decree: int) -> List[str]:
+        targets = list(self.config.secondaries)
+        targets.extend(l for l, start in self._learners.items()
+                       if decree >= start)
+        return targets
+
+    def _send_prepares(self, mu: Mutation) -> None:
+        blob = mu.encode()
+        for dst in self._prepare_targets(mu.decree):
+            self.transport.send(self.name, dst, "prepare", blob)
+
+    # ---- 2PC message handlers -----------------------------------------
+
+    def on_message(self, src: str, msg_type: str, payload: Any) -> None:
+        handler = getattr(self, f"_on_{msg_type}", None)
+        if handler is None:
+            raise ValueError(f"unknown message type {msg_type}")
+        handler(src, payload)
+
+    def _on_prepare(self, src: str, blob: bytes) -> None:
+        """Parity: on_prepare (replica_2pc.cpp:532)."""
+        mu = Mutation.decode(blob)
+        if mu.ballot < self.config.ballot:
+            self.transport.send(self.name, src, "prepare_ack", {
+                "decree": mu.decree, "ballot": self.config.ballot,
+                "err": int(ErrorCode.ERR_INVALID_STATE)})
+            return
+        if mu.ballot > self.config.ballot:
+            # newer configuration exists that we haven't heard about from
+            # meta yet; adopt the ballot so older primaries are fenced
+            # (reference: the prepare carries the config, replica updates)
+            self.config = replace(self.config, ballot=mu.ballot, primary=src)
+        if self.status not in (PartitionStatus.SECONDARY,
+                               PartitionStatus.POTENTIAL_SECONDARY):
+            self.transport.send(self.name, src, "prepare_ack", {
+                "decree": mu.decree, "ballot": mu.ballot,
+                "err": int(ErrorCode.ERR_INVALID_STATE)})
+            return
+        if self.status == PartitionStatus.SECONDARY:
+            # gap check: a missed prepare (dropped message) leaves a hole a
+            # full secondary can never commit across — it must be removed
+            # and re-added through the learner flow (PacificA
+            # reconfiguration, not voting). A POTENTIAL_SECONDARY is
+            # allowed holes: its learn_response fills them.
+            for d in range(self.last_committed_decree + 1, mu.decree):
+                if self.prepare_list.get_mutation_by_decree(d) is None:
+                    self.transport.send(self.name, src, "prepare_ack", {
+                        "decree": mu.decree, "ballot": mu.ballot,
+                        "err": int(ErrorCode.ERR_INCONSISTENT_STATE)})
+                    return
+        self.prepare_list.prepare(mu)
+        # SAFETY: ack OK only if OUR stored mutation for this decree is the
+        # one this primary sent — prepare() keeps a higher-ballot mutation,
+        # and acking a discarded prepare would let a deposed primary
+        # commit content the group never stored.
+        stored = self.prepare_list.get_mutation_by_decree(mu.decree)
+        accepted = (stored is not None and stored.ballot == mu.ballot) \
+            or mu.decree <= self.last_committed_decree
+        if not accepted:
+            self.transport.send(self.name, src, "prepare_ack", {
+                "decree": mu.decree, "ballot": self.config.ballot,
+                "err": int(ErrorCode.ERR_INVALID_STATE)})
+            return
+        self.log.append(mu)
+        # advance commit point from the piggy-backed primary commit
+        mode = (COMMIT_TO_DECREE_HARD
+                if self.status == PartitionStatus.SECONDARY
+                else COMMIT_TO_DECREE_SOFT)
+        self.prepare_list.commit(min(mu.last_committed, mu.decree - 1), mode)
+        self.transport.send(self.name, src, "prepare_ack", {
+            "decree": mu.decree, "ballot": mu.ballot,
+            "err": int(ErrorCode.ERR_OK)})
+
+    def _on_prepare_ack(self, src: str, ack: dict) -> None:
+        """Parity: on_prepare_reply (replica_2pc.cpp:731)."""
+        if self.status != PartitionStatus.PRIMARY:
+            return
+        decree = ack["decree"]
+        if ack["err"] != int(ErrorCode.ERR_OK):
+            # a member failed this prepare: PacificA removes it via
+            # reconfiguration; surface to the control plane
+            if self.on_replication_error is not None:
+                self.on_replication_error(src, decree)
+            return
+        pending = self._pending_acks.get(decree)
+        if pending is None:
+            return
+        pending.discard(src)
+        if not pending:
+            del self._pending_acks[decree]
+            self._on_decree_ready(decree)
+
+    def _on_decree_ready(self, decree: int) -> None:
+        self.prepare_list.mark_ready(decree)
+        self.prepare_list.commit(decree, COMMIT_ALL_READY)
+
+    def _on_group_check(self, src: str, payload: dict) -> None:
+        """Parity: on_group_check (replica_check.cpp:212) — heartbeat from
+        the primary carrying its commit point."""
+        if payload["ballot"] < self.config.ballot:
+            return
+        target = min(payload["last_committed"], self.last_prepared_decree())
+        if target > self.last_committed_decree:
+            self.prepare_list.commit(target, COMMIT_TO_DECREE_HARD)
+        self.transport.send(self.name, src, "group_check_ack", {
+            "ballot": payload["ballot"],
+            "last_committed": self.last_committed_decree})
+
+    def _on_group_check_ack(self, src: str, payload: dict) -> None:
+        pass  # liveness bookkeeping arrives with the failure detector
+
+    def broadcast_group_check(self) -> None:
+        """Primary heartbeat (parity: group-check timer). Doubles as the
+        lost-ack recovery path: any decree still waiting on acks has its
+        prepare re-sent to the members that haven't answered (prepare is
+        idempotent on the receiver; a re-ack drains the pending set)."""
+        if self.status != PartitionStatus.PRIMARY:
+            return
+        for dst in self.config.secondaries:
+            self.transport.send(self.name, dst, "group_check", {
+                "ballot": self.config.ballot,
+                "last_committed": self.last_committed_decree})
+        for decree, pending in sorted(self._pending_acks.items()):
+            mu = self.prepare_list.get_mutation_by_decree(decree)
+            if mu is None:
+                continue
+            blob = mu.encode()
+            for dst in pending:
+                self.transport.send(self.name, dst, "prepare", blob)
+
+    # ---- apply --------------------------------------------------------
+
+    def _apply_mutation(self, mu: Mutation) -> None:
+        """Committed mutation -> one engine batch (parity:
+        replication_app_base::apply_mutation ->
+        on_batched_write_requests)."""
+        ws = self.server.write_service
+        # deterministic 'now' derived from the primary-assigned timestamp
+        now = max(0, mu.timestamp_us // 1_000_000 - PEGASUS_EPOCH_BEGIN)
+        ts = mu.timestamp_us
+        items: List = []
+        responses: List[Any] = []
+        for wo in mu.ops:
+            if wo.op == OP_PUT:
+                key, user_data, expire_ts = wo.request
+                its = ws.translate_put(key, user_data, expire_ts, ts)
+                responses.append(int(ErrorCode.ERR_OK))
+            elif wo.op == OP_REMOVE:
+                its = ws.translate_remove(wo.request[0])
+                responses.append(int(ErrorCode.ERR_OK))
+            elif wo.op == OP_MULTI_PUT:
+                err, its = ws.translate_multi_put(wo.request, ts, now)
+                responses.append(err)
+            elif wo.op == OP_MULTI_REMOVE:
+                err, count, its = ws.translate_multi_remove(wo.request)
+                responses.append((err, count))
+            elif wo.op == OP_INCR:
+                resp, its = ws.translate_incr(wo.request, ts, now)
+                resp.decree = mu.decree
+                responses.append(resp)
+            elif wo.op == OP_CAS:
+                resp, its = ws.translate_check_and_set(wo.request, ts, now)
+                resp.decree = mu.decree
+                responses.append(resp)
+            elif wo.op == OP_CAM:
+                resp, its = ws.translate_check_and_mutate(wo.request, ts, now)
+                resp.decree = mu.decree
+                responses.append(resp)
+            else:
+                raise ValueError(f"unknown op {wo.op}")
+            items.extend(its)
+        ws.apply_items(items, mu.decree)
+        callback = self._client_callbacks.pop(mu.decree, None)
+        if callback is not None:
+            callback(responses)
+
+    # ---- learning (parity: replica_learn.cpp) -------------------------
+
+    def add_learner(self, learner: str) -> None:
+        """Primary: start shipping new prepares to the learner and tell it
+        to init_learn (parity: RPC_LEARN_ADD_LEARNER)."""
+        if self.status != PartitionStatus.PRIMARY:
+            raise RuntimeError("only the primary adds learners")
+        self._learners[learner] = self.last_prepared_decree() + 1
+        self.transport.send(self.name, learner, "add_learner", {
+            "ballot": self.config.ballot})
+
+    def _on_add_learner(self, src: str, payload: dict) -> None:
+        if payload["ballot"] < self.config.ballot:
+            return
+        self.status = PartitionStatus.POTENTIAL_SECONDARY
+        self.config = replace(self.config, ballot=payload["ballot"],
+                              primary=src)
+        self.transport.send(self.name, src, "learn_request", {
+            "last_committed": self.last_committed_decree})
+
+    def _on_learn_request(self, src: str, payload: dict) -> None:
+        """Primary chooses the learn type (parity: on_learn :361)."""
+        learner_lc = payload["last_committed"]
+        gc_floor = self.server.engine.last_flushed_decree
+        if learner_lc >= gc_floor:
+            # private log covers the gap -> ship mutations (LT_LOG; the
+            # reference's LT_CACHE case folds in: cached mutations are in
+            # the log too)
+            # ship the whole tail INCLUDING the uncommitted window: the
+            # learner must hold every in-flight decree or the first new
+            # prepare after its registration point would hit a gap
+            mutations = self.log.read_range(learner_lc + 1)
+            self.transport.send(self.name, src, "learn_response", {
+                "type": LT_LOG,
+                "mutations": [mu.encode() for mu in mutations],
+                "last_committed": self.last_committed_decree,
+            })
+        else:
+            # gap extends below the log GC floor -> checkpoint copy
+            # (LT_APP). flush so the checkpoint reaches our commit point,
+            # then hand over the sst directory (stands in for the nfs
+            # file copy, src/nfs/nfs_node.h:84).
+            self.server.engine.flush()
+            self.transport.send(self.name, src, "learn_response", {
+                "type": LT_APP,
+                "checkpoint_dir": os.path.join(self.server.engine.data_dir,
+                                               "sst"),
+                "checkpoint_decree": self.server.engine.last_flushed_decree,
+                "mutations": [mu.encode() for mu in self.log.read_range(
+                    self.server.engine.last_flushed_decree + 1)],
+                "last_committed": self.last_committed_decree,
+            })
+
+    def _on_learn_response(self, src: str, payload: dict) -> None:
+        """Learner applies learned state (parity: on_learn_reply :571,
+        on_copy_remote_state_completed :1001)."""
+        if payload["type"] == LT_APP:
+            self._apply_learned_checkpoint(payload["checkpoint_dir"],
+                                           payload["checkpoint_decree"])
+        for blob in payload["mutations"]:
+            mu = Mutation.decode(blob)
+            if mu.decree <= self.last_committed_decree:
+                continue
+            self.prepare_list.prepare(mu)
+            self.log.append(mu)
+        self.prepare_list.commit(payload["last_committed"],
+                                 COMMIT_TO_DECREE_HARD)
+        self.transport.send(self.name, src, "learn_completion", {})
+
+    def _apply_learned_checkpoint(self, checkpoint_dir: str,
+                                  checkpoint_decree: int) -> None:
+        """Replace local storage with the learned checkpoint (parity:
+        storage_apply_checkpoint, replication_app_base.h:229)."""
+        from pegasus_tpu.storage.engine import StorageEngine
+
+        app_dir = self.server.engine.data_dir
+        self.server.engine.close()
+        sst_dir = os.path.join(app_dir, "sst")
+        shutil.rmtree(sst_dir, ignore_errors=True)
+        shutil.copytree(checkpoint_dir, sst_dir)
+        wal = os.path.join(app_dir, "wal.log")
+        if os.path.exists(wal):
+            os.remove(wal)
+        self.server.engine = StorageEngine(app_dir)
+        self.server.write_service.engine = self.server.engine
+        if self.server.engine.last_committed_decree < checkpoint_decree:
+            raise RuntimeError(
+                f"learned checkpoint reaches decree "
+                f"{self.server.engine.last_committed_decree}, primary "
+                f"advertised {checkpoint_decree}")
+        self.prepare_list.reset(self.server.engine.last_committed_decree)
+
+    def _on_learn_completion(self, src: str, payload: dict) -> None:
+        """Primary: learner caught up; hand to the control plane for the
+        config change that upgrades it (parity:
+        RPC_LEARN_COMPLETION_NOTIFY -> meta config update)."""
+        if self.on_learn_completed is not None:
+            self.on_learn_completed(src)
+
+    # ---- maintenance --------------------------------------------------
+
+    def flush_and_gc_log(self) -> None:
+        """Make storage durable, then GC the private log below the durable
+        decree (parity: mutation_log GC by durable decree)."""
+        self.server.engine.flush()
+        self.log.gc(self.server.engine.last_flushed_decree)

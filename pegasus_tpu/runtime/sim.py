@@ -1,0 +1,116 @@
+"""Deterministic discrete-event loop + simulated network.
+
+Parity: the reference's simulator tool (src/runtime/simulator.h:63) with
+its seeded random env (src/runtime/env.sim.h:36) and fault-injectable
+simulated network (src/rpc/network.sim.h:86). Every delay and every
+drop decision comes from one seeded RNG, so a failing cluster schedule
+replays exactly from its seed — the property the reference's simple_kv
+.act harness is built on (SURVEY §4.2).
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import numpy as np
+
+
+class SimLoop:
+    """Virtual-clock event loop. Time only advances between events."""
+
+    def __init__(self, seed: int = 0) -> None:
+        self.rng = np.random.default_rng(seed)
+        self.now = 0.0
+        self._heap: list = []
+        self._seq = itertools.count()
+
+    def schedule(self, delay: float, fn: Callable[[], None]) -> None:
+        heapq.heappush(self._heap,
+                       (self.now + max(0.0, delay), next(self._seq), fn))
+
+    def run_until_idle(self, max_events: int = 1_000_000) -> int:
+        """Drain all events; returns the number processed."""
+        n = 0
+        while self._heap and n < max_events:
+            t, _, fn = heapq.heappop(self._heap)
+            self.now = t
+            fn()
+            n += 1
+        return n
+
+    def run_for(self, duration: float, max_events: int = 1_000_000) -> int:
+        deadline = self.now + duration
+        n = 0
+        while self._heap and n < max_events and self._heap[0][0] <= deadline:
+            t, _, fn = heapq.heappop(self._heap)
+            self.now = t
+            fn()
+            n += 1
+        self.now = max(self.now, deadline)
+        return n
+
+
+class SimNetwork:
+    """Message delivery with seeded delay and per-link fault injection.
+
+    Parity: network.sim + the toollet fault_injector's rpc drop/delay
+    knobs (src/runtime/fault_injector.cpp:62-118), configured per link
+    (src, dst) or globally.
+    """
+
+    def __init__(self, loop: SimLoop, base_delay: float = 0.001,
+                 jitter: float = 0.001) -> None:
+        self.loop = loop
+        self.base_delay = base_delay
+        self.jitter = jitter
+        self._handlers: Dict[str, Callable[[str, str, Any], None]] = {}
+        self._drop_prob: Dict[Optional[Tuple[str, str]], float] = {}
+        self._partitioned: set = set()
+        # per-link FIFO: messages on one (src, dst) link never reorder
+        # (parity: rDSN rides TCP; the 2PC protocol assumes ordered
+        # delivery per connection)
+        self._link_clock: Dict[Tuple[str, str], float] = {}
+        self.delivered = 0
+        self.dropped = 0
+
+    def register(self, addr: str,
+                 handler: Callable[[str, str, Any], None]) -> None:
+        """handler(src, msg_type, payload)"""
+        self._handlers[addr] = handler
+
+    def set_drop(self, prob: float, src: Optional[str] = None,
+                 dst: Optional[str] = None) -> None:
+        key = None if src is None and dst is None else (src, dst)
+        self._drop_prob[key] = prob
+
+    def partition(self, addr: str) -> None:
+        """Cut a node off entirely (both directions)."""
+        self._partitioned.add(addr)
+
+    def heal(self, addr: str) -> None:
+        self._partitioned.discard(addr)
+
+    def send(self, src: str, dst: str, msg_type: str, payload: Any) -> None:
+        if src in self._partitioned or dst in self._partitioned:
+            self.dropped += 1
+            return
+        prob = self._drop_prob.get((src, dst),
+                                   self._drop_prob.get(None, 0.0))
+        if prob > 0 and self.loop.rng.random() < prob:
+            self.dropped += 1
+            return
+        delay = self.base_delay + self.loop.rng.random() * self.jitter
+        deliver_at = max(self.loop.now + delay,
+                         self._link_clock.get((src, dst), 0.0))
+        self._link_clock[(src, dst)] = deliver_at
+        delay = deliver_at - self.loop.now
+
+        def deliver() -> None:
+            handler = self._handlers.get(dst)
+            if handler is not None and dst not in self._partitioned:
+                self.delivered += 1
+                handler(src, msg_type, payload)
+
+        self.loop.schedule(delay, deliver)

@@ -1,16 +1,23 @@
-"""Write service: applies one decree's worth of client writes.
+"""Write service: translate client writes into engine batches, then apply.
 
 Parity: src/server/pegasus_write_service.{h,cpp} +
-pegasus_write_service_impl.h — batch_prepare/batch_commit produce ONE
-engine write batch per decree; atomic ops (incr / check_and_set /
-check_and_mutate) are read-modify-write evaluated here under the
-single-writer-per-partition invariant (enforced by the partition server's
-write lock, mirroring the reference's per-gpid thread pinning,
-replica_2pc.cpp:115).
+pegasus_write_service_impl.h — the two-phase shape mirrors
+batch_prepare/batch_commit: `translate_*` turns client requests into
+WriteBatchItems (atomic ops are read-modify-write evaluated here, under
+the single-writer-per-partition invariant, replica_2pc.cpp:115), and
+`apply_items` commits ONE engine batch per decree. Replication calls
+translate+apply at mutation-apply time on every replica (deterministic by
+decree order, like the reference's default non-idempotent mode); the
+standalone server fuses them per request.
 
-Value encoding: every stored value is pegasus-encoded
-([expire_ts][timetag?][user_data], base/pegasus_value_schema.h) and the
-decoded expire_ts additionally rides the engine's columnar expiry column.
+Determinism: the timetag timestamp comes from the caller (the mutation's
+primary-assigned timestamp) so every replica writes identical value bytes
+— reference parity: mutation timestamps are primary-assigned
+(src/replica/mutation.h) and duplication relies on them.
+
+Batching rule parity (mutation.cpp:390,553): multiple put/remove-class
+requests may share one mutation; atomic ops (incr/cas/cam) never batch
+with anything else.
 """
 
 from __future__ import annotations
@@ -75,22 +82,18 @@ def cas_check_passed(check_type: int, operand: bytes,
         return value.startswith(operand)
     if ct == CasCheckType.CT_VALUE_MATCH_POSTFIX:
         return value.endswith(operand)
-    if ct in (CasCheckType.CT_VALUE_BYTES_LESS,
-              CasCheckType.CT_VALUE_BYTES_LESS_OR_EQUAL,
-              CasCheckType.CT_VALUE_BYTES_EQUAL,
-              CasCheckType.CT_VALUE_BYTES_GREATER_OR_EQUAL,
-              CasCheckType.CT_VALUE_BYTES_GREATER):
-        if ct == CasCheckType.CT_VALUE_BYTES_LESS:
-            return value < operand
-        if ct == CasCheckType.CT_VALUE_BYTES_LESS_OR_EQUAL:
-            return value <= operand
-        if ct == CasCheckType.CT_VALUE_BYTES_EQUAL:
-            return value == operand
-        if ct == CasCheckType.CT_VALUE_BYTES_GREATER_OR_EQUAL:
-            return value >= operand
+    if ct == CasCheckType.CT_VALUE_BYTES_LESS:
+        return value < operand
+    if ct == CasCheckType.CT_VALUE_BYTES_LESS_OR_EQUAL:
+        return value <= operand
+    if ct == CasCheckType.CT_VALUE_BYTES_EQUAL:
+        return value == operand
+    if ct == CasCheckType.CT_VALUE_BYTES_GREATER_OR_EQUAL:
+        return value >= operand
+    if ct == CasCheckType.CT_VALUE_BYTES_GREATER:
         return value > operand
-    # int compares: both sides must parse as int64 (reference uses
-    # buf2int64; failure -> kInvalidArgument)
+    # int compares: both sides must parse as int64 (reference buf2int64;
+    # failure -> kInvalidArgument)
     v = _parse_int64(value)
     o = _parse_int64(operand)
     if ct == CasCheckType.CT_VALUE_INT_LESS:
@@ -128,22 +131,14 @@ class WriteService:
 
     # -- helpers --------------------------------------------------------
 
-    def _make_value(self, user_data: bytes, expire_ts: int) -> bytes:
+    def _make_value(self, user_data: bytes, expire_ts: int,
+                    timestamp_us: Optional[int]) -> bytes:
         timetag = 0
         if self.data_version >= 1:
-            timetag = generate_timetag(int(time.time() * 1_000_000),
-                                       self.cluster_id, False)
+            ts = (timestamp_us if timestamp_us is not None
+                  else int(time.time() * 1_000_000))
+            timetag = generate_timetag(ts, self.cluster_id, False)
         return generate_value(self.data_version, user_data, expire_ts, timetag)
-
-    def _visible_user_data(self, key: bytes,
-                           now: int) -> Optional[bytes]:
-        hit = self.engine.get(key)
-        if hit is None:
-            return None
-        value, ets = hit
-        if check_if_ts_expired(now, ets):
-            return None
-        return extract_user_data(self.data_version, value)
 
     def _visible(self, key: bytes, now: int
                  ) -> Optional[Tuple[bytes, int]]:
@@ -155,48 +150,55 @@ class WriteService:
             return None
         return value, ets
 
-    # -- simple writes --------------------------------------------------
+    def _visible_user_data(self, key: bytes, now: int) -> Optional[bytes]:
+        hit = self._visible(key, now)
+        if hit is None:
+            return None
+        return extract_user_data(self.data_version, hit[0])
 
-    def put(self, key: bytes, user_data: bytes, expire_ts: int,
-            decree: int) -> int:
-        value = self._make_value(user_data, expire_ts)
-        self.engine.write_batch(
-            [WriteBatchItem(OP_PUT, key, value, expire_ts)], decree)
-        return int(StorageStatus.OK)
+    # -- translate phase ------------------------------------------------
 
-    def remove(self, key: bytes, decree: int) -> int:
-        self.engine.write_batch([WriteBatchItem(OP_DEL, key)], decree)
-        return int(StorageStatus.OK)
+    def translate_put(self, key: bytes, user_data: bytes, expire_ts: int,
+                      timestamp_us: Optional[int] = None
+                      ) -> List[WriteBatchItem]:
+        value = self._make_value(user_data, expire_ts, timestamp_us)
+        return [WriteBatchItem(OP_PUT, key, value, expire_ts)]
 
-    def multi_put(self, req: MultiPutRequest, decree: int) -> int:
+    def translate_remove(self, key: bytes) -> List[WriteBatchItem]:
+        return [WriteBatchItem(OP_DEL, key)]
+
+    def translate_multi_put(self, req: MultiPutRequest,
+                            timestamp_us: Optional[int] = None,
+                            now: Optional[int] = None
+                            ) -> Tuple[int, List[WriteBatchItem]]:
         if not req.kvs:
-            return int(StorageStatus.INVALID_ARGUMENT)
-        expire_ts = expire_ts_from_ttl(req.expire_ts_seconds)
-        items = []
-        for kv in req.kvs:
-            key = generate_key(req.hash_key, kv.key)
-            items.append(WriteBatchItem(
-                OP_PUT, key, self._make_value(kv.value, expire_ts), expire_ts))
-        self.engine.write_batch(items, decree)
-        return int(StorageStatus.OK)
+            return int(StorageStatus.INVALID_ARGUMENT), []
+        expire_ts = expire_ts_from_ttl(req.expire_ts_seconds, now)
+        items = [
+            WriteBatchItem(
+                OP_PUT, generate_key(req.hash_key, kv.key),
+                self._make_value(kv.value, expire_ts, timestamp_us),
+                expire_ts)
+            for kv in req.kvs
+        ]
+        return int(StorageStatus.OK), items
 
-    def multi_remove(self, req: MultiRemoveRequest, decree: int
-                     ) -> Tuple[int, int]:
-        """Returns (error, removed_count)."""
+    def translate_multi_remove(self, req: MultiRemoveRequest
+                               ) -> Tuple[int, int, List[WriteBatchItem]]:
         if not req.sort_keys:
-            return int(StorageStatus.INVALID_ARGUMENT), 0
+            return int(StorageStatus.INVALID_ARGUMENT), 0, []
         items = [WriteBatchItem(OP_DEL, generate_key(req.hash_key, sk))
                  for sk in req.sort_keys]
-        self.engine.write_batch(items, decree)
-        return int(StorageStatus.OK), len(items)
+        return int(StorageStatus.OK), len(items), items
 
-    # -- atomic ops -----------------------------------------------------
-
-    def incr(self, req: IncrRequest, decree: int) -> IncrResponse:
+    def translate_incr(self, req: IncrRequest,
+                       timestamp_us: Optional[int] = None,
+                       now: Optional[int] = None
+                       ) -> Tuple[IncrResponse, List[WriteBatchItem]]:
         """Parity: pegasus_write_service_impl.h incr — missing/expired
         record counts as 0; non-numeric or overflow -> kInvalidArgument;
         expire_ts_seconds: 0 keeps the old TTL, >0 resets, <0 clears."""
-        now = epoch_now()
+        now = epoch_now() if now is None else now
         resp = IncrResponse()
         old = self._visible(req.key, now)
         if old is None:
@@ -211,27 +213,29 @@ class WriteService:
                     old_int = _parse_int64(data)
                 except ValueError:
                     resp.error = int(StorageStatus.INVALID_ARGUMENT)
-                    return resp
+                    return resp, []
         new_int = old_int + req.increment
         if not (_INT64_MIN <= new_int <= _INT64_MAX):
             resp.error = int(StorageStatus.INVALID_ARGUMENT)
             resp.new_value = old_int
-            return resp
+            return resp, []
         if req.expire_ts_seconds == 0:
             new_ets = old_ets
         elif req.expire_ts_seconds > 0:
             new_ets = expire_ts_from_ttl(req.expire_ts_seconds, now)
         else:
             new_ets = 0
-        self.put(req.key, str(new_int).encode(), new_ets, decree)
         resp.error = int(StorageStatus.OK)
         resp.new_value = new_int
-        resp.decree = decree
-        return resp
+        return resp, self.translate_put(req.key, str(new_int).encode(),
+                                        new_ets, timestamp_us)
 
-    def check_and_set(self, req: CheckAndSetRequest, decree: int
-                      ) -> CheckAndSetResponse:
-        now = epoch_now()
+    def translate_check_and_set(self, req: CheckAndSetRequest,
+                                timestamp_us: Optional[int] = None,
+                                now: Optional[int] = None
+                                ) -> Tuple[CheckAndSetResponse,
+                                           List[WriteBatchItem]]:
+        now = epoch_now() if now is None else now
         resp = CheckAndSetResponse()
         check_key = generate_key(req.hash_key, req.check_sort_key)
         check_value = self._visible_user_data(check_key, now)
@@ -245,27 +249,29 @@ class WriteService:
                                       check_value)
         except ValueError:
             resp.error = int(StorageStatus.INVALID_ARGUMENT)
-            return resp
+            return resp, []
         if not passed:
             resp.error = int(StorageStatus.TRY_AGAIN)
-            return resp
+            return resp, []
         set_sort_key = (req.set_sort_key if req.set_diff_sort_key
                         else req.check_sort_key)
-        expire_ts = expire_ts_from_ttl(req.set_expire_ts_seconds, now) \
-            if req.set_expire_ts_seconds > 0 else 0
-        self.put(generate_key(req.hash_key, set_sort_key), req.set_value,
-                 expire_ts, decree)
+        expire_ts = (expire_ts_from_ttl(req.set_expire_ts_seconds, now)
+                     if req.set_expire_ts_seconds > 0 else 0)
         resp.error = int(StorageStatus.OK)
-        resp.decree = decree
-        return resp
+        return resp, self.translate_put(
+            generate_key(req.hash_key, set_sort_key), req.set_value,
+            expire_ts, timestamp_us)
 
-    def check_and_mutate(self, req: CheckAndMutateRequest, decree: int
-                         ) -> CheckAndMutateResponse:
-        now = epoch_now()
+    def translate_check_and_mutate(self, req: CheckAndMutateRequest,
+                                   timestamp_us: Optional[int] = None,
+                                   now: Optional[int] = None
+                                   ) -> Tuple[CheckAndMutateResponse,
+                                              List[WriteBatchItem]]:
+        now = epoch_now() if now is None else now
         resp = CheckAndMutateResponse()
         if not req.mutate_list:
             resp.error = int(StorageStatus.INVALID_ARGUMENT)
-            return resp
+            return resp, []
         check_key = generate_key(req.hash_key, req.check_sort_key)
         check_value = self._visible_user_data(check_key, now)
         if req.return_check_value:
@@ -278,21 +284,76 @@ class WriteService:
                                       check_value)
         except ValueError:
             resp.error = int(StorageStatus.INVALID_ARGUMENT)
-            return resp
+            return resp, []
         if not passed:
             resp.error = int(StorageStatus.TRY_AGAIN)
-            return resp
-        items = []
+            return resp, []
+        items: List[WriteBatchItem] = []
         for m in req.mutate_list:
             key = generate_key(req.hash_key, m.sort_key)
             if m.operation == MutateOperation.MO_DELETE:
                 items.append(WriteBatchItem(OP_DEL, key))
             else:
-                ets = expire_ts_from_ttl(m.set_expire_ts_seconds, now) \
-                    if m.set_expire_ts_seconds > 0 else 0
+                ets = (expire_ts_from_ttl(m.set_expire_ts_seconds, now)
+                       if m.set_expire_ts_seconds > 0 else 0)
                 items.append(WriteBatchItem(
-                    OP_PUT, key, self._make_value(m.value, ets), ets))
-        self.engine.write_batch(items, decree)
+                    OP_PUT, key, self._make_value(m.value, ets, timestamp_us),
+                    ets))
         resp.error = int(StorageStatus.OK)
-        resp.decree = decree
+        return resp, items
+
+    # -- apply phase ----------------------------------------------------
+
+    def apply_items(self, items: List[WriteBatchItem], decree: int) -> None:
+        """One engine batch per decree; empty item lists still advance the
+        decree (reference empty_put, pegasus_write_service.cpp:210 — a
+        no-op write that carries the decree watermark)."""
+        self.engine.write_batch(items, decree)
+
+    # -- fused convenience (standalone mode) ----------------------------
+
+    def put(self, key: bytes, user_data: bytes, expire_ts: int,
+            decree: int) -> int:
+        self.apply_items(self.translate_put(key, user_data, expire_ts),
+                         decree)
+        return int(StorageStatus.OK)
+
+    def remove(self, key: bytes, decree: int) -> int:
+        self.apply_items(self.translate_remove(key), decree)
+        return int(StorageStatus.OK)
+
+    def multi_put(self, req: MultiPutRequest, decree: int) -> int:
+        err, items = self.translate_multi_put(req)
+        if err == int(StorageStatus.OK):
+            self.apply_items(items, decree)
+        return err
+
+    def multi_remove(self, req: MultiRemoveRequest, decree: int
+                     ) -> Tuple[int, int]:
+        err, count, items = self.translate_multi_remove(req)
+        if err == int(StorageStatus.OK):
+            self.apply_items(items, decree)
+        return err, count
+
+    def incr(self, req: IncrRequest, decree: int) -> IncrResponse:
+        resp, items = self.translate_incr(req)
+        if resp.error == int(StorageStatus.OK):
+            self.apply_items(items, decree)
+            resp.decree = decree
+        return resp
+
+    def check_and_set(self, req: CheckAndSetRequest, decree: int
+                      ) -> CheckAndSetResponse:
+        resp, items = self.translate_check_and_set(req)
+        if resp.error == int(StorageStatus.OK):
+            self.apply_items(items, decree)
+            resp.decree = decree
+        return resp
+
+    def check_and_mutate(self, req: CheckAndMutateRequest, decree: int
+                         ) -> CheckAndMutateResponse:
+        resp, items = self.translate_check_and_mutate(req)
+        if resp.error == int(StorageStatus.OK):
+            self.apply_items(items, decree)
+            resp.decree = decree
         return resp

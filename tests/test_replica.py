@@ -1,0 +1,445 @@
+"""PacificA replication tests under the deterministic simulator.
+
+Modeled on the reference's simple_kv .act harness (SURVEY §4.2): a whole
+replica group runs in one process over SimLoop/SimNetwork with seeded
+delays, so every schedule replays exactly from its seed.
+"""
+
+import os
+
+import pytest
+
+from pegasus_tpu.base.key_schema import generate_key
+from pegasus_tpu.replica import (
+    Mutation,
+    MutationLog,
+    PartitionStatus,
+    PrepareList,
+    Replica,
+    ReplicaConfig,
+    WriteOp,
+)
+from pegasus_tpu.replica.prepare_list import (
+    COMMIT_ALL_READY,
+    COMMIT_TO_DECREE_HARD,
+)
+from pegasus_tpu.rpc.codec import OP_INCR, OP_PUT, OP_REMOVE
+from pegasus_tpu.runtime import SimLoop, SimNetwork
+from pegasus_tpu.server.types import IncrRequest
+from pegasus_tpu.utils.errors import StorageStatus
+
+
+def k(h, s=""):
+    return generate_key(h if isinstance(h, bytes) else h.encode(),
+                        s if isinstance(s, bytes) else s.encode())
+
+
+def put_op(hk, sk, value, ets=0):
+    return WriteOp(OP_PUT, (k(hk, sk), value, ets))
+
+
+class Cluster:
+    """Test control plane: wires N replicas over a SimNetwork and plays
+    the meta role (config assignment, learner upgrades)."""
+
+    def __init__(self, tmp_path, names=("r1", "r2", "r3"), seed=0):
+        self.loop = SimLoop(seed=seed)
+        self.net = SimNetwork(self.loop)
+        self.replicas = {}
+        for name in names:
+            r = Replica(name, str(tmp_path / name), self.net,
+                        clock=lambda: 1_700_000_000 + self.loop.now)
+            self.net.register(name, r.on_message)
+            self.replicas[name] = r
+        self.ballot = 1
+        self.config = ReplicaConfig(self.ballot, names[0],
+                                    list(names[1:]))
+        for r in self.replicas.values():
+            r.assign_config(self.config)
+
+    @property
+    def primary(self):
+        return self.replicas[self.config.primary]
+
+    def reconfigure(self, primary, secondaries):
+        self.ballot += 1
+        self.config = ReplicaConfig(self.ballot, primary, list(secondaries))
+        for r in self.replicas.values():
+            r.assign_config(self.config)
+
+    def write(self, ops, callback=None):
+        decree = self.primary.client_write(ops, callback)
+        self.loop.run_until_idle()
+        return decree
+
+    def close(self):
+        for r in self.replicas.values():
+            r.close()
+
+
+# ---- unit: prepare list / mutation codec ------------------------------
+
+
+def test_mutation_codec_roundtrip():
+    mu = Mutation(ballot=3, decree=17, last_committed=16,
+                  timestamp_us=123456789,
+                  ops=[put_op("h", "s", b"v", 99),
+                       WriteOp(OP_REMOVE, (k("h", "x"),)),
+                       WriteOp(OP_INCR, IncrRequest(k("h", "c"), 5, -1))])
+    mu2 = Mutation.decode(mu.encode())
+    assert mu2.ballot == 3 and mu2.decree == 17 and mu2.last_committed == 16
+    assert len(mu2.ops) == 3
+    assert mu2.ops[0].request == (k("h", "s"), b"v", 99)
+    assert mu2.ops[2].request.increment == 5
+    assert mu2.ops[2].request.expire_ts_seconds == -1
+
+
+def test_prepare_list_commit_modes():
+    committed = []
+    pl = PrepareList(0, 16, committed.append)
+    mus = [Mutation(1, d, d - 1, 0, []) for d in range(1, 5)]
+    for mu in mus:
+        pl.prepare(mu)
+    # ALL_READY commits only the contiguous acked prefix
+    pl.mark_ready(2)
+    assert pl.commit(2, COMMIT_ALL_READY) == 0  # decree 1 not ready
+    pl.mark_ready(1)
+    assert pl.commit(1, COMMIT_ALL_READY) == 2  # 1 then 2
+    assert pl.last_committed_decree == 2
+    # HARD commit advances through prepared decrees
+    assert pl.commit(4, COMMIT_TO_DECREE_HARD) == 2
+    # gap -> fatal
+    pl.prepare(Mutation(1, 7, 4, 0, []))
+    with pytest.raises(RuntimeError):
+        pl.commit(7, COMMIT_TO_DECREE_HARD)
+
+
+def test_prepare_list_higher_ballot_wins():
+    pl = PrepareList(0, 16, lambda mu: None)
+    pl.prepare(Mutation(2, 1, 0, 0, [put_op("h", "a", b"new")]))
+    pl.prepare(Mutation(1, 1, 0, 0, [put_op("h", "a", b"old")]))
+    assert pl.get_mutation_by_decree(1).ballot == 2
+
+
+def test_mutation_log_replay_and_gc(tmp_path):
+    path = str(tmp_path / "plog" / "m.bin")
+    log = MutationLog(path)
+    for d in range(1, 6):
+        log.append(Mutation(1, d, d - 1, 0, [put_op("h", "s%d" % d, b"v")]))
+    log.close()
+    log2 = MutationLog(path)
+    assert log2.max_decree == 5
+    assert [mu.decree for mu in log2.read_range(3)] == [3, 4, 5]
+    log2.gc(3)
+    assert [mu.decree for mu in log2.read_range(1)] == [4, 5]
+    log2.close()
+
+
+# ---- group: 2PC over the simulator ------------------------------------
+
+
+def test_three_replica_commit_flow(tmp_path):
+    c = Cluster(tmp_path)
+    try:
+        results = []
+        c.write([put_op("u", "s1", b"v1")], results.append)
+        assert results and results[0] == [0]
+        # primary committed
+        assert c.primary.last_committed_decree == 1
+        # secondaries committed via piggy-back on the NEXT prepare
+        c.write([put_op("u", "s2", b"v2")])
+        for name in ("r2", "r3"):
+            assert c.replicas[name].last_committed_decree >= 1
+        # group check pushes the final commit point everywhere
+        c.primary.broadcast_group_check()
+        c.loop.run_until_idle()
+        for r in c.replicas.values():
+            assert r.last_committed_decree == 2
+            assert r.server.on_get(k("u", "s1")) == (0, b"v1")
+            assert r.server.on_get(k("u", "s2")) == (0, b"v2")
+    finally:
+        c.close()
+
+
+def test_batched_and_atomic_mutations(tmp_path):
+    c = Cluster(tmp_path)
+    try:
+        c.write([put_op("u", "a", b"1"), put_op("u", "b", b"2"),
+                 WriteOp(OP_REMOVE, (k("u", "a"),))])
+        results = []
+        c.write([WriteOp(OP_INCR, IncrRequest(k("u", "cnt"), 42))],
+                results.append)
+        assert results[0][0].new_value == 42
+        c.primary.broadcast_group_check()
+        c.loop.run_until_idle()
+        for r in c.replicas.values():
+            assert r.server.on_get(k("u", "a"))[0] == 1  # removed
+            assert r.server.on_get(k("u", "b")) == (0, b"2")
+            assert r.server.on_get(k("u", "cnt")) == (0, b"42")
+        # atomic ops may not batch
+        with pytest.raises(ValueError):
+            c.primary.client_write([
+                WriteOp(OP_INCR, IncrRequest(k("u", "c"), 1)),
+                put_op("u", "d", b"x")])
+    finally:
+        c.close()
+
+
+def test_value_bytes_identical_across_replicas(tmp_path):
+    # timetag determinism: every replica must store identical value bytes
+    c = Cluster(tmp_path)
+    try:
+        c.write([put_op("u", "s", b"payload")])
+        c.primary.broadcast_group_check()
+        c.loop.run_until_idle()
+        raws = [r.server.engine.get(k("u", "s"))[0]
+                for r in c.replicas.values()]
+        assert raws[0] == raws[1] == raws[2]
+    finally:
+        c.close()
+
+
+def test_failover_promote_secondary(tmp_path):
+    c = Cluster(tmp_path)
+    try:
+        for i in range(5):
+            c.write([put_op("u", "s%d" % i, b"v%d" % i)])
+        c.primary.broadcast_group_check()
+        c.loop.run_until_idle()
+        # primary dies; meta promotes r2 with ballot+1
+        c.net.partition("r1")
+        c.reconfigure("r2", ["r3"])
+        c.loop.run_until_idle()
+        assert c.replicas["r2"].status == PartitionStatus.PRIMARY
+        assert c.replicas["r2"].ballot == 2
+        # writes continue through the new primary
+        c.write([put_op("u", "after", b"failover")])
+        c.replicas["r2"].broadcast_group_check()
+        c.loop.run_until_idle()
+        assert c.replicas["r3"].server.on_get(k("u", "after")) == (
+            0, b"failover")
+        # old data intact
+        assert c.replicas["r2"].server.on_get(k("u", "s3")) == (0, b"v3")
+    finally:
+        c.close()
+
+
+def test_new_primary_repropose_uncommitted_window(tmp_path):
+    c = Cluster(tmp_path)
+    try:
+        # drop all acks from secondaries -> primary can't commit
+        c.net.set_drop(1.0, src="r2", dst="r1")
+        c.net.set_drop(1.0, src="r3", dst="r1")
+        c.write([put_op("u", "s", b"v")])
+        assert c.primary.last_committed_decree == 0  # stuck
+        assert c.replicas["r2"].last_prepared_decree() == 1
+        # old primary dies; r2 promoted; its re-propose commits the window
+        c.net.partition("r1")
+        c.reconfigure("r2", ["r3"])
+        c.loop.run_until_idle()
+        assert c.replicas["r2"].last_committed_decree == 1
+        assert c.replicas["r2"].server.on_get(k("u", "s")) == (0, b"v")
+    finally:
+        c.close()
+
+
+def test_learner_catchup_via_log(tmp_path):
+    c = Cluster(tmp_path, names=("r1", "r2"))
+    try:
+        c.reconfigure("r1", ["r2"])
+        for i in range(8):
+            c.write([put_op("u", "s%d" % i, b"v%d" % i)])
+        # r4 joins empty
+        r4 = Replica("r4", str(tmp_path / "r4"), c.net,
+                     clock=lambda: 1_700_000_000 + c.loop.now)
+        c.net.register("r4", r4.on_message)
+        c.replicas["r4"] = r4
+        upgraded = []
+        c.primary.on_learn_completed = upgraded.append
+        c.primary.add_learner("r4")
+        c.loop.run_until_idle()
+        assert upgraded == ["r4"]
+        # meta upgrades to secondary
+        c.reconfigure("r1", ["r2", "r4"])
+        c.write([put_op("u", "after", b"learn")])
+        c.primary.broadcast_group_check()
+        c.loop.run_until_idle()
+        assert r4.status == PartitionStatus.SECONDARY
+        assert r4.server.on_get(k("u", "s5")) == (0, b"v5")
+        assert r4.server.on_get(k("u", "after")) == (0, b"learn")
+    finally:
+        c.close()
+
+
+def test_learner_catchup_via_checkpoint(tmp_path):
+    c = Cluster(tmp_path, names=("r1", "r2"))
+    try:
+        c.reconfigure("r1", ["r2"])
+        for i in range(10):
+            c.write([put_op("u", "s%02d" % i, b"v%d" % i)])
+        # flush + GC the primary's log: the early decrees now live only in
+        # storage -> learner must take the LT_APP path
+        c.primary.flush_and_gc_log()
+        assert c.primary.log.read_range(1) == []
+        for i in range(10, 14):
+            c.write([put_op("u", "s%02d" % i, b"v%d" % i)])
+        r4 = Replica("r4", str(tmp_path / "r4"), c.net,
+                     clock=lambda: 1_700_000_000 + c.loop.now)
+        c.net.register("r4", r4.on_message)
+        c.replicas["r4"] = r4
+        c.primary.add_learner("r4")
+        c.loop.run_until_idle()
+        c.reconfigure("r1", ["r2", "r4"])
+        c.write([put_op("u", "after", b"ckpt")])
+        c.primary.broadcast_group_check()
+        c.loop.run_until_idle()
+        for i in range(14):
+            assert r4.server.on_get(k("u", "s%02d" % i)) == (
+                0, b"v%d" % i), i
+        assert r4.server.on_get(k("u", "after")) == (0, b"ckpt")
+    finally:
+        c.close()
+
+
+def test_secondary_gap_detected_and_reported(tmp_path):
+    c = Cluster(tmp_path)
+    try:
+        errors = []
+        c.primary.on_replication_error = lambda src, d: errors.append(src)
+        # r3 misses decree 1 (dropped prepare)
+        c.net.set_drop(1.0, src="r1", dst="r3")
+        c.write([put_op("u", "s1", b"v1")])
+        c.net.set_drop(0.0, src="r1", dst="r3")
+        # decree 2 arrives at r3 -> gap detected -> error ack
+        c.write([put_op("u", "s2", b"v2")])
+        assert errors == ["r3"]
+        # meta removes r3; the stuck decrees commit with the smaller group
+        c.reconfigure("r1", ["r2"])
+        c.loop.run_until_idle()
+        assert c.primary.last_committed_decree == 2
+    finally:
+        c.close()
+
+
+def test_replica_restart_recovers_from_log(tmp_path):
+    c = Cluster(tmp_path, names=("r1", "r2"))
+    try:
+        c.reconfigure("r1", ["r2"])
+        for i in range(6):
+            c.write([put_op("u", "s%d" % i, b"v%d" % i)])
+        c.primary.broadcast_group_check()
+        c.loop.run_until_idle()
+        lc = c.replicas["r2"].last_committed_decree
+        c.replicas["r2"].close()
+        # restart r2 from disk
+        r2 = Replica("r2", str(tmp_path / "r2"), c.net,
+                     clock=lambda: 1_700_000_000 + c.loop.now)
+        c.net.register("r2", r2.on_message)
+        c.replicas["r2"] = r2
+        assert r2.last_committed_decree == lc
+        r2.assign_config(c.config)
+        c.write([put_op("u", "post", b"restart")])
+        c.primary.broadcast_group_check()
+        c.loop.run_until_idle()
+        assert r2.server.on_get(k("u", "post")) == (0, b"restart")
+        assert r2.server.on_get(k("u", "s2")) == (0, b"v2")
+    finally:
+        c.close()
+
+
+def test_deposed_primary_cannot_commit_divergent_content(tmp_path):
+    # regression (safety): a ballot-1 prepare arriving where a ballot-2
+    # mutation for the same decree is stored must NOT get an OK ack
+    c = Cluster(tmp_path)
+    try:
+        c.write([put_op("u", "s0", b"v0")])
+        # r2 promoted with ballot 2 but r1 doesn't know (no config update
+        # delivered to r1) and keeps writing
+        c.replicas["r2"].assign_config(ReplicaConfig(2, "r2", ["r3"]))
+        c.replicas["r3"].assign_config(ReplicaConfig(2, "r2", ["r3"]))
+        c.loop.run_until_idle()
+        c.replicas["r2"].client_write([put_op("u", "key", b"NEW")])
+        c.loop.run_until_idle()
+        # old primary r1 (ballot 1) tries the same decree with other content
+        r1 = c.replicas["r1"]
+        before = r1.last_committed_decree
+        r1.client_write([put_op("u", "key", b"OLD")])
+        c.loop.run_until_idle()
+        # r1 must not have committed its divergent decree
+        assert r1.last_committed_decree == before
+        c.replicas["r2"].broadcast_group_check()
+        c.loop.run_until_idle()
+        assert c.replicas["r3"].server.on_get(k("u", "key")) == (0, b"NEW")
+    finally:
+        c.close()
+
+
+def test_lost_ack_recovered_by_group_check(tmp_path):
+    # regression (liveness): a dropped prepare_ack must not stall commits
+    # forever — the group-check resend path recovers it
+    c = Cluster(tmp_path)
+    try:
+        c.net.set_drop(1.0, src="r2", dst="r1")  # r2's acks vanish
+        c.write([put_op("u", "s", b"v")])
+        assert c.primary.last_committed_decree == 0  # stuck
+        c.net.set_drop(0.0, src="r2", dst="r1")
+        c.primary.broadcast_group_check()  # re-sends pending prepares
+        c.loop.run_until_idle()
+        assert c.primary.last_committed_decree == 1
+    finally:
+        c.close()
+
+
+def test_learner_tolerates_prepare_before_learn_completes(tmp_path):
+    # regression: a prepare racing ahead of the learn_response must not
+    # trigger a false gap error on the mid-learn learner
+    c = Cluster(tmp_path, names=("r1", "r2"))
+    try:
+        c.reconfigure("r1", ["r2"])
+        for i in range(4):
+            c.write([put_op("u", "s%d" % i, b"v%d" % i)])
+        r4 = Replica("r4", str(tmp_path / "r4"), c.net,
+                     clock=lambda: 1_700_000_000 + c.loop.now)
+        c.net.register("r4", r4.on_message)
+        c.replicas["r4"] = r4
+        errors = []
+        c.primary.on_replication_error = lambda s, d: errors.append(s)
+        c.primary.add_learner("r4")
+        # write immediately — the prepare for decree 5 races the learn
+        c.primary.client_write([put_op("u", "race", b"x")])
+        c.loop.run_until_idle()
+        assert errors == []
+        c.reconfigure("r1", ["r2", "r4"])
+        c.write([put_op("u", "final", b"y")])
+        c.primary.broadcast_group_check()
+        c.loop.run_until_idle()
+        assert r4.server.on_get(k("u", "race")) == (0, b"x")
+        assert r4.server.on_get(k("u", "s2")) == (0, b"v2")
+    finally:
+        c.close()
+
+
+def test_deterministic_schedules_replay_identically(tmp_path):
+    # same seed -> identical delivery counts and commit points; different
+    # seed -> (almost surely) different schedule but same final state
+    import shutil
+
+    def run(seed, path):
+        c = Cluster(path, seed=seed)
+        try:
+            for i in range(5):
+                c.write([put_op("u", "s%d" % i, b"v%d" % i)])
+            c.primary.broadcast_group_check()
+            c.loop.run_until_idle()
+            return (c.net.delivered, c.loop.now,
+                    [r.last_committed_decree
+                     for r in c.replicas.values()])
+        finally:
+            c.close()
+
+    a = run(42, tmp_path / "a")
+    b = run(42, tmp_path / "b")
+    assert a == b
+    d = run(43, tmp_path / "c")
+    assert d[2] == a[2]  # same outcome
+    assert d[1] != a[1]  # different schedule timing
