@@ -44,9 +44,16 @@ class Table:
         for p in self.all_partitions():
             p.flush()
 
-    def manual_compact_all(self, default_ttl: int = 0, rules_filter=None) -> None:
+    def manual_compact_all(self, default_ttl=None, rules_filter=None) -> None:
+        """None defaults defer to each partition's app-envs."""
         for p in self.all_partitions():
             p.manual_compact(default_ttl=default_ttl, rules_filter=rules_filter)
+
+    def update_app_envs(self, envs: dict) -> None:
+        """Propagate per-table envs to every partition (parity: meta
+        config-sync pushing app-envs to replicas)."""
+        for p in self.all_partitions():
+            p.update_app_envs(envs)
 
     def close(self) -> None:
         for p in self.partitions.values():

@@ -19,6 +19,7 @@ layer drives apply with its own decrees.
 from __future__ import annotations
 
 import threading
+import time
 from collections import OrderedDict
 from typing import Iterator, List, Optional, Tuple
 
@@ -120,6 +121,73 @@ class PartitionServer:
         # (sst path, block offset) which is immutable per file
         self._device_block_cache: "OrderedDict[tuple, object]" = OrderedDict()
         self._device_block_cache_cap = 1024
+        # per-table dynamic app-envs (parity: src/common/replica_envs.h:39-83
+        # propagated through config-sync; here set via update_app_envs)
+        self.app_envs: dict = {}
+        self._deny_client = ""          # "", "all", "write", "read"
+        self._write_throttle = None     # TokenBucket (reject mode)
+        self._read_throttle = None
+        self._default_ttl = 0
+        self._compaction_rules = None   # compiled rules_filter
+
+    def update_app_envs(self, envs: dict) -> None:
+        """Apply per-table dynamic settings (parity: replica_envs keys
+        ROCKSDB_ENV_* / deny_client_request / *throttling /
+        user_specified_compaction / default_ttl). Validation is two-phase:
+        every value parses first, then everything applies — a malformed
+        env never leaves half-applied state (parity:
+        meta/app_env_validator rejects before propagation)."""
+        from pegasus_tpu.ops.compaction_rules import compile_rules
+        from pegasus_tpu.utils.token_bucket import parse_throttle_env
+
+        staged = []
+        for key, value in envs.items():
+            try:
+                if key == "replica.deny_client_request":
+                    staged.append(("_deny_client",
+                                   value.split("*")[-1] if value else ""))
+                elif key == "replica.write_throttling":
+                    staged.append(("_write_throttle",
+                                   parse_throttle_env(value)))
+                elif key == "replica.read_throttling":
+                    staged.append(("_read_throttle",
+                                   parse_throttle_env(value)))
+                elif key == "default_ttl":
+                    staged.append(("_default_ttl", int(value)))
+                elif key == "user_specified_compaction":
+                    staged.append(("_compaction_rules",
+                                   compile_rules(value) if value else None))
+            except Exception as exc:
+                raise ValueError(f"invalid app-env {key}={value!r}: {exc}") \
+                    from exc
+        for attr, parsed in staged:
+            setattr(self, attr, parsed)
+        self.app_envs.update(envs)
+
+    def _gate(self, bucket, denied: bool) -> int:
+        """Shared deny/throttle gate (parity: the gate stack at
+        replica_2pc.cpp:117-207 and replica_throttle.cpp). Delay-mode
+        throttling sleeps briefly (capped); reject-mode returns
+        TryAgain."""
+        if denied:
+            return int(StorageStatus.TRY_AGAIN)
+        if bucket is not None:
+            delay_b, reject_b = bucket
+            if reject_b is not None and not reject_b.try_consume():
+                return int(StorageStatus.TRY_AGAIN)
+            if reject_b is None and delay_b is not None:
+                wait = delay_b.consume_or_delay()
+                if wait > 0:
+                    time.sleep(min(wait, 0.1))
+        return int(StorageStatus.OK)
+
+    def _write_gate(self) -> int:
+        return self._gate(self._write_throttle,
+                          self._deny_client in ("all", "write"))
+
+    def _read_gate(self) -> int:
+        return self._gate(self._read_throttle,
+                          self._deny_client in ("all", "read"))
 
     def close(self) -> None:
         self.engine.close()
@@ -133,6 +201,9 @@ class PartitionServer:
 
     def on_put(self, key: bytes, user_data: bytes, ttl_seconds: int = 0,
                decree: Optional[int] = None) -> int:
+        gate = self._write_gate()
+        if gate:
+            return gate
         with self._write_lock:
             d = self._next_decree() if decree is None else decree
             expire_ts = expire_ts_from_ttl(ttl_seconds)
@@ -140,6 +211,9 @@ class PartitionServer:
             return self.write_service.put(key, user_data, expire_ts, d)
 
     def on_remove(self, key: bytes, decree: Optional[int] = None) -> int:
+        gate = self._write_gate()
+        if gate:
+            return gate
         with self._write_lock:
             d = self._next_decree() if decree is None else decree
             self.cu.add_write(len(key))
@@ -147,6 +221,9 @@ class PartitionServer:
 
     def on_multi_put(self, req: MultiPutRequest,
                      decree: Optional[int] = None) -> int:
+        gate = self._write_gate()
+        if gate:
+            return gate
         with self._write_lock:
             d = self._next_decree() if decree is None else decree
             self.cu.add_write(sum(len(kv.key) + len(kv.value)
@@ -155,6 +232,9 @@ class PartitionServer:
 
     def on_multi_remove(self, req: MultiRemoveRequest,
                         decree: Optional[int] = None) -> Tuple[int, int]:
+        gate = self._write_gate()
+        if gate:
+            return gate, 0
         with self._write_lock:
             d = self._next_decree() if decree is None else decree
             self.cu.add_write(len(req.hash_key)
@@ -163,6 +243,11 @@ class PartitionServer:
 
     def on_incr(self, req: IncrRequest,
                 decree: Optional[int] = None) -> IncrResponse:
+        gate = self._write_gate()
+        if gate:
+            resp = IncrResponse()
+            resp.error = gate
+            return resp
         with self._write_lock:
             d = self._next_decree() if decree is None else decree
             self.cu.add_write(len(req.key))
@@ -170,6 +255,11 @@ class PartitionServer:
 
     def on_check_and_set(self, req: CheckAndSetRequest,
                          decree: Optional[int] = None) -> CheckAndSetResponse:
+        gate = self._write_gate()
+        if gate:
+            resp = CheckAndSetResponse()
+            resp.error = gate
+            return resp
         with self._write_lock:
             d = self._next_decree() if decree is None else decree
             self.cu.add_write(len(req.hash_key) + len(req.set_sort_key)
@@ -179,6 +269,11 @@ class PartitionServer:
     def on_check_and_mutate(self, req: CheckAndMutateRequest,
                             decree: Optional[int] = None
                             ) -> CheckAndMutateResponse:
+        gate = self._write_gate()
+        if gate:
+            resp = CheckAndMutateResponse()
+            resp.error = gate
+            return resp
         with self._write_lock:
             d = self._next_decree() if decree is None else decree
             self.cu.add_write(len(req.hash_key) + sum(
@@ -190,6 +285,9 @@ class PartitionServer:
     def on_get(self, key: bytes) -> Tuple[int, bytes]:
         """Parity: on_get (pegasus_server_impl.cpp:418): expired records are
         NotFound and counted as abnormal reads."""
+        gate = self._read_gate()
+        if gate:
+            return gate, b""
         now = epoch_now()
         hit = self.engine.get(key)
         if hit is None:
@@ -204,6 +302,9 @@ class PartitionServer:
 
     def on_ttl(self, key: bytes) -> Tuple[int, int]:
         """Returns (error, ttl_seconds); -1 = no TTL (parity on_ttl:1092)."""
+        gate = self._read_gate()
+        if gate:
+            return gate, 0
         now = epoch_now()
         hit = self.engine.get(key)
         if hit is None:
@@ -216,6 +317,11 @@ class PartitionServer:
 
     def on_batch_get(self, req: BatchGetRequest) -> BatchGetResponse:
         """Parity: on_batch_get (pegasus_server_impl.cpp:906)."""
+        gate = self._read_gate()
+        if gate:
+            resp = BatchGetResponse()
+            resp.error = gate
+            return resp
         now = epoch_now()
         resp = BatchGetResponse()
         size = 0
@@ -423,6 +529,11 @@ class PartitionServer:
 
     def on_multi_get(self, req: MultiGetRequest) -> MultiGetResponse:
         """Parity: on_multi_get (pegasus_server_impl.cpp:496)."""
+        gate = self._read_gate()
+        if gate:
+            resp = MultiGetResponse()
+            resp.error = gate
+            return resp
         now = epoch_now()
         resp = MultiGetResponse()
         if not req.hash_key:
@@ -486,6 +597,9 @@ class PartitionServer:
 
     def on_sortkey_count(self, hash_key: bytes) -> Tuple[int, int]:
         """Parity: on_sortkey_count (pegasus_server_impl.cpp:1018)."""
+        gate = self._read_gate()
+        if gate:
+            return gate, 0
         now = epoch_now()
         start_key = generate_key(hash_key, b"")
         stop_key = generate_next_bytes(hash_key)
@@ -502,6 +616,11 @@ class PartitionServer:
 
     def on_get_scanner(self, req: GetScannerRequest) -> ScanResponse:
         """Parity: on_get_scanner (pegasus_server_impl.cpp:1151)."""
+        gate = self._read_gate()
+        if gate:
+            resp = ScanResponse()
+            resp.error = gate
+            return resp
         start_key = req.start_key or b""
         if start_key and not req.start_inclusive:
             start_key = _after(start_key)
@@ -512,6 +631,11 @@ class PartitionServer:
 
     def on_scan(self, context_id: int) -> ScanResponse:
         """Parity: on_scan (pegasus_server_impl.cpp:1399)."""
+        gate = self._read_gate()
+        if gate:
+            resp = ScanResponse()
+            resp.error = gate
+            return resp
         ctx = self._scan_cache.take(context_id)
         if ctx is None:
             resp = ScanResponse()
@@ -568,8 +692,15 @@ class PartitionServer:
         with self._write_lock:
             return self.engine.flush()
 
-    def manual_compact(self, default_ttl: int = 0, rules_filter=None) -> None:
-        """Parity: pegasus_manual_compact_service (manual CompactRange)."""
+    def manual_compact(self, default_ttl: Optional[int] = None,
+                       rules_filter=None) -> None:
+        """Parity: pegasus_manual_compact_service (manual CompactRange).
+        Defaults come from the table's app-envs (`default_ttl`,
+        `user_specified_compaction`) unless overridden."""
+        if default_ttl is None:
+            default_ttl = self._default_ttl
+        if rules_filter is None:
+            rules_filter = self._compaction_rules
         with self._write_lock:
             self.engine.manual_compact(
                 default_ttl=default_ttl, pidx=self.pidx,

@@ -1,0 +1,185 @@
+"""User-specified compaction rules + app-env plumbing.
+
+Parity targets: src/server/compaction_filter_rule.{h,cpp},
+compaction_operation.{h,cpp}, and the replica_envs dynamic-settings
+surface (deny client, throttling, default_ttl).
+"""
+
+import numpy as np
+import pytest
+
+from pegasus_tpu.base.key_schema import generate_key
+from pegasus_tpu.base.value_schema import PEGASUS_EPOCH_BEGIN
+from pegasus_tpu.ops.compaction_rules import compile_rules, parse_rules
+from pegasus_tpu.server import PartitionServer
+from pegasus_tpu.utils.errors import StorageStatus
+
+OK = int(StorageStatus.OK)
+TRY_AGAIN = int(StorageStatus.TRY_AGAIN)
+
+
+def k(h, s):
+    return generate_key(h, s)
+
+
+def test_delete_by_hashkey_prefix():
+    f = compile_rules(
+        '[{"op": "delete_key", "rules": '
+        '[{"type": "hashkey_pattern", "match": "prefix", "pattern": "tmp_"}]}]')
+    keys = [k(b"tmp_1", b"s"), k(b"keep", b"s"), k(b"tmp_2", b"x")]
+    drop, ets = f(keys, [0, 0, 0], now=1000)
+    assert list(drop) == [True, False, True]
+
+
+def test_delete_requires_all_rules_match():
+    # AND semantics: hashkey prefix AND sortkey postfix
+    f = compile_rules([
+        {"op": "delete_key", "rules": [
+            {"type": "hashkey_pattern", "match": "prefix", "pattern": "u_"},
+            {"type": "sortkey_pattern", "match": "postfix", "pattern": "_old"},
+        ]}])
+    keys = [k(b"u_1", b"a_old"), k(b"u_1", b"a_new"), k(b"x", b"a_old")]
+    drop, _ = f(keys, [0, 0, 0], now=1000)
+    assert list(drop) == [True, False, False]
+
+
+def test_ttl_range_rule():
+    now = 5000
+    f = compile_rules([
+        {"op": "delete_key", "rules": [
+            {"type": "ttl_range", "start_ttl": 100, "stop_ttl": 200}]}])
+    keys = [k(b"h", b"s%d" % i) for i in range(4)]
+    # remaining TTLs: none, 150 (in range), 50 (below), 300 (above)
+    ets = [0, now + 150, now + 50, now + 300]
+    drop, _ = f(keys, ets, now=now)
+    assert list(drop) == [False, True, False, False]
+    # start=stop=0 matches exactly the no-TTL records
+    f0 = compile_rules([
+        {"op": "delete_key", "rules": [
+            {"type": "ttl_range", "start_ttl": 0, "stop_ttl": 0}]}])
+    drop0, _ = f0(keys, ets, now=now)
+    assert list(drop0) == [True, False, False, False]
+
+
+def test_update_ttl_ops():
+    now = 10_000
+    keys = [k(b"h", b"a"), k(b"h", b"b"), k(b"h", b"c")]
+    # from_now
+    f = compile_rules([
+        {"op": "update_ttl", "update_ttl_type": "from_now", "value": 500,
+         "rules": [{"type": "sortkey_pattern", "match": "prefix",
+                    "pattern": "a"}]}])
+    _, ets = f(keys, [7, 7, 7], now=now)
+    assert list(ets) == [now + 500, 7, 7]
+    # from_current: no-op on no-TTL records
+    f2 = compile_rules([
+        {"op": "update_ttl", "update_ttl_type": "from_current", "value": 100,
+         "rules": [{"type": "hashkey_pattern", "match": "anywhere",
+                    "pattern": "h"}]}])
+    _, ets2 = f2(keys, [50, 0, 60], now=now)
+    assert list(ets2) == [150, 0, 160]
+    # timestamp: expire at unix ts value
+    unix_target = PEGASUS_EPOCH_BEGIN + 999
+    f3 = compile_rules([
+        {"op": "update_ttl", "update_ttl_type": "timestamp",
+         "value": unix_target,
+         "rules": [{"type": "sortkey_pattern", "match": "prefix",
+                    "pattern": "c"}]}])
+    _, ets3 = f3(keys, [0, 0, 0], now=now)
+    assert list(ets3) == [0, 0, 999]
+
+
+def test_operation_order_delete_wins():
+    f = compile_rules([
+        {"op": "delete_key", "rules": [
+            {"type": "sortkey_pattern", "match": "prefix", "pattern": "x"}]},
+        {"op": "update_ttl", "update_ttl_type": "from_now", "value": 1,
+         "rules": [{"type": "sortkey_pattern", "match": "prefix",
+                    "pattern": "x"}]},
+    ])
+    drop, ets = f([k(b"h", b"x1")], [0], now=100)
+    assert bool(drop[0]) and ets[0] == 0  # deleted, not re-stamped
+
+
+def test_empty_pattern_matches_nothing():
+    # regression (parity): reference string_pattern_match returns false
+    # for empty patterns — an empty-pattern delete rule must not wipe data
+    f = compile_rules([
+        {"op": "delete_key", "rules": [
+            {"type": "hashkey_pattern", "match": "anywhere", "pattern": ""}]}])
+    drop, _ = f([k(b"h", b"s")], [0], now=100)
+    assert not bool(drop[0])
+
+
+def test_ops_evaluate_against_original_ttl():
+    # regression (parity): op2's ttl_range must see the ORIGINAL expire_ts,
+    # not op1's rewrite
+    now = 1000
+    f = compile_rules([
+        {"op": "update_ttl", "update_ttl_type": "from_now", "value": 100,
+         "rules": [{"type": "hashkey_pattern", "match": "prefix",
+                    "pattern": "h"}]},
+        {"op": "delete_key", "rules": [
+            {"type": "ttl_range", "start_ttl": 50, "stop_ttl": 200}]},
+    ])
+    drop, ets = f([k(b"h", b"s")], [0], now=now)
+    assert not bool(drop[0])          # original ets=0 never in ttl_range
+    assert int(ets[0]) == now + 100   # but the update still applied
+
+
+def test_bad_rule_specs_rejected():
+    with pytest.raises(ValueError):
+        parse_rules('[{"op": "delete_key", "rules": []}]')
+    with pytest.raises(ValueError):
+        parse_rules('[{"op": "explode", "rules": [{"type": "ttl_range", '
+                    '"start_ttl": 0, "stop_ttl": 0}]}]')
+    with pytest.raises(ValueError):
+        parse_rules('[{"op": "delete_key", "rules": [{"type": "nope"}]}]')
+
+
+def test_server_compaction_with_env_rules(tmp_path):
+    s = PartitionServer(str(tmp_path / "p"))
+    try:
+        for i in range(10):
+            s.on_put(k(b"logs", b"day%02d" % i), b"v")
+            s.on_put(k(b"data", b"day%02d" % i), b"v")
+        s.update_app_envs({"user_specified_compaction":
+                           '[{"op": "delete_key", "rules": '
+                           '[{"type": "hashkey_pattern", "match": "prefix", '
+                           '"pattern": "logs"}]}]'})
+        s.manual_compact()
+        assert s.on_sortkey_count(b"logs") == (OK, 0)
+        assert s.on_sortkey_count(b"data") == (OK, 10)
+    finally:
+        s.close()
+
+
+def test_server_default_ttl_env(tmp_path):
+    s = PartitionServer(str(tmp_path / "p"))
+    try:
+        s.on_put(k(b"h", b"s"), b"v")  # no TTL
+        s.update_app_envs({"default_ttl": "100"})
+        s.manual_compact()
+        err, ttl = s.on_ttl(k(b"h", b"s"))
+        assert err == OK and 0 < ttl <= 100
+    finally:
+        s.close()
+
+
+def test_deny_client_and_throttle_envs(tmp_path):
+    s = PartitionServer(str(tmp_path / "p"))
+    try:
+        s.on_put(k(b"h", b"s"), b"v")
+        s.update_app_envs({"replica.deny_client_request": "reject*write"})
+        assert s.on_put(k(b"h", b"s2"), b"v") == TRY_AGAIN
+        assert s.on_get(k(b"h", b"s")) == (OK, b"v")  # reads still fine
+        s.update_app_envs({"replica.deny_client_request": "reject*all"})
+        assert s.on_get(k(b"h", b"s"))[0] == TRY_AGAIN
+        s.update_app_envs({"replica.deny_client_request": ""})
+        assert s.on_get(k(b"h", b"s")) == (OK, b"v")
+        # tiny write-QPS budget: the burst runs out
+        s.update_app_envs({"replica.write_throttling": "2*reject*0"})
+        results = [s.on_put(k(b"h", b"t%d" % i), b"v") for i in range(10)]
+        assert TRY_AGAIN in results and OK in results
+    finally:
+        s.close()
