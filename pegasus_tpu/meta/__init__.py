@@ -1,0 +1,6 @@
+"""Meta server: cluster control plane (reference: src/meta/)."""
+
+from pegasus_tpu.meta.meta_storage import MetaStorage
+from pegasus_tpu.meta.failure_detector import FailureDetector
+from pegasus_tpu.meta.server_state import AppState, PartitionConfig, ServerState
+from pegasus_tpu.meta.meta_service import MetaService

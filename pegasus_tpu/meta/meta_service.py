@@ -1,0 +1,280 @@
+"""MetaService: leader control plane — DDL, FD, partition guardian.
+
+Parity: src/meta/meta_service.{h,cpp} (admin RPC surface :480-571),
+server_state.cpp:1161 (create_app), partition_guardian.h:41 (cures), and
+meta_server_failure_detector.h:64 (worker liveness). Single-meta here;
+leader election over a distributed lock slots in front of this class the
+way the reference elects via ZK (meta_service.cpp:393) — followers
+forward to the leader.
+
+Guardian cures mirror the reference's proposal types:
+- dead primary  -> promote an alive secondary (ballot+1)
+- dead secondary-> remove it (ballot+1)
+- under-replicated -> tell the primary to add a learner on a spare node;
+  on learn completion, upgrade the learner to secondary (ballot+1).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, Dict, List, Optional, Tuple
+
+from pegasus_tpu.meta.failure_detector import FailureDetector
+from pegasus_tpu.meta.meta_storage import MetaStorage
+from pegasus_tpu.meta.server_state import (
+    AS_AVAILABLE,
+    AS_DROPPED,
+    AppState,
+    PartitionConfig,
+    ServerState,
+)
+from pegasus_tpu.utils.errors import ErrorCode, PegasusError
+
+Gpid = Tuple[int, int]
+
+
+class MetaService:
+    def __init__(self, name: str, data_dir: str, net,
+                 clock: Callable[[], float]) -> None:
+        self.name = name
+        self.net = net
+        self.clock = clock
+        self.state = ServerState(MetaStorage(os.path.join(data_dir,
+                                                          "meta.json")))
+        self.fd = FailureDetector(on_worker_dead=self._on_node_dead)
+        # in-flight learner adds: gpid -> (learner, started_at); prevents
+        # every guardian tick from restarting a slow learn from scratch
+        self._pending_learns: Dict[Gpid, Tuple[str, float]] = {}
+        self._learn_timeout = 60.0
+        net.register(name, self.on_message)
+
+    # ---- messages -----------------------------------------------------
+
+    def on_message(self, src: str, msg_type: str, payload) -> None:
+        if msg_type == "beacon":
+            self.fd.on_beacon(payload["node"], self.clock())
+            self.net.send(self.name, src, "beacon_ack", {"ok": True})
+            return
+        if msg_type == "learn_completed":
+            self._on_learn_completed(tuple(payload["gpid"]),
+                                     payload["learner"])
+            return
+        if msg_type == "replication_error":
+            self._on_replication_error(tuple(payload["gpid"]),
+                                       payload["member"])
+            return
+        raise ValueError(f"meta: unknown message {msg_type}")
+
+    def tick(self) -> None:
+        """Periodic FD check + guardian pass (parity: the meta's FD check
+        timer and partition-guardian scans)."""
+        self.fd.check(self.clock())
+        self._guardian_pass()
+
+    # ---- DDL surface (parity: meta_service.cpp:480-571) ---------------
+
+    def create_app(self, app_name: str, partition_count: int,
+                   replica_count: int = 3,
+                   envs: Optional[Dict[str, str]] = None) -> int:
+        if self.state.find_app(app_name) is not None:
+            raise PegasusError(ErrorCode.ERR_APP_EXIST, app_name)
+        nodes = self.fd.alive_workers()
+        if not nodes:
+            raise PegasusError(ErrorCode.ERR_NOT_ENOUGH_MEMBER,
+                               "no alive replica servers")
+        # the DESIRED replica count is preserved even when fewer nodes are
+        # alive now — the guardian restores the level as nodes return
+        # (placement clamps, the app state doesn't)
+        app = AppState(self.state.next_app_id(), app_name, partition_count,
+                       AS_AVAILABLE, dict(envs or {}), replica_count)
+        placed = min(replica_count, len(nodes))
+        configs = []
+        for pidx in range(partition_count):
+            members = [nodes[(pidx + i) % len(nodes)]
+                       for i in range(placed)]
+            configs.append(PartitionConfig(
+                ballot=1, primary=members[0], secondaries=members[1:]))
+        self.state.put_app(app, configs)
+        for pidx, pc in enumerate(configs):
+            self._propose(app.app_id, pidx, pc)
+        if app.envs:
+            self._propagate_envs(app)
+        return app.app_id
+
+    def drop_app(self, app_name: str) -> None:
+        app = self.state.find_app(app_name)
+        if app is None:
+            raise PegasusError(ErrorCode.ERR_APP_NOT_EXIST, app_name)
+        app.status = AS_DROPPED
+        self.state.put_app(app)
+        for pidx in range(app.partition_count):
+            pc = self.state.get_partition(app.app_id, pidx)
+            old_members = pc.members()
+            dead_pc = PartitionConfig(ballot=pc.ballot + 1, primary="",
+                                      secondaries=[])
+            self.state.update_partition(app.app_id, pidx, dead_pc)
+            for node in old_members:
+                self._send_proposal(node, app, pidx, dead_pc)
+
+    def recall_app(self, app_name: str) -> int:
+        """Parity: recall_app — resurrect a dropped table inside the recall
+        window (data dirs still on the nodes)."""
+        if self.state.find_app(app_name) is not None:
+            # the name is back in use by a live table — recalling would
+            # create two AVAILABLE apps with one name (reference rejects)
+            raise PegasusError(ErrorCode.ERR_APP_EXIST, app_name)
+        app = self.state.find_dropped_app(app_name)
+        if app is None:
+            raise PegasusError(ErrorCode.ERR_APP_NOT_EXIST, app_name)
+        if not self.fd.alive_workers():
+            raise PegasusError(ErrorCode.ERR_NOT_ENOUGH_MEMBER,
+                               "no alive replica servers to recall onto")
+        app.status = AS_AVAILABLE
+        self.state.put_app(app)
+        for pidx in range(app.partition_count):
+            pc = self.state.get_partition(app.app_id, pidx)
+            # reuse the last known membership before the drop is gone;
+            # fall back to fresh placement
+            members = [n for n in pc.members() if self.fd.is_alive(n)]
+            if not members:
+                nodes = self.fd.alive_workers()
+                members = [nodes[(pidx + i) % len(nodes)]
+                           for i in range(min(app.max_replica_count,
+                                              len(nodes)))]
+            new_pc = PartitionConfig(ballot=pc.ballot + 1,
+                                     primary=members[0],
+                                     secondaries=members[1:])
+            self.state.update_partition(app.app_id, pidx, new_pc)
+            self._propose(app.app_id, pidx, new_pc)
+        return app.app_id
+
+    def list_apps(self) -> List[AppState]:
+        return [a for a in self.state.apps.values()
+                if a.status == AS_AVAILABLE]
+
+    def query_config(self, app_name: str
+                     ) -> Tuple[int, int, List[PartitionConfig]]:
+        """Parity: query_cfg (idl/rrdb.thrift:366) — (app_id,
+        partition_count, configs)."""
+        app = self.state.find_app(app_name)
+        if app is None:
+            raise PegasusError(ErrorCode.ERR_APP_NOT_EXIST, app_name)
+        return app.app_id, app.partition_count, [
+            self.state.get_partition(app.app_id, pidx)
+            for pidx in range(app.partition_count)]
+
+    def update_app_envs(self, app_name: str, envs: Dict[str, str]) -> None:
+        app = self.state.find_app(app_name)
+        if app is None:
+            raise PegasusError(ErrorCode.ERR_APP_NOT_EXIST, app_name)
+        app.envs.update(envs)
+        self.state.put_app(app)
+        self._propagate_envs(app)
+
+    # ---- guardian (parity: partition_guardian.h:41) -------------------
+
+    def _on_node_dead(self, node: str) -> None:
+        for app in self.list_apps():
+            for pidx in range(app.partition_count):
+                pc = self.state.get_partition(app.app_id, pidx)
+                if node not in pc.members():
+                    continue
+                if pc.primary == node:
+                    alive_secs = [s for s in pc.secondaries
+                                  if self.fd.is_alive(s)]
+                    if not alive_secs:
+                        continue  # DDD: wait for a node to return
+                    new_pc = PartitionConfig(
+                        ballot=pc.ballot + 1, primary=alive_secs[0],
+                        secondaries=alive_secs[1:])
+                else:
+                    new_pc = PartitionConfig(
+                        ballot=pc.ballot + 1, primary=pc.primary,
+                        secondaries=[s for s in pc.secondaries if s != node])
+                self.state.update_partition(app.app_id, pidx, new_pc)
+                self._propose(app.app_id, pidx, new_pc)
+
+    def _on_replication_error(self, gpid: Gpid, member: str) -> None:
+        """A member NAK'd replication (e.g. gap after a lost prepare):
+        remove it; the guardian pass re-adds it as a learner."""
+        app = self.state.apps.get(gpid[0])
+        if app is None or app.status != AS_AVAILABLE:
+            return
+        pc = self.state.get_partition(*gpid)
+        if member == pc.primary or member not in pc.members():
+            return
+        new_pc = PartitionConfig(
+            ballot=pc.ballot + 1, primary=pc.primary,
+            secondaries=[s for s in pc.secondaries if s != member])
+        self.state.update_partition(gpid[0], gpid[1], new_pc)
+        self._propose(gpid[0], gpid[1], new_pc)
+        # the removed node must deactivate too
+        self._send_proposal(member, app, gpid[1], new_pc)
+
+    def _guardian_pass(self) -> None:
+        """Re-replicate under-replicated partitions onto spare nodes."""
+        now = self.clock()
+        for app in self.list_apps():
+            for pidx in range(app.partition_count):
+                gpid = (app.app_id, pidx)
+                pc = self.state.get_partition(app.app_id, pidx)
+                if not pc.primary:
+                    continue
+                if len(pc.members()) >= app.max_replica_count:
+                    self._pending_learns.pop(gpid, None)
+                    continue
+                pending = self._pending_learns.get(gpid)
+                if pending is not None:
+                    learner, started = pending
+                    if (now - started < self._learn_timeout
+                            and self.fd.is_alive(learner)):
+                        continue  # learn in flight; don't restart it
+                spare = [n for n in self.fd.alive_workers()
+                         if n not in pc.members()]
+                if not spare:
+                    continue
+                learner = spare[(app.app_id + pidx) % len(spare)]
+                self._pending_learns[gpid] = (learner, now)
+                self.net.send(self.name, pc.primary, "add_learner_cmd", {
+                    "gpid": gpid, "learner": learner})
+
+    def _on_learn_completed(self, gpid: Gpid, learner: str) -> None:
+        app = self.state.apps.get(gpid[0])
+        if app is None or app.status != AS_AVAILABLE:
+            return
+        self._pending_learns.pop(gpid, None)
+        pc = self.state.get_partition(*gpid)
+        if learner in pc.members():
+            return
+        new_pc = PartitionConfig(ballot=pc.ballot + 1, primary=pc.primary,
+                                 secondaries=pc.secondaries + [learner])
+        self.state.update_partition(gpid[0], gpid[1], new_pc)
+        self._propose(gpid[0], gpid[1], new_pc)
+        # the newcomer needs the table's envs too (it wasn't a member when
+        # they were last propagated)
+        if app.envs:
+            self.net.send(self.name, learner, "update_app_envs", {
+                "app_id": app.app_id, "envs": dict(app.envs)})
+
+    # ---- proposal delivery --------------------------------------------
+
+    def _propose(self, app_id: int, pidx: int, pc: PartitionConfig) -> None:
+        app = self.state.apps[app_id]
+        for node in pc.members():
+            self._send_proposal(node, app, pidx, pc)
+
+    def _send_proposal(self, node: str, app: AppState, pidx: int,
+                       pc: PartitionConfig) -> None:
+        self.net.send(self.name, node, "config_proposal", {
+            "gpid": (app.app_id, pidx), "ballot": pc.ballot,
+            "primary": pc.primary, "secondaries": list(pc.secondaries),
+            "partition_count": app.partition_count})
+
+    def _propagate_envs(self, app: AppState) -> None:
+        nodes = set()
+        for pidx in range(app.partition_count):
+            nodes.update(self.state.get_partition(app.app_id,
+                                                  pidx).members())
+        for node in nodes:
+            self.net.send(self.name, node, "update_app_envs", {
+                "app_id": app.app_id, "envs": dict(app.envs)})

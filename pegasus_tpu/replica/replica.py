@@ -406,7 +406,8 @@ class Replica:
             raise RuntimeError("only the primary adds learners")
         self._learners[learner] = self.last_prepared_decree() + 1
         self.transport.send(self.name, learner, "add_learner", {
-            "ballot": self.config.ballot})
+            "ballot": self.config.ballot,
+            "partition_count": self.server.partition_count})
 
     def _on_add_learner(self, src: str, payload: dict) -> None:
         if payload["ballot"] < self.config.ballot:

@@ -1,0 +1,237 @@
+"""ReplicaStub: one replica-server node hosting many partition replicas.
+
+Parity: src/replica/replica_stub.{h,cpp} — a node owns all its `Replica`
+instances, routes gpid-addressed messages to them (the rDSN layer-2
+interception, src/runtime/service_engine.cpp:163), creates replicas on
+meta config proposals, reports its stored replicas in config-sync, and
+runs the failure-detector client side (beacons to meta).
+
+All inter-node traffic is enveloped as ("replica", {gpid, type, payload})
+so one network address serves every partition on the node.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, Dict, Optional, Tuple
+
+from pegasus_tpu.replica.replica import PartitionStatus, Replica, ReplicaConfig
+
+Gpid = Tuple[int, int]  # (app_id, partition_index)
+
+
+class _GpidTransport:
+    """Binds a replica's sends to its node + gpid envelope."""
+
+    def __init__(self, net, node_name: str, gpid: Gpid) -> None:
+        self._net = net
+        self._node = node_name
+        self._gpid = gpid
+
+    def send(self, _src: str, dst: str, msg_type: str, payload) -> None:
+        self._net.send(self._node, dst, "replica", {
+            "gpid": self._gpid, "type": msg_type, "payload": payload})
+
+
+class ReplicaStub:
+    def __init__(self, name: str, data_dir: str, net,
+                 clock: Optional[Callable[[], float]] = None,
+                 sim_clock: Optional[Callable[[], float]] = None) -> None:
+        self.name = name
+        self.data_dir = data_dir
+        self.net = net
+        self.clock = clock
+        # FD timeline clock (sim time); defaults to the wall clock
+        self.sim_clock = sim_clock or clock or (lambda: 0.0)
+        self.replicas: Dict[Gpid, Replica] = {}
+        self.meta_addr: Optional[str] = None
+        self._last_beacon_ack = float("-inf")
+        net.register(name, self.on_message)
+        # load existing replica dirs (parity: replica_stub boot scan,
+        # replica_stub.cpp:594 load_replicas); each dir carries a
+        # .replica_info with its real partition_count
+        if os.path.isdir(data_dir):
+            for entry in sorted(os.listdir(data_dir)):
+                parts = entry.split(".")
+                if len(parts) == 2 and all(p.isdigit() for p in parts):
+                    gpid = (int(parts[0]), int(parts[1]))
+                    info_path = os.path.join(data_dir, entry, ".replica_info")
+                    partition_count = 1
+                    if os.path.exists(info_path):
+                        import json
+                        with open(info_path) as f:
+                            partition_count = json.load(f)["partition_count"]
+                    self._open_replica(gpid, partition_count)
+
+    def close(self) -> None:
+        for r in self.replicas.values():
+            r.close()
+
+    # ---- replica management -------------------------------------------
+
+    def _replica_dir(self, gpid: Gpid) -> str:
+        return os.path.join(self.data_dir, f"{gpid[0]}.{gpid[1]}")
+
+    def _open_replica(self, gpid: Gpid, partition_count: int) -> Replica:
+        r = self.replicas.get(gpid)
+        if r is None:
+            import json
+            rdir = self._replica_dir(gpid)
+            os.makedirs(rdir, exist_ok=True)
+            info_path = os.path.join(rdir, ".replica_info")
+            if not os.path.exists(info_path):
+                with open(info_path, "w") as f:
+                    json.dump({"app_id": gpid[0], "pidx": gpid[1],
+                               "partition_count": partition_count}, f)
+            r = Replica(self.name, rdir,
+                        _GpidTransport(self.net, self.name, gpid),
+                        app_id=gpid[0], pidx=gpid[1],
+                        partition_count=partition_count, clock=self.clock)
+            r.on_learn_completed = (
+                lambda learner, g=gpid: self._notify_learn_completed(g, learner))
+            r.on_replication_error = (
+                lambda member, decree, g=gpid:
+                self._notify_replication_error(g, member))
+            self.replicas[gpid] = r
+        return r
+
+    def get_replica(self, gpid: Gpid) -> Optional[Replica]:
+        return self.replicas.get(gpid)
+
+    # ---- message routing ----------------------------------------------
+
+    def on_message(self, src: str, msg_type: str, payload) -> None:
+        if msg_type == "replica":
+            gpid = tuple(payload["gpid"])
+            r = self.replicas.get(gpid)
+            if r is None and payload["type"] == "add_learner":
+                # a learner replica is born from the add-learner flow
+                # (parity: on_add_learner creates the potential secondary)
+                r = self._open_replica(
+                    gpid, payload["payload"].get("partition_count", 1))
+            if r is not None:
+                r.on_message(src, payload["type"], payload["payload"])
+            return
+        if msg_type == "config_proposal":
+            self._on_config_proposal(src, payload)
+            return
+        if msg_type == "add_learner_cmd":
+            self._on_add_learner_cmd(src, payload)
+            return
+        if msg_type == "update_app_envs":
+            self._on_update_app_envs(src, payload)
+            return
+        if msg_type == "beacon_ack":
+            self._last_beacon_ack = self.sim_clock()
+            return
+        if msg_type == "client_write":
+            self._on_client_write(src, payload)
+            return
+        if msg_type == "client_read":
+            self._on_client_read(src, payload)
+            return
+        raise ValueError(f"stub {self.name}: unknown message {msg_type}")
+
+    # ---- client request path (parity: replica_stub read/write dispatch,
+    # replica_stub.cpp:1100 + replica.cpp:386 gates) -------------------
+
+    def lease_valid(self) -> bool:
+        """Worker-side self-fencing: a node whose FD lease lapsed must stop
+        serving BEFORE meta's grace expires (failure_detector.h:79-121) —
+        otherwise a partitioned primary would serve stale reads after its
+        partition was reassigned."""
+        from pegasus_tpu.meta.failure_detector import worker_lease_valid
+
+        return worker_lease_valid(self._last_beacon_ack, self.sim_clock())
+
+    def _on_client_write(self, src: str, payload: dict) -> None:
+        from pegasus_tpu.replica.mutation import WriteOp
+        from pegasus_tpu.replica.replica import PartitionStatus
+        from pegasus_tpu.utils.errors import ErrorCode
+
+        gpid = tuple(payload["gpid"])
+        rid = payload["rid"]
+        r = self.replicas.get(gpid)
+        if (r is None or r.status != PartitionStatus.PRIMARY
+                or not self.lease_valid()):
+            self.net.send(self.name, src, "client_write_reply", {
+                "rid": rid, "err": int(ErrorCode.ERR_INVALID_STATE),
+                "results": []})
+            return
+        ops = [WriteOp(op, req) for op, req in payload["ops"]]
+
+        def reply(results) -> None:
+            self.net.send(self.name, src, "client_write_reply", {
+                "rid": rid, "err": int(ErrorCode.ERR_OK),
+                "results": results})
+
+        try:
+            r.client_write(ops, reply)
+        except (RuntimeError, ValueError):
+            self.net.send(self.name, src, "client_write_reply", {
+                "rid": rid, "err": int(ErrorCode.ERR_INVALID_STATE),
+                "results": []})
+
+    def _on_client_read(self, src: str, payload: dict) -> None:
+        from pegasus_tpu.replica.replica import PartitionStatus
+        from pegasus_tpu.utils.errors import ErrorCode
+
+        gpid = tuple(payload["gpid"])
+        rid = payload["rid"]
+        r = self.replicas.get(gpid)
+        if (r is None or r.status != PartitionStatus.PRIMARY
+                or not self.lease_valid()):
+            self.net.send(self.name, src, "client_read_reply", {
+                "rid": rid, "err": int(ErrorCode.ERR_INVALID_STATE),
+                "status": 0, "value": b""})
+            return
+        # err = framework routing error; status = storage status — two
+        # different code spaces (dsn::error_code vs rocksdb::Status)
+        status, value = r.server.on_get(payload["key"])
+        self.net.send(self.name, src, "client_read_reply", {
+            "rid": rid, "err": int(ErrorCode.ERR_OK),
+            "status": status, "value": value})
+
+    def _on_config_proposal(self, src: str, payload: dict) -> None:
+        """Meta assigns a configuration (parity: on_config_proposal,
+        replica_stub.cpp:2487 -> replica_config.cpp)."""
+        gpid = tuple(payload["gpid"])
+        config = ReplicaConfig(payload["ballot"], payload["primary"],
+                               list(payload["secondaries"]))
+        r = self._open_replica(gpid, payload.get("partition_count", 1))
+        r.assign_config(config)
+
+    def _on_add_learner_cmd(self, src: str, payload: dict) -> None:
+        """Meta tells the primary to pull in a learner (parity: config
+        proposal ADD_SECONDARY -> primary starts the learn flow)."""
+        gpid = tuple(payload["gpid"])
+        r = self.replicas.get(gpid)
+        if r is not None and r.status == PartitionStatus.PRIMARY:
+            r.add_learner(payload["learner"])
+
+    def _on_update_app_envs(self, src: str, payload: dict) -> None:
+        """Meta propagates table envs (parity: config-sync env delivery)."""
+        for gpid, r in self.replicas.items():
+            if gpid[0] == payload["app_id"]:
+                r.server.update_app_envs(payload["envs"])
+
+    # ---- notifications to meta ----------------------------------------
+
+    def _notify_learn_completed(self, gpid: Gpid, learner: str) -> None:
+        if self.meta_addr is not None:
+            self.net.send(self.name, self.meta_addr, "learn_completed", {
+                "gpid": gpid, "learner": learner})
+
+    def _notify_replication_error(self, gpid: Gpid, member: str) -> None:
+        if self.meta_addr is not None:
+            self.net.send(self.name, self.meta_addr, "replication_error", {
+                "gpid": gpid, "member": member})
+
+    # ---- failure detector (worker side) -------------------------------
+
+    def send_beacon(self) -> None:
+        """Parity: the FD beacon ping (failure_detector.h:79) — called on a
+        timer by the owner/sim."""
+        if self.meta_addr is not None:
+            self.net.send(self.name, self.meta_addr, "beacon",
+                          {"node": self.name})

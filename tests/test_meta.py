@@ -1,0 +1,327 @@
+"""Meta layer tests: FD, create/drop/recall, guardian cures, learner
+upgrades — a whole cluster in the deterministic simulator (the onebox
+analogue of the reference's function tests)."""
+
+import pytest
+
+from pegasus_tpu.base.key_schema import generate_key
+from pegasus_tpu.meta import MetaService
+from pegasus_tpu.meta.failure_detector import worker_lease_valid
+from pegasus_tpu.replica.mutation import WriteOp
+from pegasus_tpu.replica.replica import PartitionStatus
+from pegasus_tpu.replica.stub import ReplicaStub
+from pegasus_tpu.rpc.codec import OP_PUT
+from pegasus_tpu.runtime import SimLoop, SimNetwork
+from pegasus_tpu.utils.errors import PegasusError
+
+
+class ClusterHarness:
+    def __init__(self, tmp_path, n_nodes=4, seed=0):
+        self.loop = SimLoop(seed=seed)
+        self.net = SimNetwork(self.loop)
+        clock = lambda: self.loop.now
+        self.meta = MetaService("meta", str(tmp_path / "meta"), self.net,
+                                clock)
+        self.stubs = {}
+        for i in range(n_nodes):
+            name = f"node{i}"
+            stub = ReplicaStub(name, str(tmp_path / name), self.net,
+                               clock=lambda: 1_700_000_000 + self.loop.now)
+            stub.meta_addr = "meta"
+            self.stubs[name] = stub
+        self.run_beacons()
+
+    def run_beacons(self, rounds=2, interval=3.0):
+        """Advance virtual time with everyone beaconing."""
+        for _ in range(rounds):
+            for stub in self.stubs.values():
+                stub.send_beacon()
+            self.loop.run_for(interval)
+            self.meta.tick()
+        self.loop.run_until_idle()
+
+    def silence(self, node, rounds=5, interval=3.0):
+        """Advance time with `node` NOT beaconing (crash simulation)."""
+        for _ in range(rounds):
+            for name, stub in self.stubs.items():
+                if name != node:
+                    stub.send_beacon()
+            self.loop.run_for(interval)
+            self.meta.tick()
+        self.loop.run_until_idle()
+
+    def primary_replica(self, app_id, pidx):
+        pc = self.meta.state.get_partition(app_id, pidx)
+        return self.stubs[pc.primary].get_replica((app_id, pidx))
+
+    def write(self, app_id, pidx, hk, sk, value):
+        r = self.primary_replica(app_id, pidx)
+        r.client_write([WriteOp(OP_PUT, (generate_key(hk, sk), value, 0))])
+        self.loop.run_until_idle()
+
+    def read_everywhere(self, app_id, pidx, hk, sk):
+        pc = self.meta.state.get_partition(app_id, pidx)
+        self.primary_replica(app_id, pidx).broadcast_group_check()
+        self.loop.run_until_idle()
+        out = {}
+        for node in pc.members():
+            r = self.stubs[node].get_replica((app_id, pidx))
+            out[node] = r.server.on_get(generate_key(hk, sk))
+        return out
+
+    def close(self):
+        for s in self.stubs.values():
+            s.close()
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    c = ClusterHarness(tmp_path)
+    yield c
+    c.close()
+
+
+def test_fd_tracks_liveness(cluster):
+    assert sorted(cluster.meta.fd.alive_workers()) == [
+        "node0", "node1", "node2", "node3"]
+    cluster.silence("node2")
+    assert not cluster.meta.fd.is_alive("node2")
+    assert cluster.meta.fd.is_alive("node0")
+    # lease < grace: the worker self-fences before meta declares death
+    assert not worker_lease_valid(last_ack=0.0, now=9.5)
+    assert worker_lease_valid(last_ack=0.0, now=8.0)
+
+
+def test_create_app_places_replicas(cluster):
+    app_id = cluster.meta.create_app("temp", partition_count=4,
+                                     replica_count=3)
+    cluster.loop.run_until_idle()
+    for pidx in range(4):
+        pc = cluster.meta.state.get_partition(app_id, pidx)
+        assert pc.primary and len(pc.secondaries) == 2
+        prim = cluster.stubs[pc.primary].get_replica((app_id, pidx))
+        assert prim.status == PartitionStatus.PRIMARY
+        for s in pc.secondaries:
+            assert cluster.stubs[s].get_replica(
+                (app_id, pidx)).status == PartitionStatus.SECONDARY
+    # duplicate name rejected
+    with pytest.raises(PegasusError):
+        cluster.meta.create_app("temp", 4)
+    # end-to-end write through the placed group
+    cluster.write(app_id, 0, b"hk", b"sk", b"v1")
+    reads = cluster.read_everywhere(app_id, 0, b"hk", b"sk")
+    assert all(v == (0, b"v1") for v in reads.values())
+
+
+def test_primary_failover_cure(cluster):
+    app_id = cluster.meta.create_app("t", partition_count=2,
+                                     replica_count=3)
+    cluster.loop.run_until_idle()
+    cluster.write(app_id, 0, b"hk", b"sk", b"before")
+    pc0 = cluster.meta.state.get_partition(app_id, 0)
+    dead = pc0.primary
+    cluster.net.partition(dead)
+    cluster.silence(dead)
+    pc1 = cluster.meta.state.get_partition(app_id, 0)
+    assert pc1.primary != dead and pc1.ballot > pc0.ballot
+    assert dead not in pc1.members()
+    # new primary serves reads and writes
+    cluster.write(app_id, 0, b"hk", b"sk2", b"after")
+    reads = cluster.read_everywhere(app_id, 0, b"hk", b"sk2")
+    assert all(v == (0, b"after") for v in reads.values())
+    assert cluster.primary_replica(app_id, 0).server.on_get(
+        generate_key(b"hk", b"sk")) == (0, b"before")
+
+
+def test_guardian_restores_replication_level(cluster):
+    app_id = cluster.meta.create_app("t", partition_count=1,
+                                     replica_count=3)
+    cluster.loop.run_until_idle()
+    for i in range(5):
+        cluster.write(app_id, 0, b"hk", b"s%d" % i, b"v%d" % i)
+    pc = cluster.meta.state.get_partition(app_id, 0)
+    dead = pc.secondaries[0]
+    cluster.net.partition(dead)
+    cluster.silence(dead)
+    pc2 = cluster.meta.state.get_partition(app_id, 0)
+    assert dead not in pc2.members()
+    # guardian pass adds the spare node as learner; learn completes and
+    # the partition is back at 3 replicas
+    cluster.run_beacons(rounds=3)
+    pc3 = cluster.meta.state.get_partition(app_id, 0)
+    assert len(pc3.members()) == 3
+    newcomer = [n for n in pc3.members() if n not in pc.members()][0]
+    r = cluster.stubs[newcomer].get_replica((app_id, 0))
+    assert r.status == PartitionStatus.SECONDARY
+    cluster.primary_replica(app_id, 0).broadcast_group_check()
+    cluster.loop.run_until_idle()
+    assert r.server.on_get(generate_key(b"hk", b"s3")) == (0, b"v3")
+
+
+def test_drop_and_recall(cluster):
+    app_id = cluster.meta.create_app("t", partition_count=2,
+                                     replica_count=2)
+    cluster.loop.run_until_idle()
+    cluster.write(app_id, 0, b"hk", b"sk", b"keepme")
+    cluster.meta.drop_app("t")
+    cluster.loop.run_until_idle()
+    assert cluster.meta.state.find_app("t") is None
+    with pytest.raises(PegasusError):
+        cluster.meta.query_config("t")
+    # replicas deactivated
+    pc = cluster.meta.state.get_partition(app_id, 0)
+    assert pc.primary == ""
+    # recall resurrects with data intact
+    rid = cluster.meta.recall_app("t")
+    cluster.loop.run_until_idle()
+    assert rid == app_id
+    reads = cluster.read_everywhere(app_id, 0, b"hk", b"sk")
+    assert any(v == (0, b"keepme") for v in reads.values())
+
+
+def test_query_config_and_envs(cluster):
+    cluster.meta.create_app("t", partition_count=4, replica_count=2,
+                            envs={"default_ttl": "500"})
+    cluster.loop.run_until_idle()
+    app_id, pc_count, configs = cluster.meta.query_config("t")
+    assert pc_count == 4 and len(configs) == 4
+    assert all(c.primary for c in configs)
+    # envs propagated to the hosting replicas
+    pc = configs[0]
+    r = cluster.stubs[pc.primary].get_replica((app_id, 0))
+    assert r.server.app_envs.get("default_ttl") == "500"
+    # update propagates too
+    cluster.meta.update_app_envs(
+        "t", {"replica.deny_client_request": "reject*write"})
+    cluster.loop.run_until_idle()
+    assert r.server._deny_client == "write"
+
+
+def test_lease_fencing_blocks_stale_primary_reads(cluster):
+    # regression: a partitioned old primary must self-fence (lease < grace)
+    # instead of serving stale reads through the client path
+    from pegasus_tpu.rpc.codec import OP_PUT as OPP
+    app_id = cluster.meta.create_app("t", partition_count=1,
+                                     replica_count=3)
+    cluster.loop.run_until_idle()
+    cluster.write(app_id, 0, b"hk", b"sk", b"v")
+    pc = cluster.meta.state.get_partition(app_id, 0)
+    old_primary = pc.primary
+    replies = []
+    cluster.net.register("client", lambda src, mt, p: replies.append(p))
+
+    # healthy primary serves the read
+    cluster.net.send("client", old_primary, "client_read",
+                     {"gpid": (app_id, 0), "rid": 1,
+                      "key": generate_key(b"hk", b"sk")})
+    cluster.loop.run_until_idle()
+    assert replies[-1]["err"] == 0 and replies[-1]["value"] == b"v"
+
+    # partition the primary; its lease lapses while meta cures
+    cluster.net.partition(old_primary)
+    cluster.silence(old_primary)
+    cluster.net.heal(old_primary)  # network back, but lease expired
+    cluster.net.send("client", old_primary, "client_read",
+                     {"gpid": (app_id, 0), "rid": 2,
+                      "key": generate_key(b"hk", b"sk")})
+    cluster.loop.run_until_idle()
+    assert replies[-1]["rid"] == 2 and replies[-1]["err"] != 0
+
+    # the cured primary serves through the same path
+    pc2 = cluster.meta.state.get_partition(app_id, 0)
+    assert pc2.primary != old_primary
+    cluster.net.send("client", pc2.primary, "client_read",
+                     {"gpid": (app_id, 0), "rid": 3,
+                      "key": generate_key(b"hk", b"sk")})
+    cluster.loop.run_until_idle()
+    assert replies[-1]["err"] == 0 and replies[-1]["value"] == b"v"
+
+
+def test_client_write_path_over_network(cluster):
+    from pegasus_tpu.rpc.codec import OP_PUT as OPP
+    app_id = cluster.meta.create_app("t", partition_count=1,
+                                     replica_count=2)
+    cluster.loop.run_until_idle()
+    pc = cluster.meta.state.get_partition(app_id, 0)
+    replies = []
+    cluster.net.register("client", lambda src, mt, p: replies.append(p))
+    cluster.net.send("client", pc.primary, "client_write", {
+        "gpid": (app_id, 0), "rid": 7,
+        "ops": [(OPP, (generate_key(b"hk", b"sk"), b"netv", 0))]})
+    cluster.loop.run_until_idle()
+    assert replies and replies[-1]["rid"] == 7 and replies[-1]["err"] == 0
+    # a secondary refuses client writes
+    cluster.net.send("client", pc.secondaries[0], "client_write", {
+        "gpid": (app_id, 0), "rid": 8,
+        "ops": [(OPP, (generate_key(b"hk", b"x"), b"y", 0))]})
+    cluster.loop.run_until_idle()
+    assert replies[-1]["rid"] == 8 and replies[-1]["err"] != 0
+
+
+def test_stub_restart_recovers_partition_count(tmp_path):
+    c = ClusterHarness(tmp_path)
+    try:
+        app_id = c.meta.create_app("t", partition_count=8, replica_count=2)
+        c.loop.run_until_idle()
+        pc = c.meta.state.get_partition(app_id, 3)
+        node = pc.primary
+        r = c.stubs[node].get_replica((app_id, 3))
+        assert r.server.partition_count == 8
+        c.stubs[node].close()
+        # reboot the node: the boot scan must restore the real count
+        from pegasus_tpu.replica.stub import ReplicaStub
+        stub2 = ReplicaStub(node, str(tmp_path / node), c.net,
+                            clock=lambda: 1_700_000_000 + c.loop.now)
+        c.stubs[node] = stub2
+        r2 = stub2.get_replica((app_id, 3))
+        assert r2.server.partition_count == 8
+        assert r2.server.validate_partition_hash
+    finally:
+        c.close()
+
+
+def test_recall_rejected_when_name_reused(cluster):
+    cluster.meta.create_app("t", partition_count=1, replica_count=2)
+    cluster.loop.run_until_idle()
+    cluster.meta.drop_app("t")
+    cluster.meta.create_app("t", partition_count=1, replica_count=2)
+    cluster.loop.run_until_idle()
+    with pytest.raises(PegasusError):
+        cluster.meta.recall_app("t")
+
+
+def test_desired_replica_count_survives_small_cluster(tmp_path):
+    # create with only 2 nodes alive; when more join, the guardian tops up
+    c = ClusterHarness(tmp_path, n_nodes=2)
+    try:
+        app_id = c.meta.create_app("t", partition_count=1, replica_count=3)
+        c.loop.run_until_idle()
+        assert len(c.meta.state.get_partition(app_id, 0).members()) == 2
+        assert c.meta.state.apps[app_id].max_replica_count == 3
+        # a third node joins
+        from pegasus_tpu.replica.stub import ReplicaStub
+        s = ReplicaStub("node9", str(tmp_path / "node9"), c.net,
+                        clock=lambda: 1_700_000_000 + c.loop.now)
+        s.meta_addr = "meta"
+        c.stubs["node9"] = s
+        c.run_beacons(rounds=4)
+        pc = c.meta.state.get_partition(app_id, 0)
+        assert len(pc.members()) == 3 and "node9" in pc.members()
+    finally:
+        c.close()
+
+
+def test_meta_state_persists_across_restart(tmp_path):
+    c = ClusterHarness(tmp_path)
+    try:
+        app_id = c.meta.create_app("t", partition_count=2, replica_count=2)
+        c.loop.run_until_idle()
+        pc_before = c.meta.state.get_partition(app_id, 0)
+        # meta restarts from its storage file
+        meta2 = MetaService("meta2", str(tmp_path / "meta"), c.net,
+                            lambda: c.loop.now)
+        assert meta2.state.apps[app_id].app_name == "t"
+        pc_after = meta2.state.get_partition(app_id, 0)
+        assert pc_after.to_json() == pc_before.to_json()
+    finally:
+        c.close()
