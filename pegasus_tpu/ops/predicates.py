@@ -119,17 +119,22 @@ class ScanMasks(NamedTuple):
 
 @functools.partial(jax.jit, static_argnames=("hash_filter_type",
                                              "sort_filter_type",
-                                             "validate_hash"))
+                                             "validate_hash",
+                                             "use_hash_lo"))
 def _scan_block_predicate(keys, key_len, hashkey_len, expire_ts, valid,
                           now, hash_pattern, hash_pattern_len,
                           sort_pattern, sort_pattern_len,
                           pidx, partition_version,
                           hash_filter_type: int, sort_filter_type: int,
-                          validate_hash: bool) -> ScanMasks:
+                          validate_hash: bool, hash_lo=None,
+                          use_hash_lo: bool = False) -> ScanMasks:
     expired = ttl_expired(expire_ts, now) & valid
 
     if validate_hash:
-        _, lo = key_hash_device(keys, key_len, hashkey_len)
+        if use_hash_lo:
+            lo = hash_lo  # precomputed at SST write time
+        else:
+            _, lo = key_hash_device(keys, key_len, hashkey_len)
         pv = jnp.asarray(partition_version, jnp.uint32)
         hash_ok = (lo & pv) == jnp.asarray(pidx, jnp.uint32)
     else:
@@ -169,6 +174,7 @@ def scan_block_predicate(block: RecordBlock, now,
                               jnp.asarray(now, jnp.uint32)) & valid
         zeros = jnp.zeros((block.capacity,), dtype=bool)
         return ScanMasks(zeros, expired, valid & ~expired, zeros)
+    use_hash_lo = validate_hash and block.hash_lo is not None
     return _scan_block_predicate(
         jnp.asarray(block.keys), jnp.asarray(block.key_len),
         jnp.asarray(block.hashkey_len), jnp.asarray(block.expire_ts),
@@ -177,4 +183,7 @@ def scan_block_predicate(block: RecordBlock, now,
         sort_filter.pattern, sort_filter.pattern_len,
         jnp.asarray(pidx, jnp.uint32),
         jnp.asarray(partition_version & 0xFFFFFFFF, jnp.uint32),
-        hash_filter.filter_type, sort_filter.filter_type, validate_hash)
+        hash_filter.filter_type, sort_filter.filter_type, validate_hash,
+        hash_lo=(jnp.asarray(block.hash_lo) if use_hash_lo
+                 else jnp.zeros((1,), jnp.uint32)),
+        use_hash_lo=use_hash_lo)

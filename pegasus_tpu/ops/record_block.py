@@ -31,13 +31,18 @@ _MAX_WIDTH = 1 << 16
 
 class RecordBlock(NamedTuple):
     """Host (numpy) or device (jax) columnar record block; NamedTuple makes
-    it a pytree so it can flow through jit boundaries unchanged."""
+    it a pytree so it can flow through jit boundaries unchanged.
+
+    `hash_lo` (crc64 lo lane of pegasus_key_hash) is optional: SST blocks
+    carry it precomputed; ad-hoc blocks leave it None and the predicate
+    kernel computes the hash on device when needed."""
 
     keys: np.ndarray        # uint8[B, K]
     key_len: np.ndarray     # int32[B]
     hashkey_len: np.ndarray  # int32[B]
     expire_ts: np.ndarray   # uint32[B]
     valid: np.ndarray       # bool[B]
+    hash_lo: np.ndarray | None = None  # uint32[B] or None
 
     @property
     def capacity(self) -> int:
@@ -95,7 +100,8 @@ def build_record_block(
 
 def block_from_columns(keys: np.ndarray, key_len: np.ndarray,
                        expire_ts: np.ndarray,
-                       valid: np.ndarray | None = None) -> RecordBlock:
+                       valid: np.ndarray | None = None,
+                       hash_lo: np.ndarray | None = None) -> RecordBlock:
     """Build a block from already-columnar storage (SST blocks are stored in
     this layout — no per-record host work on the read path)."""
     keys = np.ascontiguousarray(keys, dtype=np.uint8)
@@ -106,4 +112,6 @@ def block_from_columns(keys: np.ndarray, key_len: np.ndarray,
         valid = key_len >= 2
     return RecordBlock(keys, key_len, hashkey_len,
                        np.asarray(expire_ts, dtype=np.uint32),
-                       np.asarray(valid, dtype=bool))
+                       np.asarray(valid, dtype=bool),
+                       None if hash_lo is None
+                       else np.asarray(hash_lo, dtype=np.uint32))

@@ -124,6 +124,11 @@ class PartitionServer:
         # per-table dynamic app-envs (parity: src/common/replica_envs.h:39-83
         # propagated through config-sync; here set via update_app_envs)
         self.app_envs: dict = {}
+        # fused Pallas scan kernel (ops/pallas_scan.py): opt-in until
+        # validated on real hardware; covers scans without a hashkey filter
+        import os as _os
+        self._use_fused_kernel = _os.environ.get("PEGASUS_TPU_FUSED") == "1"
+        self._prepared_cache: "OrderedDict[tuple, tuple]" = OrderedDict()
         self._deny_client = ""          # "", "all", "write", "read"
         self._write_throttle = None     # TokenBucket (reject mode)
         self._read_throttle = None
@@ -469,14 +474,17 @@ class PartitionServer:
             cache_key = (sorted_run.path, bm.offset)
             dev_block = self._device_block_cache.get(cache_key)
             if dev_block is None:
-                nb = block_from_columns(blk.keys, blk.key_len, blk.expire_ts)
+                nb = block_from_columns(blk.keys, blk.key_len, blk.expire_ts,
+                                        hash_lo=blk.hash_lo)
                 pad = cap - n
                 dev_block = RecordBlock(
                     jnp.asarray(np.pad(nb.keys, ((0, pad), (0, 0)))),
                     jnp.asarray(np.pad(nb.key_len, (0, pad))),
                     jnp.asarray(np.pad(nb.hashkey_len, (0, pad))),
                     jnp.asarray(np.pad(nb.expire_ts, (0, pad))),
-                    jnp.asarray(np.pad(nb.valid, (0, pad))))
+                    jnp.asarray(np.pad(nb.valid, (0, pad))),
+                    None if nb.hash_lo is None
+                    else jnp.asarray(np.pad(nb.hash_lo, (0, pad))))
                 self._device_block_cache[cache_key] = dev_block
                 if len(self._device_block_cache) > self._device_block_cache_cap:
                     self._device_block_cache.popitem(last=False)
@@ -484,14 +492,37 @@ class PartitionServer:
                 self._device_block_cache.move_to_end(cache_key)
             block = (dev_block if valid is None
                      else dev_block._replace(valid=jnp.asarray(valid)))
-            masks = scan_block_predicate(
-                block, now, hash_filter=hash_filter, sort_filter=sort_filter,
-                validate_hash=validate_hash, pidx=self.pidx,
-                partition_version=self.partition_version)
-            expired = int(np.asarray(masks.expired).sum())
+            fused_ok = (self._use_fused_kernel
+                        and hash_filter.filter_type == FT_NO_FILTER
+                        and int(sort_filter.pattern_len) <= 32
+                        and valid is None
+                        and dev_block.hash_lo is not None)
+            if fused_ok:
+                from pegasus_tpu.ops.pallas_scan import (
+                    fused_scan_block, prepare_transposed)
+                prepared = self._prepared_cache.get(cache_key)
+                if prepared is None:
+                    prepared = prepare_transposed(dev_block)
+                    self._prepared_cache[cache_key] = prepared
+                    if len(self._prepared_cache) > self._device_block_cache_cap:
+                        self._prepared_cache.popitem(last=False)
+                else:
+                    self._prepared_cache.move_to_end(cache_key)
+                keep, expired_mask = fused_scan_block(
+                    dev_block, now, sort_filter=sort_filter, pidx=self.pidx,
+                    partition_version=self.partition_version,
+                    validate_hash=validate_hash, prepared=prepared)
+                expired = int(expired_mask.sum())
+            else:
+                masks = scan_block_predicate(
+                    block, now, hash_filter=hash_filter,
+                    sort_filter=sort_filter, validate_hash=validate_hash,
+                    pidx=self.pidx,
+                    partition_version=self.partition_version)
+                expired = int(np.asarray(masks.expired).sum())
+                keep = np.asarray(masks.keep)
             if expired:
                 self._abnormal_reads.increment(expired)
-            keep = np.asarray(masks.keep)
             stop_early = False
             for i in np.flatnonzero(keep):
                 key = blk.key_at(i)
@@ -710,3 +741,4 @@ class PartitionServer:
             # the old L1 file is gone; its cached device blocks can never
             # hit again — drop them instead of pinning dead HBM
             self._device_block_cache.clear()
+            self._prepared_cache.clear()

@@ -6,6 +6,11 @@ the design: instead of row-oriented key/value entries, each block stores
     keys        uint8[count, key_width]  (padded rows, width bucketed pow2)
     key_len     int32[count]
     expire_ts   uint32[count]            (decoded from the value header)
+    hash_lo     uint32[count]            (low lane of crc64(pegasus_key_hash),
+                                          precomputed at write time so the
+                                          scan path validates partition
+                                          ownership with ONE compare instead
+                                          of a per-byte crc loop on device)
     flags       uint8[count]             (bit0 = tombstone)
     value_offs  uint32[count+1]
     value_heap  bytes                    (full pegasus-encoded values)
@@ -31,10 +36,11 @@ from typing import Iterator, List, Optional, Tuple
 
 import numpy as np
 
-from pegasus_tpu.base.crc import crc32
+from pegasus_tpu.base.crc import crc32, crc64_batch
 from pegasus_tpu.ops.record_block import next_bucket
 
-MAGIC = b"PGT1"
+MAGIC = b"PGT2"
+MAGIC_V1 = b"PGT1"  # pre-hash_lo format, still readable
 FOOTER = struct.Struct("<QII4s")  # index_offset, index_size, index_crc, magic
 _BLOCK_HDR = struct.Struct("<IIQ")  # count, key_width, value_heap_size
 
@@ -56,13 +62,15 @@ class BlockMeta:
 class Block:
     """A decoded columnar block; arrays are views over the file bytes."""
 
-    __slots__ = ("keys", "key_len", "expire_ts", "flags", "value_offs",
-                 "value_heap")
+    __slots__ = ("keys", "key_len", "expire_ts", "hash_lo", "flags",
+                 "value_offs", "value_heap")
 
-    def __init__(self, keys, key_len, expire_ts, flags, value_offs, value_heap):
+    def __init__(self, keys, key_len, expire_ts, hash_lo, flags, value_offs,
+                 value_heap):
         self.keys = keys              # uint8[N, W]
         self.key_len = key_len        # int32[N]
         self.expire_ts = expire_ts    # uint32[N]
+        self.hash_lo = hash_lo        # uint32[N]
         self.flags = flags            # uint8[N]
         self.value_offs = value_offs  # uint32[N+1]
         self.value_heap = value_heap  # bytes
@@ -132,11 +140,20 @@ class SSTableWriter:
         offs[n] = pos
         heap = b"".join(heap_parts)
 
+        # pegasus_key_hash lo lane: crc64 of the hashkey region (or the
+        # sortkey region when the hashkey is empty) — write-time work that
+        # removes the crc loop from every future scan of this block
+        hkl = (keys[:, 0].astype(np.int64) << 8) | keys[:, 1].astype(np.int64)
+        region_len = np.where(hkl > 0, hkl, key_len.astype(np.int64) - 2)
+        hash_lo = (crc64_batch(keys, region_len, start=2)
+                   & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+
         offset = self._f.tell()
         self._f.write(_BLOCK_HDR.pack(n, width, len(heap)))
         self._f.write(keys.tobytes())
         self._f.write(key_len.tobytes())
         self._f.write(ets.tobytes())
+        self._f.write(hash_lo.tobytes())
         self._f.write(flags.tobytes())
         self._f.write(offs.tobytes())
         self._f.write(heap)
@@ -186,8 +203,9 @@ class SSTable:
         self._f.seek(file_size - FOOTER.size)
         index_offset, index_size, index_crc, magic = FOOTER.unpack(
             self._f.read(FOOTER.size))
-        if magic != MAGIC:
+        if magic not in (MAGIC, MAGIC_V1):
             raise ValueError(f"{path}: bad footer magic")
+        self._has_hash_lo = magic == MAGIC
         self._f.seek(index_offset)
         blob = self._f.read(index_size)
         if crc32(blob) != index_crc:
@@ -231,12 +249,17 @@ class SSTable:
         pos += 4 * n
         ets = np.frombuffer(raw, dtype=np.uint32, count=n, offset=pos)
         pos += 4 * n
+        if self._has_hash_lo:
+            hash_lo = np.frombuffer(raw, dtype=np.uint32, count=n, offset=pos)
+            pos += 4 * n
+        else:
+            hash_lo = None  # v1 file: predicate path computes on device
         flags = np.frombuffer(raw, dtype=np.uint8, count=n, offset=pos)
         pos += n
         offs = np.frombuffer(raw, dtype=np.uint32, count=n + 1, offset=pos)
         pos += 4 * (n + 1)
         heap = raw[pos:pos + heap_size]
-        blk = Block(keys, key_len, ets, flags, offs, heap)
+        blk = Block(keys, key_len, ets, hash_lo, flags, offs, heap)
         if len(self._cache) >= self._cache_cap:
             self._cache.pop(next(iter(self._cache)))
         self._cache[idx] = blk

@@ -30,13 +30,14 @@ import jax._src.xla_bridge as _xb
 # private API moves — silently keeping the axon factory would make the whole
 # test session dial the single-tenant TPU pool (observed: >120s hangs).
 jax.config.update("jax_platforms", "cpu")
-for _name in list(_xb._backend_factories):
-    if _name != "cpu":
-        _xb._backend_factories.pop(_name, None)
-_left = [n for n in _xb._backend_factories if n != "cpu"]
-if _left:
+# pop ONLY the axon tunnel plugin: popping "tpu" as well would remove it
+# from xb.known_platforms() and break importing pallas' TPU lowerings
+_xb._backend_factories.pop("axon", None)
+# prove the isolation actually holds: backend init must yield cpu devices
+# only (this would hang/fail loudly if the tunnel were still reachable)
+_devs = {d.platform for d in jax.devices()}
+if _devs != {"cpu"}:
     raise RuntimeError(
-        f"conftest failed to de-register non-cpu jax backends: {_left}; "
-        "tests must not touch the TPU tunnel")
+        f"conftest failed to isolate tests from the TPU tunnel: {_devs}")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

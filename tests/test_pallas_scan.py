@@ -1,0 +1,99 @@
+"""Fused Pallas scan kernel vs the reference jnp predicate path.
+
+Runs in interpret mode on CPU; the same program compiles for TPU.
+"""
+
+import numpy as np
+import pytest
+
+from pegasus_tpu.base.crc import crc64
+from pegasus_tpu.base.key_schema import generate_key, key_hash
+from pegasus_tpu.ops.pallas_scan import fused_scan_block, prepare_transposed
+from pegasus_tpu.ops.predicates import (
+    FT_MATCH_ANYWHERE,
+    FT_MATCH_POSTFIX,
+    FT_MATCH_PREFIX,
+    FT_NO_FILTER,
+    FilterSpec,
+    scan_block_predicate,
+)
+from pegasus_tpu.ops.record_block import build_record_block
+
+
+def _block_with_hash(keys, ets, capacity=None):
+    block = build_record_block(keys, ets, capacity=capacity)
+    n = block.capacity
+    hash_lo = np.zeros(n, dtype=np.uint32)
+    for i, k in enumerate(keys):
+        hash_lo[i] = key_hash(k) & 0xFFFFFFFF
+    return block._replace(hash_lo=hash_lo)
+
+
+def _random_keys(rng, n, pattern=b""):
+    keys = []
+    for _ in range(n):
+        hk = bytes(rng.integers(97, 123, size=rng.integers(1, 10),
+                                dtype=np.uint8))
+        sk = bytes(rng.integers(97, 123, size=rng.integers(0, 16),
+                                dtype=np.uint8))
+        if pattern and rng.random() < 0.5:
+            pos = rng.integers(0, len(sk) + 1)
+            sk = sk[:pos] + pattern + sk[pos:]
+        keys.append(generate_key(hk, sk))
+    return keys
+
+
+@pytest.mark.parametrize("ftype", [FT_NO_FILTER, FT_MATCH_ANYWHERE,
+                                   FT_MATCH_PREFIX, FT_MATCH_POSTFIX])
+def test_fused_matches_jnp_path(ftype):
+    rng = np.random.default_rng(ftype)
+    keys = _random_keys(rng, 100, pattern=b"abc")
+    ets = [0 if i % 4 else 500 for i in range(100)]
+    block = _block_with_hash(keys, ets, capacity=128)
+    spec = FilterSpec.make(ftype, b"abc")
+    now = 1000
+    keep_f, expired_f = fused_scan_block(
+        block, now, sort_filter=spec, validate_hash=True, pidx=3,
+        partition_version=7, interpret=True)
+    masks = scan_block_predicate(block, now, sort_filter=spec,
+                                 validate_hash=True, pidx=3,
+                                 partition_version=7)
+    np.testing.assert_array_equal(keep_f, np.asarray(masks.keep))
+    np.testing.assert_array_equal(expired_f, np.asarray(masks.expired))
+
+
+def test_fused_no_validate_hash():
+    keys = [generate_key(b"h%d" % i, b"s%d" % i) for i in range(10)]
+    block = _block_with_hash(keys, [0] * 10, capacity=16)
+    keep, expired = fused_scan_block(block, 100, interpret=True)
+    assert keep[:10].all() and not keep[10:].any()
+    assert not expired.any()
+
+
+def test_fused_requires_hash_column():
+    keys = [generate_key(b"h", b"s")]
+    block = build_record_block(keys, [0])
+    with pytest.raises(ValueError):
+        fused_scan_block(block, 0, validate_hash=True, partition_version=1)
+
+
+def test_fused_with_prepared_cache():
+    keys = [generate_key(b"hk", b"s%02d" % i) for i in range(20)]
+    block = _block_with_hash(keys, [0] * 20, capacity=32)
+    prepared = prepare_transposed(block)
+    spec = FilterSpec.make(FT_MATCH_PREFIX, b"s0")
+    keep1, _ = fused_scan_block(block, 0, sort_filter=spec, interpret=True,
+                                prepared=prepared)
+    keep2, _ = fused_scan_block(block, 0, sort_filter=spec, interpret=True)
+    np.testing.assert_array_equal(keep1, keep2)
+    assert keep1[:10].all() and not keep1[10:20].any()
+
+
+def test_fused_long_pattern_rejected():
+    keys = [generate_key(b"h", b"s")]
+    block = _block_with_hash(keys, [0])
+    with pytest.raises(ValueError):
+        fused_scan_block(block, 0,
+                         sort_filter=FilterSpec.make(FT_MATCH_PREFIX,
+                                                     b"x" * 40),
+                         interpret=True)

@@ -126,6 +126,28 @@ def test_sstable_roundtrip(tmp_path):
     t.close()
 
 
+def test_sstable_hash_lo_column_matches_key_hash(tmp_path):
+    # the writer's precomputed crc column IS what validate_hash scans
+    # compare against — it must equal pegasus_key_hash's lo lane, including
+    # the empty-hashkey fallback
+    from pegasus_tpu.base.key_schema import key_hash
+    path = str(tmp_path / "t.sst")
+    w = SSTableWriter(path, block_capacity=4)
+    keys = sorted([k("user%d" % i, "s%d" % i) for i in range(9)]
+                  + [k("", "sortonly")])
+    for key in keys:
+        w.add(key, b"v")
+    w.finish()
+    t = SSTable(path)
+    got = {}
+    for _, blk in t.iter_blocks():
+        for i in range(blk.count):
+            got[blk.key_at(i)] = int(blk.hash_lo[i])
+    for key in keys:
+        assert got[key] == (key_hash(key) & 0xFFFFFFFF), key
+    t.close()
+
+
 def test_sstable_rejects_unsorted(tmp_path):
     w = SSTableWriter(str(tmp_path / "x.sst"))
     w.add(k("b"), b"v")
