@@ -14,7 +14,7 @@ from __future__ import annotations
 
 import os
 import struct
-from typing import Iterator, List, Optional
+from typing import Iterator, List, Optional, Tuple
 
 from pegasus_tpu.base.crc import crc32
 from pegasus_tpu.replica.mutation import Mutation
@@ -33,6 +33,9 @@ class MutationLog:
             with open(path, "r+b") as f:
                 f.truncate(valid_end)
         self._f = open(path, "ab")
+        # bumped whenever the file is rewritten (gc): readers holding byte
+        # offsets must restart from 0 when the generation changes
+        self.generation = 0
 
     @staticmethod
     def _scan(path: str) -> tuple[Optional[int], int]:
@@ -97,6 +100,27 @@ class MutationLog:
                 best[mu.decree] = mu
         return [best[d] for d in sorted(best)]
 
+    def read_tail(self, offset: int) -> "Tuple[List[Mutation], int]":
+        """Incremental read: frames starting at byte `offset`, plus the new
+        end offset (parity: load_from_private_log tails the log instead of
+        re-reading it — callers re-tail from 0 when `generation` changes)."""
+        with open(self.path, "rb") as f:
+            f.seek(offset)
+            data = f.read()
+        out: List[Mutation] = []
+        pos = 0
+        while pos + _FRAME.size <= len(data):
+            length, want = _FRAME.unpack_from(data, pos)
+            end = pos + _FRAME.size + length
+            if end > len(data):
+                break
+            blob = data[pos + _FRAME.size:end]
+            if crc32(blob) != want:
+                break
+            out.append(Mutation.decode(blob))
+            pos = end
+        return out, offset + pos
+
     def gc(self, durable_decree: int) -> None:
         """Drop everything <= durable_decree (rewrite in place)."""
         keep = [mu for mu in self.replay(self.path)
@@ -110,6 +134,7 @@ class MutationLog:
             f.flush()
             os.fsync(f.fileno())
         self._f = open(self.path, "ab")
+        self.generation += 1
 
     def close(self) -> None:
         self._f.close()

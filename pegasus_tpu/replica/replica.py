@@ -114,6 +114,10 @@ class Replica:
             if mu.decree > self.prepare_list.last_committed_decree:
                 self.prepare_list.prepare(mu)
 
+        # primary-assigned mutation timestamps must be strictly monotonic
+        # (duplication conflict resolution and timetag uniqueness depend on
+        # it; the reference guarantees this per-primary)
+        self._last_timestamp_us = 0
         # primary-side state (parity: primary_context, replica_context.h)
         self._pending_acks: Dict[int, Set[str]] = {}
         self._client_callbacks: Dict[int, Callable[[List[Any]], None]] = {}
@@ -206,10 +210,14 @@ class Replica:
         if any(wo.op in ATOMIC_OPS for wo in ops) and len(ops) > 1:
             raise ValueError("atomic ops cannot batch with other writes")
         decree = self.last_prepared_decree() + 1
+        ts = max(int(self.clock() * 1_000_000), self._last_timestamp_us + 1)
+        # reserve one microsecond PER OP: duplication stamps op i with
+        # ts + i, and the next mutation must not overlap those timetags
+        self._last_timestamp_us = ts + max(len(ops), 1) - 1
         mu = Mutation(
             ballot=self.config.ballot, decree=decree,
             last_committed=self.last_committed_decree,
-            timestamp_us=int(self.clock() * 1_000_000), ops=ops)
+            timestamp_us=ts, ops=ops)
         self.prepare_list.prepare(mu)
         self.log.append(mu)
         if callback is not None:

@@ -302,6 +302,42 @@ class WriteService:
         resp.error = int(StorageStatus.OK)
         return resp, items
 
+    # -- duplicated writes (parity: the duplicate-apply variants in
+    # pegasus_write_service_impl + value timetag conflict resolution,
+    # base/pegasus_value_schema.h:175-209) ------------------------------
+
+    def _existing_timetag(self, key: bytes) -> int:
+        hit = self.engine.get(key)
+        if hit is None:
+            return 0
+        value, _ = hit
+        if self.data_version < 1 or len(value) < 12:
+            return 0
+        from pegasus_tpu.base.value_schema import extract_timetag
+        return extract_timetag(self.data_version, value)
+
+    def duplicate_put(self, key: bytes, user_data: bytes, expire_ts: int,
+                      timetag: int, decree: int) -> bool:
+        """Apply a write shipped from a remote cluster iff its timetag wins
+        (larger timestamp, then cluster id, resolves master-master
+        conflicts). Returns whether it applied."""
+        if timetag <= self._existing_timetag(key):
+            self.apply_items([], decree)  # decree still advances
+            return False
+        from pegasus_tpu.base.value_schema import generate_value
+        value = generate_value(self.data_version, user_data, expire_ts,
+                               timetag)
+        self.apply_items([WriteBatchItem(OP_PUT, key, value, expire_ts)],
+                         decree)
+        return True
+
+    def duplicate_remove(self, key: bytes, timetag: int, decree: int) -> bool:
+        if timetag <= self._existing_timetag(key):
+            self.apply_items([], decree)
+            return False
+        self.apply_items([WriteBatchItem(OP_DEL, key)], decree)
+        return True
+
     # -- apply phase ----------------------------------------------------
 
     def apply_items(self, items: List[WriteBatchItem], decree: int) -> None:

@@ -1,0 +1,106 @@
+"""Block service: remote blob storage for backup artifacts.
+
+Parity: src/block_service/block_service.h:273,337 — the abstract remote
+file system (create_file / write / read / list_dir / remove_path /
+upload / download) used by cold backup, restore, and bulk load. Backends:
+LocalFS here (parity: block_service/local/local_service.h:47); an object
+store (GCS/HDFS-style) backend slots in behind the same interface.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import shutil
+from typing import List, Optional
+
+
+class BlockService:
+    """Interface."""
+
+    def write_file(self, path: str, data: bytes) -> None:
+        raise NotImplementedError
+
+    def read_file(self, path: str) -> bytes:
+        raise NotImplementedError
+
+    def exists(self, path: str) -> bool:
+        raise NotImplementedError
+
+    def list_dir(self, path: str) -> List[str]:
+        raise NotImplementedError
+
+    def remove_path(self, path: str) -> None:
+        raise NotImplementedError
+
+    def upload(self, local_path: str, remote_path: str) -> None:
+        with open(local_path, "rb") as f:
+            self.write_file(remote_path, f.read())
+
+    def download(self, remote_path: str, local_path: str) -> None:
+        os.makedirs(os.path.dirname(local_path) or ".", exist_ok=True)
+        with open(local_path, "wb") as f:
+            f.write(self.read_file(remote_path))
+
+
+class LocalBlockService(BlockService):
+    """Filesystem-backed blob store with content md5s in a sidecar index
+    (parity: local_service writes .md5 metadata alongside files)."""
+
+    def __init__(self, root: str) -> None:
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+
+    def _abs(self, path: str) -> str:
+        p = os.path.normpath(os.path.join(self.root, path.lstrip("/")))
+        root = os.path.normpath(self.root)
+        if os.path.commonpath([p, root]) != root:
+            raise ValueError(f"path escapes block service root: {path}")
+        return p
+
+    def write_file(self, path: str, data: bytes) -> None:
+        abs_path = self._abs(path)
+        os.makedirs(os.path.dirname(abs_path), exist_ok=True)
+        tmp = abs_path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        # data first, checksum after: a crash in between leaves old data
+        # with the OLD md5 (readable), never new-md5-over-old-data
+        os.replace(tmp, abs_path)
+        with open(abs_path + ".md5", "w") as f:
+            f.write(hashlib.md5(data).hexdigest())
+
+    def read_file(self, path: str) -> bytes:
+        abs_path = self._abs(path)
+        with open(abs_path, "rb") as f:
+            data = f.read()
+        md5_path = abs_path + ".md5"
+        if os.path.exists(md5_path):
+            with open(md5_path) as f:
+                want = f.read().strip()
+            if hashlib.md5(data).hexdigest() != want:
+                raise IOError(f"block service md5 mismatch for {path}")
+        return data
+
+    def exists(self, path: str) -> bool:
+        return os.path.exists(self._abs(path))
+
+    def list_dir(self, path: str) -> List[str]:
+        abs_path = self._abs(path)
+        if not os.path.isdir(abs_path):
+            return []
+        return sorted(n for n in os.listdir(abs_path)
+                      if not n.endswith((".md5", ".tmp")))
+
+    def remove_path(self, path: str) -> None:
+        abs_path = self._abs(path)
+        if os.path.isdir(abs_path):
+            shutil.rmtree(abs_path)
+        elif os.path.exists(abs_path):
+            os.remove(abs_path)
+            md5 = abs_path + ".md5"
+            if os.path.exists(md5):
+                os.remove(md5)

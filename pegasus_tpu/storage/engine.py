@@ -118,6 +118,44 @@ class StorageEngine:
                 reverse: bool = False):
         return self.lsm.iterate(start, stop, reverse)
 
+    # ---- checkpoint (parity: replication_app_base.h:171-236 +
+    # rocksdb Checkpoint::CreateCheckpoint usage in pegasus_server_impl) --
+
+    def checkpoint(self, dest_dir: str) -> int:
+        """Flush, then materialize a consistent snapshot of the store into
+        `dest_dir` (the checkpoint.<decree> analogue). Returns the decree
+        the checkpoint contains."""
+        import shutil
+
+        self.flush()
+        os.makedirs(dest_dir, exist_ok=True)
+        sst_dir = os.path.join(self.data_dir, "sst")
+        for name in os.listdir(sst_dir):
+            if name.endswith(".sst"):
+                shutil.copy2(os.path.join(sst_dir, name),
+                             os.path.join(dest_dir, name))
+        return self.last_flushed_decree
+
+    @staticmethod
+    def restore_from_checkpoint(checkpoint_dir: str, data_dir: str
+                                ) -> "StorageEngine":
+        """Open a fresh engine whose state is the checkpoint's content
+        (parity: storage_apply_checkpoint / restore-from-backup branch,
+        pegasus_server_impl.cpp:1624)."""
+        import shutil
+
+        sst_dir = os.path.join(data_dir, "sst")
+        shutil.rmtree(sst_dir, ignore_errors=True)
+        os.makedirs(sst_dir, exist_ok=True)
+        for name in os.listdir(checkpoint_dir):
+            if name.endswith(".sst"):
+                shutil.copy2(os.path.join(checkpoint_dir, name),
+                             os.path.join(sst_dir, name))
+        wal = os.path.join(data_dir, "wal.log")
+        if os.path.exists(wal):
+            os.remove(wal)
+        return StorageEngine(data_dir)
+
     # ---- compaction ---------------------------------------------------
 
     def manual_compact(self, default_ttl: int = 0, pidx: int = 0,

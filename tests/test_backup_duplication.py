@@ -1,0 +1,235 @@
+"""Cold backup/restore over the block service + cross-cluster duplication."""
+
+import os
+
+import pytest
+
+from pegasus_tpu.base.key_schema import generate_key
+from pegasus_tpu.client import PegasusClient, Table
+from pegasus_tpu.replica.mutation import WriteOp
+from pegasus_tpu.replica.replica import Replica, ReplicaConfig
+from pegasus_tpu.rpc.codec import OP_INCR, OP_MULTI_PUT, OP_PUT, OP_REMOVE
+from pegasus_tpu.runtime import SimLoop, SimNetwork
+from pegasus_tpu.server.backup import (
+    BackupEngine,
+    BackupPolicy,
+    BackupScheduler,
+)
+from pegasus_tpu.server.duplication import ReplicaDuplicator, TableShipper
+from pegasus_tpu.server.types import IncrRequest, KeyValue, MultiPutRequest
+from pegasus_tpu.storage.block_service import LocalBlockService
+from pegasus_tpu.storage.engine import StorageEngine, WriteBatchItem
+from pegasus_tpu.storage.wal import OP_PUT as WAL_PUT
+
+
+def k(h, s):
+    return generate_key(h, s)
+
+
+# ---- block service ----------------------------------------------------
+
+
+def test_block_service_roundtrip(tmp_path):
+    bs = LocalBlockService(str(tmp_path / "bs"))
+    bs.write_file("a/b/file.bin", b"hello")
+    assert bs.exists("a/b/file.bin")
+    assert bs.read_file("a/b/file.bin") == b"hello"
+    assert bs.list_dir("a/b") == ["file.bin"]
+    # md5 integrity check
+    with open(bs._abs("a/b/file.bin"), "wb") as f:
+        f.write(b"corrupted")
+    with pytest.raises(IOError):
+        bs.read_file("a/b/file.bin")
+    bs.remove_path("a")
+    assert not bs.exists("a/b/file.bin")
+
+
+def test_block_service_rejects_escape(tmp_path):
+    bs = LocalBlockService(str(tmp_path / "bs"))
+    with pytest.raises(ValueError):
+        bs.write_file("../outside", b"x")
+
+
+# ---- backup / restore -------------------------------------------------
+
+
+def test_backup_restore_roundtrip(tmp_path):
+    from pegasus_tpu.base.value_schema import generate_value
+    eng = StorageEngine(str(tmp_path / "src"))
+    items = [WriteBatchItem(WAL_PUT, k(b"h%02d" % i, b"s"),
+                            generate_value(1, b"v%d" % i, 0), 0)
+             for i in range(50)]
+    eng.write_batch(items, decree=1)
+
+    bs = LocalBlockService(str(tmp_path / "bs"))
+    be = BackupEngine(bs, "daily")
+    decree = be.backup_partition(backup_id=100, app_id=2, pidx=0,
+                                 engine=eng)
+    assert decree == 1
+    be.finish_backup(100, 2, "mytable", 1)
+    assert be.list_backups() == [100]
+    meta = be.read_backup_metadata(100)
+    assert meta["app_name"] == "mytable" and meta["complete"]
+
+    # restore into a fresh dir
+    eng2 = be.restore_partition(100, 2, 0, str(tmp_path / "restored"))
+    for i in range(50):
+        hit = eng2.get(k(b"h%02d" % i, b"s"))
+        assert hit is not None
+    assert eng2.last_committed_decree == 1
+    # writes continue after the restored watermark
+    eng2.write_batch([WriteBatchItem(WAL_PUT, k(b"new", b"s"), b"\0\0\0\0x",
+                                     0)], decree=2)
+    eng.close()
+    eng2.close()
+
+
+def test_backup_gc(tmp_path):
+    bs = LocalBlockService(str(tmp_path / "bs"))
+    be = BackupEngine(bs, "daily")
+    eng = StorageEngine(str(tmp_path / "src"))
+    eng.write_batch([WriteBatchItem(WAL_PUT, k(b"h", b"s"), b"\0\0\0\0v", 0)],
+                    decree=1)
+    for backup_id in (1, 2, 3, 4):
+        be.backup_partition(backup_id, 1, 0, eng)
+        be.finish_backup(backup_id, 1, "t", 1)
+    assert be.gc_old_backups(keep=2) == [1, 2]
+    assert be.list_backups() == [3, 4]
+    eng.close()
+
+
+def test_backup_scheduler(tmp_path):
+    ran = []
+    clock_now = [1000.0]
+    sched = BackupScheduler(
+        backup_table=lambda policy, backup_id, app_id: ran.append(
+            (policy.name, app_id)),
+        clock=lambda: clock_now[0])
+    sched.add_policy(BackupPolicy("daily", app_ids=[1, 2],
+                                  interval_seconds=3600))
+    assert len(sched.tick()) == 1           # due immediately
+    assert ran == [("daily", 1), ("daily", 2)]
+    assert sched.tick() == []               # not due again yet
+    clock_now[0] += 3601
+    assert len(sched.tick()) == 1
+    with pytest.raises(ValueError):
+        sched.add_policy(BackupPolicy("daily", app_ids=[1]))
+
+
+# ---- duplication ------------------------------------------------------
+
+
+def _make_master_replica(tmp_path, loop, net):
+    # wall clock: duplication timetags must be comparable with the
+    # follower's locally-written timetags
+    import time
+    r = Replica("m1", str(tmp_path / "m1"), net, clock=time.time)
+    net.register("m1", r.on_message)
+    r.assign_config(ReplicaConfig(1, "m1", []))
+    return r
+
+
+def test_duplication_ships_and_confirms(tmp_path):
+    loop = SimLoop()
+    net = SimNetwork(loop)
+    master = _make_master_replica(tmp_path, loop, net)
+    follower = Table(str(tmp_path / "follower"), partition_count=4)
+    progress = []
+    dup = ReplicaDuplicator(master, TableShipper(follower),
+                            on_progress=lambda d, c: progress.append(c))
+    try:
+        for i in range(10):
+            master.client_write([WriteOp(
+                OP_PUT, (k(b"user_%d" % i, b"s"), b"v%d" % i, 0))])
+        loop.run_until_idle()
+        shipped = dup.sync_round()
+        assert shipped == 10
+        assert dup.confirmed_decree == 10
+        assert progress == [10]
+        fc = PegasusClient(follower)
+        for i in range(10):
+            assert fc.get(b"user_%d" % i, b"s") == (0, b"v%d" % i)
+        # idle round ships nothing
+        assert dup.sync_round() == 0
+        # multi_put + remove flow through too
+        master.client_write([WriteOp(OP_MULTI_PUT, MultiPutRequest(
+            b"cart", [KeyValue(b"a", b"1"), KeyValue(b"b", b"2")]))])
+        master.client_write([WriteOp(OP_REMOVE, (k(b"user_3", b"s"),))])
+        loop.run_until_idle()
+        assert dup.sync_round() == 2
+        assert fc.multi_get(b"cart")[1] == {b"a": b"1", b"b": b"2"}
+        assert fc.get(b"user_3", b"s")[0] == 1  # removed on follower
+    finally:
+        master.close()
+        follower.close()
+
+
+def test_duplication_timetag_conflict_resolution(tmp_path):
+    loop = SimLoop()
+    net = SimNetwork(loop)
+    master = _make_master_replica(tmp_path, loop, net)
+    follower = Table(str(tmp_path / "f"), partition_count=2)
+    dup = ReplicaDuplicator(master, TableShipper(follower))
+    try:
+        # master writes an OLD value (its mutation timestamp is in the past
+        # relative to the follower's local write)
+        master.client_write([WriteOp(OP_PUT, (k(b"hk", b"s"), b"stale", 0))])
+        loop.run_until_idle()
+        # follower's own LOCAL write happens later -> larger timetag
+        import time
+        time.sleep(0.001)
+        fc = PegasusClient(follower)
+        fc.set(b"hk", b"s", b"local-newer")
+        # the master's mutation timestamp predates the local write, so the
+        # shipped update must LOSE
+        dup.sync_round()
+        assert fc.get(b"hk", b"s") == (0, b"local-newer")
+        # but a later master write wins
+        time.sleep(0.001)
+        master.client_write([WriteOp(OP_PUT, (k(b"hk", b"s"), b"m2", 0))])
+        loop.run_until_idle()
+        dup.sync_round()
+        assert fc.get(b"hk", b"s") == (0, b"m2")
+    finally:
+        master.close()
+        follower.close()
+
+
+def test_duplication_rejects_atomic_mutations(tmp_path):
+    loop = SimLoop()
+    net = SimNetwork(loop)
+    master = _make_master_replica(tmp_path, loop, net)
+    follower = Table(str(tmp_path / "f"), partition_count=2)
+    dup = ReplicaDuplicator(master, TableShipper(follower))
+    try:
+        master.client_write([WriteOp(OP_INCR,
+                                     IncrRequest(k(b"h", b"c"), 1))])
+        loop.run_until_idle()
+        with pytest.raises(ValueError):
+            dup.sync_round()
+    finally:
+        master.close()
+        follower.close()
+
+
+def test_duplication_resumes_from_confirmed(tmp_path):
+    loop = SimLoop()
+    net = SimNetwork(loop)
+    master = _make_master_replica(tmp_path, loop, net)
+    follower = Table(str(tmp_path / "f"), partition_count=2)
+    try:
+        for i in range(6):
+            master.client_write([WriteOp(
+                OP_PUT, (k(b"u%d" % i, b"s"), b"v", 0))])
+        loop.run_until_idle()
+        dup = ReplicaDuplicator(master, TableShipper(follower))
+        dup.sync_round()
+        confirmed = dup.confirmed_decree
+        # a new duplicator resuming from the synced progress re-ships
+        # nothing old
+        dup2 = ReplicaDuplicator(master, TableShipper(follower),
+                                 confirmed_decree=confirmed)
+        assert dup2.sync_round() == 0
+    finally:
+        master.close()
+        follower.close()
