@@ -1,0 +1,3 @@
+"""HTTP service (reference: src/http/)."""
+
+from pegasus_tpu.http.http_server import MetricsHttpServer

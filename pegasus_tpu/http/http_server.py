@@ -1,0 +1,71 @@
+"""Built-in HTTP endpoints: /metrics, /version, /config.
+
+Parity: src/http/http_server.h:91 (registry-based endpoints) with the
+builtin calls (src/http/builtin_http_calls.cpp:80-103 /version /config;
+:280-288 /metrics via metrics_http_service, JSON with entity/metric
+filters — the surface the Go collector scrapes).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+from urllib.parse import parse_qs, urlparse
+
+import pegasus_tpu
+from pegasus_tpu.utils.flags import FLAGS
+from pegasus_tpu.utils.metrics import METRICS
+
+
+class _Handler(BaseHTTPRequestHandler):
+    def log_message(self, fmt, *args):  # quiet
+        pass
+
+    def _reply(self, code: int, payload) -> None:
+        body = json.dumps(payload, indent=1).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        url = urlparse(self.path)
+        query = parse_qs(url.query)
+        if url.path == "/version":
+            self._reply(200, {"version": pegasus_tpu.__version__,
+                              "framework": "pegasus_tpu"})
+        elif url.path == "/config":
+            self._reply(200, FLAGS.snapshot())
+        elif url.path == "/metrics":
+            entity_type = query.get("with_metric_entity_type",
+                                    query.get("entity_type", [None]))[0]
+            names = query.get("with_metrics", query.get("metrics", [None]))[0]
+            metric_names = names.split(",") if names else None
+            self._reply(200, METRICS.snapshot(entity_type, metric_names))
+        else:
+            self._reply(404, {"error": f"unknown path {url.path}"})
+
+
+class MetricsHttpServer:
+    """Threaded HTTP server; bind port 0 for an ephemeral port."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0) -> None:
+        self._server = ThreadingHTTPServer((host, port), _Handler)
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self._server.server_address[1]
+
+    def start(self) -> "MetricsHttpServer":
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()

@@ -100,14 +100,17 @@ class MutationLog:
                 best[mu.decree] = mu
         return [best[d] for d in sorted(best)]
 
-    def read_tail(self, offset: int) -> "Tuple[List[Mutation], int]":
-        """Incremental read: frames starting at byte `offset`, plus the new
-        end offset (parity: load_from_private_log tails the log instead of
-        re-reading it — callers re-tail from 0 when `generation` changes)."""
+    def read_tail(self, offset: int) -> "List[Tuple[Mutation, int]]":
+        """Incremental read: (mutation, end_offset) pairs for frames
+        starting at byte `offset` (parity: load_from_private_log tails the
+        log instead of re-reading it). Per-frame offsets let a consumer
+        stop mid-batch WITHOUT skipping unprocessed frames — it resumes
+        from the last frame it actually consumed. Callers re-tail from 0
+        when `generation` changes."""
         with open(self.path, "rb") as f:
             f.seek(offset)
             data = f.read()
-        out: List[Mutation] = []
+        out: List[Tuple[Mutation, int]] = []
         pos = 0
         while pos + _FRAME.size <= len(data):
             length, want = _FRAME.unpack_from(data, pos)
@@ -117,9 +120,9 @@ class MutationLog:
             blob = data[pos + _FRAME.size:end]
             if crc32(blob) != want:
                 break
-            out.append(Mutation.decode(blob))
+            out.append((Mutation.decode(blob), offset + end))
             pos = end
-        return out, offset + pos
+        return out
 
     def gc(self, durable_decree: int) -> None:
         """Drop everything <= durable_decree (rewrite in place)."""

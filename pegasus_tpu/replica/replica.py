@@ -109,15 +109,24 @@ class Replica:
         self.prepare_list = PrepareList(
             self.server.engine.last_committed_decree, PREPARE_LIST_CAPACITY,
             self._apply_mutation)
-        # boot: re-prepare logged mutations beyond the applied decree
+        # boot: re-prepare logged mutations beyond the applied decree, and
+        # seed the monotonic-timestamp floor from replayed mutations (a
+        # restarted primary must not mint timestamps at or below ones it
+        # already shipped to duplication followers)
         for mu in self.log.replay(self.log.path):
             if mu.decree > self.prepare_list.last_committed_decree:
                 self.prepare_list.prepare(mu)
+            self._boot_timestamp_floor = max(
+                getattr(self, "_boot_timestamp_floor", 0),
+                mu.timestamp_us + max(len(mu.ops), 1) - 1)
 
         # primary-assigned mutation timestamps must be strictly monotonic
         # (duplication conflict resolution and timetag uniqueness depend on
-        # it; the reference guarantees this per-primary)
-        self._last_timestamp_us = 0
+        # it; the reference guarantees this per-primary) — seeded from the
+        # log replay above so restarts don't regress the floor
+        self._last_timestamp_us = getattr(self, "_boot_timestamp_floor", 0)
+        # duplicators attach here; log GC must not outrun their progress
+        self.duplicators: List = []
         # primary-side state (parity: primary_context, replica_context.h)
         self._pending_acks: Dict[int, Set[str]] = {}
         self._client_callbacks: Dict[int, Callable[[List[Any]], None]] = {}
@@ -509,6 +518,12 @@ class Replica:
 
     def flush_and_gc_log(self) -> None:
         """Make storage durable, then GC the private log below the durable
-        decree (parity: mutation_log GC by durable decree)."""
+        decree — capped by duplication progress: unshipped mutations must
+        survive GC or duplication stalls forever (parity: the reference
+        holds plog GC back by the dup confirmed decree,
+        mutation_log.h:213 + duplication progress plumbing)."""
         self.server.engine.flush()
-        self.log.gc(self.server.engine.last_flushed_decree)
+        floor = self.server.engine.last_flushed_decree
+        for dup in self.duplicators:
+            floor = min(floor, dup.confirmed_decree)
+        self.log.gc(floor)

@@ -148,32 +148,35 @@ class ReplicaDuplicator:
         # reset when the log is rewritten by GC
         self._log_offset = 0
         self._log_generation = self.replica.log.generation
+        # registering holds the replica's log GC back to our progress
+        self.replica.duplicators.append(self)
 
     def sync_round(self) -> int:
         """One load->ship->confirm round (parity: duplication_sync_timer).
-        Tails the private log incrementally (no full re-read per round),
-        ships committed mutations beyond the confirmed decree; returns how
-        many mutations shipped."""
+        Tails the private log incrementally; ships committed mutations
+        beyond the confirmed decree; returns how many shipped.
+
+        Offset discipline: the offset only advances past frames that were
+        actually consumed (shipped, or skippable as <= confirmed). A frame
+        whose decree is still uncommitted, or a ship failure, stops the
+        round WITHOUT advancing — the next round re-reads from there.
+        Committed re-proposed frames (same decree, higher ballot) carry
+        identical ops, so shipping the first-seen committed frame is safe.
+        """
         last_committed = self.replica.last_committed_decree
-        if last_committed <= self.confirmed_decree:
-            return 0
         log = self.replica.log
         if log.generation != self._log_generation:
             self._log_offset = 0
             self._log_generation = log.generation
-        mutations, self._log_offset = log.read_tail(self._log_offset)
-        # highest-ballot entry per decree wins (re-proposed windows)
-        best = {}
-        for mu in mutations:
-            if self.confirmed_decree < mu.decree <= last_committed:
-                cur = best.get(mu.decree)
-                if cur is None or mu.ballot >= cur.ballot:
-                    best[mu.decree] = mu
         shipped = 0
-        for d in sorted(best):
-            self.shipper.ship(best[d])
-            shipped += 1
-            self.confirmed_decree = d
+        for mu, frame_end in log.read_tail(self._log_offset):
+            if mu.decree > last_committed:
+                break  # not committed yet: do NOT advance past it
+            if mu.decree > self.confirmed_decree:
+                self.shipper.ship(mu)  # a raise leaves the offset put
+                self.confirmed_decree = mu.decree
+                shipped += 1
+            self._log_offset = frame_end
         if shipped and self.on_progress is not None:
             self.on_progress(self.dupid, self.confirmed_decree)
         return shipped

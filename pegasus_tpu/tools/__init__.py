@@ -1,0 +1,4 @@
+"""Operational tools: onebox cluster, interactive shell (reference:
+src/shell/, run.sh onebox, admin-cli/)."""
+
+from pegasus_tpu.tools.onebox import Onebox

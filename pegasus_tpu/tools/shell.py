@@ -1,0 +1,251 @@
+"""pegasus_tpu shell — data access + table administration CLI.
+
+Parity: the reference's interactive shell (src/shell/main.cpp:874, 87
+commands in commands.h) and the Go admin-cli/pegic split. One binary
+serves both roles here:
+
+    python -m pegasus_tpu.tools.shell --root /data/onebox <command> ...
+
+Commands (subset mirroring the reference's most used):
+  table mgmt : create_app, drop_app, ls, app
+  data       : set, get, del, exist, ttl, incr, multi_set, multi_get,
+               count, scan
+  admin      : set_app_envs, get_app_envs, manual_compact, flush,
+               metrics, backup, restore
+
+Bytes arguments accept UTF-8 strings.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _b(s: str) -> bytes:
+    return s.encode()
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="pegasus-shell",
+                                     description=__doc__)
+    parser.add_argument("--root", required=True,
+                        help="onebox cluster root directory")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("create_app")
+    p.add_argument("name")
+    p.add_argument("-p", "--partition_count", type=int, default=8)
+    p = sub.add_parser("drop_app")
+    p.add_argument("name")
+    sub.add_parser("ls")
+    p = sub.add_parser("app")
+    p.add_argument("name")
+
+    for cmd in ("set", "get", "del", "exist", "ttl"):
+        p = sub.add_parser(cmd)
+        p.add_argument("table")
+        p.add_argument("hash_key")
+        p.add_argument("sort_key")
+        if cmd == "set":
+            p.add_argument("value")
+            p.add_argument("--ttl", type=int, default=0)
+    p = sub.add_parser("incr")
+    p.add_argument("table")
+    p.add_argument("hash_key")
+    p.add_argument("sort_key")
+    p.add_argument("increment", type=int)
+    p = sub.add_parser("multi_set")
+    p.add_argument("table")
+    p.add_argument("hash_key")
+    p.add_argument("kvs", nargs="+", help="sortkey=value pairs")
+    p = sub.add_parser("multi_get")
+    p.add_argument("table")
+    p.add_argument("hash_key")
+    p = sub.add_parser("count")
+    p.add_argument("table")
+    p.add_argument("hash_key")
+    p = sub.add_parser("scan")
+    p.add_argument("table")
+    p.add_argument("--hash_prefix", default="")
+    p.add_argument("--max", type=int, default=100)
+
+    p = sub.add_parser("set_app_envs")
+    p.add_argument("table")
+    p.add_argument("envs", nargs="+", help="key=value pairs")
+    p = sub.add_parser("get_app_envs")
+    p.add_argument("table")
+    p = sub.add_parser("manual_compact")
+    p.add_argument("table")
+    p = sub.add_parser("flush")
+    p.add_argument("table")
+    p = sub.add_parser("metrics")
+    p.add_argument("--entity_type", default=None)
+    p = sub.add_parser("backup")
+    p.add_argument("table")
+    p.add_argument("--bucket", required=True)
+    p.add_argument("--policy", default="manual")
+    p.add_argument("--backup_id", type=int, required=True)
+    p = sub.add_parser("restore")
+    p.add_argument("table")
+    p.add_argument("--bucket", required=True)
+    p.add_argument("--policy", default="manual")
+    p.add_argument("--backup_id", type=int, required=True)
+    p.add_argument("--new_name", default=None)
+
+    args = parser.parse_args(argv)
+
+    from pegasus_tpu.tools.onebox import Onebox
+
+    box = Onebox(args.root)
+    out = sys.stdout
+    try:
+        return _dispatch(args, box, out)
+    except (KeyError, ValueError) as exc:
+        print(f"error: {exc}", file=sys.stderr)
+        return 1
+    finally:
+        box.close()
+
+
+def _dispatch(args, box, out) -> int:
+    from pegasus_tpu.ops.predicates import FT_MATCH_PREFIX
+    from pegasus_tpu.utils.errors import StorageStatus
+
+    if args.cmd == "create_app":
+        box.create_table(args.name, args.partition_count)
+        print(f"OK: created {args.name} "
+              f"({args.partition_count} partitions)", file=out)
+    elif args.cmd == "drop_app":
+        box.drop_table(args.name)
+        print(f"OK: dropped {args.name}", file=out)
+    elif args.cmd == "ls":
+        for row in box.list_tables():
+            print(f"{row['app_id']:>4}  {row['name']:<24} "
+                  f"partitions={row['partition_count']}", file=out)
+    elif args.cmd == "app":
+        t = box.open_table(args.name)
+        for p_ in t.all_partitions():
+            print(f"  {t.app_id}.{p_.pidx}: decree="
+                  f"{p_.engine.last_committed_decree} "
+                  f"records~{sum(s.total_count for s in p_.engine.lsm.l0) + (p_.engine.lsm.l1.total_count if p_.engine.lsm.l1 else 0)}",
+                  file=out)
+    elif args.cmd == "set":
+        c = box.client(args.table)
+        err = c.set(_b(args.hash_key), _b(args.sort_key), _b(args.value),
+                    ttl_seconds=args.ttl)
+        print("OK" if err == 0 else f"error {err}", file=out)
+    elif args.cmd == "get":
+        c = box.client(args.table)
+        err, value = c.get(_b(args.hash_key), _b(args.sort_key))
+        if err == int(StorageStatus.NOT_FOUND):
+            print("not found", file=out)
+            return 1
+        print(value.decode(errors="replace"), file=out)
+    elif args.cmd == "del":
+        c = box.client(args.table)
+        err = c.delete(_b(args.hash_key), _b(args.sort_key))
+        print("OK" if err == 0 else f"error {err}", file=out)
+    elif args.cmd == "exist":
+        c = box.client(args.table)
+        print("true" if c.exist(_b(args.hash_key), _b(args.sort_key))
+              else "false", file=out)
+    elif args.cmd == "ttl":
+        c = box.client(args.table)
+        err, ttl = c.ttl(_b(args.hash_key), _b(args.sort_key))
+        if err != 0:
+            print("not found", file=out)
+            return 1
+        print("no ttl" if ttl < 0 else f"{ttl}s", file=out)
+    elif args.cmd == "incr":
+        c = box.client(args.table)
+        resp = c.incr(_b(args.hash_key), _b(args.sort_key),
+                      args.increment)
+        if resp.error != 0:
+            print(f"error {resp.error}", file=out)
+            return 1
+        print(resp.new_value, file=out)
+    elif args.cmd == "multi_set":
+        c = box.client(args.table)
+        kvs = dict(kv.split("=", 1) for kv in args.kvs)
+        err = c.multi_set(_b(args.hash_key),
+                          {_b(k): _b(v) for k, v in kvs.items()})
+        print("OK" if err == 0 else f"error {err}", file=out)
+    elif args.cmd == "multi_get":
+        c = box.client(args.table)
+        err, kvs = c.multi_get(_b(args.hash_key))
+        for k, v in sorted(kvs.items()):
+            print(f"{k.decode(errors='replace')} : "
+                  f"{v.decode(errors='replace')}", file=out)
+        print(f"{len(kvs)} record(s)", file=out)
+    elif args.cmd == "count":
+        c = box.client(args.table)
+        err, n = c.sortkey_count(_b(args.hash_key))
+        print(n, file=out)
+    elif args.cmd == "scan":
+        from pegasus_tpu.client import ScanOptions
+        c = box.client(args.table)
+        opts = ScanOptions(batch_size=args.max)
+        if args.hash_prefix:
+            opts.hash_key_filter_type = FT_MATCH_PREFIX
+            opts.hash_key_filter_pattern = _b(args.hash_prefix)
+        n = 0
+        for sc in c.get_unordered_scanners(1, opts):
+            for hk, sk, v in sc:
+                print(f"{hk.decode(errors='replace')} : "
+                      f"{sk.decode(errors='replace')} => "
+                      f"{v.decode(errors='replace')}", file=out)
+                n += 1
+                if n >= args.max:
+                    break
+            if n >= args.max:
+                break
+        print(f"{n} record(s)", file=out)
+    elif args.cmd == "set_app_envs":
+        envs = dict(kv.split("=", 1) for kv in args.envs)
+        box.update_app_envs(args.table, envs)
+        print("OK", file=out)
+    elif args.cmd == "get_app_envs":
+        t = box.open_table(args.table)
+        print(json.dumps(t.partitions[0].app_envs, indent=1), file=out)
+    elif args.cmd == "manual_compact":
+        box.open_table(args.table).manual_compact_all()
+        print("OK", file=out)
+    elif args.cmd == "flush":
+        box.open_table(args.table).flush_all()
+        print("OK", file=out)
+    elif args.cmd == "metrics":
+        from pegasus_tpu.utils.metrics import METRICS
+        print(json.dumps(METRICS.snapshot(args.entity_type), indent=1),
+              file=out)
+    elif args.cmd == "backup":
+        from pegasus_tpu.server.backup import BackupEngine
+        from pegasus_tpu.storage.block_service import LocalBlockService
+        t = box.open_table(args.table)
+        be = BackupEngine(LocalBlockService(args.bucket), args.policy)
+        for p_ in t.all_partitions():
+            be.backup_partition(args.backup_id, t.app_id, p_.pidx,
+                                p_.engine)
+        be.finish_backup(args.backup_id, t.app_id, args.table,
+                         t.partition_count)
+        print(f"OK: backup {args.backup_id}", file=out)
+    elif args.cmd == "restore":
+        from pegasus_tpu.server.backup import BackupEngine
+        from pegasus_tpu.storage.block_service import LocalBlockService
+        be = BackupEngine(LocalBlockService(args.bucket), args.policy)
+        meta = be.read_backup_metadata(args.backup_id)
+        new_name = args.new_name or f"{args.table}_restored"
+        t = box.create_table(new_name, meta["partition_count"])
+        for p_ in t.all_partitions():
+            p_.engine.close()
+            p_.engine = be.restore_partition(
+                args.backup_id, meta["app_id"], p_.pidx,
+                p_.engine.data_dir)
+            p_.write_service.engine = p_.engine
+        print(f"OK: restored into {new_name}", file=out)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,119 @@
+"""Onebox + shell CLI + HTTP endpoints."""
+
+import json
+import urllib.request
+
+import pytest
+
+from pegasus_tpu.http import MetricsHttpServer
+from pegasus_tpu.tools.onebox import Onebox
+from pegasus_tpu.tools.shell import main as shell_main
+
+
+def run_shell(capsys, *argv):
+    code = shell_main(list(argv))
+    return code, capsys.readouterr().out
+
+
+def test_onebox_lifecycle(tmp_path):
+    box = Onebox(str(tmp_path / "box"))
+    box.create_table("t1", partition_count=4)
+    with pytest.raises(ValueError):
+        box.create_table("t1")
+    c = box.client("t1")
+    c.set(b"h", b"s", b"v")
+    box.close()
+    # reopen from catalog
+    box2 = Onebox(str(tmp_path / "box"))
+    assert [t["name"] for t in box2.list_tables()] == ["t1"]
+    assert box2.client("t1").get(b"h", b"s") == (0, b"v")
+    box2.drop_table("t1")
+    assert box2.list_tables() == []
+    box2.close()
+
+
+def test_shell_data_flow(tmp_path, capsys):
+    root = str(tmp_path / "box")
+    assert run_shell(capsys, "--root", root, "create_app", "demo",
+                     "-p", "4")[0] == 0
+    code, out = run_shell(capsys, "--root", root, "ls")
+    assert "demo" in out and "partitions=4" in out
+    assert run_shell(capsys, "--root", root, "set", "demo", "hk", "sk",
+                     "hello")[0] == 0
+    code, out = run_shell(capsys, "--root", root, "get", "demo", "hk", "sk")
+    assert code == 0 and out.strip() == "hello"
+    code, out = run_shell(capsys, "--root", root, "incr", "demo", "hk",
+                          "cnt", "5")
+    assert out.strip() == "5"
+    run_shell(capsys, "--root", root, "multi_set", "demo", "cart",
+              "a=1", "b=2")
+    code, out = run_shell(capsys, "--root", root, "multi_get", "demo",
+                          "cart")
+    assert "a : 1" in out and "2 record(s)" in out
+    code, out = run_shell(capsys, "--root", root, "count", "demo", "cart")
+    assert out.strip() == "2"
+    code, out = run_shell(capsys, "--root", root, "scan", "demo",
+                          "--hash_prefix", "hk")
+    assert "hk : sk => hello" in out
+    # del + not-found exit code
+    run_shell(capsys, "--root", root, "del", "demo", "hk", "sk")
+    code, out = run_shell(capsys, "--root", root, "get", "demo", "hk", "sk")
+    assert code == 1 and "not found" in out
+
+
+def test_shell_admin_flow(tmp_path, capsys):
+    root = str(tmp_path / "box")
+    run_shell(capsys, "--root", root, "create_app", "t", "-p", "2")
+    run_shell(capsys, "--root", root, "set", "t", "logs_1", "s", "v")
+    run_shell(capsys, "--root", root, "set", "t", "keep_1", "s", "v")
+    code, _ = run_shell(
+        capsys, "--root", root, "set_app_envs", "t",
+        'user_specified_compaction=[{"op": "delete_key", "rules": '
+        '[{"type": "hashkey_pattern", "match": "prefix", '
+        '"pattern": "logs_"}]}]')
+    assert code == 0
+    code, out = run_shell(capsys, "--root", root, "get_app_envs", "t")
+    assert "user_specified_compaction" in out
+    run_shell(capsys, "--root", root, "manual_compact", "t")
+    code, out = run_shell(capsys, "--root", root, "count", "t", "logs_1")
+    assert out.strip() == "0"
+    code, out = run_shell(capsys, "--root", root, "count", "t", "keep_1")
+    assert out.strip() == "1"
+
+
+def test_shell_backup_restore(tmp_path, capsys):
+    root = str(tmp_path / "box")
+    bucket = str(tmp_path / "bucket")
+    run_shell(capsys, "--root", root, "create_app", "t", "-p", "2")
+    run_shell(capsys, "--root", root, "set", "t", "h", "s", "precious")
+    code, out = run_shell(capsys, "--root", root, "backup", "t",
+                          "--bucket", bucket, "--backup_id", "42")
+    assert code == 0 and "backup 42" in out
+    code, out = run_shell(capsys, "--root", root, "restore", "t",
+                          "--bucket", bucket, "--backup_id", "42")
+    assert code == 0
+    code, out = run_shell(capsys, "--root", root, "get", "t_restored",
+                          "h", "s")
+    assert out.strip() == "precious"
+
+
+def test_http_endpoints(tmp_path):
+    from pegasus_tpu.utils.metrics import METRICS
+    METRICS.entity("server", "http-test").counter("probe").increment(3)
+    srv = MetricsHttpServer().start()
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        version = json.load(urllib.request.urlopen(f"{base}/version"))
+        assert version["framework"] == "pegasus_tpu"
+        config = json.load(urllib.request.urlopen(f"{base}/config"))
+        assert "pegasus.server" in config
+        metrics = json.load(urllib.request.urlopen(
+            f"{base}/metrics?entity_type=server"))
+        ours = [e for e in metrics if e["id"] == "http-test"]
+        assert ours and ours[0]["metrics"]["probe"]["value"] == 3
+        # unknown path -> 404
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(f"{base}/nope")
+        assert err.value.code == 404
+    finally:
+        srv.stop()
