@@ -1,0 +1,112 @@
+"""Load balancer: even out primaries and replicas across nodes.
+
+Parity: src/meta/greedy_load_balancer.h:46 + load_balance_policy /
+app_balance_policy / cluster_balance_policy.h:47. The reference computes
+primary placements with a ford-fulkerson max-flow and greedy copy moves;
+this implementation keeps the same two proposal kinds with a greedy
+matcher:
+
+- MOVE_PRIMARY: demote the primary on an overloaded node in favour of an
+  existing secondary on an underloaded node (a ballot-bump config
+  change — no data movement).
+- COPY_SECONDARY: relocate a secondary from an overloaded node to an
+  underloaded one (data movement through the learner flow).
+
+Proposals are pure data; MetaService.rebalance applies them.
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+from dataclasses import dataclass
+from typing import Dict, List, Tuple
+
+Gpid = Tuple[int, int]
+
+
+@dataclass
+class BalanceProposal:
+    kind: str                  # "move_primary" | "copy_secondary"
+    gpid: Gpid
+    from_node: str
+    to_node: str
+
+
+def _counts(configs: Dict[Gpid, "PartitionConfig"], nodes: List[str]):
+    primaries = {n: 0 for n in nodes}
+    replicas = {n: 0 for n in nodes}
+    for pc in configs.values():
+        if pc.primary in primaries:
+            primaries[pc.primary] += 1
+        for s in [pc.primary] + list(pc.secondaries):
+            if s in replicas:
+                replicas[s] += 1
+    return primaries, replicas
+
+
+def propose_primary_moves(configs: Dict[Gpid, "PartitionConfig"],
+                          nodes: List[str]) -> List[BalanceProposal]:
+    """Greedy primary balancing: while the spread exceeds 1, shift one
+    primary from the most-loaded node to a least-loaded node that already
+    holds a secondary of that partition (zero-copy move)."""
+    if not nodes:
+        return []
+    primaries, _ = _counts(configs, nodes)
+    proposals: List[BalanceProposal] = []
+    moved = set()
+    while True:
+        hi = max(primaries, key=lambda n: primaries[n])
+        lo = min(primaries, key=lambda n: primaries[n])
+        if primaries[hi] - primaries[lo] <= 1:
+            break
+        candidate = None
+        for gpid, pc in sorted(configs.items()):
+            if gpid in moved:
+                continue
+            if pc.primary == hi and lo in pc.secondaries:
+                candidate = gpid
+                break
+        if candidate is None:
+            break
+        proposals.append(BalanceProposal("move_primary", candidate, hi, lo))
+        moved.add(candidate)
+        primaries[hi] -= 1
+        primaries[lo] += 1
+    return proposals
+
+
+def propose_secondary_moves(configs: Dict[Gpid, "PartitionConfig"],
+                            nodes: List[str]) -> List[BalanceProposal]:
+    """Greedy replica-count balancing: move a secondary off the most
+    replica-loaded node onto the least-loaded node not already hosting
+    the partition."""
+    if not nodes:
+        return []
+    _, replicas = _counts(configs, nodes)
+    proposals: List[BalanceProposal] = []
+    moved = set()
+    while True:
+        lo = min(replicas, key=lambda n: replicas[n])
+        # donor: the most replica-loaded node that actually has a movable
+        # secondary for a partition not already on `lo` (the global max
+        # may hold only primaries, which don't copy-move)
+        candidate = None
+        for donor in sorted(replicas, key=lambda n: -replicas[n]):
+            if replicas[donor] - replicas[lo] <= 1:
+                break
+            for gpid, pc in sorted(configs.items()):
+                if gpid in moved:
+                    continue
+                if donor in pc.secondaries and lo not in pc.members():
+                    candidate = (gpid, donor)
+                    break
+            if candidate is not None:
+                break
+        if candidate is None:
+            break
+        gpid, donor = candidate
+        proposals.append(BalanceProposal("copy_secondary", gpid, donor, lo))
+        moved.add(gpid)
+        replicas[donor] -= 1
+        replicas[lo] += 1
+    return proposals

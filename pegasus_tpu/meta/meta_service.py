@@ -46,6 +46,9 @@ class MetaService:
         # every guardian tick from restarting a slow learn from scratch
         self._pending_learns: Dict[Gpid, Tuple[str, float]] = {}
         self._learn_timeout = 60.0
+        # balancer copy-secondary moves waiting on a learn: gpid -> node to
+        # remove once the learner lands
+        self._pending_moves: Dict[Gpid, str] = {}
         net.register(name, self.on_message)
 
     # ---- messages -----------------------------------------------------
@@ -220,15 +223,30 @@ class MetaService:
                 pc = self.state.get_partition(app.app_id, pidx)
                 if not pc.primary:
                     continue
-                if len(pc.members()) >= app.max_replica_count:
-                    self._pending_learns.pop(gpid, None)
-                    continue
                 pending = self._pending_learns.get(gpid)
+                if len(pc.members()) >= app.max_replica_count:
+                    # a pending learn on a FULL partition is a balancer
+                    # copy-secondary move: keep its guard alive until the
+                    # learner lands, dies, or times out (dropping it early
+                    # would let a second move start and over-replicate)
+                    if pending is not None:
+                        learner, started = pending
+                        if (learner in pc.members()
+                                or now - started >= self._learn_timeout
+                                or not self.fd.is_alive(learner)):
+                            self._pending_learns.pop(gpid, None)
+                            if learner not in pc.members():
+                                # the move failed: forget the planned
+                                # removal or a later unrelated learn would
+                                # strip a healthy secondary
+                                self._pending_moves.pop(gpid, None)
+                    continue
                 if pending is not None:
                     learner, started = pending
                     if (now - started < self._learn_timeout
                             and self.fd.is_alive(learner)):
                         continue  # learn in flight; don't restart it
+                    self._pending_moves.pop(gpid, None)  # stale move, if any
                 spare = [n for n in self.fd.alive_workers()
                          if n not in pc.members()]
                 if not spare:
@@ -246,15 +264,75 @@ class MetaService:
         pc = self.state.get_partition(*gpid)
         if learner in pc.members():
             return
+        secondaries = pc.secondaries + [learner]
+        # a balancer copy-secondary move completes here: the source node
+        # leaves in the same config update its TARGET learner joins in
+        # (a different learner completing — e.g. a guardian heal — must
+        # not trigger the removal)
+        leaving = None
+        move = self._pending_moves.get(gpid)
+        if move is not None and move[0] == learner:
+            leaving = move[1]
+            del self._pending_moves[gpid]
+        if leaving is not None and leaving in secondaries:
+            secondaries = [s for s in secondaries if s != leaving]
         new_pc = PartitionConfig(ballot=pc.ballot + 1, primary=pc.primary,
-                                 secondaries=pc.secondaries + [learner])
+                                 secondaries=secondaries)
         self.state.update_partition(gpid[0], gpid[1], new_pc)
         self._propose(gpid[0], gpid[1], new_pc)
+        if leaving is not None and leaving not in new_pc.members():
+            self._send_proposal(leaving, app, gpid[1], new_pc)
         # the newcomer needs the table's envs too (it wasn't a member when
         # they were last propagated)
         if app.envs:
             self.net.send(self.name, learner, "update_app_envs", {
                 "app_id": app.app_id, "envs": dict(app.envs)})
+
+    # ---- balancer (parity: meta_service rebalance RPC ->
+    # greedy_load_balancer proposals) -----------------------------------
+
+    def rebalance(self) -> List:
+        """Compute and apply balance proposals (parity:
+        RPC_CM_START_BALANCER -> server_load_balancer::rebalance).
+        Primary moves apply immediately (zero-copy config change);
+        secondary copies start a targeted learner flow and complete when
+        the learn lands. Returns the proposals applied/started."""
+        from pegasus_tpu.meta.balancer import (
+            propose_primary_moves,
+            propose_secondary_moves,
+        )
+
+        nodes = self.fd.alive_workers()
+        configs = {}
+        for app in self.list_apps():
+            for pidx in range(app.partition_count):
+                configs[(app.app_id, pidx)] = self.state.get_partition(
+                    app.app_id, pidx)
+        proposals = (propose_primary_moves(configs, nodes)
+                     + propose_secondary_moves(configs, nodes))
+        for prop in proposals:
+            app = self.state.apps[prop.gpid[0]]
+            pc = self.state.get_partition(*prop.gpid)
+            if prop.kind == "move_primary":
+                if prop.to_node not in pc.secondaries:
+                    continue  # config changed since proposal generation
+                new_pc = PartitionConfig(
+                    ballot=pc.ballot + 1, primary=prop.to_node,
+                    secondaries=[s for s in pc.secondaries
+                                 if s != prop.to_node] + [pc.primary])
+                self.state.update_partition(prop.gpid[0], prop.gpid[1],
+                                            new_pc)
+                self._propose(prop.gpid[0], prop.gpid[1], new_pc)
+            else:  # copy_secondary via the learner flow
+                if prop.gpid in self._pending_learns:
+                    continue
+                self._pending_moves[prop.gpid] = (prop.to_node,
+                                                  prop.from_node)
+                self._pending_learns[prop.gpid] = (prop.to_node,
+                                                   self.clock())
+                self.net.send(self.name, pc.primary, "add_learner_cmd", {
+                    "gpid": prop.gpid, "learner": prop.to_node})
+        return proposals
 
     # ---- proposal delivery --------------------------------------------
 

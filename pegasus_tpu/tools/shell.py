@@ -136,6 +136,8 @@ def _dispatch(args, box, out) -> int:
         err = c.set(_b(args.hash_key), _b(args.sort_key), _b(args.value),
                     ttl_seconds=args.ttl)
         print("OK" if err == 0 else f"error {err}", file=out)
+        if err != 0:
+            return 1
     elif args.cmd == "get":
         c = box.client(args.table)
         err, value = c.get(_b(args.hash_key), _b(args.sort_key))
@@ -147,6 +149,8 @@ def _dispatch(args, box, out) -> int:
         c = box.client(args.table)
         err = c.delete(_b(args.hash_key), _b(args.sort_key))
         print("OK" if err == 0 else f"error {err}", file=out)
+        if err != 0:
+            return 1
     elif args.cmd == "exist":
         c = box.client(args.table)
         print("true" if c.exist(_b(args.hash_key), _b(args.sort_key))
@@ -172,9 +176,14 @@ def _dispatch(args, box, out) -> int:
         err = c.multi_set(_b(args.hash_key),
                           {_b(k): _b(v) for k, v in kvs.items()})
         print("OK" if err == 0 else f"error {err}", file=out)
+        if err != 0:
+            return 1
     elif args.cmd == "multi_get":
         c = box.client(args.table)
         err, kvs = c.multi_get(_b(args.hash_key))
+        if err != 0:
+            print(f"error {err}", file=out)
+            return 1
         for k, v in sorted(kvs.items()):
             print(f"{k.decode(errors='replace')} : "
                   f"{v.decode(errors='replace')}", file=out)
@@ -182,6 +191,9 @@ def _dispatch(args, box, out) -> int:
     elif args.cmd == "count":
         c = box.client(args.table)
         err, n = c.sortkey_count(_b(args.hash_key))
+        if err != 0:
+            print(f"error {err}", file=out)
+            return 1
         print(n, file=out)
     elif args.cmd == "scan":
         from pegasus_tpu.client import ScanOptions

@@ -1,0 +1,111 @@
+"""Hotkey detection, hotspot partitions, and the load balancer."""
+
+import numpy as np
+import pytest
+
+from pegasus_tpu.meta.balancer import (
+    propose_primary_moves,
+    propose_secondary_moves,
+)
+from pegasus_tpu.meta.server_state import PartitionConfig
+from pegasus_tpu.server.hotkey import (
+    HotkeyCollector,
+    HotkeyState,
+    hotspot_partition_indices,
+)
+
+
+def test_hotkey_two_phase_detection():
+    rng = np.random.default_rng(0)
+    hc = HotkeyCollector()
+    assert hc.state == HotkeyState.STOPPED
+    hc.capture([b"ignored"])  # stopped: no effect
+    hc.start()
+    # 70% of traffic hits one key, the rest spreads
+    batch = []
+    for i in range(3000):
+        if rng.random() < 0.7:
+            batch.append(b"celebrity")
+        else:
+            batch.append(b"user_%d" % int(rng.integers(0, 500)))
+    for off in range(0, len(batch), 256):
+        hc.capture(batch[off:off + 256])
+        if hc.state == HotkeyState.FINISHED:
+            break
+    assert hc.state == HotkeyState.FINISHED
+    assert hc.result == b"celebrity"
+
+
+def test_hotkey_uniform_traffic_never_fires():
+    hc = HotkeyCollector()
+    hc.start()
+    keys = [b"user_%d" % i for i in range(5000)]
+    for off in range(0, len(keys), 500):
+        hc.capture(keys[off:off + 500])
+    assert hc.state == HotkeyState.COARSE  # no outlier bucket
+    assert hc.result is None
+
+
+def test_hotspot_partition_zscore():
+    qps = [100.0] * 63 + [5000.0]
+    assert hotspot_partition_indices(qps) == [63]
+    assert hotspot_partition_indices([100.0] * 64) == []
+    assert hotspot_partition_indices([5.0]) == []
+
+
+def test_primary_move_proposals():
+    nodes = ["n0", "n1", "n2"]
+    # n0 hogs all 6 primaries; each partition has secondaries elsewhere
+    configs = {(1, i): PartitionConfig(1, "n0", ["n1", "n2"])
+               for i in range(6)}
+    props = propose_primary_moves(configs, nodes)
+    assert len(props) == 4  # 6,0,0 -> 2,2,2
+    assert all(p.kind == "move_primary" and p.from_node == "n0"
+               for p in props)
+    # already balanced -> nothing
+    balanced = {(1, i): PartitionConfig(1, nodes[i % 3],
+                                        [nodes[(i + 1) % 3]])
+                for i in range(6)}
+    assert propose_primary_moves(balanced, nodes) == []
+
+
+def test_secondary_move_proposals():
+    nodes = ["n0", "n1", "n2", "n3"]
+    # n3 holds nothing; replicas pile on n0/n1/n2
+    configs = {(1, i): PartitionConfig(1, "n0", ["n1", "n2"])
+               for i in range(4)}
+    props = propose_secondary_moves(configs, nodes)
+    assert props and all(p.kind == "copy_secondary" and p.to_node == "n3"
+                         for p in props)
+
+
+def test_rebalance_end_to_end(tmp_path):
+    from tests.test_meta import ClusterHarness
+    c = ClusterHarness(tmp_path, n_nodes=3)
+    try:
+        # all primaries forced onto node0
+        app_id = c.meta.create_app("t", partition_count=6, replica_count=3)
+        c.loop.run_until_idle()
+        for pidx in range(6):
+            pc = c.meta.state.get_partition(app_id, pidx)
+            forced = PartitionConfig(pc.ballot + 1, "node0",
+                                     [n for n in pc.members()
+                                      if n != "node0"])
+            c.meta.state.update_partition(app_id, pidx, forced)
+            c.meta._propose(app_id, pidx, forced)
+        c.loop.run_until_idle()
+        props = c.meta.rebalance()
+        c.loop.run_until_idle()
+        assert props
+        counts = {n: 0 for n in ("node0", "node1", "node2")}
+        for pidx in range(6):
+            counts[c.meta.state.get_partition(app_id, pidx).primary] += 1
+        assert max(counts.values()) - min(counts.values()) <= 1
+        # the moved-to primaries actually serve
+        from pegasus_tpu.replica.replica import PartitionStatus
+        for pidx in range(6):
+            pc = c.meta.state.get_partition(app_id, pidx)
+            r = c.stubs[pc.primary].get_replica((app_id, pidx))
+            assert r.status == PartitionStatus.PRIMARY
+    finally:
+        c.close()
