@@ -1,0 +1,108 @@
+// Native host runtime: record-batch packing + crc64 columns.
+//
+// Role parity: the reference's hot host-side loops are C++
+// (src/server/pegasus_server_impl.cpp record iteration, src/base codecs);
+// our device kernels consume columnar batches, and building those batches
+// from a record stream is the host hot loop — this library packs a batch
+// of encoded keys into the padded key matrix + length/hashkey-length/crc64
+// columns in one call instead of a per-record Python loop.
+//
+// crc64 is reimplemented from the polynomial bit-spec (reflected,
+// ~init/~final — see pegasus_tpu/base/crc.py for the spec and golden
+// vectors); nothing here is copied from the reference.
+//
+// Build: g++ -O3 -shared -fPIC packer.cpp -o libpegasus_native.so
+// ABI: plain C, consumed via ctypes.
+
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+constexpr int kPolyBits[] = {63, 61, 59, 58, 56, 55, 52, 49, 48, 47, 46, 44,
+                             41, 37, 36, 34, 32, 31, 28, 26, 23, 22, 19, 16,
+                             13, 12, 10, 9,  6,  4,  3,  0};
+
+struct Crc64Table {
+  uint64_t entries[256];
+  Crc64Table() {
+    uint64_t poly = 0;
+    for (int bit : kPolyBits) poly |= 1ULL << (63 - bit);
+    for (uint32_t i = 0; i < 256; ++i) {
+      uint64_t k = i;
+      for (int j = 0; j < 8; ++j) k = (k & 1) ? (k >> 1) ^ poly : k >> 1;
+      entries[i] = k;
+    }
+  }
+};
+
+// C++11 guarantees thread-safe once-initialization of local statics —
+// concurrent first calls from several partition threads are safe
+const Crc64Table& table() {
+  static const Crc64Table t;
+  return t;
+}
+
+inline uint64_t crc64(const uint8_t* data, int64_t len, uint64_t init) {
+  const Crc64Table& t = table();
+  uint64_t crc = ~init;
+  for (int64_t i = 0; i < len; ++i)
+    crc = t.entries[(crc ^ data[i]) & 0xFF] ^ (crc >> 8);
+  return ~crc;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Scalar crc64 (compatibility checks / tests).
+uint64_t pegasus_crc64(const uint8_t* data, int64_t len) {
+  return crc64(data, len, 0);
+}
+
+// Pack n encoded keys (concatenated in `heap`, row i spanning
+// [offsets[i], offsets[i+1])) into:
+//   keys_out     uint8[n, key_width]   zero-padded rows
+//   key_len_out  int32[n]
+//   hkl_out      int32[n]              big-endian u16 header
+//   hash_lo_out  uint32[n]             crc64 lo lane of pegasus_key_hash
+//   valid_out    uint8[n]      0 for malformed rows (len < 2, or a
+//                              hashkey_len header exceeding the body)
+// Returns 0 on success, -1 if any key exceeds key_width.
+int32_t pegasus_pack_records(const uint8_t* heap, const int64_t* offsets,
+                             int64_t n, int64_t key_width, uint8_t* keys_out,
+                             int32_t* key_len_out, int32_t* hkl_out,
+                             uint32_t* hash_lo_out, uint8_t* valid_out) {
+  std::memset(keys_out, 0, static_cast<size_t>(n) * key_width);
+  for (int64_t i = 0; i < n; ++i) {
+    const int64_t start = offsets[i];
+    const int64_t len = offsets[i + 1] - start;
+    if (len > key_width) return -1;
+    const uint8_t* key = heap + start;
+    std::memcpy(keys_out + i * key_width, key, len);
+    key_len_out[i] = static_cast<int32_t>(len);
+    int32_t hkl = 0;
+    uint64_t hash = 0;
+    bool valid = len >= 2;
+    if (valid) {
+      hkl = (static_cast<int32_t>(key[0]) << 8) | key[1];
+      if (hkl > len - 2) {
+        // header claims more hashkey bytes than the key holds: malformed
+        // (the Python codec rejects such keys); never read past the row
+        valid = false;
+        hkl = 0;
+      } else {
+        // pegasus_key_hash: crc64 of the hashkey region, or of the
+        // sortkey region when the hashkey is empty
+        const int64_t region_len = hkl > 0 ? hkl : len - 2;
+        hash = crc64(key + 2, region_len, 0);
+      }
+    }
+    hkl_out[i] = hkl;
+    hash_lo_out[i] = static_cast<uint32_t>(hash & 0xFFFFFFFFu);
+    valid_out[i] = valid ? 1 : 0;
+  }
+  return 0;
+}
+
+}  // extern "C"

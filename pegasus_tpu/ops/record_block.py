@@ -72,7 +72,13 @@ def build_record_block(
     capacity: int | None = None,
     key_width: int | None = None,
 ) -> RecordBlock:
-    """Pack encoded keys + decoded expire_ts into a padded columnar block."""
+    """Pack encoded keys + decoded expire_ts into a padded columnar block.
+
+    Uses the native C++ packer when available (one call packs the key
+    matrix + length/hashkey-length/crc64 columns — the host hot loop of
+    the non-columnar scan path); falls back to the Python loop otherwise.
+    Blocks produced by the native packer carry hash_lo for free.
+    """
     n = len(keys)
     if capacity is None:
         capacity = n
@@ -84,17 +90,45 @@ def build_record_block(
     elif max_len > key_width:
         raise ValueError(f"key of {max_len} bytes exceeds key_width {key_width}")
 
+    ets = np.zeros(capacity, dtype=np.uint32)
+    ets[:n] = np.asarray(list(expire_ts), dtype=np.uint32)
+
+    if n > 0:
+        from pegasus_tpu import native
+
+        packed = native.pack_records(list(keys), key_width) \
+            if native.available() else None
+        if packed is not None:
+            nk, nlen, nhkl, nhash, nvalid = packed
+            if capacity == n:
+                return RecordBlock(nk, nlen, nhkl, ets, nvalid, nhash)
+            arr = np.zeros((capacity, key_width), dtype=np.uint8)
+            arr[:n] = nk
+            key_len = np.zeros(capacity, dtype=np.int32)
+            key_len[:n] = nlen
+            hashkey_len = np.zeros(capacity, dtype=np.int32)
+            hashkey_len[:n] = nhkl
+            hash_lo = np.zeros(capacity, dtype=np.uint32)
+            hash_lo[:n] = nhash
+            valid = np.zeros(capacity, dtype=bool)
+            valid[:n] = nvalid
+            return RecordBlock(arr, key_len, hashkey_len, ets, valid,
+                               hash_lo)
+
     arr = np.zeros((capacity, key_width), dtype=np.uint8)
     key_len = np.zeros(capacity, dtype=np.int32)
     hashkey_len = np.zeros(capacity, dtype=np.int32)
-    ets = np.zeros(capacity, dtype=np.uint32)
     valid = np.zeros(capacity, dtype=bool)
     for i, k in enumerate(keys):
         arr[i, :len(k)] = np.frombuffer(k, dtype=np.uint8)
         key_len[i] = len(k)
-        (hashkey_len[i],) = struct.unpack_from(">H", k)
-        valid[i] = True
-    ets[:n] = np.asarray(list(expire_ts), dtype=np.uint32)
+        # malformed rows (short key / header longer than the body) are
+        # marked invalid, matching the native packer's contract
+        if len(k) >= 2:
+            (hkl,) = struct.unpack_from(">H", k)
+            if hkl <= len(k) - 2:
+                hashkey_len[i] = hkl
+                valid[i] = True
     return RecordBlock(arr, key_len, hashkey_len, ets, valid)
 
 

@@ -72,7 +72,8 @@ def test_fused_no_validate_hash():
 
 def test_fused_requires_hash_column():
     keys = [generate_key(b"h", b"s")]
-    block = build_record_block(keys, [0])
+    # strip the hash column (the native packer now provides it by default)
+    block = build_record_block(keys, [0])._replace(hash_lo=None)
     with pytest.raises(ValueError):
         fused_scan_block(block, 0, validate_hash=True, partition_version=1)
 
