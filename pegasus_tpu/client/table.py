@@ -27,6 +27,7 @@ class Table:
         self.app_id = app_id
         self.app_name = app_name
         self.partition_count = partition_count
+        self.data_version = data_version
         self.partitions: Dict[int, PartitionServer] = {}
         for pidx in range(partition_count):
             self.partitions[pidx] = PartitionServer(
@@ -54,6 +55,61 @@ class Table:
         config-sync pushing app-envs to replicas)."""
         for p in self.all_partitions():
             p.update_app_envs(envs)
+
+    def split(self) -> None:
+        """In-place 2x partition split (parity: replica/split/
+        replica_split_manager.h:58 — each child copies its parent's state,
+        the group flips to the doubled partition count, and the stale half
+        of every partition is dropped lazily: masked from scans by the
+        partition-hash predicate, physically removed at the next manual
+        compaction via the same predicate in the compaction filter,
+        key_ttl_compaction_filter.h:114-121).
+
+        Known limitation: scanners opened before the split keep their old
+        partition groups and may miss records that moved to the children
+        mid-drain; the reference's clients detect this via partition-
+        version mismatch errors on the wire — re-open scanners after a
+        split (the wire layer will carry the same signal here).
+        """
+        old_count = self.partition_count
+        if old_count & (old_count - 1):
+            # the stale-half mask predicate is an &-mask: only meaningful
+            # for power-of-two counts (reference split counts are pow2 by
+            # construction)
+            raise ValueError(
+                f"partition split requires a power-of-two count, "
+                f"have {old_count}")
+        new_count = old_count * 2
+        created = []
+        try:
+            for pidx in range(old_count):
+                parent = self.partitions[pidx]
+                child_pidx = pidx + old_count
+                child_dir = os.path.join(self.data_dir,
+                                         f"{self.app_id}.{child_pidx}")
+                # checkpoint straight into the child's sst dir: the child's
+                # engine discovers it at open (no tempdir double-copy, no
+                # throwaway engine)
+                parent.engine.checkpoint(os.path.join(child_dir, "sst"))
+                child = PartitionServer(
+                    child_dir, app_id=self.app_id, pidx=child_pidx,
+                    partition_count=new_count,
+                    data_version=self.data_version)
+                created.append((child_pidx, child, child_dir))
+                if parent.app_envs:
+                    child.update_app_envs(dict(parent.app_envs))
+        except BaseException:
+            # roll back: a half-split table must not leak open children
+            # (a retry would otherwise double-open their WALs)
+            for _, child, child_dir in created:
+                child.close()
+                shutil.rmtree(child_dir, ignore_errors=True)
+            raise
+        for child_pidx, child, _ in created:
+            self.partitions[child_pidx] = child
+        for p in self.partitions.values():
+            p.update_partition_count(new_count)
+        self.partition_count = new_count
 
     def close(self) -> None:
         for p in self.partitions.values():

@@ -723,6 +723,22 @@ class PartitionServer:
         with self._write_lock:
             return self.engine.flush()
 
+    def update_partition_count(self, new_count: int) -> None:
+        """Partition-count flip after a split (parity: the group
+        partition-count update in replica_split_manager.h:76-123): routing
+        and the stale-key predicate switch to the new count; stale-half
+        records are filtered from every scan immediately and physically
+        dropped by the next manual compaction."""
+        if new_count < self.partition_count:
+            raise ValueError("partition count can only grow")
+        self.partition_count = new_count
+        self.partition_version = new_count - 1
+        self.validate_partition_hash = (
+            new_count > 1 and (new_count & (new_count - 1)) == 0)
+        # cached masks were computed under the old partition_version; the
+        # predicate takes pv dynamically so caches stay valid, but fused
+        # prepared tensors embed nothing version-dependent either — keep.
+
     def manual_compact(self, default_ttl: Optional[int] = None,
                        rules_filter=None) -> None:
         """Parity: pegasus_manual_compact_service (manual CompactRange).

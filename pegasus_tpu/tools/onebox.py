@@ -54,6 +54,15 @@ class Onebox:
             self._tables[name] = t
         return t
 
+    def split_table(self, name: str) -> int:
+        """2x partition split, persisted in the catalog. Returns the new
+        partition count."""
+        t = self.open_table(name)
+        t.split()
+        self._catalog[name]["partition_count"] = t.partition_count
+        self._persist()
+        return t.partition_count
+
     def update_app_envs(self, name: str, envs: Dict[str, str]) -> None:
         """Persisted env update (parity: envs live in meta state and are
         re-delivered through config-sync after restarts)."""

@@ -78,6 +78,8 @@ def main(argv=None) -> int:
     p.add_argument("table")
     p = sub.add_parser("manual_compact")
     p.add_argument("table")
+    p = sub.add_parser("partition_split")
+    p.add_argument("table")
     p = sub.add_parser("flush")
     p.add_argument("table")
     p = sub.add_parser("metrics")
@@ -224,6 +226,9 @@ def _dispatch(args, box, out) -> int:
     elif args.cmd == "manual_compact":
         box.open_table(args.table).manual_compact_all()
         print("OK", file=out)
+    elif args.cmd == "partition_split":
+        new_count = box.split_table(args.table)
+        print(f"OK: partition count now {new_count}", file=out)
     elif args.cmd == "flush":
         box.open_table(args.table).flush_all()
         print("OK", file=out)

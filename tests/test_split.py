@@ -1,0 +1,131 @@
+"""Partition split: 2x in-place split with lazy stale-half GC.
+
+Parity: src/replica/split/replica_split_manager.h:58 (child copies parent
+state, group flips partition count) + key_ttl_compaction_filter.h:114-121
+(stale-half physical removal at compaction).
+"""
+
+import pytest
+
+from pegasus_tpu.base.key_schema import generate_key, partition_index
+from pegasus_tpu.client import PegasusClient, ScanOptions, Table
+
+
+@pytest.fixture
+def loaded(tmp_path):
+    t = Table(str(tmp_path / "t"), partition_count=4)
+    c = PegasusClient(t)
+    data = {}
+    for i in range(120):
+        hk, sk, v = b"user_%03d" % i, b"s%d" % (i % 3), b"v%d" % i
+        c.multi_set(hk, {sk: v})
+        data.setdefault(hk, {})[sk] = v
+    yield t, c, data
+    t.close()
+
+
+def test_split_preserves_all_data(loaded):
+    t, c, data = loaded
+    t.split()
+    assert t.partition_count == 8
+    for hk, kvs in data.items():
+        for sk, v in kvs.items():
+            assert c.get(hk, sk) == (0, v), (hk, sk)
+    # new routing actually spreads across the new partitions
+    owners = {partition_index(hk, 8) for hk in data}
+    assert len(owners) > 4
+
+
+def test_split_scans_exclude_stale_halves(loaded):
+    t, c, data = loaded
+    total_before = sum(len(kvs) for kvs in data.values())
+    t.split()
+    rows = [r for sc in c.get_unordered_scanners(1, ScanOptions(
+        batch_size=1000)) for r in sc]
+    # every record exactly once despite two physical copies existing
+    assert len(rows) == total_before
+    seen = {}
+    for hk, sk, v in rows:
+        assert seen.setdefault((hk, sk), v) == v
+    assert len(seen) == total_before
+
+
+def test_split_compaction_drops_stale_halves(loaded):
+    t, c, data = loaded
+    t.split()
+    # physical copies before compaction: every record exists twice
+    physical = sum(
+        sum(tbl.total_count for tbl in p.engine.lsm.l0)
+        + (p.engine.lsm.l1.total_count if p.engine.lsm.l1 else 0)
+        + len(p.engine.lsm.memtable)
+        for p in t.all_partitions())
+    total = sum(len(kvs) for kvs in data.values())
+    assert physical >= total  # duplicated state present
+    t.manual_compact_all()
+    physical_after = sum(
+        p.engine.lsm.l1.total_count if p.engine.lsm.l1 else 0
+        for p in t.all_partitions())
+    assert physical_after == total  # stale halves physically gone
+    for hk, kvs in data.items():
+        for sk, v in kvs.items():
+            assert c.get(hk, sk) == (0, v)
+
+
+def test_split_table_reopens_from_disk(tmp_path):
+    t = Table(str(tmp_path / "t"), partition_count=2)
+    c = PegasusClient(t)
+    c.set(b"hk", b"s", b"v")
+    t.split()
+    t.flush_all()
+    t.close()
+    t2 = Table(str(tmp_path / "t"), partition_count=4)
+    assert PegasusClient(t2).get(b"hk", b"s") == (0, b"v")
+    t2.close()
+
+
+def test_onebox_split_persists_catalog(tmp_path, capsys):
+    from pegasus_tpu.tools.shell import main as shell_main
+    root = str(tmp_path / "box")
+    shell_main(["--root", root, "create_app", "t", "-p", "2"])
+    shell_main(["--root", root, "set", "t", "hk", "s", "v"])
+    assert shell_main(["--root", root, "partition_split", "t"]) == 0
+    out = capsys.readouterr().out
+    assert "partition count now 4" in out
+    shell_main(["--root", root, "ls"])
+    assert "partitions=4" in capsys.readouterr().out
+    assert shell_main(["--root", root, "get", "t", "hk", "s"]) == 0
+    assert capsys.readouterr().out.strip() == "v"
+
+
+def test_split_requires_power_of_two(tmp_path):
+    t = Table(str(tmp_path / "t"), partition_count=3)
+    try:
+        with pytest.raises(ValueError):
+            t.split()
+    finally:
+        t.close()
+
+
+def test_split_children_inherit_envs_and_data_version(tmp_path):
+    t = Table(str(tmp_path / "t"), partition_count=2, data_version=0)
+    try:
+        c = PegasusClient(t)
+        t.update_app_envs({"default_ttl": "500"})
+        c.set(b"hk", b"s", b"v0value")
+        t.split()
+        for p in t.all_partitions():
+            assert p.app_envs.get("default_ttl") == "500"
+            assert p.data_version == 0
+        # v0 values still decode correctly everywhere after the split
+        assert c.get(b"hk", b"s") == (0, b"v0value")
+    finally:
+        t.close()
+
+
+def test_writes_after_split_land_in_new_partitions(loaded):
+    t, c, _ = loaded
+    t.split()
+    c.set(b"newbie_42", b"s", b"fresh")
+    pidx = partition_index(b"newbie_42", 8)
+    server = t.partitions[pidx]
+    assert server.on_get(generate_key(b"newbie_42", b"s")) == (0, b"fresh")
