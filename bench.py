@@ -155,7 +155,7 @@ def main() -> None:
             # block caches on the fresh files) -> measure
             with jax.default_device(accel):
                 reset_store()
-                run_scans(table, 20, n_partitions, n_hashkeys, seed + 1, insert_frac=0)
+                run_scans(table, 60, n_partitions, n_hashkeys, seed + 2, insert_frac=0)
                 ops, recs, accel_s = run_scans(table, n_ops, n_partitions,
                                                n_hashkeys, seed + 2)
             accel_qps = ops / accel_s
@@ -166,7 +166,7 @@ def main() -> None:
             # predicate programs
             with jax.default_device(cpu):
                 reset_store()
-                run_scans(table, 20, n_partitions, n_hashkeys, seed + 1, insert_frac=0)
+                run_scans(table, 60, n_partitions, n_hashkeys, seed + 2, insert_frac=0)
                 ops_c, recs_c, cpu_s = run_scans(table, n_ops, n_partitions,
                                                  n_hashkeys, seed + 2)
             cpu_qps = ops_c / cpu_s

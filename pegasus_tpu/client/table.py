@@ -81,31 +81,40 @@ class Table:
                 f"have {old_count}")
         new_count = old_count * 2
         created = []
+        touched_dirs = []
         try:
             for pidx in range(old_count):
                 parent = self.partitions[pidx]
                 child_pidx = pidx + old_count
                 child_dir = os.path.join(self.data_dir,
                                          f"{self.app_id}.{child_pidx}")
-                # checkpoint straight into the child's sst dir: the child's
-                # engine discovers it at open (no tempdir double-copy, no
-                # throwaway engine)
-                parent.engine.checkpoint(os.path.join(child_dir, "sst"))
+                # track + clear the dir BEFORE writing anything into it: a
+                # failed earlier attempt must not leave stale SSTs that a
+                # retry would merge with fresh ones
+                touched_dirs.append(child_dir)
+                shutil.rmtree(child_dir, ignore_errors=True)
+                # checkpoint straight into the child's sst dir (no tempdir
+                # double-copy), under the parent's single-writer lock —
+                # checkpoint flushes + truncates the parent's WAL and must
+                # not race a concurrent client write
+                with parent._write_lock:
+                    parent.engine.checkpoint(os.path.join(child_dir, "sst"))
                 child = PartitionServer(
                     child_dir, app_id=self.app_id, pidx=child_pidx,
                     partition_count=new_count,
                     data_version=self.data_version)
-                created.append((child_pidx, child, child_dir))
+                created.append((child_pidx, child))
                 if parent.app_envs:
                     child.update_app_envs(dict(parent.app_envs))
         except BaseException:
-            # roll back: a half-split table must not leak open children
-            # (a retry would otherwise double-open their WALs)
-            for _, child, child_dir in created:
+            # roll back: a half-split table must not leak open children or
+            # partially-written child dirs
+            for _, child in created:
                 child.close()
+            for child_dir in touched_dirs:
                 shutil.rmtree(child_dir, ignore_errors=True)
             raise
-        for child_pidx, child, _ in created:
+        for child_pidx, child in created:
             self.partitions[child_pidx] = child
         for p in self.partitions.values():
             p.update_partition_count(new_count)

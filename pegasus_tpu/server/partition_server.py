@@ -548,7 +548,12 @@ class PartitionServer:
                         validate_hash: bool) -> np.ndarray:
         keys = [b[0] for b in batch]
         ets = [b[2] for b in batch]
-        block = build_record_block(keys, ets)
+        # bucket the batch capacity to a power of two: arbitrary merge-path
+        # batch sizes would otherwise each compile their own XLA program
+        cap = 256
+        while cap < len(batch):
+            cap <<= 1
+        block = build_record_block(keys, ets, capacity=cap)
         masks = scan_block_predicate(
             block, now, hash_filter=hash_filter, sort_filter=sort_filter,
             validate_hash=validate_hash, pidx=self.pidx,
