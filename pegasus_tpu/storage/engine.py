@@ -156,6 +156,45 @@ class StorageEngine:
             os.remove(wal)
         return StorageEngine(data_dir)
 
+    # ---- ingestion (parity: rocksdb_wrapper.cpp:248-266 IngestExternalFile
+    # with the decree watermark carried atomically) ----------------------
+
+    def ingest_sst_file(self, path: str, decree: int) -> None:
+        """Adopt an externally-built columnar SST as the newest L0 run.
+
+        The ingested file's meta is rewritten to carry the ingesting
+        decree (the reference puts last_flushed_decree into the meta CF in
+        the same atomic step as the ingestion), so checkpoints and
+        learning know exactly what state they contain. The memtable is
+        flushed FIRST: the ingest decree becomes the flushed watermark,
+        and unflushed earlier writes must not be skipped by WAL recovery
+        nor outrank the (newer-decree) ingested run in merge order.
+        """
+        from pegasus_tpu.storage.sstable import SSTable, SSTableWriter
+
+        if decree <= self.last_committed_decree:
+            raise ValueError(
+                f"ingest decree {decree} <= last committed "
+                f"{self.last_committed_decree}")
+        self.flush()
+        src = SSTable(path)
+
+        def build(dest: str, meta) -> None:
+            writer = SSTableWriter(dest, meta=meta)
+            for key, value, ets in src.iterate():
+                writer.add(key, value or b"", ets, tombstone=value is None)
+            writer.finish()
+
+        try:
+            self.lsm.ingest(build, meta={
+                "last_flushed_decree": decree,
+                "data_version": self.data_version,
+            })
+        finally:
+            src.close()
+        self.last_committed_decree = decree
+        self.last_flushed_decree = decree
+
     # ---- compaction ---------------------------------------------------
 
     def manual_compact(self, default_ttl: int = 0, pidx: int = 0,

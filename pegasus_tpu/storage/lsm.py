@@ -120,6 +120,16 @@ class LSMStore:
         self.memtable = Memtable()
         return table
 
+    def ingest(self, build_sst, meta: Optional[dict] = None):
+        """Adopt an externally-built run as the newest L0 SST. `build_sst`
+        is a callback (dest_path, meta) -> None writing the file; keeping
+        the naming + newest-first invariants inside the store."""
+        dest = self._next_path("l0")
+        build_sst(dest, meta)
+        table = SSTable(dest)
+        self.l0.insert(0, table)
+        return table
+
     def should_compact(self) -> bool:
         return len(self.l0) >= self._l0_trigger
 
