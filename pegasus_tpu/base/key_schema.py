@@ -70,8 +70,27 @@ def hash_key_hash(hash_key: bytes) -> int:
     return crc64(hash_key)
 
 
-def partition_index(hash_key: bytes, partition_count: int) -> int:
-    return crc64(hash_key) % partition_count
+def key_hash_parts(hash_key: bytes, sort_key: bytes = b"") -> int:
+    """pegasus_key_hash(generate_key(hash_key, sort_key)) without building
+    the encoded key: crc64 of the hashkey, or of the sortkey when the
+    hashkey is empty (pegasus_key_schema.h:150)."""
+    return crc64(hash_key) if hash_key else crc64(sort_key)
+
+
+def partition_index(hash_key: bytes, partition_count: int,
+                    sort_key: bytes = b"") -> int:
+    """Routing: pegasus_key_hash(generate_key(hash_key, sort_key)) % count.
+
+    The reference client routes every request by pegasus_key_hash of the
+    full encoded key (pegasus_client_impl.cpp:124,273 for single-key ops;
+    :212,:362 build generate_key(hash_key, "") for multi-key ops), so an
+    empty hash key routes by the sort key — exactly the hash the
+    post-split staleness check (check_key_hash) and the scan/compaction
+    validation predicates use. Routing by crc64(hash_key) alone would
+    scatter empty-hashkey records onto partitions whose validation hash
+    disagrees, silently hiding them from validated scans.
+    """
+    return key_hash_parts(hash_key, sort_key) % partition_count
 
 
 def check_key_hash(key: bytes, pidx: int, partition_version: int) -> bool:

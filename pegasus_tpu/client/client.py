@@ -35,7 +35,17 @@ from pegasus_tpu.server.types import (
     SCAN_CONTEXT_ID_COMPLETED,
     SCAN_CONTEXT_ID_NOT_EXIST,
 )
-from pegasus_tpu.utils.errors import StorageStatus
+from pegasus_tpu.utils.errors import ErrorCode, StorageStatus
+
+_MISROUTED = int(ErrorCode.ERR_PARENT_PARTITION_MISUSED)
+
+
+def _err_of(resp) -> int:
+    if isinstance(resp, int):
+        return resp
+    if isinstance(resp, tuple):
+        return resp[0]
+    return resp.error
 
 
 @dataclass
@@ -131,45 +141,71 @@ class PegasusClient:
     def __init__(self, table: Table) -> None:
         self._table = table
 
+    def _dispatch(self, hash_key: bytes, sort_key: bytes, op):
+        """Route, dispatch, and re-resolve on a stale-route rejection.
+
+        The server rejects requests whose partition_hash no longer maps to
+        it after a split (ERR_PARENT_PARTITION_MISUSED); re-resolving picks
+        up the new partition count — parity with partition_resolver's
+        config-refresh-on-error loop (partition_resolver_simple.h:56).
+        """
+        resp = None
+        for _ in range(3):
+            server, ph = self._table.route(hash_key, sort_key)
+            resp = op(server, ph)
+            if _err_of(resp) != _MISROUTED:
+                return resp
+        return resp
+
     # ---- single-record ops --------------------------------------------
 
     def set(self, hash_key: bytes, sort_key: bytes, value: bytes,
             ttl_seconds: int = 0) -> int:
-        server = self._table.resolve(hash_key)
-        return server.on_put(generate_key(hash_key, sort_key), value,
-                             ttl_seconds)
+        key = generate_key(hash_key, sort_key)
+        return self._dispatch(hash_key, sort_key, lambda s, ph: s.on_put(
+            key, value, ttl_seconds, partition_hash=ph))
 
     def get(self, hash_key: bytes, sort_key: bytes) -> Tuple[int, bytes]:
-        server = self._table.resolve(hash_key)
-        return server.on_get(generate_key(hash_key, sort_key))
+        key = generate_key(hash_key, sort_key)
+        return self._dispatch(hash_key, sort_key,
+                              lambda s, ph: s.on_get(key, partition_hash=ph))
 
     def delete(self, hash_key: bytes, sort_key: bytes) -> int:
-        server = self._table.resolve(hash_key)
-        return server.on_remove(generate_key(hash_key, sort_key))
+        key = generate_key(hash_key, sort_key)
+        return self._dispatch(hash_key, sort_key, lambda s, ph: s.on_remove(
+            key, partition_hash=ph))
 
     def exist(self, hash_key: bytes, sort_key: bytes) -> bool:
         return self.get(hash_key, sort_key)[0] == int(StorageStatus.OK)
 
     def ttl(self, hash_key: bytes, sort_key: bytes) -> Tuple[int, int]:
-        server = self._table.resolve(hash_key)
-        return server.on_ttl(generate_key(hash_key, sort_key))
+        key = generate_key(hash_key, sort_key)
+        return self._dispatch(hash_key, sort_key,
+                              lambda s, ph: s.on_ttl(key, partition_hash=ph))
 
     def incr(self, hash_key: bytes, sort_key: bytes, increment: int,
              ttl_seconds: int = 0):
-        server = self._table.resolve(hash_key)
-        return server.on_incr(IncrRequest(
-            generate_key(hash_key, sort_key), increment, ttl_seconds))
+        req = IncrRequest(generate_key(hash_key, sort_key), increment,
+                          ttl_seconds)
+        return self._dispatch(hash_key, sort_key, lambda s, ph: s.on_incr(
+            req, partition_hash=ph))
 
     # ---- multi ops ----------------------------------------------------
 
     def multi_set(self, hash_key: bytes,
                   kvs: Dict[bytes, bytes] | Sequence[Tuple[bytes, bytes]],
                   ttl_seconds: int = 0) -> int:
+        if not hash_key:
+            # parity: PERR_INVALID_HASH_KEY (pegasus_client_impl.cpp:177) —
+            # multi-key records validate by crc64(hash_key); an empty one
+            # would be routed and validated inconsistently
+            return int(StorageStatus.INVALID_ARGUMENT)
         items = kvs.items() if isinstance(kvs, dict) else kvs
         req = MultiPutRequest(hash_key,
                               [KeyValue(k, v) for k, v in items],
                               ttl_seconds)
-        return self._table.resolve(hash_key).on_multi_put(req)
+        return self._dispatch(hash_key, b"", lambda s, ph: s.on_multi_put(
+            req, partition_hash=ph))
 
     def multi_get(self, hash_key: bytes,
                   sort_keys: Optional[Sequence[bytes]] = None,
@@ -180,6 +216,8 @@ class PegasusClient:
                   sort_key_filter_pattern: bytes = b"",
                   no_value: bool = False, reverse: bool = False
                   ) -> Tuple[int, Dict[bytes, bytes]]:
+        if not hash_key:
+            return int(StorageStatus.INVALID_ARGUMENT), {}
         req = MultiGetRequest(
             hash_key, sort_keys=list(sort_keys or []),
             max_kv_count=max_kv_count, max_kv_size=max_kv_size,
@@ -198,15 +236,18 @@ class PegasusClient:
 
     def multi_del(self, hash_key: bytes, sort_keys: Sequence[bytes]
                   ) -> Tuple[int, int]:
+        if not hash_key:
+            return int(StorageStatus.INVALID_ARGUMENT), 0
         req = MultiRemoveRequest(hash_key, list(sort_keys))
-        return self._table.resolve(hash_key).on_multi_remove(req)
+        return self._dispatch(hash_key, b"", lambda s, ph: s.on_multi_remove(
+            req, partition_hash=ph))
 
     def batch_get(self, keys: Sequence[Tuple[bytes, bytes]]
                   ) -> Tuple[int, List[Tuple[bytes, bytes, bytes]]]:
         """Point-gets across partitions; groups by partition server."""
         by_server: Dict[int, List[FullKey]] = {}
         for hk, sk in keys:
-            pidx = self._table.resolve(hk).pidx
+            pidx = self._table.resolve(hk, sk).pidx
             by_server.setdefault(pidx, []).append(FullKey(hk, sk))
         out: List[Tuple[bytes, bytes, bytes]] = []
         for pidx, fks in by_server.items():
@@ -218,6 +259,8 @@ class PegasusClient:
         return int(StorageStatus.OK), out
 
     def sortkey_count(self, hash_key: bytes) -> Tuple[int, int]:
+        if not hash_key:
+            return int(StorageStatus.INVALID_ARGUMENT), 0
         return self._table.resolve(hash_key).on_sortkey_count(hash_key)
 
     def check_and_set(self, hash_key: bytes, check_sort_key: bytes,
@@ -226,24 +269,39 @@ class PegasusClient:
                       ttl_seconds: int = 0,
                       return_check_value: bool = False
                       ) -> CheckAndSetResponse:
+        if not hash_key:
+            # deviation from the reference (which only rejects oversized
+            # hash keys here): with partition-hash validation always on for
+            # pow-2 tables, an empty-hashkey cas record could never satisfy
+            # the stale-key predicate on its routed partition
+            resp = CheckAndSetResponse()
+            resp.error = int(StorageStatus.INVALID_ARGUMENT)
+            return resp
         req = CheckAndSetRequest(
             hash_key, check_sort_key, check_type, check_operand,
             set_diff_sort_key=(set_sort_key != check_sort_key),
             set_sort_key=set_sort_key, set_value=set_value,
             set_expire_ts_seconds=ttl_seconds,
             return_check_value=return_check_value)
-        return self._table.resolve(hash_key).on_check_and_set(req)
+        return self._dispatch(hash_key, b"", lambda s, ph: s.on_check_and_set(
+            req, partition_hash=ph))
 
     def check_and_mutate(self, hash_key: bytes, check_sort_key: bytes,
                          check_type: int, check_operand: bytes,
                          mutates: Sequence[Mutate],
                          return_check_value: bool = False
                          ) -> CheckAndMutateResponse:
+        if not hash_key:
+            resp = CheckAndMutateResponse()
+            resp.error = int(StorageStatus.INVALID_ARGUMENT)
+            return resp
         req = CheckAndMutateRequest(
             hash_key, check_sort_key, check_type, check_operand,
             mutate_list=list(mutates),
             return_check_value=return_check_value)
-        return self._table.resolve(hash_key).on_check_and_mutate(req)
+        return self._dispatch(hash_key, b"",
+                              lambda s, ph: s.on_check_and_mutate(
+                                  req, partition_hash=ph))
 
     # ---- scanners -----------------------------------------------------
 
@@ -253,6 +311,10 @@ class PegasusClient:
         """Ordered scan within one hashkey (single partition)."""
         from pegasus_tpu.base.key_schema import generate_next_bytes
 
+        if not hash_key:
+            # parity: PERR_INVALID_HASH_KEY — "hash key cannot be empty
+            # when scan" (pegasus_client_impl.cpp:1147)
+            raise ValueError("hash key cannot be empty when scan")
         opts = options or ScanOptions()
         start_key = generate_key(hash_key, start_sortkey)
         if stop_sortkey:

@@ -1,9 +1,9 @@
 """Table: a partitioned rrdb app.
 
 In-process stand-in for the cluster side of the reference's client stack:
-the partition resolver maps crc64(hashkey) % partition_count to a
-partition (src/client/partition_resolver.cpp:48) and dispatches to that
-partition's primary. Here the "primaries" are local PartitionServer
+the partition resolver maps pegasus_key_hash(key) % partition_count to a
+partition (src/client/partition_resolver.cpp:48,
+pegasus_client_impl.cpp:124) and dispatches to that partition's primary. Here the "primaries" are local PartitionServer
 instances; the RPC/meta layers (resolver cache, config refresh) take over
 dispatch in the distributed deployment.
 """
@@ -35,8 +35,25 @@ class Table:
                 app_id=app_id, pidx=pidx, partition_count=partition_count,
                 data_version=data_version)
 
-    def resolve(self, hash_key: bytes) -> PartitionServer:
-        return self.partitions[partition_index(hash_key, self.partition_count)]
+    def resolve(self, hash_key: bytes,
+                sort_key: bytes = b"") -> PartitionServer:
+        """Route by pegasus_key_hash of the full key (see partition_index):
+        single-key ops pass their sort_key; multi-key ops pass b"" —
+        matching the reference client's tmp_key construction
+        (pegasus_client_impl.cpp:212)."""
+        return self.route(hash_key, sort_key)[0]
+
+    def route(self, hash_key: bytes,
+              sort_key: bytes = b"") -> "tuple[PartitionServer, int]":
+        """(server, partition_hash): the hash is computed once and carried
+        with the request — the server validates it against its post-split
+        partition_version so a request routed under a stale partition
+        count is rejected instead of silently acked (parity: the
+        rpc-header partition_hash, rpc_message.h:81-126)."""
+        from pegasus_tpu.base.key_schema import key_hash_parts
+
+        h = key_hash_parts(hash_key, sort_key)
+        return self.partitions[h % self.partition_count], h
 
     def all_partitions(self) -> List[PartitionServer]:
         return [self.partitions[i] for i in range(self.partition_count)]
@@ -82,43 +99,53 @@ class Table:
         new_count = old_count * 2
         created = []
         touched_dirs = []
-        try:
+        # hold EVERY parent's write lock from first checkpoint through the
+        # partition-count flip: a write accepted by a parent after its
+        # child's checkpoint (routed by the old count) whose hash maps to
+        # the child under the new count would be absent from the child and
+        # later GC'd from the parent as stale-half data — silent loss. The
+        # reference avoids this with a child catch-up from the parent's
+        # private log plus a write fence before the flip
+        # (replica_split_manager.h:76-123); this offline table-level split
+        # fences instead. Locks in pidx order (the only multi-lock site).
+        from contextlib import ExitStack
+        with ExitStack() as stack:
             for pidx in range(old_count):
-                parent = self.partitions[pidx]
-                child_pidx = pidx + old_count
-                child_dir = os.path.join(self.data_dir,
-                                         f"{self.app_id}.{child_pidx}")
-                # track + clear the dir BEFORE writing anything into it: a
-                # failed earlier attempt must not leave stale SSTs that a
-                # retry would merge with fresh ones
-                touched_dirs.append(child_dir)
-                shutil.rmtree(child_dir, ignore_errors=True)
-                # checkpoint straight into the child's sst dir (no tempdir
-                # double-copy), under the parent's single-writer lock —
-                # checkpoint flushes + truncates the parent's WAL and must
-                # not race a concurrent client write
-                with parent._write_lock:
+                stack.enter_context(self.partitions[pidx]._write_lock)
+            try:
+                for pidx in range(old_count):
+                    parent = self.partitions[pidx]
+                    child_pidx = pidx + old_count
+                    child_dir = os.path.join(self.data_dir,
+                                             f"{self.app_id}.{child_pidx}")
+                    # track + clear the dir BEFORE writing anything into
+                    # it: a failed earlier attempt must not leave stale
+                    # SSTs that a retry would merge with fresh ones
+                    touched_dirs.append(child_dir)
+                    shutil.rmtree(child_dir, ignore_errors=True)
+                    # checkpoint straight into the child's sst dir (no
+                    # tempdir double-copy); writes are fenced table-wide
                     parent.engine.checkpoint(os.path.join(child_dir, "sst"))
-                child = PartitionServer(
-                    child_dir, app_id=self.app_id, pidx=child_pidx,
-                    partition_count=new_count,
-                    data_version=self.data_version)
-                created.append((child_pidx, child))
-                if parent.app_envs:
-                    child.update_app_envs(dict(parent.app_envs))
-        except BaseException:
-            # roll back: a half-split table must not leak open children or
-            # partially-written child dirs
-            for _, child in created:
-                child.close()
-            for child_dir in touched_dirs:
-                shutil.rmtree(child_dir, ignore_errors=True)
-            raise
-        for child_pidx, child in created:
-            self.partitions[child_pidx] = child
-        for p in self.partitions.values():
-            p.update_partition_count(new_count)
-        self.partition_count = new_count
+                    child = PartitionServer(
+                        child_dir, app_id=self.app_id, pidx=child_pidx,
+                        partition_count=new_count,
+                        data_version=self.data_version)
+                    created.append((child_pidx, child))
+                    if parent.app_envs:
+                        child.update_app_envs(dict(parent.app_envs))
+            except BaseException:
+                # roll back: a half-split table must not leak open children
+                # or partially-written child dirs
+                for _, child in created:
+                    child.close()
+                for child_dir in touched_dirs:
+                    shutil.rmtree(child_dir, ignore_errors=True)
+                raise
+            for child_pidx, child in created:
+                self.partitions[child_pidx] = child
+            for p in self.partitions.values():
+                p.update_partition_count(new_count)
+            self.partition_count = new_count
 
     def close(self) -> None:
         for p in self.partitions.values():

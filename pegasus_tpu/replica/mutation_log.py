@@ -125,19 +125,39 @@ class MutationLog:
         return out
 
     def gc(self, durable_decree: int) -> None:
-        """Drop everything <= durable_decree (rewrite in place)."""
+        """Drop everything <= durable_decree.
+
+        Crash-safe: the kept tail is written to a temp file, fsynced, and
+        os.replace()d over the log (then the directory is fsynced so the
+        rename is durable). Truncating the live file first would lose the
+        retained tail on a crash mid-rewrite — the uncommitted prepare
+        window and the mutations duplication has not yet shipped (the gc
+        floor is held back precisely to preserve those).
+        """
         keep = [mu for mu in self.replay(self.path)
                 if mu.decree > durable_decree]
-        self._f.close()
-        with open(self.path, "wb") as f:
+        tmp = self.path + ".gc.tmp"
+        with open(tmp, "wb") as f:
             for mu in keep:
                 blob = mu.encode()
                 f.write(_FRAME.pack(len(blob), crc32(blob)))
                 f.write(blob)
             f.flush()
             os.fsync(f.fileno())
-        self._f = open(self.path, "ab")
-        self.generation += 1
+        # replace first, swap the append handle after: if the replace
+        # raises, self._f still appends to the live (un-gc'd) log instead
+        # of being left closed and wedging every later append
+        os.replace(tmp, self.path)
+        try:
+            dir_fd = os.open(os.path.dirname(self.path) or ".", os.O_RDONLY)
+            try:
+                os.fsync(dir_fd)
+            finally:
+                os.close(dir_fd)
+        finally:
+            self._f.close()
+            self._f = open(self.path, "ab")
+            self.generation += 1
 
     def close(self) -> None:
         self._f.close()

@@ -131,6 +131,7 @@ class Replica:
         self._pending_acks: Dict[int, Set[str]] = {}
         self._client_callbacks: Dict[int, Callable[[List[Any]], None]] = {}
         self._learners: Dict[str, int] = {}  # learner -> prepare_start decree
+        self._learn_ckpt_dirs: Dict[str, str] = {}  # learner -> frozen ckpt
         # callbacks to the control plane (meta); tests wire these
         self.on_learn_completed: Optional[Callable[[str], None]] = None
         self.on_replication_error: Optional[Callable[[str, int], None]] = None
@@ -187,6 +188,11 @@ class Replica:
         self._pending_acks.clear()
         self._client_callbacks.clear()
         self._learners.clear()
+        # learn snapshots for in-flight learners die with the primaryship
+        # (each is a full SST copy; completion will never fire to GC them)
+        for ckpt in self._learn_ckpt_dirs.values():
+            shutil.rmtree(ckpt, ignore_errors=True)
+        self._learn_ckpt_dirs.clear()
 
     def _reprepare_window(self) -> None:
         """New primary: re-send every prepared-but-uncommitted mutation
@@ -454,17 +460,25 @@ class Replica:
             })
         else:
             # gap extends below the log GC floor -> checkpoint copy
-            # (LT_APP). flush so the checkpoint reaches our commit point,
-            # then hand over the sst directory (stands in for the nfs
-            # file copy, src/nfs/nfs_node.h:84).
-            self.server.engine.flush()
+            # (LT_APP). Materialize a frozen snapshot via
+            # engine.checkpoint() and advertise THAT path — never the live
+            # sst dir: a concurrent flush/compaction deletes old L0/L1
+            # files mid-copy, so a learner walking the live dir can fail
+            # or capture a mixed-generation file set. The reference copies
+            # a checkpoint.<decree> dir (replica_learn.cpp:504 +
+            # nfs/nfs_node.h:84); the snapshot is GC'd on learn
+            # completion/abort.
+            ckpt_dir = os.path.join(self.server.engine.data_dir,
+                                    f"learn.ckpt.{src}")
+            shutil.rmtree(ckpt_dir, ignore_errors=True)
+            ckpt_decree = self.server.engine.checkpoint(ckpt_dir)
+            self._learn_ckpt_dirs[src] = ckpt_dir
             self.transport.send(self.name, src, "learn_response", {
                 "type": LT_APP,
-                "checkpoint_dir": os.path.join(self.server.engine.data_dir,
-                                               "sst"),
-                "checkpoint_decree": self.server.engine.last_flushed_decree,
+                "checkpoint_dir": ckpt_dir,
+                "checkpoint_decree": ckpt_decree,
                 "mutations": [mu.encode() for mu in self.log.read_range(
-                    self.server.engine.last_flushed_decree + 1)],
+                    ckpt_decree + 1)],
                 "last_committed": self.last_committed_decree,
             })
 
@@ -511,6 +525,9 @@ class Replica:
         """Primary: learner caught up; hand to the control plane for the
         config change that upgrades it (parity:
         RPC_LEARN_COMPLETION_NOTIFY -> meta config update)."""
+        ckpt = self._learn_ckpt_dirs.pop(src, None)
+        if ckpt is not None:
+            shutil.rmtree(ckpt, ignore_errors=True)
         if self.on_learn_completed is not None:
             self.on_learn_completed(src)
 

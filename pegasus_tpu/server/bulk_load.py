@@ -53,13 +53,14 @@ class SSTGenerator:
                  ) -> Dict[int, int]:
         """records: (hash_key, sort_key, value, expire_ts). Returns per-
         partition record counts."""
-        # routing MUST match Table.resolve (partition_index of the raw
-        # hash key), or empty-hashkey records would land where reads never
-        # look; dict insertion keeps the LAST occurrence of duplicates
+        # routing MUST match the single-key write path (pegasus_key_hash
+        # of the full key, Table.resolve(hk, sk)), or empty-hashkey records
+        # would land where reads never look; dict insertion keeps the LAST
+        # occurrence of duplicates
         buckets: Dict[int, Dict[bytes, Tuple[bytes, int]]] = {}
         for hk, sk, value, ets in records:
             key = generate_key(hk, sk)
-            pidx = partition_index(hk, self.partition_count)
+            pidx = partition_index(hk, self.partition_count, sk)
             buckets.setdefault(pidx, {})[key] = (
                 generate_value(self.data_version, value, ets), ets)
         counts = {}

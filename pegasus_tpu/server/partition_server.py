@@ -64,7 +64,7 @@ from pegasus_tpu.server.types import (
 )
 from pegasus_tpu.server.write_service import WriteService
 from pegasus_tpu.storage.engine import StorageEngine
-from pegasus_tpu.utils.errors import StorageStatus
+from pegasus_tpu.utils.errors import ErrorCode, StorageStatus
 from pegasus_tpu.utils.metrics import METRICS
 
 # candidate records gathered per device predicate dispatch
@@ -202,77 +202,125 @@ class PartitionServer:
     def _next_decree(self) -> int:
         return self.engine.last_committed_decree + 1
 
+    def _hash_gate(self, partition_hash: Optional[int]) -> int:
+        """Reject requests whose routing hash no longer maps to this
+        partition. The reference client carries its routing hash in the rpc
+        header (rpc_message.h:81-126 `partition_hash`) and the replica
+        rejects mismatches during/after a split so the client re-resolves
+        (ERR_PARENT_PARTITION_MISUSED, replica_split_manager.h). Without
+        this, a write that resolved under the old partition count but
+        reached the parent after the count flip would be acked and then
+        dropped as stale-half data. Callers on the write path must invoke
+        this AFTER taking the write lock so the check is against the
+        post-flip partition_version."""
+        if partition_hash is None or not self.validate_partition_hash:
+            return 0
+        if (partition_hash & self.partition_version) != self.pidx:
+            return int(ErrorCode.ERR_PARENT_PARTITION_MISUSED)
+        return 0
+
     # ---- write handlers ----------------------------------------------
 
     def on_put(self, key: bytes, user_data: bytes, ttl_seconds: int = 0,
-               decree: Optional[int] = None) -> int:
+               decree: Optional[int] = None,
+               partition_hash: Optional[int] = None) -> int:
         gate = self._write_gate()
         if gate:
             return gate
         with self._write_lock:
+            gate = self._hash_gate(partition_hash)
+            if gate:
+                return gate
             d = self._next_decree() if decree is None else decree
             expire_ts = expire_ts_from_ttl(ttl_seconds)
             self.cu.add_write(len(key) + len(user_data))
             return self.write_service.put(key, user_data, expire_ts, d)
 
-    def on_remove(self, key: bytes, decree: Optional[int] = None) -> int:
+    def on_remove(self, key: bytes, decree: Optional[int] = None,
+                  partition_hash: Optional[int] = None) -> int:
         gate = self._write_gate()
         if gate:
             return gate
         with self._write_lock:
+            gate = self._hash_gate(partition_hash)
+            if gate:
+                return gate
             d = self._next_decree() if decree is None else decree
             self.cu.add_write(len(key))
             return self.write_service.remove(key, d)
 
     def on_multi_put(self, req: MultiPutRequest,
-                     decree: Optional[int] = None) -> int:
+                     decree: Optional[int] = None,
+                     partition_hash: Optional[int] = None) -> int:
         gate = self._write_gate()
         if gate:
             return gate
         with self._write_lock:
+            gate = self._hash_gate(partition_hash)
+            if gate:
+                return gate
             d = self._next_decree() if decree is None else decree
             self.cu.add_write(sum(len(kv.key) + len(kv.value)
                                   for kv in req.kvs) + len(req.hash_key))
             return self.write_service.multi_put(req, d)
 
     def on_multi_remove(self, req: MultiRemoveRequest,
-                        decree: Optional[int] = None) -> Tuple[int, int]:
+                        decree: Optional[int] = None,
+                        partition_hash: Optional[int] = None
+                        ) -> Tuple[int, int]:
         gate = self._write_gate()
         if gate:
             return gate, 0
         with self._write_lock:
+            gate = self._hash_gate(partition_hash)
+            if gate:
+                return gate, 0
             d = self._next_decree() if decree is None else decree
             self.cu.add_write(len(req.hash_key)
                               + sum(len(sk) for sk in req.sort_keys))
             return self.write_service.multi_remove(req, d)
 
     def on_incr(self, req: IncrRequest,
-                decree: Optional[int] = None) -> IncrResponse:
+                decree: Optional[int] = None,
+                partition_hash: Optional[int] = None) -> IncrResponse:
         gate = self._write_gate()
         if gate:
             resp = IncrResponse()
             resp.error = gate
             return resp
         with self._write_lock:
+            gate = self._hash_gate(partition_hash)
+            if gate:
+                resp = IncrResponse()
+                resp.error = gate
+                return resp
             d = self._next_decree() if decree is None else decree
             self.cu.add_write(len(req.key))
             return self.write_service.incr(req, d)
 
     def on_check_and_set(self, req: CheckAndSetRequest,
-                         decree: Optional[int] = None) -> CheckAndSetResponse:
+                         decree: Optional[int] = None,
+                         partition_hash: Optional[int] = None
+                         ) -> CheckAndSetResponse:
         gate = self._write_gate()
         if gate:
             resp = CheckAndSetResponse()
             resp.error = gate
             return resp
         with self._write_lock:
+            gate = self._hash_gate(partition_hash)
+            if gate:
+                resp = CheckAndSetResponse()
+                resp.error = gate
+                return resp
             d = self._next_decree() if decree is None else decree
             self.cu.add_write(len(req.hash_key) + len(req.set_sort_key)
                               + len(req.set_value))
             return self.write_service.check_and_set(req, d)
 
     def on_check_and_mutate(self, req: CheckAndMutateRequest,
-                            decree: Optional[int] = None
+                            decree: Optional[int] = None,
+                            partition_hash: Optional[int] = None
                             ) -> CheckAndMutateResponse:
         gate = self._write_gate()
         if gate:
@@ -280,6 +328,11 @@ class PartitionServer:
             resp.error = gate
             return resp
         with self._write_lock:
+            gate = self._hash_gate(partition_hash)
+            if gate:
+                resp = CheckAndMutateResponse()
+                resp.error = gate
+                return resp
             d = self._next_decree() if decree is None else decree
             self.cu.add_write(len(req.hash_key) + sum(
                 len(m.sort_key) + len(m.value) for m in req.mutate_list))
@@ -287,10 +340,11 @@ class PartitionServer:
 
     # ---- point reads --------------------------------------------------
 
-    def on_get(self, key: bytes) -> Tuple[int, bytes]:
+    def on_get(self, key: bytes,
+               partition_hash: Optional[int] = None) -> Tuple[int, bytes]:
         """Parity: on_get (pegasus_server_impl.cpp:418): expired records are
         NotFound and counted as abnormal reads."""
-        gate = self._read_gate()
+        gate = self._read_gate() or self._hash_gate(partition_hash)
         if gate:
             return gate, b""
         now = epoch_now()
@@ -305,9 +359,10 @@ class PartitionServer:
         self.cu.add_read(len(key) + len(data))
         return int(StorageStatus.OK), data
 
-    def on_ttl(self, key: bytes) -> Tuple[int, int]:
+    def on_ttl(self, key: bytes,
+               partition_hash: Optional[int] = None) -> Tuple[int, int]:
         """Returns (error, ttl_seconds); -1 = no TTL (parity on_ttl:1092)."""
-        gate = self._read_gate()
+        gate = self._read_gate() or self._hash_gate(partition_hash)
         if gate:
             return gate, 0
         now = epoch_now()

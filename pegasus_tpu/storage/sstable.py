@@ -181,6 +181,14 @@ class SSTableWriter:
         os.fsync(self._f.fileno())
         self._f.close()
         os.replace(self.path + ".tmp", self.path)
+        # the rename itself must be durable BEFORE the caller truncates the
+        # WAL, or a power failure can lose the SST while the WAL is already
+        # empty — fsync the containing directory
+        dir_fd = os.open(os.path.dirname(self.path) or ".", os.O_RDONLY)
+        try:
+            os.fsync(dir_fd)
+        finally:
+            os.close(dir_fd)
 
     def abandon(self) -> None:
         self._f.close()

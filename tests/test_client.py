@@ -185,3 +185,34 @@ def test_expired_records_filtered_everywhere(client, table):
     assert client.sortkey_count(b"hk") == (OK, 1)
     got = list(client.get_scanner(b"hk"))
     assert [sk for _, sk, _ in got] == [b"live"]
+
+
+def test_empty_hashkey_routing_consistent_with_validation(client, table):
+    """ADVICE r1 (high): empty-hashkey records must route by the same hash
+    the scan/compaction validation predicates use (pegasus_key_hash ==
+    crc64 of the sortkey when the hashkey is empty), or they are hidden
+    from validated scans and deleted by the next manual compaction."""
+    n = 32
+    for i in range(n):
+        assert client.set(b"", b"esk_%04d" % i, b"v%d" % i) == OK
+    # point reads see them
+    for i in range(n):
+        assert client.get(b"", b"esk_%04d" % i) == (OK, b"v%d" % i)
+    # they scatter across partitions (crc64 of the sortkey), not all on p0
+    touched = {p.pidx for p in table.all_partitions()
+               if p.engine.last_committed_decree > 0}
+    assert len(touched) > 1
+    # validated full scan sees all of them
+    scanners = client.get_unordered_scanners(8)
+    got = set()
+    for sc in scanners:
+        for hk, sk, _v in sc:
+            if hk == b"":
+                got.add(sk)
+    assert got == {b"esk_%04d" % i for i in range(n)}
+    # manual compaction (partition-hash validation active for pow-2
+    # counts) must NOT drop them
+    table.flush_all()
+    table.manual_compact_all()
+    for i in range(n):
+        assert client.get(b"", b"esk_%04d" % i) == (OK, b"v%d" % i)

@@ -129,3 +129,43 @@ def test_writes_after_split_land_in_new_partitions(loaded):
     pidx = partition_index(b"newbie_42", 8)
     server = t.partitions[pidx]
     assert server.on_get(generate_key(b"newbie_42", b"s")) == (0, b"fresh")
+
+
+def test_split_concurrent_writes_not_lost(tmp_path):
+    """ADVICE r1 (medium): a write acked by a parent after its child's
+    checkpoint but before the count flip must not vanish. split() fences
+    writes table-wide, so every acked write is either pre-checkpoint (in
+    the child copy) or post-flip (routed by the new count)."""
+    import threading
+
+    from pegasus_tpu.client import PegasusClient, Table
+    from pegasus_tpu.utils.errors import StorageStatus
+
+    t = Table(str(tmp_path / "t"), partition_count=4)
+    c = PegasusClient(t)
+    acked = []
+    stop = threading.Event()
+
+    def writer():
+        i = 0
+        while not stop.is_set():
+            hk = b"w_%05d" % i
+            if c.set(hk, b"sk", b"v%d" % i) == int(StorageStatus.OK):
+                acked.append((hk, b"v%d" % i))
+            i += 1
+
+    th = threading.Thread(target=writer)
+    th.start()
+    try:
+        t.split()
+        t.split()  # 4 -> 8 -> 16 under fire
+    finally:
+        stop.set()
+        th.join()
+    assert t.partition_count == 16
+    t.flush_all()
+    t.manual_compact_all()  # drops stale-half copies; acked must survive
+    for hk, v in acked:
+        assert c.get(hk, b"sk") == (int(StorageStatus.OK), v), hk
+    assert len(acked) > 0
+    t.close()
