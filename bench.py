@@ -13,12 +13,24 @@ vs_baseline = accelerator throughput / XLA-CPU throughput for the same
 workload in the same process (the CPU baseline the reference's scalar C++
 loop competes with — see BASELINE.md "measure CPU baseline").
 
-Env knobs: PEGBENCH_RECORDS (default 100_000), PEGBENCH_OPS (default 300),
-PEGBENCH_PARTITIONS (default 64), PEGBENCH_SEED.
+Secondary phases (BASELINE configs #3/#4: TTL-expiry and rule-based
+manual-compaction GB/s) run when PEGBENCH_COMPACT=1 and are reported in
+BENCH_DETAILS.json next to this script plus stderr — stdout stays one line.
+
+The accelerator in this image sits behind a tunnel whose backend init can
+fail transiently (or hang for hours if a previous claim was killed), so
+device bring-up happens in a probe SUBPROCESS with bounded retries and
+backoff; on permanent failure the one JSON line is a structured error
+record rather than a traceback.
+
+Env knobs: PEGBENCH_RECORDS (default 100_000), PEGBENCH_OPS (default 1200),
+PEGBENCH_PARTITIONS (default 64), PEGBENCH_SEED, PEGBENCH_COMPACT=1,
+PEGBENCH_PROBE_TIMEOUT (s, default 180), PEGBENCH_PROBE_RETRIES (default 4).
 """
 
 import json
 import os
+import subprocess
 import sys
 import tempfile
 import time
@@ -28,17 +40,78 @@ def _log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
-def setup_jax():
-    """Make both the accelerator and CPU platforms available."""
-    import jax
+_PROBE_SRC = r"""
+import sys
+import jax
+devs = jax.devices()
+accel = [d for d in devs if d.platform != "cpu"]
+print("PROBE_OK", devs[0].platform, len(devs), flush=True)
+if accel:
+    # one tiny dispatch proves the chip executes, not just enumerates
+    import jax.numpy as jnp
+    x = jnp.arange(8)
+    print("PROBE_EXEC", int((x * 2).sum()), flush=True)
+"""
 
+
+def probe_accelerator(timeout_s: float, retries: int) -> dict:
+    """Bring up the accelerator backend in a subprocess.
+
+    Returns {"ok": True, "platform": ...} or {"ok": False, "error": ...}.
+    A subprocess is the only safe way to bound this: a wedged tunnel blocks
+    inside the PJRT C client where no Python-level timeout can interrupt.
+    The probe holds no claim until init succeeds, and exits cleanly right
+    after, so killing it on timeout does not wedge the chip.
+    """
+    last = ""
+    for attempt in range(retries):
+        if attempt:
+            backoff = min(60, 10 * (2 ** (attempt - 1)))
+            _log(f"probe retry {attempt + 1}/{retries} in {backoff}s "
+                 f"(last: {last.strip()[:200]})")
+            time.sleep(backoff)
+        t0 = time.perf_counter()
+        try:
+            r = subprocess.run([sys.executable, "-c", _PROBE_SRC],
+                               capture_output=True, text=True,
+                               timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            last = f"probe timed out after {timeout_s}s (tunnel wedged?)"
+            continue
+        out = r.stdout or ""
+        if r.returncode == 0 and "PROBE_OK" in out:
+            platform = out.split("PROBE_OK", 1)[1].split()[0]
+            _log(f"probe ok in {time.perf_counter() - t0:.1f}s: "
+                 f"platform={platform}")
+            return {"ok": True, "platform": platform,
+                    "executed": "PROBE_EXEC" in out}
+        last = (r.stderr or "")[-500:] or f"rc={r.returncode}"
+    return {"ok": False, "error": last}
+
+
+def pallas_smoke() -> str:
+    """Compile+run the fused Pallas scan kernel on the default backend.
+
+    Distinguishes a mosaic-lowering failure from a tunnel failure: the
+    caller already proved the backend is alive. Returns "ok", "fallback"
+    (interpret mode used — not a TPU), or the error string.
+    """
     try:
-        current = jax.config.jax_platforms or ""
-    except AttributeError:
-        current = os.environ.get("JAX_PLATFORMS", "")
-    if current and "cpu" not in current.split(","):
-        jax.config.update("jax_platforms", current + ",cpu")
-    return jax
+        import numpy as np
+
+        from pegasus_tpu.base.key_schema import generate_key
+        from pegasus_tpu.ops.record_block import build_record_block
+        import pegasus_tpu.ops.pallas_scan as ps
+
+        keys = [generate_key(b"hk%d" % i, b"s%02d" % i) for i in range(64)]
+        ets = [0 if i % 2 else 1 for i in range(64)]
+        block = build_record_block(keys, ets, capacity=64, key_width=32)
+        keep, expired = ps.fused_scan_block(block, now=100)
+        n = int(np.asarray(keep).sum())
+        assert 0 <= n <= 64
+        return "ok"
+    except Exception as e:  # noqa: BLE001 - diagnostic path
+        return f"{type(e).__name__}: {e}"[:300]
 
 
 def build_table(tmpdir, n_records, n_partitions, seed):
@@ -104,19 +177,18 @@ def run_scans(table, n_ops, n_partitions, n_hashkeys, seed, record_goal=100,
     weights /= weights.sum()
     # zipfian-ish start-key popularity within the loaded keyspace
     zipf_u = rng.random(n_ops) ** 2.0
+    pidx_choices = rng.choice(n_partitions, size=n_ops, p=weights)
+    insert_draw = rng.random(n_ops)
 
     records = 0
-    inserts = 0
     t0 = time.perf_counter()
     for op in range(n_ops):
-        if rng.random() < insert_frac:
+        if insert_draw[op] < insert_frac:
             hk = b"user%08d" % int(rng.integers(0, 1 << 30))
             server = table.resolve(hk)
             server.on_put(generate_key(hk, b"s00"), b"inserted")
-            inserts += 1
             continue
-        pidx = int(rng.choice(n_partitions, p=weights))
-        server = partitions[pidx]
+        server = partitions[int(pidx_choices[op])]
         start_hk = b"user%08d" % int(zipf_u[op] * n_hashkeys)
         scan_len = int(rng.integers(1, record_goal + 1))
         resp = server.on_get_scanner(GetScannerRequest(
@@ -130,48 +202,132 @@ def run_scans(table, n_ops, n_partitions, n_hashkeys, seed, record_goal=100,
     return n_ops, records, elapsed
 
 
+def measure_scan_phase(jax, device, table, n_ops, n_partitions, n_hashkeys,
+                      seed):
+    """reset -> warmup (compile + device block caches) -> measure."""
+    with jax.default_device(device):
+        table.manual_compact_all()
+        run_scans(table, 60, n_partitions, n_hashkeys, seed, insert_frac=0)
+        ops, recs, secs = run_scans(table, n_ops, n_partitions,
+                                    n_hashkeys, seed)
+    return ops, recs, secs
+
+
+def data_bytes(table) -> int:
+    total = 0
+    for p in table.all_partitions():
+        sst = os.path.join(p.engine.data_dir, "sst")
+        for name in os.listdir(sst):
+            total += os.path.getsize(os.path.join(sst, name))
+    return total
+
+
+def measure_compaction(jax, device, table, mode: str):
+    """Manual compaction GB/s through the device filter path.
+
+    mode "ttl": TTL-expiry filter only (BASELINE config #3).
+    mode "rules": hashkey-prefix delete + sortkey-range TTL rules
+    (BASELINE config #4, compaction_filter_rule.h:99,121,141).
+    """
+    rules_filter = None
+    if mode == "rules":
+        from pegasus_tpu.ops.compaction_rules import compile_rules
+        rules_filter = compile_rules([{
+            "op": "delete_key",
+            "rules": [{"type": "hashkey_pattern", "match": "prefix",
+                       "pattern": "user0000001"}],
+        }])
+    size_before = data_bytes(table)
+    with jax.default_device(device):
+        t0 = time.perf_counter()
+        table.manual_compact_all(rules_filter=rules_filter)
+        secs = time.perf_counter() - t0
+    return size_before / max(secs, 1e-9), secs
+
+
 def main() -> None:
     n_records = int(os.environ.get("PEGBENCH_RECORDS", 100_000))
-    n_ops = int(os.environ.get("PEGBENCH_OPS", 300))
+    n_ops = int(os.environ.get("PEGBENCH_OPS", 1200))
     n_partitions = int(os.environ.get("PEGBENCH_PARTITIONS", 64))
     seed = int(os.environ.get("PEGBENCH_SEED", 7))
+    probe_timeout = float(os.environ.get("PEGBENCH_PROBE_TIMEOUT", 180))
+    probe_retries = int(os.environ.get("PEGBENCH_PROBE_RETRIES", 4))
+    do_compact = os.environ.get("PEGBENCH_COMPACT") == "1"
 
-    jax = setup_jax()
+    details = {"phases": {}}
+
+    probe = probe_accelerator(probe_timeout, probe_retries)
+    if not probe["ok"]:
+        # structured failure record: the premise (a TPU number) cannot be
+        # measured because the backend never came up — say so in the one
+        # JSON line instead of dying with a traceback
+        print(json.dumps({
+            "metric": "YCSB-E scan ops/sec/chip (64-partition, "
+                      "TTL+hash-validated)",
+            "value": 0,
+            "unit": "ops/s",
+            "vs_baseline": 0,
+            "error": f"accelerator backend unavailable after "
+                     f"{probe_retries} probes: {probe['error']}",
+        }))
+        sys.exit(1)
+
+    import jax
+    try:
+        current = jax.config.jax_platforms or ""
+    except AttributeError:
+        current = os.environ.get("JAX_PLATFORMS", "")
+    if current and "cpu" not in current.split(","):
+        jax.config.update("jax_platforms", current + ",cpu")
+
     accel = jax.devices()[0]
     cpu = jax.local_devices(backend="cpu")[0]
     _log(f"accelerator: {accel}, baseline: {cpu}")
 
+    with jax.default_device(accel):
+        smoke = pallas_smoke()
+    _log(f"pallas fused-kernel smoke on {accel.platform}: {smoke}")
+    details["pallas_smoke"] = smoke
+    details["accel_platform"] = accel.platform
+
     with tempfile.TemporaryDirectory(prefix="pegbench") as tmpdir:
         table, client = build_table(tmpdir, n_records, n_partitions, seed)
         n_hashkeys = max(1, n_records // 10)
-        def reset_store():
-            # both measured phases start from the identical fully-compacted
-            # state (the 5% inserts during a phase otherwise leave the
-            # store different for the second phase)
-            table.manual_compact_all()
-
         try:
-            # each phase: reset store -> warmup (compile + populate device
-            # block caches on the fresh files) -> measure
-            with jax.default_device(accel):
-                reset_store()
-                run_scans(table, 60, n_partitions, n_hashkeys, seed + 2, insert_frac=0)
-                ops, recs, accel_s = run_scans(table, n_ops, n_partitions,
-                                               n_hashkeys, seed + 2)
+            ops, recs, accel_s = measure_scan_phase(
+                jax, accel, table, n_ops, n_partitions, n_hashkeys, seed + 2)
             accel_qps = ops / accel_s
             _log(f"accel: {ops} ops / {recs} records in {accel_s:.2f}s "
                  f"-> {accel_qps:.1f} ops/s, {recs / accel_s:.0f} rec/s")
 
-            # CPU baseline: identical workload, XLA-CPU executes the
-            # predicate programs
-            with jax.default_device(cpu):
-                reset_store()
-                run_scans(table, 60, n_partitions, n_hashkeys, seed + 2, insert_frac=0)
-                ops_c, recs_c, cpu_s = run_scans(table, n_ops, n_partitions,
-                                                 n_hashkeys, seed + 2)
+            ops_c, recs_c, cpu_s = measure_scan_phase(
+                jax, cpu, table, n_ops, n_partitions, n_hashkeys, seed + 2)
             cpu_qps = ops_c / cpu_s
             _log(f"cpu:   {ops_c} ops / {recs_c} records in {cpu_s:.2f}s "
                  f"-> {cpu_qps:.1f} ops/s")
+            details["phases"]["scan"] = {
+                "accel_qps": round(accel_qps, 2),
+                "cpu_qps": round(cpu_qps, 2),
+                "accel_records_per_s": round(recs / accel_s, 1),
+                "ops": n_ops, "records_loaded": n_records,
+            }
+
+            if do_compact:
+                for mode in ("ttl", "rules"):
+                    a_bps, a_s = measure_compaction(jax, accel, table, mode)
+                    c_bps, c_s = measure_compaction(jax, cpu, table, mode)
+                    details["phases"][f"compact_{mode}"] = {
+                        "accel_gbps": round(a_bps / 1e9, 4),
+                        "cpu_gbps": round(c_bps / 1e9, 4),
+                        "vs_baseline": round(a_bps / c_bps, 3) if c_bps else 0,
+                    }
+                    _log(f"compact[{mode}]: accel {a_bps / 1e9:.3f} GB/s "
+                         f"({a_s:.1f}s), cpu {c_bps / 1e9:.3f} GB/s "
+                         f"({c_s:.1f}s)")
+
+            here = os.path.dirname(os.path.abspath(__file__))
+            with open(os.path.join(here, "BENCH_DETAILS.json"), "w") as f:
+                json.dump(details, f, indent=1)
 
             print(json.dumps({
                 "metric": "YCSB-E scan ops/sec/chip (64-partition, "
