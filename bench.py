@@ -114,63 +114,88 @@ def pallas_smoke() -> str:
         return f"{type(e).__name__}: {e}"[:300]
 
 
-def build_table(tmpdir, n_records, n_partitions, seed):
+class BenchCluster:
+    """Replicated-path bench target: a SimCluster onebox whose measured
+    ops go client -> sim transport -> replica-stub gates -> storage app
+    (VERDICT r1: the benched path must be the replicated path). Single
+    replica per partition (BASELINE config #1 "onebox single-replica") so
+    load cost stays in the storage engine, not the sim scheduler."""
+
+    def __init__(self, tmpdir, n_partitions):
+        from pegasus_tpu.tools.cluster import SimCluster
+
+        self.cluster = SimCluster(tmpdir, n_nodes=1)
+        self.app_id = self.cluster.create_table(
+            "bench", partition_count=n_partitions, replica_count=1)
+        self.client = self.cluster.client("bench")
+        self.client.refresh_config()
+        node = next(iter(self.cluster.stubs.values()))
+        self.servers = [node.get_replica((self.app_id, pidx)).server
+                        for pidx in range(n_partitions)]
+        self.replicas = [node.get_replica((self.app_id, pidx))
+                         for pidx in range(n_partitions)]
+
+    def manual_compact_all(self, rules_filter=None):
+        for srv in self.servers:
+            srv.manual_compact(rules_filter=rules_filter)
+
+    def close(self):
+        self.cluster.close()
+
+
+def build_cluster(tmpdir, n_records, n_partitions, seed):
     import numpy as np
 
+    from pegasus_tpu.base.key_schema import generate_key, key_hash_parts
     from pegasus_tpu.base.value_schema import epoch_now
-    from pegasus_tpu.client import PegasusClient, Table
+    from pegasus_tpu.replica.mutation import WriteOp
+    from pegasus_tpu.rpc.codec import OP_PUT
 
     rng = np.random.default_rng(seed)
-    table = Table(tmpdir, app_name="bench", partition_count=n_partitions)
-    client = PegasusClient(table)
+    bc = BenchCluster(tmpdir, n_partitions)
     now = epoch_now()
 
     t0 = time.perf_counter()
     n_hashkeys = max(1, n_records // 10)
-    # direct write-service loads grouped per partition (bulk-load style)
-    from pegasus_tpu.base.key_schema import generate_key
-    from pegasus_tpu.base.value_schema import generate_value
-    from pegasus_tpu.storage.engine import WriteBatchItem
-    from pegasus_tpu.storage.wal import OP_PUT
-
-    per_server_items = {p.pidx: [] for p in table.all_partitions()}
+    # load through the REPLICA WRITE PATH (batched mutations: many puts
+    # share one mutation, parity mutation.cpp:390) grouped per partition
+    per_pidx_ops = {pidx: [] for pidx in range(n_partitions)}
     i = 0
     for h in range(n_hashkeys):
         hk = b"user%08d" % h
-        server = table.resolve(hk)
-        items = per_server_items[server.pidx]
-        for s in range(10):
+        ops = per_pidx_ops[key_hash_parts(hk) % n_partitions]
+        for sk_i in range(10):
             if i >= n_records:
                 break
             ets = 0 if rng.random() > 0.10 else max(1, now - 100)
             value = b"field0=%064d" % i
-            key = generate_key(hk, b"s%02d" % s)
-            items.append(WriteBatchItem(
-                OP_PUT, key, generate_value(1, value, ets), ets))
+            key = generate_key(hk, b"s%02d" % sk_i)
+            ops.append(WriteOp(OP_PUT, (key, value, ets)))
             i += 1
-    for p in table.all_partitions():
-        items = per_server_items[p.pidx]
-        for off in range(0, len(items), 1000):
-            p.engine.write_batch(items[off:off + 1000],
-                                 p.engine.last_committed_decree + 1)
+    for pidx, ops in per_pidx_ops.items():
+        r = bc.replicas[pidx]
+        for off in range(0, len(ops), 1000):
+            r.client_write(ops[off:off + 1000])
+        bc.cluster.loop.run_until_idle()
     _log(f"loaded {i} records in {time.perf_counter() - t0:.1f}s")
 
     t0 = time.perf_counter()
-    table.manual_compact_all()
+    bc.manual_compact_all()
     _log(f"compacted in {time.perf_counter() - t0:.1f}s")
-    return table, client
+    return bc
 
 
-def run_scans(table, n_ops, n_partitions, n_hashkeys, seed, record_goal=100,
+def run_scans(bc, n_ops, n_partitions, n_hashkeys, seed, record_goal=100,
               insert_frac=0.05):
-    """95% scans / 5% inserts; returns (ops, records, elapsed_s)."""
+    """95% scans / 5% inserts THROUGH the cluster read/write gates;
+    returns (ops, records, elapsed_s)."""
     import numpy as np
 
     from pegasus_tpu.base.key_schema import generate_key
     from pegasus_tpu.server.types import GetScannerRequest
 
     rng = np.random.default_rng(seed)
-    partitions = table.all_partitions()
+    client = bc.client
     # zipfian-ish partition popularity
     ranks = rng.permutation(n_partitions)
     weights = 1.0 / (1.0 + ranks.astype(float))
@@ -185,44 +210,43 @@ def run_scans(table, n_ops, n_partitions, n_hashkeys, seed, record_goal=100,
     for op in range(n_ops):
         if insert_draw[op] < insert_frac:
             hk = b"user%08d" % int(rng.integers(0, 1 << 30))
-            server = table.resolve(hk)
-            server.on_put(generate_key(hk, b"s00"), b"inserted")
+            client.set(hk, b"s00", b"inserted")
             continue
-        server = partitions[int(pidx_choices[op])]
+        pidx = int(pidx_choices[op])
         start_hk = b"user%08d" % int(zipf_u[op] * n_hashkeys)
         scan_len = int(rng.integers(1, record_goal + 1))
-        resp = server.on_get_scanner(GetScannerRequest(
+        resp = client._read("get_scanner", GetScannerRequest(
             start_key=generate_key(start_hk, b""),
             batch_size=scan_len,
-            validate_partition_hash=True))
+            validate_partition_hash=True), pidx)
         records += len(resp.kvs)
         if resp.context_id >= 0:
-            server.on_clear_scanner(resp.context_id)
+            client._read("clear_scanner", resp.context_id, pidx)
     elapsed = time.perf_counter() - t0
     return n_ops, records, elapsed
 
 
-def measure_scan_phase(jax, device, table, n_ops, n_partitions, n_hashkeys,
+def measure_scan_phase(jax, device, bc, n_ops, n_partitions, n_hashkeys,
                       seed):
     """reset -> warmup (compile + device block caches) -> measure."""
     with jax.default_device(device):
-        table.manual_compact_all()
-        run_scans(table, 60, n_partitions, n_hashkeys, seed, insert_frac=0)
-        ops, recs, secs = run_scans(table, n_ops, n_partitions,
+        bc.manual_compact_all()
+        run_scans(bc, 60, n_partitions, n_hashkeys, seed, insert_frac=0)
+        ops, recs, secs = run_scans(bc, n_ops, n_partitions,
                                     n_hashkeys, seed)
     return ops, recs, secs
 
 
-def data_bytes(table) -> int:
+def data_bytes(bc) -> int:
     total = 0
-    for p in table.all_partitions():
-        sst = os.path.join(p.engine.data_dir, "sst")
+    for srv in bc.servers:
+        sst = os.path.join(srv.engine.data_dir, "sst")
         for name in os.listdir(sst):
             total += os.path.getsize(os.path.join(sst, name))
     return total
 
 
-def measure_compaction(jax, device, table, mode: str):
+def measure_compaction(jax, device, bc, mode: str):
     """Manual compaction GB/s through the device filter path.
 
     mode "ttl": TTL-expiry filter only (BASELINE config #3).
@@ -237,10 +261,10 @@ def measure_compaction(jax, device, table, mode: str):
             "rules": [{"type": "hashkey_pattern", "match": "prefix",
                        "pattern": "user0000001"}],
         }])
-    size_before = data_bytes(table)
+    size_before = data_bytes(bc)
     with jax.default_device(device):
         t0 = time.perf_counter()
-        table.manual_compact_all(rules_filter=rules_filter)
+        bc.manual_compact_all(rules_filter=rules_filter)
         secs = time.perf_counter() - t0
     return size_before / max(secs, 1e-9), secs
 
@@ -291,17 +315,17 @@ def main() -> None:
     details["accel_platform"] = accel.platform
 
     with tempfile.TemporaryDirectory(prefix="pegbench") as tmpdir:
-        table, client = build_table(tmpdir, n_records, n_partitions, seed)
+        bc = build_cluster(tmpdir, n_records, n_partitions, seed)
         n_hashkeys = max(1, n_records // 10)
         try:
             ops, recs, accel_s = measure_scan_phase(
-                jax, accel, table, n_ops, n_partitions, n_hashkeys, seed + 2)
+                jax, accel, bc, n_ops, n_partitions, n_hashkeys, seed + 2)
             accel_qps = ops / accel_s
             _log(f"accel: {ops} ops / {recs} records in {accel_s:.2f}s "
                  f"-> {accel_qps:.1f} ops/s, {recs / accel_s:.0f} rec/s")
 
             ops_c, recs_c, cpu_s = measure_scan_phase(
-                jax, cpu, table, n_ops, n_partitions, n_hashkeys, seed + 2)
+                jax, cpu, bc, n_ops, n_partitions, n_hashkeys, seed + 2)
             cpu_qps = ops_c / cpu_s
             _log(f"cpu:   {ops_c} ops / {recs_c} records in {cpu_s:.2f}s "
                  f"-> {cpu_qps:.1f} ops/s")
@@ -314,8 +338,8 @@ def main() -> None:
 
             if do_compact:
                 for mode in ("ttl", "rules"):
-                    a_bps, a_s = measure_compaction(jax, accel, table, mode)
-                    c_bps, c_s = measure_compaction(jax, cpu, table, mode)
+                    a_bps, a_s = measure_compaction(jax, accel, bc, mode)
+                    c_bps, c_s = measure_compaction(jax, cpu, bc, mode)
                     details["phases"][f"compact_{mode}"] = {
                         "accel_gbps": round(a_bps / 1e9, 4),
                         "cpu_gbps": round(c_bps / 1e9, 4),
@@ -337,7 +361,7 @@ def main() -> None:
                 "vs_baseline": round(accel_qps / cpu_qps, 3) if cpu_qps else 0,
             }))
         finally:
-            table.close()
+            bc.close()
 
 
 if __name__ == "__main__":

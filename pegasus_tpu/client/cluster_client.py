@@ -1,0 +1,479 @@
+"""ClusterClient: the client stack over a replicated cluster.
+
+Parity: the reference client's resolution pipeline —
+pegasus_client_impl (pegasus_client_impl.cpp:124 key hash) →
+partition_resolver_simple (partition_resolver_simple.h:56: hash → cached
+partition_configuration → primary address, re-query meta on error) →
+gpid-addressed RPC served through the replica gates
+(replica_stub.cpp:1100, replica.cpp:386).
+
+Unlike `PegasusClient` (in-process Table), every op here crosses the
+network abstraction: writes go through the primary's full 2PC, reads
+through the primary's replica gate. The config cache refreshes on
+ERR_INVALID_STATE-class errors and on reply timeouts.
+
+The transport is pluggable: a `pump()` callable drives message delivery
+while the client waits for a reply (the deterministic SimNetwork needs
+its loop driven; a real socket transport pumps by blocking on the
+socket).
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence, Tuple
+
+from pegasus_tpu.base.key_schema import generate_key, key_hash_parts, restore_key
+from pegasus_tpu.client.client import ScanOptions
+from pegasus_tpu.rpc.codec import (
+    OP_CAM,
+    OP_CAS,
+    OP_INCR,
+    OP_MULTI_PUT,
+    OP_MULTI_REMOVE,
+    OP_PUT,
+    OP_REMOVE,
+)
+from pegasus_tpu.server.types import (
+    BatchGetRequest,
+    CheckAndMutateRequest,
+    CheckAndMutateResponse,
+    CheckAndSetRequest,
+    CheckAndSetResponse,
+    FullKey,
+    GetScannerRequest,
+    IncrRequest,
+    KeyValue,
+    MultiGetRequest,
+    MultiPutRequest,
+    MultiRemoveRequest,
+    Mutate,
+    SCAN_CONTEXT_ID_COMPLETED,
+    SCAN_CONTEXT_ID_NOT_EXIST,
+)
+from pegasus_tpu.utils.errors import ErrorCode, PegasusError, StorageStatus
+
+_RETRYABLE = {
+    int(ErrorCode.ERR_INVALID_STATE),
+    int(ErrorCode.ERR_INACTIVE_STATE),
+    int(ErrorCode.ERR_PARENT_PARTITION_MISUSED),
+    int(ErrorCode.ERR_OBJECT_NOT_FOUND),
+    int(ErrorCode.ERR_TIMEOUT),
+}
+
+_OK = int(ErrorCode.ERR_OK)
+
+
+class ClusterClient:
+    """Full data-plane client resolved through meta.
+
+    `pump` is called repeatedly while waiting for a reply; each call
+    should advance message delivery (and, in simulation, virtual time so
+    failure detection can progress during retries).
+    """
+
+    def __init__(self, net, name: str, meta_addr: str, app_name: str,
+                 pump: Callable[[], None],
+                 max_retries: int = 6, pump_rounds: int = 50) -> None:
+        self.net = net
+        self.name = name
+        self.meta_addr = meta_addr
+        self.app_name = app_name
+        self._pump = pump
+        self._max_retries = max_retries
+        self._pump_rounds = pump_rounds
+        self._rids = itertools.count(1)
+        self._replies: Dict[int, dict] = {}
+        self._pending: set = set()
+        self.app_id: Optional[int] = None
+        self.partition_count = 0
+        self._configs: List[dict] = []
+        net.register(name, self._on_message)
+
+    # ---- transport plumbing -------------------------------------------
+
+    def _on_message(self, src: str, msg_type: str, payload) -> None:
+        if msg_type in ("client_read_reply", "client_write_reply",
+                        "query_config_reply"):
+            rid = payload.get("rid")
+            # only requests still being awaited are stored: a reply that
+            # straggles in after its _await gave up (e.g. delivered once a
+            # partition heals) would otherwise accumulate forever
+            if rid in self._pending:
+                self._replies[rid] = payload
+
+    def _send_request(self, dst: str, msg_type: str, payload: dict) -> int:
+        rid = next(self._rids)
+        payload["rid"] = rid
+        self._pending.add(rid)
+        self.net.send(self.name, dst, msg_type, payload)
+        return rid
+
+    def _await(self, rid: int) -> Optional[dict]:
+        try:
+            for _ in range(self._pump_rounds):
+                if rid in self._replies:
+                    return self._replies.pop(rid)
+                self._pump()
+            return self._replies.pop(rid, None)
+        finally:
+            self._pending.discard(rid)
+
+    # ---- config cache (parity: partition_resolver_simple) -------------
+
+    def refresh_config(self) -> None:
+        rid = self._send_request(self.meta_addr, "query_config", {
+            "app_name": self.app_name})
+        reply = self._await(rid)
+        if reply is None:
+            raise PegasusError(ErrorCode.ERR_TIMEOUT,
+                               f"meta {self.meta_addr} unreachable")
+        if reply["err"] != _OK:
+            raise PegasusError(ErrorCode(reply["err"]), self.app_name)
+        self.app_id = reply["app_id"]
+        self.partition_count = reply["partition_count"]
+        self._configs = reply["configs"]
+
+    def _ensure_config(self) -> None:
+        if self.app_id is None:
+            self.refresh_config()
+
+    def _primary_of(self, pidx: int) -> str:
+        return self._configs[pidx]["primary"]
+
+    # ---- request dispatch with refresh-on-error retry ------------------
+
+    def _read(self, op: str, args: Any, pidx: int,
+              partition_hash: Optional[int] = None) -> Any:
+        self._ensure_config()
+        last_err = int(ErrorCode.ERR_TIMEOUT)
+        for attempt in range(self._max_retries):
+            if attempt:
+                self.refresh_config()
+            p = pidx if partition_hash is None else (
+                partition_hash % self.partition_count)
+            primary = self._primary_of(p)
+            if not primary:
+                continue  # partition momentarily unowned; refresh + retry
+            rid = self._send_request(primary, "client_read", {
+                "gpid": (self.app_id, p), "op": op,
+                "args": args, "partition_hash": partition_hash})
+            reply = self._await(rid)
+            if reply is None:
+                last_err = int(ErrorCode.ERR_TIMEOUT)
+                continue
+            if reply["err"] in _RETRYABLE:
+                last_err = reply["err"]
+                continue
+            if reply["err"] != _OK:
+                raise PegasusError(ErrorCode(reply["err"]), op)
+            return reply["result"]
+        raise PegasusError(ErrorCode(last_err), f"{op} exhausted retries")
+
+    def _write(self, ops: List[Tuple[int, Any]],
+               partition_hash: int) -> List[Any]:
+        from pegasus_tpu.replica.mutation import ATOMIC_OPS
+
+        self._ensure_config()
+        retry_safe = all(op not in ATOMIC_OPS for op, _ in ops)
+        last_err = int(ErrorCode.ERR_TIMEOUT)
+        for attempt in range(self._max_retries):
+            if attempt:
+                self.refresh_config()
+            pidx = partition_hash % self.partition_count
+            primary = self._primary_of(pidx)
+            if not primary:
+                continue
+            rid = self._send_request(primary, "client_write", {
+                "gpid": (self.app_id, pidx), "ops": ops,
+                "partition_hash": partition_hash})
+            reply = self._await(rid)
+            if reply is None:
+                # a LOST REPLY is ambiguous: the write may have committed.
+                # Retrying a put/remove is idempotent; retrying incr/cas/
+                # cam would double-apply — surface the timeout instead
+                # (the reference client does the same for atomic ops)
+                if not retry_safe:
+                    raise PegasusError(ErrorCode.ERR_TIMEOUT,
+                                       "atomic write reply lost")
+                last_err = int(ErrorCode.ERR_TIMEOUT)
+                continue
+            if reply["err"] in _RETRYABLE:
+                last_err = reply["err"]
+                continue
+            if reply["err"] != _OK:
+                raise PegasusError(ErrorCode(reply["err"]), "write")
+            return reply["results"]
+        raise PegasusError(ErrorCode(last_err), "write exhausted retries")
+
+    # ---- single-record ops --------------------------------------------
+
+    def set(self, hash_key: bytes, sort_key: bytes, value: bytes,
+            ttl_seconds: int = 0) -> int:
+        from pegasus_tpu.base.value_schema import expire_ts_from_ttl
+
+        ph = key_hash_parts(hash_key, sort_key)
+        key = generate_key(hash_key, sort_key)
+        results = self._write(
+            [(OP_PUT, (key, value, expire_ts_from_ttl(ttl_seconds)))], ph)
+        return results[0]
+
+    def get(self, hash_key: bytes, sort_key: bytes) -> Tuple[int, bytes]:
+        ph = key_hash_parts(hash_key, sort_key)
+        return self._read("get", generate_key(hash_key, sort_key), -1, ph)
+
+    def delete(self, hash_key: bytes, sort_key: bytes) -> int:
+        ph = key_hash_parts(hash_key, sort_key)
+        results = self._write(
+            [(OP_REMOVE, (generate_key(hash_key, sort_key),))], ph)
+        return results[0]
+
+    def exist(self, hash_key: bytes, sort_key: bytes) -> bool:
+        return self.get(hash_key, sort_key)[0] == int(StorageStatus.OK)
+
+    def ttl(self, hash_key: bytes, sort_key: bytes) -> Tuple[int, int]:
+        ph = key_hash_parts(hash_key, sort_key)
+        return self._read("ttl", generate_key(hash_key, sort_key), -1, ph)
+
+    def incr(self, hash_key: bytes, sort_key: bytes, increment: int,
+             ttl_seconds: int = 0):
+        ph = key_hash_parts(hash_key, sort_key)
+        req = IncrRequest(generate_key(hash_key, sort_key), increment,
+                          ttl_seconds)
+        return self._write([(OP_INCR, req)], ph)[0]
+
+    # ---- multi ops ----------------------------------------------------
+
+    def multi_set(self, hash_key: bytes, kvs, ttl_seconds: int = 0) -> int:
+        if not hash_key:
+            return int(StorageStatus.INVALID_ARGUMENT)
+        items = kvs.items() if isinstance(kvs, dict) else kvs
+        req = MultiPutRequest(hash_key,
+                              [KeyValue(k, v) for k, v in items],
+                              ttl_seconds)
+        return self._write([(OP_MULTI_PUT, req)],
+                           key_hash_parts(hash_key))[0]
+
+    def multi_get(self, hash_key: bytes,
+                  sort_keys: Optional[Sequence[bytes]] = None,
+                  **kwargs) -> Tuple[int, Dict[bytes, bytes]]:
+        if not hash_key:
+            return int(StorageStatus.INVALID_ARGUMENT), {}
+        req = MultiGetRequest(hash_key, sort_keys=list(sort_keys or []),
+                              **kwargs)
+        resp = self._read("multi_get", req, -1, key_hash_parts(hash_key))
+        return resp.error, {kv.key: kv.value for kv in resp.kvs}
+
+    def multi_del(self, hash_key: bytes, sort_keys: Sequence[bytes]
+                  ) -> Tuple[int, int]:
+        if not hash_key:
+            return int(StorageStatus.INVALID_ARGUMENT), 0
+        req = MultiRemoveRequest(hash_key, list(sort_keys))
+        return self._write([(OP_MULTI_REMOVE, req)],
+                           key_hash_parts(hash_key))[0]
+
+    def sortkey_count(self, hash_key: bytes) -> Tuple[int, int]:
+        if not hash_key:
+            return int(StorageStatus.INVALID_ARGUMENT), 0
+        return self._read("sortkey_count", hash_key, -1,
+                          key_hash_parts(hash_key))
+
+    def batch_get(self, keys: Sequence[Tuple[bytes, bytes]]
+                  ) -> Tuple[int, List[Tuple[bytes, bytes, bytes]]]:
+        self._ensure_config()
+        for attempt in range(self._max_retries):
+            if attempt:
+                self.refresh_config()
+            # regroup under the CURRENT partition count each attempt — a
+            # split between attempts changes every key's pidx
+            by_pidx: Dict[int, List[FullKey]] = {}
+            for hk, sk in keys:
+                pidx = key_hash_parts(hk, sk) % self.partition_count
+                by_pidx.setdefault(pidx, []).append(FullKey(hk, sk))
+            out: List[Tuple[bytes, bytes, bytes]] = []
+            stale = False
+            for pidx, fks in by_pidx.items():
+                try:
+                    resp = self._read("batch_get", BatchGetRequest(fks),
+                                      pidx)
+                except PegasusError as e:
+                    if int(e.code) in _RETRYABLE:
+                        stale = True
+                        break
+                    raise
+                if resp.error == int(
+                        ErrorCode.ERR_PARENT_PARTITION_MISUSED):
+                    stale = True
+                    break
+                if resp.error != int(StorageStatus.OK):
+                    return resp.error, []
+                out.extend((d.hash_key, d.sort_key, d.value)
+                           for d in resp.data)
+            if not stale:
+                return int(StorageStatus.OK), out
+        raise PegasusError(ErrorCode.ERR_TIMEOUT,
+                           "batch_get exhausted retries")
+
+    def check_and_set(self, hash_key: bytes, check_sort_key: bytes,
+                      check_type: int, check_operand: bytes,
+                      set_sort_key: bytes, set_value: bytes,
+                      ttl_seconds: int = 0,
+                      return_check_value: bool = False
+                      ) -> CheckAndSetResponse:
+        if not hash_key:
+            resp = CheckAndSetResponse()
+            resp.error = int(StorageStatus.INVALID_ARGUMENT)
+            return resp
+        req = CheckAndSetRequest(
+            hash_key, check_sort_key, check_type, check_operand,
+            set_diff_sort_key=(set_sort_key != check_sort_key),
+            set_sort_key=set_sort_key, set_value=set_value,
+            set_expire_ts_seconds=ttl_seconds,
+            return_check_value=return_check_value)
+        return self._write([(OP_CAS, req)], key_hash_parts(hash_key))[0]
+
+    def check_and_mutate(self, hash_key: bytes, check_sort_key: bytes,
+                         check_type: int, check_operand: bytes,
+                         mutates: Sequence[Mutate],
+                         return_check_value: bool = False
+                         ) -> CheckAndMutateResponse:
+        if not hash_key:
+            resp = CheckAndMutateResponse()
+            resp.error = int(StorageStatus.INVALID_ARGUMENT)
+            return resp
+        req = CheckAndMutateRequest(
+            hash_key, check_sort_key, check_type, check_operand,
+            mutate_list=list(mutates),
+            return_check_value=return_check_value)
+        return self._write([(OP_CAM, req)], key_hash_parts(hash_key))[0]
+
+    # ---- scanners ------------------------------------------------------
+
+    def get_scanner(self, hash_key: bytes, start_sortkey: bytes = b"",
+                    stop_sortkey: bytes = b"",
+                    options: Optional[ScanOptions] = None
+                    ) -> "ClusterScanner":
+        from dataclasses import replace
+
+        from pegasus_tpu.base.key_schema import generate_next_bytes
+
+        if not hash_key:
+            raise ValueError("hash key cannot be empty when scan")
+        self._ensure_config()
+        opts = options or ScanOptions()
+        start_key = generate_key(hash_key, start_sortkey)
+        if stop_sortkey:
+            stop_key = generate_key(hash_key, stop_sortkey)
+        else:
+            stop_key = generate_next_bytes(hash_key)
+            opts = replace(opts, stop_inclusive=False)
+        req = self._make_scan_request(start_key, stop_key, opts)
+        pidx = key_hash_parts(hash_key) % self.partition_count
+        return ClusterScanner(self, [pidx], req)
+
+    def get_unordered_scanners(self, max_split_count: int,
+                               options: Optional[ScanOptions] = None
+                               ) -> List["ClusterScanner"]:
+        if max_split_count < 1:
+            raise ValueError("max_split_count must be >= 1")
+        self._ensure_config()
+        opts = options or ScanOptions()
+        req = self._make_scan_request(b"", b"", opts, full_scan=True)
+        split = min(max_split_count, self.partition_count)
+        groups: List[List[int]] = [[] for _ in range(split)]
+        for pidx in range(self.partition_count):
+            groups[pidx % split].append(pidx)
+        return [ClusterScanner(self, g, req) for g in groups if g]
+
+    @staticmethod
+    def _make_scan_request(start_key: bytes, stop_key: bytes,
+                           opts: ScanOptions,
+                           full_scan: bool = False) -> GetScannerRequest:
+        return GetScannerRequest(
+            start_key=start_key, stop_key=stop_key,
+            start_inclusive=opts.start_inclusive,
+            stop_inclusive=opts.stop_inclusive,
+            batch_size=opts.batch_size,
+            hash_key_filter_type=opts.hash_key_filter_type,
+            hash_key_filter_pattern=opts.hash_key_filter_pattern,
+            sort_key_filter_type=opts.sort_key_filter_type,
+            sort_key_filter_pattern=opts.sort_key_filter_pattern,
+            no_value=opts.no_value,
+            return_expire_ts=opts.return_expire_ts,
+            only_return_count=opts.only_return_count,
+            full_scan=full_scan,
+            validate_partition_hash=True)
+
+
+class ClusterScanner:
+    """Pages scan contexts over the cluster read path (parity:
+    pegasus_scanner_impl paging via RPC_RRDB_RRDB_SCAN)."""
+
+    def __init__(self, client: ClusterClient, pidxs: List[int],
+                 request: GetScannerRequest) -> None:
+        self._client = client
+        self._pidxs = list(pidxs)
+        self._request = request
+        self._i = 0
+        self._context_id: Optional[int] = None
+        self._buffer: List[KeyValue] = []
+        self._pos = 0
+        self._last_key: Optional[bytes] = None
+        self.kv_count = 0
+
+    def __iter__(self) -> Iterator[Tuple[bytes, bytes, bytes]]:
+        return self
+
+    def __next__(self) -> Tuple[bytes, bytes, bytes]:
+        while True:
+            if self._pos < len(self._buffer):
+                kv = self._buffer[self._pos]
+                self._pos += 1
+                self._last_key = kv.key
+                hk, sk = restore_key(kv.key)
+                return hk, sk, kv.value
+            if not self._fetch(self._request):
+                raise StopIteration
+
+    def _fetch(self, base_req: GetScannerRequest) -> bool:
+        from dataclasses import replace
+
+        while self._i < len(self._pidxs):
+            pidx = self._pidxs[self._i]
+            if self._context_id is None:
+                resp = self._client._read("get_scanner", base_req, pidx)
+            else:
+                resp = self._client._read("scan", self._context_id, pidx)
+                if resp.context_id == SCAN_CONTEXT_ID_NOT_EXIST:
+                    # context expired server-side (or moved with a
+                    # failover): restart past the last served key
+                    self._context_id = None
+                    restart = base_req
+                    if self._last_key is not None:
+                        restart = replace(base_req,
+                                          start_key=self._last_key + b"\x00",
+                                          start_inclusive=True)
+                    resp = self._client._read("get_scanner", restart, pidx)
+            if resp.error != int(StorageStatus.OK):
+                raise RuntimeError(f"scan failed: error {resp.error}")
+            if resp.kv_count >= 0:
+                self.kv_count += resp.kv_count
+            self._buffer = resp.kvs
+            self._pos = 0
+            if resp.context_id == SCAN_CONTEXT_ID_COMPLETED:
+                self._i += 1
+                self._context_id = None
+            else:
+                self._context_id = resp.context_id
+            if self._buffer:
+                return True
+        return False
+
+    def close(self) -> None:
+        if self._context_id is not None and self._i < len(self._pidxs):
+            try:
+                self._client._read("clear_scanner", self._context_id,
+                                   self._pidxs[self._i])
+            except PegasusError:
+                pass
+            self._context_id = None

@@ -66,6 +66,26 @@ class MetaService:
             self._on_replication_error(tuple(payload["gpid"]),
                                        payload["member"])
             return
+        if msg_type == "query_config":
+            # client partition-config resolution (parity: RPC_CM_QUERY_
+            # PARTITION_CONFIG_BY_INDEX, the miss path of the client
+            # resolver — partition_resolver.h:122)
+            rid = payload.get("rid")
+            try:
+                app_id, count, configs = self.query_config(
+                    payload["app_name"])
+                reply = {
+                    "rid": rid, "err": int(ErrorCode.ERR_OK),
+                    "app_id": app_id, "partition_count": count,
+                    "configs": [{"ballot": pc.ballot, "primary": pc.primary,
+                                 "secondaries": list(pc.secondaries)}
+                                for pc in configs],
+                }
+            except PegasusError as e:
+                reply = {"rid": rid, "err": int(e.code), "app_id": 0,
+                         "partition_count": 0, "configs": []}
+            self.net.send(self.name, src, "query_config_reply", reply)
+            return
         raise ValueError(f"meta: unknown message {msg_type}")
 
     def tick(self) -> None:

@@ -158,6 +158,11 @@ class ReplicaStub:
                 "rid": rid, "err": int(ErrorCode.ERR_INVALID_STATE),
                 "results": []})
             return
+        gate = r.server._hash_gate(payload.get("partition_hash"))
+        if gate:
+            self.net.send(self.name, src, "client_write_reply", {
+                "rid": rid, "err": gate, "results": []})
+            return
         ops = [WriteOp(op, req) for op, req in payload["ops"]]
 
         def reply(results) -> None:
@@ -173,24 +178,75 @@ class ReplicaStub:
                 "results": []})
 
     def _on_client_read(self, src: str, payload: dict) -> None:
+        """Dispatch a read op to the partition's storage app through the
+        replica gate (parity: replica_stub::on_client_read
+        replica_stub.cpp:1100 -> replica::on_client_read replica.cpp:386 ->
+        storage_serverlet dispatch, common/storage_serverlet.h:52).
+
+        payload: {gpid, rid, op, args, partition_hash?}; the reply carries
+        `err` (framework routing error space) and `result` (the storage
+        handler's return value — storage status codes live inside it).
+        """
         from pegasus_tpu.replica.replica import PartitionStatus
         from pegasus_tpu.utils.errors import ErrorCode
 
         gpid = tuple(payload["gpid"])
         rid = payload["rid"]
+        op = payload.get("op", "get")
         r = self.replicas.get(gpid)
         if (r is None or r.status != PartitionStatus.PRIMARY
                 or not self.lease_valid()):
             self.net.send(self.name, src, "client_read_reply", {
                 "rid": rid, "err": int(ErrorCode.ERR_INVALID_STATE),
-                "status": 0, "value": b""})
+                "result": None})
             return
-        # err = framework routing error; status = storage status — two
-        # different code spaces (dsn::error_code vs rocksdb::Status)
-        status, value = r.server.on_get(payload["key"])
+        ph = payload.get("partition_hash")
+        args = payload.get("args")
+        srv = r.server
+        # split staleness gate for EVERY read op (scanner paging ops carry
+        # ph=None — their context was validated at get_scanner time)
+        gate = srv._hash_gate(ph)
+        if gate:
+            self.net.send(self.name, src, "client_read_reply", {
+                "rid": rid, "err": gate, "result": None})
+            return
+        try:
+            if op == "get":
+                result = srv.on_get(args, partition_hash=ph)
+            elif op == "ttl":
+                result = srv.on_ttl(args, partition_hash=ph)
+            elif op == "multi_get":
+                result = srv.on_multi_get(args)
+            elif op == "batch_get":
+                result = srv.on_batch_get(args)
+            elif op == "sortkey_count":
+                result = srv.on_sortkey_count(args)
+            elif op == "get_scanner":
+                result = srv.on_get_scanner(args)
+            elif op == "scan":
+                result = srv.on_scan(args)
+            elif op == "clear_scanner":
+                result = srv.on_clear_scanner(args)
+            else:
+                self.net.send(self.name, src, "client_read_reply", {
+                    "rid": rid,
+                    "err": int(ErrorCode.ERR_HANDLER_NOT_FOUND),
+                    "result": None})
+                return
+        except ValueError:
+            # bad request arguments: permanent, NOT retryable — the client
+            # must surface it, not burn retries refreshing its config
+            self.net.send(self.name, src, "client_read_reply", {
+                "rid": rid, "err": int(ErrorCode.ERR_INVALID_PARAMETERS),
+                "result": None})
+            return
+        except RuntimeError:
+            self.net.send(self.name, src, "client_read_reply", {
+                "rid": rid, "err": int(ErrorCode.ERR_INVALID_STATE),
+                "result": None})
+            return
         self.net.send(self.name, src, "client_read_reply", {
-            "rid": rid, "err": int(ErrorCode.ERR_OK),
-            "status": status, "value": value})
+            "rid": rid, "err": int(ErrorCode.ERR_OK), "result": result})
 
     def _on_config_proposal(self, src: str, payload: dict) -> None:
         """Meta assigns a configuration (parity: on_config_proposal,

@@ -382,6 +382,19 @@ class PartitionServer:
             resp = BatchGetResponse()
             resp.error = gate
             return resp
+        if self.validate_partition_hash:
+            # per-key staleness gate: a client that grouped this batch
+            # under a pre-split partition count must be told to re-resolve
+            # (missing-with-OK would silently hide moved keys)
+            from pegasus_tpu.base.key_schema import key_hash_parts
+
+            for fk in req.keys:
+                h = key_hash_parts(fk.hash_key, fk.sort_key)
+                if (h & self.partition_version) != self.pidx:
+                    resp = BatchGetResponse()
+                    resp.error = int(
+                        ErrorCode.ERR_PARENT_PARTITION_MISUSED)
+                    return resp
         now = epoch_now()
         resp = BatchGetResponse()
         size = 0

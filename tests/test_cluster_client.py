@@ -1,0 +1,155 @@
+"""Unified-stack tests: client → meta resolution → replica gates.
+
+Parity targets: partition_resolver_simple.h:56 (hash → cached config →
+primary, refresh on error), replica_stub.cpp:1100 (read dispatch through
+the replica gate), and the kill-test harness's acked-write durability
+invariant (src/test/kill_test/data_verifier.cpp).
+"""
+
+import pytest
+
+from pegasus_tpu.tools.cluster import SimCluster
+from pegasus_tpu.utils.errors import StorageStatus
+
+OK = int(StorageStatus.OK)
+NOT_FOUND = int(StorageStatus.NOT_FOUND)
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    c = SimCluster(str(tmp_path / "cluster"), n_nodes=4)
+    yield c
+    c.close()
+
+
+def test_client_resolves_through_meta(cluster):
+    cluster.create_table("t", partition_count=8)
+    client = cluster.client("t")
+    assert client.set(b"hk", b"sk", b"v") == OK
+    assert client.app_id is not None and client.partition_count == 8
+    assert client.get(b"hk", b"sk") == (OK, b"v")
+    assert client.get(b"hk", b"nope") == (NOT_FOUND, b"")
+
+
+def test_full_api_over_cluster(cluster):
+    cluster.create_table("api", partition_count=4)
+    c = cluster.client("api")
+    # spread across partitions
+    for i in range(40):
+        assert c.set(b"u%03d" % i, b"s", b"v%d" % i) == OK
+    for i in range(40):
+        assert c.get(b"u%03d" % i, b"s") == (OK, b"v%d" % i)
+    # multi ops
+    assert c.multi_set(b"mh", {b"a": b"1", b"b": b"2"}) == OK
+    err, kvs = c.multi_get(b"mh")
+    assert err == OK and kvs == {b"a": b"1", b"b": b"2"}
+    err, n = c.multi_del(b"mh", [b"a"])
+    assert (err, n) == (OK, 1)
+    assert c.sortkey_count(b"mh") == (OK, 1)
+    # ttl
+    assert c.set(b"th", b"ts", b"tv", ttl_seconds=5000) == OK
+    err, ttl = c.ttl(b"th", b"ts")
+    assert err == OK and 4000 < ttl <= 5000
+    # incr
+    resp = c.incr(b"ih", b"is", 5)
+    assert resp.error == OK and resp.new_value == 5
+    # batch_get across partitions
+    err, rows = c.batch_get([(b"u%03d" % i, b"s") for i in range(10)])
+    assert err == OK and len(rows) == 10
+    # delete
+    assert c.delete(b"u000", b"s") == OK
+    assert not c.exist(b"u000", b"s")
+
+
+def test_scanners_over_cluster(cluster):
+    cluster.create_table("scan", partition_count=4)
+    c = cluster.client("scan")
+    for i in range(30):
+        c.set(b"sc%02d" % (i % 3), b"k%03d" % i, b"v%d" % i)
+    # hashkey-scoped ordered scan
+    got = [(sk, v) for _hk, sk, v in c.get_scanner(b"sc00")]
+    assert got == [(b"k%03d" % i, b"v%d" % i) for i in range(0, 30, 3)]
+    # full-table fan-out
+    seen = set()
+    for sc in c.get_unordered_scanners(3):
+        for hk, sk, v in sc:
+            seen.add((hk, sk))
+    assert len(seen) == 30
+
+
+def test_writes_replicate_through_2pc(cluster):
+    """The served path is the REPLICATED path: an acked write is on every
+    member, not just the primary."""
+    app_id = cluster.create_table("rep", partition_count=2)
+    c = cluster.client("rep")
+    assert c.set(b"rk", b"rs", b"rv") == OK
+    cluster.step()
+    from pegasus_tpu.base.key_schema import generate_key, key_hash_parts
+
+    pidx = key_hash_parts(b"rk", b"rs") % 2
+    pc = cluster.meta.state.get_partition(app_id, pidx)
+    assert len(pc.members()) == 3
+    for node in pc.members():
+        r = cluster.stubs[node].get_replica((app_id, pidx))
+        assert r.server.on_get(generate_key(b"rk", b"rs")) == (OK, b"rv")
+
+
+def test_failover_mid_workload_keeps_acked_writes(cluster):
+    """Kill a primary mid-stream: every OK-acked write must remain
+    readable after the guardian cures the partitions (VERDICT item 3
+    done-condition; parity: kill_test data_verifier)."""
+    app_id = cluster.create_table("fo", partition_count=4)
+    c = cluster.client("fo")
+    acked = []
+    for i in range(40):
+        if c.set(b"f%03d" % i, b"s", b"v%d" % i) == OK:
+            acked.append(i)
+    assert len(acked) == 40
+    victim = cluster.meta.state.get_partition(app_id, 0).primary
+    cluster.kill(victim)
+    # clients keep working THROUGH the failover: retries pump sim time,
+    # FD declares the node dead, guardian promotes secondaries
+    for i in range(40, 60):
+        if c.set(b"f%03d" % i, b"s", b"v%d" % i) == OK:
+            acked.append(i)
+    for i in acked:
+        assert c.get(b"f%03d" % i, b"s") == (OK, b"v%d" % i), i
+    # the cured configs exclude the dead node
+    for pidx in range(4):
+        pc = cluster.meta.state.get_partition(app_id, pidx)
+        assert victim not in pc.members()
+        assert pc.primary
+
+
+def test_config_refresh_after_primary_move(cluster):
+    """A client holding a stale config must transparently re-resolve
+    (parity: partition_resolver refresh on ERR_INVALID_STATE)."""
+    app_id = cluster.create_table("mv", partition_count=2)
+    c = cluster.client("mv")
+    assert c.set(b"a", b"b", b"c") == OK
+    # force new primaries via rebalance-style config churn: kill current
+    # primary of partition 0
+    old = cluster.meta.state.get_partition(app_id, 0).primary
+    cluster.kill(old)
+    cluster.step(rounds=8)
+    # stale cache in c still names `old`; ops must succeed anyway
+    assert c.set(b"a2", b"b2", b"c2") == OK
+    assert c.get(b"a", b"b") == (OK, b"c")
+
+
+def test_read_your_writes_after_failover(cluster):
+    cluster.create_table("ryw", partition_count=2, replica_count=3)
+    c = cluster.client("ryw")
+    for i in range(10):
+        assert c.set(b"h", b"s%02d" % i, b"val%d" % i) == OK
+    # kill ALL the primaries of both partitions one at a time
+    killed = set()
+    for pidx in range(2):
+        p = cluster.meta.state.get_partition(c.app_id, pidx).primary
+        if p and p not in killed:
+            cluster.kill(p)
+            killed.add(p)
+    cluster.step(rounds=8)
+    err, kvs = c.multi_get(b"h")
+    assert err == OK
+    assert kvs == {b"s%02d" % i: b"val%d" % i for i in range(10)}

@@ -212,18 +212,18 @@ def test_lease_fencing_blocks_stale_primary_reads(cluster):
 
     # healthy primary serves the read
     cluster.net.send("client", old_primary, "client_read",
-                     {"gpid": (app_id, 0), "rid": 1,
-                      "key": generate_key(b"hk", b"sk")})
+                     {"gpid": (app_id, 0), "rid": 1, "op": "get",
+                      "args": generate_key(b"hk", b"sk")})
     cluster.loop.run_until_idle()
-    assert replies[-1]["err"] == 0 and replies[-1]["value"] == b"v"
+    assert replies[-1]["err"] == 0 and replies[-1]["result"] == (0, b"v")
 
     # partition the primary; its lease lapses while meta cures
     cluster.net.partition(old_primary)
     cluster.silence(old_primary)
     cluster.net.heal(old_primary)  # network back, but lease expired
     cluster.net.send("client", old_primary, "client_read",
-                     {"gpid": (app_id, 0), "rid": 2,
-                      "key": generate_key(b"hk", b"sk")})
+                     {"gpid": (app_id, 0), "rid": 2, "op": "get",
+                      "args": generate_key(b"hk", b"sk")})
     cluster.loop.run_until_idle()
     assert replies[-1]["rid"] == 2 and replies[-1]["err"] != 0
 
@@ -231,10 +231,10 @@ def test_lease_fencing_blocks_stale_primary_reads(cluster):
     pc2 = cluster.meta.state.get_partition(app_id, 0)
     assert pc2.primary != old_primary
     cluster.net.send("client", pc2.primary, "client_read",
-                     {"gpid": (app_id, 0), "rid": 3,
-                      "key": generate_key(b"hk", b"sk")})
+                     {"gpid": (app_id, 0), "rid": 3, "op": "get",
+                      "args": generate_key(b"hk", b"sk")})
     cluster.loop.run_until_idle()
-    assert replies[-1]["err"] == 0 and replies[-1]["value"] == b"v"
+    assert replies[-1]["err"] == 0 and replies[-1]["result"] == (0, b"v")
 
 
 def test_client_write_path_over_network(cluster):
