@@ -66,6 +66,12 @@ class MetaService:
             self._on_replication_error(tuple(payload["gpid"]),
                                        payload["member"])
             return
+        if msg_type == "config_sync":
+            self._on_config_sync(src, payload)
+            return
+        if msg_type == "admin":
+            self._on_admin(src, payload)
+            return
         if msg_type == "query_config":
             # client partition-config resolution (parity: RPC_CM_QUERY_
             # PARTITION_CONFIG_BY_INDEX, the miss path of the client
@@ -93,6 +99,86 @@ class MetaService:
         timer and partition-guardian scans)."""
         self.fd.check(self.clock())
         self._guardian_pass()
+
+    def _on_admin(self, src: str, payload: dict) -> None:
+        """Networked DDL/admin surface (parity: the meta admin RPC table,
+        meta_service.cpp:480-571 — create/drop/recall app, envs, balancer
+        — invoked by shell/admin clients over the wire)."""
+        rid = payload.get("rid")
+        cmd = payload.get("cmd")
+        args = payload.get("args") or {}
+        try:
+            if cmd == "create_app":
+                result = self.create_app(
+                    args["app_name"], args["partition_count"],
+                    args.get("replica_count", 3), args.get("envs"))
+            elif cmd == "drop_app":
+                result = self.drop_app(args["app_name"])
+            elif cmd == "recall_app":
+                result = self.recall_app(args["app_name"])
+            elif cmd == "list_apps":
+                result = [{"app_id": a.app_id, "app_name": a.app_name,
+                           "partition_count": a.partition_count,
+                           "envs": dict(a.envs),
+                           "replica_count": a.max_replica_count}
+                          for a in self.list_apps()]
+            elif cmd == "update_app_envs":
+                result = self.update_app_envs(args["app_name"],
+                                              args["envs"])
+            elif cmd == "rebalance":
+                result = len(self.rebalance())
+            elif cmd == "list_nodes":
+                result = self.fd.alive_workers()
+            else:
+                self.net.send(self.name, src, "admin_reply", {
+                    "rid": rid,
+                    "err": int(ErrorCode.ERR_HANDLER_NOT_FOUND),
+                    "result": None})
+                return
+        except PegasusError as e:
+            self.net.send(self.name, src, "admin_reply", {
+                "rid": rid, "err": int(e.code), "result": str(e)})
+            return
+        except (KeyError, TypeError) as e:
+            # malformed request: reply immediately instead of letting the
+            # client burn its full timeout waiting for nothing
+            self.net.send(self.name, src, "admin_reply", {
+                "rid": rid, "err": int(ErrorCode.ERR_INVALID_PARAMETERS),
+                "result": f"bad admin args: {e}"})
+            return
+        self.net.send(self.name, src, "admin_reply", {
+            "rid": rid, "err": int(ErrorCode.ERR_OK), "result": result})
+
+    def _on_config_sync(self, src: str, payload: dict) -> None:
+        """Pull-reconciliation (parity: on_query_configuration_by_node,
+        meta_service.cpp:793 + meta_admin.thrift:103-115): reply with the
+        node's authoritative partition configs and the stored replicas it
+        should delete. GC is deliberately conservative: only replicas of
+        apps that no longer exist anywhere (fully gone, not in the
+        dropped-recall window) are listed — a replica missing from its
+        partition's member list may be an in-flight learner."""
+        node = payload["node"]
+        configs = []
+        for app in self.list_apps():
+            for pidx in range(app.partition_count):
+                pc = self.state.get_partition(app.app_id, pidx)
+                if node in pc.members():
+                    configs.append({
+                        "gpid": (app.app_id, pidx), "ballot": pc.ballot,
+                        "primary": pc.primary,
+                        "secondaries": list(pc.secondaries),
+                        "partition_count": app.partition_count,
+                        "envs": dict(app.envs),
+                    })
+        gc = []
+        for entry in payload.get("stored", []):
+            app_id = tuple(entry["gpid"])[0]
+            # dropped apps stay in state (recall window) — only replicas
+            # of apps unknown to meta entirely are garbage
+            if app_id not in self.state.apps:
+                gc.append(tuple(entry["gpid"]))
+        self.net.send(self.name, src, "config_sync_reply", {
+            "configs": configs, "gc": gc})
 
     # ---- DDL surface (parity: meta_service.cpp:480-571) ---------------
 

@@ -124,6 +124,9 @@ class ReplicaStub:
         if msg_type == "beacon_ack":
             self._last_beacon_ack = self.sim_clock()
             return
+        if msg_type == "config_sync_reply":
+            self._on_config_sync_reply(src, payload)
+            return
         if msg_type == "client_write":
             self._on_client_write(src, payload)
             return
@@ -282,6 +285,41 @@ class ReplicaStub:
         if self.meta_addr is not None:
             self.net.send(self.name, self.meta_addr, "replication_error", {
                 "gpid": gpid, "member": member})
+
+    # ---- config sync (parity: the pull-reconciliation protocol —
+    # replica_stub.cpp:944-954 query_configuration_by_node,
+    # idl/meta_admin.thrift:103-115 stored_replicas/gc_replicas,
+    # meta/meta_service.cpp:793) ----------------------------------------
+
+    def config_sync(self) -> None:
+        """Timer: report stored replicas; meta replies with this node's
+        authoritative configs plus replicas to garbage-collect. Pull-based
+        reconciliation is how replicas converge after meta-side
+        reconfiguration that happened while this node was unreachable."""
+        if self.meta_addr is None:
+            return
+        stored = [{"gpid": gpid, "ballot": r.config.ballot,
+                   "partition_count": r.server.partition_count}
+                  for gpid, r in self.replicas.items()]
+        self.net.send(self.name, self.meta_addr, "config_sync", {
+            "node": self.name, "stored": stored})
+
+    def _on_config_sync_reply(self, src: str, payload: dict) -> None:
+        import shutil
+
+        for entry in payload["configs"]:
+            gpid = tuple(entry["gpid"])
+            r = self._open_replica(gpid, entry["partition_count"])
+            r.assign_config(ReplicaConfig(entry["ballot"], entry["primary"],
+                                          list(entry["secondaries"])))
+            if entry.get("envs"):
+                r.server.update_app_envs(entry["envs"])
+        for gpid in payload.get("gc", []):
+            gpid = tuple(gpid)
+            r = self.replicas.pop(gpid, None)
+            if r is not None:
+                r.close()
+                shutil.rmtree(self._replica_dir(gpid), ignore_errors=True)
 
     # ---- failure detector (worker side) -------------------------------
 

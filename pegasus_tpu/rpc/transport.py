@@ -1,0 +1,243 @@
+"""TCP transport: the real inter-node network layer.
+
+Parity: the reference's network provider (src/rpc/asio_net_provider.*,
+rpc_engine.h:146) — every node listens on one port, outbound connections
+are cached per peer, replies to non-listening peers (clients) ride the
+inbound connection they arrived on, and messages are framed binary
+(rpc/message.py, the rpc_message.h analogue). Same interface as the
+deterministic SimNetwork (`register`/`send`), so MetaService /
+ReplicaStub / ClusterClient run unchanged over either.
+
+Threading model (replaces rDSN's task engine for this path):
+- one accept thread; one reader thread per inbound connection;
+- ONE dispatcher thread delivers every inbound message serially under
+  `self.lock` — preserving the single-threaded access the replica state
+  machine asserts (the reference pins a replica's work to one thread by
+  gpid thread-hash, task_engine.h:53);
+- timer callbacks (beacons, group checks, config-sync) must take the
+  same lock; `run_timer` does.
+
+Loss semantics match SimNetwork: a send to an unreachable peer is
+dropped (the 2PC/FD/learning protocols already tolerate loss and the
+client retries) — no backpressure, no delivery guarantee beyond TCP's
+per-connection FIFO.
+"""
+
+from __future__ import annotations
+
+import queue
+import socket
+import threading
+import time
+from typing import Any, Callable, Dict, Optional, Tuple
+
+from pegasus_tpu.rpc.message import decode_message, encode_message, read_frames
+
+Addr = Tuple[str, int]
+
+
+class TcpTransport:
+    def __init__(self, listen: Optional[Addr],
+                 address_book: Dict[str, Addr]) -> None:
+        """`listen`: (host, port) to serve on, or None for a client-only
+        transport. `address_book`: name -> (host, port) for every peer
+        this node may dial (the static onebox topology; a dns_resolver
+        analogue can replace it later). Peers NOT in the book (clients)
+        are reachable once they have dialed us — replies use the learned
+        inbound route."""
+        self.address_book = dict(address_book)
+        self.lock = threading.RLock()  # node-wide handler serialization
+        self._handlers: Dict[str, Callable[[str, str, Any], None]] = {}
+        # name -> (socket, write-lock); outbound dials and learned inbound
+        # routes share this table (latest wins — a reconnecting peer's new
+        # connection replaces the dead one)
+        self._routes: Dict[str, Tuple[socket.socket, threading.Lock]] = {}
+        self._routes_lock = threading.Lock()
+        self._inbox: "queue.Queue[Optional[tuple]]" = queue.Queue()
+        # outbound frames are written by a dedicated sender thread: the
+        # senders (dispatcher, timers) hold the node lock, and a blocking
+        # dial/write there would stall every handler and timer on the node
+        self._outbox: "queue.Queue[Optional[Tuple[str, bytes]]]" = (
+            queue.Queue())
+        self._closing = False
+        self._threads: list = []
+        self._listener: Optional[socket.socket] = None
+        self.listen_addr: Optional[Addr] = None
+        if listen is not None:
+            srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            srv.bind(listen)
+            srv.listen(64)
+            self._listener = srv
+            self.listen_addr = srv.getsockname()
+            self._spawn(self._accept_loop)
+        self._spawn(self._dispatch_loop)
+        self._spawn(self._send_loop)
+
+    def _spawn(self, fn, *args) -> None:
+        t = threading.Thread(target=fn, args=args, daemon=True)
+        t.start()
+        self._threads.append(t)
+
+    # ---- public interface (SimNetwork-compatible) ----------------------
+
+    def register(self, addr: str,
+                 handler: Callable[[str, str, Any], None]) -> None:
+        self._handlers[addr] = handler
+
+    def send(self, src: str, dst: str, msg_type: str, payload: Any) -> None:
+        if dst in self._handlers:
+            # loopback: still through the inbox so delivery stays serial
+            self._inbox.put((src, dst, msg_type, payload))
+            return
+        # encode HERE so an unencodable payload raises at the caller (a
+        # programming error, not network loss); network IO happens on the
+        # sender thread so a dead peer never stalls handlers or timers
+        frame = encode_message(src, dst, msg_type, payload)
+        self._outbox.put((dst, frame))
+
+    def _send_loop(self) -> None:
+        while True:
+            item = self._outbox.get()
+            if item is None:
+                return
+            dst, frame = item
+            try:
+                sock, wlock = self._route(dst)
+                with wlock:
+                    sock.sendall(frame)
+            except OSError:
+                self._drop_route(dst)  # loss; protocols retry
+
+    def close(self) -> None:
+        self._closing = True
+        self._inbox.put(None)
+        self._outbox.put(None)
+        if self._listener is not None:
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+        with self._routes_lock:
+            for sock, _ in self._routes.values():
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+            self._routes.clear()
+
+    # ---- timers --------------------------------------------------------
+
+    def run_timer(self, interval: float, fn: Callable[[], None]) -> None:
+        """Periodic callback under the node lock (parity: timer tasks)."""
+
+        def loop() -> None:
+            while not self._closing:
+                time.sleep(interval)
+                if self._closing:
+                    return
+                try:
+                    with self.lock:
+                        fn()
+                except Exception:  # noqa: BLE001 - timers must survive
+                    import traceback
+
+                    traceback.print_exc()
+
+        self._spawn(loop)
+
+    # ---- internals -----------------------------------------------------
+
+    def _route(self, dst: str) -> Tuple[socket.socket, threading.Lock]:
+        with self._routes_lock:
+            entry = self._routes.get(dst)
+            if entry is not None:
+                return entry
+        addr = self.address_book.get(dst)
+        if addr is None:
+            raise OSError(f"no route to peer {dst!r}")
+        sock = socket.create_connection(addr, timeout=2.0)
+        sock.settimeout(None)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        # our own reader on the outbound connection too: RPC replies come
+        # back on the connection the request went out on
+        self._spawn(self._read_loop, sock)
+        with self._routes_lock:
+            existing = self._routes.get(dst)
+            if existing is not None:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                return existing
+            entry = (sock, threading.Lock())
+            self._routes[dst] = entry
+            return entry
+
+    def _drop_route(self, dst: str) -> None:
+        with self._routes_lock:
+            entry = self._routes.pop(dst, None)
+        if entry is not None:
+            try:
+                entry[0].close()
+            except OSError:
+                pass
+
+    def _learn_route(self, src: str, conn: socket.socket) -> None:
+        with self._routes_lock:
+            existing = self._routes.get(src)
+            if existing is None or existing[0] is not conn:
+                self._routes[src] = (conn, threading.Lock())
+
+    def _accept_loop(self) -> None:
+        assert self._listener is not None
+        while not self._closing:
+            try:
+                conn, _peer_addr = self._listener.accept()
+            except OSError:
+                return
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._spawn(self._read_loop, conn)
+
+    def _read_loop(self, conn: socket.socket) -> None:
+        buf = bytearray()
+        while not self._closing:
+            try:
+                chunk = conn.recv(1 << 16)
+            except OSError:
+                break
+            if not chunk:
+                break
+            buf.extend(chunk)
+            try:
+                bodies = read_frames(buf)
+            except ValueError:
+                break  # corrupt stream: drop the connection
+            for body in bodies:
+                try:
+                    src, dst, msg_type, payload = decode_message(body)
+                except (ValueError, TypeError):
+                    continue
+                self._learn_route(src, conn)
+                self._inbox.put((src, dst, msg_type, payload))
+        try:
+            conn.close()
+        except OSError:
+            pass
+
+    def _dispatch_loop(self) -> None:
+        while True:
+            item = self._inbox.get()
+            if item is None:
+                return
+            src, dst, msg_type, payload = item
+            handler = self._handlers.get(dst)
+            if handler is None:
+                continue
+            try:
+                with self.lock:
+                    handler(src, msg_type, payload)
+            except Exception:  # noqa: BLE001 - a bad message must not
+                import traceback  # kill the dispatcher
+
+                traceback.print_exc()

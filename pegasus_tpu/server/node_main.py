@@ -1,0 +1,106 @@
+"""Node boot: the config-driven service host.
+
+Parity: src/server/main.cpp:34-74 + dsn_run (runtime/service_api_c.cpp:279)
+— ONE entry point; the cluster config decides whether this process runs
+the meta role or a replica role (the rDSN idea that applications are
+plugins selected by config, SURVEY §1). Timers stand in for the task
+engine's timer tasks: FD beacons, group checks, config-sync, meta ticks.
+
+Run:  python -m pegasus_tpu.server.node_main --config cluster.json --name node0
+
+cluster.json:
+    {"data_root": "/path",
+     "nodes": {"meta":  {"host": "127.0.0.1", "port": 34601, "role": "meta"},
+               "node0": {"host": "127.0.0.1", "port": 34801, "role": "replica"},
+               ...}}
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import time
+
+
+def load_config(path: str) -> dict:
+    with open(path) as f:
+        return json.load(f)
+
+
+def address_book(cfg: dict) -> dict:
+    return {name: (n["host"], n["port"])
+            for name, n in cfg["nodes"].items()}
+
+
+def run_node(cfg: dict, name: str) -> None:
+    from pegasus_tpu.rpc.transport import TcpTransport
+
+    node_cfg = cfg["nodes"][name]
+    role = node_cfg["role"]
+    data_root = cfg["data_root"]
+    book = address_book(cfg)
+    transport = TcpTransport((node_cfg["host"], node_cfg["port"]), book)
+    meta_name = next(n for n, c in cfg["nodes"].items()
+                     if c["role"] == "meta")
+
+    stop = {"flag": False}
+
+    def on_term(_sig, _frm):
+        stop["flag"] = True
+
+    signal.signal(signal.SIGTERM, on_term)
+    signal.signal(signal.SIGINT, on_term)
+
+    if role == "meta":
+        from pegasus_tpu.meta.meta_service import MetaService
+
+        svc = MetaService(name, os.path.join(data_root, name), transport,
+                          clock=time.monotonic)
+        transport.run_timer(1.0, svc.tick)
+        print(f"[{name}] meta serving on {node_cfg['host']}:"
+              f"{node_cfg['port']}", flush=True)
+    elif role == "replica":
+        from pegasus_tpu.replica.replica import PartitionStatus
+        from pegasus_tpu.replica.stub import ReplicaStub
+
+        stub = ReplicaStub(name, os.path.join(data_root, name), transport,
+                           clock=time.time, sim_clock=time.monotonic)
+        stub.meta_addr = meta_name
+        transport.run_timer(1.0, stub.send_beacon)
+        transport.run_timer(2.5, stub.config_sync)
+
+        def group_checks() -> None:
+            for r in stub.replicas.values():
+                if r.status == PartitionStatus.PRIMARY:
+                    r.broadcast_group_check()
+
+        transport.run_timer(1.0, group_checks)
+        print(f"[{name}] replica serving on {node_cfg['host']}:"
+              f"{node_cfg['port']}", flush=True)
+    else:
+        raise SystemExit(f"unknown role {role!r} for {name}")
+
+    try:
+        while not stop["flag"]:
+            time.sleep(0.2)
+    finally:
+        transport.close()
+        if role == "replica":
+            stub.close()
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--config", required=True)
+    ap.add_argument("--name", required=True)
+    args = ap.parse_args()
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))))
+    run_node(load_config(args.config), args.name)
+
+
+if __name__ == "__main__":
+    main()

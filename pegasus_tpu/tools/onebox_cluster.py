@@ -1,0 +1,219 @@
+"""Multi-process onebox: 1 meta + N replica server PROCESSES on one box.
+
+Parity: the reference onebox (run.sh:60-66 start_onebox — real meta and
+replica-server processes on one machine, the target of all function
+tests). `start()` writes the cluster topology, spawns node processes via
+`python -m pegasus_tpu.server.node_main`, and waits for liveness;
+`connect()`/`admin()` return wire clients; `stop()` tears down.
+
+CLI:
+    python -m pegasus_tpu.tools.onebox_cluster start  [--dir D] [--nodes 3]
+    python -m pegasus_tpu.tools.onebox_cluster status [--dir D]
+    python -m pegasus_tpu.tools.onebox_cluster stop   [--dir D]
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+from typing import Dict, List, Optional
+
+from pegasus_tpu.utils.errors import ErrorCode, PegasusError
+
+DEFAULT_DIR = "/tmp/pegasus_tpu_onebox"
+_REPO_ROOT = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _cluster_paths(directory: str) -> Dict[str, str]:
+    return {"config": os.path.join(directory, "cluster.json"),
+            "pids": os.path.join(directory, "pids.json"),
+            "logs": os.path.join(directory, "logs")}
+
+
+def start(directory: str = DEFAULT_DIR, n_replica: int = 3) -> dict:
+    paths = _cluster_paths(directory)
+    os.makedirs(paths["logs"], exist_ok=True)
+    nodes = {"meta": {"host": "127.0.0.1", "port": _free_port(),
+                      "role": "meta"}}
+    for i in range(n_replica):
+        nodes[f"node{i}"] = {"host": "127.0.0.1", "port": _free_port(),
+                             "role": "replica"}
+    cfg = {"data_root": os.path.join(directory, "data"), "nodes": nodes}
+    with open(paths["config"], "w") as f:
+        json.dump(cfg, f, indent=1)
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = _REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    # server processes must never touch the accelerator tunnel: they are
+    # the control/storage plane; device work happens via jax lazily only
+    # when the read path runs — force CPU for the onebox (the single-chip
+    # bench uses the in-process cluster instead)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+
+    pids = {}
+    for name in nodes:
+        log = open(os.path.join(paths["logs"], f"{name}.log"), "ab")
+        p = subprocess.Popen(
+            [sys.executable, "-m", "pegasus_tpu.server.node_main",
+             "--config", paths["config"], "--name", name],
+            stdout=log, stderr=subprocess.STDOUT, env=env,
+            cwd=_REPO_ROOT)
+        pids[name] = p.pid
+    with open(paths["pids"], "w") as f:
+        json.dump(pids, f)
+
+    # liveness: every node's port accepts within the deadline
+    deadline = time.monotonic() + 30
+    for name, n in nodes.items():
+        while True:
+            try:
+                socket.create_connection((n["host"], n["port"]),
+                                         timeout=1.0).close()
+                break
+            except OSError:
+                if time.monotonic() > deadline:
+                    raise RuntimeError(f"{name} did not come up")
+                time.sleep(0.2)
+    return cfg
+
+
+def stop(directory: str = DEFAULT_DIR) -> List[str]:
+    paths = _cluster_paths(directory)
+    stopped = []
+    if not os.path.exists(paths["pids"]):
+        return stopped
+    with open(paths["pids"]) as f:
+        pids = json.load(f)
+    for name, pid in pids.items():
+        try:
+            os.kill(pid, signal.SIGTERM)
+            stopped.append(name)
+        except ProcessLookupError:
+            pass
+    os.remove(paths["pids"])
+    return stopped
+
+
+def status(directory: str = DEFAULT_DIR) -> Dict[str, bool]:
+    paths = _cluster_paths(directory)
+    if not os.path.exists(paths["pids"]):
+        return {}
+    with open(paths["pids"]) as f:
+        pids = json.load(f)
+    out = {}
+    for name, pid in pids.items():
+        try:
+            os.kill(pid, 0)
+            out[name] = True
+        except ProcessLookupError:
+            out[name] = False
+    return out
+
+
+def kill_node(name: str, directory: str = DEFAULT_DIR) -> None:
+    """kill -9 one node (parity: the kill_test harness)."""
+    paths = _cluster_paths(directory)
+    with open(paths["pids"]) as f:
+        pids = json.load(f)
+    os.kill(pids[name], signal.SIGKILL)
+
+
+class OneboxAdmin:
+    """Wire admin client: DDL against the onebox meta."""
+
+    def __init__(self, directory: str = DEFAULT_DIR,
+                 name: str = "admin-cli") -> None:
+        from pegasus_tpu.rpc.transport import TcpTransport
+
+        paths = _cluster_paths(directory)
+        with open(paths["config"]) as f:
+            self.cfg = json.load(f)
+        book = {n: (c["host"], c["port"])
+                for n, c in self.cfg["nodes"].items()}
+        self.net = TcpTransport(None, book)
+        self.name = name
+        self._rids = itertools.count(1)
+        self._replies: Dict[int, dict] = {}
+        self.net.register(name, self._on_message)
+
+    def _on_message(self, src: str, msg_type: str, payload) -> None:
+        if msg_type == "admin_reply":
+            self._replies[payload["rid"]] = payload
+
+    def call(self, cmd: str, timeout: float = 10.0, **args):
+        rid = next(self._rids)
+        self.net.send(self.name, "meta", "admin",
+                      {"rid": rid, "cmd": cmd, "args": args})
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if rid in self._replies:
+                reply = self._replies.pop(rid)
+                if reply["err"] != int(ErrorCode.ERR_OK):
+                    raise PegasusError(ErrorCode(reply["err"]),
+                                       str(reply.get("result")))
+                return reply["result"]
+            time.sleep(0.01)
+        raise PegasusError(ErrorCode.ERR_TIMEOUT, f"admin {cmd}")
+
+    def create_table(self, app_name: str, partition_count: int = 8,
+                     replica_count: int = 3,
+                     envs: Optional[Dict[str, str]] = None) -> int:
+        return self.call("create_app", app_name=app_name,
+                         partition_count=partition_count,
+                         replica_count=replica_count, envs=envs)
+
+    def close(self) -> None:
+        self.net.close()
+
+
+def connect(app_name: str, directory: str = DEFAULT_DIR,
+            client_name: Optional[str] = None):
+    """Wire data client for a onebox table."""
+    from pegasus_tpu.client.cluster_client import ClusterClient
+    from pegasus_tpu.rpc.transport import TcpTransport
+
+    paths = _cluster_paths(directory)
+    with open(paths["config"]) as f:
+        cfg = json.load(f)
+    book = {n: (c["host"], c["port"]) for n, c in cfg["nodes"].items()}
+    net = TcpTransport(None, book)
+    return ClusterClient(
+        net, client_name or f"client-{os.getpid()}", "meta", app_name,
+        pump=lambda: time.sleep(0.01), max_retries=8, pump_rounds=400)
+
+
+def main() -> None:
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("action", choices=["start", "stop", "status"])
+    ap.add_argument("--dir", default=DEFAULT_DIR)
+    ap.add_argument("--nodes", type=int, default=3)
+    args = ap.parse_args()
+    if args.action == "start":
+        cfg = start(args.dir, args.nodes)
+        print(json.dumps(cfg["nodes"], indent=1))
+    elif args.action == "stop":
+        print("stopped:", ", ".join(stop(args.dir)) or "(nothing)")
+    else:
+        print(json.dumps(status(args.dir), indent=1))
+
+
+if __name__ == "__main__":
+    main()

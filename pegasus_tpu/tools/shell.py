@@ -30,8 +30,12 @@ def _b(s: str) -> bytes:
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(prog="pegasus-shell",
                                      description=__doc__)
-    parser.add_argument("--root", required=True,
-                        help="onebox cluster root directory")
+    parser.add_argument("--root", default=None,
+                        help="in-process onebox catalog root directory")
+    parser.add_argument("--cluster", default=None,
+                        help="multi-process onebox directory (wire mode: "
+                             "commands go over TCP through meta and the "
+                             "replica servers)")
     sub = parser.add_subparsers(dest="cmd", required=True)
 
     p = sub.add_parser("create_app")
@@ -98,17 +102,77 @@ def main(argv=None) -> int:
 
     args = parser.parse_args(argv)
 
-    from pegasus_tpu.tools.onebox import Onebox
+    if (args.root is None) == (args.cluster is None):
+        print("error: exactly one of --root / --cluster is required",
+              file=sys.stderr)
+        return 2
+    if args.cluster is not None:
+        box = _ClusterBox(args.cluster)
+    else:
+        from pegasus_tpu.tools.onebox import Onebox
 
-    box = Onebox(args.root)
+        box = Onebox(args.root)
+    from pegasus_tpu.utils.errors import PegasusError
+
     out = sys.stdout
     try:
         return _dispatch(args, box, out)
-    except (KeyError, ValueError) as exc:
+    except (KeyError, ValueError, NotImplementedError,
+            PegasusError) as exc:
         print(f"error: {exc}", file=sys.stderr)
         return 1
     finally:
         box.close()
+
+
+class _ClusterBox:
+    """Adapter: the shell's verbs over the wire clients (parity: the
+    reference shell drives ddl_client + client_lib RPCs, never local
+    state)."""
+
+    def __init__(self, directory: str) -> None:
+        from pegasus_tpu.tools.onebox_cluster import OneboxAdmin
+
+        self.directory = directory
+        self.admin = OneboxAdmin(directory)
+        self._clients = {}
+
+    def client(self, app_name: str):
+        c = self._clients.get(app_name)
+        if c is None:
+            from pegasus_tpu.tools.onebox_cluster import connect
+
+            c = connect(app_name, self.directory)
+            self._clients[app_name] = c
+        return c
+
+    def create_table(self, name: str, partition_count: int):
+        return self.admin.create_table(name, partition_count)
+
+    def drop_table(self, name: str) -> None:
+        self.admin.call("drop_app", app_name=name)
+
+    def list_tables(self):
+        return [{"app_id": a["app_id"], "name": a["app_name"],
+                 "partition_count": a["partition_count"]}
+                for a in self.admin.call("list_apps")]
+
+    def update_app_envs(self, name: str, envs) -> None:
+        self.admin.call("update_app_envs", app_name=name, envs=envs)
+
+    def open_table(self, name: str):
+        raise NotImplementedError(
+            "this command needs local table access — use --root mode, or "
+            "the admin verbs in wire mode")
+
+    def split_table(self, name: str):
+        raise NotImplementedError(
+            "online split over the wire lands with the meta split service")
+
+    def close(self) -> None:
+        for c in self._clients.values():
+            c.net.close()
+        self.admin.close()
 
 
 def _dispatch(args, box, out) -> int:
@@ -239,7 +303,7 @@ def _dispatch(args, box, out) -> int:
     elif args.cmd == "backup":
         from pegasus_tpu.server.backup import BackupEngine
         from pegasus_tpu.storage.block_service import LocalBlockService
-        t = box.open_table(args.table)
+        t = box.open_table(args.table)  # NotImplementedError in wire mode
         be = BackupEngine(LocalBlockService(args.bucket), args.policy)
         for p_ in t.all_partitions():
             be.backup_partition(args.backup_id, t.app_id, p_.pidx,
@@ -248,6 +312,9 @@ def _dispatch(args, box, out) -> int:
                          t.partition_count)
         print(f"OK: backup {args.backup_id}", file=out)
     elif args.cmd == "restore":
+        if isinstance(box, _ClusterBox):
+            raise NotImplementedError(
+                "restore needs local table access — use --root mode")
         from pegasus_tpu.server.backup import BackupEngine
         from pegasus_tpu.storage.block_service import LocalBlockService
         be = BackupEngine(LocalBlockService(args.bucket), args.policy)
