@@ -1,0 +1,125 @@
+"""Meta-side bulk-load orchestration.
+
+Parity: src/meta/meta_bulk_load_service.h:143 — the per-partition
+download→ingest state machine with rolling ingestion concurrency
+(meta_bulk_load_ingestion_context.*). The data move itself is a
+replicated OP_INGEST mutation through 2PC (replica_2pc.cpp:211-230), so
+every member ingests at the same decree; this service owns WHICH
+partitions ingest, how many at once, retries across failovers, and
+persisted progress so a meta restart resumes the load.
+
+Protocol:
+    meta  → primary : "trigger_ingest" {gpid, root, src_app}
+    primary → meta  : "ingest_done" {gpid, err}
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, Optional, Tuple
+
+from pegasus_tpu.storage.block_service import LocalBlockService
+from pegasus_tpu.utils.errors import ErrorCode, PegasusError
+
+Gpid = Tuple[int, int]
+
+
+class MetaBulkLoadService:
+    def __init__(self, meta, max_concurrent: int = 2) -> None:
+        self.meta = meta
+        self.max_concurrent = max_concurrent
+        # app_id -> {root, src_app, pending: [pidx], inflight: [pidx]}
+        self._loads: Dict[int, dict] = {}
+        self._load_state()
+
+    def _load_state(self) -> None:
+        raw = self.meta.state._storage.get("/bulk_load/inflight") or {}
+        self._loads = {int(k): v for k, v in raw.items()}
+
+    def _save(self) -> None:
+        self.meta.state._storage.set_batch({"/bulk_load/inflight": {
+            str(k): v for k, v in self._loads.items()}})
+
+    # ---- control surface ----------------------------------------------
+
+    def start_bulk_load(self, app_name: str, root: str,
+                        src_app: Optional[str] = None) -> int:
+        from pegasus_tpu.server.bulk_load import BULK_LOAD_INFO
+
+        app = self.meta.state.find_app(app_name)
+        if app is None:
+            raise PegasusError(ErrorCode.ERR_APP_NOT_EXIST, app_name)
+        if app.app_id in self._loads:
+            raise PegasusError(ErrorCode.ERR_BUSY, "bulk load in progress")
+        src_app = src_app or app_name
+        bs = LocalBlockService(root)
+        info = json.loads(bs.read_file(f"{src_app}/{BULK_LOAD_INFO}"))
+        if info["partition_count"] != app.partition_count:
+            raise PegasusError(
+                ErrorCode.ERR_INVALID_PARAMETERS,
+                f"staged for {info['partition_count']} partitions, table "
+                f"has {app.partition_count}")
+        self._loads[app.app_id] = {
+            "root": root, "src_app": src_app,
+            "load_id": int(self.meta.clock() * 1000),
+            "pending": list(range(app.partition_count)), "inflight": []}
+        self._save()
+        self._drive(app.app_id)
+        return app.app_id
+
+    def bulk_load_status(self, app_name: str) -> dict:
+        app = self.meta.state.find_app(app_name)
+        if app is None:
+            raise PegasusError(ErrorCode.ERR_APP_NOT_EXIST, app_name)
+        info = self._loads.get(app.app_id)
+        if info is None:
+            return {"complete": True, "pending": [], "inflight": []}
+        return {"complete": False, "pending": list(info["pending"]),
+                "inflight": list(info["inflight"])}
+
+    # ---- state machine -------------------------------------------------
+
+    def _drive(self, app_id: int) -> None:
+        """Fill the rolling window (parity: the ingestion context caps
+        concurrent ingests so compaction debt stays bounded)."""
+        info = self._loads.get(app_id)
+        if info is None:
+            return
+        while (info["pending"]
+               and len(info["inflight"]) < self.max_concurrent):
+            pidx = info["pending"].pop(0)
+            info["inflight"].append(pidx)
+        for pidx in info["inflight"]:
+            pc = self.meta.state.get_partition(app_id, pidx)
+            if not pc.primary:
+                continue
+            self.meta.net.send(self.meta.name, pc.primary,
+                               "trigger_ingest", {
+                                   "gpid": (app_id, pidx),
+                                   "load_id": info.get("load_id", 0),
+                                   "root": info["root"],
+                                   "src_app": info["src_app"]})
+        self._save()
+
+    def on_ingest_done(self, payload: dict) -> None:
+        gpid = tuple(payload["gpid"])
+        info = self._loads.get(gpid[0])
+        if info is None:
+            return
+        if payload.get("err", 0) != 0:
+            # permanent per-partition failure (e.g. version mismatch):
+            # abort the whole load, matching the reference's BLS_FAILED
+            del self._loads[gpid[0]]
+            self._save()
+            return
+        if gpid[1] in info["inflight"]:
+            info["inflight"].remove(gpid[1])
+        if not info["pending"] and not info["inflight"]:
+            del self._loads[gpid[0]]
+            self._save()
+        else:
+            self._drive(gpid[0])
+
+    def tick(self) -> None:
+        for app_id in list(self._loads):
+            self._drive(app_id)

@@ -1,0 +1,161 @@
+"""Meta-side duplication bookkeeping.
+
+Parity: src/meta/duplication/meta_duplication_service.h +
+duplication_info.h — dup add/query/remove, per-partition confirmed-
+decree bookkeeping persisted in meta state (synced up from primaries the
+way duplication_sync_timer reports, meta_service.cpp RPC_CM_DUPLICATION_
+SYNC), and re-homing: every tick re-sends dup_add to each partition's
+CURRENT primary, so a failover moves the shipping session to the new
+primary which resumes from the persisted confirmed decree.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+from pegasus_tpu.utils.errors import ErrorCode, PegasusError
+
+
+class MetaDuplicationService:
+    def __init__(self, meta) -> None:
+        self.meta = meta
+        # dupid -> {app_id, app_name, follower_meta, follower_app, status,
+        #           progress: {str(pidx): confirmed_decree}}
+        self._dups: Dict[int, dict] = {}
+        self._next_dupid = 1
+        self._load()
+
+    def _load(self) -> None:
+        raw = self.meta.state._storage.get("/duplication/dups") or {}
+        self._dups = {int(k): v for k, v in raw.items()}
+        self._next_dupid = max(self._dups, default=0) + 1
+
+    def _save(self) -> None:
+        self.meta.state._storage.set_batch({"/duplication/dups": {
+            str(k): v for k, v in self._dups.items()}})
+
+    # ---- control surface (parity: dup add/query/remove RPCs) ----------
+
+    def add_duplication(self, app_name: str, follower_meta: str,
+                        follower_app: str,
+                        bootstrap_root: str = "") -> int:
+        """`bootstrap_root`: when set, pre-existing data is synced first
+        (parity: the reference's DS_PREPARE stage — the follower table is
+        created FROM a checkpoint of the master, then incremental log
+        shipping starts from the checkpoint decrees; meta_duplication_
+        service's follower-table creation). Empty = incremental-only (the
+        follower table must already exist)."""
+        app = self.meta.state.find_app(app_name)
+        if app is None:
+            raise PegasusError(ErrorCode.ERR_APP_NOT_EXIST, app_name)
+        for info in self._dups.values():
+            if (info["app_id"] == app.app_id
+                    and info["follower_meta"] == follower_meta
+                    and info["follower_app"] == follower_app):
+                raise PegasusError(ErrorCode.ERR_DUP_EXIST, app_name)
+        dupid = self._next_dupid
+        self._next_dupid += 1
+        self._dups[dupid] = {
+            "app_id": app.app_id, "app_name": app_name,
+            "follower_meta": follower_meta, "follower_app": follower_app,
+            "status": "bootstrap" if bootstrap_root else "start",
+            "bootstrap_root": bootstrap_root,
+            "backup_id": 0, "restore_sent": False,
+            "progress": {str(p): 0 for p in range(app.partition_count)},
+        }
+        if bootstrap_root:
+            self._dups[dupid]["backup_id"] = (
+                self.meta.backup.start_backup(
+                    app_name, bootstrap_root, policy=f"dup{dupid}"))
+        self._save()
+        if not bootstrap_root:
+            self._drive(dupid)
+        return dupid
+
+    def _tick_bootstrap(self, dupid: int, info: dict) -> None:
+        """DS_PREPARE: wait for the master checkpoint, create the
+        follower table from it, seed progress with the checkpoint
+        decrees, then go incremental."""
+        import json as _json
+
+        from pegasus_tpu.storage.block_service import LocalBlockService
+
+        st = self.meta.backup.backup_status(info["backup_id"])
+        if not st["complete"]:
+            return
+        policy = f"dup{dupid}"
+        if not info["restore_sent"]:
+            # ask the follower cluster's meta to create the table from
+            # the checkpoint (same admin verb an operator would use)
+            self.meta.net.send(self.meta.name, info["follower_meta"],
+                               "admin", {
+                                   "rid": None, "cmd": "restore_app",
+                                   "args": {
+                                       "new_name": info["follower_app"],
+                                       "root": info["bootstrap_root"],
+                                       "policy": policy,
+                                       "backup_id": info["backup_id"]}})
+            info["restore_sent"] = True
+        # seed confirmed decrees from the checkpoint's per-partition meta
+        bs = LocalBlockService(info["bootstrap_root"])
+        for pidx_s in list(info["progress"]):
+            meta_blob = _json.loads(bs.read_file(
+                f"{policy}/{info['backup_id']}/{info['app_id']}/"
+                f"{pidx_s}/meta.json"))
+            info["progress"][pidx_s] = meta_blob["decree"]
+        info["status"] = "start"
+        self._save()
+        self._drive(dupid)
+
+    def query_duplication(self, app_name: str) -> List[dict]:
+        app = self.meta.state.find_app(app_name)
+        if app is None:
+            raise PegasusError(ErrorCode.ERR_APP_NOT_EXIST, app_name)
+        return [dict(info, dupid=dupid)
+                for dupid, info in self._dups.items()
+                if info["app_id"] == app.app_id]
+
+    def remove_duplication(self, dupid: int) -> None:
+        info = self._dups.pop(dupid, None)
+        self._save()
+        if info is None:
+            return
+        for pidx in range(len(info["progress"])):
+            pc = self.meta.state.get_partition(info["app_id"], pidx)
+            for node in pc.members():
+                self.meta.net.send(self.meta.name, node, "dup_remove", {
+                    "gpid": (info["app_id"], pidx), "dupid": dupid})
+
+    # ---- progress sync (parity: RPC_CM_DUPLICATION_SYNC) ---------------
+
+    def on_duplication_sync(self, payload: dict) -> None:
+        info = self._dups.get(payload["dupid"])
+        if info is None:
+            return
+        gpid = tuple(payload["gpid"])
+        key = str(gpid[1])
+        if payload["confirmed"] > info["progress"].get(key, 0):
+            info["progress"][key] = payload["confirmed"]
+            self._save()
+
+    # ---- driving -------------------------------------------------------
+
+    def _drive(self, dupid: int) -> None:
+        info = self._dups[dupid]
+        for pidx_s, confirmed in info["progress"].items():
+            pidx = int(pidx_s)
+            pc = self.meta.state.get_partition(info["app_id"], pidx)
+            if not pc.primary:
+                continue
+            self.meta.net.send(self.meta.name, pc.primary, "dup_add", {
+                "gpid": (info["app_id"], pidx), "dupid": dupid,
+                "follower_meta": info["follower_meta"],
+                "follower_app": info["follower_app"],
+                "confirmed": confirmed})
+
+    def tick(self) -> None:
+        for dupid, info in list(self._dups.items()):
+            if info["status"] == "bootstrap":
+                self._tick_bootstrap(dupid, info)
+            elif info["status"] == "start":
+                self._drive(dupid)

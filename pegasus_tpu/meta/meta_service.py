@@ -49,6 +49,21 @@ class MetaService:
         # balancer copy-secondary moves waiting on a learn: gpid -> node to
         # remove once the learner lands
         self._pending_moves: Dict[Gpid, str] = {}
+        # partitions created from a backup that have not restored yet:
+        # gpid -> {root, policy, backup_id, src_app_id}. The guardian must
+        # not add learners to these (a learner would copy the pre-restore
+        # empty state). Persisted so a meta restart keeps driving them.
+        self.pending_restores: Dict[Gpid, dict] = {}
+        self._load_pending_restores()
+        from pegasus_tpu.meta.backup_service import MetaBackupService
+        from pegasus_tpu.meta.bulk_load_service import MetaBulkLoadService
+        from pegasus_tpu.meta.duplication_service import (
+            MetaDuplicationService,
+        )
+
+        self.backup = MetaBackupService(self)
+        self.bulk_load = MetaBulkLoadService(self)
+        self.duplication = MetaDuplicationService(self)
         net.register(name, self.on_message)
 
     # ---- messages -----------------------------------------------------
@@ -71,6 +86,23 @@ class MetaService:
             return
         if msg_type == "admin":
             self._on_admin(src, payload)
+            return
+        if msg_type == "backup_partition_done":
+            self.backup.on_backup_partition_done(payload)
+            return
+        if msg_type == "restore_partition_done":
+            self.backup.on_restore_partition_done(payload)
+            return
+        if msg_type == "ingest_done":
+            self.bulk_load.on_ingest_done(payload)
+            return
+        if msg_type == "duplication_sync":
+            self.duplication.on_duplication_sync(payload)
+            return
+        if msg_type == "admin_reply":
+            # replies to admin verbs THIS meta issued (e.g. dup bootstrap
+            # asking the follower cluster's meta to restore_app); the
+            # senders are fire-and-retry, so replies are informational
             return
         if msg_type == "query_config":
             # client partition-config resolution (parity: RPC_CM_QUERY_
@@ -99,6 +131,20 @@ class MetaService:
         timer and partition-guardian scans)."""
         self.fd.check(self.clock())
         self._guardian_pass()
+        self.backup.tick()
+        self.bulk_load.tick()
+        self.duplication.tick()
+
+    # ---- restore bookkeeping ------------------------------------------
+
+    def _load_pending_restores(self) -> None:
+        raw = self.state._storage.get("/restore/pending") or []
+        self.pending_restores = {tuple(e["gpid"]): e["info"] for e in raw}
+
+    def persist_pending_restores(self) -> None:
+        self.state._storage.set_batch({"/restore/pending": [
+            {"gpid": list(gpid), "info": info}
+            for gpid, info in self.pending_restores.items()]})
 
     def _on_admin(self, src: str, payload: dict) -> None:
         """Networked DDL/admin surface (parity: the meta admin RPC table,
@@ -129,6 +175,37 @@ class MetaService:
                 result = len(self.rebalance())
             elif cmd == "list_nodes":
                 result = self.fd.alive_workers()
+            elif cmd == "start_backup":
+                result = self.backup.start_backup(
+                    args["app_name"], args["root"],
+                    args.get("policy", "manual"))
+            elif cmd == "backup_status":
+                result = self.backup.backup_status(args["backup_id"])
+            elif cmd == "add_backup_policy":
+                result = self.backup.add_policy(
+                    args["name"], args["app_names"], args["root"],
+                    args.get("interval_seconds", 86400),
+                    args.get("backup_history_count", 3))
+            elif cmd == "restore_app":
+                result = self.backup.create_app_from_backup(
+                    args["new_name"], args["root"],
+                    args.get("policy", "manual"), args["backup_id"],
+                    args.get("replica_count", 3))
+            elif cmd == "start_bulk_load":
+                result = self.bulk_load.start_bulk_load(
+                    args["app_name"], args["root"], args.get("src_app"))
+            elif cmd == "bulk_load_status":
+                result = self.bulk_load.bulk_load_status(args["app_name"])
+            elif cmd == "add_dup":
+                result = self.duplication.add_duplication(
+                    args["app_name"], args["follower_meta"],
+                    args["follower_app"])
+            elif cmd == "query_dup":
+                result = self.duplication.query_duplication(
+                    args["app_name"])
+            elif cmd == "remove_dup":
+                result = self.duplication.remove_duplication(
+                    args["dupid"])
             else:
                 self.net.send(self.name, src, "admin_reply", {
                     "rid": rid,
@@ -184,7 +261,8 @@ class MetaService:
 
     def create_app(self, app_name: str, partition_count: int,
                    replica_count: int = 3,
-                   envs: Optional[Dict[str, str]] = None) -> int:
+                   envs: Optional[Dict[str, str]] = None,
+                   restore_from: Optional[dict] = None) -> int:
         if self.state.find_app(app_name) is not None:
             raise PegasusError(ErrorCode.ERR_APP_EXIST, app_name)
         nodes = self.fd.alive_workers()
@@ -196,7 +274,10 @@ class MetaService:
         # (placement clamps, the app state doesn't)
         app = AppState(self.state.next_app_id(), app_name, partition_count,
                        AS_AVAILABLE, dict(envs or {}), replica_count)
-        placed = min(replica_count, len(nodes))
+        # restore-from-backup starts primary-only: secondaries join later
+        # via LT_APP learning of the RESTORED state (guardian is held off
+        # until the primary's download completes)
+        placed = 1 if restore_from else min(replica_count, len(nodes))
         configs = []
         for pidx in range(partition_count):
             members = [nodes[(pidx + i) % len(nodes)]
@@ -204,10 +285,17 @@ class MetaService:
             configs.append(PartitionConfig(
                 ballot=1, primary=members[0], secondaries=members[1:]))
         self.state.put_app(app, configs)
+        if restore_from:
+            for pidx in range(partition_count):
+                self.pending_restores[(app.app_id, pidx)] = dict(
+                    restore_from)
+            self.persist_pending_restores()
         for pidx, pc in enumerate(configs):
             self._propose(app.app_id, pidx, pc)
         if app.envs:
             self._propagate_envs(app)
+        if restore_from:
+            self.backup.drive_restores()
         return app.app_id
 
     def drop_app(self, app_name: str) -> None:
@@ -326,6 +414,8 @@ class MetaService:
         for app in self.list_apps():
             for pidx in range(app.partition_count):
                 gpid = (app.app_id, pidx)
+                if gpid in self.pending_restores:
+                    continue  # no learners until the restore lands
                 pc = self.state.get_partition(app.app_id, pidx)
                 if not pc.primary:
                     continue
@@ -452,7 +542,10 @@ class MetaService:
         self.net.send(self.name, node, "config_proposal", {
             "gpid": (app.app_id, pidx), "ballot": pc.ballot,
             "primary": pc.primary, "secondaries": list(pc.secondaries),
-            "partition_count": app.partition_count})
+            "partition_count": app.partition_count,
+            # a partition created from a backup must not serve until its
+            # restore lands — the replica gates clients on this flag
+            "restoring": (app.app_id, pidx) in self.pending_restores})
 
     def _propagate_envs(self, app: AppState) -> None:
         nodes = set()

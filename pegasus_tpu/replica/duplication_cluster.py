@@ -1,0 +1,204 @@
+"""Cluster duplication: ship a partition's committed mutations to a
+follower cluster's table over the network, through the follower's 2PC.
+
+Parity: the replica-side duplication pipeline (replica_duplicator.h:79,
+duplication_pipeline.h:42-76) with pegasus_mutation_duplicator.h:56 as
+the shipping backend — here the backend is the wire: shipped writes are
+OP_DUP_PUT / OP_DUP_REMOVE mutations sent to the follower partition's
+primary (client_write), which replicates them to the follower's members
+and resolves conflicts via the carried source timetags.
+
+Confirmation discipline (the part the in-process TableShipper doesn't
+need): `confirmed_decree` advances ONLY after the follower's primary
+acks the write — a crash between ship and ack re-ships the same
+mutations, which is safe because dup application is idempotent (same
+timetag loses the `>` comparison the second time).
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Callable, Dict, List, Optional, Tuple
+
+from pegasus_tpu.base.key_schema import generate_key, key_hash
+from pegasus_tpu.base.value_schema import (
+    PEGASUS_EPOCH_BEGIN,
+    expire_ts_from_ttl,
+    generate_timetag,
+)
+from pegasus_tpu.replica.mutation import ATOMIC_OPS, Mutation
+from pegasus_tpu.rpc.codec import (
+    OP_DUP_PUT,
+    OP_DUP_REMOVE,
+    OP_MULTI_PUT,
+    OP_MULTI_REMOVE,
+    OP_PUT,
+    OP_REMOVE,
+)
+
+_RIDS = itertools.count(1_000_000)
+
+
+class ClusterDuplicator:
+    """One partition's dup session on its primary's node.
+
+    Driven by the stub: `tick()` from the dup timer; `on_write_reply` /
+    `on_follower_config` from inbound messages. At most one mutation is
+    in flight at a time (ordering: the follower must apply mutations in
+    decree order for timetag floors to behave like the reference's
+    single-channel shipping).
+    """
+
+    def __init__(self, stub, gpid: Tuple[int, int], dupid: int,
+                 follower_meta: str, follower_app: str,
+                 confirmed_decree: int = 0,
+                 source_cluster_id: int = 1,
+                 on_progress: Optional[Callable[[int, int], None]] = None
+                 ) -> None:
+        self.stub = stub
+        self.gpid = gpid
+        self.dupid = dupid
+        self.follower_meta = follower_meta
+        self.follower_app = follower_app
+        self.confirmed_decree = confirmed_decree
+        self.source_cluster_id = source_cluster_id
+        self.on_progress = on_progress
+        self._fconfig: Optional[dict] = None  # follower app config
+        self._config_rid: Optional[int] = None
+        # in-flight mutation: decree + outstanding write rids
+        self._inflight_decree: Optional[int] = None
+        self._outstanding: Dict[int, bool] = {}
+        self._log_offset = 0
+        self._log_generation: Optional[int] = None
+        replica = stub.get_replica(gpid)
+        if replica is not None:
+            self._log_generation = replica.log.generation
+            replica.duplicators.append(self)
+
+    # ---- follower config -----------------------------------------------
+
+    def _request_follower_config(self) -> None:
+        rid = next(_RIDS)
+        self._config_rid = rid
+        self.stub.net.send(self.stub.name, self.follower_meta,
+                           "query_config",
+                           {"app_name": self.follower_app, "rid": rid})
+
+    def on_follower_config(self, payload: dict) -> bool:
+        if payload.get("rid") != self._config_rid:
+            return False
+        self._config_rid = None
+        if payload["err"] == 0:
+            self._fconfig = {
+                "app_id": payload["app_id"],
+                "partition_count": payload["partition_count"],
+                "configs": payload["configs"],
+            }
+        return True
+
+    # ---- shipping ------------------------------------------------------
+
+    def tick(self) -> None:
+        """Load → ship the next committed mutation (one at a time)."""
+        from pegasus_tpu.replica.replica import PartitionStatus
+
+        replica = self.stub.get_replica(self.gpid)
+        if replica is None or replica.status != PartitionStatus.PRIMARY:
+            return  # dup runs on the primary only (meta re-homes us)
+        if self._inflight_decree is not None:
+            return  # waiting on follower acks; replies drive progress
+        if self._fconfig is None:
+            if self._config_rid is None:
+                self._request_follower_config()
+            return
+        log = replica.log
+        if log.generation != self._log_generation:
+            self._log_offset = 0
+            self._log_generation = log.generation
+        last_committed = replica.last_committed_decree
+        for mu, frame_end in log.read_tail(self._log_offset):
+            if mu.decree > last_committed:
+                break
+            if mu.decree <= self.confirmed_decree:
+                self._log_offset = frame_end
+                continue
+            self._ship(mu, frame_end)
+            return  # one mutation in flight
+
+    def _ship(self, mu: Mutation, frame_end: int) -> None:
+        mu_now = max(0, mu.timestamp_us // 1_000_000 - PEGASUS_EPOCH_BEGIN)
+        by_pidx: Dict[int, List[tuple]] = {}
+        count = self._fconfig["partition_count"]
+        for i, wo in enumerate(mu.ops):
+            timetag = generate_timetag(mu.timestamp_us + i,
+                                       self.source_cluster_id, False)
+            for key, dup_op, req in self._dup_ops(wo, timetag, mu_now):
+                by_pidx.setdefault(key_hash(key) % count, []).append(
+                    (dup_op, req))
+        if not by_pidx:
+            # nothing shippable (e.g. empty mutation): confirm and move on
+            self._advance(mu.decree, frame_end)
+            return
+        self._inflight_decree = mu.decree
+        self._inflight_frame_end = frame_end
+        self._outstanding = {}
+        for pidx, ops in by_pidx.items():
+            primary = self._fconfig["configs"][pidx]["primary"]
+            if not primary:
+                # follower partition unowned: drop config, retry later
+                self._fconfig = None
+                self._inflight_decree = None
+                return
+            rid = next(_RIDS)
+            self._outstanding[rid] = True
+            self.stub.net.send(self.stub.name, primary, "client_write", {
+                "gpid": (self._fconfig["app_id"], pidx), "rid": rid,
+                "ops": ops})
+
+    def _dup_ops(self, wo, timetag: int, mu_now: int):
+        """Translate one logged write op into (key, dup_op, request)s."""
+        if wo.op in ATOMIC_OPS:
+            # parity note (replica/idempotent_writer.h): atomic ops must
+            # be idempotent-translated before duplication; shipping the
+            # raw op would re-execute it on the follower. Skipped here —
+            # enable idempotent translation on duplicated tables.
+            return
+        if wo.op == OP_PUT:
+            key, user_data, expire_ts = wo.request
+            yield key, OP_DUP_PUT, (key, user_data, expire_ts, timetag)
+        elif wo.op == OP_REMOVE:
+            (key,) = wo.request
+            yield key, OP_DUP_REMOVE, (key, timetag)
+        elif wo.op == OP_MULTI_PUT:
+            expire_ts = expire_ts_from_ttl(wo.request.expire_ts_seconds,
+                                           now=mu_now)
+            for kv in wo.request.kvs:
+                key = generate_key(wo.request.hash_key, kv.key)
+                yield key, OP_DUP_PUT, (key, kv.value, expire_ts, timetag)
+        elif wo.op == OP_MULTI_REMOVE:
+            for sk in wo.request.sort_keys:
+                key = generate_key(wo.request.hash_key, sk)
+                yield key, OP_DUP_REMOVE, (key, timetag)
+
+    def on_write_reply(self, payload: dict) -> bool:
+        rid = payload.get("rid")
+        if rid not in self._outstanding:
+            return False
+        if payload["err"] != 0:
+            # follower rejected (failover/stale config): re-resolve and
+            # re-ship the whole mutation — idempotent on the follower
+            self._fconfig = None
+            self._inflight_decree = None
+            self._outstanding = {}
+            return True
+        del self._outstanding[rid]
+        if not self._outstanding and self._inflight_decree is not None:
+            self._advance(self._inflight_decree, self._inflight_frame_end)
+            self._inflight_decree = None
+        return True
+
+    def _advance(self, decree: int, frame_end: int) -> None:
+        self.confirmed_decree = decree
+        self._log_offset = frame_end
+        if self.on_progress is not None:
+            self.on_progress(self.dupid, decree)

@@ -27,15 +27,21 @@ _HDR = struct.Struct("<QQQQI")
 from pegasus_tpu.rpc.codec import (  # noqa: E402
     OP_CAM,
     OP_CAS,
+    OP_DUP_PUT,
+    OP_DUP_REMOVE,
     OP_INCR,
+    OP_INGEST,
     OP_MULTI_PUT,
     OP_MULTI_REMOVE,
     OP_PUT,
     OP_REMOVE,
 )
 
-BATCHABLE_OPS = {OP_PUT, OP_REMOVE, OP_MULTI_PUT, OP_MULTI_REMOVE}
-ATOMIC_OPS = {OP_INCR, OP_CAS, OP_CAM}
+BATCHABLE_OPS = {OP_PUT, OP_REMOVE, OP_MULTI_PUT, OP_MULTI_REMOVE,
+                 OP_DUP_PUT, OP_DUP_REMOVE}
+# ingestion rides alone like atomic ops (a whole-SST apply must own its
+# decree; parity: bulk-load mutations never batch)
+ATOMIC_OPS = {OP_INCR, OP_CAS, OP_CAM, OP_INGEST}
 
 
 @dataclass

@@ -53,7 +53,10 @@ from pegasus_tpu.replica.prepare_list import (
 from pegasus_tpu.rpc.codec import (
     OP_CAM,
     OP_CAS,
+    OP_DUP_PUT,
+    OP_DUP_REMOVE,
     OP_INCR,
+    OP_INGEST,
     OP_MULTI_PUT,
     OP_MULTI_REMOVE,
     OP_PUT,
@@ -132,6 +135,8 @@ class Replica:
         self._client_callbacks: Dict[int, Callable[[List[Any]], None]] = {}
         self._learners: Dict[str, int] = {}  # learner -> prepare_start decree
         self._learn_ckpt_dirs: Dict[str, str] = {}  # learner -> frozen ckpt
+        # reads/checkpoints gate on this after a promotion (replica.cpp:426)
+        self._promotion_watermark = 0
         # callbacks to the control plane (meta); tests wire these
         self.on_learn_completed: Optional[Callable[[str], None]] = None
         self.on_replication_error: Optional[Callable[[str, int], None]] = None
@@ -153,6 +158,12 @@ class Replica:
     def last_prepared_decree(self) -> int:
         return self.prepare_list.max_decree()
 
+    def ready_to_serve(self) -> bool:
+        """Reads/checkpoints allowed only once the promotion-time prepare
+        window has re-committed (parity: replica.cpp:426 — the gate that
+        keeps a fresh primary from serving state missing acked writes)."""
+        return self.last_committed_decree >= self._promotion_watermark
+
     # ---- config (driven by meta / tests) ------------------------------
 
     def assign_config(self, config: ReplicaConfig) -> None:
@@ -163,6 +174,11 @@ class Replica:
         if config.primary == self.name:
             if self.status != PartitionStatus.PRIMARY:
                 self.status = PartitionStatus.PRIMARY
+                # serving gate (parity: replica.cpp:426): reads and
+                # checkpoints must wait until everything prepared at
+                # promotion time has re-committed under the new ballot —
+                # an acked write can live in the window as prepared-only
+                self._promotion_watermark = self.last_prepared_decree()
                 # a new primary must not carry uncommitted decrees from an
                 # older window beyond what it can now re-propose; reconcile
                 # by re-preparing its own window under the new ballot
@@ -386,6 +402,10 @@ class Replica:
         ts = mu.timestamp_us
         items: List = []
         responses: List[Any] = []
+        # timetags already written EARLIER IN THIS MUTATION per key: a
+        # batched dup mutation may touch one key twice, and the engine
+        # won't see the first write until apply_items at the end
+        dup_floors: Dict[bytes, int] = {}
         for wo in mu.ops:
             if wo.op == OP_PUT:
                 key, user_data, expire_ts = wo.request
@@ -412,6 +432,34 @@ class Replica:
                 resp, its = ws.translate_check_and_mutate(wo.request, ts, now)
                 resp.decree = mu.decree
                 responses.append(resp)
+            elif wo.op == OP_DUP_PUT:
+                key, user_data, expire_ts, timetag = wo.request
+                applied, its = ws.translate_duplicate_put(
+                    key, user_data, expire_ts, timetag,
+                    dup_floors.get(key, 0))
+                if applied:
+                    dup_floors[key] = timetag
+                responses.append(int(applied))
+            elif wo.op == OP_DUP_REMOVE:
+                key, timetag = wo.request
+                applied, its = ws.translate_duplicate_remove(
+                    key, timetag, dup_floors.get(key, 0))
+                if applied:
+                    dup_floors[key] = timetag
+                responses.append(int(applied))
+            elif wo.op == OP_INGEST:
+                # bulk-load ingestion applies on EVERY member at the same
+                # decree (the mutation carries only the remote location;
+                # the staged SST is immutable, so the download is
+                # deterministic) — parity: replica_bulk_loader.h:49 +
+                # ingestion through 2PC. ingest_sst_file stamps the decree
+                # watermark itself; skip the empty apply_items below
+                # (OP_INGEST rides alone per ATOMIC_OPS)
+                responses.append(self._apply_ingest(wo.request, mu.decree))
+                callback = self._client_callbacks.pop(mu.decree, None)
+                if callback is not None:
+                    callback(responses)
+                return
             else:
                 raise ValueError(f"unknown op {wo.op}")
             items.extend(its)
@@ -419,6 +467,45 @@ class Replica:
         callback = self._client_callbacks.pop(mu.decree, None)
         if callback is not None:
             callback(responses)
+
+    def _apply_ingest(self, request, decree: int) -> int:
+        """Download this partition's staged SST and ingest it at `decree`."""
+        import json as _json
+        import tempfile
+
+        from pegasus_tpu.server.bulk_load import (
+            BULK_LOAD_FILE,
+            BULK_LOAD_INFO,
+        )
+        from pegasus_tpu.storage.block_service import LocalBlockService
+        from pegasus_tpu.utils.errors import StorageStatus
+
+        root, src_app = request
+        bs = LocalBlockService(root)
+        info = _json.loads(bs.read_file(f"{src_app}/{BULK_LOAD_INFO}"))
+        if info["partition_count"] != self.server.partition_count:
+            # still stamp the decree: the mutation is committed groupwide
+            # and the watermark must advance identically on every member
+            self.server.write_service.apply_items([], decree)
+            return int(StorageStatus.INVALID_ARGUMENT)
+        remote = f"{src_app}/{self.server.pidx}/{BULK_LOAD_FILE}"
+        if not bs.exists(remote):
+            self.server.write_service.apply_items([], decree)
+            return int(StorageStatus.OK)  # nothing staged for this pidx
+        try:
+            with tempfile.TemporaryDirectory(prefix="pegingest") as tmp:
+                local = os.path.join(tmp, "ingest.sst")
+                bs.download(remote, local)
+                self.server.engine.ingest_sst_file(local, decree)
+        except (OSError, ValueError):
+            # staged files must stay immutable+present for the whole load
+            # (same contract as the reference). If they vanish mid-apply,
+            # STILL stamp the decree — a committed mutation must advance
+            # the watermark identically on every member — and surface the
+            # failure so meta aborts the load.
+            self.server.write_service.apply_items([], decree)
+            return int(StorageStatus.IO_ERROR)
+        return int(StorageStatus.OK)
 
     # ---- learning (parity: replica_learn.cpp) -------------------------
 

@@ -45,6 +45,13 @@ class ReplicaStub:
         self.sim_clock = sim_clock or clock or (lambda: 0.0)
         self.replicas: Dict[Gpid, Replica] = {}
         self.meta_addr: Optional[str] = None
+        # (gpid, dupid) -> ClusterDuplicator on this node's primaries
+        self._dup_sessions: Dict = {}
+        # long-op dedup: a meta tick re-sends commands until done arrives;
+        # a second copy of an in-flight backup/ingest must be ignored
+        self._backup_inflight: set = set()
+        self._ingest_inflight: set = set()
+        self._ingested_loads: set = set()
         self._last_beacon_ack = float("-inf")
         net.register(name, self.on_message)
         # load existing replica dirs (parity: replica_stub boot scan,
@@ -127,6 +134,40 @@ class ReplicaStub:
         if msg_type == "config_sync_reply":
             self._on_config_sync_reply(src, payload)
             return
+        if msg_type == "backup_partition":
+            self._on_backup_partition(src, payload)
+            return
+        if msg_type == "restore_partition":
+            self._on_restore_partition(src, payload)
+            return
+        if msg_type == "trigger_ingest":
+            self._on_trigger_ingest(src, payload)
+            return
+        if msg_type == "dup_add":
+            self._on_dup_add(src, payload)
+            return
+        if msg_type == "dup_remove":
+            gpid = tuple(payload["gpid"])
+            dup = self._dup_sessions.pop((gpid, payload["dupid"]), None)
+            if dup is not None:
+                r = self.replicas.get(gpid)
+                if r is not None and dup in r.duplicators:
+                    # unhook or the log-GC floor stays pinned forever
+                    r.duplicators.remove(dup)
+            return
+        if msg_type == "query_config_reply":
+            for dup in self._dup_sessions.values():
+                if dup.on_follower_config(payload):
+                    dup.tick()
+                    return
+            return
+        if msg_type == "client_write_reply":
+            # replies to duplication-shipped writes come back to the node
+            for dup in self._dup_sessions.values():
+                if dup.on_write_reply(payload):
+                    dup.tick()
+                    return
+            return
         if msg_type == "client_write":
             self._on_client_write(src, payload)
             return
@@ -156,6 +197,7 @@ class ReplicaStub:
         rid = payload["rid"]
         r = self.replicas.get(gpid)
         if (r is None or r.status != PartitionStatus.PRIMARY
+                or getattr(r, "restoring", False)
                 or not self.lease_valid()):
             self.net.send(self.name, src, "client_write_reply", {
                 "rid": rid, "err": int(ErrorCode.ERR_INVALID_STATE),
@@ -198,6 +240,8 @@ class ReplicaStub:
         op = payload.get("op", "get")
         r = self.replicas.get(gpid)
         if (r is None or r.status != PartitionStatus.PRIMARY
+                or getattr(r, "restoring", False)
+                or not r.ready_to_serve()
                 or not self.lease_valid()):
             self.net.send(self.name, src, "client_read_reply", {
                 "rid": rid, "err": int(ErrorCode.ERR_INVALID_STATE),
@@ -258,6 +302,11 @@ class ReplicaStub:
         config = ReplicaConfig(payload["ballot"], payload["primary"],
                                list(payload["secondaries"]))
         r = self._open_replica(gpid, payload.get("partition_count", 1))
+        if payload.get("restoring"):
+            # created from a backup: serve NOTHING until the restore
+            # lands, or a stray early write would make the idempotence
+            # check misread the partition as already restored
+            r.restoring = True
         r.assign_config(config)
 
     def _on_add_learner_cmd(self, src: str, payload: dict) -> None:
@@ -273,6 +322,152 @@ class ReplicaStub:
         for gpid, r in self.replicas.items():
             if gpid[0] == payload["app_id"]:
                 r.server.update_app_envs(payload["envs"])
+
+    # ---- meta-driven backup / restore (parity: the replica-side cold
+    # backup flow, replica/replica_backup.cpp, and restore,
+    # replica/replica_restore.cpp — commanded by the meta services) -----
+
+    def _on_backup_partition(self, src: str, payload: dict) -> None:
+        from pegasus_tpu.replica.replica import PartitionStatus
+        from pegasus_tpu.server.backup import BackupEngine
+        from pegasus_tpu.storage.block_service import LocalBlockService
+
+        gpid = tuple(payload["gpid"])
+        r = self.replicas.get(gpid)
+        if r is None or r.status != PartitionStatus.PRIMARY:
+            return  # meta's tick retries against the current primary
+        if not r.ready_to_serve():
+            return  # promotion window not re-committed; meta retries
+        key = (gpid, payload["backup_id"])
+        if key in self._backup_inflight:
+            return  # meta re-sends until done; one upload is enough
+        self._backup_inflight.add(key)
+        try:
+            engine = BackupEngine(LocalBlockService(payload["root"]),
+                                  payload["policy"])
+            decree = engine.backup_partition(payload["backup_id"], gpid[0],
+                                             gpid[1], r.server.engine)
+        finally:
+            self._backup_inflight.discard(key)
+        self.net.send(self.name, src, "backup_partition_done", {
+            "gpid": gpid, "backup_id": payload["backup_id"],
+            "decree": decree})
+
+    def _on_restore_partition(self, src: str, payload: dict) -> None:
+        from pegasus_tpu.replica.replica import PartitionStatus
+        from pegasus_tpu.server.backup import BackupEngine
+        from pegasus_tpu.storage.block_service import LocalBlockService
+
+        gpid = tuple(payload["gpid"])
+        r = self.replicas.get(gpid)
+        if r is None or r.status != PartitionStatus.PRIMARY:
+            return
+        if not getattr(r, "restoring", False):
+            # already restored (idempotence against meta's retry timer) —
+            # clients were gated until the flag cleared, so no stray
+            # write can masquerade as a completed restore
+            self.net.send(self.name, src, "restore_partition_done",
+                          {"gpid": gpid})
+            return
+        engine = BackupEngine(LocalBlockService(payload["root"]),
+                              payload["policy"])
+        app_dir = r.server.engine.data_dir
+        r.server.engine.close()
+        new_engine = engine.restore_partition(
+            payload["backup_id"], payload["src_app_id"], gpid[1], app_dir)
+        r.server.engine = new_engine
+        r.server.write_service.engine = new_engine
+        r.prepare_list.reset(new_engine.last_committed_decree)
+        r.restoring = False
+        self.net.send(self.name, src, "restore_partition_done",
+                      {"gpid": gpid})
+
+    def _on_trigger_ingest(self, src: str, payload: dict) -> None:
+        """Meta commands an ingestion: the primary replicates an
+        OP_INGEST mutation through 2PC so every member ingests at the
+        same decree (parity: bulk-load ingestion, replica_2pc.cpp:211)."""
+        from pegasus_tpu.replica.mutation import WriteOp
+        from pegasus_tpu.replica.replica import PartitionStatus
+        from pegasus_tpu.rpc.codec import OP_INGEST
+
+        gpid = tuple(payload["gpid"])
+        r = self.replicas.get(gpid)
+        if r is None or r.status != PartitionStatus.PRIMARY:
+            return  # meta's tick retries against the current primary
+        key = (gpid, payload.get("load_id", 0))
+        if key in self._ingested_loads:
+            # done message to meta was lost; re-ack WITHOUT re-ingesting —
+            # a second OP_INGEST at a later decree would resurrect keys
+            # deleted since the first one
+            self.net.send(self.name, src, "ingest_done",
+                          {"gpid": gpid, "err": 0})
+            return
+        if key in self._ingest_inflight:
+            return  # download/2PC still running; meta's tick re-sends
+
+        def done(results) -> None:
+            self._ingest_inflight.discard(key)
+            err = results[0] if results else 0
+            if err == 0:
+                self._ingested_loads.add(key)
+            self.net.send(self.name, src, "ingest_done", {
+                "gpid": gpid, "err": err})
+
+        self._ingest_inflight.add(key)
+        try:
+            r.client_write(
+                [WriteOp(OP_INGEST,
+                         (payload["root"], payload["src_app"]))], done)
+        except (RuntimeError, ValueError):
+            self._ingest_inflight.discard(key)
+
+    # ---- duplication (parity: duplication_sync_timer driving the
+    # replica-side pipeline; meta owns WHICH partitions duplicate) -------
+
+    def _on_dup_add(self, src: str, payload: dict) -> None:
+        from pegasus_tpu.replica.duplication_cluster import (
+            ClusterDuplicator,
+        )
+        from pegasus_tpu.replica.replica import PartitionStatus
+
+        gpid = tuple(payload["gpid"])
+        dupid = payload["dupid"]
+        r = self.replicas.get(gpid)
+        if r is None or r.status != PartitionStatus.PRIMARY:
+            return  # meta re-sends to the current primary on its tick
+        key = (gpid, dupid)
+        if key in self._dup_sessions:
+            return
+
+        def progress(dup_id: int, confirmed: int) -> None:
+            if self.meta_addr is not None:
+                self.net.send(self.name, self.meta_addr,
+                              "duplication_sync", {
+                                  "gpid": gpid, "dupid": dup_id,
+                                  "confirmed": confirmed})
+
+        self._dup_sessions[key] = ClusterDuplicator(
+            self, gpid, dupid, payload["follower_meta"],
+            payload["follower_app"],
+            confirmed_decree=payload.get("confirmed", 0),
+            source_cluster_id=payload.get("source_cluster_id", 1),
+            on_progress=progress)
+
+    def dup_tick(self) -> None:
+        """Timer: drive every dup session (parity: duplication_sync_timer).
+        Sessions whose replica lost primaryship are dropped — meta
+        re-homes them on the new primary."""
+        from pegasus_tpu.replica.replica import PartitionStatus
+
+        for key in list(self._dup_sessions):
+            gpid, _dupid = key
+            r = self.replicas.get(gpid)
+            if r is None or r.status != PartitionStatus.PRIMARY:
+                dup = self._dup_sessions.pop(key)
+                if r is not None and dup in r.duplicators:
+                    r.duplicators.remove(dup)
+                continue
+            self._dup_sessions[key].tick()
 
     # ---- notifications to meta ----------------------------------------
 

@@ -42,6 +42,16 @@ OP_MULTI_REMOVE = 4
 OP_INCR = 5
 OP_CAS = 6
 OP_CAM = 7
+# bulk-load SST ingestion rides the 2PC pipeline as its own mutation
+# (parity: RPC_RRDB_RRDB_BULK_LOAD through init_prepare,
+# replica_2pc.cpp:211-230): request = (block_root, staged_app_name)
+OP_INGEST = 8
+# duplication-shipped writes (parity: duplicate-tagged update_request,
+# idl/rrdb.thrift dup fields): carry the SOURCE timetag so the follower
+# resolves conflicts; applied through the follower's own 2PC
+# dup_put: (key, user_data, expire_ts, timetag); dup_remove: (key, timetag)
+OP_DUP_PUT = 9
+OP_DUP_REMOVE = 10
 
 
 def _blob(b: bytes) -> bytes:
@@ -118,6 +128,18 @@ def encode_write(op: int, req: Any) -> bytes:
                 + _blob(req.set_sort_key) + _blob(req.set_value)
                 + struct.pack("<i", req.set_expire_ts_seconds)
                 + bytes([int(req.return_check_value)]))
+    if op == OP_INGEST:
+        root, src_app = req
+        return (bytes([OP_INGEST]) + _blob(root.encode())
+                + _blob(src_app.encode()))
+    if op == OP_DUP_PUT:
+        key, user_data, expire_ts, timetag = req
+        return (bytes([OP_DUP_PUT]) + _blob(key) + _blob(user_data)
+                + struct.pack("<IQ", expire_ts, timetag))
+    if op == OP_DUP_REMOVE:
+        key, timetag = req
+        return bytes([OP_DUP_REMOVE]) + _blob(key) + struct.pack(
+            "<Q", timetag)
     if op == OP_CAM:
         assert isinstance(req, CheckAndMutateRequest)
         out = [bytes([OP_CAM]), _blob(req.hash_key),
@@ -177,6 +199,21 @@ def decode_write(data: bytes, pos: int = 0) -> Tuple[int, Any, int]:
         ret = bool(r.u8())
         return op, CheckAndSetRequest(hk, csk, ctype, operand, diff, ssk,
                                       sval, expire, ret), r.pos
+    if op == OP_INGEST:
+        root = r.blob().decode()
+        src_app = r.blob().decode()
+        return op, (root, src_app), r.pos
+    if op == OP_DUP_PUT:
+        key = r.blob()
+        user_data = r.blob()
+        (expire, timetag) = struct.unpack_from("<IQ", r.data, r.pos)
+        r.pos += 12
+        return op, (key, user_data, expire, timetag), r.pos
+    if op == OP_DUP_REMOVE:
+        key = r.blob()
+        (timetag,) = struct.unpack_from("<Q", r.data, r.pos)
+        r.pos += 8
+        return op, (key, timetag), r.pos
     if op == OP_CAM:
         hk = r.blob()
         csk = r.blob()

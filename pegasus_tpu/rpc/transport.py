@@ -54,11 +54,13 @@ class TcpTransport:
         self._routes: Dict[str, Tuple[socket.socket, threading.Lock]] = {}
         self._routes_lock = threading.Lock()
         self._inbox: "queue.Queue[Optional[tuple]]" = queue.Queue()
-        # outbound frames are written by a dedicated sender thread: the
+        # outbound frames are written by PER-PEER sender threads: the
         # senders (dispatcher, timers) hold the node lock, and a blocking
-        # dial/write there would stall every handler and timer on the node
-        self._outbox: "queue.Queue[Optional[Tuple[str, bytes]]]" = (
-            queue.Queue())
+        # dial/write there would stall every handler and timer; per-peer
+        # queues additionally stop one blackholed peer from head-of-line
+        # blocking beacons/prepares to healthy peers
+        self._peer_outboxes: Dict[str, "queue.Queue[Optional[bytes]]"] = {}
+        self._outboxes_lock = threading.Lock()
         self._closing = False
         self._threads: list = []
         self._listener: Optional[socket.socket] = None
@@ -72,7 +74,6 @@ class TcpTransport:
             self.listen_addr = srv.getsockname()
             self._spawn(self._accept_loop)
         self._spawn(self._dispatch_loop)
-        self._spawn(self._send_loop)
 
     def _spawn(self, fn, *args) -> None:
         t = threading.Thread(target=fn, args=args, daemon=True)
@@ -92,16 +93,21 @@ class TcpTransport:
             return
         # encode HERE so an unencodable payload raises at the caller (a
         # programming error, not network loss); network IO happens on the
-        # sender thread so a dead peer never stalls handlers or timers
+        # peer's sender thread so a dead peer never stalls handlers/timers
         frame = encode_message(src, dst, msg_type, payload)
-        self._outbox.put((dst, frame))
+        with self._outboxes_lock:
+            box = self._peer_outboxes.get(dst)
+            if box is None:
+                box = queue.Queue()
+                self._peer_outboxes[dst] = box
+                self._spawn(self._send_loop, dst, box)
+        box.put(frame)
 
-    def _send_loop(self) -> None:
+    def _send_loop(self, dst: str, box: "queue.Queue") -> None:
         while True:
-            item = self._outbox.get()
-            if item is None:
+            frame = box.get()
+            if frame is None:
                 return
-            dst, frame = item
             try:
                 sock, wlock = self._route(dst)
                 with wlock:
@@ -112,7 +118,9 @@ class TcpTransport:
     def close(self) -> None:
         self._closing = True
         self._inbox.put(None)
-        self._outbox.put(None)
+        with self._outboxes_lock:
+            for box in self._peer_outboxes.values():
+                box.put(None)
         if self._listener is not None:
             try:
                 self._listener.close()

@@ -78,6 +78,7 @@ def run_node(cfg: dict, name: str) -> None:
                     r.broadcast_group_check()
 
         transport.run_timer(1.0, group_checks)
+        transport.run_timer(1.0, stub.dup_tick)
         print(f"[{name}] replica serving on {node_cfg['host']}:"
               f"{node_cfg['port']}", flush=True)
     else:

@@ -316,27 +316,40 @@ class WriteService:
         from pegasus_tpu.base.value_schema import extract_timetag
         return extract_timetag(self.data_version, value)
 
-    def duplicate_put(self, key: bytes, user_data: bytes, expire_ts: int,
-                      timetag: int, decree: int) -> bool:
-        """Apply a write shipped from a remote cluster iff its timetag wins
-        (larger timestamp, then cluster id, resolves master-master
-        conflicts). Returns whether it applied."""
-        if timetag <= self._existing_timetag(key):
-            self.apply_items([], decree)  # decree still advances
-            return False
+    def translate_duplicate_put(self, key: bytes, user_data: bytes,
+                                expire_ts: int, timetag: int,
+                                floor_tag: int = 0):
+        """(applied, items) for a shipped write: applies iff its timetag
+        wins (larger timestamp, then cluster id — master-master conflict
+        resolution). `floor_tag` lets a caller batching several dup ops in
+        one mutation account for an earlier write to the same key that is
+        not in the engine yet."""
+        if timetag <= max(self._existing_timetag(key), floor_tag):
+            return False, []
         from pegasus_tpu.base.value_schema import generate_value
         value = generate_value(self.data_version, user_data, expire_ts,
                                timetag)
-        self.apply_items([WriteBatchItem(OP_PUT, key, value, expire_ts)],
-                         decree)
-        return True
+        return True, [WriteBatchItem(OP_PUT, key, value, expire_ts)]
+
+    def translate_duplicate_remove(self, key: bytes, timetag: int,
+                                   floor_tag: int = 0):
+        if timetag <= max(self._existing_timetag(key), floor_tag):
+            return False, []
+        return True, [WriteBatchItem(OP_DEL, key)]
+
+    def duplicate_put(self, key: bytes, user_data: bytes, expire_ts: int,
+                      timetag: int, decree: int) -> bool:
+        """translate_duplicate_put + apply (the in-process shipper path);
+        the decree advances even on a lost conflict."""
+        applied, items = self.translate_duplicate_put(key, user_data,
+                                                      expire_ts, timetag)
+        self.apply_items(items, decree)
+        return applied
 
     def duplicate_remove(self, key: bytes, timetag: int, decree: int) -> bool:
-        if timetag <= self._existing_timetag(key):
-            self.apply_items([], decree)
-            return False
-        self.apply_items([WriteBatchItem(OP_DEL, key)], decree)
-        return True
+        applied, items = self.translate_duplicate_remove(key, timetag)
+        self.apply_items(items, decree)
+        return applied
 
     # -- apply phase ----------------------------------------------------
 

@@ -82,6 +82,7 @@ class SimCluster:
                     for r in stub.replicas.values():
                         if r.status == PartitionStatus.PRIMARY:
                             r.broadcast_group_check()
+                    stub.dup_tick()
             self.loop.run_for(self.beacon_interval)
             self.meta.tick()
         self.loop.run_until_idle()

@@ -78,6 +78,7 @@ class ErrorCode(enum.IntEnum):
     ERR_RANGER_POLICIES_NO_NEED_UPDATE = 59
     ERR_RANGER_PARSE_ACL = 60
     ERR_ACL_DENY = 61
+    ERR_DUP_EXIST = 62
 
 
 class StorageStatus(enum.IntEnum):

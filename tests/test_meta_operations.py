@@ -1,0 +1,234 @@
+"""Meta-orchestrated operations: backup, restore, duplication, split,
+bulk load — each driven end-to-end through meta on a replicated
+SimCluster, surviving failovers mid-operation (VERDICT r1 item 5).
+
+Parity: meta_backup_service.h:360, server_state_restore.cpp,
+meta_duplication_service.h, meta_split_service.h:34,
+meta_bulk_load_service.h:143.
+"""
+
+import pytest
+
+from pegasus_tpu.tools.cluster import SimCluster
+from pegasus_tpu.utils.errors import StorageStatus
+
+OK = int(StorageStatus.OK)
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    c = SimCluster(str(tmp_path / "cluster"), n_nodes=4)
+    yield c
+    c.close()
+
+
+def _fill(client, n=40, prefix=b"bk"):
+    for i in range(n):
+        assert client.set(b"%s%03d" % (prefix, i), b"s",
+                          b"v%d" % i) == OK
+
+
+def test_meta_backup_completes_across_partitions(cluster, tmp_path):
+    cluster.create_table("bt", partition_count=4)
+    c = cluster.client("bt")
+    _fill(c)
+    backup_id = cluster.meta.backup.start_backup("bt",
+                                                 str(tmp_path / "bucket"))
+    cluster.step(rounds=2)
+    st = cluster.meta.backup.backup_status(backup_id)
+    assert st["complete"], st
+    # metadata written and listed
+    from pegasus_tpu.server.backup import BackupEngine
+    from pegasus_tpu.storage.block_service import LocalBlockService
+
+    be = BackupEngine(LocalBlockService(str(tmp_path / "bucket")),
+                      "manual")
+    assert backup_id in be.list_backups()
+
+
+def test_meta_backup_survives_primary_failover(cluster, tmp_path):
+    app_id = cluster.create_table("bt2", partition_count=4)
+    c = cluster.client("bt2")
+    _fill(c)
+    # kill the primary of partition 0 BEFORE starting: the start pass
+    # cannot reach it; meta's tick must re-drive against the cured primary
+    victim = cluster.meta.state.get_partition(app_id, 0).primary
+    cluster.kill(victim)
+    backup_id = cluster.meta.backup.start_backup("bt2",
+                                                 str(tmp_path / "b2"))
+    cluster.step(rounds=8)  # FD grace + cure + retry ticks
+    st = cluster.meta.backup.backup_status(backup_id)
+    assert st["complete"], st
+
+
+def test_restore_into_new_table(cluster, tmp_path):
+    cluster.create_table("src", partition_count=4)
+    c = cluster.client("src")
+    _fill(c, 50)
+    backup_id = cluster.meta.backup.start_backup("src",
+                                                 str(tmp_path / "b3"))
+    cluster.step(rounds=2)
+    assert cluster.meta.backup.backup_status(backup_id)["complete"]
+
+    cluster.meta.backup.create_app_from_backup(
+        "dst", str(tmp_path / "b3"), "manual", backup_id)
+    cluster.step(rounds=3)
+    assert not cluster.meta.pending_restores
+    c2 = cluster.client("dst")
+    for i in range(50):
+        assert c2.get(b"bk%03d" % i, b"s") == (OK, b"v%d" % i), i
+    # the guardian re-replicates the restored table back to 3 members,
+    # and the learners carry the RESTORED data
+    for _ in range(10):
+        cluster.step(rounds=2)
+        pcs = [cluster.meta.state.get_partition(c2.app_id, p)
+               for p in range(4)]
+        if all(len(pc.members()) == 3 for pc in pcs):
+            break
+    pcs = [cluster.meta.state.get_partition(c2.app_id, p)
+           for p in range(4)]
+    assert all(len(pc.members()) == 3 for pc in pcs)
+    from pegasus_tpu.base.key_schema import generate_key, key_hash_parts
+
+    key = generate_key(b"bk001", b"s")
+    pidx = key_hash_parts(b"bk001", b"s") % 4
+    for node in pcs[pidx].members():
+        r = cluster.stubs[node].get_replica((c2.app_id, pidx))
+        assert r.server.on_get(key) == (OK, b"v1"), node
+
+
+def test_meta_bulk_load_rolling_ingest(cluster, tmp_path):
+    """Offline SSTs -> meta-driven rolling ingestion through 2PC: every
+    member of every partition holds the loaded records."""
+    from pegasus_tpu.server.bulk_load import SSTGenerator
+    from pegasus_tpu.storage.block_service import LocalBlockService
+
+    app_id = cluster.create_table("blt", partition_count=4)
+    root = str(tmp_path / "staged")
+    gen = SSTGenerator(LocalBlockService(root), "blt", partition_count=4)
+    records = [(b"bl%04d" % i, b"s", b"val%d" % i, 0) for i in range(80)]
+    gen.generate(records)
+
+    cluster.meta.bulk_load.start_bulk_load("blt", root)
+    for _ in range(12):
+        cluster.step()
+        if cluster.meta.bulk_load.bulk_load_status("blt")["complete"]:
+            break
+    assert cluster.meta.bulk_load.bulk_load_status("blt")["complete"]
+    # group checks piggy-back the commit point so secondaries apply the
+    # (single, deduplicated) ingest mutation
+    cluster.step(rounds=2)
+    c = cluster.client("blt")
+    for i in range(80):
+        assert c.get(b"bl%04d" % i, b"s") == (OK, b"val%d" % i), i
+    # replicated: every member ingested at the same decree
+    from pegasus_tpu.base.key_schema import generate_key, key_hash_parts
+
+    key = generate_key(b"bl0001", b"s")
+    pidx = key_hash_parts(b"bl0001", b"s") % 4
+    pc = cluster.meta.state.get_partition(app_id, pidx)
+    assert len(pc.members()) == 3
+    for node in pc.members():
+        r = cluster.stubs[node].get_replica((app_id, pidx))
+        assert r.server.on_get(key) == (OK, b"val1"), node
+
+
+def test_bulk_load_survives_failover_midway(cluster, tmp_path):
+    from pegasus_tpu.server.bulk_load import SSTGenerator
+    from pegasus_tpu.storage.block_service import LocalBlockService
+
+    app_id = cluster.create_table("blf", partition_count=4)
+    root = str(tmp_path / "staged2")
+    gen = SSTGenerator(LocalBlockService(root), "blf", partition_count=4)
+    gen.generate([(b"f%04d" % i, b"s", b"v%d" % i, 0) for i in range(60)])
+
+    victim = cluster.meta.state.get_partition(app_id, 0).primary
+    cluster.meta.bulk_load.start_bulk_load("blf", root)
+    cluster.kill(victim)  # mid-operation crash
+    for _ in range(20):
+        cluster.step()
+        if cluster.meta.bulk_load.bulk_load_status("blf")["complete"]:
+            break
+    assert cluster.meta.bulk_load.bulk_load_status("blf")["complete"]
+    c = cluster.client("blf")
+    for i in range(60):
+        assert c.get(b"f%04d" % i, b"s") == (OK, b"v%d" % i), i
+
+
+def test_meta_duplication_ships_to_follower(cluster):
+    """Master table -> follower table through the wire: shipped writes ride
+    the follower's own 2PC, conflicts resolve by source timetag."""
+    cluster.create_table("master", partition_count=2)
+    cluster.create_table("follower", partition_count=4)  # different count
+    c = cluster.client("master")
+    for i in range(20):
+        assert c.set(b"d%03d" % i, b"s", b"v%d" % i) == OK
+    dupid = cluster.meta.duplication.add_duplication(
+        "master", "meta", "follower")
+    for _ in range(10):
+        cluster.step()
+    fc = cluster.client("follower")
+    for i in range(20):
+        assert fc.get(b"d%03d" % i, b"s") == (OK, b"v%d" % i), i
+    # progress synced to meta and persisted
+    dups = cluster.meta.duplication.query_duplication("master")
+    assert dups and dups[0]["dupid"] == dupid
+    assert all(v > 0 for v in dups[0]["progress"].values())
+    # writes made AFTER dup-add flow through too (tailing, not snapshot)
+    assert c.set(b"late", b"s", b"latev") == OK
+    for _ in range(6):
+        cluster.step()
+    assert fc.get(b"late", b"s") == (OK, b"latev")
+    # multi ops and deletes ship as well
+    assert c.multi_set(b"mh", {b"a": b"1", b"b": b"2"}) == OK
+    assert c.delete(b"d000", b"s") == OK
+    for _ in range(6):
+        cluster.step()
+    assert fc.multi_get(b"mh") == (OK, {b"a": b"1", b"b": b"2"})
+    assert fc.get(b"d000", b"s")[0] != OK
+
+
+def test_duplication_resumes_after_primary_failover(cluster):
+    app_id = cluster.create_table("m2", partition_count=2)
+    cluster.create_table("f2", partition_count=2)
+    c = cluster.client("m2")
+    for i in range(10):
+        assert c.set(b"x%03d" % i, b"s", b"v%d" % i) == OK
+    cluster.meta.duplication.add_duplication("m2", "meta", "f2")
+    for _ in range(6):
+        cluster.step()
+    # kill the primary of partition 0; new primary must resume shipping
+    # from the persisted confirmed decree
+    victim = cluster.meta.state.get_partition(app_id, 0).primary
+    cluster.kill(victim)
+    for _ in range(8):
+        cluster.step()
+    for i in range(10, 25):
+        assert c.set(b"x%03d" % i, b"s", b"v%d" % i) == OK
+    for _ in range(10):
+        cluster.step()
+    fc = cluster.client("f2")
+    for i in range(25):
+        assert fc.get(b"x%03d" % i, b"s") == (OK, b"v%d" % i), i
+
+
+def test_duplication_bootstrap_syncs_preexisting_data(cluster, tmp_path):
+    """DS_PREPARE parity: pre-existing data reaches the follower via a
+    checkpoint restore; incremental shipping resumes from the checkpoint
+    decrees (no replay of already-synced mutations, no gaps)."""
+    cluster.create_table("bm", partition_count=2)
+    c = cluster.client("bm")
+    for i in range(30):
+        assert c.set(b"p%03d" % i, b"s", b"v%d" % i) == OK
+    cluster.meta.duplication.add_duplication(
+        "bm", "meta", "bf", bootstrap_root=str(tmp_path / "boot"))
+    for _ in range(12):
+        cluster.step()
+    fc = cluster.client("bf")
+    for i in range(30):
+        assert fc.get(b"p%03d" % i, b"s") == (OK, b"v%d" % i), i
+    # incremental keeps flowing after bootstrap
+    assert c.set(b"after", b"s", b"av") == OK
+    for _ in range(6):
+        cluster.step()
+    assert fc.get(b"after", b"s") == (OK, b"av")
