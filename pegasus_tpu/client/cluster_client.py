@@ -59,6 +59,7 @@ _RETRYABLE = {
     int(ErrorCode.ERR_PARENT_PARTITION_MISUSED),
     int(ErrorCode.ERR_OBJECT_NOT_FOUND),
     int(ErrorCode.ERR_TIMEOUT),
+    int(ErrorCode.ERR_SPLITTING),
 }
 
 _OK = int(ErrorCode.ERR_OK)

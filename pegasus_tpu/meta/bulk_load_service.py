@@ -30,15 +30,22 @@ class MetaBulkLoadService:
         self.max_concurrent = max_concurrent
         # app_id -> {root, src_app, pending: [pidx], inflight: [pidx]}
         self._loads: Dict[int, dict] = {}
+        self._failed: Dict[int, str] = {}  # app_id -> failure reason
         self._load_state()
 
     def _load_state(self) -> None:
         raw = self.meta.state._storage.get("/bulk_load/inflight") or {}
         self._loads = {int(k): v for k, v in raw.items()}
+        fraw = self.meta.state._storage.get("/bulk_load/failed") or {}
+        self._failed = {int(k): v for k, v in fraw.items()}
 
     def _save(self) -> None:
-        self.meta.state._storage.set_batch({"/bulk_load/inflight": {
-            str(k): v for k, v in self._loads.items()}})
+        self.meta.state._storage.set_batch({
+            "/bulk_load/inflight": {str(k): v
+                                    for k, v in self._loads.items()},
+            "/bulk_load/failed": {str(k): v
+                                  for k, v in self._failed.items()},
+        })
 
     # ---- control surface ----------------------------------------------
 
@@ -51,6 +58,7 @@ class MetaBulkLoadService:
             raise PegasusError(ErrorCode.ERR_APP_NOT_EXIST, app_name)
         if app.app_id in self._loads:
             raise PegasusError(ErrorCode.ERR_BUSY, "bulk load in progress")
+        self._failed.pop(app.app_id, None)  # a fresh start clears failure
         src_app = src_app or app_name
         bs = LocalBlockService(root)
         info = json.loads(bs.read_file(f"{src_app}/{BULK_LOAD_INFO}"))
@@ -71,10 +79,16 @@ class MetaBulkLoadService:
         app = self.meta.state.find_app(app_name)
         if app is None:
             raise PegasusError(ErrorCode.ERR_APP_NOT_EXIST, app_name)
+        if app.app_id in self._failed:
+            return {"complete": False, "failed": True,
+                    "reason": self._failed[app.app_id],
+                    "pending": [], "inflight": []}
         info = self._loads.get(app.app_id)
         if info is None:
-            return {"complete": True, "pending": [], "inflight": []}
-        return {"complete": False, "pending": list(info["pending"]),
+            return {"complete": True, "failed": False,
+                    "pending": [], "inflight": []}
+        return {"complete": False, "failed": False,
+                "pending": list(info["pending"]),
                 "inflight": list(info["inflight"])}
 
     # ---- state machine -------------------------------------------------
@@ -108,7 +122,11 @@ class MetaBulkLoadService:
             return
         if payload.get("err", 0) != 0:
             # permanent per-partition failure (e.g. version mismatch):
-            # abort the whole load, matching the reference's BLS_FAILED
+            # abort the whole load with a VISIBLE failure record,
+            # matching the reference's BLS_FAILED state
+            self._failed[gpid[0]] = (
+                f"partition {gpid[1]} ingest failed "
+                f"(err {payload['err']})")
             del self._loads[gpid[0]]
             self._save()
             return

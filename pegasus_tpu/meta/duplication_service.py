@@ -73,30 +73,42 @@ class MetaDuplicationService:
         return dupid
 
     def _tick_bootstrap(self, dupid: int, info: dict) -> None:
-        """DS_PREPARE: wait for the master checkpoint, create the
-        follower table from it, seed progress with the checkpoint
-        decrees, then go incremental."""
+        """DS_PREPARE: wait for the master checkpoint, ask the follower
+        cluster's meta to create the table from it (RETRIED every tick
+        until its admin reply confirms — a dropped message or transient
+        error must not stall the dup forever), then seed progress with
+        the checkpoint decrees and go incremental."""
+        st = self.meta.backup.backup_status(info["backup_id"])
+        if not st["complete"]:
+            return
+        # re-send each tick until on_admin_reply flips the status; the
+        # follower's ERR_APP_EXIST makes the retry idempotent
+        self.meta.net.send(self.meta.name, info["follower_meta"],
+                           "admin", {
+                               "rid": f"dupboot-{dupid}",
+                               "cmd": "restore_app",
+                               "args": {
+                                   "new_name": info["follower_app"],
+                                   "root": info["bootstrap_root"],
+                                   "policy": f"dup{dupid}",
+                                   "backup_id": info["backup_id"]}})
+
+    def on_admin_reply(self, payload: dict) -> None:
+        """Completion signal for the bootstrap's restore_app verb."""
         import json as _json
 
         from pegasus_tpu.storage.block_service import LocalBlockService
 
-        st = self.meta.backup.backup_status(info["backup_id"])
-        if not st["complete"]:
+        rid = payload.get("rid")
+        if not isinstance(rid, str) or not rid.startswith("dupboot-"):
             return
+        dupid = int(rid.split("-", 1)[1])
+        info = self._dups.get(dupid)
+        if info is None or info["status"] != "bootstrap":
+            return
+        if payload["err"] not in (0, int(ErrorCode.ERR_APP_EXIST)):
+            return  # transient failure; the tick re-sends
         policy = f"dup{dupid}"
-        if not info["restore_sent"]:
-            # ask the follower cluster's meta to create the table from
-            # the checkpoint (same admin verb an operator would use)
-            self.meta.net.send(self.meta.name, info["follower_meta"],
-                               "admin", {
-                                   "rid": None, "cmd": "restore_app",
-                                   "args": {
-                                       "new_name": info["follower_app"],
-                                       "root": info["bootstrap_root"],
-                                       "policy": policy,
-                                       "backup_id": info["backup_id"]}})
-            info["restore_sent"] = True
-        # seed confirmed decrees from the checkpoint's per-partition meta
         bs = LocalBlockService(info["bootstrap_root"])
         for pidx_s in list(info["progress"]):
             meta_blob = _json.loads(bs.read_file(

@@ -61,9 +61,12 @@ class MetaService:
             MetaDuplicationService,
         )
 
+        from pegasus_tpu.meta.split_service import MetaSplitService
+
         self.backup = MetaBackupService(self)
         self.bulk_load = MetaBulkLoadService(self)
         self.duplication = MetaDuplicationService(self)
+        self.split = MetaSplitService(self)
         net.register(name, self.on_message)
 
     # ---- messages -----------------------------------------------------
@@ -99,10 +102,13 @@ class MetaService:
         if msg_type == "duplication_sync":
             self.duplication.on_duplication_sync(payload)
             return
+        if msg_type == "register_child":
+            self.split.on_register_child(src, payload)
+            return
         if msg_type == "admin_reply":
-            # replies to admin verbs THIS meta issued (e.g. dup bootstrap
-            # asking the follower cluster's meta to restore_app); the
-            # senders are fire-and-retry, so replies are informational
+            # replies to admin verbs THIS meta issued (dup bootstrap
+            # asking the follower cluster's meta to restore_app)
+            self.duplication.on_admin_reply(payload)
             return
         if msg_type == "query_config":
             # client partition-config resolution (parity: RPC_CM_QUERY_
@@ -134,6 +140,7 @@ class MetaService:
         self.backup.tick()
         self.bulk_load.tick()
         self.duplication.tick()
+        self.split.tick()
 
     # ---- restore bookkeeping ------------------------------------------
 
@@ -206,6 +213,11 @@ class MetaService:
             elif cmd == "remove_dup":
                 result = self.duplication.remove_duplication(
                     args["dupid"])
+            elif cmd == "start_partition_split":
+                result = self.split.start_partition_split(
+                    args["app_name"])
+            elif cmd == "split_status":
+                result = self.split.split_status(args["app_name"])
             else:
                 self.net.send(self.name, src, "admin_reply", {
                     "rid": rid,
@@ -216,12 +228,19 @@ class MetaService:
             self.net.send(self.name, src, "admin_reply", {
                 "rid": rid, "err": int(e.code), "result": str(e)})
             return
-        except (KeyError, TypeError) as e:
+        except (KeyError, TypeError, ValueError) as e:
             # malformed request: reply immediately instead of letting the
             # client burn its full timeout waiting for nothing
             self.net.send(self.name, src, "admin_reply", {
                 "rid": rid, "err": int(ErrorCode.ERR_INVALID_PARAMETERS),
                 "result": f"bad admin args: {e}"})
+            return
+        except OSError as e:
+            # e.g. a wrong bucket path handed to start_bulk_load/restore
+            self.net.send(self.name, src, "admin_reply", {
+                "rid": rid,
+                "err": int(ErrorCode.ERR_FILE_OPERATION_FAILED),
+                "result": str(e)})
             return
         self.net.send(self.name, src, "admin_reply", {
             "rid": rid, "err": int(ErrorCode.ERR_OK), "result": result})

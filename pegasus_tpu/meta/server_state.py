@@ -114,5 +114,16 @@ class ServerState:
         self._storage.set(f"/apps/{app_id}/{pidx}", pc.to_json())
         self.configs[app_id][pidx] = pc
 
+    def set_partition_raw(self, app_id: int, pidx: int,
+                          pc: PartitionConfig) -> None:
+        """update_partition for an index beyond the app's current count —
+        partition split registers child configs BEFORE the count flips
+        (parity: meta_split_service child registration)."""
+        self._storage.set(f"/apps/{app_id}/{pidx}", pc.to_json())
+        configs = self.configs[app_id]
+        while len(configs) <= pidx:
+            configs.append(PartitionConfig())
+        configs[pidx] = pc
+
     def get_partition(self, app_id: int, pidx: int) -> PartitionConfig:
         return self.configs[app_id][pidx]

@@ -1,0 +1,125 @@
+"""Meta-side partition split orchestration.
+
+Parity: src/meta/meta_split_service.h:34 — drives the in-place 2x
+partition-count doubling: commands every parent partition's primary to
+spawn its child (replica_split_manager.h:58 does the replica-side state
+copy + catch-up), registers each child partition as it reports in, and
+flips the app's partition count once EVERY child is registered. The
+flip propagates through config proposals; parents drop their write
+fence on receiving the new count, and clients pick it up via the
+partition-hash gate + config refresh (ERR_PARENT_PARTITION_MISUSED).
+
+Split state is persisted: a meta restart mid-split keeps driving it.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+from pegasus_tpu.meta.server_state import PartitionConfig
+from pegasus_tpu.utils.errors import ErrorCode, PegasusError
+
+
+class MetaSplitService:
+    def __init__(self, meta) -> None:
+        self.meta = meta
+        # app_id -> {old_count, new_count, registered: [child_pidx]}
+        self._splits: Dict[int, dict] = {}
+        self._load()
+
+    def _load(self) -> None:
+        raw = self.meta.state._storage.get("/split/inflight") or {}
+        self._splits = {int(k): v for k, v in raw.items()}
+
+    def _save(self) -> None:
+        self.meta.state._storage.set_batch({"/split/inflight": {
+            str(k): v for k, v in self._splits.items()}})
+
+    # ---- control surface (parity: RPC_CM_START_PARTITION_SPLIT) --------
+
+    def start_partition_split(self, app_name: str) -> int:
+        app = self.meta.state.find_app(app_name)
+        if app is None:
+            raise PegasusError(ErrorCode.ERR_APP_NOT_EXIST, app_name)
+        if app.app_id in self._splits:
+            raise PegasusError(ErrorCode.ERR_SPLITTING, app_name)
+        if app.partition_count & (app.partition_count - 1):
+            raise PegasusError(
+                ErrorCode.ERR_INVALID_PARAMETERS,
+                "split requires a power-of-two partition count")
+        self._splits[app.app_id] = {
+            "old_count": app.partition_count,
+            "new_count": app.partition_count * 2,
+            "registered": [],
+        }
+        self._save()
+        self._drive(app.app_id)
+        return app.partition_count * 2
+
+    def split_status(self, app_name: str) -> dict:
+        app = self.meta.state.find_app(app_name)
+        if app is None:
+            raise PegasusError(ErrorCode.ERR_APP_NOT_EXIST, app_name)
+        info = self._splits.get(app.app_id)
+        if info is None:
+            return {"splitting": False,
+                    "partition_count": app.partition_count}
+        return {"splitting": True, "old_count": info["old_count"],
+                "registered": sorted(info["registered"])}
+
+    # ---- driving -------------------------------------------------------
+
+    def _drive(self, app_id: int) -> None:
+        info = self._splits.get(app_id)
+        if info is None:
+            return
+        for pidx in range(info["old_count"]):
+            child_pidx = pidx + info["old_count"]
+            if child_pidx in info["registered"]:
+                continue
+            pc = self.meta.state.get_partition(app_id, pidx)
+            if not pc.primary:
+                continue
+            self.meta.net.send(self.meta.name, pc.primary, "start_split", {
+                "gpid": (app_id, pidx),
+                "child_gpid": (app_id, child_pidx),
+                "new_count": info["new_count"]})
+
+    def on_register_child(self, src: str, payload: dict) -> None:
+        """Parity: register_child_on_meta — the child partition enters the
+        cluster state; the count flips once every child is in."""
+        child = tuple(payload["child_gpid"])
+        app_id = child[0]
+        info = self._splits.get(app_id)
+        if info is None:
+            return
+        app = self.meta.state.apps.get(app_id)
+        if app is None:
+            return
+        if child[1] not in info["registered"]:
+            info["registered"].append(child[1])
+            # the child starts primary-only on the node that built it;
+            # the guardian restores the replication level after the flip
+            self.meta.state.set_partition_raw(
+                app_id, child[1],
+                PartitionConfig(ballot=1, primary=payload["primary"],
+                                secondaries=[]))
+            self._save()
+        if len(info["registered"]) == info["old_count"]:
+            self._finish(app_id, info)
+
+    def _finish(self, app_id: int, info: dict) -> None:
+        app = self.meta.state.apps[app_id]
+        app.partition_count = info["new_count"]
+        self.meta.state.put_app(app)
+        del self._splits[app_id]
+        self._save()
+        # propagate the flip: every partition (parents AND children) gets
+        # a proposal carrying the new count; parents unfence on receipt
+        for pidx in range(info["new_count"]):
+            pc = self.meta.state.get_partition(app_id, pidx)
+            self.meta._propose(app_id, pidx, pc)
+
+    def tick(self) -> None:
+        for app_id in list(self._splits):
+            self._drive(app_id)

@@ -137,6 +137,8 @@ class Replica:
         self._learn_ckpt_dirs: Dict[str, str] = {}  # learner -> frozen ckpt
         # reads/checkpoints gate on this after a promotion (replica.cpp:426)
         self._promotion_watermark = 0
+        # lazily hydrated from the .ingested_loads marker (bulk load dedup)
+        self._ingested_load_ids: Set[int] = set()
         # callbacks to the control plane (meta); tests wire these
         self.on_learn_completed: Optional[Callable[[str], None]] = None
         self.on_replication_error: Optional[Callable[[str, int], None]] = None
@@ -468,6 +470,32 @@ class Replica:
         if callback is not None:
             callback(responses)
 
+    def has_ingested(self, load_id: int) -> bool:
+        """Group-visible ingest dedup: the marker is written by EVERY
+        member at apply time, so whoever becomes primary after a failover
+        knows the load already committed and will not replicate a second
+        OP_INGEST (which could resurrect keys deleted in between)."""
+        if load_id in self._ingested_load_ids:
+            return True
+        marker = os.path.join(self.data_dir, ".ingested_loads")
+        if os.path.exists(marker):
+            import json as _json
+
+            with open(marker) as f:
+                self._ingested_load_ids = set(_json.load(f))
+        return load_id in self._ingested_load_ids
+
+    def _record_ingested(self, load_id: int) -> None:
+        import json as _json
+
+        self.has_ingested(load_id)  # hydrate from disk first
+        self._ingested_load_ids.add(load_id)
+        marker = os.path.join(self.data_dir, ".ingested_loads")
+        tmp = marker + ".tmp"
+        with open(tmp, "w") as f:
+            _json.dump(sorted(self._ingested_load_ids), f)
+        os.replace(tmp, marker)
+
     def _apply_ingest(self, request, decree: int) -> int:
         """Download this partition's staged SST and ingest it at `decree`."""
         import json as _json
@@ -480,7 +508,12 @@ class Replica:
         from pegasus_tpu.storage.block_service import LocalBlockService
         from pegasus_tpu.utils.errors import StorageStatus
 
-        root, src_app = request
+        root, src_app, load_id = request
+        if self.has_ingested(load_id):
+            # replayed or duplicated ingest mutation: decree advances,
+            # data does not re-apply
+            self.server.write_service.apply_items([], decree)
+            return int(StorageStatus.OK)
         bs = LocalBlockService(root)
         info = _json.loads(bs.read_file(f"{src_app}/{BULK_LOAD_INFO}"))
         if info["partition_count"] != self.server.partition_count:
@@ -497,6 +530,7 @@ class Replica:
                 local = os.path.join(tmp, "ingest.sst")
                 bs.download(remote, local)
                 self.server.engine.ingest_sst_file(local, decree)
+            self._record_ingested(load_id)
         except (OSError, ValueError):
             # staged files must stay immutable+present for the whole load
             # (same contract as the reference). If they vanish mid-apply,

@@ -51,7 +51,8 @@ class ReplicaStub:
         # a second copy of an in-flight backup/ingest must be ignored
         self._backup_inflight: set = set()
         self._ingest_inflight: set = set()
-        self._ingested_loads: set = set()
+        # parent gpid -> split session state (see _split_advance)
+        self._split_sessions: Dict[Gpid, dict] = {}
         self._last_beacon_ack = float("-inf")
         net.register(name, self.on_message)
         # load existing replica dirs (parity: replica_stub boot scan,
@@ -143,6 +144,9 @@ class ReplicaStub:
         if msg_type == "trigger_ingest":
             self._on_trigger_ingest(src, payload)
             return
+        if msg_type == "start_split":
+            self._on_start_split(src, payload)
+            return
         if msg_type == "dup_add":
             self._on_dup_add(src, payload)
             return
@@ -196,6 +200,13 @@ class ReplicaStub:
         gpid = tuple(payload["gpid"])
         rid = payload["rid"]
         r = self.replicas.get(gpid)
+        if r is not None and getattr(r, "splitting", False):
+            # write fence during the split's final catch-up (parity: the
+            # reference fences the parent before the count flip)
+            self.net.send(self.name, src, "client_write_reply", {
+                "rid": rid, "err": int(ErrorCode.ERR_SPLITTING),
+                "results": []})
+            return
         if (r is None or r.status != PartitionStatus.PRIMARY
                 or getattr(r, "restoring", False)
                 or not self.lease_valid()):
@@ -307,6 +318,21 @@ class ReplicaStub:
             # lands, or a stray early write would make the idempotence
             # check misread the partition as already restored
             r.restoring = True
+        new_count = payload.get("partition_count", 1)
+        if new_count > r.server.partition_count:
+            # the split's group count flip (meta_split_service _finish):
+            # routing + the stale-half predicate switch to the new count,
+            # the write fence lifts, and the split session retires
+            r.server.update_partition_count(new_count)
+            import json as _json
+
+            info_path = os.path.join(self._replica_dir(gpid),
+                                     ".replica_info")
+            with open(info_path, "w") as f:
+                _json.dump({"app_id": gpid[0], "pidx": gpid[1],
+                            "partition_count": new_count}, f)
+            r.splitting = False
+            self._split_sessions.pop(gpid, None)
         r.assign_config(config)
 
     def _on_add_learner_cmd(self, src: str, payload: dict) -> None:
@@ -342,16 +368,34 @@ class ReplicaStub:
         if key in self._backup_inflight:
             return  # meta re-sends until done; one upload is enough
         self._backup_inflight.add(key)
+        # checkpoint HERE (needs engine serialization with applies);
+        # the slow upload runs off the dispatcher so beacons/prepares
+        # keep flowing during a large backup
+        import shutil
+        import tempfile
+
+        ckpt_dir = tempfile.mkdtemp(prefix="pegbk")
         try:
-            engine = BackupEngine(LocalBlockService(payload["root"]),
-                                  payload["policy"])
-            decree = engine.backup_partition(payload["backup_id"], gpid[0],
-                                             gpid[1], r.server.engine)
-        finally:
+            decree = r.server.engine.checkpoint(ckpt_dir)
+        except Exception:
+            shutil.rmtree(ckpt_dir, ignore_errors=True)
             self._backup_inflight.discard(key)
-        self.net.send(self.name, src, "backup_partition_done", {
-            "gpid": gpid, "backup_id": payload["backup_id"],
-            "decree": decree})
+            raise
+
+        def upload() -> None:
+            try:
+                engine = BackupEngine(LocalBlockService(payload["root"]),
+                                      payload["policy"])
+                engine.upload_checkpoint(payload["backup_id"], gpid[0],
+                                         gpid[1], ckpt_dir, decree)
+                self.net.send(self.name, src, "backup_partition_done", {
+                    "gpid": gpid, "backup_id": payload["backup_id"],
+                    "decree": decree})
+            finally:
+                shutil.rmtree(ckpt_dir, ignore_errors=True)
+                self._backup_inflight.discard(key)
+
+        self.net.offload(upload)
 
     def _on_restore_partition(self, src: str, payload: dict) -> None:
         from pegasus_tpu.replica.replica import PartitionStatus
@@ -394,11 +438,13 @@ class ReplicaStub:
         r = self.replicas.get(gpid)
         if r is None or r.status != PartitionStatus.PRIMARY:
             return  # meta's tick retries against the current primary
-        key = (gpid, payload.get("load_id", 0))
-        if key in self._ingested_loads:
-            # done message to meta was lost; re-ack WITHOUT re-ingesting —
-            # a second OP_INGEST at a later decree would resurrect keys
-            # deleted since the first one
+        load_id = payload.get("load_id", 0)
+        key = (gpid, load_id)
+        if r.has_ingested(load_id):
+            # the load already committed groupwide (the marker is written
+            # by every member at apply, so it survives failovers); re-ack
+            # WITHOUT re-ingesting — a second OP_INGEST at a later decree
+            # would resurrect keys deleted since the first one
             self.net.send(self.name, src, "ingest_done",
                           {"gpid": gpid, "err": 0})
             return
@@ -408,8 +454,6 @@ class ReplicaStub:
         def done(results) -> None:
             self._ingest_inflight.discard(key)
             err = results[0] if results else 0
-            if err == 0:
-                self._ingested_loads.add(key)
             self.net.send(self.name, src, "ingest_done", {
                 "gpid": gpid, "err": err})
 
@@ -417,9 +461,93 @@ class ReplicaStub:
         try:
             r.client_write(
                 [WriteOp(OP_INGEST,
-                         (payload["root"], payload["src_app"]))], done)
+                         (payload["root"], payload["src_app"], load_id))],
+                done)
         except (RuntimeError, ValueError):
             self._ingest_inflight.discard(key)
+
+    # ---- partition split (parity: replica_split_manager.h:58 — the
+    # replica-side parent/child state copy + catch-up; meta owns the
+    # group count flip) --------------------------------------------------
+
+    def _on_start_split(self, src: str, payload: dict) -> None:
+        from pegasus_tpu.replica.replica import PartitionStatus
+
+        gpid = tuple(payload["gpid"])
+        r = self.replicas.get(gpid)
+        if r is None or r.status != PartitionStatus.PRIMARY:
+            return  # meta retries against the current primary
+        if gpid in self._split_sessions:
+            return  # already in progress on this node
+        self._split_sessions[gpid] = {
+            "phase": "ckpt", "child_gpid": tuple(payload["child_gpid"]),
+            "new_count": payload["new_count"], "ckpt_decree": 0,
+        }
+        self._split_advance(gpid)
+
+    def split_tick(self) -> None:
+        """Timer: advance split sessions (drain waits on the in-flight
+        window; register re-sends until the flip proposal lands)."""
+        for gpid in list(self._split_sessions):
+            self._split_advance(gpid)
+
+    def _split_advance(self, gpid: Gpid) -> None:
+        import shutil
+
+        from pegasus_tpu.replica.replica import PartitionStatus
+
+        sess = self._split_sessions.get(gpid)
+        if sess is None:
+            return
+        r = self.replicas.get(gpid)
+        if r is None or r.status != PartitionStatus.PRIMARY:
+            # lost primaryship mid-split: abandon; meta re-drives the new
+            # primary, whose own checkpoint supersedes this half-built one
+            r2 = self.replicas.get(gpid)
+            if r2 is not None:
+                r2.splitting = False
+            del self._split_sessions[gpid]
+            return
+        child_gpid = sess["child_gpid"]
+        if sess["phase"] == "ckpt":
+            # phase 1 — checkpoint copy WITHOUT a write fence (bulk of the
+            # data moves while writes continue)
+            child_dir = self._replica_dir(child_gpid)
+            shutil.rmtree(child_dir, ignore_errors=True)
+            os.makedirs(os.path.join(child_dir, "app"), exist_ok=True)
+            sess["ckpt_decree"] = r.server.engine.checkpoint(
+                os.path.join(child_dir, "app", "sst"))
+            # phase 2 — fence writes (clients get ERR_SPLITTING, retry);
+            # only the small log tail remains to move
+            r.splitting = True
+            sess["phase"] = "drain"
+        if sess["phase"] == "drain":
+            if r.last_committed_decree < r.last_prepared_decree():
+                return  # in-flight window still committing; tick retries
+            child = self._open_replica(child_gpid, sess["new_count"])
+            # replay the post-checkpoint tail THROUGH the child's own
+            # prepare/commit pipeline: the child is born with a proper
+            # plog and the exact apply semantics (atomic-op determinism)
+            from pegasus_tpu.replica.mutation import Mutation  # noqa: F401
+
+            for mu in r.log.read_range(sess["ckpt_decree"] + 1,
+                                       r.last_committed_decree):
+                child.prepare_list.prepare(mu)
+                child.log.append(mu)
+            from pegasus_tpu.replica.prepare_list import (
+                COMMIT_TO_DECREE_HARD,
+            )
+
+            child.prepare_list.commit(r.last_committed_decree,
+                                      COMMIT_TO_DECREE_HARD)
+            sess["phase"] = "register"
+        if sess["phase"] == "register":
+            if self.meta_addr is not None:
+                self.net.send(self.name, self.meta_addr, "register_child", {
+                    "gpid": gpid, "child_gpid": child_gpid,
+                    "primary": self.name})
+            # stays in register until the flip proposal arrives
+            # (_on_config_proposal clears the session + the fence)
 
     # ---- duplication (parity: duplication_sync_timer driving the
     # replica-side pipeline; meta owns WHICH partitions duplicate) -------

@@ -129,9 +129,9 @@ def encode_write(op: int, req: Any) -> bytes:
                 + struct.pack("<i", req.set_expire_ts_seconds)
                 + bytes([int(req.return_check_value)]))
     if op == OP_INGEST:
-        root, src_app = req
+        root, src_app, load_id = req
         return (bytes([OP_INGEST]) + _blob(root.encode())
-                + _blob(src_app.encode()))
+                + _blob(src_app.encode()) + struct.pack("<Q", load_id))
     if op == OP_DUP_PUT:
         key, user_data, expire_ts, timetag = req
         return (bytes([OP_DUP_PUT]) + _blob(key) + _blob(user_data)
@@ -202,7 +202,8 @@ def decode_write(data: bytes, pos: int = 0) -> Tuple[int, Any, int]:
     if op == OP_INGEST:
         root = r.blob().decode()
         src_app = r.blob().decode()
-        return op, (root, src_app), r.pos
+        load_id = r.i64() & 0xFFFFFFFFFFFFFFFF
+        return op, (root, src_app, load_id), r.pos
     if op == OP_DUP_PUT:
         key = r.blob()
         user_data = r.blob()

@@ -96,6 +96,8 @@ class TcpTransport:
         # peer's sender thread so a dead peer never stalls handlers/timers
         frame = encode_message(src, dst, msg_type, payload)
         with self._outboxes_lock:
+            if self._closing:
+                return  # late send: spawning a sender now would leak it
             box = self._peer_outboxes.get(dst)
             if box is None:
                 box = queue.Queue()
@@ -116,11 +118,13 @@ class TcpTransport:
                 self._drop_route(dst)  # loss; protocols retry
 
     def close(self) -> None:
-        self._closing = True
-        self._inbox.put(None)
         with self._outboxes_lock:
+            # flag set under the lock: send() cannot race a new sender
+            # thread into existence after the sentinels go out
+            self._closing = True
             for box in self._peer_outboxes.values():
                 box.put(None)
+        self._inbox.put(None)
         if self._listener is not None:
             try:
                 self._listener.close()
@@ -133,6 +137,23 @@ class TcpTransport:
                 except OSError:
                     pass
             self._routes.clear()
+
+    def offload(self, fn: Callable[[], None]) -> None:
+        """Run slow IO (block-service uploads/downloads) off the
+        dispatcher: handlers run under the node lock, and a long upload
+        there would stall beacons, prepares, and client traffic —
+        demoting the node's primaries mid-backup (the reference runs
+        these on THREAD_POOL_REPLICATION_LONG)."""
+
+        def run() -> None:
+            try:
+                fn()
+            except Exception:  # noqa: BLE001 - background op must not
+                import traceback  # kill silently with no trace
+
+                traceback.print_exc()
+
+        self._spawn(run)
 
     # ---- timers --------------------------------------------------------
 

@@ -80,6 +80,11 @@ class SimNetwork:
         """handler(src, msg_type, payload)"""
         self._handlers[addr] = handler
 
+    def offload(self, fn: Callable[[], None]) -> None:
+        """Run slow IO 'in the background': inline here (determinism is
+        the sim's whole point), a real thread on the TCP transport."""
+        fn()
+
     def set_drop(self, prob: float, src: Optional[str] = None,
                  dst: Optional[str] = None) -> None:
         key = None if src is None and dst is None else (src, dst)

@@ -49,15 +49,22 @@ class BackupEngine:
         """Checkpoint one partition and upload it. Returns the decree."""
         with tempfile.TemporaryDirectory(prefix="pegbk") as tmp:
             decree = engine.checkpoint(tmp)
-            base = f"{self.policy_name}/{backup_id}/{app_id}/{pidx}"
-            files = []
-            for name in sorted(os.listdir(tmp)):
-                with open(os.path.join(tmp, name), "rb") as f:
-                    self.bs.write_file(f"{base}/{name}", f.read())
-                files.append(name)
-            self.bs.write_file(f"{base}/meta.json", json.dumps({
-                "decree": decree, "files": files}).encode())
+            self.upload_checkpoint(backup_id, app_id, pidx, tmp, decree)
             return decree
+
+    def upload_checkpoint(self, backup_id: int, app_id: int, pidx: int,
+                          ckpt_dir: str, decree: int) -> None:
+        """Upload a materialized checkpoint dir (the slow half — safe to
+        run off the replica's dispatch thread; only the checkpoint itself
+        needs engine serialization)."""
+        base = f"{self.policy_name}/{backup_id}/{app_id}/{pidx}"
+        files = []
+        for name in sorted(os.listdir(ckpt_dir)):
+            with open(os.path.join(ckpt_dir, name), "rb") as f:
+                self.bs.write_file(f"{base}/{name}", f.read())
+            files.append(name)
+        self.bs.write_file(f"{base}/meta.json", json.dumps({
+            "decree": decree, "files": files}).encode())
 
     def finish_backup(self, backup_id: int, app_id: int, app_name: str,
                       partition_count: int) -> None:

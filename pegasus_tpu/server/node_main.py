@@ -79,6 +79,7 @@ def run_node(cfg: dict, name: str) -> None:
 
         transport.run_timer(1.0, group_checks)
         transport.run_timer(1.0, stub.dup_tick)
+        transport.run_timer(1.0, stub.split_tick)
         print(f"[{name}] replica serving on {node_cfg['host']}:"
               f"{node_cfg['port']}", flush=True)
     else:

@@ -83,6 +83,7 @@ class SimCluster:
                         if r.status == PartitionStatus.PRIMARY:
                             r.broadcast_group_check()
                     stub.dup_tick()
+                    stub.split_tick()
             self.loop.run_for(self.beacon_interval)
             self.meta.tick()
         self.loop.run_until_idle()

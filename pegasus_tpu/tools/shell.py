@@ -100,6 +100,40 @@ def main(argv=None) -> int:
     p.add_argument("--backup_id", type=int, required=True)
     p.add_argument("--new_name", default=None)
 
+    # meta-orchestrated ops (wire mode; parity: the shell's backup/dup/
+    # split/bulk-load admin verbs over ddl_client)
+    p = sub.add_parser("start_backup")
+    p.add_argument("table")
+    p.add_argument("--bucket", required=True)
+    p.add_argument("--policy", default="manual")
+    p = sub.add_parser("query_backup")
+    p.add_argument("backup_id", type=int)
+    p = sub.add_parser("restore_app")
+    p.add_argument("new_name")
+    p.add_argument("--bucket", required=True)
+    p.add_argument("--policy", default="manual")
+    p.add_argument("--backup_id", type=int, required=True)
+    p = sub.add_parser("start_bulk_load")
+    p.add_argument("table")
+    p.add_argument("--bucket", required=True)
+    p.add_argument("--staged_app", default=None)
+    p = sub.add_parser("query_bulk_load")
+    p.add_argument("table")
+    p = sub.add_parser("add_dup")
+    p.add_argument("table")
+    p.add_argument("follower_app")
+    p.add_argument("--follower_meta", default="meta")
+    p = sub.add_parser("query_dup")
+    p.add_argument("table")
+    p = sub.add_parser("remove_dup")
+    p.add_argument("dupid", type=int)
+    p = sub.add_parser("start_split")
+    p.add_argument("table")
+    p = sub.add_parser("query_split")
+    p.add_argument("table")
+    p = sub.add_parser("nodes")
+    p = sub.add_parser("rebalance")
+
     args = parser.parse_args(argv)
 
     if (args.root is None) == (args.cluster is None):
@@ -311,6 +345,50 @@ def _dispatch(args, box, out) -> int:
         be.finish_backup(args.backup_id, t.app_id, args.table,
                          t.partition_count)
         print(f"OK: backup {args.backup_id}", file=out)
+    elif args.cmd == "start_backup":
+        bid = box.admin.call("start_backup", app_name=args.table,
+                             root=args.bucket, policy=args.policy)
+        print(f"OK: backup {bid} started", file=out)
+    elif args.cmd == "query_backup":
+        print(json.dumps(box.admin.call("backup_status",
+                                        backup_id=args.backup_id)),
+              file=out)
+    elif args.cmd == "restore_app":
+        app_id = box.admin.call("restore_app", new_name=args.new_name,
+                                root=args.bucket, policy=args.policy,
+                                backup_id=args.backup_id)
+        print(f"OK: restoring into {args.new_name} (app {app_id})",
+              file=out)
+    elif args.cmd == "start_bulk_load":
+        box.admin.call("start_bulk_load", app_name=args.table,
+                       root=args.bucket, src_app=args.staged_app)
+        print("OK: bulk load started", file=out)
+    elif args.cmd == "query_bulk_load":
+        print(json.dumps(box.admin.call("bulk_load_status",
+                                        app_name=args.table)), file=out)
+    elif args.cmd == "add_dup":
+        dupid = box.admin.call("add_dup", app_name=args.table,
+                               follower_meta=args.follower_meta,
+                               follower_app=args.follower_app)
+        print(f"OK: dup {dupid}", file=out)
+    elif args.cmd == "query_dup":
+        print(json.dumps(box.admin.call("query_dup",
+                                        app_name=args.table)), file=out)
+    elif args.cmd == "remove_dup":
+        box.admin.call("remove_dup", dupid=args.dupid)
+        print("OK", file=out)
+    elif args.cmd == "start_split":
+        n = box.admin.call("start_partition_split", app_name=args.table)
+        print(f"OK: splitting to {n} partitions", file=out)
+    elif args.cmd == "query_split":
+        print(json.dumps(box.admin.call("split_status",
+                                        app_name=args.table)), file=out)
+    elif args.cmd == "nodes":
+        for n in box.admin.call("list_nodes"):
+            print(n, file=out)
+    elif args.cmd == "rebalance":
+        n = box.admin.call("rebalance")
+        print(f"OK: {n} proposals", file=out)
     elif args.cmd == "restore":
         if isinstance(box, _ClusterBox):
             raise NotImplementedError(

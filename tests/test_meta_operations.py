@@ -232,3 +232,79 @@ def test_duplication_bootstrap_syncs_preexisting_data(cluster, tmp_path):
     for _ in range(6):
         cluster.step()
     assert fc.get(b"after", b"s") == (OK, b"av")
+
+
+def test_replica_protocol_split_doubles_partitions(cluster):
+    """Meta-driven online split: children copy parent state + log tail,
+    register, count flips, stale halves filter out — no data loss and no
+    table-wide rewrite."""
+    app_id = cluster.create_table("sp", partition_count=2)
+    c = cluster.client("sp")
+    for i in range(60):
+        assert c.set(b"s%03d" % i, b"s", b"v%d" % i) == OK
+    assert cluster.meta.split.start_partition_split("sp") == 4
+    for _ in range(12):
+        cluster.step()
+        if not cluster.meta.split.split_status("sp")["splitting"]:
+            break
+    assert not cluster.meta.split.split_status("sp")["splitting"]
+    assert cluster.meta.state.apps[app_id].partition_count == 4
+    # every record readable through the NEW routing
+    c.refresh_config()
+    assert c.partition_count == 4
+    for i in range(60):
+        assert c.get(b"s%03d" % i, b"s") == (OK, b"v%d" % i), i
+    # scans see exactly the records (stale halves masked)
+    seen = set()
+    for sc in c.get_unordered_scanners(4):
+        for hk, sk, v in sc:
+            seen.add(hk)
+    assert len(seen) == 60
+    # new writes land on children when routed there
+    for i in range(60, 80):
+        assert c.set(b"s%03d" % i, b"s", b"v%d" % i) == OK
+    for i in range(60, 80):
+        assert c.get(b"s%03d" % i, b"s") == (OK, b"v%d" % i)
+
+
+def test_split_under_concurrent_writes_no_loss(cluster):
+    """Writes racing the split either land pre-checkpoint (copied), get
+    fenced+retried (ERR_SPLITTING -> client retry), or land post-flip
+    (new routing) — every ack survives."""
+    cluster.create_table("spw", partition_count=2)
+    c = cluster.client("spw")
+    acked = []
+    for i in range(20):
+        if c.set(b"w%03d" % i, b"s", b"v%d" % i) == OK:
+            acked.append(i)
+    cluster.meta.split.start_partition_split("spw")
+    # interleave writes with split progress
+    for i in range(20, 50):
+        if c.set(b"w%03d" % i, b"s", b"v%d" % i) == OK:
+            acked.append(i)
+        cluster.step()
+    for _ in range(10):
+        cluster.step()
+        if not cluster.meta.split.split_status("spw")["splitting"]:
+            break
+    assert not cluster.meta.split.split_status("spw")["splitting"]
+    assert len(acked) == 50
+    for i in acked:
+        assert c.get(b"w%03d" % i, b"s") == (OK, b"v%d" % i), i
+
+
+def test_split_survives_parent_primary_failover(cluster):
+    app_id = cluster.create_table("spf", partition_count=2)
+    c = cluster.client("spf")
+    for i in range(30):
+        assert c.set(b"f%03d" % i, b"s", b"v%d" % i) == OK
+    victim = cluster.meta.state.get_partition(app_id, 0).primary
+    cluster.meta.split.start_partition_split("spf")
+    cluster.kill(victim)  # mid-split crash of a parent primary
+    for _ in range(25):
+        cluster.step()
+        if not cluster.meta.split.split_status("spf")["splitting"]:
+            break
+    assert not cluster.meta.split.split_status("spf")["splitting"]
+    for i in range(30):
+        assert c.get(b"f%03d" % i, b"s") == (OK, b"v%d" % i), i

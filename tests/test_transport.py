@@ -138,12 +138,20 @@ def test_multiprocess_onebox(tmp_path):
                     acked.append(i)
             except PegasusError:
                 pass
-        for i in acked:
-            assert c.get(b"k%02d" % i, b"s") == (0, b"v%d" % i), i
-        c.refresh_config()
+        # wait for the guardian cure to finish before verifying (the
+        # FD grace + cure can take >10s on a loaded machine)
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            c.refresh_config()
+            if all(victim not in [pc["primary"]] + pc["secondaries"]
+                   and pc["primary"] for pc in c._configs):
+                break
+            time.sleep(1)
         for pc in c._configs:
             assert victim not in [pc["primary"]] + pc["secondaries"]
             assert pc["primary"]
+        for i in acked:
+            assert c.get(b"k%02d" % i, b"s") == (0, b"v%d" % i), i
     finally:
         if admin is not None:
             admin.close()
