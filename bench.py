@@ -25,6 +25,7 @@ record rather than a traceback.
 
 Env knobs: PEGBENCH_RECORDS (default 100_000), PEGBENCH_OPS (default 1200),
 PEGBENCH_PARTITIONS (default 64), PEGBENCH_SEED, PEGBENCH_COMPACT=1,
+PEGBENCH_GEO=1 (radius-search phase, BASELINE row 5),
 PEGBENCH_PROBE_TIMEOUT (s, default 180), PEGBENCH_PROBE_RETRIES (default 4).
 """
 
@@ -269,6 +270,43 @@ def measure_compaction(jax, device, bc, mode: str):
     return size_before / max(secs, 1e-9), secs
 
 
+def measure_geo(jax, device, n_points=20_000, n_searches=150, seed=11):
+    """Geo radius-search ops/sec (BASELINE config #5): cell-cover prefix
+    scans + one batched device distance predicate per search."""
+    import numpy as np
+
+    from pegasus_tpu.client import PegasusClient, Table
+    from pegasus_tpu.geo import GeoClient
+
+    rng = np.random.default_rng(seed)
+    with tempfile.TemporaryDirectory(prefix="peggeo") as tmp:
+        raw = Table(os.path.join(tmp, "raw"), app_id=1, partition_count=8)
+        idx = Table(os.path.join(tmp, "idx"), app_id=2, partition_count=8)
+        geo = GeoClient(PegasusClient(raw), PegasusClient(idx))
+        # ~20km x 20km urban box around (40, -74)
+        lats = 40.0 + (rng.random(n_points) - 0.5) * 0.18
+        lngs = -74.0 + (rng.random(n_points) - 0.5) * 0.24
+        for i in range(n_points):
+            geo.set(b"poi%06d" % i, b"s",
+                    b"%f|%f|poi-%d" % (lats[i], lngs[i], i))
+        raw.flush_all()
+        idx.flush_all()
+        centers = rng.integers(0, n_points, size=n_searches)
+        with jax.default_device(device):
+            # warmup (compile)
+            geo.search_radial(float(lats[centers[0]]),
+                              float(lngs[centers[0]]), 500)
+            hits = 0
+            t0 = time.perf_counter()
+            for ci in centers:
+                hits += len(geo.search_radial(float(lats[ci]),
+                                              float(lngs[ci]), 500))
+            secs = time.perf_counter() - t0
+        raw.close()
+        idx.close()
+        return n_searches / secs, hits
+
+
 def main() -> None:
     n_records = int(os.environ.get("PEGBENCH_RECORDS", 100_000))
     n_ops = int(os.environ.get("PEGBENCH_OPS", 1200))
@@ -277,6 +315,7 @@ def main() -> None:
     probe_timeout = float(os.environ.get("PEGBENCH_PROBE_TIMEOUT", 180))
     probe_retries = int(os.environ.get("PEGBENCH_PROBE_RETRIES", 4))
     do_compact = os.environ.get("PEGBENCH_COMPACT") == "1"
+    do_geo = os.environ.get("PEGBENCH_GEO") == "1"
 
     details = {"phases": {}}
 
@@ -348,6 +387,18 @@ def main() -> None:
                     _log(f"compact[{mode}]: accel {a_bps / 1e9:.3f} GB/s "
                          f"({a_s:.1f}s), cpu {c_bps / 1e9:.3f} GB/s "
                          f"({c_s:.1f}s)")
+
+            if do_geo:
+                g_accel, g_hits = measure_geo(jax, accel)
+                g_cpu, _ = measure_geo(jax, cpu)
+                details["phases"]["geo_radius_search"] = {
+                    "accel_qps": round(g_accel, 2),
+                    "cpu_qps": round(g_cpu, 2),
+                    "vs_baseline": round(g_accel / g_cpu, 3) if g_cpu
+                    else 0,
+                    "hits": g_hits,
+                }
+                _log(f"geo: accel {g_accel:.1f} q/s, cpu {g_cpu:.1f} q/s")
 
             here = os.path.dirname(os.path.abspath(__file__))
             with open(os.path.join(here, "BENCH_DETAILS.json"), "w") as f:

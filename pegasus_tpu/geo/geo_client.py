@@ -1,0 +1,178 @@
+"""GeoClient: location-aware KV over the dual-table design.
+
+Parity: src/geo/lib/geo_client.h:96 — two tables:
+- the RAW table: the user's (hashkey, sortkey) -> value, unchanged;
+- the GEO index table: hashkey = cell id at `index_level` (the S2
+  min_level analogue), sortkey = remaining cell digits + the raw keys,
+  value = the raw value. Radius search covers the circle with index
+  cells (geo_client.h:295-335), scans each cell in parallel-ready
+  fashion, and filters candidates by exact distance — here as ONE
+  batched device predicate (ops/geo.py) instead of a scalar loop.
+
+Values carry their coordinates; the codec extracts (lat, lng) from a
+'|'-separated value by field index (parity: latlng_codec with
+configurable latitude_index/longitude_index).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional, Tuple
+
+from pegasus_tpu.geo.cells import cell_id, covering_cells, haversine_m
+from pegasus_tpu.ops.geo import radius_filter
+from pegasus_tpu.utils.errors import StorageStatus
+
+SORT_SEP = b"|"
+
+
+@dataclass
+class LatLngCodec:
+    """Extract/encode coordinates from a record value (parity:
+    base/latlng_codec)."""
+
+    latitude_index: int = 0
+    longitude_index: int = 1
+
+    def decode(self, value: bytes) -> Optional[Tuple[float, float]]:
+        parts = value.split(b"|")
+        hi = max(self.latitude_index, self.longitude_index)
+        if len(parts) <= hi:
+            return None
+        try:
+            return (float(parts[self.latitude_index]),
+                    float(parts[self.longitude_index]))
+        except ValueError:
+            return None
+
+
+@dataclass
+class GeoSearchResult:
+    hash_key: bytes
+    sort_key: bytes
+    value: bytes
+    distance_m: float
+
+
+class GeoClient:
+    """`raw` and `index` are any client exposing the PegasusClient API
+    (in-process or cluster)."""
+
+    def __init__(self, raw_client, index_client,
+                 codec: Optional[LatLngCodec] = None,
+                 index_level: int = 12, max_level: int = 16) -> None:
+        self.raw = raw_client
+        self.index = index_client
+        self.codec = codec or LatLngCodec()
+        self.index_level = index_level
+        self.max_level = max_level
+
+    # ---- index key layout ---------------------------------------------
+
+    def _index_keys(self, hash_key: bytes, sort_key: bytes,
+                    lat: float, lng: float) -> Tuple[bytes, bytes]:
+        cell = cell_id(lat, lng, self.max_level)
+        idx_hash = cell[:self.index_level].encode()
+        idx_sort = (cell[self.index_level:].encode() + SORT_SEP
+                    + hash_key + SORT_SEP + sort_key)
+        return idx_hash, idx_sort
+
+    @staticmethod
+    def _restore_raw_keys(idx_sort: bytes) -> Tuple[bytes, bytes]:
+        _cell_rest, hk, sk = idx_sort.split(SORT_SEP, 2)
+        return hk, sk
+
+    # ---- data ops (parity: geo_client set/get/del keep both tables) ---
+
+    def set(self, hash_key: bytes, sort_key: bytes, value: bytes,
+            ttl_seconds: int = 0) -> int:
+        coord = self.codec.decode(value)
+        if coord is None:
+            return int(StorageStatus.INVALID_ARGUMENT)
+        # stale index entries for a moved point are removed first (the
+        # reference reads the old value and deletes its old cell entry)
+        err, old = self.raw.get(hash_key, sort_key)
+        if err == int(StorageStatus.OK):
+            old_coord = self.codec.decode(old)
+            if old_coord is not None and old_coord != coord:
+                oh, os_ = self._index_keys(hash_key, sort_key, *old_coord)
+                self.index.delete(oh, os_)
+        err = self.raw.set(hash_key, sort_key, value, ttl_seconds)
+        if err != int(StorageStatus.OK):
+            return err
+        ih, isk = self._index_keys(hash_key, sort_key, *coord)
+        return self.index.set(ih, isk, value, ttl_seconds)
+
+    def get(self, hash_key: bytes, sort_key: bytes) -> Tuple[int, bytes]:
+        return self.raw.get(hash_key, sort_key)
+
+    def delete(self, hash_key: bytes, sort_key: bytes) -> int:
+        err, value = self.raw.get(hash_key, sort_key)
+        if err == int(StorageStatus.OK):
+            coord = self.codec.decode(value)
+            if coord is not None:
+                ih, isk = self._index_keys(hash_key, sort_key, *coord)
+                self.index.delete(ih, isk)
+        return self.raw.delete(hash_key, sort_key)
+
+    # ---- radius search (parity: async_search_radial :295-335) ----------
+
+    def search_radial(self, lat: float, lng: float, radius_m: float,
+                      count: int = -1,
+                      sort_by_distance: bool = True
+                      ) -> List[GeoSearchResult]:
+        cells = covering_cells(lat, lng, radius_m, self.index_level)
+        cand_keys: List[Tuple[bytes, bytes, bytes]] = []
+        cand_lat: List[float] = []
+        cand_lng: List[float] = []
+        for cell in cells:
+            # one hashkey-scoped scan per covering cell (the reference
+            # fans these out in parallel; scans here are already batched
+            # device dispatches per partition)
+            scanner = self.index.get_scanner(cell.encode())
+            for _ih, isk, value in scanner:
+                coord = self.codec.decode(value)
+                if coord is None:
+                    continue
+                hk, sk = self._restore_raw_keys(isk)
+                cand_keys.append((hk, sk, value))
+                cand_lat.append(coord[0])
+                cand_lng.append(coord[1])
+        if not cand_keys:
+            return []
+        # exact-distance filtering: ONE device dispatch for the batch
+        keep, dist = radius_filter(cand_lat, cand_lng, lat, lng, radius_m)
+        out = [GeoSearchResult(hk, sk, value, float(d))
+               for (hk, sk, value), k, d in zip(cand_keys, keep, dist)
+               if k]
+        if sort_by_distance:
+            out.sort(key=lambda r: r.distance_m)
+        if count >= 0:
+            out = out[:count]
+        return out
+
+    def search_radial_by_key(self, hash_key: bytes, sort_key: bytes,
+                             radius_m: float, count: int = -1
+                             ) -> List[GeoSearchResult]:
+        """Radius search centered on an existing record (parity:
+        the hashkey/sortkey overload of async_search_radial)."""
+        err, value = self.raw.get(hash_key, sort_key)
+        if err != int(StorageStatus.OK):
+            return []
+        coord = self.codec.decode(value)
+        if coord is None:
+            return []
+        return self.search_radial(coord[0], coord[1], radius_m, count)
+
+    def distance(self, hk1: bytes, sk1: bytes, hk2: bytes, sk2: bytes
+                 ) -> Optional[float]:
+        """Parity: geo_client::distance."""
+        err1, v1 = self.raw.get(hk1, sk1)
+        err2, v2 = self.raw.get(hk2, sk2)
+        if err1 != int(StorageStatus.OK) or err2 != int(StorageStatus.OK):
+            return None
+        c1 = self.codec.decode(v1)
+        c2 = self.codec.decode(v2)
+        if c1 is None or c2 is None:
+            return None
+        return haversine_m(c1[0], c1[1], c2[0], c2[1])

@@ -58,7 +58,6 @@ class MetaBulkLoadService:
             raise PegasusError(ErrorCode.ERR_APP_NOT_EXIST, app_name)
         if app.app_id in self._loads:
             raise PegasusError(ErrorCode.ERR_BUSY, "bulk load in progress")
-        self._failed.pop(app.app_id, None)  # a fresh start clears failure
         src_app = src_app or app_name
         bs = LocalBlockService(root)
         info = json.loads(bs.read_file(f"{src_app}/{BULK_LOAD_INFO}"))
@@ -67,6 +66,9 @@ class MetaBulkLoadService:
                 ErrorCode.ERR_INVALID_PARAMETERS,
                 f"staged for {info['partition_count']} partitions, table "
                 f"has {app.partition_count}")
+        # clear the old failure record only now — a retry that fails
+        # VALIDATION above must not make the old failure read as success
+        self._failed.pop(app.app_id, None)
         self._loads[app.app_id] = {
             "root": root, "src_app": src_app,
             "load_id": int(self.meta.clock() * 1000),

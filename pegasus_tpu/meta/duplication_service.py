@@ -107,7 +107,13 @@ class MetaDuplicationService:
         if info is None or info["status"] != "bootstrap":
             return
         if payload["err"] not in (0, int(ErrorCode.ERR_APP_EXIST)):
-            return  # transient failure; the tick re-sends
+            if payload["err"] in (int(ErrorCode.ERR_INVALID_PARAMETERS),
+                                  int(ErrorCode.ERR_FILE_OPERATION_FAILED)):
+                # permanent: surface it instead of retrying forever
+                info["status"] = "failed"
+                info["error"] = str(payload.get("result"))
+                self._save()
+            return  # transient failures: the tick re-sends
         policy = f"dup{dupid}"
         bs = LocalBlockService(info["bootstrap_root"])
         for pidx_s in list(info["progress"]):

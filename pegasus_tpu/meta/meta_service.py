@@ -564,7 +564,10 @@ class MetaService:
             "partition_count": app.partition_count,
             # a partition created from a backup must not serve until its
             # restore lands — the replica gates clients on this flag
-            "restoring": (app.app_id, pidx) in self.pending_restores})
+            "restoring": (app.app_id, pidx) in self.pending_restores,
+            # a split parent whose child registered stays write-fenced on
+            # whoever holds primaryship until the count flip
+            "splitting": self.split.is_parent_fenced(app.app_id, pidx)})
 
     def _propagate_envs(self, app: AppState) -> None:
         nodes = set()

@@ -120,10 +120,21 @@ class ServerState:
         partition split registers child configs BEFORE the count flips
         (parity: meta_split_service child registration)."""
         self._storage.set(f"/apps/{app_id}/{pidx}", pc.to_json())
+        self._extend_configs(app_id, pidx)
+        self.configs[app_id][pidx] = pc
+
+    def _extend_configs(self, app_id: int, pidx: int) -> None:
+        """Grow the in-memory list to cover `pidx`, loading any persisted
+        beyond-count entries from storage — a meta restart mid-split must
+        not blank child configs registered before the restart (boot only
+        loads indices < partition_count)."""
         configs = self.configs[app_id]
         while len(configs) <= pidx:
-            configs.append(PartitionConfig())
-        configs[pidx] = pc
+            data = self._storage.get(f"/apps/{app_id}/{len(configs)}")
+            configs.append(PartitionConfig.from_json(data) if data
+                           else PartitionConfig())
 
     def get_partition(self, app_id: int, pidx: int) -> PartitionConfig:
+        if pidx >= len(self.configs[app_id]):
+            self._extend_configs(app_id, pidx)
         return self.configs[app_id][pidx]

@@ -85,6 +85,17 @@ class MetaSplitService:
                 "child_gpid": (app_id, child_pidx),
                 "new_count": info["new_count"]})
 
+    def is_parent_fenced(self, app_id: int, pidx: int) -> bool:
+        """A parent whose child has registered must stay write-fenced on
+        WHOEVER is its primary until the flip: a failover would otherwise
+        hand primaryship to an unfenced node whose writes to the child
+        half silently vanish at the flip. The flag rides in every config
+        proposal, so a new primary is fenced in the same message that
+        promotes it."""
+        info = self._splits.get(app_id)
+        return (info is not None
+                and pidx + info["old_count"] in info["registered"])
+
     def on_register_child(self, src: str, payload: dict) -> None:
         """Parity: register_child_on_meta — the child partition enters the
         cluster state; the count flips once every child is in."""
@@ -105,10 +116,44 @@ class MetaSplitService:
                 PartitionConfig(ballot=1, primary=payload["primary"],
                                 secondaries=[]))
             self._save()
+            # re-propose the parent config ballot+1 carrying the fence
+            # flag — the CURRENT primary (which may have changed since
+            # the drain) learns it must stay fenced until the flip
+            parent_pidx = child[1] - info["old_count"]
+            pc = self.meta.state.get_partition(app_id, parent_pidx)
+            new_pc = PartitionConfig(ballot=pc.ballot + 1,
+                                     primary=pc.primary,
+                                     secondaries=list(pc.secondaries))
+            self.meta.state.update_partition(app_id, parent_pidx, new_pc)
+            self.meta._propose(app_id, parent_pidx, new_pc)
         if len(info["registered"]) == info["old_count"]:
             self._finish(app_id, info)
 
     def _finish(self, app_id: int, info: dict) -> None:
+        # a registered child whose (single-replica) primary died before
+        # the flip would be an empty partition after it — unregister and
+        # let the tick re-split it from the parent, which still holds the
+        # full pre-split key range until the post-flip compaction GC
+        dead = [cp for cp in info["registered"]
+                if not self.meta.fd.is_alive(
+                    self.meta.state.get_partition(app_id, cp).primary)]
+        if dead:
+            for cp in dead:
+                info["registered"].remove(cp)
+                self.meta.state.set_partition_raw(app_id, cp,
+                                                  PartitionConfig())
+                # unfence + re-drive the parent
+                parent_pidx = cp - info["old_count"]
+                pc = self.meta.state.get_partition(app_id, parent_pidx)
+                new_pc = PartitionConfig(ballot=pc.ballot + 1,
+                                         primary=pc.primary,
+                                         secondaries=list(pc.secondaries))
+                self.meta.state.update_partition(app_id, parent_pidx,
+                                                 new_pc)
+                self.meta._propose(app_id, parent_pidx, new_pc)
+            self._save()
+            self._drive(app_id)
+            return
         app = self.meta.state.apps[app_id]
         app.partition_count = info["new_count"]
         self.meta.state.put_app(app)

@@ -318,6 +318,11 @@ class ReplicaStub:
             # lands, or a stray early write would make the idempotence
             # check misread the partition as already restored
             r.restoring = True
+        if gpid not in self._split_sessions:
+            # the meta-carried fence: a parent whose child registered
+            # stays fenced across failovers (a local split session's own
+            # fence is authoritative while it runs)
+            r.splitting = bool(payload.get("splitting"))
         new_count = payload.get("partition_count", 1)
         if new_count > r.server.partition_count:
             # the split's group count flip (meta_split_service _finish):
@@ -502,10 +507,19 @@ class ReplicaStub:
         r = self.replicas.get(gpid)
         if r is None or r.status != PartitionStatus.PRIMARY:
             # lost primaryship mid-split: abandon; meta re-drives the new
-            # primary, whose own checkpoint supersedes this half-built one
-            r2 = self.replicas.get(gpid)
-            if r2 is not None:
-                r2.splitting = False
+            # primary. Unfence locally (a meta proposal re-fences if the
+            # child did register) and reap the half-built child — it was
+            # never part of any config, and leaving it would resurrect at
+            # boot scan as a zombie replica
+            import shutil
+
+            if r is not None:
+                r.splitting = False
+            child = self.replicas.pop(sess["child_gpid"], None)
+            if child is not None:
+                child.close()
+            shutil.rmtree(self._replica_dir(sess["child_gpid"]),
+                          ignore_errors=True)
             del self._split_sessions[gpid]
             return
         child_gpid = sess["child_gpid"]

@@ -1,0 +1,124 @@
+"""GEO client tests (parity: src/geo/test + the radius-search semantics
+of geo_client.h:295-335), on the dual-table design over in-process
+tables and over the replicated cluster."""
+
+import math
+
+import pytest
+
+from pegasus_tpu.client import PegasusClient, Table
+from pegasus_tpu.geo import GeoClient, cell_id, covering_cells, haversine_m
+from pegasus_tpu.utils.errors import StorageStatus
+
+OK = int(StorageStatus.OK)
+
+
+def make_geo(tmp_path, partitions=4):
+    raw = Table(str(tmp_path / "raw"), app_id=1, partition_count=partitions)
+    idx = Table(str(tmp_path / "idx"), app_id=2, partition_count=partitions)
+    return (GeoClient(PegasusClient(raw), PegasusClient(idx)), raw, idx)
+
+
+def test_cell_ids_hierarchical():
+    deep = cell_id(40.0, -74.0, 16)
+    assert cell_id(40.0, -74.0, 12) == deep[:12]
+    assert len(deep) == 16
+    # neighbors at the same level share the ancestor prefix
+    assert cell_id(40.0, -74.0, 4) == cell_id(40.01, -74.01, 4)
+
+
+def test_covering_cells_cover_the_circle():
+    cells = covering_cells(40.0, -74.0, 500.0, 12)
+    assert cell_id(40.0, -74.0, 12) in cells
+    # points near the radius edge fall inside SOME covering cell
+    for brg in range(0, 360, 45):
+        dlat = 0.004 * math.cos(math.radians(brg))
+        dlng = 0.004 * math.sin(math.radians(brg))
+        assert cell_id(40.0 + dlat, -74.0 + dlng, 12) in cells
+
+
+def test_haversine_known_distance():
+    # JFK -> LGA is ~17.5 km
+    d = haversine_m(40.6413, -73.7781, 40.7769, -73.8740)
+    assert 16000 < d < 19000
+
+
+def test_geo_set_get_search(tmp_path):
+    geo, raw, idx = make_geo(tmp_path)
+    try:
+        # a small constellation around (40, -74)
+        points = {
+            b"p_center": (40.0000, -74.0000),
+            b"p_200m_n": (40.0018, -74.0000),
+            b"p_400m_e": (40.0000, -73.9953),
+            b"p_2km_s": (39.9820, -74.0000),
+            b"p_far": (41.0, -75.0),
+        }
+        for name, (la, ln) in points.items():
+            value = b"%f|%f|payload-%s" % (la, ln, name)
+            assert geo.set(name, b"s", value) == OK
+        assert geo.get(b"p_center", b"s")[0] == OK
+
+        got = {r.hash_key for r in geo.search_radial(40.0, -74.0, 500)}
+        assert got == {b"p_center", b"p_200m_n", b"p_400m_e"}
+        # sorted by distance; count caps results
+        top = geo.search_radial(40.0, -74.0, 5000, count=2)
+        assert [r.hash_key for r in top] == [b"p_center", b"p_200m_n"]
+        assert top[0].distance_m < 1.0
+        # search by existing key
+        got = {r.hash_key
+               for r in geo.search_radial_by_key(b"p_center", b"s", 500)}
+        assert b"p_400m_e" in got
+        # distance between two stored records
+        d = geo.distance(b"p_center", b"s", b"p_2km_s", b"s")
+        assert 1800 < d < 2200
+    finally:
+        raw.close()
+        idx.close()
+
+
+def test_geo_update_moves_index_entry(tmp_path):
+    geo, raw, idx = make_geo(tmp_path)
+    try:
+        assert geo.set(b"mover", b"s", b"40.0|-74.0|v1") == OK
+        assert len(geo.search_radial(40.0, -74.0, 200)) == 1
+        # move far away: old index entry must disappear
+        assert geo.set(b"mover", b"s", b"41.0|-75.0|v2") == OK
+        assert geo.search_radial(40.0, -74.0, 200) == []
+        hits = geo.search_radial(41.0, -75.0, 200)
+        assert len(hits) == 1 and hits[0].value == b"41.0|-75.0|v2"
+        # delete removes both tables' entries
+        assert geo.delete(b"mover", b"s") == OK
+        assert geo.search_radial(41.0, -75.0, 200) == []
+    finally:
+        raw.close()
+        idx.close()
+
+
+def test_geo_rejects_uncodable_value(tmp_path):
+    geo, raw, idx = make_geo(tmp_path)
+    try:
+        assert geo.set(b"bad", b"s", b"no-coords-here") == int(
+            StorageStatus.INVALID_ARGUMENT)
+    finally:
+        raw.close()
+        idx.close()
+
+
+def test_geo_over_replicated_cluster(tmp_path):
+    from pegasus_tpu.tools.cluster import SimCluster
+
+    cluster = SimCluster(str(tmp_path / "cl"), n_nodes=3)
+    try:
+        cluster.create_table("georaw", partition_count=4)
+        cluster.create_table("geoidx", partition_count=4)
+        geo = GeoClient(cluster.client("georaw"), cluster.client("geoidx"))
+        for i in range(12):
+            la = 40.0 + i * 0.0009  # ~100m apart going north
+            assert geo.set(b"pt%02d" % i, b"s",
+                           b"%f|%f|v%d" % (la, -74.0, i)) == OK
+        hits = geo.search_radial(40.0, -74.0, 520)
+        assert {r.hash_key for r in hits} == {b"pt%02d" % i
+                                              for i in range(6)}
+    finally:
+        cluster.close()

@@ -308,3 +308,45 @@ def test_split_survives_parent_primary_failover(cluster):
     assert not cluster.meta.split.split_status("spf")["splitting"]
     for i in range(30):
         assert c.get(b"f%03d" % i, b"s") == (OK, b"v%d" % i), i
+
+
+def test_split_fence_survives_parent_failover_after_registration(cluster):
+    """A parent primary failing over AFTER its child registered must leave
+    the NEW primary write-fenced until the flip — otherwise writes acked
+    in that window that hash to the child half vanish at the flip."""
+    app_id = cluster.create_table("spz", partition_count=2)
+    c = cluster.client("spz")
+    for i in range(20):
+        assert c.set(b"z%03d" % i, b"s", b"v%d" % i) == OK
+    cluster.meta.split.start_partition_split("spz")
+    # drive until at least one child registers but the split is unfinished
+    for _ in range(20):
+        cluster.step()
+        st = cluster.meta.split.split_status("spz")
+        if not st.get("splitting"):
+            break
+        if st["registered"]:
+            break
+    st = cluster.meta.split.split_status("spz")
+    if st.get("splitting") and st["registered"]:
+        child_pidx = st["registered"][0]
+        parent_pidx = child_pidx - 2
+        old_primary = cluster.meta.state.get_partition(
+            app_id, parent_pidx).primary
+        cluster.kill(old_primary)
+        cluster.step(rounds=8)  # cure + fence re-proposal
+        new_primary = cluster.meta.state.get_partition(
+            app_id, parent_pidx).primary
+        if new_primary:
+            r = cluster.stubs[new_primary].get_replica(
+                (app_id, parent_pidx))
+            assert getattr(r, "splitting", False), (
+                "new primary of a registered parent must be fenced")
+    # drive to completion; every acked write must survive
+    for _ in range(30):
+        cluster.step()
+        if not cluster.meta.split.split_status("spz")["splitting"]:
+            break
+    assert not cluster.meta.split.split_status("spz")["splitting"]
+    for i in range(20):
+        assert c.get(b"z%03d" % i, b"s") == (OK, b"v%d" % i), i
