@@ -73,12 +73,16 @@ class ClusterClient:
     failure detection can progress during retries).
     """
 
-    def __init__(self, net, name: str, meta_addr: str, app_name: str,
+    def __init__(self, net, name: str, meta_addr, app_name: str,
                  pump: Callable[[], None],
                  max_retries: int = 6, pump_rounds: int = 50) -> None:
         self.net = net
         self.name = name
-        self.meta_addr = meta_addr
+        # one address or the whole meta group (rotated on timeout —
+        # parity: the client's meta group_address failover)
+        self.meta_addrs = ([meta_addr] if isinstance(meta_addr, str)
+                           else list(meta_addr))
+        self._meta_i = 0
         self.app_name = app_name
         self._pump = pump
         self._max_retries = max_retries
@@ -122,18 +126,30 @@ class ClusterClient:
 
     # ---- config cache (parity: partition_resolver_simple) -------------
 
+    @property
+    def meta_addr(self) -> str:
+        return self.meta_addrs[self._meta_i % len(self.meta_addrs)]
+
     def refresh_config(self) -> None:
-        rid = self._send_request(self.meta_addr, "query_config", {
-            "app_name": self.app_name})
-        reply = self._await(rid)
-        if reply is None:
-            raise PegasusError(ErrorCode.ERR_TIMEOUT,
-                               f"meta {self.meta_addr} unreachable")
-        if reply["err"] != _OK:
-            raise PegasusError(ErrorCode(reply["err"]), self.app_name)
-        self.app_id = reply["app_id"]
-        self.partition_count = reply["partition_count"]
-        self._configs = reply["configs"]
+        last = None
+        for _ in range(len(self.meta_addrs)):
+            rid = self._send_request(self.meta_addr, "query_config", {
+                "app_name": self.app_name})
+            reply = self._await(rid)
+            if reply is None:
+                # this meta is down/partitioned: rotate to the next group
+                # member (a follower forwards to the leader)
+                self._meta_i += 1
+                last = PegasusError(ErrorCode.ERR_TIMEOUT,
+                                    f"meta {self.meta_addr} unreachable")
+                continue
+            if reply["err"] != _OK:
+                raise PegasusError(ErrorCode(reply["err"]), self.app_name)
+            self.app_id = reply["app_id"]
+            self.partition_count = reply["partition_count"]
+            self._configs = reply["configs"]
+            return
+        raise last
 
     def _ensure_config(self) -> None:
         if self.app_id is None:

@@ -1,0 +1,292 @@
+"""Meta group: lease-based leader election + replicated meta storage.
+
+Parity: the reference elects its meta leader through a distributed lock
+and keeps cluster state in a replicated store (meta_service.cpp:384-401
+elect via ZK lock; meta_state_service_zookeeper.h:50), with followers
+forwarding every request to the leader (check_leader,
+meta_service.h:304). Without an external ZooKeeper, the meta GROUP
+provides both itself:
+
+- Election: term-numbered vote rounds. A follower whose leader lease
+  expires becomes a candidate, increments its term, and asks every peer
+  for a vote; a peer grants iff the term is new AND the candidate's
+  storage sequence is at least its own (the up-to-date gate). A majority
+  of the full group elects. The leader heartbeats {term, seq}; any
+  message with a newer term demotes.
+- Storage replication: every leader-side storage mutation gets a
+  sequence number and fans out to followers, which apply it to their
+  local stores. A follower that detects a gap (heartbeat seq ahead of
+  its own) pulls a full snapshot — meta state is small, so snapshot
+  catch-up beats log reconciliation in complexity. The vote gate then
+  guarantees the next leader has the most complete state among any
+  electing majority.
+
+Window semantics: an update acked to a client but not yet replicated
+when the leader dies can be lost (the reference accepts the analogous
+window only because ZK persists first). The cluster self-heals: replica
+config-sync reports carry ballots, and the new leader adopts any
+reported config whose ballot is ahead of its own state — the replicas
+are the recovery source of truth (parity: `recover` from replica list,
+shell commands.h:209).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+from pegasus_tpu.meta.meta_storage import MetaStorage
+
+LEASE_SECONDS = 8.0
+HEARTBEAT_EVERY = 2.0
+
+
+class ReplicatedMetaStorage(MetaStorage):
+    """MetaStorage that notifies a replication hook on every mutation.
+    The hook fires ONLY for locally-originated writes (the leader's);
+    follower-applied updates go through `apply_replicated`."""
+
+    def __init__(self, path: str) -> None:
+        super().__init__(path)
+        self.seq = int(self._tree.get("/__meta_seq", 0))
+        # the TERM whose leader wrote the latest mutation: freshness is
+        # (state_term, seq) lexicographic, so a deposed leader that kept
+        # writing (inflating seq under its OLD term) can never outrank
+        # state written under a newer term
+        self.state_term = int(self._tree.get("/__meta_term", 0))
+        self.term_source: Callable[[], int] = lambda: 0
+        self.on_mutate: Optional[Callable[[Dict[str, Any]], None]] = None
+
+    @property
+    def version(self):
+        return (self.state_term, self.seq)
+
+    def _bump(self, updates: Dict[str, Any]) -> Dict[str, Any]:
+        self.seq += 1
+        self.state_term = self.term_source()
+        updates = dict(updates)
+        updates["/__meta_seq"] = self.seq
+        updates["/__meta_term"] = self.state_term
+        return updates
+
+    def set(self, node: str, value: Any) -> None:
+        self.set_batch({node: value})
+
+    def set_batch(self, updates: Dict[str, Any]) -> None:
+        updates = self._bump(updates)
+        super().set_batch(updates)
+        if self.on_mutate is not None:
+            self.on_mutate(updates)
+
+    def delete(self, node: str) -> None:
+        # deletions replicate as explicit tombstone lists inside a batch
+        keys = [k for k in self._tree
+                if k == node or k.startswith(node + "/")]
+        for k in keys:
+            self._tree.pop(k, None)
+        self.seq += 1
+        self.state_term = self.term_source()
+        self._tree["/__meta_seq"] = self.seq
+        self._tree["/__meta_term"] = self.state_term
+        self._persist()
+        if self.on_mutate is not None:
+            self.on_mutate({"/__meta_seq": self.seq,
+                            "/__meta_term": self.state_term,
+                            "/__tombstones": keys})
+
+    def apply_replicated(self, seq: int, updates: Dict[str, Any]) -> None:
+        """Follower-side apply (no re-replication). Caller has already
+        gap-checked seq."""
+        tombs = updates.pop("/__tombstones", None)
+        if tombs:
+            for k in tombs:
+                self._tree.pop(k, None)
+            updates = {k: v for k, v in updates.items() if v is not None}
+        self._tree.update(updates)
+        self.seq = max(self.seq, seq)
+        self.state_term = int(updates.get("/__meta_term",
+                                          self.state_term))
+        self._tree["/__meta_seq"] = self.seq
+        self._persist()
+
+    def snapshot(self) -> Dict[str, Any]:
+        return dict(self._tree)
+
+    def load_snapshot(self, tree: Dict[str, Any]) -> None:
+        self._tree = dict(tree)
+        self.seq = int(self._tree.get("/__meta_seq", 0))
+        self.state_term = int(self._tree.get("/__meta_term", 0))
+        self._persist()
+
+
+class MetaElection:
+    """Election + replication sidecar for one MetaService instance."""
+
+    def __init__(self, meta, peers: List[str],
+                 storage: ReplicatedMetaStorage) -> None:
+        self.meta = meta
+        self.peers = [p for p in peers if p != meta.name]
+        self.group = sorted(set(peers) | {meta.name})
+        self.storage = storage
+        self.term = 0
+        self.voted_term = 0
+        self.is_leader = len(self.peers) == 0  # single-meta: always lead
+        self.leader: Optional[str] = meta.name if self.is_leader else None
+        # boot counts as a heartbeat: with -inf every member would
+        # campaign on its FIRST tick simultaneously and split the vote;
+        # the staggered delays only order timers measured from a common
+        # reference point
+        self._last_heartbeat = meta.clock()
+        self._last_sent_hb = float("-inf")
+        self._votes: set = set()
+        # staggered election timeouts break split-vote livelock the way
+        # Raft's randomized timeouts do, but DETERMINISTICALLY (the sim
+        # must replay from its seed). The per-index stagger must exceed
+        # the slowest tick interval (SimCluster ticks each 3s) or two
+        # timers cross within one tick and split the vote; 2 heartbeats
+        # (4s) clears it, so the lowest-indexed live member campaigns
+        # alone and wins before the next member's timer fires
+        self._election_delay = (LEASE_SECONDS
+                                + self.group.index(meta.name)
+                                * 2 * HEARTBEAT_EVERY)
+        storage.term_source = lambda: self.term
+        storage.on_mutate = self._replicate
+
+    # ---- leader-side ---------------------------------------------------
+
+    def _replicate(self, updates: Dict[str, Any]) -> None:
+        if not self.is_leader:
+            return
+        for peer in self.peers:
+            self.meta.net.send(self.meta.name, peer, "meta_replicate", {
+                "term": self.term, "seq": self.storage.seq,
+                "updates": updates})
+
+    def _send_heartbeats(self, now: float) -> None:
+        if now - self._last_sent_hb < HEARTBEAT_EVERY:
+            return
+        self._last_sent_hb = now
+        for peer in self.peers:
+            self.meta.net.send(self.meta.name, peer, "meta_heartbeat", {
+                "term": self.term,
+                "version": list(self.storage.version)})
+
+    # ---- follower/candidate side ---------------------------------------
+
+    def _start_election(self) -> None:
+        self.term += 1
+        self.voted_term = self.term  # vote for self
+        self._votes = {self.meta.name}
+        self.is_leader = False
+        self.leader = None
+        for peer in self.peers:
+            self.meta.net.send(self.meta.name, peer, "meta_vote_req", {
+                "term": self.term,
+                "version": list(self.storage.version)})
+        self._maybe_win()
+
+    def _maybe_win(self) -> None:
+        if len(self._votes) * 2 > len(self.group):
+            self.is_leader = True
+            self.leader = self.meta.name
+            self._last_sent_hb = float("-inf")
+            self._send_heartbeats(self.meta.clock())
+            # a fresh leader re-learns worker liveness before curing:
+            # without this, the guardian would treat every worker as dead
+            self.meta.on_leadership_acquired()
+
+    # ---- message handlers (wired from MetaService.on_message) ----------
+
+    def on_message(self, src: str, msg_type: str, payload: dict) -> bool:
+        """Returns True if the message was an election-internal one."""
+        if msg_type == "meta_heartbeat":
+            if payload["term"] >= self.term:
+                if payload["term"] > self.term or self.is_leader:
+                    self._step_down(payload["term"])
+                self.leader = src
+                self._last_heartbeat = self.meta.clock()
+                if tuple(payload["version"]) > self.storage.version:
+                    self.meta.net.send(self.meta.name, src,
+                                       "meta_fetch_state", {})
+            return True
+        if msg_type == "meta_replicate":
+            if payload["term"] >= self.term:
+                if payload["seq"] > self.storage.seq + 1:
+                    # a replicated update was lost: applying past the gap
+                    # would silently fork state while seq ties defeat
+                    # every later freshness check — pull a full snapshot
+                    self.meta.net.send(self.meta.name, src,
+                                       "meta_fetch_state", {})
+                elif payload["seq"] == self.storage.seq + 1:
+                    self.storage.apply_replicated(payload["seq"],
+                                                  dict(payload["updates"]))
+                    self.meta.reload_state()
+                # seq <= ours: stale duplicate, ignore
+            return True
+        if msg_type == "meta_vote_req":
+            if payload["term"] > self.term:
+                # ALWAYS adopt a higher term, granted or not — otherwise
+                # a stale-state member campaigning faster permanently
+                # outruns everyone else's term and no leader ever wins
+                self._step_down(payload["term"])
+            grant = (payload["term"] > self.voted_term
+                     and tuple(payload["version"])
+                     >= self.storage.version)
+            if grant:
+                self.voted_term = payload["term"]
+                self.meta.net.send(self.meta.name, src, "meta_vote_ack", {
+                    "term": payload["term"]})
+            return True
+        if msg_type == "meta_vote_ack":
+            if (not self.is_leader and payload["term"] == self.term
+                    and self.voted_term == self.term):
+                self._votes.add(src)
+                self._maybe_win()
+            return True
+        if msg_type == "meta_fetch_state":
+            if self.is_leader:
+                self.meta.net.send(self.meta.name, src,
+                                   "meta_state_snapshot", {
+                                       "term": self.term,
+                                       "seq": self.storage.seq,
+                                       "tree": self.storage.snapshot()})
+            return True
+        if msg_type == "meta_state_snapshot":
+            if payload["term"] >= self.term and not self.is_leader:
+                self.storage.load_snapshot(dict(payload["tree"]))
+                self.meta.reload_state()
+            return True
+        return False
+
+    def _step_down(self, term: int) -> None:
+        self.term = term
+        self.is_leader = False
+
+    # ---- timer ---------------------------------------------------------
+
+    def tick(self) -> None:
+        if not self.peers:
+            return  # single-meta
+        now = self.meta.clock()
+        if self.is_leader:
+            self._send_heartbeats(now)
+        elif now - self._last_heartbeat > self._election_delay:
+            # re-arm before campaigning so a failed round retries after
+            # another full (still staggered) delay, not every tick
+            self._last_heartbeat = now
+            self._start_election()
+
+    def forward_to_leader(self, src: str, msg_type: str,
+                          payload: dict) -> bool:
+        """Follower-side request forwarding (parity: check_leader →
+        forward, meta_service.h:304). The original request is WRAPPED —
+        spoofing the original src would make a TCP leader bind the
+        requester's name to the follower's connection, blackholing the
+        leader's replies to the real requester."""
+        if self.is_leader:
+            return False
+        if self.leader is not None and self.leader != self.meta.name:
+            self.meta.net.send(self.meta.name, self.leader,
+                               "meta_forward", {
+                                   "src": src, "msg_type": msg_type,
+                                   "payload": payload})
+        return True

@@ -35,12 +35,24 @@ Gpid = Tuple[int, int]
 
 class MetaService:
     def __init__(self, name: str, data_dir: str, net,
-                 clock: Callable[[], float]) -> None:
+                 clock: Callable[[], float],
+                 peers: Optional[List[str]] = None) -> None:
+        """`peers`: the full meta group (including this node) for
+        leader-elected multi-meta deployments; None/singleton = the
+        single-meta mode every existing caller gets."""
+        from pegasus_tpu.meta.election import (
+            MetaElection,
+            ReplicatedMetaStorage,
+        )
+
         self.name = name
         self.net = net
         self.clock = clock
-        self.state = ServerState(MetaStorage(os.path.join(data_dir,
-                                                          "meta.json")))
+        self.storage = ReplicatedMetaStorage(os.path.join(data_dir,
+                                                          "meta.json"))
+        self.state = ServerState(self.storage)
+        self.election = MetaElection(self, list(peers or [name]),
+                                     self.storage)
         self.fd = FailureDetector(on_worker_dead=self._on_node_dead)
         # in-flight learner adds: gpid -> (learner, started_at); prevents
         # every guardian tick from restarting a slow learn from scratch
@@ -69,13 +81,67 @@ class MetaService:
         self.split = MetaSplitService(self)
         net.register(name, self.on_message)
 
+    # ---- multi-meta plumbing ------------------------------------------
+
+    @property
+    def is_leader(self) -> bool:
+        return self.election.is_leader
+
+    def reload_state(self) -> None:
+        """Follower: re-derive in-memory views after replicated storage
+        changed underneath (cheap — meta state is small)."""
+        self.state = ServerState(self.storage)
+
+    def on_leadership_acquired(self) -> None:
+        """Fresh leader: rebuild every service's in-memory view from the
+        replicated storage. The FD starts empty — no worker is declared
+        dead until its grace expires from MISSING beacons, so a leader
+        change never mass-cures healthy partitions."""
+        self.reload_state()
+        self._load_pending_restores()
+        self.backup._load()
+        self.bulk_load._load_state()
+        self.duplication._load()
+        self.split._load()
+
     # ---- messages -----------------------------------------------------
 
+    _LEADER_ONLY = frozenset({
+        "beacon", "learn_completed", "replication_error", "config_sync",
+        "admin", "backup_partition_done", "restore_partition_done",
+        "ingest_done", "duplication_sync", "register_child",
+        "query_config", "admin_reply",
+    })
+
     def on_message(self, src: str, msg_type: str, payload) -> None:
-        if msg_type == "beacon":
-            self.fd.on_beacon(payload["node"], self.clock())
-            self.net.send(self.name, src, "beacon_ack", {"ok": True})
+        if self.election.on_message(src, msg_type, payload):
             return
+        if msg_type == "meta_forward":
+            # a follower forwarded a request (the wrapper keeps transport
+            # routes clean); handle it as if from the original requester —
+            # replies travel over OUR route to that requester
+            self.on_message(payload["src"], payload["msg_type"],
+                            payload["payload"])
+            return
+        if msg_type == "config_sync" and not self.election.is_leader:
+            # stubs broadcast config_sync to the whole group; followers
+            # gain nothing from it and forwarding would just triple the
+            # leader's work — drop silently
+            return
+        if msg_type == "beacon":
+            # every group member tracks beacons PASSIVELY (parity:
+            # multimaster FD) so a freshly elected leader has a warm
+            # liveness view — but only the LEADER grants leases (acks):
+            # a follower ack would let a worker keep serving while the
+            # actual authority considers it dead
+            self.fd.on_beacon(payload["node"], self.clock())
+            if self.election.is_leader:
+                self.net.send(self.name, src, "beacon_ack", {"ok": True})
+            return
+        if (msg_type in self._LEADER_ONLY
+                and self.election.forward_to_leader(src, msg_type,
+                                                    payload)):
+            return  # forwarded with the ORIGINAL src; reply goes direct
         if msg_type == "learn_completed":
             self._on_learn_completed(tuple(payload["gpid"]),
                                      payload["learner"])
@@ -134,7 +200,11 @@ class MetaService:
 
     def tick(self) -> None:
         """Periodic FD check + guardian pass (parity: the meta's FD check
-        timer and partition-guardian scans)."""
+        timer and partition-guardian scans). Followers only run the
+        election timer."""
+        self.election.tick()
+        if not self.election.is_leader:
+            return
         self.fd.check(self.clock())
         self._guardian_pass()
         self.backup.tick()
@@ -254,6 +324,18 @@ class MetaService:
         dropped-recall window) are listed — a replica missing from its
         partition's member list may be an in-flight learner."""
         node = payload["node"]
+        # recovery adoption: a replica holding a HIGHER ballot than our
+        # state knows (e.g. updates lost across a leader change) is the
+        # truth — adopt its view before answering
+        for entry in payload.get("stored", []):
+            gpid = tuple(entry["gpid"])
+            if gpid[0] not in self.state.apps or "primary" not in entry:
+                continue
+            pc = self.state.get_partition(*gpid)
+            if entry["ballot"] > pc.ballot:
+                self.state.update_partition(gpid[0], gpid[1], PartitionConfig(
+                    ballot=entry["ballot"], primary=entry["primary"],
+                    secondaries=list(entry["secondaries"])))
         configs = []
         for app in self.list_apps():
             for pidx in range(app.partition_count):

@@ -44,6 +44,9 @@ class ReplicaStub:
         # FD timeline clock (sim time); defaults to the wall clock
         self.sim_clock = sim_clock or clock or (lambda: 0.0)
         self.replicas: Dict[Gpid, Replica] = {}
+        # the meta group (parity: failure_detector_multimaster — workers
+        # beacon the whole group; only the leader acts, followers forward)
+        self.meta_addrs: list = []
         self.meta_addr: Optional[str] = None
         # (gpid, dupid) -> ClusterDuplicator on this node's primaries
         self._dup_sessions: Dict = {}
@@ -628,18 +631,27 @@ class ReplicaStub:
     # idl/meta_admin.thrift:103-115 stored_replicas/gc_replicas,
     # meta/meta_service.cpp:793) ----------------------------------------
 
+    def _meta_targets(self) -> list:
+        return self.meta_addrs or ([self.meta_addr]
+                                   if self.meta_addr else [])
+
     def config_sync(self) -> None:
         """Timer: report stored replicas; meta replies with this node's
         authoritative configs plus replicas to garbage-collect. Pull-based
         reconciliation is how replicas converge after meta-side
-        reconfiguration that happened while this node was unreachable."""
-        if self.meta_addr is None:
-            return
+        reconfiguration that happened while this node was unreachable.
+        The report carries each replica's full config VIEW: after a meta
+        leader change lost recent updates, the new leader adopts any
+        reported config with a higher ballot (replicas are the recovery
+        source of truth — parity: `recover` from replica list)."""
         stored = [{"gpid": gpid, "ballot": r.config.ballot,
+                   "primary": r.config.primary,
+                   "secondaries": list(r.config.secondaries),
                    "partition_count": r.server.partition_count}
                   for gpid, r in self.replicas.items()]
-        self.net.send(self.name, self.meta_addr, "config_sync", {
-            "node": self.name, "stored": stored})
+        for meta in self._meta_targets():
+            self.net.send(self.name, meta, "config_sync", {
+                "node": self.name, "stored": stored})
 
     def _on_config_sync_reply(self, src: str, payload: dict) -> None:
         import shutil
@@ -661,8 +673,7 @@ class ReplicaStub:
     # ---- failure detector (worker side) -------------------------------
 
     def send_beacon(self) -> None:
-        """Parity: the FD beacon ping (failure_detector.h:79) — called on a
-        timer by the owner/sim."""
-        if self.meta_addr is not None:
-            self.net.send(self.name, self.meta_addr, "beacon",
-                          {"node": self.name})
+        """Parity: the FD beacon ping (failure_detector.h:79) — sent to
+        every meta-group member; only the leader's FD acts."""
+        for meta in self._meta_targets():
+            self.net.send(self.name, meta, "beacon", {"node": self.name})

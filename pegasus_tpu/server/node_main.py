@@ -43,8 +43,8 @@ def run_node(cfg: dict, name: str) -> None:
     data_root = cfg["data_root"]
     book = address_book(cfg)
     transport = TcpTransport((node_cfg["host"], node_cfg["port"]), book)
-    meta_name = next(n for n, c in cfg["nodes"].items()
-                     if c["role"] == "meta")
+    meta_names = [n for n, c in cfg["nodes"].items()
+                  if c["role"] == "meta"]
 
     stop = {"flag": False}
 
@@ -58,7 +58,7 @@ def run_node(cfg: dict, name: str) -> None:
         from pegasus_tpu.meta.meta_service import MetaService
 
         svc = MetaService(name, os.path.join(data_root, name), transport,
-                          clock=time.monotonic)
+                          clock=time.monotonic, peers=meta_names)
         transport.run_timer(1.0, svc.tick)
         print(f"[{name}] meta serving on {node_cfg['host']}:"
               f"{node_cfg['port']}", flush=True)
@@ -68,7 +68,8 @@ def run_node(cfg: dict, name: str) -> None:
 
         stub = ReplicaStub(name, os.path.join(data_root, name), transport,
                            clock=time.time, sim_clock=time.monotonic)
-        stub.meta_addr = meta_name
+        stub.meta_addrs = meta_names
+        stub.meta_addr = meta_names[0]
         transport.run_timer(1.0, stub.send_beacon)
         transport.run_timer(2.5, stub.config_sync)
 

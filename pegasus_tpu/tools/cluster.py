@@ -26,21 +26,30 @@ from pegasus_tpu.runtime.sim import SimLoop, SimNetwork
 
 class SimCluster:
     def __init__(self, data_dir: str, n_nodes: int = 3, seed: int = 0,
-                 beacon_interval: float = 3.0) -> None:
+                 beacon_interval: float = 3.0, n_meta: int = 1) -> None:
         self.data_dir = data_dir
         self.loop = SimLoop(seed=seed)
         self.net = SimNetwork(self.loop)
         self.beacon_interval = beacon_interval
         clock = lambda: self.loop.now  # noqa: E731
-        self.meta = MetaService("meta", os.path.join(data_dir, "meta"),
-                                self.net, clock)
+        if n_meta <= 1:
+            self.metas = [MetaService(
+                "meta", os.path.join(data_dir, "meta"), self.net, clock)]
+        else:
+            group = [f"meta{i}" for i in range(n_meta)]
+            self.metas = [MetaService(
+                name, os.path.join(data_dir, name), self.net, clock,
+                peers=group) for name in group]
+            # deterministic initial leader: meta0 wins the first election
+            self.metas[0].election._start_election()
+            self.loop.run_until_idle()
         self.stubs: Dict[str, ReplicaStub] = {}
+        self._dead: set = set()
         # wall-anchored clock so value timetags / TTL math are realistic
         # while FD timing stays on deterministic sim time
         self._epoch = 1_700_000_000
         for i in range(n_nodes):
             self.add_node(f"node{i}")
-        self._dead: set = set()
         # settle: everyone beacons, FD learns the membership
         self.step(rounds=2)
 
@@ -51,7 +60,8 @@ class SimCluster:
             name, os.path.join(self.data_dir, name), self.net,
             clock=lambda: self._epoch + self.loop.now,
             sim_clock=lambda: self.loop.now)
-        stub.meta_addr = "meta"
+        stub.meta_addrs = [m.name for m in self.metas]
+        stub.meta_addr = self.metas[0].name
         self.stubs[name] = stub
         return stub
 
@@ -85,7 +95,9 @@ class SimCluster:
                     stub.dup_tick()
                     stub.split_tick()
             self.loop.run_for(self.beacon_interval)
-            self.meta.tick()
+            for m in self.metas:
+                if m.name not in self._dead:
+                    m.tick()
         self.loop.run_until_idle()
 
     def pump(self) -> None:
@@ -97,6 +109,19 @@ class SimCluster:
 
     # ---- DDL + clients -------------------------------------------------
 
+    @property
+    def meta(self) -> MetaService:
+        """The current leader meta (single-meta: the only one)."""
+        for m in self.metas:
+            if m.election.is_leader and m.name not in self._dead:
+                return m
+        alive = [m for m in self.metas if m.name not in self._dead]
+        if not alive:
+            raise RuntimeError("no live meta")
+        # no elected leader yet: return a live member so callers get a
+        # VISIBLE not-enough-members/forwarded behavior, never a dead one
+        return alive[0]
+
     def create_table(self, app_name: str, partition_count: int = 8,
                      replica_count: int = 3,
                      envs: Optional[Dict[str, str]] = None) -> int:
@@ -107,7 +132,8 @@ class SimCluster:
 
     def client(self, app_name: str,
                name: Optional[str] = None) -> ClusterClient:
-        c = ClusterClient(self.net, name or f"client-{app_name}", "meta",
+        c = ClusterClient(self.net, name or f"client-{app_name}",
+                          [m.name for m in self.metas],
                           app_name, pump=self.pump)
         return c
 

@@ -45,11 +45,16 @@ def _cluster_paths(directory: str) -> Dict[str, str]:
             "logs": os.path.join(directory, "logs")}
 
 
-def start(directory: str = DEFAULT_DIR, n_replica: int = 3) -> dict:
+def start(directory: str = DEFAULT_DIR, n_replica: int = 3,
+          n_meta: int = 1) -> dict:
     paths = _cluster_paths(directory)
     os.makedirs(paths["logs"], exist_ok=True)
-    nodes = {"meta": {"host": "127.0.0.1", "port": _free_port(),
-                      "role": "meta"}}
+    if n_meta <= 1:
+        nodes = {"meta": {"host": "127.0.0.1", "port": _free_port(),
+                          "role": "meta"}}
+    else:
+        nodes = {f"meta{i}": {"host": "127.0.0.1", "port": _free_port(),
+                              "role": "meta"} for i in range(n_meta)}
     for i in range(n_replica):
         nodes[f"node{i}"] = {"host": "127.0.0.1", "port": _free_port(),
                              "role": "replica"}
@@ -156,20 +161,32 @@ class OneboxAdmin:
         if msg_type == "admin_reply":
             self._replies[payload["rid"]] = payload
 
-    def call(self, cmd: str, timeout: float = 10.0, **args):
-        rid = next(self._rids)
-        self.net.send(self.name, "meta", "admin",
-                      {"rid": rid, "cmd": cmd, "args": args})
-        deadline = time.monotonic() + timeout
-        while time.monotonic() < deadline:
-            if rid in self._replies:
-                reply = self._replies.pop(rid)
-                if reply["err"] != int(ErrorCode.ERR_OK):
-                    raise PegasusError(ErrorCode(reply["err"]),
-                                       str(reply.get("result")))
-                return reply["result"]
-            time.sleep(0.01)
-        raise PegasusError(ErrorCode.ERR_TIMEOUT, f"admin {cmd}")
+    def call(self, cmd: str, timeout: float = 15.0, **args):
+        """One OVERALL deadline shared across the meta-group rotation —
+        the caller's timeout bound holds in both directions."""
+        metas = [n for n, c in self.cfg["nodes"].items()
+                 if c["role"] == "meta"]
+        overall = time.monotonic() + timeout
+        last = None
+        for i, meta in enumerate(metas):
+            remaining = overall - time.monotonic()
+            if remaining <= 0:
+                break
+            rid = next(self._rids)
+            self.net.send(self.name, meta, "admin",
+                          {"rid": rid, "cmd": cmd, "args": args})
+            slice_deadline = time.monotonic() + remaining / (len(metas) - i)
+            while time.monotonic() < slice_deadline:
+                if rid in self._replies:
+                    reply = self._replies.pop(rid)
+                    if reply["err"] != int(ErrorCode.ERR_OK):
+                        raise PegasusError(ErrorCode(reply["err"]),
+                                           str(reply.get("result")))
+                    return reply["result"]
+                time.sleep(0.01)
+            last = PegasusError(ErrorCode.ERR_TIMEOUT,
+                                f"admin {cmd} via {meta}")
+        raise last or PegasusError(ErrorCode.ERR_TIMEOUT, f"admin {cmd}")
 
     def create_table(self, app_name: str, partition_count: int = 8,
                      replica_count: int = 3,
@@ -193,8 +210,9 @@ def connect(app_name: str, directory: str = DEFAULT_DIR,
         cfg = json.load(f)
     book = {n: (c["host"], c["port"]) for n, c in cfg["nodes"].items()}
     net = TcpTransport(None, book)
+    metas = [n for n, c in cfg["nodes"].items() if c["role"] == "meta"]
     return ClusterClient(
-        net, client_name or f"client-{os.getpid()}", "meta", app_name,
+        net, client_name or f"client-{os.getpid()}", metas, app_name,
         pump=lambda: time.sleep(0.01), max_retries=8, pump_rounds=400)
 
 
@@ -205,9 +223,10 @@ def main() -> None:
     ap.add_argument("action", choices=["start", "stop", "status"])
     ap.add_argument("--dir", default=DEFAULT_DIR)
     ap.add_argument("--nodes", type=int, default=3)
+    ap.add_argument("--metas", type=int, default=1)
     args = ap.parse_args()
     if args.action == "start":
-        cfg = start(args.dir, args.nodes)
+        cfg = start(args.dir, args.nodes, args.metas)
         print(json.dumps(cfg["nodes"], indent=1))
     elif args.action == "stop":
         print("stopped:", ", ".join(stop(args.dir)) or "(nothing)")
