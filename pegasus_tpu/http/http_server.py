@@ -1,9 +1,10 @@
-"""Built-in HTTP endpoints: /metrics, /version, /config.
+"""Built-in HTTP endpoints: /metrics, /version, /config, /command.
 
 Parity: src/http/http_server.h:91 (registry-based endpoints) with the
 builtin calls (src/http/builtin_http_calls.cpp:80-103 /version /config;
 :280-288 /metrics via metrics_http_service, JSON with entity/metric
-filters — the surface the Go collector scrapes).
+filters — the surface the Go collector scrapes) plus remote-command
+verbs over HTTP (/command?verb=...&args=a,b — command_manager.h:52).
 """
 
 from __future__ import annotations
@@ -39,6 +40,18 @@ class _Handler(BaseHTTPRequestHandler):
                               "framework": "pegasus_tpu"})
         elif url.path == "/config":
             self._reply(200, FLAGS.snapshot())
+        elif url.path == "/command":
+            mgr = getattr(self.server, "commands", None)
+            if mgr is None:
+                self._reply(404, {"error": "no command manager attached"})
+                return
+            verb = query.get("verb", ["help"])[0]
+            args = [a for a in query.get("args", [""])[0].split(",") if a]
+            try:
+                self._reply(200, {"verb": verb,
+                                  "result": mgr.call(verb, args)})
+            except (KeyError, ValueError, TypeError) as e:
+                self._reply(400, {"error": str(e)})
         elif url.path == "/metrics":
             entity_type = query.get("with_metric_entity_type",
                                     query.get("entity_type", [None]))[0]
@@ -52,8 +65,11 @@ class _Handler(BaseHTTPRequestHandler):
 class MetricsHttpServer:
     """Threaded HTTP server; bind port 0 for an ephemeral port."""
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0) -> None:
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 commands=None) -> None:
         self._server = ThreadingHTTPServer((host, port), _Handler)
+        # the /command endpoint serves this registry (None = 404)
+        self._server.commands = commands
         self._thread: Optional[threading.Thread] = None
 
     @property

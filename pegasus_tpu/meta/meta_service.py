@@ -79,6 +79,18 @@ class MetaService:
         self.bulk_load = MetaBulkLoadService(self)
         self.duplication = MetaDuplicationService(self)
         self.split = MetaSplitService(self)
+        from pegasus_tpu.utils.command_manager import CommandManager
+
+        self.commands = CommandManager()
+        self.commands.register(
+            "meta.status",
+            lambda _a: {"name": self.name,
+                        "leader": self.election.leader,
+                        "is_leader": self.election.is_leader,
+                        "term": self.election.term,
+                        "state_seq": self.storage.seq,
+                        "alive_nodes": self.fd.alive_workers()},
+            "leadership + state version + live workers")
         net.register(name, self.on_message)
 
     # ---- multi-meta plumbing ------------------------------------------
@@ -175,6 +187,18 @@ class MetaService:
             # replies to admin verbs THIS meta issued (dup bootstrap
             # asking the follower cluster's meta to restore_app)
             self.duplication.on_admin_reply(payload)
+            return
+        if msg_type == "remote_command":
+            rid = payload.get("rid")
+            try:
+                result = self.commands.call(payload["cmd"],
+                                            payload.get("args") or [])
+                err = 0
+            except (KeyError, ValueError, TypeError) as e:
+                result = str(e)
+                err = int(ErrorCode.ERR_HANDLER_NOT_FOUND)
+            self.net.send(self.name, src, "remote_command_reply", {
+                "rid": rid, "err": err, "result": result})
             return
         if msg_type == "query_config":
             # client partition-config resolution (parity: RPC_CM_QUERY_

@@ -139,6 +139,13 @@ class Replica:
         self._promotion_watermark = 0
         # lazily hydrated from the .ingested_loads marker (bulk load dedup)
         self._ingested_load_ids: Set[int] = set()
+        # per-mutation latency tracers (parity: every mutation carries a
+        # latency_tracer, replica_2pc.cpp:338-359; slow dumps via
+        # dump_trace_points). Write traces share the server's slow log so
+        # ONE app-env threshold (replica.slow_query_threshold_ms) governs
+        # reads and writes alike
+        self._traces: Dict[int, Any] = {}
+        self.slow_log = self.server.slow_log
         # callbacks to the control plane (meta); tests wire these
         self.on_learn_completed: Optional[Callable[[str], None]] = None
         self.on_replication_error: Optional[Callable[[str, int], None]] = None
@@ -205,6 +212,7 @@ class Replica:
     def _clear_primary_state(self) -> None:
         self._pending_acks.clear()
         self._client_callbacks.clear()
+        self._traces.clear()
         self._learners.clear()
         # learn snapshots for in-flight learners die with the primaryship
         # (each is a full SST copy; completion will never fire to GC them)
@@ -247,17 +255,25 @@ class Replica:
         # reserve one microsecond PER OP: duplication stamps op i with
         # ts + i, and the next mutation must not overlap those timetags
         self._last_timestamp_us = ts + max(len(ops), 1) - 1
+        from pegasus_tpu.utils.latency_tracer import LatencyTracer
+
+        tracer = LatencyTracer(f"write.{self.server.app_id}."
+                               f"{self.server.pidx}.d{decree}")
+        self._traces[decree] = tracer
         mu = Mutation(
             ballot=self.config.ballot, decree=decree,
             last_committed=self.last_committed_decree,
             timestamp_us=ts, ops=ops)
         self.prepare_list.prepare(mu)
+        tracer.add_point("prepare_local")
         self.log.append(mu)
+        tracer.add_point("append_plog")
         if callback is not None:
             self._client_callbacks[decree] = callback
         targets = self._prepare_targets(decree)
         self._pending_acks[decree] = set(targets)
         self._send_prepares(mu)
+        tracer.add_point("prepares_sent")
         if not targets:
             self._on_decree_ready(decree)
         return decree
@@ -350,6 +366,9 @@ class Replica:
         if pending is None:
             return
         pending.discard(src)
+        tracer = self._traces.get(decree)
+        if tracer is not None:
+            tracer.add_point(f"ack.{src}")
         if not pending:
             del self._pending_acks[decree]
             self._on_decree_ready(decree)
@@ -466,9 +485,15 @@ class Replica:
                 raise ValueError(f"unknown op {wo.op}")
             items.extend(its)
         ws.apply_items(items, mu.decree)
+        tracer = self._traces.pop(mu.decree, None)
+        if tracer is not None:
+            tracer.add_point("committed_applied")
         callback = self._client_callbacks.pop(mu.decree, None)
         if callback is not None:
             callback(responses)
+        if tracer is not None:
+            tracer.add_point("replied")
+            self.slow_log.observe(tracer)
 
     def has_ingested(self, load_id: int) -> bool:
         """Group-visible ingest dedup: the marker is written by EVERY

@@ -56,6 +56,11 @@ class ReplicaStub:
         self._ingest_inflight: set = set()
         # parent gpid -> split session state (see _split_advance)
         self._split_sessions: Dict[Gpid, dict] = {}
+        # remote-command verb registry (parity: command_manager.h:52)
+        from pegasus_tpu.utils.command_manager import CommandManager
+
+        self.commands = CommandManager()
+        self._register_default_commands()
         self._last_beacon_ack = float("-inf")
         net.register(name, self.on_message)
         # load existing replica dirs (parity: replica_stub boot scan,
@@ -73,6 +78,56 @@ class ReplicaStub:
                         with open(info_path) as f:
                             partition_count = json.load(f)["partition_count"]
                     self._open_replica(gpid, partition_count)
+
+    def _register_default_commands(self) -> None:
+        """The node's built-in control verbs (parity: the verbs replicas
+        register with command_manager — slow-query dumps, replica info,
+        metrics; invoked via shell remote_command, commands.h:111)."""
+        from pegasus_tpu.replica.replica import PartitionStatus
+
+        def slow_query_dump(args):
+            clear = "clear" in args
+            out = []
+            for gpid, r in sorted(self.replicas.items()):
+                # one shared log per replica; the name prefix tells the
+                # request class apart
+                for rep in r.server.slow_log.dump(clear=clear):
+                    kind = ("write" if rep.get("name", "").startswith(
+                        "write.") else "read")
+                    out.append(dict(rep, gpid=list(gpid), kind=kind))
+            return sorted(out, key=lambda d: -d.get("total_ms", 0))
+
+        def replica_info(_args):
+            return [{"gpid": list(gpid),
+                     "status": PartitionStatus(r.status).name,
+                     "ballot": r.config.ballot,
+                     "last_committed": r.last_committed_decree,
+                     "last_prepared": r.last_prepared_decree(),
+                     "partition_count": r.server.partition_count}
+                    for gpid, r in sorted(self.replicas.items())]
+
+        def metrics_dump(args):
+            from pegasus_tpu.utils.metrics import METRICS
+
+            return METRICS.snapshot(args[0] if args else None)
+
+        def flush_all(_args):
+            n = 0
+            for r in self.replicas.values():
+                if r.server.engine.flush():
+                    n += 1
+            return f"flushed {n} replicas"
+
+        self.commands.register(
+            "slow-query-dump", slow_query_dump,
+            "dump recent slow requests (arg 'clear' empties the ring)")
+        self.commands.register(
+            "replica.info", replica_info,
+            "list hosted replicas with roles and decrees")
+        self.commands.register("metrics", metrics_dump,
+                               "metrics snapshot [entity_type]")
+        self.commands.register("flush", flush_all,
+                               "flush every hosted replica's memtable")
 
     def close(self) -> None:
         for r in self.replicas.values():
@@ -174,6 +229,20 @@ class ReplicaStub:
                 if dup.on_write_reply(payload):
                     dup.tick()
                     return
+            return
+        if msg_type == "remote_command":
+            from pegasus_tpu.utils.errors import ErrorCode
+
+            rid = payload.get("rid")
+            try:
+                result = self.commands.call(payload["cmd"],
+                                            payload.get("args") or [])
+                err = 0
+            except (KeyError, ValueError, TypeError) as e:
+                result = str(e)
+                err = int(ErrorCode.ERR_HANDLER_NOT_FOUND)
+            self.net.send(self.name, src, "remote_command_reply", {
+                "rid": rid, "err": err, "result": result})
             return
         if msg_type == "client_write":
             self._on_client_write(src, payload)

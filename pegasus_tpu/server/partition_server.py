@@ -116,6 +116,12 @@ class PartitionServer:
             {"table": str(app_id), "partition": str(pidx)})
         self.cu = CapacityUnitCalculator(self.metrics)
         self._abnormal_reads = self.metrics.counter("abnormal_read_count")
+        # slow-read dumps (parity: slow-query threshold app-env +
+        # latency_tracer dumps); threshold configurable per table via
+        # replica.slow_query_threshold_ms
+        from pegasus_tpu.utils.latency_tracer import SlowQueryLog
+
+        self.slow_log = SlowQueryLog()
         # device-resident block cache: hot SST blocks stay in device memory
         # across scans (the HBM analogue of RocksDB's block cache), keyed by
         # (sst path, block offset) which is immutable per file
@@ -159,6 +165,8 @@ class PartitionServer:
                                    parse_throttle_env(value)))
                 elif key == "default_ttl":
                     staged.append(("_default_ttl", int(value)))
+                elif key == "replica.slow_query_threshold_ms":
+                    staged.append(("_slow_threshold_ms", float(value)))
                 elif key == "user_specified_compaction":
                     staged.append(("_compaction_rules",
                                    compile_rules(value) if value else None))
@@ -166,7 +174,10 @@ class PartitionServer:
                 raise ValueError(f"invalid app-env {key}={value!r}: {exc}") \
                     from exc
         for attr, parsed in staged:
-            setattr(self, attr, parsed)
+            if attr == "_slow_threshold_ms":
+                self.slow_log.threshold_ms = parsed
+            else:
+                setattr(self, attr, parsed)
         self.app_envs.update(envs)
 
     def _gate(self, bucket, denied: bool) -> int:
@@ -633,6 +644,16 @@ class PartitionServer:
 
     def on_multi_get(self, req: MultiGetRequest) -> MultiGetResponse:
         """Parity: on_multi_get (pegasus_server_impl.cpp:496)."""
+        t0 = time.perf_counter()
+        try:
+            return self._on_multi_get(req)
+        finally:
+            self.slow_log.observe_simple(
+                f"multi_get.{self.app_id}.{self.pidx}",
+                (time.perf_counter() - t0) * 1000.0,
+                {"hash_key": req.hash_key.decode(errors="replace")})
+
+    def _on_multi_get(self, req: MultiGetRequest) -> MultiGetResponse:
         gate = self._read_gate()
         if gate:
             resp = MultiGetResponse()
@@ -754,6 +775,17 @@ class PartitionServer:
 
     def _serve_scan_batch(self, req: GetScannerRequest, start_key: bytes,
                           stop_key: bytes) -> ScanResponse:
+        t0 = time.perf_counter()
+        try:
+            return self._serve_scan_batch_inner(req, start_key, stop_key)
+        finally:
+            self.slow_log.observe_simple(
+                f"scan.{self.app_id}.{self.pidx}",
+                (time.perf_counter() - t0) * 1000.0)
+
+    def _serve_scan_batch_inner(self, req: GetScannerRequest,
+                                start_key: bytes,
+                                stop_key: bytes) -> ScanResponse:
         now = epoch_now()
         resp = ScanResponse()
         limiter = RangeReadLimiter()

@@ -133,6 +133,12 @@ def main(argv=None) -> int:
     p.add_argument("table")
     p = sub.add_parser("nodes")
     p = sub.add_parser("rebalance")
+    p = sub.add_parser("remote_command")
+    p.add_argument("node", help="node name (meta / node0 / ...)")
+    p.add_argument("verb", help="registered verb ('help' lists them)")
+    p.add_argument("cmd_args", nargs="*")
+    p = sub.add_parser("slow_queries")
+    p.add_argument("node")
 
     args = parser.parse_args(argv)
 
@@ -193,6 +199,33 @@ class _ClusterBox:
 
     def update_app_envs(self, name: str, envs) -> None:
         self.admin.call("update_app_envs", app_name=name, envs=envs)
+
+    def remote_command(self, node: str, verb: str, cmd_args):
+        """Invoke a registered control verb on one node (parity: shell
+        remote_command over RPC_CLI_CLI_CALL)."""
+        import itertools as _it
+        import time as _time
+
+        rid = next(self.admin._rids)
+        replies = self.admin._replies
+        self.admin.net.register(self.admin.name, self.admin._on_message)
+
+        def on_msg(src, msg_type, payload):
+            if msg_type in ("admin_reply", "remote_command_reply"):
+                replies[payload["rid"]] = payload
+
+        self.admin.net.register(self.admin.name, on_msg)
+        self.admin.net.send(self.admin.name, node, "remote_command",
+                            {"rid": rid, "cmd": verb, "args": cmd_args})
+        deadline = _time.monotonic() + 10
+        while _time.monotonic() < deadline:
+            if rid in replies:
+                reply = replies.pop(rid)
+                if reply["err"] != 0:
+                    raise ValueError(str(reply["result"]))
+                return reply["result"]
+            _time.sleep(0.01)
+        raise ValueError(f"remote_command to {node} timed out")
 
     def open_table(self, name: str):
         raise NotImplementedError(
@@ -383,6 +416,13 @@ def _dispatch(args, box, out) -> int:
     elif args.cmd == "query_split":
         print(json.dumps(box.admin.call("split_status",
                                         app_name=args.table)), file=out)
+    elif args.cmd == "remote_command":
+        print(json.dumps(box.remote_command(args.node, args.verb,
+                                            args.cmd_args), indent=1),
+              file=out)
+    elif args.cmd == "slow_queries":
+        for rep in box.remote_command(args.node, "slow-query-dump", []):
+            print(json.dumps(rep), file=out)
     elif args.cmd == "nodes":
         for n in box.admin.call("list_nodes"):
             print(n, file=out)

@@ -99,3 +99,69 @@ def test_error_codes():
     assert err.code == ErrorCode.ERR_TIMEOUT
     assert "ERR_TIMEOUT" in str(err)
     assert StorageStatus.OK == 0 and StorageStatus.NOT_FOUND == 1
+
+
+def test_latency_tracer_stage_chain():
+    from pegasus_tpu.utils.latency_tracer import LatencyTracer, SlowQueryLog
+
+    clock_v = [0.0]
+    tr = LatencyTracer("write.1.0.d7", clock=lambda: clock_v[0])
+    clock_v[0] = 0.002
+    tr.add_point("prepare_local")
+    clock_v[0] = 0.010
+    tr.add_point("committed")
+    rep = tr.report()
+    assert rep["total_ms"] == 10.0
+    assert [s["stage"] for s in rep["stages"]] == ["prepare_local",
+                                                   "committed"]
+    assert rep["stages"][1]["delta_ms"] == 8.0
+
+    log = SlowQueryLog(threshold_ms=5.0, capacity=2)
+    assert log.observe(tr)
+    fast = LatencyTracer("fast", clock=lambda: clock_v[0])
+    assert not log.observe(fast)
+    # capacity bounds the ring
+    log.observe_simple("a", 50)
+    log.observe_simple("b", 60)
+    dump = log.dump()
+    assert len(dump) == 2 and dump[-1]["name"] == "b"
+
+
+def test_command_manager_verbs():
+    import pytest
+
+    from pegasus_tpu.utils.command_manager import CommandManager
+
+    mgr = CommandManager()
+    mgr.register("echo", lambda args: list(args), "echo args")
+    assert mgr.call("echo", ["a", "b"]) == ["a", "b"]
+    assert "echo" in mgr.call("help", [])
+    with pytest.raises(KeyError):
+        mgr.call("nope", [])
+    with pytest.raises(ValueError):
+        mgr.register("echo", lambda a: a)
+
+
+def test_slow_write_traces_recorded(tmp_path):
+    """The replicated write path records stage chains for slow mutations
+    and the node's remote command dumps them."""
+    from pegasus_tpu.tools.cluster import SimCluster
+
+    cluster = SimCluster(str(tmp_path / "c"), n_nodes=2)
+    try:
+        cluster.create_table("tr", partition_count=2, replica_count=2)
+        c = cluster.client("tr")
+        # force every write to be "slow" by lowering the threshold
+        for stub in cluster.stubs.values():
+            for r in stub.replicas.values():
+                r.slow_log.threshold_ms = 0.0
+        assert c.set(b"k", b"s", b"v") == 0
+        cluster.step()
+        dumps = []
+        for stub in cluster.stubs.values():
+            dumps += stub.commands.call("slow-query-dump", [])
+        assert dumps, "no slow-write trace recorded"
+        stages = [st["stage"] for st in dumps[0]["stages"]]
+        assert "append_plog" in stages and "replied" in stages
+    finally:
+        cluster.close()
