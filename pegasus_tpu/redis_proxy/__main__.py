@@ -1,0 +1,3 @@
+from pegasus_tpu.redis_proxy.proxy import main
+
+main()
