@@ -17,7 +17,7 @@ import threading
 from typing import List, Optional
 
 from pegasus_tpu.redis_proxy import resp
-from pegasus_tpu.utils.errors import StorageStatus
+from pegasus_tpu.utils.errors import PegasusError, StorageStatus
 
 OK = int(StorageStatus.OK)
 NOT_FOUND = int(StorageStatus.NOT_FOUND)
@@ -43,6 +43,10 @@ class RedisHandler:
             return fn(argv[1:])
         except (ValueError, IndexError) as e:
             return resp.error(str(e) or "wrong number of arguments")
+        except PegasusError as e:
+            # cluster-side failures (failover retries exhausted, timeouts)
+            # become -ERR replies, never dropped connections
+            return resp.error(f"cluster error: {e}")
 
     # ---- connection & introspection ------------------------------------
 
@@ -112,10 +116,12 @@ class RedisHandler:
         return resp.integer(-1 if ttl < 0 else ttl)
 
     def cmd_PTTL(self, args):
-        reply = self.cmd_TTL(args)
-        if reply.startswith(b":") and not reply.startswith((b":-1", b":-2")):
-            return resp.integer(int(reply[1:-2]) * 1000)
-        return reply
+        err, ttl = self.client.ttl(args[0], _EMPTY_SK)
+        if err == NOT_FOUND:
+            return resp.integer(-2)
+        if err != OK:
+            return resp.error(f"storage error {err}")
+        return resp.integer(-1 if ttl < 0 else ttl * 1000)
 
     # ---- counters ------------------------------------------------------
 

@@ -59,6 +59,10 @@ class RespParser:
             if nl2 < 0:
                 return None
             size = int(buf[pos + 1:nl2])
+            if size < 0:
+                # a negative bulk length in a REQUEST is a protocol error
+                # (accepting it would desynchronize the buffer)
+                raise ValueError(f"negative bulk length {size}")
             start = nl2 + 2
             if len(buf) < start + size + 2:
                 return None

@@ -447,10 +447,10 @@ class PartitionServer:
         exhausted means the range completed, and resume_key is where a
         follow-up should continue when not exhausted.
         """
-        sorted_run = None if reverse else self.engine.lsm.sorted_run()
-        if sorted_run is not None:
-            return self._columnar_scan(sorted_run, start_key, stop_key, now,
-                                       hash_filter, sort_filter,
+        sorted_runs = None if reverse else self.engine.lsm.sorted_runs()
+        if sorted_runs is not None:
+            return self._columnar_scan(sorted_runs, start_key, stop_key,
+                                       now, hash_filter, sort_filter,
                                        validate_hash, limiter, max_records,
                                        max_bytes, with_values)
 
@@ -497,7 +497,7 @@ class PartitionServer:
 
     def _columnar_scan(
         self,
-        sorted_run,
+        sorted_runs,
         start_key: bytes,
         stop_key: Optional[bytes],
         now: int,
@@ -509,11 +509,13 @@ class PartitionServer:
         max_bytes: int,
         with_values: bool,
     ) -> Tuple[List[Tuple[bytes, bytes, int]], bool, Optional[bytes]]:
-        """Fast path: the store is one sorted L1 run with no overlay, so SST
-        blocks stream columnar to the device with ZERO per-record host work
-        before the predicate — the TPU-first replacement for the
-        reference's per-record iterator loop. Only returned survivors are
-        materialized per record (response assembly).
+        """Fast path: the store is a sequence of non-overlapping sorted L1
+        runs with no overlay, so SST blocks stream columnar to the device
+        with ZERO per-record host work before the predicate — the
+        TPU-first replacement for the reference's per-record iterator
+        loop. Only returned survivors are materialized per record
+        (response assembly). Runs are visited in key order, skipping runs
+        outside the range.
 
         Boundary trimming (records outside [start_key, stop_key)) happens
         in the same device program via numpy prefix masks computed per
@@ -528,7 +530,53 @@ class PartitionServer:
         out_bytes = 0
         exhausted = True
         resume_key: Optional[bytes] = None
-        for bm, blk in sorted_run.iter_blocks(start_key, stop_key or None):
+
+        def ranged_blocks():
+            for run in sorted_runs:
+                if stop_key is not None and (run.first_key or b"") >= stop_key:
+                    continue
+                if start_key and (run.last_key or b"") < start_key:
+                    continue
+                for bm_blk in run.iter_blocks(start_key, stop_key or None):
+                    yield run, bm_blk
+
+        # one-deep pipeline: while the device evaluates block N's
+        # predicate, the host gathers/uploads block N+1 (jax dispatch is
+        # asynchronous; np.asarray in _drain is the sync point). Stopping
+        # one block late costs a dispatched-but-unused mask, never
+        # correctness — resume_key always comes from the drained block.
+        pending = None
+        stopped = False
+
+        def _drain(entry) -> bool:
+            """Materialize one block's result; True = stop the scan."""
+            nonlocal out_bytes, exhausted, resume_key
+            blk, n, keep_x, expired_x = entry
+            keep = np.asarray(keep_x)
+            expired = int(np.asarray(expired_x).sum())
+            if expired:
+                self._abnormal_reads.increment(expired)
+            stop_early = False
+            for i in np.flatnonzero(keep):
+                key = blk.key_at(i)
+                data = (extract_user_data(self.data_version,
+                                          blk.value_at(i))
+                        if with_values else b"")
+                out.append((key, data, int(blk.expire_ts[i])))
+                out_bytes += len(key) + len(data)
+                if ((max_records > 0 and len(out) >= max_records)
+                        or (max_bytes > 0 and out_bytes >= max_bytes)):
+                    resume_key = _after(key)
+                    stop_early = True
+                    break
+            if stop_early or not limiter.valid():
+                if not stop_early:
+                    resume_key = _after(blk.key_at(blk.count - 1))
+                exhausted = False
+                return True
+            return False
+
+        for run, (bm, blk) in ranged_blocks():
             n = blk.count
             valid = None
             # boundary blocks: mask rows outside the range (bisect on the
@@ -550,7 +598,7 @@ class PartitionServer:
                 valid = np.zeros(cap, dtype=bool)
                 valid[lo:hi] = True
             # device block cache: keyed by immutable (file, offset)
-            cache_key = (sorted_run.path, bm.offset)
+            cache_key = (run.path, bm.offset)
             dev_block = self._device_block_cache.get(cache_key)
             if dev_block is None:
                 nb = block_from_columns(blk.keys, blk.key_len, blk.expire_ts,
@@ -587,38 +635,26 @@ class PartitionServer:
                         self._prepared_cache.popitem(last=False)
                 else:
                     self._prepared_cache.move_to_end(cache_key)
-                keep, expired_mask = fused_scan_block(
+                keep, expired_lazy = fused_scan_block(
                     dev_block, now, sort_filter=sort_filter, pidx=self.pidx,
                     partition_version=self.partition_version,
                     validate_hash=validate_hash, prepared=prepared)
-                expired = int(expired_mask.sum())
             else:
                 masks = scan_block_predicate(
                     block, now, hash_filter=hash_filter,
                     sort_filter=sort_filter, validate_hash=validate_hash,
                     pidx=self.pidx,
                     partition_version=self.partition_version)
-                expired = int(np.asarray(masks.expired).sum())
-                keep = np.asarray(masks.keep)
-            if expired:
-                self._abnormal_reads.increment(expired)
-            stop_early = False
-            for i in np.flatnonzero(keep):
-                key = blk.key_at(i)
-                data = (extract_user_data(self.data_version, blk.value_at(i))
-                        if with_values else b"")
-                out.append((key, data, int(blk.expire_ts[i])))
-                out_bytes += len(key) + len(data)
-                if ((max_records > 0 and len(out) >= max_records)
-                        or (max_bytes > 0 and out_bytes >= max_bytes)):
-                    resume_key = _after(key)
-                    stop_early = True
-                    break
-            if stop_early or not limiter.valid():
-                if not stop_early:
-                    resume_key = _after(blk.key_at(n - 1))
-                exhausted = False
+                keep = masks.keep
+                expired_lazy = masks.expired
+            entry = (blk, n, keep, expired_lazy)
+            if pending is not None and _drain(pending):
+                pending = None
+                stopped = True
                 break
+            pending = entry
+        if pending is not None and not stopped:
+            _drain(pending)
         return out, exhausted, resume_key
 
     def _validate_batch(self, batch: List[Tuple[bytes, bytes, int]],

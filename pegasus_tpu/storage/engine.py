@@ -54,7 +54,7 @@ class StorageEngine:
         # from the table with the NEWEST watermark (an older L1 must not
         # revert a schema upgrade recorded by a newer L0 flush)
         self.last_flushed_decree = 0
-        for table in list(self.lsm.l0) + ([self.lsm.l1] if self.lsm.l1 else []):
+        for table in list(self.lsm.l0) + list(self.lsm.l1_runs):
             d = int(table.meta.get("last_flushed_decree", 0))
             if d >= self.last_flushed_decree and "data_version" in table.meta:
                 self.data_version = int(table.meta["data_version"])
@@ -131,7 +131,10 @@ class StorageEngine:
         os.makedirs(dest_dir, exist_ok=True)
         sst_dir = os.path.join(self.data_dir, "sst")
         for name in os.listdir(sst_dir):
-            if name.endswith(".sst"):
+            # the manifest MUST travel with the runs: without it a
+            # restored multi-run store would fall into the legacy
+            # newest-l1-wins recovery and silently drop runs
+            if name.endswith(".sst") or name == "MANIFEST.json":
                 shutil.copy2(os.path.join(sst_dir, name),
                              os.path.join(dest_dir, name))
         return self.last_flushed_decree
@@ -148,7 +151,7 @@ class StorageEngine:
         shutil.rmtree(sst_dir, ignore_errors=True)
         os.makedirs(sst_dir, exist_ok=True)
         for name in os.listdir(checkpoint_dir):
-            if name.endswith(".sst"):
+            if name.endswith(".sst") or name == "MANIFEST.json":
                 shutil.copy2(os.path.join(checkpoint_dir, name),
                              os.path.join(sst_dir, name))
         wal = os.path.join(data_dir, "wal.log")

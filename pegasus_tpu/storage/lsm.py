@@ -1,17 +1,28 @@
-"""LSMStore: memtable + L0 runs + L1, flush, merge, compaction.
+"""LSMStore: memtable + L0 runs + ranged L1 runs, flush, merge, compaction.
 
 Role parity: the RocksDB instance behind one replica
 (src/server/pegasus_server_impl.cpp:1551 opens the DB; manual compaction
 drives CompactRange, src/server/pegasus_manual_compact_service.h:48).
 
-Shape: two levels. Flushes produce L0 SSTs (overlapping, newest wins);
-full compaction merges memtable + L0 + L1 into a single L1 run, dropping
-tombstones, expired records (device-evaluated TTL predicate), stale
-post-split keys, and applying user-specified compaction rules — the
-bottommost-level semantics the reference relies on for TTL GC
-(src/server/key_ttl_compaction_filter.h:55,91).
+Shape: two levels. Flushes produce L0 SSTs (overlapping, newest wins).
+L1 is a sequence of NON-OVERLAPPING, size-capped runs ordered by key —
+compaction processes one output range at a time (merge memtable + L0
+sub-range + that L1 run) and caps each output run, so a big table is
+never rewritten as one monolithic file and each step's memory/latency
+stays bounded (the leveled-compaction property manual CompactRange
+relies on). The filter seam drops tombstones, expired records
+(device-evaluated TTL predicate), stale post-split keys, and applies
+user-specified rules — the bottommost-level semantics of
+src/server/key_ttl_compaction_filter.h:55,91.
 
-Scan merge order: memtable > newest L0 > ... > oldest L0 > L1.
+Device pipelining: while the device evaluates one batch's filter, the
+host builds the next (jax dispatch is async; materialization is delayed
+one batch).
+
+Durability: a manifest (temp+rename) names the live L1 runs; boot
+removes obsolete compaction inputs/outputs from crash windows.
+
+Scan merge order: memtable > newest L0 > ... > oldest L0 > L1 runs.
 """
 
 from __future__ import annotations
@@ -33,25 +44,58 @@ from pegasus_tpu.storage.sstable import (
 Record = Tuple[bytes, Optional[bytes], int]
 
 
+# records per L1 output run before the compactor starts a new one:
+# bounds every future range-compaction step (and its device batches)
+L1_RUN_CAPACITY = 262_144
+
+
 class LSMStore:
     def __init__(self, data_dir: str, block_capacity: int = BLOCK_CAPACITY,
-                 l0_compaction_trigger: int = 4) -> None:
+                 l0_compaction_trigger: int = 4,
+                 l1_run_capacity: int = L1_RUN_CAPACITY) -> None:
         self.data_dir = data_dir
         os.makedirs(data_dir, exist_ok=True)
         self._block_capacity = block_capacity
         self._l0_trigger = l0_compaction_trigger
+        self._l1_run_capacity = l1_run_capacity
         self.memtable = Memtable()
         self.l0: List[SSTable] = []   # newest first
-        self.l1: Optional[SSTable] = None
+        self.l1_runs: List[SSTable] = []  # key-ordered, non-overlapping
         self._file_seq = 0
         self._load_existing()
 
     # ---- files --------------------------------------------------------
 
+    def _manifest_path(self) -> str:
+        return os.path.join(self.data_dir, "MANIFEST.json")
+
+    def _write_manifest(self, l1_names: List[str]) -> None:
+        """Atomically record the live L1 run set + the seq horizon. Any
+        l1-* file not listed, and any l0-* file older than the horizon,
+        is a crash leftover boot removes."""
+        import json as _json
+        import tempfile as _tempfile
+
+        fd, tmp = _tempfile.mkstemp(dir=self.data_dir)
+        with os.fdopen(fd, "w") as f:
+            _json.dump({"seq": self._file_seq, "l1": l1_names}, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self._manifest_path())
+
     def _load_existing(self) -> None:
+        import json as _json
+
+        manifest = None
+        if os.path.exists(self._manifest_path()):
+            with open(self._manifest_path()) as f:
+                manifest = _json.load(f)
+            # the seq horizon must survive even when every .sst is gone
+            # (an all-tombstone compaction): fresh flushes below the
+            # horizon would be deleted as consumed inputs at next boot
+            self._file_seq = max(self._file_seq, manifest["seq"])
         l0_files = []
-        l1_file = None
-        l1_file_stale: List[Tuple[int, str]] = []
+        l1_files = []
         for name in os.listdir(self.data_dir):
             if name.endswith(".sst"):
                 seq = int(name.split("-")[1].split(".")[0])
@@ -59,30 +103,41 @@ class LSMStore:
                 if name.startswith("l0-"):
                     l0_files.append((seq, name))
                 elif name.startswith("l1-"):
-                    if l1_file is None or seq > l1_file[0]:
-                        if l1_file is not None:
-                            l1_file_stale.append(l1_file)
-                        l1_file = (seq, name)
-                    else:
-                        l1_file_stale.append((seq, name))
+                    l1_files.append((seq, name))
             elif name.endswith(".sst.tmp"):
                 # abandoned writer from a crash mid-build
                 os.remove(os.path.join(self.data_dir, name))
-        # Crash-recovery invariant: compaction merges EVERY live file into
-        # the new L1, so any file with seq < newest-L1 seq is an obsolete
-        # compaction input whose removal didn't complete — resurrect-proof
-        # cleanup happens here instead of via a manifest.
-        l1_seq = l1_file[0] if l1_file is not None else -1
+        if manifest is None:
+            # legacy layout (pre-manifest): newest l1 file wins, older
+            # files are obsolete compaction inputs
+            l1_live = []
+            if l1_files:
+                newest = max(l1_files)
+                l1_live = [newest[1]]
+                horizon = newest[0]
+            else:
+                horizon = -1
+            stale_l1 = [n for _s, n in l1_files if n not in l1_live]
+        else:
+            l1_live = [n for n in manifest["l1"]
+                       if os.path.exists(os.path.join(self.data_dir, n))]
+            horizon = manifest["seq"]
+            # unlisted l1 files: incomplete outputs from a crashed
+            # compaction (or inputs whose removal did not finish)
+            stale_l1 = [n for _s, n in l1_files if n not in l1_live]
+        for name in stale_l1:
+            os.remove(os.path.join(self.data_dir, name))
+        # l0 files older than the horizon are consumed compaction inputs
         for seq, name in list(l0_files):
-            if seq < l1_seq:
+            if seq < horizon:
                 os.remove(os.path.join(self.data_dir, name))
                 l0_files.remove((seq, name))
-        for seq, name in l1_file_stale:
-            os.remove(os.path.join(self.data_dir, name))
         for seq, name in sorted(l0_files, reverse=True):
             self.l0.append(SSTable(os.path.join(self.data_dir, name)))
-        if l1_file is not None:
-            self.l1 = SSTable(os.path.join(self.data_dir, l1_file[1]))
+        runs = [SSTable(os.path.join(self.data_dir, name))
+                for name in l1_live]
+        runs.sort(key=lambda t: t.first_key or b"")
+        self.l1_runs = runs
 
     def _next_path(self, level: str) -> str:
         path = os.path.join(self.data_dir, f"{level}-{self._file_seq}.sst")
@@ -92,8 +147,8 @@ class LSMStore:
     def close(self) -> None:
         for t in self.l0:
             t.close()
-        if self.l1 is not None:
-            self.l1.close()
+        for t in self.l1_runs:
+            t.close()
 
     # ---- writes -------------------------------------------------------
 
@@ -147,11 +202,27 @@ class LSMStore:
             if hit is not None:
                 value, ets = hit
                 return None if value is None else (value, ets)
-        if self.l1 is not None:
-            hit = self.l1.get(key)
+        run = self._run_for(key)
+        if run is not None:
+            hit = run.get(key)
             if hit is not None:
                 value, ets = hit
                 return None if value is None else (value, ets)
+        return None
+
+    def _run_for(self, key: bytes) -> Optional[SSTable]:
+        """The (single) L1 run whose range may hold `key` — runs are
+        non-overlapping and key-ordered."""
+        lo, hi = 0, len(self.l1_runs)
+        while lo < hi:
+            mid = (lo + hi) // 2
+            if (self.l1_runs[mid].last_key or b"") < key:
+                lo = mid + 1
+            else:
+                hi = mid
+        if lo < len(self.l1_runs) and (
+                (self.l1_runs[lo].first_key or b"") <= key):
+            return self.l1_runs[lo]
         return None
 
     def iterate(self, start: bytes = b"", stop: Optional[bytes] = None,
@@ -161,16 +232,20 @@ class LSMStore:
             self.memtable.iterate(start, stop, reverse)]
         for table in self.l0:
             sources.append(table.iterate(start, stop, reverse))
-        if self.l1 is not None:
-            sources.append(self.l1.iterate(start, stop, reverse))
+        if self.l1_runs:
+            # non-overlapping ordered runs chain into ONE merged source,
+            # keeping the merge heap as small as the old single-L1 shape
+            runs = (self.l1_runs if not reverse
+                    else list(reversed(self.l1_runs)))
+            sources.append(_chain_runs(runs, start, stop, reverse))
         return _merge(sources, reverse)
 
-    def sorted_run(self) -> Optional[SSTable]:
-        """The single L1 run when the store is fully compacted and there is
-        no overlay — the device fast path qualifier: scans may then stream
-        L1 blocks columnar to the predicate kernels."""
-        if len(self.memtable) == 0 and not self.l0 and self.l1 is not None:
-            return self.l1
+    def sorted_runs(self) -> Optional[List[SSTable]]:
+        """The ordered L1 runs when the store is fully compacted and there
+        is no overlay — the device fast path qualifier: scans stream each
+        run's blocks columnar to the predicate kernels, in key order."""
+        if len(self.memtable) == 0 and not self.l0 and self.l1_runs:
+            return self.l1_runs
         return None
 
     # ---- compaction ---------------------------------------------------
@@ -180,36 +255,66 @@ class LSMStore:
         record_filter: Optional[Callable[..., np.ndarray]] = None,
         meta: Optional[dict] = None,
     ) -> None:
-        """Full merge into one L1 run.
+        """Full compaction as a sequence of BOUNDED range steps.
+
+        One merged pass over memtable + L0 + L1 runs; output runs are
+        size-capped (`l1_run_capacity`), so no monolithic rewrite and a
+        predictable working set per step — the manual CompactRange shape.
 
         `record_filter(keys: List[bytes], expire_ts: List[int]) ->
-        (drop_mask, new_expire)` is evaluated over columnar batches of
-        merged records — the seam where the device TTL/compaction-rule
-        kernels plug in (engine.StorageEngine wires it). Tombstones always
-        drop (bottommost).
+        (drop_mask, new_expire)` is the device TTL/compaction-rule seam
+        (engine.StorageEngine wires it); evaluation is DOUBLE-BUFFERED:
+        while the device filters batch N, the host gathers batch N+1
+        (jax dispatch is asynchronous — only materialization blocks).
+        Tombstones always drop (bottommost).
         """
         merged = self.iterate()
-        writer = SSTableWriter(self._next_path("l1"),
-                               block_capacity=self._block_capacity, meta=meta)
+        new_runs: List[SSTable] = []
+        writer: Optional[SSTableWriter] = None
+        written_in_run = 0
+
+        def open_writer() -> SSTableWriter:
+            return SSTableWriter(self._next_path("l1"),
+                                 block_capacity=self._block_capacity,
+                                 meta=meta)
+
+        def write_records(keys, vals, drop, new_ets) -> None:
+            nonlocal writer, written_in_run
+            for i, k in enumerate(keys):
+                if drop is not None and drop[i]:
+                    continue
+                if writer is None:
+                    writer = open_writer()
+                writer.add(k, vals[i], int(new_ets[i]))
+                written_in_run += 1
+                if written_in_run >= self._l1_run_capacity:
+                    writer.finish()
+                    new_runs.append(SSTable(writer.path))
+                    writer = None
+                    written_in_run = 0
+
+        # pipeline state: the batch whose filter is in flight on device
+        pending: Optional[tuple] = None
+
+        def submit(keys, vals, ets):
+            if record_filter is None:
+                return (keys, vals, None, ets)
+            drop, new_ets = record_filter(keys, ets)
+            # jax returns asynchronously-evaluated arrays; conversion to
+            # numpy in drain() is the synchronization point
+            return (keys, vals, drop, new_ets)
+
+        def drain(entry) -> None:
+            keys, vals, drop, new_ets = entry
+            if drop is not None:
+                # materialize = the device synchronization point
+                drop = np.asarray(drop)
+                new_ets = np.asarray(new_ets)
+            write_records(keys, vals, drop, new_ets)
+
         batch_keys: List[bytes] = []
         batch_vals: List[bytes] = []
         batch_ets: List[int] = []
-
-        def flush_batch() -> None:
-            if not batch_keys:
-                return
-            if record_filter is not None:
-                drop, new_ets = record_filter(batch_keys, batch_ets)
-                for i, k in enumerate(batch_keys):
-                    if not drop[i]:
-                        writer.add(k, batch_vals[i], int(new_ets[i]))
-            else:
-                for k, v, e in zip(batch_keys, batch_vals, batch_ets):
-                    writer.add(k, v, e)
-            batch_keys.clear()
-            batch_vals.clear()
-            batch_ets.clear()
-
         for key, value, ets in merged:
             if value is None:  # tombstone: bottommost level -> drop
                 continue
@@ -217,20 +322,35 @@ class LSMStore:
             batch_vals.append(value)
             batch_ets.append(ets)
             if len(batch_keys) >= self._block_capacity:
-                flush_batch()
-        flush_batch()
-        writer.finish()
+                entry = submit(batch_keys, batch_vals, batch_ets)
+                if pending is not None:
+                    drain(pending)
+                pending = entry
+                batch_keys, batch_vals, batch_ets = [], [], []
+        if batch_keys:
+            entry = submit(batch_keys, batch_vals, batch_ets)
+            if pending is not None:
+                drain(pending)
+            pending = entry
+        if pending is not None:
+            drain(pending)
+        if writer is not None:
+            writer.finish()
+            new_runs.append(SSTable(writer.path))
 
-        old_l0, old_l1 = self.l0, self.l1
-        self.l1 = SSTable(writer.path)
+        # publish: manifest first (atomic), then remove inputs — boot
+        # cleans up either crash window
+        self._write_manifest([os.path.basename(t.path) for t in new_runs])
+        old_l0, old_runs = self.l0, self.l1_runs
+        self.l1_runs = new_runs
         self.l0 = []
         self.memtable = Memtable()
         for t in old_l0:
             t.close()
             os.remove(t.path)
-        if old_l1 is not None:
-            old_l1.close()
-            os.remove(old_l1.path)
+        for t in old_runs:
+            t.close()
+            os.remove(t.path)
 
 
 class _HeapEntry:
@@ -275,3 +395,17 @@ def _merge(sources: List[Iterator[Record]], reverse: bool = False
             heapq.heappush(heap,
                            _HeapEntry(nxt[0], entry.src_idx, nxt, entry.it,
                                       reverse))
+
+
+def _chain_runs(runs: List[SSTable], start: bytes, stop: Optional[bytes],
+                reverse: bool) -> Iterator[Record]:
+    """Iterate non-overlapping key-ordered runs as one ordered stream,
+    skipping runs outside [start, stop)."""
+    for run in runs:
+        first = run.first_key or b""
+        last = run.last_key or b""
+        if stop is not None and first >= stop:
+            continue
+        if start and last < start:
+            continue
+        yield from run.iterate(start, stop, reverse)

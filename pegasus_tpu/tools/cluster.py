@@ -45,6 +45,7 @@ class SimCluster:
             self.loop.run_until_idle()
         self.stubs: Dict[str, ReplicaStub] = {}
         self._dead: set = set()
+        self._last_step_time = 0.0
         # wall-anchored clock so value timetags / TTL math are realistic
         # while FD timing stays on deterministic sim time
         self._epoch = 1_700_000_000
@@ -98,13 +99,19 @@ class SimCluster:
             for m in self.metas:
                 if m.name not in self._dead:
                     m.tick()
+        self._last_step_time = self.loop.now
         self.loop.run_until_idle()
 
     def pump(self) -> None:
         """ClusterClient wait-callback: drain messages; if the client is
         still blocked (caller loops), advance a beacon interval so FD/
-        guardian progress can unblock it."""
-        if self.loop.run_until_idle() == 0:
+        guardian progress can unblock it. Heavy traffic ALSO advances sim
+        time (per-message delays), so the timer round must fire whenever
+        a beacon interval of sim time has passed — otherwise a long write
+        burst starves beacons and every worker's lease lapses."""
+        if (self.loop.run_until_idle() == 0
+                or self.loop.now - self._last_step_time
+                > self.beacon_interval):
             self.step()
 
     # ---- DDL + clients -------------------------------------------------

@@ -262,7 +262,7 @@ def _dispatch(args, box, out) -> int:
         for p_ in t.all_partitions():
             print(f"  {t.app_id}.{p_.pidx}: decree="
                   f"{p_.engine.last_committed_decree} "
-                  f"records~{sum(s.total_count for s in p_.engine.lsm.l0) + (p_.engine.lsm.l1.total_count if p_.engine.lsm.l1 else 0)}",
+                  f"records~{sum(s.total_count for s in p_.engine.lsm.l0) + sum(s.total_count for s in p_.engine.lsm.l1_runs)}",
                   file=out)
     elif args.cmd == "set":
         c = box.client(args.table)

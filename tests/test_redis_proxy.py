@@ -110,3 +110,21 @@ def test_proxy_over_socket_against_cluster(tmp_path):
         proxy.stop()
     finally:
         cluster.close()
+
+
+def test_resp_negative_bulk_rejected():
+    p = RespParser()
+    with pytest.raises(ValueError):
+        p.feed(b"*1\r\n$-1\r\n*1\r\n$4\r\nPING\r\n")
+
+
+def test_cluster_error_becomes_err_reply(handler):
+    from pegasus_tpu.utils.errors import ErrorCode, PegasusError
+
+    class Boom:
+        def set(self, *a, **k):
+            raise PegasusError(ErrorCode.ERR_TIMEOUT, "retries exhausted")
+
+    h = RedisHandler(Boom())
+    out = h.handle([b"SET", b"k", b"v"])
+    assert out.startswith(b"-ERR cluster error")

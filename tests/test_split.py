@@ -56,14 +56,14 @@ def test_split_compaction_drops_stale_halves(loaded):
     # physical copies before compaction: every record exists twice
     physical = sum(
         sum(tbl.total_count for tbl in p.engine.lsm.l0)
-        + (p.engine.lsm.l1.total_count if p.engine.lsm.l1 else 0)
+        + sum(t.total_count for t in p.engine.lsm.l1_runs)
         + len(p.engine.lsm.memtable)
         for p in t.all_partitions())
     total = sum(len(kvs) for kvs in data.values())
     assert physical >= total  # duplicated state present
     t.manual_compact_all()
     physical_after = sum(
-        p.engine.lsm.l1.total_count if p.engine.lsm.l1 else 0
+        sum(t.total_count for t in p.engine.lsm.l1_runs)
         for p in t.all_partitions())
     assert physical_after == total  # stale halves physically gone
     for hk, kvs in data.items():

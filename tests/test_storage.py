@@ -201,13 +201,13 @@ def test_lsm_compact_drops_tombstones(tmp_path):
     for i in range(0, 20, 2):
         lsm.delete(k("h", "s%02d" % i))
     lsm.compact()
-    assert lsm.l1 is not None and not lsm.l0 and len(lsm.memtable) == 0
-    assert lsm.l1.total_count == 10
+    assert lsm.l1_runs and not lsm.l0 and len(lsm.memtable) == 0
+    assert sum(t.total_count for t in lsm.l1_runs) == 10
     assert lsm.get(k("h", "s00")) is None
     assert lsm.get(k("h", "s01")) == (b"v1", 0)
-    assert lsm.sorted_run() is not None
+    assert lsm.sorted_runs() is not None
     lsm.put(k("h", "zzz"), b"x")
-    assert lsm.sorted_run() is None  # overlay disqualifies the fast path
+    assert lsm.sorted_runs() is None  # overlay disqualifies the fast path
     lsm.close()
 
 
@@ -305,7 +305,7 @@ def test_engine_manual_compact_ttl(tmp_path):
     assert eng.get(k("h", "dead")) is None
     assert eng.get(k("h", "live")) is not None
     assert eng.get(k("h", "eternal")) is not None
-    assert eng.lsm.l1.meta["last_flushed_decree"] == 1
+    assert eng.lsm.l1_runs[0].meta["last_flushed_decree"] == 1
     eng.close()
 
 
@@ -343,3 +343,124 @@ def test_engine_compact_pv_negative_keeps_all(tmp_path):
     eng.manual_compact(validate_hash=True, pidx=0, partition_version=-1)
     assert all(eng.get(key) is not None for key in keys)
     eng.close()
+
+
+def test_multi_run_l1_compaction_and_recovery(tmp_path):
+    """Range-capped compaction: output splits into non-overlapping runs,
+    reads/scans stay correct, and the manifest makes recovery exact."""
+    from pegasus_tpu.storage.lsm import LSMStore
+
+    d = str(tmp_path / "lsm")
+    lsm = LSMStore(d, l1_run_capacity=100)
+    for i in range(350):
+        lsm.put(b"k%05d" % i, b"v%d" % i)
+    lsm.flush()
+    for i in range(350, 700):
+        lsm.put(b"k%05d" % i, b"v%d" % i)
+    lsm.flush()
+    lsm.compact()
+    assert len(lsm.l1_runs) == 7  # 700 records / 100-cap runs
+    # non-overlapping + ordered
+    for a, b in zip(lsm.l1_runs, lsm.l1_runs[1:]):
+        assert a.last_key < b.first_key
+    # point reads route to the right run
+    for i in (0, 99, 100, 350, 699):
+        assert lsm.get(b"k%05d" % i) == (b"v%d" % i, 0)
+    # ranged scans merge across run boundaries
+    got = [k for k, _v, _e in lsm.iterate(b"k00095", b"k00105")]
+    assert got == [b"k%05d" % i for i in range(95, 105)]
+    assert lsm.sorted_runs() is not None and len(lsm.sorted_runs()) == 7
+    lsm.close()
+
+    # recovery via manifest: all runs come back
+    lsm2 = LSMStore(d, l1_run_capacity=100)
+    assert len(lsm2.l1_runs) == 7
+    assert lsm2.get(b"k00500") == (b"v500", 0)
+    # a second compaction after more writes keeps working
+    lsm2.put(b"k00500", b"updated")
+    lsm2.delete(b"k00000")
+    lsm2.flush()
+    lsm2.compact()
+    assert lsm2.get(b"k00500") == (b"updated", 0)
+    assert lsm2.get(b"k00000") is None
+    lsm2.close()
+
+
+def test_manifest_cleans_crash_leftovers(tmp_path):
+    """An l1 file not in the manifest (incomplete compaction output) is
+    removed at boot; l0 files older than the horizon too."""
+    import os
+
+    from pegasus_tpu.storage.lsm import LSMStore
+
+    d = str(tmp_path / "lsm")
+    lsm = LSMStore(d, l1_run_capacity=50)
+    for i in range(120):
+        lsm.put(b"a%04d" % i, b"v")
+    lsm.flush()
+    lsm.compact()
+    runs_before = [os.path.basename(t.path) for t in lsm.l1_runs]
+    lsm.close()
+    # simulate a crashed compaction: an orphan l1 output + stale l0 input
+    open(os.path.join(d, "l1-9999.sst"), "wb").write(b"garbage")
+    open(os.path.join(d, "l0-0.sst"), "wb").write(b"garbage")
+    lsm2 = LSMStore(d)
+    assert sorted(os.path.basename(t.path) for t in lsm2.l1_runs) == \
+        sorted(runs_before)
+    assert not os.path.exists(os.path.join(d, "l1-9999.sst"))
+    assert not os.path.exists(os.path.join(d, "l0-0.sst"))
+    assert lsm2.get(b"a0050") == (b"v", 0)
+    lsm2.close()
+
+
+def test_checkpoint_carries_manifest(tmp_path):
+    """A checkpoint of a multi-run store restores with ALL runs (the
+    manifest travels with the SSTs)."""
+    from pegasus_tpu.storage.engine import StorageEngine, WriteBatchItem
+    from pegasus_tpu.storage.wal import OP_PUT
+    from pegasus_tpu.base.value_schema import generate_value
+
+    eng = StorageEngine(str(tmp_path / "e"))
+    eng.lsm._l1_run_capacity = 50
+    items = [WriteBatchItem(OP_PUT, b"c%04d" % i,
+                            generate_value(1, b"v%d" % i, 0), 0)
+             for i in range(160)]
+    eng.write_batch(items, 1)
+    eng.manual_compact()
+    assert len(eng.lsm.l1_runs) > 1
+    ck = str(tmp_path / "ckpt")
+    eng.checkpoint(ck)
+    restored = StorageEngine.restore_from_checkpoint(
+        ck, str(tmp_path / "r"))
+    assert len(restored.lsm.l1_runs) == len(eng.lsm.l1_runs)
+    for i in (0, 70, 159):
+        hit = restored.lsm.get(b"c%04d" % i)
+        assert hit is not None
+    eng.close()
+    restored.close()
+
+
+def test_empty_compaction_keeps_seq_horizon(tmp_path):
+    """Review regression: an all-tombstone compaction leaves no .sst files;
+    the next boot must still honor the manifest's seq horizon or freshly
+    flushed L0 files get deleted as 'consumed compaction inputs'."""
+    from pegasus_tpu.storage.lsm import LSMStore
+
+    d = str(tmp_path / "lsm")
+    lsm = LSMStore(d)
+    lsm.put(b"k", b"v")
+    lsm.flush()
+    lsm.delete(b"k")
+    lsm.flush()
+    lsm.compact()
+    assert not lsm.l1_runs  # everything dropped
+    lsm.close()
+
+    lsm2 = LSMStore(d)
+    lsm2.put(b"new", b"data")
+    lsm2.flush()
+    lsm2.close()
+
+    lsm3 = LSMStore(d)  # the boot that used to eat the fresh flush
+    assert lsm3.get(b"new") == (b"data", 0)
+    lsm3.close()

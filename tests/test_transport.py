@@ -118,6 +118,16 @@ def test_multiprocess_onebox(tmp_path):
         assert len(admin.call("list_nodes")) == 3
         admin.create_table("fn", partition_count=4, replica_count=3)
         c = ob.connect("fn", d)
+        from pegasus_tpu.utils.errors import PegasusError
+
+        # settle: a loaded machine can lag config propagation/leases
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            try:
+                if c.set(b"warm", b"s", b"w") == 0:
+                    break
+            except PegasusError:
+                time.sleep(1)
         acked = []
         for i in range(20):
             if c.set(b"k%02d" % i, b"s", b"v%d" % i) == 0:
@@ -128,8 +138,6 @@ def test_multiprocess_onebox(tmp_path):
         c.refresh_config()
         victim = c._configs[0]["primary"]
         ob.kill_node(victim, d)
-        from pegasus_tpu.utils.errors import PegasusError
-
         for i in range(20, 30):
             # a write that exhausts retries during the outage is simply
             # un-acked — only OK-acked writes must survive
