@@ -1,0 +1,160 @@
+"""FsManager: multi-data-dir layout, capacity tracking, trash cleanup.
+
+Parity: src/common/fs_manager.h:115 (dir_node capacity tracking +
+per-disk replica placement), src/replica/disk_cleaner.* (removed
+replicas rename to trash and age out instead of vanishing instantly),
+and src/replica/replica_disk_migrator.h (move a replica between disks).
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import time
+from typing import Dict, List, Optional, Tuple
+
+Gpid = Tuple[int, int]
+
+TRASH_SUFFIX = ".gar"
+
+
+class FsManager:
+    def __init__(self, data_dirs: List[str]) -> None:
+        if not data_dirs:
+            raise ValueError("need at least one data dir")
+        self.data_dirs = [os.path.abspath(d) for d in data_dirs]
+        for d in self.data_dirs:
+            os.makedirs(d, exist_ok=True)
+
+    # ---- layout --------------------------------------------------------
+
+    @staticmethod
+    def _entry_name(gpid: Gpid) -> str:
+        return f"{gpid[0]}.{gpid[1]}"
+
+    def scan_replicas(self) -> Dict[Gpid, str]:
+        """gpid -> replica dir, across every data dir (parity: the boot
+        scan, replica_stub.cpp:594 load_replicas per disk)."""
+        out: Dict[Gpid, str] = {}
+        for d in self.data_dirs:
+            for entry in sorted(os.listdir(d)):
+                if entry.endswith(".migrating"):
+                    # crashed mid-migration copy: the source is intact
+                    shutil.rmtree(os.path.join(d, entry),
+                                  ignore_errors=True)
+                    continue
+                parts = entry.split(".")
+                if len(parts) == 2 and all(p.isdigit() for p in parts):
+                    out[(int(parts[0]), int(parts[1]))] = os.path.join(
+                        d, entry)
+        return out
+
+    def dir_of(self, gpid: Gpid) -> Optional[str]:
+        for d in self.data_dirs:
+            path = os.path.join(d, self._entry_name(gpid))
+            if os.path.isdir(path):
+                return path
+        return None
+
+    def replica_dir(self, gpid: Gpid) -> str:
+        """Existing home, or a placement on the least-loaded disk
+        (parity: fs_manager picks the dir with most headroom; replica
+        COUNT is the capacity proxy here — byte usage shifts with
+        compaction and would make placement flappy)."""
+        existing = self.dir_of(gpid)
+        if existing is not None:
+            return existing
+        counts = {d: 0 for d in self.data_dirs}
+        for _g, path in self.scan_replicas().items():
+            counts[os.path.dirname(path)] += 1
+        best = min(self.data_dirs, key=lambda d: (counts[d], d))
+        return os.path.join(best, self._entry_name(gpid))
+
+    # ---- capacity ------------------------------------------------------
+
+    def stats(self) -> List[dict]:
+        out = []
+        for d in self.data_dirs:
+            replicas = []
+            used = 0
+            for entry in sorted(os.listdir(d)):
+                path = os.path.join(d, entry)
+                if not os.path.isdir(path) or entry.endswith(TRASH_SUFFIX):
+                    continue
+                parts = entry.split(".")
+                if len(parts) == 2 and all(p.isdigit() for p in parts):
+                    replicas.append(entry)
+                    used += _dir_bytes(path)
+            disk = shutil.disk_usage(d)
+            out.append({"dir": d, "replicas": replicas,
+                        "used_bytes": used,
+                        "disk_total": disk.total,
+                        "disk_available": disk.free})
+        return out
+
+    # ---- trash (parity: disk_cleaner — .gar aging) ---------------------
+
+    def trash_replica(self, gpid: Gpid) -> Optional[str]:
+        """Removed replicas move to trash (name.<ts>.gar) instead of
+        instant deletion — an operator can still recover from a wrong
+        GC decision until the cleaner ages it out."""
+        path = self.dir_of(gpid)
+        if path is None:
+            return None
+        dest = f"{path}.{int(time.time())}{TRASH_SUFFIX}"
+        os.rename(path, dest)
+        return dest
+
+    def clean_trash(self, max_age_seconds: float = 86400.0) -> List[str]:
+        removed = []
+        now = time.time()
+        for d in self.data_dirs:
+            for entry in os.listdir(d):
+                if not entry.endswith(TRASH_SUFFIX):
+                    continue
+                try:
+                    ts = int(entry[:-len(TRASH_SUFFIX)].rsplit(".", 1)[1])
+                except (IndexError, ValueError):
+                    ts = 0
+                if now - ts >= max_age_seconds:
+                    shutil.rmtree(os.path.join(d, entry),
+                                  ignore_errors=True)
+                    removed.append(entry)
+        return removed
+
+    # ---- migration (parity: replica_disk_migrator.h) -------------------
+
+    def migrate(self, gpid: Gpid, dest_data_dir: str) -> str:
+        """Copy a (closed) replica dir to another disk and retire the
+        old copy to trash; caller must have closed the replica first and
+        reopens it from the returned path."""
+        dest_data_dir = os.path.abspath(dest_data_dir)
+        if dest_data_dir not in self.data_dirs:
+            raise ValueError(f"{dest_data_dir} is not a managed data dir")
+        src = self.dir_of(gpid)
+        if src is None:
+            raise ValueError(f"replica {gpid} not found")
+        if os.path.dirname(src) == dest_data_dir:
+            return src
+        dest = os.path.join(dest_data_dir, self._entry_name(gpid))
+        # copy under a temp name, then rename: a crash mid-copy must not
+        # leave a truncated dir with the REPLICA'S name that could shadow
+        # the intact source at the next boot scan
+        tmp = dest + ".migrating"
+        shutil.rmtree(tmp, ignore_errors=True)
+        shutil.rmtree(dest, ignore_errors=True)
+        shutil.copytree(src, tmp)
+        os.rename(src, f"{src}.{int(time.time())}{TRASH_SUFFIX}")
+        os.rename(tmp, dest)
+        return dest
+
+
+def _dir_bytes(path: str) -> int:
+    total = 0
+    for root, _dirs, files in os.walk(path):
+        for name in files:
+            try:
+                total += os.path.getsize(os.path.join(root, name))
+            except OSError:
+                pass
+    return total

@@ -146,6 +146,12 @@ class Replica:
         # reads and writes alike
         self._traces: Dict[int, Any] = {}
         self.slow_log = self.server.slow_log
+        # whether learn checkpoint paths are reachable via the local
+        # filesystem (single host / shared fs). Multi-host deployments set
+        # False on the stub and checkpoints travel via the file-transfer
+        # service (nfs_node.h:84 parity)
+        self.shared_fs = True
+        self.on_remote_checkpoint: Optional[Callable] = None
         # callbacks to the control plane (meta); tests wire these
         self.on_learn_completed: Optional[Callable[[str], None]] = None
         self.on_replication_error: Optional[Callable[[str, int], None]] = None
@@ -622,6 +628,7 @@ class Replica:
             self.transport.send(self.name, src, "learn_response", {
                 "type": LT_APP,
                 "checkpoint_dir": ckpt_dir,
+                "checkpoint_node": self.name,
                 "checkpoint_decree": ckpt_decree,
                 "mutations": [mu.encode() for mu in self.log.read_range(
                     ckpt_decree + 1)],
@@ -630,10 +637,29 @@ class Replica:
 
     def _on_learn_response(self, src: str, payload: dict) -> None:
         """Learner applies learned state (parity: on_learn_reply :571,
-        on_copy_remote_state_completed :1001)."""
+        on_copy_remote_state_completed :1001). An LT_APP checkpoint on a
+        DIFFERENT host (no shared fs) is pulled asynchronously through
+        the file-transfer service first — the nfs copy_remote_files leg."""
         if payload["type"] == LT_APP:
-            self._apply_learned_checkpoint(payload["checkpoint_dir"],
+            ckpt = payload["checkpoint_dir"]
+            if not (self.shared_fs and os.path.exists(ckpt)):
+                if self.on_remote_checkpoint is not None:
+                    self.on_remote_checkpoint(src, payload)
+                    return  # complete_remote_learn resumes after the copy
+                return  # unreachable checkpoint and no transfer: give up
+            self._apply_learned_checkpoint(ckpt,
                                            payload["checkpoint_decree"])
+        self._finish_learn(src, payload)
+
+    def complete_remote_learn(self, src: str, payload: dict,
+                              local_ckpt_dir: str) -> None:
+        """File-transfer completion: apply the fetched checkpoint and
+        finish the learn exactly like the shared-fs path."""
+        self._apply_learned_checkpoint(local_ckpt_dir,
+                                       payload["checkpoint_decree"])
+        self._finish_learn(src, payload)
+
+    def _finish_learn(self, src: str, payload: dict) -> None:
         for blob in payload["mutations"]:
             mu = Mutation.decode(blob)
             if mu.decree <= self.last_committed_decree:

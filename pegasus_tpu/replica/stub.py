@@ -34,11 +34,18 @@ class _GpidTransport:
 
 
 class ReplicaStub:
-    def __init__(self, name: str, data_dir: str, net,
+    def __init__(self, name: str, data_dir, net,
                  clock: Optional[Callable[[], float]] = None,
                  sim_clock: Optional[Callable[[], float]] = None) -> None:
+        """`data_dir`: one path or a list of paths (multi-disk layout —
+        parity: fs_manager dir_nodes; replicas place on the least-loaded
+        disk)."""
+        from pegasus_tpu.replica.fs_manager import FsManager
+
         self.name = name
-        self.data_dir = data_dir
+        dirs = [data_dir] if isinstance(data_dir, str) else list(data_dir)
+        self.fs = FsManager(dirs)
+        self.data_dir = dirs[0]
         self.net = net
         self.clock = clock
         # FD timeline clock (sim time); defaults to the wall clock
@@ -61,23 +68,28 @@ class ReplicaStub:
 
         self.commands = CommandManager()
         self._register_default_commands()
+        # file-transfer service (parity: src/nfs/ — learning/migration
+        # file copies between hosts); shared_fs=True means checkpoint
+        # paths are locally reachable (onebox/sim) and transfers are
+        # bypassed
+        from pegasus_tpu.replica.file_transfer import TransferServer
+
+        self.shared_fs = True
+        self.transfer = TransferServer(net, name, self.fs.data_dirs)
+        self._fetch_sessions: Dict = {}
         self._last_beacon_ack = float("-inf")
         net.register(name, self.on_message)
-        # load existing replica dirs (parity: replica_stub boot scan,
-        # replica_stub.cpp:594 load_replicas); each dir carries a
-        # .replica_info with its real partition_count
-        if os.path.isdir(data_dir):
-            for entry in sorted(os.listdir(data_dir)):
-                parts = entry.split(".")
-                if len(parts) == 2 and all(p.isdigit() for p in parts):
-                    gpid = (int(parts[0]), int(parts[1]))
-                    info_path = os.path.join(data_dir, entry, ".replica_info")
-                    partition_count = 1
-                    if os.path.exists(info_path):
-                        import json
-                        with open(info_path) as f:
-                            partition_count = json.load(f)["partition_count"]
-                    self._open_replica(gpid, partition_count)
+        # load existing replica dirs across every data dir (parity:
+        # replica_stub boot scan, replica_stub.cpp:594 load_replicas per
+        # disk); each dir carries a .replica_info with its partition_count
+        for gpid, rdir in self.fs.scan_replicas().items():
+            info_path = os.path.join(rdir, ".replica_info")
+            partition_count = 1
+            if os.path.exists(info_path):
+                import json
+                with open(info_path) as f:
+                    partition_count = json.load(f)["partition_count"]
+            self._open_replica(gpid, partition_count)
 
     def _register_default_commands(self) -> None:
         """The node's built-in control verbs (parity: the verbs replicas
@@ -129,6 +141,45 @@ class ReplicaStub:
         self.commands.register("flush", flush_all,
                                "flush every hosted replica's memtable")
 
+        def fs_stats(_args):
+            return self.fs.stats()
+
+        def clean_trash(args):
+            age = float(args[0]) if args else 86400.0
+            return self.fs.clean_trash(age)
+
+        def migrate(args):
+            import os as _os
+
+            app_id, pidx, dest = int(args[0]), int(args[1]), args[2]
+            gpid = (app_id, pidx)
+            # validate EVERYTHING before taking the replica down — a bad
+            # destination must not leave the partition unserved
+            if _os.path.abspath(dest) not in self.fs.data_dirs:
+                raise ValueError(f"{dest} is not a managed data dir")
+            r = self.replicas.get(gpid)
+            if r is None:
+                raise ValueError(f"replica {gpid} not hosted here")
+            count = r.server.partition_count
+            del self.replicas[gpid]
+            r.close()
+            try:
+                new_dir = self.fs.migrate(gpid, dest)
+            finally:
+                # reopen from wherever the replica now lives — even a
+                # failed copy leaves the source intact
+                self._open_replica(gpid, count)
+            return new_dir
+
+        self.commands.register("fs.stats", fs_stats,
+                               "per-data-dir replicas + usage")
+        self.commands.register("fs.clean-trash", clean_trash,
+                               "remove trashed replica dirs older than "
+                               "[seconds]")
+        self.commands.register(
+            "replica.migrate", migrate,
+            "replica.migrate <app_id> <pidx> <dest_data_dir>")
+
     def close(self) -> None:
         for r in self.replicas.values():
             r.close()
@@ -136,7 +187,7 @@ class ReplicaStub:
     # ---- replica management -------------------------------------------
 
     def _replica_dir(self, gpid: Gpid) -> str:
-        return os.path.join(self.data_dir, f"{gpid[0]}.{gpid[1]}")
+        return self.fs.replica_dir(gpid)
 
     def _open_replica(self, gpid: Gpid, partition_count: int) -> Replica:
         r = self.replicas.get(gpid)
@@ -158,6 +209,10 @@ class ReplicaStub:
             r.on_replication_error = (
                 lambda member, decree, g=gpid:
                 self._notify_replication_error(g, member))
+            r.shared_fs = self.shared_fs
+            r.on_remote_checkpoint = (
+                lambda src, payload, g=gpid:
+                self._start_ckpt_fetch(g, src, payload))
             self.replicas[gpid] = r
         return r
 
@@ -228,6 +283,17 @@ class ReplicaStub:
             for dup in self._dup_sessions.values():
                 if dup.on_write_reply(payload):
                     dup.tick()
+                    return
+            return
+        if msg_type == "list_dir":
+            self.transfer.on_list_dir(src, payload)
+            return
+        if msg_type == "fetch_chunk":
+            self.transfer.on_fetch_chunk(src, payload)
+            return
+        if msg_type in ("list_dir_reply", "fetch_chunk_reply"):
+            for sess in list(self._fetch_sessions.values()):
+                if sess.on_reply(msg_type, payload):
                     return
             return
         if msg_type == "remote_command":
@@ -635,6 +701,38 @@ class ReplicaStub:
             # stays in register until the flip proposal arrives
             # (_on_config_proposal clears the session + the fence)
 
+    def _start_ckpt_fetch(self, gpid: Gpid, primary_src: str,
+                          payload: dict) -> None:
+        """LT_APP checkpoint on another host: pull it via the transfer
+        service, then resume the learn (parity: on_learn_reply ->
+        nfs copy_remote_files -> on_copy_remote_state_completed)."""
+        import shutil
+
+        from pegasus_tpu.replica.file_transfer import FileFetchSession
+
+        if gpid in self._fetch_sessions:
+            return
+        r = self.replicas.get(gpid)
+        if r is None:
+            return
+        local = os.path.join(self._replica_dir(gpid), "learn_fetch")
+        shutil.rmtree(local, ignore_errors=True)
+
+        def done(ok: bool) -> None:
+            self._fetch_sessions.pop(gpid, None)
+            if ok and self.replicas.get(gpid) is r:
+                r.complete_remote_learn(primary_src, payload, local)
+            shutil.rmtree(local, ignore_errors=True)
+
+        self._fetch_sessions[gpid] = FileFetchSession(
+            self.net, self.name, payload["checkpoint_node"],
+            payload["checkpoint_dir"], local, done)
+
+    def transfer_tick(self) -> None:
+        """Timer: re-send possibly-lost transfer requests."""
+        for sess in list(self._fetch_sessions.values()):
+            sess.resend()
+
     # ---- duplication (parity: duplication_sync_timer driving the
     # replica-side pipeline; meta owns WHICH partitions duplicate) -------
 
@@ -736,8 +834,15 @@ class ReplicaStub:
             gpid = tuple(gpid)
             r = self.replicas.pop(gpid, None)
             if r is not None:
+                # an in-flight checkpoint fetch must die with the replica
+                # (its completion callback would resurrect a closed one)
+                sess = self._fetch_sessions.pop(gpid, None)
+                if sess is not None:
+                    sess._finished = True
                 r.close()
-                shutil.rmtree(self._replica_dir(gpid), ignore_errors=True)
+                # trash, don't delete: the disk cleaner ages it out
+                # (parity: .gar dirs, replica/disk_cleaner.*)
+                self.fs.trash_replica(gpid)
 
     # ---- failure detector (worker side) -------------------------------
 

@@ -255,6 +255,14 @@ class TcpTransport:
             pass
 
     def _dispatch_loop(self) -> None:
+        from pegasus_tpu.utils.metrics import METRICS
+
+        # profiler toollet (parity: runtime/profiler.cpp:90-198 —
+        # per-task-code execute latency/counts from engine join points;
+        # here the join point is handler dispatch, keyed by message type)
+        prof = METRICS.entity("rpc", "dispatch", {})
+        lat: Dict[str, Any] = {}
+        cnt: Dict[str, Any] = {}
         while True:
             item = self._inbox.get()
             if item is None:
@@ -263,6 +271,7 @@ class TcpTransport:
             handler = self._handlers.get(dst)
             if handler is None:
                 continue
+            t0 = time.perf_counter()
             try:
                 with self.lock:
                     handler(src, msg_type, payload)
@@ -270,3 +279,11 @@ class TcpTransport:
                 import traceback  # kill the dispatcher
 
                 traceback.print_exc()
+            finally:
+                p_lat = lat.get(msg_type)
+                if p_lat is None:
+                    p_lat = lat[msg_type] = prof.percentile(
+                        f"{msg_type}_exec_ms")
+                    cnt[msg_type] = prof.counter(f"{msg_type}_count")
+                p_lat.set((time.perf_counter() - t0) * 1000.0)
+                cnt[msg_type].increment()

@@ -66,7 +66,8 @@ def run_node(cfg: dict, name: str) -> None:
         from pegasus_tpu.replica.replica import PartitionStatus
         from pegasus_tpu.replica.stub import ReplicaStub
 
-        stub = ReplicaStub(name, os.path.join(data_root, name), transport,
+        dirs = node_cfg.get("data_dirs") or [os.path.join(data_root, name)]
+        stub = ReplicaStub(name, dirs, transport,
                            clock=time.time, sim_clock=time.monotonic)
         stub.meta_addrs = meta_names
         stub.meta_addr = meta_names[0]
@@ -81,6 +82,10 @@ def run_node(cfg: dict, name: str) -> None:
         transport.run_timer(1.0, group_checks)
         transport.run_timer(1.0, stub.dup_tick)
         transport.run_timer(1.0, stub.split_tick)
+        transport.run_timer(2.0, stub.transfer_tick)
+        # disk cleaner (parity: replica/disk_cleaner.*): age out trashed
+        # replica dirs so rebalancing churn cannot fill the disk
+        transport.run_timer(600.0, stub.fs.clean_trash)
         print(f"[{name}] replica serving on {node_cfg['host']}:"
               f"{node_cfg['port']}", flush=True)
     else:

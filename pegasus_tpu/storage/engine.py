@@ -61,6 +61,17 @@ class StorageEngine:
             self.last_flushed_decree = max(self.last_flushed_decree, d)
         self.last_committed_decree = self.last_flushed_decree
 
+        # flush/compaction event metrics (parity: pegasus_event_listener)
+        from pegasus_tpu.utils.metrics import METRICS
+
+        ev = METRICS.entity("engine", data_dir, {"dir": data_dir})
+        self._ev_flush_count = ev.counter("flush_count")
+        self._ev_flush_bytes = ev.counter("flush_bytes")
+        self._ev_flush_ms = ev.percentile("flush_duration_ms")
+        self._ev_compact_count = ev.counter("compaction_count")
+        self._ev_compact_bytes = ev.counter("compaction_bytes")
+        self._ev_compact_ms = ev.percentile("compaction_duration_ms")
+
         # replay WAL beyond the flushed watermark
         self._wal_path = os.path.join(data_dir, "wal.log")
         for decree, records in WriteAheadLog.replay(self._wal_path):
@@ -99,6 +110,9 @@ class StorageEngine:
 
     def flush(self) -> bool:
         """Memtable -> durable L0 SST stamped with the decree watermark."""
+        import time as _time
+
+        t0 = _time.perf_counter()
         table = self.lsm.flush(meta={
             "last_flushed_decree": self.last_committed_decree,
             "data_version": self.data_version,
@@ -107,6 +121,11 @@ class StorageEngine:
             return False
         self.last_flushed_decree = self.last_committed_decree
         self.wal.truncate()
+        # event-listener hooks (parity: pegasus_event_listener —
+        # rocksdb flush/compaction events -> metrics)
+        self._ev_flush_count.increment()
+        self._ev_flush_ms.set((_time.perf_counter() - t0) * 1000.0)
+        self._ev_flush_bytes.increment(os.path.getsize(table.path))
         return True
 
     # ---- read path ----------------------------------------------------
@@ -244,9 +263,18 @@ class StorageEngine:
                 np.uint32(pidx),
                 np.uint32(max(partition_version, 0)),
                 do_validate)
-            drop = np.asarray(drop)[:n] | rule_drop
-            return drop, np.asarray(new_ets)[:n]
+            # stay LAZY: combining on device keeps the result an async
+            # jax value, so the LSM's double-buffered compaction really
+            # overlaps this batch's device work with the next batch's
+            # host gathering (materialization happens at drain)
+            import jax.numpy as jnp
 
+            drop = jnp.logical_or(drop[:n], jnp.asarray(rule_drop))
+            return drop, new_ets[:n]
+
+        import time as _time
+
+        t0 = _time.perf_counter()
         self.lsm.compact(record_filter=record_filter, meta={
             "last_flushed_decree": self.last_committed_decree,
             "data_version": self.data_version,
@@ -254,3 +282,7 @@ class StorageEngine:
         })
         self.last_flushed_decree = self.last_committed_decree
         self.wal.truncate()
+        self._ev_compact_count.increment()
+        self._ev_compact_ms.set((_time.perf_counter() - t0) * 1000.0)
+        self._ev_compact_bytes.increment(sum(
+            os.path.getsize(t.path) for t in self.lsm.l1_runs))

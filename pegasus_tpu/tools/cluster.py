@@ -95,6 +95,7 @@ class SimCluster:
                             r.broadcast_group_check()
                     stub.dup_tick()
                     stub.split_tick()
+                    stub.transfer_tick()
             self.loop.run_for(self.beacon_interval)
             for m in self.metas:
                 if m.name not in self._dead:
