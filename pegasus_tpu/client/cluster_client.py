@@ -75,7 +75,11 @@ class ClusterClient:
 
     def __init__(self, net, name: str, meta_addr, app_name: str,
                  pump: Callable[[], None],
-                 max_retries: int = 6, pump_rounds: int = 50) -> None:
+                 max_retries: int = 6, pump_rounds: int = 50,
+                 auth=None) -> None:
+        """`auth`: (user, token) credentials from
+        security.make_credentials — required when the cluster enforces
+        authentication."""
         self.net = net
         self.name = name
         # one address or the whole meta group (rotated on timeout —
@@ -93,6 +97,7 @@ class ClusterClient:
         self.app_id: Optional[int] = None
         self.partition_count = 0
         self._configs: List[dict] = []
+        self.auth = tuple(auth) if auth else None
         net.register(name, self._on_message)
 
     # ---- transport plumbing -------------------------------------------
@@ -173,7 +178,7 @@ class ClusterClient:
             if not primary:
                 continue  # partition momentarily unowned; refresh + retry
             rid = self._send_request(primary, "client_read", {
-                "gpid": (self.app_id, p), "op": op,
+                "gpid": (self.app_id, p), "op": op, "auth": self.auth,
                 "args": args, "partition_hash": partition_hash})
             reply = self._await(rid)
             if reply is None:
@@ -203,6 +208,7 @@ class ClusterClient:
                 continue
             rid = self._send_request(primary, "client_write", {
                 "gpid": (self.app_id, pidx), "ops": ops,
+                "auth": self.auth,
                 "partition_hash": partition_hash})
             reply = self._await(rid)
             if reply is None:

@@ -433,21 +433,30 @@ class Replica:
         # batched dup mutation may touch one key twice, and the engine
         # won't see the first write until apply_items at the end
         dup_floors: Dict[bytes, int] = {}
+        cu = self.server.cu  # capacity-unit metering (parity: every
+        # write handler feeds capacity_unit_calculator.h:62-104)
         for wo in mu.ops:
             if wo.op == OP_PUT:
                 key, user_data, expire_ts = wo.request
+                cu.add_write(len(key) + len(user_data))
                 its = ws.translate_put(key, user_data, expire_ts, ts)
                 responses.append(int(ErrorCode.ERR_OK))
             elif wo.op == OP_REMOVE:
+                cu.add_write(len(wo.request[0]))
                 its = ws.translate_remove(wo.request[0])
                 responses.append(int(ErrorCode.ERR_OK))
             elif wo.op == OP_MULTI_PUT:
+                cu.add_write(len(wo.request.hash_key) + sum(
+                    len(kv.key) + len(kv.value) for kv in wo.request.kvs))
                 err, its = ws.translate_multi_put(wo.request, ts, now)
                 responses.append(err)
             elif wo.op == OP_MULTI_REMOVE:
+                cu.add_write(len(wo.request.hash_key) + sum(
+                    len(sk) for sk in wo.request.sort_keys))
                 err, count, its = ws.translate_multi_remove(wo.request)
                 responses.append((err, count))
             elif wo.op == OP_INCR:
+                cu.add_write(len(wo.request.key))
                 resp, its = ws.translate_incr(wo.request, ts, now)
                 resp.decree = mu.decree
                 responses.append(resp)

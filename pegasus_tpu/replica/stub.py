@@ -74,6 +74,9 @@ class ReplicaStub:
         # bypassed
         from pegasus_tpu.replica.file_transfer import TransferServer
 
+        # cluster auth secret (None = auth disabled); parity:
+        # security/negotiation + ranger table ACLs
+        self.auth_secret: Optional[str] = None
         self.shared_fs = True
         self.transfer = TransferServer(net, name, self.fs.data_dirs)
         self._fetch_sessions: Dict = {}
@@ -338,6 +341,11 @@ class ReplicaStub:
         gpid = tuple(payload["gpid"])
         rid = payload["rid"]
         r = self.replicas.get(gpid)
+        if not self._client_allowed(r, payload):
+            self.net.send(self.name, src, "client_write_reply", {
+                "rid": rid, "err": int(ErrorCode.ERR_ACL_DENY),
+                "results": []})
+            return
         if r is not None and getattr(r, "splitting", False):
             # write fence during the split's final catch-up (parity: the
             # reference fences the parent before the count flip)
@@ -388,6 +396,11 @@ class ReplicaStub:
         rid = payload["rid"]
         op = payload.get("op", "get")
         r = self.replicas.get(gpid)
+        if not self._client_allowed(r, payload):
+            self.net.send(self.name, src, "client_read_reply", {
+                "rid": rid, "err": int(ErrorCode.ERR_ACL_DENY),
+                "result": None})
+            return
         if (r is None or r.status != PartitionStatus.PRIMARY
                 or getattr(r, "restoring", False)
                 or not r.ready_to_serve()
@@ -608,6 +621,16 @@ class ReplicaStub:
                 done)
         except (RuntimeError, ValueError):
             self._ingest_inflight.discard(key)
+
+    def _client_allowed(self, r, payload: dict) -> bool:
+        """Auth + table-ACL gate (parity: the ACL gate leading the client
+        gate stack, replica_2pc.cpp:117 / replica.cpp:388)."""
+        from pegasus_tpu.security.auth import check_client
+
+        allowed = ""
+        if r is not None:
+            allowed = r.server.app_envs.get("replica.allowed_users", "")
+        return check_client(payload.get("auth"), self.auth_secret, allowed)
 
     # ---- partition split (parity: replica_split_manager.h:58 — the
     # replica-side parent/child state copy + catch-up; meta owns the

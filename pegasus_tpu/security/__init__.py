@@ -1,0 +1,3 @@
+from pegasus_tpu.security.auth import make_credentials, sign, verify
+
+__all__ = ["make_credentials", "sign", "verify"]

@@ -1,0 +1,56 @@
+"""Authentication + table ACLs.
+
+Parity role: src/security/negotiation.h:37 (the RPC-connection auth
+negotiation — SASL/Kerberos there; a shared-secret HMAC here, since
+this environment has no KDC) and the Ranger-style per-table allow-list
+(src/ranger/ranger_resource_policy_manager.h:67, enforced at the
+replica's client gates like replica_2pc.cpp:117 / replica.cpp:388).
+
+Model: the cluster holds one secret. A client identity is
+(user, HMAC(secret, user)); servers verify the token and then check the
+table's `replica.allowed_users` app-env (empty / absent = open table).
+Inter-node traffic authenticates as the reserved NODE_USER.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+from typing import Optional, Tuple
+
+NODE_USER = "__node__"
+
+
+def sign(user: str, secret: str) -> str:
+    return hmac.new(secret.encode(), user.encode(),
+                    hashlib.sha256).hexdigest()
+
+
+def verify(user: str, token: str, secret: str) -> bool:
+    return hmac.compare_digest(sign(user, secret), token)
+
+
+def make_credentials(user: str, secret: str) -> Tuple[str, str]:
+    return user, sign(user, secret)
+
+
+def check_client(auth: Optional[tuple], secret: Optional[str],
+                 allowed_users: str = "") -> bool:
+    """The gate servers run per request: authentication (when the
+    cluster has a secret) then the table allow-list.
+
+    `allowed_users`: comma-separated env value; empty = every
+    authenticated user (parity: tables without ranger policies are
+    governed by legacy allowed-user lists; empty list = open)."""
+    if secret:
+        if not auth:
+            return False
+        user, token = auth[0], auth[1]
+        if not verify(user, token, secret):
+            return False
+    else:
+        user = auth[0] if auth else ""
+    if allowed_users:
+        allowed = {u.strip() for u in allowed_users.split(",") if u.strip()}
+        return user in allowed or user == NODE_USER
+    return True

@@ -69,6 +69,7 @@ def run_node(cfg: dict, name: str) -> None:
         dirs = node_cfg.get("data_dirs") or [os.path.join(data_root, name)]
         stub = ReplicaStub(name, dirs, transport,
                            clock=time.time, sim_clock=time.monotonic)
+        stub.auth_secret = cfg.get("auth_secret")
         stub.meta_addrs = meta_names
         stub.meta_addr = meta_names[0]
         transport.run_timer(1.0, stub.send_beacon)

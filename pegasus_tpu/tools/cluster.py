@@ -26,7 +26,8 @@ from pegasus_tpu.runtime.sim import SimLoop, SimNetwork
 
 class SimCluster:
     def __init__(self, data_dir: str, n_nodes: int = 3, seed: int = 0,
-                 beacon_interval: float = 3.0, n_meta: int = 1) -> None:
+                 beacon_interval: float = 3.0, n_meta: int = 1,
+                 auth_secret: Optional[str] = None) -> None:
         self.data_dir = data_dir
         self.loop = SimLoop(seed=seed)
         self.net = SimNetwork(self.loop)
@@ -43,6 +44,7 @@ class SimCluster:
             # deterministic initial leader: meta0 wins the first election
             self.metas[0].election._start_election()
             self.loop.run_until_idle()
+        self.auth_secret = auth_secret
         self.stubs: Dict[str, ReplicaStub] = {}
         self._dead: set = set()
         self._last_step_time = 0.0
@@ -63,6 +65,7 @@ class SimCluster:
             sim_clock=lambda: self.loop.now)
         stub.meta_addrs = [m.name for m in self.metas]
         stub.meta_addr = self.metas[0].name
+        stub.auth_secret = self.auth_secret
         self.stubs[name] = stub
         return stub
 
@@ -138,11 +141,16 @@ class SimCluster:
         self.loop.run_until_idle()
         return app_id
 
-    def client(self, app_name: str,
-               name: Optional[str] = None) -> ClusterClient:
+    def client(self, app_name: str, name: Optional[str] = None,
+               user: str = "admin") -> ClusterClient:
+        auth = None
+        if self.auth_secret:
+            from pegasus_tpu.security.auth import make_credentials
+
+            auth = make_credentials(user, self.auth_secret)
         c = ClusterClient(self.net, name or f"client-{app_name}",
                           [m.name for m in self.metas],
-                          app_name, pump=self.pump)
+                          app_name, pump=self.pump, auth=auth)
         return c
 
     def primaries(self, app_id: int) -> List[str]:

@@ -1,0 +1,135 @@
+"""Info collector: cluster-wide stat aggregation + availability probing.
+
+Parity: src/server/info_collector.h:48 (per-table stat aggregation
+written back into a `stat` table via result_writer) and
+src/server/available_detector.h:49 / collector/avail/detector.go (a
+periodic set/get probe on a detect table producing an availability
+percentage). The Go collector's metric scraping maps to the nodes'
+remote "metrics" command (the /metrics JSON surface).
+
+Runs over any deployment exposing the remote-command message and a
+client factory: the in-process SimCluster (tests) or the multi-process
+onebox (point it at the cluster dir).
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+_RIDS = itertools.count(9_000_000)
+
+STAT_TABLE = "stat"
+DETECT_TABLE = "detect"
+
+
+class InfoCollector:
+    """`nodes`: replica node names; `send`/pump come from the transport;
+    `client_factory(table_name)` returns a data client."""
+
+    def __init__(self, net, name: str, nodes: List[str],
+                 client_factory: Callable[[str], Any],
+                 pump: Callable[[], None]) -> None:
+        self.net = net
+        self.name = name
+        self.nodes = list(nodes)
+        self.client_factory = client_factory
+        self._pump = pump
+        self._replies: Dict[int, dict] = {}
+        self._stat_client = None
+        self._detect_client = None
+        # availability accounting (parity: available_detector partition
+        # probe counters)
+        self.probe_total = 0
+        self.probe_failed = 0
+        net.register(name, self._on_message)
+
+    def _on_message(self, src: str, msg_type: str, payload) -> None:
+        if msg_type == "remote_command_reply":
+            self._replies[payload["rid"]] = payload
+
+    def _command(self, node: str, verb: str,
+                 args: Optional[list] = None,
+                 rounds: int = 100) -> Optional[Any]:
+        rid = next(_RIDS)
+        self.net.send(self.name, node, "remote_command",
+                      {"rid": rid, "cmd": verb, "args": args or []})
+        for _ in range(rounds):
+            if rid in self._replies:
+                reply = self._replies.pop(rid)
+                return reply["result"] if reply["err"] == 0 else None
+            self._pump()
+        return None
+
+    # ---- stat aggregation (parity: info_collector.h:206-212) -----------
+
+    def collect_round(self) -> Dict[str, dict]:
+        """Scrape every node's replica metrics, aggregate per table, and
+        write one row per table into the stat table."""
+        per_table: Dict[str, dict] = {}
+        for node in self.nodes:
+            snapshot = self._command(node, "metrics", ["replica"])
+            if not snapshot:
+                continue
+            for entity in snapshot:
+                table = entity.get("attributes", {}).get("table")
+                if table is None:
+                    continue
+                agg = per_table.setdefault(table, {
+                    "partitions": 0, "read_cu": 0, "write_cu": 0,
+                    "abnormal_reads": 0})
+                agg["partitions"] += 1
+                metrics = entity.get("metrics", {})
+                agg["read_cu"] += int(
+                    metrics.get("recent_read_cu", {}).get("value", 0))
+                agg["write_cu"] += int(
+                    metrics.get("recent_write_cu", {}).get("value", 0))
+                agg["abnormal_reads"] += int(
+                    metrics.get("abnormal_read_count", {})
+                    .get("value", 0))
+        if per_table:
+            if self._stat_client is None:
+                self._stat_client = self.client_factory(STAT_TABLE)
+            ts = b"%d" % int(time.time())
+            for table, agg in per_table.items():
+                self._stat_client.set(
+                    table.encode(), ts, json.dumps(agg).encode())
+        return per_table
+
+    def table_history(self, app_id_str: str) -> List[dict]:
+        if self._stat_client is None:
+            self._stat_client = self.client_factory(STAT_TABLE)
+        err, kvs = self._stat_client.multi_get(app_id_str.encode())
+        if err != 0:
+            return []
+        return [json.loads(v) for _k, v in sorted(kvs.items())]
+
+    # ---- availability (parity: available_detector.h:49) ----------------
+
+    def probe_round(self, probes: int = 4) -> float:
+        """Write+read probes against the detect table; returns the
+        availability fraction so far."""
+        if self._detect_client is None:
+            self._detect_client = self.client_factory(DETECT_TABLE)
+        c = self._detect_client
+        for i in range(probes):
+            self.probe_total += 1
+            key = b"probe_%d" % (self.probe_total % 64)
+            value = b"%d" % self.probe_total
+            try:
+                if c.set(key, b"s", value) != 0:
+                    self.probe_failed += 1
+                    continue
+                err, got = c.get(key, b"s")
+                if err != 0 or got != value:
+                    self.probe_failed += 1
+            except Exception:  # noqa: BLE001 - a probe failure IS the data
+                self.probe_failed += 1
+        return self.availability()
+
+    def availability(self) -> float:
+        if self.probe_total == 0:
+            return 1.0
+        return 1.0 - self.probe_failed / self.probe_total

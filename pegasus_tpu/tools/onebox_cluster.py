@@ -46,7 +46,7 @@ def _cluster_paths(directory: str) -> Dict[str, str]:
 
 
 def start(directory: str = DEFAULT_DIR, n_replica: int = 3,
-          n_meta: int = 1) -> dict:
+          n_meta: int = 1, auth_secret: Optional[str] = None) -> dict:
     paths = _cluster_paths(directory)
     os.makedirs(paths["logs"], exist_ok=True)
     if n_meta <= 1:
@@ -59,6 +59,10 @@ def start(directory: str = DEFAULT_DIR, n_replica: int = 3,
         nodes[f"node{i}"] = {"host": "127.0.0.1", "port": _free_port(),
                              "role": "replica"}
     cfg = {"data_root": os.path.join(directory, "data"), "nodes": nodes}
+    if auth_secret:
+        # onebox-grade key distribution: the secret lives in the cluster
+        # config file (the keytab-file analogue)
+        cfg["auth_secret"] = auth_secret
     with open(paths["config"], "w") as f:
         json.dump(cfg, f, indent=1)
 
@@ -200,7 +204,7 @@ class OneboxAdmin:
 
 
 def connect(app_name: str, directory: str = DEFAULT_DIR,
-            client_name: Optional[str] = None):
+            client_name: Optional[str] = None, user: str = "admin"):
     """Wire data client for a onebox table."""
     from pegasus_tpu.client.cluster_client import ClusterClient
     from pegasus_tpu.rpc.transport import TcpTransport
@@ -211,9 +215,15 @@ def connect(app_name: str, directory: str = DEFAULT_DIR,
     book = {n: (c["host"], c["port"]) for n, c in cfg["nodes"].items()}
     net = TcpTransport(None, book)
     metas = [n for n, c in cfg["nodes"].items() if c["role"] == "meta"]
+    auth = None
+    if cfg.get("auth_secret"):
+        from pegasus_tpu.security.auth import make_credentials
+
+        auth = make_credentials(user, cfg["auth_secret"])
     return ClusterClient(
         net, client_name or f"client-{os.getpid()}", metas, app_name,
-        pump=lambda: time.sleep(0.01), max_retries=8, pump_rounds=400)
+        pump=lambda: time.sleep(0.01), max_retries=8, pump_rounds=400,
+        auth=auth)
 
 
 def main() -> None:

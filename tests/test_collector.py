@@ -1,0 +1,54 @@
+"""Info-collector + availability-detector tests (parity:
+src/server/info_collector.h:48, available_detector.h:49)."""
+
+import pytest
+
+from pegasus_tpu.tools.cluster import SimCluster
+from pegasus_tpu.tools.collector import DETECT_TABLE, STAT_TABLE, InfoCollector
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    c = SimCluster(str(tmp_path / "c"), n_nodes=3)
+    yield c
+    c.close()
+
+
+def make_collector(cluster):
+    cluster.create_table(STAT_TABLE, partition_count=2)
+    cluster.create_table(DETECT_TABLE, partition_count=2)
+    return InfoCollector(cluster.net, "collector",
+                         list(cluster.stubs), cluster.client, cluster.pump)
+
+
+def test_collect_round_aggregates_and_persists(cluster):
+    cluster.create_table("traffic", partition_count=4)
+    c = cluster.client("traffic")
+    for i in range(30):
+        assert c.set(b"t%02d" % i, b"s", b"v" * 100) == 0
+    for i in range(30):
+        assert c.get(b"t%02d" % i, b"s")[0] == 0
+    col = make_collector(cluster)
+    per_table = col.collect_round()
+    app_id = str(c.app_id)
+    assert app_id in per_table
+    assert per_table[app_id]["write_cu"] > 0
+    assert per_table[app_id]["read_cu"] > 0
+    assert per_table[app_id]["partitions"] >= 4
+    # the row landed in the stat table (result_writer parity)
+    history = col.table_history(app_id)
+    assert history and history[-1]["write_cu"] == \
+        per_table[app_id]["write_cu"]
+
+
+def test_availability_probe_tracks_failures(cluster):
+    col = make_collector(cluster)
+    assert col.probe_round(probes=5) == 1.0
+    # cut every node off: probes fail, availability drops below 1
+    for name in list(cluster.stubs):
+        cluster.kill(name)
+    col._detect_client._max_retries = 1
+    col._detect_client._pump_rounds = 3
+    av = col.probe_round(probes=3)
+    assert av < 1.0
+    assert col.probe_total == 8 and col.probe_failed >= 3
