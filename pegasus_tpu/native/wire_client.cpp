@@ -1,0 +1,640 @@
+// Native C++ wire client for pegasus_tpu.
+//
+// Role parity: the reference ships native clients (src/client_lib C++,
+// go-client, java-client) speaking the cluster's wire format; this is the
+// pegasus_tpu equivalent — a self-contained C++17 library speaking the
+// PGT1 frame + tagged value grammar (pegasus_tpu/rpc/message.py), doing
+// client-side partition resolution (query_config -> crc64 routing ->
+// primary dispatch, parity src/client/partition_resolver.cpp:48) with a
+// C ABI so any language with FFI can bind it (the test drives it from
+// ctypes against a live multi-process onebox).
+//
+// CRC tables re-derive from the same polynomial bit-specs as
+// src/utils/crc.cpp (crc64 routing must be bit-identical everywhere).
+
+#include <arpa/inet.h>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <netdb.h>
+#include <string>
+#include <sys/socket.h>
+#include <unistd.h>
+#include <vector>
+
+namespace {
+
+// ---------------- crc (polynomial bit-specs from the reference) ----------
+
+uint64_t crc64_table[256];
+uint32_t crc32_table[256];
+
+struct TableInit {
+  TableInit() {
+    static const int bits64[] = {63, 61, 59, 58, 56, 55, 52, 49, 48, 47, 46,
+                                 44, 41, 37, 36, 34, 32, 31, 28, 26, 23, 22,
+                                 19, 16, 13, 12, 10, 9,  6,  4,  3,  0};
+    uint64_t poly64 = 0;
+    for (int n : bits64) poly64 |= 1ULL << (63 - n);
+    for (uint32_t i = 0; i < 256; i++) {
+      uint64_t k = i;
+      for (int j = 0; j < 8; j++) k = (k & 1) ? (k >> 1) ^ poly64 : k >> 1;
+      crc64_table[i] = k;
+    }
+    static const int bits32[] = {28, 27, 26, 25, 23, 22, 20, 19, 18,
+                                 14, 13, 11, 10, 9,  8,  6,  0};
+    uint32_t poly32 = 0;
+    for (int n : bits32) poly32 |= 1U << (31 - n);
+    for (uint32_t i = 0; i < 256; i++) {
+      uint32_t k = i;
+      for (int j = 0; j < 8; j++) k = (k & 1) ? (k >> 1) ^ poly32 : k >> 1;
+      crc32_table[i] = k;
+    }
+  }
+} table_init;
+
+uint64_t crc64(const uint8_t* data, size_t n) {
+  uint64_t crc = ~0ULL;
+  for (size_t i = 0; i < n; i++)
+    crc = crc64_table[(crc ^ data[i]) & 0xFF] ^ (crc >> 8);
+  return ~crc;
+}
+
+uint32_t crc32(const uint8_t* data, size_t n) {
+  uint32_t crc = ~0U;
+  for (size_t i = 0; i < n; i++)
+    crc = crc32_table[(crc ^ data[i]) & 0xFF] ^ (crc >> 8);
+  return ~crc;
+}
+
+// ---------------- tagged value grammar (rpc/message.py) -------------------
+
+struct Value;
+using ValueList = std::vector<Value>;
+
+struct Value {
+  enum Kind { NONE, BOOL, INT, UINT, BYTES, STR, LIST, TUPLE, DICT } kind =
+      NONE;
+  bool b = false;
+  int64_t i = 0;
+  uint64_t u = 0;
+  std::string s;                              // BYTES / STR payload
+  std::vector<Value> items;                   // LIST / TUPLE
+  std::vector<std::pair<Value, Value>> kv;    // DICT
+
+  static Value none() { return Value{}; }
+  static Value boolean(bool v) { Value x; x.kind = BOOL; x.b = v; return x; }
+  static Value integer(int64_t v) { Value x; x.kind = INT; x.i = v; return x; }
+  static Value uinteger(uint64_t v) {
+    Value x; x.kind = UINT; x.u = v; return x;
+  }
+  static Value bytes(const std::string& v) {
+    Value x; x.kind = BYTES; x.s = v; return x;
+  }
+  static Value str(const std::string& v) {
+    Value x; x.kind = STR; x.s = v; return x;
+  }
+  const Value* get(const std::string& key) const {
+    for (auto& p : kv)
+      if (p.first.kind == STR && p.first.s == key) return &p.second;
+    return nullptr;
+  }
+  int64_t as_int() const { return kind == UINT ? (int64_t)u : i; }
+};
+
+void put_u32(std::string& out, uint32_t v) {
+  char b[4];
+  memcpy(b, &v, 4);  // little-endian hosts only (x86/arm LE)
+  out.append(b, 4);
+}
+
+void encode(std::string& out, const Value& v) {
+  switch (v.kind) {
+    case Value::NONE: out += 'N'; break;
+    case Value::BOOL: out += v.b ? 'T' : 'F'; break;
+    case Value::INT: {
+      out += 'i';
+      char b[8];
+      memcpy(b, &v.i, 8);
+      out.append(b, 8);
+      break;
+    }
+    case Value::UINT: {
+      if (v.u <= 0x7FFFFFFFFFFFFFFFULL) {
+        Value w = Value::integer((int64_t)v.u);
+        encode(out, w);
+      } else {
+        out += 'u';
+        char b[8];
+        memcpy(b, &v.u, 8);
+        out.append(b, 8);
+      }
+      break;
+    }
+    case Value::BYTES:
+      out += 'b';
+      put_u32(out, v.s.size());
+      out += v.s;
+      break;
+    case Value::STR:
+      out += 's';
+      put_u32(out, v.s.size());
+      out += v.s;
+      break;
+    case Value::LIST:
+    case Value::TUPLE:
+      out += v.kind == Value::LIST ? 'l' : 't';
+      put_u32(out, v.items.size());
+      for (auto& item : v.items) encode(out, item);
+      break;
+    case Value::DICT:
+      out += 'm';
+      put_u32(out, v.kv.size());
+      for (auto& p : v.kv) {
+        encode(out, p.first);
+        encode(out, p.second);
+      }
+      break;
+  }
+}
+
+struct Decoder {
+  const uint8_t* data;
+  size_t len;
+  size_t pos = 0;
+  bool ok = true;
+
+  uint32_t u32() {
+    if (pos + 4 > len) { ok = false; return 0; }
+    uint32_t v;
+    memcpy(&v, data + pos, 4);
+    pos += 4;
+    return v;
+  }
+  Value value() {
+    Value out;
+    if (pos >= len) { ok = false; return out; }
+    char tag = (char)data[pos++];
+    switch (tag) {
+      case 'N': break;
+      case 'T': out = Value::boolean(true); break;
+      case 'F': out = Value::boolean(false); break;
+      case 'i': {
+        if (pos + 8 > len) { ok = false; break; }
+        int64_t v;
+        memcpy(&v, data + pos, 8);
+        pos += 8;
+        out = Value::integer(v);
+        break;
+      }
+      case 'u': {
+        if (pos + 8 > len) { ok = false; break; }
+        uint64_t v;
+        memcpy(&v, data + pos, 8);
+        pos += 8;
+        out = Value::uinteger(v);
+        break;
+      }
+      case 'd': {  // float: skip payload, surface as INT(0) — unused here
+        pos += 8;
+        break;
+      }
+      case 'b':
+      case 's': {
+        uint32_t n = u32();
+        if (pos + n > len) { ok = false; break; }
+        out = tag == 'b' ? Value::bytes(std::string((const char*)data + pos, n))
+                         : Value::str(std::string((const char*)data + pos, n));
+        pos += n;
+        break;
+      }
+      case 'l':
+      case 't': {
+        uint32_t n = u32();
+        out.kind = tag == 'l' ? Value::LIST : Value::TUPLE;
+        for (uint32_t i = 0; i < n && ok; i++) out.items.push_back(value());
+        break;
+      }
+      case 'm': {
+        uint32_t n = u32();
+        out.kind = Value::DICT;
+        for (uint32_t i = 0; i < n && ok; i++) {
+          Value k = value();
+          Value v = value();
+          out.kv.emplace_back(std::move(k), std::move(v));
+        }
+        break;
+      }
+      case 'D': {  // registered dataclass: decode as DICT of field order
+        uint32_t nn = u32();
+        if (pos + nn > len) { ok = false; break; }
+        std::string name((const char*)data + pos, nn);
+        pos += nn;
+        uint32_t nf = u32();
+        out.kind = Value::DICT;
+        out.kv.emplace_back(Value::str("__dataclass__"), Value::str(name));
+        for (uint32_t i = 0; i < nf && ok; i++) {
+          Value v = value();
+          out.kv.emplace_back(Value::integer(i), std::move(v));
+        }
+        break;
+      }
+      default:
+        ok = false;
+    }
+    return out;
+  }
+};
+
+// ---------------- frame ---------------------------------------------------
+
+std::string make_frame(const std::string& src, const std::string& dst,
+                       const std::string& msg_type, const Value& payload) {
+  std::string body;
+  encode(body, Value::str(src));
+  encode(body, Value::str(dst));
+  encode(body, Value::str(msg_type));
+  encode(body, payload);
+  std::string frame = "PGT1";
+  put_u32(frame, body.size());
+  put_u32(frame, crc32((const uint8_t*)body.data(), body.size()));
+  frame += body;
+  return frame;
+}
+
+// ---------------- client --------------------------------------------------
+
+struct Endpoint {
+  std::string host;
+  int port;
+};
+
+struct Client {
+  std::string name;
+  std::string app_name;
+  std::string user, token;
+  std::map<std::string, Endpoint> book;
+  std::map<std::string, int> socks;
+  std::vector<std::string> metas;
+  int64_t app_id = -1;
+  int64_t partition_count = 0;
+  std::vector<std::string> primaries;
+  uint64_t next_rid = 1;
+  std::string last_error;
+
+  int sock_for(const std::string& node) {
+    auto it = socks.find(node);
+    if (it != socks.end()) return it->second;
+    auto b = book.find(node);
+    if (b == book.end()) return -1;
+    int fd = socket(AF_INET, SOCK_STREAM, 0);
+    if (fd < 0) return -1;
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons(b->second.port);
+    if (inet_pton(AF_INET, b->second.host.c_str(), &addr.sin_addr) != 1) {
+      close(fd);
+      return -1;
+    }
+    if (connect(fd, (sockaddr*)&addr, sizeof(addr)) != 0) {
+      close(fd);
+      return -1;
+    }
+    socks[node] = fd;
+    return fd;
+  }
+
+  void drop_sock(const std::string& node) {
+    auto it = socks.find(node);
+    if (it != socks.end()) {
+      close(it->second);
+      socks.erase(it);
+    }
+  }
+
+  bool send_msg(const std::string& node, const std::string& msg_type,
+                const Value& payload) {
+    int fd = sock_for(node);
+    if (fd < 0) return false;
+    std::string frame = make_frame(name, node, msg_type, payload);
+    size_t off = 0;
+    while (off < frame.size()) {
+      ssize_t n = ::send(fd, frame.data() + off, frame.size() - off, 0);
+      if (n <= 0) {
+        drop_sock(node);
+        return false;
+      }
+      off += (size_t)n;
+    }
+    return true;
+  }
+
+  // blocking read of ONE frame from the node's connection
+  bool recv_msg(const std::string& node, std::string* msg_type,
+                Value* payload) {
+    int fd = sock_for(node);
+    if (fd < 0) return false;
+    auto read_exact = [&](uint8_t* buf, size_t n) -> bool {
+      size_t off = 0;
+      while (off < n) {
+        ssize_t r = ::recv(fd, buf + off, n - off, 0);
+        if (r <= 0) return false;
+        off += (size_t)r;
+      }
+      return true;
+    };
+    uint8_t hdr[12];
+    if (!read_exact(hdr, 12) || memcmp(hdr, "PGT1", 4) != 0) {
+      drop_sock(node);
+      return false;
+    }
+    uint32_t blen, want;
+    memcpy(&blen, hdr + 4, 4);
+    memcpy(&want, hdr + 8, 4);
+    std::vector<uint8_t> body(blen);
+    if (!read_exact(body.data(), blen) ||
+        crc32(body.data(), blen) != want) {
+      drop_sock(node);
+      return false;
+    }
+    Decoder d{body.data(), body.size()};
+    d.value();  // src
+    d.value();  // dst
+    Value mt = d.value();
+    Value pl = d.value();
+    if (!d.ok || mt.kind != Value::STR) return false;
+    *msg_type = mt.s;
+    *payload = std::move(pl);
+    return true;
+  }
+
+  Value auth_value() {
+    if (user.empty()) return Value::none();
+    Value t;
+    t.kind = Value::TUPLE;
+    t.items.push_back(Value::str(user));
+    t.items.push_back(Value::str(token));
+    return t;
+  }
+
+  bool refresh_config() {
+    for (auto& meta : metas) {
+      Value req;
+      req.kind = Value::DICT;
+      req.kv.emplace_back(Value::str("app_name"), Value::str(app_name));
+      req.kv.emplace_back(Value::str("rid"),
+                          Value::integer((int64_t)next_rid++));
+      if (!send_msg(meta, "query_config", req)) continue;
+      std::string mt;
+      Value reply;
+      if (!recv_msg(meta, &mt, &reply) || mt != "query_config_reply")
+        continue;
+      const Value* err = reply.get("err");
+      if (!err || err->as_int() != 0) {
+        last_error = "query_config error";
+        continue;
+      }
+      app_id = reply.get("app_id")->as_int();
+      partition_count = reply.get("partition_count")->as_int();
+      primaries.clear();
+      for (auto& cfg : reply.get("configs")->items) {
+        const Value* p = cfg.get("primary");
+        primaries.push_back(p && p->kind == Value::STR ? p->s : "");
+      }
+      return true;
+    }
+    last_error = "no meta reachable";
+    return false;
+  }
+
+  Value make_gpid(int64_t pidx) {
+    Value g;
+    g.kind = Value::TUPLE;
+    g.items.push_back(Value::integer(app_id));
+    g.items.push_back(Value::integer(pidx));
+    return g;
+  }
+
+  // returns reply payload for a matching {msg_type, rid}; empty on failure
+  bool call(const std::string& node, const std::string& send_type,
+            Value req, const std::string& reply_type, uint64_t rid,
+            Value* out) {
+    if (!send_msg(node, send_type, req)) return false;
+    for (int i = 0; i < 64; i++) {  // tolerate unrelated frames
+      std::string mt;
+      Value reply;
+      if (!recv_msg(node, &mt, &reply)) return false;
+      if (mt != reply_type) continue;
+      const Value* r = reply.get("rid");
+      if (r && (uint64_t)r->as_int() == rid) {
+        *out = std::move(reply);
+        return true;
+      }
+    }
+    return false;
+  }
+
+  std::string full_key(const std::string& hk, const std::string& sk) {
+    std::string key;
+    key.push_back((char)((hk.size() >> 8) & 0xFF));
+    key.push_back((char)(hk.size() & 0xFF));
+    key += hk;
+    key += sk;
+    return key;
+  }
+
+  uint64_t route_hash(const std::string& hk, const std::string& sk) {
+    const std::string& basis = hk.empty() ? sk : hk;
+    return crc64((const uint8_t*)basis.data(), basis.size());
+  }
+
+  int write_op(const std::string& hk, const std::string& sk,
+               const std::string& value, int64_t expire_ts, int op) {
+    if (app_id < 0 && !refresh_config()) return -1;
+    uint64_t h = route_hash(hk, sk);
+    for (int attempt = 0; attempt < 4; attempt++) {
+      if (attempt && !refresh_config()) return -1;
+      int64_t pidx = (int64_t)(h % (uint64_t)partition_count);
+      const std::string& primary = primaries[(size_t)pidx];
+      if (primary.empty()) continue;
+      uint64_t rid = next_rid++;
+      Value wop;
+      wop.kind = Value::TUPLE;
+      wop.items.push_back(Value::integer(op));
+      Value args;
+      args.kind = Value::TUPLE;
+      args.items.push_back(Value::bytes(full_key(hk, sk)));
+      if (op == 1) {  // OP_PUT: (key, value, expire_ts)
+        args.items.push_back(Value::bytes(value));
+        args.items.push_back(Value::integer(expire_ts));
+      }
+      wop.items.push_back(std::move(args));
+      Value ops;
+      ops.kind = Value::LIST;
+      ops.items.push_back(std::move(wop));
+      Value req;
+      req.kind = Value::DICT;
+      req.kv.emplace_back(Value::str("gpid"), make_gpid(pidx));
+      req.kv.emplace_back(Value::str("rid"), Value::integer((int64_t)rid));
+      req.kv.emplace_back(Value::str("ops"), std::move(ops));
+      req.kv.emplace_back(Value::str("auth"), auth_value());
+      req.kv.emplace_back(Value::str("partition_hash"),
+                          Value::uinteger(h));
+      Value reply;
+      if (!call(primary, "client_write", std::move(req),
+                "client_write_reply", rid, &reply))
+        continue;
+      int64_t err = reply.get("err")->as_int();
+      if (err == 0) {
+        const Value* results = reply.get("results");
+        if (results && !results->items.empty())
+          return (int)results->items[0].as_int();
+        return 0;
+      }
+      // retryable state errors: re-resolve; anything else surfaces
+      if (err == 13 || err == 14 || err == 53 || err == 56 || err == 5 ||
+          err == 6)
+        continue;
+      return (int)err;
+    }
+    last_error = "write retries exhausted";
+    return -1;
+  }
+
+  // returns storage status; fills value on hit
+  int read_get(const std::string& hk, const std::string& sk,
+               std::string* value) {
+    if (app_id < 0 && !refresh_config()) return -1;
+    uint64_t h = route_hash(hk, sk);
+    for (int attempt = 0; attempt < 4; attempt++) {
+      if (attempt && !refresh_config()) return -1;
+      int64_t pidx = (int64_t)(h % (uint64_t)partition_count);
+      const std::string& primary = primaries[(size_t)pidx];
+      if (primary.empty()) continue;
+      uint64_t rid = next_rid++;
+      Value req;
+      req.kind = Value::DICT;
+      req.kv.emplace_back(Value::str("gpid"), make_gpid(pidx));
+      req.kv.emplace_back(Value::str("rid"), Value::integer((int64_t)rid));
+      req.kv.emplace_back(Value::str("op"), Value::str("get"));
+      req.kv.emplace_back(Value::str("args"),
+                          Value::bytes(full_key(hk, sk)));
+      req.kv.emplace_back(Value::str("auth"), auth_value());
+      req.kv.emplace_back(Value::str("partition_hash"),
+                          Value::uinteger(h));
+      Value reply;
+      if (!call(primary, "client_read", std::move(req),
+                "client_read_reply", rid, &reply))
+        continue;
+      int64_t err = reply.get("err")->as_int();
+      if (err != 0) {
+        if (err == 13 || err == 14 || err == 53 || err == 56 || err == 5 ||
+            err == 6)
+          continue;
+        return (int)err;
+      }
+      const Value* result = reply.get("result");
+      if (!result || result->items.size() < 2) return -1;
+      int status = (int)result->items[0].as_int();
+      if (status == 0) *value = result->items[1].s;
+      return status;
+    }
+    last_error = "read retries exhausted";
+    return -1;
+  }
+};
+
+}  // namespace
+
+// ---------------- C ABI ---------------------------------------------------
+
+extern "C" {
+
+// address_book: "name=host:port;name=host:port;..."; metas: "meta0,meta1"
+void* pegc_open(const char* client_name, const char* address_book,
+                const char* metas, const char* app_name, const char* user,
+                const char* token) {
+  auto* c = new Client();
+  c->name = client_name;
+  c->app_name = app_name;
+  if (user) c->user = user;
+  if (token) c->token = token;
+  std::string book(address_book);
+  size_t pos = 0;
+  while (pos < book.size()) {
+    size_t end = book.find(';', pos);
+    if (end == std::string::npos) end = book.size();
+    std::string entry = book.substr(pos, end - pos);
+    size_t eq = entry.find('=');
+    size_t colon = entry.rfind(':');
+    if (eq != std::string::npos && colon != std::string::npos && colon > eq) {
+      c->book[entry.substr(0, eq)] = Endpoint{
+          entry.substr(eq + 1, colon - eq - 1),
+          atoi(entry.c_str() + colon + 1)};
+    }
+    pos = end + 1;
+  }
+  std::string ms(metas);
+  pos = 0;
+  while (pos < ms.size()) {
+    size_t end = ms.find(',', pos);
+    if (end == std::string::npos) end = ms.size();
+    c->metas.push_back(ms.substr(pos, end - pos));
+    pos = end + 1;
+  }
+  return c;
+}
+
+void pegc_close(void* handle) {
+  auto* c = (Client*)handle;
+  for (auto& p : c->socks) close(p.second);
+  delete c;
+}
+
+int pegc_refresh(void* handle) {
+  return ((Client*)handle)->refresh_config() ? 0 : -1;
+}
+
+long pegc_partition_count(void* handle) {
+  return (long)((Client*)handle)->partition_count;
+}
+
+int pegc_set(void* handle, const char* hk, int hklen, const char* sk,
+             int sklen, const char* value, int vlen, long expire_ts) {
+  return ((Client*)handle)
+      ->write_op(std::string(hk, hklen), std::string(sk, sklen),
+                 std::string(value, vlen), expire_ts, 1 /*OP_PUT*/);
+}
+
+int pegc_del(void* handle, const char* hk, int hklen, const char* sk,
+             int sklen) {
+  return ((Client*)handle)
+      ->write_op(std::string(hk, hklen), std::string(sk, sklen), "", 0,
+                 2 /*OP_REMOVE*/);
+}
+
+// returns status (0=OK,1=NotFound,<0 transport); on OK writes min(vlen,cap)
+// bytes and stores the full length into *out_len
+int pegc_get(void* handle, const char* hk, int hklen, const char* sk,
+             int sklen, char* out, int out_cap, int* out_len) {
+  std::string value;
+  int status = ((Client*)handle)
+                   ->read_get(std::string(hk, hklen),
+                              std::string(sk, sklen), &value);
+  if (status == 0) {
+    int n = (int)value.size();
+    *out_len = n;
+    if (n > out_cap) n = out_cap;
+    memcpy(out, value.data(), n);
+  }
+  return status;
+}
+
+const char* pegc_last_error(void* handle) {
+  return ((Client*)handle)->last_error.c_str();
+}
+
+uint64_t pegc_crc64(const char* data, int len) {
+  return crc64((const uint8_t*)data, len);
+}
+}

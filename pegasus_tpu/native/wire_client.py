@@ -1,0 +1,128 @@
+"""ctypes loader + thin wrapper for the native C++ wire client.
+
+Parity role: a second-language client (the reference ships Go/Java/C++
+clients over one wire format). The C ABI (wire_client.cpp) is the
+bindable surface; this module is the Python convenience binding and the
+build-on-first-use loader, following native/__init__.py's pattern.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional, Tuple
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "wire_client.cpp")
+_SO = os.path.join(_DIR, "libpegasus_wire_client.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _build() -> bool:
+    try:
+        tmp = f"{_SO}.{os.getpid()}.tmp"
+        result = subprocess.run(
+            ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", _SRC,
+             "-o", tmp],
+            capture_output=True, timeout=180)
+        if result.returncode != 0:
+            return False
+        os.replace(tmp, _SO)
+        return True
+    except (OSError, subprocess.TimeoutExpired):
+        return False
+
+
+def load() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if not os.path.exists(_SO) or (
+                os.path.getmtime(_SO) < os.path.getmtime(_SRC)):
+            if not _build():
+                return None
+        lib = ctypes.CDLL(_SO)
+        lib.pegc_open.restype = ctypes.c_void_p
+        lib.pegc_open.argtypes = [ctypes.c_char_p] * 6
+        lib.pegc_close.argtypes = [ctypes.c_void_p]
+        lib.pegc_refresh.argtypes = [ctypes.c_void_p]
+        lib.pegc_partition_count.restype = ctypes.c_long
+        lib.pegc_partition_count.argtypes = [ctypes.c_void_p]
+        lib.pegc_set.restype = ctypes.c_int
+        lib.pegc_set.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int,
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
+            ctypes.c_long]
+        lib.pegc_del.restype = ctypes.c_int
+        lib.pegc_del.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int,
+            ctypes.c_char_p, ctypes.c_int]
+        lib.pegc_get.restype = ctypes.c_int
+        lib.pegc_get.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int,
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_int)]
+        lib.pegc_last_error.restype = ctypes.c_char_p
+        lib.pegc_last_error.argtypes = [ctypes.c_void_p]
+        lib.pegc_crc64.restype = ctypes.c_uint64
+        lib.pegc_crc64.argtypes = [ctypes.c_char_p, ctypes.c_int]
+        _lib = lib
+        return _lib
+
+
+class NativeClient:
+    """The C client, bound: set/get/del over the live cluster wire."""
+
+    def __init__(self, name: str, address_book: dict, metas: list,
+                 app_name: str,
+                 auth: Optional[Tuple[str, str]] = None) -> None:
+        lib = load()
+        if lib is None:
+            raise RuntimeError("native wire client unavailable (no g++?)")
+        self._lib = lib
+        book = ";".join(f"{n}={h}:{p}" for n, (h, p) in
+                        address_book.items())
+        user, token = auth if auth else ("", "")
+        self._h = lib.pegc_open(
+            name.encode(), book.encode(), ",".join(metas).encode(),
+            app_name.encode(), user.encode(), token.encode())
+
+    def refresh(self) -> bool:
+        return self._lib.pegc_refresh(self._h) == 0
+
+    @property
+    def partition_count(self) -> int:
+        return self._lib.pegc_partition_count(self._h)
+
+    def set(self, hk: bytes, sk: bytes, value: bytes,
+            expire_ts: int = 0) -> int:
+        return self._lib.pegc_set(self._h, hk, len(hk), sk, len(sk),
+                                  value, len(value), expire_ts)
+
+    def get(self, hk: bytes, sk: bytes) -> Tuple[int, bytes]:
+        cap = 1 << 20
+        buf = ctypes.create_string_buffer(cap)
+        out_len = ctypes.c_int(0)
+        status = self._lib.pegc_get(self._h, hk, len(hk), sk, len(sk),
+                                    buf, cap, ctypes.byref(out_len))
+        if status != 0:
+            return status, b""
+        return 0, buf.raw[:out_len.value]
+
+    def delete(self, hk: bytes, sk: bytes) -> int:
+        return self._lib.pegc_del(self._h, hk, len(hk), sk, len(sk))
+
+    def last_error(self) -> str:
+        return self._lib.pegc_last_error(self._h).decode()
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.pegc_close(self._h)
+            self._h = None
