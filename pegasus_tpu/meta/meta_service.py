@@ -618,10 +618,7 @@ class MetaService:
         Primary moves apply immediately (zero-copy config change);
         secondary copies start a targeted learner flow and complete when
         the learn lands. Returns the proposals applied/started."""
-        from pegasus_tpu.meta.balancer import (
-            propose_primary_moves,
-            propose_secondary_moves,
-        )
+        from pegasus_tpu.meta.balancer import propose_app_balanced_moves
 
         nodes = self.fd.alive_workers()
         configs = {}
@@ -629,8 +626,7 @@ class MetaService:
             for pidx in range(app.partition_count):
                 configs[(app.app_id, pidx)] = self.state.get_partition(
                     app.app_id, pidx)
-        proposals = (propose_primary_moves(configs, nodes)
-                     + propose_secondary_moves(configs, nodes))
+        proposals = propose_app_balanced_moves(configs, nodes)
         for prop in proposals:
             app = self.state.apps[prop.gpid[0]]
             pc = self.state.get_partition(*prop.gpid)

@@ -109,3 +109,90 @@ def test_rebalance_end_to_end(tmp_path):
             assert r.status == PartitionStatus.PRIMARY
     finally:
         c.close()
+
+
+def test_maxflow_routes_multihop_primary_moves():
+    """The case greedy cannot solve: A's movable primaries reach only B,
+    B's reach only C — flow schedules A->B and B->C together."""
+    from pegasus_tpu.meta.balancer import (
+        propose_primary_moves,
+        propose_primary_moves_maxflow,
+    )
+    from pegasus_tpu.meta.server_state import PartitionConfig
+
+    nodes = ["A", "B", "C"]
+    configs = {
+        # A: 3 primaries, all with secondaries ONLY on B
+        (1, 0): PartitionConfig(1, "A", ["B"]),
+        (1, 1): PartitionConfig(1, "A", ["B"]),
+        (1, 2): PartitionConfig(1, "A", ["B"]),
+        # B: 1 primary whose secondary is on C; C: none
+        (1, 3): PartitionConfig(1, "B", ["C"]),
+    }
+    flow = propose_primary_moves_maxflow(configs, nodes)
+    # final counts must be [2,1,1] in some arrangement: A->B one move AND
+    # B->C one move
+    counts = {"A": 3, "B": 1, "C": 0}
+    for p in flow:
+        assert p.gpid in configs
+        pc = configs[p.gpid]
+        assert pc.primary == p.from_node and p.to_node in pc.secondaries
+        counts[p.from_node] -= 1
+        counts[p.to_node] += 1
+    assert max(counts.values()) - min(counts.values()) <= 1, (flow, counts)
+    # the single-hop greedy CANNOT fully balance this topology
+    greedy = propose_primary_moves(configs, nodes)
+    gcounts = {"A": 3, "B": 1, "C": 0}
+    for p in greedy:
+        gcounts[p.from_node] -= 1
+        gcounts[p.to_node] += 1
+    assert max(gcounts.values()) - min(gcounts.values()) > 1
+
+
+def test_balancer_simulator_property():
+    """balancer_simulator parity: random clusters converge to spread<=1
+    per app under repeated proposal application, with every proposal
+    legal (move to an existing secondary / copy to a non-member)."""
+    import random
+
+    from pegasus_tpu.meta.balancer import propose_app_balanced_moves
+    from pegasus_tpu.meta.server_state import PartitionConfig
+
+    rng = random.Random(42)
+    for trial in range(10):
+        nodes = [f"n{i}" for i in range(rng.randint(3, 6))]
+        configs = {}
+        for app_id in range(1, rng.randint(2, 4)):
+            for pidx in range(rng.choice([4, 8])):
+                members = rng.sample(nodes, k=min(3, len(nodes)))
+                configs[(app_id, pidx)] = PartitionConfig(
+                    1, members[0], members[1:])
+        for _round in range(20):
+            proposals = propose_app_balanced_moves(configs, nodes)
+            if not proposals:
+                break
+            for p in proposals:
+                pc = configs[p.gpid]
+                if p.kind == "move_primary":
+                    assert pc.primary == p.from_node
+                    assert p.to_node in pc.secondaries
+                    configs[p.gpid] = PartitionConfig(
+                        pc.ballot + 1, p.to_node,
+                        [s for s in pc.secondaries if s != p.to_node]
+                        + [pc.primary])
+                else:
+                    assert p.from_node in pc.secondaries
+                    assert p.to_node not in pc.members()
+                    configs[p.gpid] = PartitionConfig(
+                        pc.ballot + 1, pc.primary,
+                        [s for s in pc.secondaries if s != p.from_node]
+                        + [p.to_node])
+        # per-app primary spread settled to <= 1
+        from collections import defaultdict
+
+        per_app = defaultdict(lambda: {n: 0 for n in nodes})
+        for (app_id, _pidx), pc in configs.items():
+            per_app[app_id][pc.primary] += 1
+        for app_id, counts in per_app.items():
+            assert max(counts.values()) - min(counts.values()) <= 1, (
+                trial, app_id, counts)
