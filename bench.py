@@ -13,9 +13,10 @@ vs_baseline = accelerator throughput / XLA-CPU throughput for the same
 workload in the same process (the CPU baseline the reference's scalar C++
 loop competes with — see BASELINE.md "measure CPU baseline").
 
-Secondary phases (BASELINE configs #3/#4: TTL-expiry and rule-based
-manual-compaction GB/s) run when PEGBENCH_COMPACT=1 and are reported in
-BENCH_DETAILS.json next to this script plus stderr — stdout stays one line.
+Secondary phases — YCSB-C point gets (BASELINE config #1; always on),
+manual-compaction GB/s (configs #3/#4; PEGBENCH_COMPACT=1), geo radius
+search (config #5; PEGBENCH_GEO=1) — are reported in BENCH_DETAILS.json
+next to this script plus stderr; stdout stays one line.
 
 The accelerator in this image sits behind a tunnel whose backend init can
 fail transiently (or hang for hours if a previous claim was killed), so
@@ -227,6 +228,24 @@ def run_scans(bc, n_ops, n_partitions, n_hashkeys, seed, record_goal=100,
     return n_ops, records, elapsed
 
 
+def run_point_gets(bc, n_ops, n_hashkeys, seed):
+    """YCSB-C: 100% point gets, zipfian-ish key popularity (BASELINE
+    config #1), through the cluster read gate."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    client = bc.client
+    zipf_u = rng.random(n_ops) ** 2.0
+    sk_draw = rng.integers(0, 10, size=n_ops)
+    hits = 0
+    t0 = time.perf_counter()
+    for op in range(n_ops):
+        hk = b"user%08d" % int(zipf_u[op] * n_hashkeys)
+        err, _v = client.get(hk, b"s%02d" % int(sk_draw[op]))
+        hits += err == 0
+    return n_ops, hits, time.perf_counter() - t0
+
+
 def measure_scan_phase(jax, device, bc, n_ops, n_partitions, n_hashkeys,
                       seed):
     """reset -> warmup (compile + device block caches) -> measure."""
@@ -374,6 +393,24 @@ def main() -> None:
                 "accel_records_per_s": round(recs / accel_s, 1),
                 "ops": n_ops, "records_loaded": n_records,
             }
+
+            # YCSB-C point gets (host-dominated: measures the full
+            # client->gate->engine path; the accel/cpu ratio shows the
+            # device path does not tax point reads)
+            g_ops = max(2000, n_ops)
+            with jax.default_device(accel):
+                ops_g, hits_g, accel_g = run_point_gets(
+                    bc, g_ops, n_hashkeys, seed + 3)
+            with jax.default_device(cpu):
+                _o, _h, cpu_g = run_point_gets(bc, g_ops, n_hashkeys,
+                                               seed + 3)
+            details["phases"]["point_get"] = {
+                "accel_qps": round(ops_g / accel_g, 2),
+                "cpu_qps": round(ops_g / cpu_g, 2),
+                "hit_rate": round(hits_g / ops_g, 4),
+            }
+            _log(f"point-get: accel {ops_g / accel_g:.0f} q/s, "
+                 f"cpu {ops_g / cpu_g:.0f} q/s, hits {hits_g}/{ops_g}")
 
             if do_compact:
                 for mode in ("ttl", "rules"):
