@@ -27,6 +27,8 @@ record rather than a traceback.
 Env knobs: PEGBENCH_RECORDS (default 100_000), PEGBENCH_OPS (default 1200),
 PEGBENCH_PARTITIONS (default 64), PEGBENCH_SEED, PEGBENCH_COMPACT=1,
 PEGBENCH_GEO=1 (radius-search phase, BASELINE row 5),
+PEGBENCH_SCAN_BATCH (default 32: scans coalesced per device dispatch —
+the request-batching unit of SURVEY §2.6; 1 disables coalescing),
 PEGBENCH_PROBE_TIMEOUT (s, default 180), PEGBENCH_PROBE_RETRIES (default 4).
 """
 
@@ -188,14 +190,22 @@ def build_cluster(tmpdir, n_records, n_partitions, seed):
 
 
 def run_scans(bc, n_ops, n_partitions, n_hashkeys, seed, record_goal=100,
-              insert_frac=0.05):
+              insert_frac=0.05, scan_batch=None):
     """95% scans / 5% inserts THROUGH the cluster read/write gates;
-    returns (ops, records, elapsed_s)."""
+    returns (ops, records, elapsed_s).
+
+    Scans are coalesced into per-partition batches of up to
+    `scan_batch` (PEGBENCH_SCAN_BATCH): the server evaluates each
+    unique touched block ONCE per batch on the device — the request-
+    batching dispatch model (SURVEY §2.6), which is what amortizes
+    per-dispatch latency on a real accelerator."""
     import numpy as np
 
     from pegasus_tpu.base.key_schema import generate_key
     from pegasus_tpu.server.types import GetScannerRequest
 
+    if scan_batch is None:
+        scan_batch = int(os.environ.get("PEGBENCH_SCAN_BATCH", 32))
     rng = np.random.default_rng(seed)
     client = bc.client
     # zipfian-ish partition popularity
@@ -208,22 +218,41 @@ def run_scans(bc, n_ops, n_partitions, n_hashkeys, seed, record_goal=100,
     insert_draw = rng.random(n_ops)
 
     records = 0
+    pending: dict = {}
+    pending_n = 0
+
+    def flush_pending():
+        nonlocal records, pending_n
+        for pidx, reqs in pending.items():
+            if len(reqs) == 1:
+                resps = [client._read("get_scanner", reqs[0], pidx)]
+            else:
+                resps = client._read("scan_batch", reqs, pidx)
+            for resp in resps:
+                records += len(resp.kvs)
+                if resp.context_id >= 0:
+                    client._read("clear_scanner", resp.context_id, pidx)
+        pending.clear()
+        pending_n = 0
+
     t0 = time.perf_counter()
     for op in range(n_ops):
         if insert_draw[op] < insert_frac:
+            flush_pending()  # writes serialize against in-flight scans
             hk = b"user%08d" % int(rng.integers(0, 1 << 30))
             client.set(hk, b"s00", b"inserted")
             continue
         pidx = int(pidx_choices[op])
         start_hk = b"user%08d" % int(zipf_u[op] * n_hashkeys)
         scan_len = int(rng.integers(1, record_goal + 1))
-        resp = client._read("get_scanner", GetScannerRequest(
+        pending.setdefault(pidx, []).append(GetScannerRequest(
             start_key=generate_key(start_hk, b""),
             batch_size=scan_len,
-            validate_partition_hash=True), pidx)
-        records += len(resp.kvs)
-        if resp.context_id >= 0:
-            client._read("clear_scanner", resp.context_id, pidx)
+            validate_partition_hash=True))
+        pending_n += 1
+        if pending_n >= scan_batch:
+            flush_pending()
+    flush_pending()
     elapsed = time.perf_counter() - t0
     return n_ops, records, elapsed
 

@@ -432,6 +432,8 @@ class ReplicaStub:
                 result = srv.on_sortkey_count(args)
             elif op == "get_scanner":
                 result = srv.on_get_scanner(args)
+            elif op == "scan_batch":
+                result = srv.on_get_scanner_batch(args)
             elif op == "scan":
                 result = srv.on_scan(args)
             elif op == "clear_scanner":
