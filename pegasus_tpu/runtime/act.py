@@ -1,0 +1,156 @@
+"""Declarative scripted cluster cases (the .act harness).
+
+Parity: src/replica/storage/simple_kv/test — the reference verifies
+PacificA with declarative .act scripts run under the deterministic
+simulator (case-000.act:30-64: client ops, config assertions, state
+assertions, fault injection), numbered by fault class. This runner
+executes the same idea against SimCluster: one line per step, seeded
+determinism, every assertion against live cluster state.
+
+Case grammar (one `verb: args` per line; '#' comments):
+
+    create: <table> partitions=N replicas=N     create the table
+    set: <hk> <sk> <value>                      client write (must ack)
+    set_fail: <hk> <sk> <value>                 client write must NOT ack
+    expect_read: <hk> <sk> <value|NOT_FOUND>    client read assertion
+    kill: <node>     revive: <node>             crash / restore a node
+    drop: <src> <dst> <prob>                    inject link loss
+    heal_links:                                 clear loss injection
+    step: <rounds>                              beacon/guardian rounds
+    expect_primary_not: <pidx> <node>           cure assertion
+    expect_members: <pidx> <count>              replication level
+    expect_ballot_ge: <pidx> <n>                ballot monotonicity
+    expect_consistent: <hk> <sk>                every member agrees
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Tuple
+
+from pegasus_tpu.tools.cluster import SimCluster
+from pegasus_tpu.utils.errors import PegasusError, StorageStatus
+
+OK = int(StorageStatus.OK)
+
+
+class ActError(AssertionError):
+    pass
+
+
+def _parse(text: str) -> List[Tuple[int, str, List[str]]]:
+    steps = []
+    for lineno, raw in enumerate(text.splitlines(), 1):
+        line = raw.split("#", 1)[0].strip()
+        if not line:
+            continue
+        if ":" not in line:
+            raise ValueError(f"line {lineno}: expected 'verb: args'")
+        verb, _sep, rest = line.partition(":")
+        steps.append((lineno, verb.strip(), rest.split()))
+    return steps
+
+
+class ActRunner:
+    def __init__(self, data_dir: str, n_nodes: int = 4,
+                 seed: int = 0) -> None:
+        self.cluster = SimCluster(data_dir, n_nodes=n_nodes, seed=seed)
+        self.client = None
+        self.app_id: Optional[int] = None
+
+    def close(self) -> None:
+        self.cluster.close()
+
+    def run_text(self, text: str, name: str = "<case>") -> None:
+        for lineno, verb, args in _parse(text):
+            try:
+                self._step(verb, args)
+            except (ActError, AssertionError) as e:
+                raise ActError(
+                    f"{name}:{lineno}: `{verb}: {' '.join(args)}` "
+                    f"failed: {e}") from e
+
+    def run_file(self, path: str) -> None:
+        with open(path) as f:
+            self.run_text(f.read(), os.path.basename(path))
+
+    # ---- verbs ---------------------------------------------------------
+
+    def _step(self, verb: str, args: List[str]) -> None:
+        c = self.cluster
+        if verb == "create":
+            kw = dict(kv.split("=") for kv in args[1:])
+            self.app_id = c.create_table(
+                args[0], partition_count=int(kw.get("partitions", 4)),
+                replica_count=int(kw.get("replicas", 3)))
+            self.client = c.client(args[0])
+        elif verb == "set":
+            hk, sk, value = (a.encode() for a in args)
+            err = self.client.set(hk, sk, value)
+            if err != OK:
+                raise ActError(f"write not acked (err {err})")
+        elif verb == "set_fail":
+            hk, sk, value = (a.encode() for a in args)
+            try:
+                err = self.client.set(hk, sk, value)
+            except PegasusError:
+                return
+            if err == OK:
+                raise ActError("write unexpectedly acked")
+        elif verb == "expect_read":
+            hk, sk = args[0].encode(), args[1].encode()
+            want = args[2]
+            err, value = self.client.get(hk, sk)
+            if want == "NOT_FOUND":
+                if err == OK:
+                    raise ActError(f"found {value!r}, wanted NOT_FOUND")
+            else:
+                if err != OK or value != want.encode():
+                    raise ActError(f"got (err={err}, {value!r}), "
+                                   f"wanted {want!r}")
+        elif verb == "kill":
+            c.kill(args[0])
+        elif verb == "revive":
+            c.revive(args[0])
+        elif verb == "drop":
+            c.net.set_drop(float(args[2]), args[0], args[1])
+        elif verb == "heal_links":
+            c.net._drop_prob.clear()
+        elif verb == "step":
+            c.step(rounds=int(args[0]) if args else 1)
+        elif verb == "expect_primary_not":
+            pc = c.meta.state.get_partition(self.app_id, int(args[0]))
+            if pc.primary == args[1]:
+                raise ActError(f"primary still {args[1]}")
+            if not pc.primary:
+                raise ActError("partition has NO primary")
+        elif verb == "expect_members":
+            pc = c.meta.state.get_partition(self.app_id, int(args[0]))
+            if len(pc.members()) != int(args[1]):
+                raise ActError(f"{len(pc.members())} members "
+                               f"({pc.members()}), wanted {args[1]}")
+        elif verb == "expect_ballot_ge":
+            pc = c.meta.state.get_partition(self.app_id, int(args[0]))
+            if pc.ballot < int(args[1]):
+                raise ActError(f"ballot {pc.ballot} < {args[1]}")
+        elif verb == "expect_consistent":
+            from pegasus_tpu.base.key_schema import (
+                generate_key,
+                key_hash_parts,
+            )
+
+            hk, sk = args[0].encode(), args[1].encode()
+            app = c.meta.state.apps[self.app_id]
+            pidx = key_hash_parts(hk, sk) % app.partition_count
+            pc = c.meta.state.get_partition(self.app_id, pidx)
+            key = generate_key(hk, sk)
+            seen = {}
+            for node in pc.members():
+                if node in c._dead:
+                    continue
+                r = c.stubs[node].get_replica((self.app_id, pidx))
+                seen[node] = r.server.engine.get(key)
+            if len({repr(v) for v in seen.values()}) > 1:
+                raise ActError(f"members disagree: {seen}")
+        else:
+            raise ValueError(f"unknown act verb {verb!r}")

@@ -1,0 +1,209 @@
+"""Chaos harness: random process kills under continuous verification.
+
+Parity: src/test/kill_test/ — process killers plus data_verifier.cpp's
+continuous write/read consistency checking, driven as a script
+(admin_tools/pegasus_kill_test.sh). Runs against the multi-process
+onebox: a verifier loop writes sequenced records and re-reads a random
+sample of everything previously acked; a killer loop kill -9s a random
+replica node, waits, and restarts it.
+
+CLI:
+    python -m pegasus_tpu.tools.kill_test --dir D --duration 120
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import subprocess
+import sys
+import time
+from typing import Dict, List, Optional
+
+from pegasus_tpu.utils.errors import PegasusError
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+
+
+class DataVerifier:
+    """Continuous write->read verification (data_verifier.cpp parity):
+    every acked write must remain readable with its exact value."""
+
+    def __init__(self, client, rng: random.Random) -> None:
+        self.client = client
+        self.rng = rng
+        self.acked: Dict[bytes, bytes] = {}
+        self.seq = 0
+        self.write_ok = 0
+        self.write_rejected = 0
+        self.violations: List[str] = []
+
+    def step(self) -> None:
+        # one write
+        self.seq += 1
+        hk = b"kt%06d" % self.seq
+        value = b"v%d" % self.seq
+        try:
+            if self.client.set(hk, b"s", value) == 0:
+                self.acked[hk] = value
+                self.write_ok += 1
+            else:
+                self.write_rejected += 1
+        except PegasusError:
+            self.write_rejected += 1
+        # verify a sample of history
+        if self.acked:
+            for hk in self.rng.sample(sorted(self.acked),
+                                      min(4, len(self.acked))):
+                want = self.acked[hk]
+                try:
+                    err, got = self.client.get(hk, b"s")
+                except PegasusError:
+                    continue  # unavailable now; durability checked later
+                if err == 0 and got != want:
+                    self.violations.append(
+                        f"{hk!r}: read {got!r}, acked {want!r}")
+                elif err == 1:  # NotFound: an acked write vanished
+                    self.violations.append(f"{hk!r}: acked write lost")
+
+    def final_check(self, deadline_s: float = 120.0) -> None:
+        """After chaos ends: EVERY acked write must read back."""
+        deadline = time.monotonic() + deadline_s
+        pending = dict(self.acked)
+        while pending and time.monotonic() < deadline:
+            for hk in list(pending):
+                try:
+                    err, got = self.client.get(hk, b"s")
+                except PegasusError:
+                    break
+                if err == 0 and got == pending[hk]:
+                    del pending[hk]
+                elif err == 1:
+                    self.violations.append(
+                        f"final: {hk!r} acked write lost")
+                    del pending[hk]
+            if pending:
+                time.sleep(1)
+        for hk in pending:
+            self.violations.append(f"final: {hk!r} unreadable at deadline")
+
+
+class Killer:
+    """Random kill -9 / restart of replica processes."""
+
+    def __init__(self, directory: str, rng: random.Random) -> None:
+        self.directory = directory
+        self.rng = rng
+        with open(os.path.join(directory, "cluster.json")) as f:
+            self.cfg = json.load(f)
+        self.replica_nodes = [n for n, c in self.cfg["nodes"].items()
+                              if c["role"] == "replica"]
+        self.down: Optional[str] = None
+        self.kills = 0
+
+    def kill_one(self) -> str:
+        from pegasus_tpu.tools.onebox_cluster import kill_node
+
+        victim = self.rng.choice([n for n in self.replica_nodes
+                                  if n != self.down])
+        kill_node(victim, self.directory)
+        self.down = victim
+        self.kills += 1
+        return victim
+
+    def restart_down(self) -> Optional[str]:
+        if self.down is None:
+            return None
+        name = self.down
+        env = dict(os.environ)
+        env["PYTHONPATH"] = _REPO_ROOT + os.pathsep + env.get(
+            "PYTHONPATH", "")
+        env["JAX_PLATFORMS"] = "cpu"
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        log = open(os.path.join(self.directory, "logs",
+                                f"{name}.restart.log"), "ab")
+        p = subprocess.Popen(
+            [sys.executable, "-m", "pegasus_tpu.server.node_main",
+             "--config", os.path.join(self.directory, "cluster.json"),
+             "--name", name],
+            stdout=log, stderr=subprocess.STDOUT, env=env,
+            cwd=_REPO_ROOT)
+        # track the fresh pid so stop()/later kills target the live one
+        pids_path = os.path.join(self.directory, "pids.json")
+        with open(pids_path) as f:
+            pids = json.load(f)
+        pids[name] = p.pid
+        with open(pids_path, "w") as f:
+            json.dump(pids, f)
+        self.down = None
+        return name
+
+
+def run_kill_test(directory: str, duration_s: float = 60.0,
+                  kill_every_s: float = 12.0, seed: int = 0,
+                  table: str = "killtest") -> dict:
+    from pegasus_tpu.tools import onebox_cluster as ob
+
+    rng = random.Random(seed)
+    admin = ob.OneboxAdmin(directory)
+    deadline = time.monotonic() + 40
+    n_nodes = len([1 for c in admin.cfg["nodes"].values()
+                   if c["role"] == "replica"])
+    while time.monotonic() < deadline:
+        if len(admin.call("list_nodes")) == n_nodes:
+            break
+        time.sleep(0.5)
+    try:
+        admin.create_table(table, partition_count=4, replica_count=3)
+    except PegasusError as e:
+        if "APP_EXIST" not in str(e):
+            raise
+    client = ob.connect(table, directory)
+    verifier = DataVerifier(client, rng)
+    killer = Killer(directory, rng)
+
+    t_end = time.monotonic() + duration_s
+    next_kill = time.monotonic() + kill_every_s
+    next_restart = None
+    while time.monotonic() < t_end:
+        verifier.step()
+        now = time.monotonic()
+        if next_restart is not None and now >= next_restart:
+            killer.restart_down()
+            next_restart = None
+        if now >= next_kill and killer.down is None:
+            killer.kill_one()
+            next_restart = now + kill_every_s / 2
+            next_kill = now + kill_every_s
+        time.sleep(0.05)
+    killer.restart_down()
+    verifier.final_check()
+    report = {
+        "kills": killer.kills,
+        "writes_acked": verifier.write_ok,
+        "writes_rejected": verifier.write_rejected,
+        "violations": verifier.violations,
+    }
+    admin.close()
+    return report
+
+
+def main() -> None:
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--dir", required=True)
+    ap.add_argument("--duration", type=float, default=60.0)
+    ap.add_argument("--kill-every", type=float, default=12.0)
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args()
+    report = run_kill_test(args.dir, args.duration, args.kill_every,
+                           args.seed)
+    print(json.dumps(report, indent=1))
+    sys.exit(1 if report["violations"] else 0)
+
+
+if __name__ == "__main__":
+    main()

@@ -164,3 +164,20 @@ def test_multiprocess_onebox(tmp_path):
         if admin is not None:
             admin.close()
         ob.stop(d)
+
+
+def test_kill_test_harness_short(tmp_path):
+    """A bounded chaos run (parity: kill_test + data_verifier): random
+    kill -9s under continuous verification, zero acked-write loss."""
+    from pegasus_tpu.tools import onebox_cluster as ob
+    from pegasus_tpu.tools.kill_test import run_kill_test
+
+    d = str(tmp_path / "kt")
+    ob.start(d, n_replica=3)
+    try:
+        report = run_kill_test(d, duration_s=25, kill_every_s=10, seed=5)
+        assert report["violations"] == [], report
+        assert report["writes_acked"] > 20
+        assert report["kills"] >= 1
+    finally:
+        ob.stop(d)
