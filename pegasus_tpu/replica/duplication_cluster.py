@@ -157,11 +157,22 @@ class ClusterDuplicator:
 
     def _dup_ops(self, wo, timetag: int, mu_now: int):
         """Translate one logged write op into (key, dup_op, request)s."""
+        if wo.op == OP_DUP_PUT:
+            # already idempotent-translated at the primary (the
+            # idempotent-writer path for atomic ops on duplicated
+            # tables): ship verbatim with its ORIGINAL timetag
+            yield wo.request[0], OP_DUP_PUT, wo.request
+            return
+        if wo.op == OP_DUP_REMOVE:
+            yield wo.request[0], OP_DUP_REMOVE, wo.request
+            return
         if wo.op in ATOMIC_OPS:
-            # parity note (replica/idempotent_writer.h): atomic ops must
-            # be idempotent-translated before duplication; shipping the
-            # raw op would re-execute it on the follower. Skipped here —
-            # enable idempotent translation on duplicated tables.
+            # unreachable on tables that enabled duplication BEFORE the
+            # write (client_write idempotent-translates); mutations
+            # logged before dup-add may still carry raw atomic ops —
+            # those cannot ship safely (re-execution) and are skipped,
+            # matching the reference's requirement that idempotence be
+            # enabled before adding a duplication
             return
         if wo.op == OP_PUT:
             key, user_data, expire_ts = wo.request

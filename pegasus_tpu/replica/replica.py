@@ -139,6 +139,10 @@ class Replica:
         self._promotion_watermark = 0
         # lazily hydrated from the .ingested_loads marker (bulk load dedup)
         self._ingested_load_ids: Set[int] = set()
+        # decree -> responses computed at idempotent translation time
+        # (the logged dup-puts apply as ints; the client wants the
+        # original atomic op's response object)
+        self._idempotent_responses: Dict[int, List[Any]] = {}
         # per-mutation latency tracers (parity: every mutation carries a
         # latency_tracer, replica_2pc.cpp:338-359; slow dumps via
         # dump_trace_points). Write traces share the server's slow log so
@@ -258,6 +262,21 @@ class Replica:
             raise ValueError("atomic ops cannot batch with other writes")
         decree = self.last_prepared_decree() + 1
         ts = max(int(self.clock() * 1_000_000), self._last_timestamp_us + 1)
+        idem_responses = None
+        if self.duplicators and any(wo.op in (OP_INCR, OP_CAS, OP_CAM)
+                                    for wo in ops):
+            # idempotent translation (parity: make_idempotent,
+            # replica_2pc.cpp:283 + idempotent_writer.h): a duplicated
+            # table must log atomic ops as the CONCRETE puts they
+            # resolve to, or the follower would re-execute them. The
+            # read-translate is only sound against fully-applied state:
+            # an open window could hold a conflicting earlier write, so
+            # busy-reject and let the client retry after it drains.
+            if self.last_committed_decree != self.last_prepared_decree():
+                raise RuntimeError(
+                    f"{self.name}: atomic write on a duplicated table "
+                    f"must wait for the in-flight window")
+            ops, idem_responses = self._make_idempotent(ops, ts)
         # reserve one microsecond PER OP: duplication stamps op i with
         # ts + i, and the next mutation must not overlap those timetags
         self._last_timestamp_us = ts + max(len(ops), 1) - 1
@@ -266,6 +285,8 @@ class Replica:
         tracer = LatencyTracer(f"write.{self.server.app_id}."
                                f"{self.server.pidx}.d{decree}")
         self._traces[decree] = tracer
+        if idem_responses is not None:
+            self._idempotent_responses[decree] = idem_responses
         mu = Mutation(
             ballot=self.config.ballot, decree=decree,
             last_committed=self.last_committed_decree,
@@ -504,8 +525,9 @@ class Replica:
         if tracer is not None:
             tracer.add_point("committed_applied")
         callback = self._client_callbacks.pop(mu.decree, None)
+        override = self._idempotent_responses.pop(mu.decree, None)
         if callback is not None:
-            callback(responses)
+            callback(override if override is not None else responses)
         if tracer is not None:
             tracer.add_point("replied")
             self.slow_log.observe(tracer)
@@ -535,6 +557,54 @@ class Replica:
         with open(tmp, "w") as f:
             _json.dump(sorted(self._ingested_load_ids), f)
         os.replace(tmp, marker)
+
+    def _make_idempotent(self, ops: List[WriteOp], ts: int):
+        """Atomic ops -> the concrete dup-tagged puts/removes they
+        resolve to, plus the response objects to hand the client. The
+        timetag embedded by translation rides each dup op, so follower
+        clusters resolve conflicts exactly as for plain writes."""
+        from pegasus_tpu.base.value_schema import (
+            PEGASUS_EPOCH_BEGIN,
+            extract_timetag,
+            extract_user_data,
+            generate_timetag,
+        )
+        from pegasus_tpu.storage.wal import OP_PUT as ITEM_PUT
+
+        ws = self.server.write_service
+        now = max(0, ts // 1_000_000 - PEGASUS_EPOCH_BEGIN)
+        out_ops: List[WriteOp] = []
+        responses: List[Any] = []
+        for wo in ops:
+            if wo.op == OP_INCR:
+                resp, items = ws.translate_incr(wo.request, ts, now)
+            elif wo.op == OP_CAS:
+                resp, items = ws.translate_check_and_set(wo.request, ts,
+                                                         now)
+            elif wo.op == OP_CAM:
+                resp, items = ws.translate_check_and_mutate(wo.request,
+                                                            ts, now)
+            else:
+                out_ops.append(wo)
+                responses.append(None)
+                continue
+            responses.append(resp)
+            for it in items:
+                if it.op == ITEM_PUT:
+                    timetag = extract_timetag(ws.data_version, it.value)
+                    user_data = extract_user_data(ws.data_version,
+                                                  it.value)
+                    out_ops.append(WriteOp(
+                        OP_DUP_PUT,
+                        (it.key, user_data, it.expire_ts, timetag)))
+                else:
+                    out_ops.append(WriteOp(
+                        OP_DUP_REMOVE,
+                        (it.key,
+                         generate_timetag(ts, ws.cluster_id, True))))
+        # an atomic op may resolve to NO writes (failed check / error):
+        # the mutation ships empty and the decree still advances
+        return out_ops, responses
 
     def _apply_ingest(self, request, decree: int) -> int:
         """Download this partition's staged SST and ingest it at `decree`."""

@@ -148,18 +148,27 @@ def run_kill_test(directory: str, duration_s: float = 60.0,
 
     rng = random.Random(seed)
     admin = ob.OneboxAdmin(directory)
-    deadline = time.monotonic() + 40
+    deadline = time.monotonic() + 90
     n_nodes = len([1 for c in admin.cfg["nodes"].values()
                    if c["role"] == "replica"])
     while time.monotonic() < deadline:
-        if len(admin.call("list_nodes")) == n_nodes:
-            break
+        try:
+            if len(admin.call("list_nodes", timeout=6)) == n_nodes:
+                break
+        except PegasusError:
+            pass  # meta still booting/electing (slow loaded machines)
         time.sleep(0.5)
-    try:
-        admin.create_table(table, partition_count=4, replica_count=3)
-    except PegasusError as e:
-        if "APP_EXIST" not in str(e):
-            raise
+    create_deadline = time.monotonic() + 60
+    while True:
+        try:
+            admin.create_table(table, partition_count=4, replica_count=3)
+            break
+        except PegasusError as e:
+            if "APP_EXIST" in str(e):
+                break
+            if time.monotonic() > create_deadline:
+                raise
+            time.sleep(1)
     client = ob.connect(table, directory)
     verifier = DataVerifier(client, rng)
     killer = Killer(directory, rng)

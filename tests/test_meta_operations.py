@@ -350,3 +350,34 @@ def test_split_fence_survives_parent_failover_after_registration(cluster):
     assert not cluster.meta.split.split_status("spz")["splitting"]
     for i in range(20):
         assert c.get(b"z%03d" % i, b"s") == (OK, b"v%d" % i), i
+
+
+def test_duplicated_atomic_ops_ship_idempotently(cluster):
+    """Idempotent-writer parity: on a duplicated table, incr/cas log as
+    the concrete puts they resolve to, so the follower converges without
+    re-executing the atomic op."""
+    cluster.create_table("am", partition_count=2)
+    cluster.create_table("af", partition_count=2)
+    c = cluster.client("am")
+    cluster.meta.duplication.add_duplication("am", "meta", "af")
+    cluster.step(rounds=3)
+    r = c.incr(b"cnt", b"x", 5)
+    assert r.error == OK and r.new_value == 5
+    r = c.incr(b"cnt", b"x", 37)
+    assert r.error == OK and r.new_value == 42
+    from pegasus_tpu.server.types import CasCheckType
+
+    resp = c.check_and_set(b"cas", b"k", int(CasCheckType.CT_VALUE_NOT_EXIST),
+                           b"", b"k", b"first")
+    assert resp.error == OK
+    # a FAILED check resolves to no writes and must not disturb anything
+    resp = c.check_and_set(b"cas", b"k", int(CasCheckType.CT_VALUE_NOT_EXIST),
+                           b"", b"k", b"second")
+    assert resp.error != OK
+    for _ in range(8):
+        cluster.step()
+    fc = cluster.client("af")
+    assert fc.get(b"cnt", b"x") == (OK, b"42")
+    assert fc.get(b"cas", b"k") == (OK, b"first")
+    # and the master itself reads its own atomic results
+    assert c.get(b"cnt", b"x") == (OK, b"42")

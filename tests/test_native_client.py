@@ -33,11 +33,16 @@ def test_native_client_against_onebox(tmp_path):
     cfg = ob.start(d, n_replica=2)
     nc = None
     try:
+        from pegasus_tpu.utils.errors import PegasusError
+
         admin = ob.OneboxAdmin(d)
-        deadline = time.monotonic() + 40
+        deadline = time.monotonic() + 90
         while time.monotonic() < deadline:
-            if len(admin.call("list_nodes")) == 2:
-                break
+            try:
+                if len(admin.call("list_nodes", timeout=6)) == 2:
+                    break
+            except PegasusError:
+                pass
             time.sleep(0.5)
         admin.create_table("native", partition_count=4, replica_count=2)
         admin.close()

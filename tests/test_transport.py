@@ -109,11 +109,16 @@ def test_multiprocess_onebox(tmp_path):
     ob.start(d, n_replica=3)
     admin = None
     try:
+        from pegasus_tpu.utils.errors import PegasusError as _PE
+
         admin = ob.OneboxAdmin(d)
-        deadline = time.monotonic() + 40
+        deadline = time.monotonic() + 90
         while time.monotonic() < deadline:
-            if len(admin.call("list_nodes")) == 3:
-                break
+            try:
+                if len(admin.call("list_nodes", timeout=6)) == 3:
+                    break
+            except _PE:
+                pass
             time.sleep(0.5)
         assert len(admin.call("list_nodes")) == 3
         admin.create_table("fn", partition_count=4, replica_count=3)
