@@ -151,20 +151,34 @@ class ClusterDuplicator:
                 return
             rid = next(_RIDS)
             self._outstanding[rid] = True
+            auth = None
+            if getattr(self.stub, "auth_secret", None):
+                from pegasus_tpu.security.auth import (
+                    NODE_USER,
+                    make_credentials,
+                )
+
+                auth = make_credentials(NODE_USER, self.stub.auth_secret)
             self.stub.net.send(self.stub.name, primary, "client_write", {
                 "gpid": (self._fconfig["app_id"], pidx), "rid": rid,
-                "ops": ops})
+                "ops": ops, "auth": auth})
+
+    @staticmethod
+    def _timetag_cluster(timetag: int) -> int:
+        return (timetag >> 1) & 0x7F
 
     def _dup_ops(self, wo, timetag: int, mu_now: int):
         """Translate one logged write op into (key, dup_op, request)s."""
-        if wo.op == OP_DUP_PUT:
-            # already idempotent-translated at the primary (the
-            # idempotent-writer path for atomic ops on duplicated
-            # tables): ship verbatim with its ORIGINAL timetag
-            yield wo.request[0], OP_DUP_PUT, wo.request
-            return
-        if wo.op == OP_DUP_REMOVE:
-            yield wo.request[0], OP_DUP_REMOVE, wo.request
+        if wo.op in (OP_DUP_PUT, OP_DUP_REMOVE):
+            # a dup-tagged op is either (a) an idempotent-translated
+            # LOCAL atomic (timetag minted with OUR cluster id) — ship
+            # verbatim — or (b) a write RECEIVED from another cluster's
+            # duplication: re-shipping those would echo master-master
+            # writes back and forth forever (the reference's
+            # origin-cluster filter)
+            if (self._timetag_cluster(wo.request[-1])
+                    == self.source_cluster_id):
+                yield wo.request[0], wo.op, wo.request
             return
         if wo.op in ATOMIC_OPS:
             # unreachable on tables that enabled duplication BEFORE the

@@ -40,6 +40,7 @@ from typing import Any, Callable, Dict, List, Optional, Set, Tuple
 from pegasus_tpu.base.value_schema import PEGASUS_EPOCH_BEGIN
 from pegasus_tpu.replica.mutation import (
     ATOMIC_OPS,
+    BATCHABLE_OPS,
     Mutation,
     WriteOp,
 )
@@ -143,6 +144,10 @@ class Replica:
         # (the logged dup-puts apply as ints; the client wants the
         # original atomic op's response object)
         self._idempotent_responses: Dict[int, List[Any]] = {}
+        # the mutation-queue batch: (op_count, callback) spans + the ops
+        # accumulated while a 2PC round is in flight
+        self._write_queue: List[Tuple[int, Optional[Callable]]] = []
+        self._queued_ops: List[WriteOp] = []
         # per-mutation latency tracers (parity: every mutation carries a
         # latency_tracer, replica_2pc.cpp:338-359; slow dumps via
         # dump_trace_points). Write traces share the server's slow log so
@@ -223,6 +228,10 @@ class Replica:
         self._pending_acks.clear()
         self._client_callbacks.clear()
         self._traces.clear()
+        # queued writes die unacked with the primaryship (clients retry)
+        self._write_queue.clear()
+        self._queued_ops.clear()
+        self._idempotent_responses.clear()
         self._learners.clear()
         # learn snapshots for in-flight learners die with the primaryship
         # (each is a full SST copy; completion will never fire to GC them)
@@ -244,22 +253,51 @@ class Replica:
             self.prepare_list.prepare(remu)
             self.log.append(remu)
             targets = self._prepare_targets(remu.decree)
-            self._pending_acks[remu.decree] = set(targets)
+            if targets:
+                self._pending_acks[remu.decree] = set(targets)
             self._send_prepares(remu)
             if not targets:
+                # never leave an empty entry (it would count toward the
+                # pipelining depth forever and wedge the write queue)
                 self._on_decree_ready(remu.decree)
 
     # ---- client write path (primary) ----------------------------------
+
+    # writes queued while a 2PC round is in flight coalesce into ONE
+    # following mutation (parity: mutation_queue batching — requests with
+    # rpc_request_is_write_allow_batch join the pending mutation,
+    # mutation.cpp:390,553; the queue drains when the window moves)
+    MAX_BATCH_OPS = 128
+    # in-flight 2PC rounds allowed before writes start coalescing (the
+    # bounded-staleness pipelining window)
+    PIPELINE_DEPTH = 2
 
     def client_write(self, ops: List[WriteOp],
                      callback: Optional[Callable[[List[Any]], None]] = None
                      ) -> int:
         """Parity: on_client_write -> init_prepare (replica_2pc.cpp:113,328).
-        Returns the assigned decree, or raises on gate failure."""
+        Returns the assigned decree (-1 when queued behind an in-flight
+        round), or raises on gate failure."""
         if self.status != PartitionStatus.PRIMARY:
             raise RuntimeError(f"{self.name}: not primary")
         if any(wo.op in ATOMIC_OPS for wo in ops) and len(ops) > 1:
             raise ValueError("atomic ops cannot batch with other writes")
+        if (self._write_queue
+                or len(self._pending_acks) >= self.PIPELINE_DEPTH):
+            # the window is at its pipelining depth (or earlier writes
+            # already queued — a later write must NOT overtake them, or
+            # two puts to one key could apply in reversed order):
+            # coalesce batchable writes into the NEXT mutation (bounded
+            # staleness, replica_2pc.cpp:366); non-batchable ones and a
+            # full batch busy-reject for a client retry
+            if (all(wo.op in BATCHABLE_OPS for wo in ops)
+                    and sum(n for n, _cb in self._write_queue)
+                    + len(ops) <= self.MAX_BATCH_OPS):
+                self._write_queue.append((len(ops), callback))
+                self._queued_ops.extend(ops)
+                return -1
+            raise RuntimeError(
+                f"{self.name}: write queue busy (retry)")
         decree = self.last_prepared_decree() + 1
         ts = max(int(self.clock() * 1_000_000), self._last_timestamp_us + 1)
         idem_responses = None
@@ -277,6 +315,10 @@ class Replica:
                     f"{self.name}: atomic write on a duplicated table "
                     f"must wait for the in-flight window")
             ops, idem_responses = self._make_idempotent(ops, ts)
+            # per-item microseconds were handed out above: re-reserve by
+            # the OUTPUT count so the next mutation's timetags can't tie
+            self._last_timestamp_us = max(self._last_timestamp_us,
+                                          ts + max(len(ops), 1) - 1)
         # reserve one microsecond PER OP: duplication stamps op i with
         # ts + i, and the next mutation must not overlap those timetags
         self._last_timestamp_us = ts + max(len(ops), 1) - 1
@@ -298,10 +340,14 @@ class Replica:
         if callback is not None:
             self._client_callbacks[decree] = callback
         targets = self._prepare_targets(decree)
-        self._pending_acks[decree] = set(targets)
+        if targets:
+            self._pending_acks[decree] = set(targets)
         self._send_prepares(mu)
         tracer.add_point("prepares_sent")
         if not targets:
+            # no members to wait on: ready now. (Never leave an EMPTY
+            # entry in _pending_acks — it would count toward the
+            # pipelining depth forever and wedge the write queue.)
             self._on_decree_ready(decree)
         return decree
 
@@ -403,6 +449,27 @@ class Replica:
     def _on_decree_ready(self, decree: int) -> None:
         self.prepare_list.mark_ready(decree)
         self.prepare_list.commit(decree, COMMIT_ALL_READY)
+        self._drain_write_queue()
+
+    def _drain_write_queue(self) -> None:
+        """The round finished: ship everything queued behind it as ONE
+        mutation whose responses split back per original request."""
+        if (not self._write_queue or self._pending_acks
+                or self.status != PartitionStatus.PRIMARY):
+            return
+        spans = self._write_queue
+        ops = self._queued_ops
+        self._write_queue = []
+        self._queued_ops = []
+
+        def split_responses(responses: List[Any]) -> None:
+            off = 0
+            for n, cb in spans:
+                if cb is not None:
+                    cb(responses[off:off + n])
+                off += n
+
+        self.client_write(ops, split_responses)
 
     def _on_group_check(self, src: str, payload: dict) -> None:
         """Parity: on_group_check (replica_check.cpp:212) — heartbeat from
@@ -559,13 +626,14 @@ class Replica:
         os.replace(tmp, marker)
 
     def _make_idempotent(self, ops: List[WriteOp], ts: int):
-        """Atomic ops -> the concrete dup-tagged puts/removes they
-        resolve to, plus the response objects to hand the client. The
-        timetag embedded by translation rides each dup op, so follower
-        clusters resolve conflicts exactly as for plain writes."""
+        """The (single — atomic ops never batch) atomic op -> the
+        concrete dup-tagged puts/removes it resolves to, plus the
+        response object to hand the client. Each output op gets ITS OWN
+        microsecond (ts + i): two mutates of the same sort key in one
+        check_and_mutate must not tie on timetag, or the dup floor would
+        silently drop the later one. The caller re-reserves the
+        timestamp range by the OUTPUT count."""
         from pegasus_tpu.base.value_schema import (
-            PEGASUS_EPOCH_BEGIN,
-            extract_timetag,
             extract_user_data,
             generate_timetag,
         )
@@ -573,38 +641,31 @@ class Replica:
 
         ws = self.server.write_service
         now = max(0, ts // 1_000_000 - PEGASUS_EPOCH_BEGIN)
+        assert len(ops) == 1, "atomic ops never batch"
+        wo = ops[0]
+        if wo.op == OP_INCR:
+            resp, items = ws.translate_incr(wo.request, ts, now)
+        elif wo.op == OP_CAS:
+            resp, items = ws.translate_check_and_set(wo.request, ts, now)
+        else:
+            resp, items = ws.translate_check_and_mutate(wo.request, ts,
+                                                        now)
         out_ops: List[WriteOp] = []
-        responses: List[Any] = []
-        for wo in ops:
-            if wo.op == OP_INCR:
-                resp, items = ws.translate_incr(wo.request, ts, now)
-            elif wo.op == OP_CAS:
-                resp, items = ws.translate_check_and_set(wo.request, ts,
-                                                         now)
-            elif wo.op == OP_CAM:
-                resp, items = ws.translate_check_and_mutate(wo.request,
-                                                            ts, now)
+        for i, it in enumerate(items):
+            if it.op == ITEM_PUT:
+                user_data = extract_user_data(ws.data_version, it.value)
+                out_ops.append(WriteOp(
+                    OP_DUP_PUT,
+                    (it.key, user_data, it.expire_ts,
+                     generate_timetag(ts + i, ws.cluster_id, False))))
             else:
-                out_ops.append(wo)
-                responses.append(None)
-                continue
-            responses.append(resp)
-            for it in items:
-                if it.op == ITEM_PUT:
-                    timetag = extract_timetag(ws.data_version, it.value)
-                    user_data = extract_user_data(ws.data_version,
-                                                  it.value)
-                    out_ops.append(WriteOp(
-                        OP_DUP_PUT,
-                        (it.key, user_data, it.expire_ts, timetag)))
-                else:
-                    out_ops.append(WriteOp(
-                        OP_DUP_REMOVE,
-                        (it.key,
-                         generate_timetag(ts, ws.cluster_id, True))))
-        # an atomic op may resolve to NO writes (failed check / error):
-        # the mutation ships empty and the decree still advances
-        return out_ops, responses
+                out_ops.append(WriteOp(
+                    OP_DUP_REMOVE,
+                    (it.key,
+                     generate_timetag(ts + i, ws.cluster_id, True))))
+        # the op may resolve to NO writes (failed check / error): the
+        # mutation ships empty and the decree still advances
+        return out_ops, [resp]
 
     def _apply_ingest(self, request, decree: int) -> int:
         """Download this partition's staged SST and ingest it at `decree`."""

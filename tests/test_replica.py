@@ -443,3 +443,36 @@ def test_deterministic_schedules_replay_identically(tmp_path):
     d = run(43, tmp_path / "c")
     assert d[2] == a[2]  # same outcome
     assert d[1] != a[1]  # different schedule timing
+
+
+def test_write_queue_batches_behind_inflight_window(tmp_path):
+    """Mutation-queue parity: once the 2PC window is at pipelining depth,
+    further batchable writes coalesce into ONE following mutation, each
+    caller still receiving its own response."""
+    c = Cluster(tmp_path)
+    try:
+        # freeze acks so the window fills: r3 never answers
+        c.net.set_drop(1.0, src="r3", dst="r1")
+        results = []
+        for i in range(6):
+            c.primary.client_write(
+                [put_op("u", "s%d" % i, b"v%d" % i)],
+                lambda r, i=i: results.append((i, r)))
+        c.loop.run_until_idle()
+        # depth-2 window in flight, the rest queued as one pending batch
+        assert len(c.primary._pending_acks) == 2
+        assert sum(n for n, _cb in c.primary._write_queue) == 4
+        assert results == []  # nothing acked yet
+        # heal: acks flow, the window drains, the batch ships and commits
+        c.net.set_drop(0.0, src="r3", dst="r1")
+        c.primary.broadcast_group_check()
+        c.loop.run_until_idle()
+        c.primary.broadcast_group_check()
+        c.loop.run_until_idle()
+        assert sorted(i for i, _r in results) == list(range(6))
+        for i in range(6):
+            err, v = c.primary.server.on_get(
+                generate_key(b"u", b"s%d" % i))
+            assert (err, v) == (0, b"v%d" % i)
+    finally:
+        c.close()

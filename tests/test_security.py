@@ -75,3 +75,17 @@ def test_table_acl_allow_list(secure_cluster):
         "acl", {"replica.allowed_users": "alice,mallory"})
     secure_cluster.step()
     assert mallory.get(b"k", b"s") == (OK, b"v")
+
+
+def test_duplication_works_on_secured_cluster(secure_cluster):
+    """Inter-node duplication authenticates as the reserved node user."""
+    secure_cluster.create_table("sm", partition_count=2)
+    secure_cluster.create_table("sf", partition_count=2)
+    c = secure_cluster.client("sm", user="alice")
+    secure_cluster.meta.duplication.add_duplication("sm", "meta", "sf")
+    secure_cluster.step(rounds=3)
+    assert c.set(b"sk", b"s", b"sv") == OK
+    for _ in range(6):
+        secure_cluster.step()
+    fc = secure_cluster.client("sf", user="alice")
+    assert fc.get(b"sk", b"s") == (OK, b"sv")
