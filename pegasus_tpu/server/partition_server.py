@@ -148,6 +148,16 @@ class PartitionServer:
         self._read_throttle = None
         self._default_ttl = 0
         self._compaction_rules = None   # compiled rules_filter
+        # auto-compaction runs with THIS partition's filter context
+        # (TTL + stale-split + user rules), like every rocksdb
+        # compaction runs the filter in the reference
+        self.engine.auto_compact_ctx = lambda: {
+            "default_ttl": self._default_ttl,
+            "pidx": self.pidx,
+            "partition_version": self.partition_version,
+            "validate_hash": self.validate_partition_hash,
+            "rules_filter": self._compaction_rules,
+        }
 
     def update_app_envs(self, envs: dict) -> None:
         """Apply per-table dynamic settings (parity: replica_envs keys
@@ -175,6 +185,11 @@ class PartitionServer:
                     staged.append(("_default_ttl", int(value)))
                 elif key == "replica.slow_query_threshold_ms":
                     staged.append(("_slow_threshold_ms", float(value)))
+                elif key == "rocksdb.usage_scenario":
+                    if value not in ("normal", "prefer_write",
+                                     "bulk_load"):
+                        raise ValueError("unknown scenario")
+                    staged.append(("_usage_scenario", value))
                 elif key == "user_specified_compaction":
                     staged.append(("_compaction_rules",
                                    compile_rules(value) if value else None))
@@ -184,9 +199,30 @@ class PartitionServer:
         for attr, parsed in staged:
             if attr == "_slow_threshold_ms":
                 self.slow_log.threshold_ms = parsed
+            elif attr == "_usage_scenario":
+                self._apply_usage_scenario(parsed)
             else:
                 setattr(self, attr, parsed)
         self.app_envs.update(envs)
+
+    def _apply_usage_scenario(self, scenario: str) -> None:
+        """Parity: the usage-scenario dynamic tuning
+        (pegasus_server_impl.cpp:1758; envs common/replica_envs.h:81):
+        normal serves balanced; prefer_write buffers more before
+        flushing; bulk_load buffers maximally and defers compaction
+        entirely until the load finishes (ingest-behind style)."""
+        eng = self.engine
+        if scenario == "normal":
+            eng.memtable_flush_trigger = 100_000
+            eng.auto_compact = True
+            eng.lsm._l0_trigger = 4
+        elif scenario == "prefer_write":
+            eng.memtable_flush_trigger = 250_000
+            eng.auto_compact = True
+            eng.lsm._l0_trigger = 8
+        else:  # bulk_load
+            eng.memtable_flush_trigger = 500_000
+            eng.auto_compact = False
 
     def _gate(self, bucket, denied: bool) -> int:
         """Shared deny/throttle gate (parity: the gate stack at

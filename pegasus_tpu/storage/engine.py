@@ -61,6 +61,12 @@ class StorageEngine:
             self.last_flushed_decree = max(self.last_flushed_decree, d)
         self.last_committed_decree = self.last_flushed_decree
 
+        # auto-maintenance knobs (the usage-scenario env rewires them:
+        # normal / prefer_write / bulk_load — common/replica_envs.h:81)
+        self.memtable_flush_trigger = 100_000  # records
+        self.auto_compact = True
+        self.auto_compact_ctx = None  # server installs its filter context
+
         # flush/compaction event metrics (parity: pegasus_event_listener)
         from pegasus_tpu.utils.metrics import METRICS
 
@@ -107,6 +113,20 @@ class StorageEngine:
             else:
                 self.lsm.put(i.key, i.value, i.expire_ts)
         self.last_committed_decree = decree
+        self._maybe_maintain()
+
+    def _maybe_maintain(self) -> None:
+        """Auto flush + compaction (parity: rocksdb's write-buffer flush
+        and level-0 compaction trigger, tuned by the usage-scenario env,
+        pegasus_server_impl.cpp:1758): without this a write-heavy table
+        never flushes — unbounded memtable, unbounded WAL replay.
+        Callers hold the single-writer context already."""
+        if len(self.lsm.memtable) < self.memtable_flush_trigger:
+            return
+        self.flush()
+        if self.auto_compact and self.lsm.should_compact():
+            ctx = self.auto_compact_ctx() if self.auto_compact_ctx else {}
+            self.manual_compact(**ctx)
 
     def flush(self) -> bool:
         """Memtable -> durable L0 SST stamped with the decree watermark."""

@@ -464,3 +464,49 @@ def test_empty_compaction_keeps_seq_horizon(tmp_path):
     lsm3 = LSMStore(d)  # the boot that used to eat the fresh flush
     assert lsm3.get(b"new") == (b"data", 0)
     lsm3.close()
+
+
+def test_auto_flush_and_compact_bound_growth(tmp_path):
+    """A write-heavy engine flushes at the memtable trigger and compacts
+    at the L0 trigger without any manual call (the rocksdb write-buffer +
+    level-0 trigger parity)."""
+    from pegasus_tpu.base.value_schema import generate_value
+    from pegasus_tpu.storage.engine import StorageEngine, WriteBatchItem
+    from pegasus_tpu.storage.wal import OP_PUT
+
+    eng = StorageEngine(str(tmp_path / "e"))
+    eng.memtable_flush_trigger = 500
+    d = 0
+    for batch in range(12):
+        items = [WriteBatchItem(
+            OP_PUT, b"a%06d" % (batch * 200 + i),
+            generate_value(1, b"v", 0), 0) for i in range(200)]
+        d += 1
+        eng.write_batch(items, d)
+    # flush trigger fired (memtable bounded) and at least one compaction
+    assert len(eng.lsm.memtable) < 500
+    assert eng._ev_flush_count._value >= 3
+    assert eng._ev_compact_count._value >= 1
+    assert len(eng.lsm.l0) < 4 + 1
+    # everything still readable
+    assert eng.get(b"a000000") is not None
+    assert eng.get(b"a%06d" % (12 * 200 - 1)) is not None
+    eng.close()
+
+
+def test_usage_scenarios_rewire_maintenance(tmp_path):
+    from pegasus_tpu.server.partition_server import PartitionServer
+
+    srv = PartitionServer(str(tmp_path / "p"))
+    srv.update_app_envs({"rocksdb.usage_scenario": "bulk_load"})
+    assert srv.engine.auto_compact is False
+    assert srv.engine.memtable_flush_trigger == 500_000
+    srv.update_app_envs({"rocksdb.usage_scenario": "prefer_write"})
+    assert srv.engine.auto_compact and srv.engine.lsm._l0_trigger == 8
+    srv.update_app_envs({"rocksdb.usage_scenario": "normal"})
+    assert srv.engine.lsm._l0_trigger == 4
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError):
+        srv.update_app_envs({"rocksdb.usage_scenario": "warp_speed"})
+    srv.close()
