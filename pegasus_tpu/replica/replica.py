@@ -414,6 +414,16 @@ class Replica:
                 "decree": mu.decree, "ballot": self.config.ballot,
                 "err": int(ErrorCode.ERR_INVALID_STATE)})
             return
+        # fail point (parity: the disk-fault injection sites around log
+        # writes — the .act 200-series exercise this): a configured
+        # write-fault NAKs the prepare like a real aio failure would
+        from pegasus_tpu.utils.fail_point import fail_point
+
+        if fail_point(f"{self.name}::plog_append") is not None:
+            self.transport.send(self.name, src, "prepare_ack", {
+                "decree": mu.decree, "ballot": self.config.ballot,
+                "err": int(ErrorCode.ERR_FILE_OPERATION_FAILED)})
+            return
         self.log.append(mu)
         # advance commit point from the piggy-backed primary commit
         mode = (COMMIT_TO_DECREE_HARD

@@ -21,6 +21,7 @@ Case grammar (one `verb: args` per line; '#' comments):
     expect_members: <pidx> <count>              replication level
     expect_ballot_ge: <pidx> <n>                ballot monotonicity
     expect_consistent: <hk> <sk>                every member agrees
+    fail_point: <name> <action>                 e.g. node1::plog_append raise(io)
 """
 
 from __future__ import annotations
@@ -59,6 +60,9 @@ class ActRunner:
         self.app_id: Optional[int] = None
 
     def close(self) -> None:
+        from pegasus_tpu.utils.fail_point import FAIL_POINTS
+
+        FAIL_POINTS.teardown()  # a case must not leak faults
         self.cluster.close()
 
     def run_text(self, text: str, name: str = "<case>") -> None:
@@ -116,6 +120,11 @@ class ActRunner:
             c.net.set_drop(float(args[2]), args[0], args[1])
         elif verb == "heal_links":
             c.net._drop_prob.clear()
+        elif verb == "fail_point":
+            from pegasus_tpu.utils.fail_point import FAIL_POINTS
+
+            FAIL_POINTS.setup()
+            FAIL_POINTS.cfg(args[0], " ".join(args[1:]))
         elif verb == "step":
             c.step(rounds=int(args[0]) if args else 1)
         elif verb == "expect_primary_not":
