@@ -35,6 +35,14 @@ class _Handler(BaseHTTPRequestHandler):
     def do_GET(self):
         url = urlparse(self.path)
         query = parse_qs(url.query)
+        routes = getattr(self.server, "routes", None) or {}
+        if url.path in routes:
+            try:
+                self._reply(200, routes[url.path](
+                    {k: v[0] for k, v in query.items()}))
+            except (KeyError, ValueError) as e:
+                self._reply(400, {"error": str(e)})
+            return
         if url.path == "/version":
             self._reply(200, {"version": pegasus_tpu.__version__,
                               "framework": "pegasus_tpu"})
@@ -66,10 +74,13 @@ class MetricsHttpServer:
     """Threaded HTTP server; bind port 0 for an ephemeral port."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
-                 commands=None) -> None:
+                 commands=None, routes=None) -> None:
         self._server = ThreadingHTTPServer((host, port), _Handler)
         # the /command endpoint serves this registry (None = 404)
         self._server.commands = commands
+        # extra GET routes: path -> callable(query_dict) -> payload
+        # (the meta REST surface rides here, meta_http_service parity)
+        self._server.routes = routes
         self._thread: Optional[threading.Thread] = None
 
     @property

@@ -236,6 +236,37 @@ class MetaService:
         self.duplication.tick()
         self.split.tick()
 
+    def http_routes(self) -> dict:
+        """The cluster/table info REST surface (parity:
+        meta/meta_http_service.h): /meta/apps, /meta/app?name=,
+        /meta/nodes, /meta/status."""
+
+        def apps(_q):
+            return [{"app_id": a.app_id, "app_name": a.app_name,
+                     "partition_count": a.partition_count,
+                     "replica_count": a.max_replica_count,
+                     "envs": dict(a.envs)} for a in self.list_apps()]
+
+        def app(q):
+            app_id, count, configs = self.query_config(q["name"])
+            return {"app_id": app_id, "partition_count": count,
+                    "partitions": [{"pidx": i, "ballot": pc.ballot,
+                                    "primary": pc.primary,
+                                    "secondaries": list(pc.secondaries)}
+                                   for i, pc in enumerate(configs)]}
+
+        def nodes(_q):
+            return {"alive": self.fd.alive_workers()}
+
+        def status(_q):
+            return {"name": self.name, "leader": self.election.leader,
+                    "is_leader": self.election.is_leader,
+                    "term": self.election.term,
+                    "state_seq": self.storage.seq}
+
+        return {"/meta/apps": apps, "/meta/app": app,
+                "/meta/nodes": nodes, "/meta/status": status}
+
     # ---- restore bookkeeping ------------------------------------------
 
     def _load_pending_restores(self) -> None:

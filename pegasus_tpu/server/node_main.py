@@ -54,14 +54,19 @@ def run_node(cfg: dict, name: str) -> None:
     signal.signal(signal.SIGTERM, on_term)
     signal.signal(signal.SIGINT, on_term)
 
+    http_server = None
     if role == "meta":
+        from pegasus_tpu.http.http_server import MetricsHttpServer
         from pegasus_tpu.meta.meta_service import MetaService
 
         svc = MetaService(name, os.path.join(data_root, name), transport,
                           clock=time.monotonic, peers=meta_names)
         transport.run_timer(1.0, svc.tick)
+        http_server = MetricsHttpServer(
+            port=node_cfg.get("http_port", 0), commands=svc.commands,
+            routes=svc.http_routes()).start()
         print(f"[{name}] meta serving on {node_cfg['host']}:"
-              f"{node_cfg['port']}", flush=True)
+              f"{node_cfg['port']} http={http_server.port}", flush=True)
     elif role == "replica":
         from pegasus_tpu.replica.replica import PartitionStatus
         from pegasus_tpu.replica.stub import ReplicaStub
@@ -87,8 +92,13 @@ def run_node(cfg: dict, name: str) -> None:
         # disk cleaner (parity: replica/disk_cleaner.*): age out trashed
         # replica dirs so rebalancing churn cannot fill the disk
         transport.run_timer(600.0, stub.fs.clean_trash)
+        from pegasus_tpu.http.http_server import MetricsHttpServer
+
+        http_server = MetricsHttpServer(
+            port=node_cfg.get("http_port", 0),
+            commands=stub.commands).start()
         print(f"[{name}] replica serving on {node_cfg['host']}:"
-              f"{node_cfg['port']}", flush=True)
+              f"{node_cfg['port']} http={http_server.port}", flush=True)
     else:
         raise SystemExit(f"unknown role {role!r} for {name}")
 

@@ -133,6 +133,14 @@ def main(argv=None) -> int:
     p.add_argument("table")
     p = sub.add_parser("nodes")
     p = sub.add_parser("rebalance")
+    # offline debugging (parity: shell sst_dump / mlog_dump and
+    # src/tools/mutation_log_tool.*) — read files directly, no cluster
+    p = sub.add_parser("sst_dump")
+    p.add_argument("path", help="one .sst file or a replica sst dir")
+    p.add_argument("--max", type=int, default=20)
+    p = sub.add_parser("mlog_dump")
+    p.add_argument("path", help="a replica's plog file (mlog.bin)")
+    p.add_argument("--max", type=int, default=20)
     p = sub.add_parser("remote_command")
     p.add_argument("node", help="node name (meta / node0 / ...)")
     p.add_argument("verb", help="registered verb ('help' lists them)")
@@ -142,6 +150,8 @@ def main(argv=None) -> int:
 
     args = parser.parse_args(argv)
 
+    if args.cmd in ("sst_dump", "mlog_dump"):
+        return _offline_dump(args, sys.stdout)
     if (args.root is None) == (args.cluster is None):
         print("error: exactly one of --root / --cluster is required",
               file=sys.stderr)
@@ -163,6 +173,57 @@ def main(argv=None) -> int:
         return 1
     finally:
         box.close()
+
+
+def _offline_dump(args, out) -> int:
+    import os
+
+    from pegasus_tpu.base.key_schema import restore_key
+    from pegasus_tpu.base.value_schema import (
+        extract_expire_ts,
+        extract_user_data,
+    )
+
+    if args.cmd == "sst_dump":
+        from pegasus_tpu.storage.sstable import SSTable
+
+        paths = ([args.path] if args.path.endswith(".sst") else sorted(
+            os.path.join(args.path, n) for n in os.listdir(args.path)
+            if n.endswith(".sst")))
+        shown = 0
+        for path in paths:
+            t = SSTable(path)
+            print(f"# {path}: {t.total_count} records, "
+                  f"{len(t.blocks)} blocks, meta={t.meta}", file=out)
+            for key, value, ets in t.iterate():
+                if shown >= args.max:
+                    break
+                hk, sk = restore_key(key)
+                if value is None:
+                    print(f"  DEL {hk!r} : {sk!r}", file=out)
+                else:
+                    data = extract_user_data(1, value)
+                    print(f"  {hk!r} : {sk!r} => {data!r} "
+                          f"(ets={ets})", file=out)
+                shown += 1
+            t.close()
+            if shown >= args.max:
+                break
+        return 0
+    # mlog_dump
+    from pegasus_tpu.replica.mutation_log import MutationLog
+
+    shown = 0
+    for mu in MutationLog.replay(args.path):
+        if shown >= args.max:
+            break
+        ops = ", ".join(f"op{wo.op}" for wo in mu.ops)
+        print(f"decree={mu.decree} ballot={mu.ballot} "
+              f"last_committed={mu.last_committed} "
+              f"ts_us={mu.timestamp_us} ops=[{ops}]", file=out)
+        shown += 1
+    print(f"# {shown} mutation(s) shown", file=out)
+    return 0
 
 
 class _ClusterBox:
