@@ -910,23 +910,33 @@ class PartitionServer:
                 resp.error = gate
                 out.append(resp)
             return out
-        runs = self.engine.lsm.sorted_runs()
+        lsm = self.engine.lsm
+        runs = lsm.l1_runs
+        # a light write overlay (memtable + small L0s) must NOT evict the
+        # whole partition from the device path: its rows merge host-side
+        # on top of the device-filtered base (the YCSB-E 5%-insert shape
+        # leaves a handful of overlay rows per partition)
+        overlay_count = len(lsm.memtable) + sum(t.total_count
+                                                for t in lsm.l0)
         # the shared-mask trick needs every request to share the mask
         # inputs: no per-request filters/count mode, and ONE effective
         # validate flag (a request-level opt-out would need its own mask)
         validates = {bool(r.validate_partition_hash
                           and self.validate_partition_hash)
                      for r in reqs}
-        simple = (runs is not None and len(validates) == 1 and all(
-            r.hash_key_filter_type == FT_NO_FILTER
-            and r.sort_key_filter_type == FT_NO_FILTER
-            and not r.only_return_count
-            for r in reqs))
+        simple = (runs and overlay_count <= self.OVERLAY_MERGE_LIMIT
+                  and len(validates) == 1 and all(
+                      r.hash_key_filter_type == FT_NO_FILTER
+                      and r.sort_key_filter_type == FT_NO_FILTER
+                      and not r.only_return_count
+                      for r in reqs))
         if not simple:
             return [self.on_get_scanner(r) for r in reqs]
         now = epoch_now()
         none_f = FilterSpec.none()
         validate = validates.pop()
+        overlay = self._overlay_snapshot(now, validate) \
+            if overlay_count else ([], {})
         # 1 — per request: the block list + boundary bounds, capped a bit
         # beyond batch_size so expiry/hash drops don't starve the page
         req_plans = []
@@ -966,7 +976,7 @@ class PartitionServer:
         # then one materialization wave); cached masks cost nothing
         keep_masks = {}
         expired_masks = {}
-        lazy_masks = {}
+        misses: "OrderedDict[tuple, object]" = OrderedDict()
         for ckey, (run, bm, blk) in unique.items():
             mkey = (ckey, now, self.partition_version, validate)
             cached = self._mask_cache.get(mkey)
@@ -974,22 +984,21 @@ class PartitionServer:
                 self._mask_cache.move_to_end(mkey)
                 keep_masks[ckey], expired_masks[ckey] = cached
                 continue
-            dev_block = self._device_cached_block(ckey, blk)
-            masks = scan_block_predicate(
-                dev_block, now, hash_filter=none_f, sort_filter=none_f,
-                validate_hash=validate, pidx=self.pidx,
-                partition_version=self.partition_version)
-            lazy_masks[ckey] = masks
-        for ckey, m in lazy_masks.items():
-            keep = np.asarray(m.keep)
-            expired = np.asarray(m.expired)
+            misses[ckey] = self._device_cached_block(ckey, blk)
+        for ckey, keep, expired in self._eval_blocks_stacked(
+                misses, now, none_f, validate):
             keep_masks[ckey] = keep
             expired_masks[ckey] = expired
             self._mask_cache[(ckey, now, self.partition_version,
                               validate)] = (keep, expired)
             if len(self._mask_cache) > self._mask_cache_cap:
                 self._mask_cache.popitem(last=False)
-        # 3 — assemble each response from the shared masks
+        # 3 — assemble each response from the shared masks, merging the
+        # host-side overlay in key order (overlay rows SHADOW base rows:
+        # newest wins, tombstones hide)
+        import bisect
+
+        overlay_keys, overlay_map = overlay
         out = []
         for req, start_key, stop_key, want, plan in req_plans:
             records = []
@@ -997,30 +1006,66 @@ class PartitionServer:
             resume_key = None
             stop_early = False
             req_expired = 0
-            for ckey, blk, lo, hi in plan:
-                keep = keep_masks[ckey]
+
+            def base_rows(plan=plan):
+                for ckey, blk, lo, hi in plan:
+                    keep = keep_masks[ckey]
+                    for i in np.flatnonzero(keep[lo:hi]):
+                        idx = lo + int(i)
+                        yield blk.key_at(idx), blk, idx
+
+            for ckey, _blk, lo, hi in plan:
                 # per-REQUEST expired accounting (the solo path counts
                 # per request served, not per block evaluated)
                 req_expired += int(expired_masks[ckey][lo:hi].sum())
-                for i in np.flatnonzero(keep[lo:hi]):
-                    idx = lo + int(i)
-                    key = blk.key_at(idx)
+            # plan frontier: where a budget-capped base plan ends — the
+            # overlay must not run ahead of it (resume correctness)
+            capped = (plan and sum(hi - lo for _c, _b, lo, hi in plan)
+                      >= want * 2 + 64)
+            frontier = (_after(plan[-1][1].key_at(plan[-1][1].count - 1))
+                        if capped else None)
+            ov_lo = (bisect.bisect_left(overlay_keys, start_key)
+                     if start_key else 0)
+            ov_hi = len(overlay_keys)
+            if stop_key:
+                ov_hi = bisect.bisect_left(overlay_keys, stop_key, ov_lo)
+            if frontier is not None:
+                ov_hi = bisect.bisect_left(overlay_keys, frontier,
+                                           ov_lo, ov_hi)
+            ov_i = ov_lo
+            base = base_rows()
+            base_item = next(base, None)
+            while len(records) < want:
+                ov_key = overlay_keys[ov_i] if ov_i < ov_hi else None
+                if base_item is None and ov_key is None:
+                    break
+                take_overlay = (ov_key is not None
+                                and (base_item is None
+                                     or ov_key <= base_item[0]))
+                if take_overlay:
+                    if base_item is not None and ov_key == base_item[0]:
+                        base_item = next(base, None)  # shadowed
+                    ov_i += 1
+                    entry = overlay_map[ov_key]
+                    if entry is None:
+                        continue  # tombstone / hidden overlay row
+                    data = b"" if req.no_value else entry[0]
+                    records.append((ov_key, data, entry[1]))
+                    key = ov_key
+                else:
+                    key, blk, idx = base_item
+                    base_item = next(base, None)
                     data = (b"" if req.no_value
                             else extract_user_data(self.data_version,
                                                    blk.value_at(idx)))
                     records.append((key, data, int(blk.expire_ts[idx])))
-                    if len(records) >= want:
-                        resume_key = _after(key)
-                        stop_early = True
-                        break
-                if stop_early:
-                    break
+                if len(records) >= want:
+                    resume_key = _after(key)
+                    stop_early = True
             if stop_early:
                 exhausted = False
-            elif plan and sum(hi - lo for _c, _b, lo, hi in plan)                     >= want * 2 + 64:
-                # budget-capped plan: there may be more range beyond
-                last_ckey, last_blk, _lo, _hi = plan[-1]
-                resume_key = _after(last_blk.key_at(last_blk.count - 1))
+            elif capped:
+                resume_key = frontier
                 exhausted = False
             if req_expired:
                 self._abnormal_reads.increment(req_expired)
@@ -1046,6 +1091,95 @@ class PartitionServer:
             (time.perf_counter() - t0) * 1000.0,
             {"scans": len(reqs), "unique_blocks": len(unique)})
         return out
+
+    # overlay rows tolerated on the batched device path before falling
+    # back to per-request merged serving
+    OVERLAY_MERGE_LIMIT = 4096
+
+    def _overlay_snapshot(self, now: int, validate: bool):
+        """(sorted_keys, key -> None|(user_data, ets)) for the memtable +
+        L0 overlay, newest-wins, with the scan predicates (TTL, stale-
+        split hash) evaluated HOST-side — the overlay is tiny by the
+        fast-path qualifier, so a device dispatch would cost more than
+        it filters."""
+        from pegasus_tpu.base.key_schema import check_key_hash
+        from pegasus_tpu.storage.memtable import TOMBSTONE
+
+        lsm = self.engine.lsm
+        merged: dict = {}
+        for key, value, ets in lsm.memtable.items_sorted():
+            merged[key] = (None if value is TOMBSTONE
+                           else (value, ets))
+        for table in lsm.l0:  # newest first; first writer wins
+            for key, value, ets in table.iterate():
+                if key not in merged:
+                    merged[key] = (None if value is None
+                                   else (value, ets))
+        keys = sorted(merged)
+        out: dict = {}
+        for key in keys:
+            entry = merged[key]
+            if entry is None:
+                out[key] = None  # tombstone: shadows the base
+                continue
+            value, ets = entry
+            if check_if_ts_expired(now, ets):
+                self._abnormal_reads.increment()
+                out[key] = None  # expired: hidden AND shadows the base
+                continue
+            if validate and not check_key_hash(key, self.pidx,
+                                               self.partition_version):
+                out[key] = None
+                continue
+            out[key] = (extract_user_data(self.data_version, value), ets)
+        return keys, out
+
+    def _eval_blocks_stacked(self, misses, now, none_f, validate):
+        """Evaluate MANY blocks' predicates in as few device dispatches
+        as possible: blocks sharing a key width stack into one [B*cap, W]
+        program (records are independent — block boundaries carry no
+        meaning to the predicate). B pads to a power of two so each
+        (width, B-bucket) pair compiles once. On a high-RTT device link
+        this turns a dispatch per block into a dispatch per batch."""
+        import jax.numpy as jnp
+
+        if not misses:
+            return
+        by_width: "OrderedDict[int, list]" = OrderedDict()
+        for ckey, dev in misses.items():
+            by_width.setdefault(int(dev.keys.shape[1]), []).append(
+                (ckey, dev))
+        for _w, group in by_width.items():
+            cap = int(group[0][1].keys.shape[0])
+            if len(group) == 1:
+                ckey, dev = group[0]
+                m = scan_block_predicate(
+                    dev, now, hash_filter=none_f, sort_filter=none_f,
+                    validate_hash=validate, pidx=self.pidx,
+                    partition_version=self.partition_version)
+                yield ckey, np.asarray(m.keep), np.asarray(m.expired)
+                continue
+            bucket = 1 << (len(group) - 1).bit_length()
+            padded = group + [group[0]] * (bucket - len(group))
+            from pegasus_tpu.ops.record_block import RecordBlock
+
+            stacked = RecordBlock(
+                jnp.concatenate([d.keys for _c, d in padded]),
+                jnp.concatenate([d.key_len for _c, d in padded]),
+                jnp.concatenate([d.hashkey_len for _c, d in padded]),
+                jnp.concatenate([d.expire_ts for _c, d in padded]),
+                jnp.concatenate([d.valid for _c, d in padded]),
+                (None if padded[0][1].hash_lo is None
+                 else jnp.concatenate([d.hash_lo for _c, d in padded])))
+            m = scan_block_predicate(
+                stacked, now, hash_filter=none_f, sort_filter=none_f,
+                validate_hash=validate, pidx=self.pidx,
+                partition_version=self.partition_version)
+            keep_all = np.asarray(m.keep)
+            exp_all = np.asarray(m.expired)
+            for i, (ckey, _d) in enumerate(group):
+                yield (ckey, keep_all[i * cap:(i + 1) * cap],
+                       exp_all[i * cap:(i + 1) * cap])
 
     def _device_cached_block(self, cache_key, blk):
         """The shared device-upload cache used by both scan paths."""

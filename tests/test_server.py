@@ -394,3 +394,54 @@ def test_batched_scan_falls_back_off_fast_path(tmp_path):
     assert len(out[0].kvs) == 50
     assert len(out[1].kvs) == 10
     srv.close()
+
+
+def test_batched_scan_overlay_merge_matches_individual(tmp_path):
+    """A small write overlay merges host-side onto the device-filtered
+    base: batched results must equal per-request serving, including
+    shadowing (updates + tombstones) and pagination."""
+    from pegasus_tpu.base.key_schema import generate_key
+    from pegasus_tpu.base.value_schema import generate_value
+    from pegasus_tpu.server.partition_server import PartitionServer
+    from pegasus_tpu.server.types import GetScannerRequest
+    from pegasus_tpu.storage.engine import WriteBatchItem
+    from pegasus_tpu.storage.wal import OP_PUT
+
+    srv = PartitionServer(str(tmp_path / "p"), partition_count=1)
+    items = [WriteBatchItem(
+        OP_PUT, generate_key(b"h%02d" % (i % 10), b"s%04d" % i),
+        generate_value(1, b"base%d" % i, 0), 0) for i in range(400)]
+    srv.engine.write_batch(items, 1)
+    srv.manual_compact()
+    # overlay: updates shadowing base rows, fresh inserts, tombstones
+    srv.on_put(generate_key(b"h00", b"s0000"), b"UPDATED")
+    srv.on_put(generate_key(b"h00", b"s0000x"), b"INSERTED")
+    srv.on_remove(generate_key(b"h01", b"s0011"))
+    srv.engine.flush()  # some overlay in L0...
+    srv.on_put(generate_key(b"h02", b"s0002"), b"NEWEST")  # ...some in mem
+
+    reqs = [GetScannerRequest(start_key=generate_key(b"h0%d" % d, b""),
+                              batch_size=17) for d in range(4)] \
+        + [GetScannerRequest(start_key=b"", batch_size=33)]
+    batch = srv.on_get_scanner_batch(list(reqs))
+    for req, got in zip(reqs, batch):
+        solo = srv.on_get_scanner(req)
+        assert [(kv.key, kv.value) for kv in got.kvs] == \
+            [(kv.key, kv.value) for kv in solo.kvs], req
+        # paging equivalence
+        g, s_ = got, solo
+        while g.context_id >= 0 and s_.context_id >= 0:
+            g = srv.on_scan(g.context_id)
+            s_ = srv.on_scan(s_.context_id)
+            assert [(kv.key, kv.value) for kv in g.kvs] == \
+                [(kv.key, kv.value) for kv in s_.kvs]
+        assert (g.context_id >= 0) == (s_.context_id >= 0)
+    # the shadowed values surfaced
+    all_rows = dict((kv.key, kv.value)
+                    for kv in srv.on_get_scanner(
+                        GetScannerRequest(start_key=b"",
+                                          batch_size=1000)).kvs)
+    assert all_rows[generate_key(b"h00", b"s0000")] == b"UPDATED"
+    assert all_rows[generate_key(b"h02", b"s0002")] == b"NEWEST"
+    assert generate_key(b"h01", b"s0011") not in all_rows
+    srv.close()
