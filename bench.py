@@ -223,11 +223,10 @@ def run_scans(bc, n_ops, n_partitions, n_hashkeys, seed, record_goal=100,
 
     def flush_pending():
         nonlocal records, pending_n
-        for pidx, reqs in pending.items():
-            if len(reqs) == 1:
-                resps = [client._read("get_scanner", reqs[0], pidx)]
-            else:
-                resps = client._read("scan_batch", reqs, pidx)
+        if not pending:
+            return
+        results = client.scan_multi(dict(pending))
+        for pidx, resps in results.items():
             for resp in resps:
                 records += len(resp.kvs)
                 if resp.context_id >= 0:

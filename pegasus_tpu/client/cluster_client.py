@@ -370,6 +370,56 @@ class ClusterClient:
             return_check_value=return_check_value)
         return self._write([(OP_CAM, req)], key_hash_parts(hash_key))[0]
 
+    def scan_multi(self, groups: Dict[int, list]):
+        """Batched scans for MANY partitions in as few node round-trips
+        as possible: partitions group by their primary node, each node
+        stacks its partitions' blocks into one device evaluation
+        (SURVEY §2.6's partitions-as-batch-dimension model). Returns
+        {pidx: [ScanResponse]}."""
+        self._ensure_config()
+        out: Dict[int, list] = {}
+        for attempt in range(self._max_retries):
+            if attempt:
+                self.refresh_config()
+            by_node: Dict[str, list] = {}
+            for pidx, reqs in groups.items():
+                if pidx in out:
+                    continue
+                primary = self._primary_of(pidx)
+                if primary:
+                    by_node.setdefault(primary, []).append(
+                        ((self.app_id, pidx), reqs))
+            if not by_node:
+                continue  # mid-failover: refresh and retry, like _read
+            # send EVERY node's request first, then await — per-attempt
+            # latency is the max of node round-trips, not the sum
+            rids = []
+            for node, node_groups in by_node.items():
+                rids.append(self._send_request(
+                    node, "client_scan_multi",
+                    {"groups": node_groups, "auth": self.auth}))
+            for rid in rids:
+                reply = self._await(rid)
+                if reply is None or reply["err"] != _OK:
+                    continue  # retried next attempt for missing pidxs
+                for pidx, resps in reply["result"]:
+                    if resps and resps[0].error == int(
+                            ErrorCode.ERR_ACL_DENY):
+                        raise PegasusError(ErrorCode.ERR_ACL_DENY,
+                                           "scan_multi")
+                    if resps and resps[0].error == int(
+                            ErrorCode.ERR_INVALID_STATE):
+                        continue  # stale primary; re-resolve
+                    out[pidx] = resps
+            if len(out) == len(groups):
+                break
+        missing = set(groups) - set(out)
+        if missing:
+            raise PegasusError(ErrorCode.ERR_TIMEOUT,
+                               f"scan_multi: partitions {sorted(missing)} "
+                               f"unreachable")
+        return out
+
     # ---- scanners ------------------------------------------------------
 
     def get_scanner(self, hash_key: bytes, start_sortkey: bytes = b"",

@@ -157,7 +157,7 @@ def scan_block_predicate(block: RecordBlock, now,
                          hash_filter: Optional[FilterSpec] = None,
                          sort_filter: Optional[FilterSpec] = None,
                          validate_hash: bool = False,
-                         pidx: int = 0,
+                         pidx=0,
                          partition_version: int = -1) -> ScanMasks:
     """Evaluate the full scan validation for a record block on device.
 
@@ -168,7 +168,13 @@ def scan_block_predicate(block: RecordBlock, now,
     """
     hash_filter = hash_filter or FilterSpec.none()
     sort_filter = sort_filter or FilterSpec.none()
-    if validate_hash and (partition_version < 0 or pidx > partition_version):
+    # `pidx` may be a PER-RECORD array: stacked cross-partition batches
+    # (SURVEY §2.6 — partitions as the batch dimension of one dispatch)
+    # pass each record its owning partition index; scalar callers keep
+    # the reject-all split-safety gate below
+    pidx_is_array = not isinstance(pidx, int)
+    if (validate_hash and not pidx_is_array
+            and (partition_version < 0 or pidx > partition_version)):
         valid = jnp.asarray(block.valid)
         expired = ttl_expired(jnp.asarray(block.expire_ts),
                               jnp.asarray(now, jnp.uint32)) & valid
@@ -181,7 +187,8 @@ def scan_block_predicate(block: RecordBlock, now,
         jnp.asarray(block.valid), jnp.asarray(now, jnp.uint32),
         hash_filter.pattern, hash_filter.pattern_len,
         sort_filter.pattern, sort_filter.pattern_len,
-        jnp.asarray(pidx, jnp.uint32),
+        jnp.asarray(pidx, jnp.uint32)
+        if not pidx_is_array else jnp.asarray(pidx),
         jnp.asarray(partition_version & 0xFFFFFFFF, jnp.uint32),
         hash_filter.filter_type, sort_filter.filter_type, validate_hash,
         hash_lo=(jnp.asarray(block.hash_lo) if use_hash_lo

@@ -313,6 +313,9 @@ class ReplicaStub:
             self.net.send(self.name, src, "remote_command_reply", {
                 "rid": rid, "err": err, "result": result})
             return
+        if msg_type == "client_scan_multi":
+            self._on_client_scan_multi(src, payload)
+            return
         if msg_type == "client_write":
             self._on_client_write(src, payload)
             return
@@ -623,6 +626,74 @@ class ReplicaStub:
                 done)
         except (RuntimeError, ValueError):
             self._ingest_inflight.discard(key)
+
+    def _on_client_scan_multi(self, src: str, payload: dict) -> None:
+        """Cross-partition batched scans: one message covers every
+        partition this node hosts for the table; qualifying partitions
+        share ONE stacked device evaluation (scan_coordinator). Reply:
+        {rid, err, result: [(pidx, [ScanResponse])]} aligned with the
+        request's groups; per-partition gate failures surface as
+        error responses in that partition's slot."""
+        from pegasus_tpu.replica.replica import PartitionStatus
+        from pegasus_tpu.server.scan_coordinator import scan_multi
+        from pegasus_tpu.server.types import ScanResponse
+        from pegasus_tpu.utils.errors import ErrorCode
+
+        rid = payload.get("rid")
+        groups = payload.get("groups") or []
+        now = None
+        ok_servers = []
+        slots = []
+        for gpid, reqs in groups:
+            gpid = tuple(gpid)
+            r = self.replicas.get(gpid)
+            if not self._client_allowed(r, payload):
+                # auth/ACL is PERMANENT — distinct from stale-primary so
+                # the client doesn't burn retries re-resolving
+                errs = []
+                for _req in reqs:
+                    resp = ScanResponse()
+                    resp.error = int(ErrorCode.ERR_ACL_DENY)
+                    errs.append(resp)
+                slots.append((gpid[1], errs))
+                continue
+            if (r is None or r.status != PartitionStatus.PRIMARY
+                    or getattr(r, "restoring", False)
+                    or not r.ready_to_serve()
+                    or not self.lease_valid()):
+                errs = []
+                for _req in reqs:
+                    resp = ScanResponse()
+                    resp.error = int(ErrorCode.ERR_INVALID_STATE)
+                    errs.append(resp)
+                slots.append((gpid[1], errs))
+                continue
+            slots.append((gpid[1], None))
+            ok_servers.append((len(slots) - 1, r.server, reqs))
+        if ok_servers:
+            from pegasus_tpu.base.value_schema import epoch_now
+
+            now = epoch_now()
+            try:
+                results = scan_multi(
+                    [(srv, reqs) for _i, srv, reqs in ok_servers], now)
+            except ValueError as e:
+                # malformed request: a DEFINITE reply, not a dropped one
+                # (retrying a deterministic failure helps no one)
+                for slot_i, _srv, reqs in ok_servers:
+                    errs = []
+                    for _req in reqs:
+                        resp = ScanResponse()
+                        resp.error = int(
+                            ErrorCode.ERR_INVALID_PARAMETERS)
+                        errs.append(resp)
+                    slots[slot_i] = (slots[slot_i][0], errs)
+            else:
+                for (slot_i, _srv, _reqs), resps in zip(ok_servers,
+                                                        results):
+                    slots[slot_i] = (slots[slot_i][0], resps)
+        self.net.send(self.name, src, "client_read_reply", {
+            "rid": rid, "err": int(ErrorCode.ERR_OK), "result": slots})
 
     def _client_allowed(self, r, payload: dict) -> bool:
         """Auth + table-ACL gate (parity: the ACL gate leading the client

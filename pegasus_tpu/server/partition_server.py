@@ -894,13 +894,27 @@ class PartitionServer:
                              ) -> List[ScanResponse]:
         """Serve a batch of scans with per-block dedup.
 
-        Fast path requires the fully-compacted columnar store and plain
-        range scans (no filters/count-only) — the YCSB-E shape; anything
-        else falls back to per-request serving. Each UNIQUE block touched
-        by the batch gets one device predicate evaluation (cached device
-        uploads); per-request boundary trimming happens on the host
-        against the materialized keep mask, so shared blocks need no
-        per-scan device work at all."""
+        Fast path requires the columnar store (light write overlays
+        merge host-side) and plain range scans (no filters/count-only) —
+        the YCSB-E shape; anything else falls back to per-request
+        serving. Each UNIQUE block touched by the batch gets one device
+        predicate evaluation (cached device uploads); per-request
+        boundary trimming happens on the host against the materialized
+        keep mask, so shared blocks need no per-scan device work at
+        all. plan/finish split so a NODE-level coordinator can stack
+        blocks across partitions into one dispatch."""
+        state = self.plan_scan_batch(reqs)
+        if state is None:
+            return [self.on_get_scanner(r) for r in reqs]
+        if "precomputed" in state:  # read gate rejected the whole batch
+            return state["precomputed"]
+        keep_masks, expired_masks = self.eval_planned_masks(state)
+        return self.finish_scan_batch(state, keep_masks, expired_masks)
+
+    def plan_scan_batch(self, reqs: List[GetScannerRequest],
+                        now: Optional[int] = None):
+        """Phase 1: qualify + block planning. None = caller must serve
+        per-request."""
         t0 = time.perf_counter()
         gate = self._read_gate()
         if gate:
@@ -909,7 +923,7 @@ class PartitionServer:
                 resp = ScanResponse()
                 resp.error = gate
                 out.append(resp)
-            return out
+            return {"precomputed": out, "t0": t0}
         lsm = self.engine.lsm
         runs = lsm.l1_runs
         # a light write overlay (memtable + small L0s) must NOT evict the
@@ -931,8 +945,8 @@ class PartitionServer:
                       and not r.only_return_count
                       for r in reqs))
         if not simple:
-            return [self.on_get_scanner(r) for r in reqs]
-        now = epoch_now()
+            return None
+        now = epoch_now() if now is None else now
         none_f = FilterSpec.none()
         validate = validates.pop()
         overlay = self._overlay_snapshot(now, validate) \
@@ -972,12 +986,18 @@ class PartitionServer:
                 if budget <= 0:
                     break
             req_plans.append((req, start_key, stop_key, want, plan))
-        # 2 — ONE predicate evaluation per unique UNCACHED block (lazy,
-        # then one materialization wave); cached masks cost nothing
+        return {"reqs": reqs, "req_plans": req_plans, "unique": unique,
+                "validate": validate, "now": now, "overlay": overlay,
+                "none_f": none_f, "t0": t0}
+
+    def planned_misses(self, state) -> "OrderedDict[tuple, object]":
+        """Unique planned blocks whose masks are NOT cached (the device
+        work remaining); uploads happen here via the block cache."""
         keep_masks = {}
         expired_masks = {}
         misses: "OrderedDict[tuple, object]" = OrderedDict()
-        for ckey, (run, bm, blk) in unique.items():
+        now, validate = state["now"], state["validate"]
+        for ckey, (run, bm, blk) in state["unique"].items():
             mkey = (ckey, now, self.partition_version, validate)
             cached = self._mask_cache.get(mkey)
             if cached is not None:
@@ -985,14 +1005,39 @@ class PartitionServer:
                 keep_masks[ckey], expired_masks[ckey] = cached
                 continue
             misses[ckey] = self._device_cached_block(ckey, blk)
+        state["cached_keep"] = keep_masks
+        state["cached_expired"] = expired_masks
+        return misses
+
+    def store_mask(self, state, ckey, keep, expired) -> None:
+        self._mask_cache[(ckey, state["now"], self.partition_version,
+                          state["validate"])] = (keep, expired)
+        if len(self._mask_cache) > self._mask_cache_cap:
+            self._mask_cache.popitem(last=False)
+
+    def eval_planned_masks(self, state):
+        """Phase 2 (solo-node form): evaluate this partition's misses."""
+        misses = self.planned_misses(state)
+        keep_masks = state["cached_keep"]
+        expired_masks = state["cached_expired"]
         for ckey, keep, expired in self._eval_blocks_stacked(
-                misses, now, none_f, validate):
+                misses, state["now"], state["none_f"],
+                state["validate"]):
             keep_masks[ckey] = keep
             expired_masks[ckey] = expired
-            self._mask_cache[(ckey, now, self.partition_version,
-                              validate)] = (keep, expired)
-            if len(self._mask_cache) > self._mask_cache_cap:
-                self._mask_cache.popitem(last=False)
+            self.store_mask(state, ckey, keep, expired)
+        return keep_masks, expired_masks
+
+    def finish_scan_batch(self, state, keep_masks, expired_masks
+                          ) -> List[ScanResponse]:
+        """Phase 3: assemble responses from (shared) masks."""
+        if "precomputed" in state:
+            return state["precomputed"]
+        reqs = state["reqs"]
+        req_plans = state["req_plans"]
+        overlay = state["overlay"]
+        unique = state["unique"]
+        t0 = state["t0"]
         # 3 — assemble each response from the shared masks, merging the
         # host-side overlay in key order (overlay rows SHADOW base rows:
         # newest wins, tombstones hide)
@@ -1136,50 +1181,14 @@ class PartitionServer:
 
     def _eval_blocks_stacked(self, misses, now, none_f, validate):
         """Evaluate MANY blocks' predicates in as few device dispatches
-        as possible: blocks sharing a key width stack into one [B*cap, W]
-        program (records are independent — block boundaries carry no
-        meaning to the predicate). B pads to a power of two so each
-        (width, B-bucket) pair compiles once. On a high-RTT device link
-        this turns a dispatch per block into a dispatch per batch."""
-        import jax.numpy as jnp
+        as possible via the shared stacker (scan_coordinator): blocks
+        sharing (width, cap) become one [B*cap, W] program — records are
+        independent, so block boundaries carry no meaning there."""
+        from pegasus_tpu.server.scan_coordinator import stacked_block_eval
 
-        if not misses:
-            return
-        by_width: "OrderedDict[int, list]" = OrderedDict()
-        for ckey, dev in misses.items():
-            by_width.setdefault(int(dev.keys.shape[1]), []).append(
-                (ckey, dev))
-        for _w, group in by_width.items():
-            cap = int(group[0][1].keys.shape[0])
-            if len(group) == 1:
-                ckey, dev = group[0]
-                m = scan_block_predicate(
-                    dev, now, hash_filter=none_f, sort_filter=none_f,
-                    validate_hash=validate, pidx=self.pidx,
-                    partition_version=self.partition_version)
-                yield ckey, np.asarray(m.keep), np.asarray(m.expired)
-                continue
-            bucket = 1 << (len(group) - 1).bit_length()
-            padded = group + [group[0]] * (bucket - len(group))
-            from pegasus_tpu.ops.record_block import RecordBlock
-
-            stacked = RecordBlock(
-                jnp.concatenate([d.keys for _c, d in padded]),
-                jnp.concatenate([d.key_len for _c, d in padded]),
-                jnp.concatenate([d.hashkey_len for _c, d in padded]),
-                jnp.concatenate([d.expire_ts for _c, d in padded]),
-                jnp.concatenate([d.valid for _c, d in padded]),
-                (None if padded[0][1].hash_lo is None
-                 else jnp.concatenate([d.hash_lo for _c, d in padded])))
-            m = scan_block_predicate(
-                stacked, now, hash_filter=none_f, sort_filter=none_f,
-                validate_hash=validate, pidx=self.pidx,
-                partition_version=self.partition_version)
-            keep_all = np.asarray(m.keep)
-            exp_all = np.asarray(m.expired)
-            for i, (ckey, _d) in enumerate(group):
-                yield (ckey, keep_all[i * cap:(i + 1) * cap],
-                       exp_all[i * cap:(i + 1) * cap])
+        blocks = [(ckey, dev, self.pidx) for ckey, dev in misses.items()]
+        yield from stacked_block_eval(blocks, now, validate,
+                                      self.partition_version)
 
     def _device_cached_block(self, cache_key, blk):
         """The shared device-upload cache used by both scan paths."""

@@ -1,0 +1,147 @@
+"""Node-level cross-partition scan coordination.
+
+The SURVEY §2.6 dispatch model realized: partitions are the batch
+dimension of ONE device program. A node hosting many partitions of a
+table receives one multi-partition scan message, plans each partition's
+batch, stacks every uncached block ACROSS partitions (same key width →
+one [B*cap, W] program with a per-record partition-index column for the
+stale-split check), evaluates once, and hands each partition its masks
+back. Per-flush device dispatches drop from
+O(partitions × blocks) to O(key-width buckets).
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+from pegasus_tpu.ops.predicates import FilterSpec, scan_block_predicate
+
+
+def scan_multi(servers_and_reqs: List[Tuple[object, list]],
+               now: int) -> List[list]:
+    """[(PartitionServer, [GetScannerRequest])] -> [[ScanResponse]].
+
+    Partitions that cannot take the batched fast path (filters, big
+    overlay, gates) serve per-request; qualifying ones share one stacked
+    evaluation wave.
+    """
+    states = []
+    for server, reqs in servers_and_reqs:
+        state = server.plan_scan_batch(reqs, now=now)
+        states.append((server, reqs, state))
+
+    # gather misses across partitions; stacking requires a shared
+    # effective (validate, partition_version) — one table's partitions
+    # satisfy that; mixed groups fall back to per-server evaluation
+    flavor_groups: Dict[tuple, list] = {}
+    for server, reqs, state in states:
+        if state is None or "precomputed" in state:
+            continue
+        misses = server.planned_misses(state)
+        flavor = (state["validate"], server.partition_version)
+        for ckey, dev in misses.items():
+            flavor_groups.setdefault(flavor, []).append(
+                (server, state, ckey, dev))
+
+    for (validate, pv), entries in flavor_groups.items():
+        _eval_cross_partition(entries, now, validate, pv)
+
+    out = []
+    for server, reqs, state in states:
+        if state is None:
+            out.append([server.on_get_scanner(r) for r in reqs])
+        elif "precomputed" in state:
+            out.append(state["precomputed"])
+        else:
+            out.append(server.finish_scan_batch(
+                state, state["cached_keep"], state["cached_expired"]))
+    return out
+
+
+def stacked_block_eval(blocks, now: int, validate: bool, pv: int):
+    """The ONE stacking implementation both the per-partition and the
+    cross-partition paths use. `blocks`: [(tag, dev_block, pidx)] —
+    yields (tag, keep, expired). Buckets by (key width, capacity) so
+    differently-capped tail blocks can never misalign mask slices; the
+    padded count rounds to a power of two to bound compilations; a
+    stack mixing hash_lo and non-hash_lo blocks drops the precomputed
+    column (the kernel computes the hash on device instead)."""
+    import jax.numpy as jnp
+
+    from pegasus_tpu.ops.record_block import RecordBlock
+
+    none_f = FilterSpec.none()
+    buckets: "OrderedDict[tuple, list]" = OrderedDict()
+    for tag, dev, pidx in blocks:
+        key = (int(dev.keys.shape[1]), int(dev.keys.shape[0]))
+        buckets.setdefault(key, []).append((tag, dev, pidx))
+    for (_w, cap), group in buckets.items():
+        if len(group) == 1:
+            tag, dev, pidx = group[0]
+            m = scan_block_predicate(
+                dev, now, hash_filter=none_f, sort_filter=none_f,
+                validate_hash=validate, pidx=pidx,
+                partition_version=pv)
+            yield tag, np.asarray(m.keep), np.asarray(m.expired)
+            continue
+        # FIXED chunk size: exactly two compiled shapes per key width
+        # ([cap, W] and [STACK_CHUNK*cap, W]) — variable power-of-two
+        # buckets made every batch's stack a fresh XLA compile
+        for off in range(0, len(group), STACK_CHUNK):
+            yield from _eval_chunk(group[off:off + STACK_CHUNK], cap,
+                                   now, validate, pv, none_f)
+
+
+STACK_CHUNK = 16
+
+
+def _eval_chunk(group, cap, now, validate, pv, none_f):
+    import jax.numpy as jnp
+
+    from pegasus_tpu.ops.record_block import RecordBlock
+
+    if len(group) == 1:
+        tag, dev, pidx = group[0]
+        m = scan_block_predicate(
+            dev, now, hash_filter=none_f, sort_filter=none_f,
+            validate_hash=validate, pidx=pidx, partition_version=pv)
+        yield tag, np.asarray(m.keep), np.asarray(m.expired)
+        return
+    padded = group + [group[0]] * (STACK_CHUNK - len(group))
+    pidx_col = np.concatenate([
+        np.full(cap, pidx, dtype=np.uint32)
+        for _t, _d, pidx in padded])
+    all_hash_lo = all(d.hash_lo is not None for _t, d, _p in padded)
+    stacked = RecordBlock(
+        jnp.concatenate([d.keys for _t, d, _p in padded]),
+        jnp.concatenate([d.key_len for _t, d, _p in padded]),
+        jnp.concatenate([d.hashkey_len for _t, d, _p in padded]),
+        jnp.concatenate([d.expire_ts for _t, d, _p in padded]),
+        jnp.concatenate([d.valid for _t, d, _p in padded]),
+        (jnp.concatenate([d.hash_lo for _t, d, _p in padded])
+         if all_hash_lo else None))
+    m = scan_block_predicate(
+        stacked, now, hash_filter=none_f, sort_filter=none_f,
+        validate_hash=validate, pidx=pidx_col,
+        partition_version=pv)
+    keep_all = np.asarray(m.keep)
+    exp_all = np.asarray(m.expired)
+    for i, (tag, _d, _p) in enumerate(group):
+        yield (tag, keep_all[i * cap:(i + 1) * cap],
+               exp_all[i * cap:(i + 1) * cap])
+
+
+def _eval_cross_partition(entries, now: int, validate: bool,
+                          pv: int) -> None:
+    """Stack blocks from MANY partitions; each record carries its owning
+    partition index so one program validates all."""
+    blocks = [((server, state, ckey), dev, server.pidx)
+              for server, state, ckey, dev in entries]
+    for (server, state, ckey), keep, expired in stacked_block_eval(
+            blocks, now, validate, pv):
+        state["cached_keep"][ckey] = keep
+        state["cached_expired"][ckey] = expired
+        server.store_mask(state, ckey, keep, expired)
