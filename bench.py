@@ -279,7 +279,11 @@ def measure_scan_phase(jax, device, bc, n_ops, n_partitions, n_hashkeys,
     """reset -> warmup (compile + device block caches) -> measure."""
     with jax.default_device(device):
         bc.manual_compact_all()
-        run_scans(bc, 60, n_partitions, n_hashkeys, seed, insert_frac=0)
+        # warmup covers both compiled stack shapes AND the overlay path
+        # (inserts) so the measured phase pays no first-touch compiles
+        run_scans(bc, 120, n_partitions, n_hashkeys, seed, insert_frac=0)
+        run_scans(bc, 60, n_partitions, n_hashkeys, seed + 1)
+        bc.manual_compact_all()
         ops, recs, secs = run_scans(bc, n_ops, n_partitions,
                                     n_hashkeys, seed)
     return ops, recs, secs

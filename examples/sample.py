@@ -1,0 +1,43 @@
+"""Minimal client usage example (parity: src/sample/main.cpp).
+
+Run against a live onebox:
+    python -m pegasus_tpu.tools.onebox_cluster start --dir /tmp/box
+    python -m pegasus_tpu.tools.shell --cluster /tmp/box create_app demo -p 4
+    python examples/sample.py /tmp/box demo
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from pegasus_tpu.tools.onebox_cluster import connect  # noqa: E402
+
+
+def main() -> None:
+    cluster_dir, table = sys.argv[1], sys.argv[2]
+    client = connect(table, cluster_dir)
+
+    # basic set / get / delete
+    assert client.set(b"user:42", b"name", b"Ada") == 0
+    err, value = client.get(b"user:42", b"name")
+    print("get ->", err, value)
+
+    # multiple sort keys under one hash key + ranged read
+    client.multi_set(b"user:42", {b"city": b"Zurich", b"lang": b"py"})
+    err, kvs = client.multi_get(b"user:42")
+    print("multi_get ->", sorted(kvs.items()))
+
+    # TTL + counter
+    client.set(b"session:1", b"token", b"abc", ttl_seconds=60)
+    print("ttl ->", client.ttl(b"session:1", b"token"))
+    print("incr ->", client.incr(b"stats", b"visits", 1).new_value)
+
+    # full-table scan fan-out
+    total = sum(1 for sc in client.get_unordered_scanners(4) for _ in sc)
+    print("records in table:", total)
+
+
+if __name__ == "__main__":
+    main()
