@@ -274,7 +274,12 @@ class StorageEngine:
             # Stage 3 — expiry + stale-split drop on device (default_ttl=0:
             # the rewrite already happened; a rule that cleared a TTL must
             # not be re-stamped).
-            block = build_record_block(keys, ets_arr)
+            # power-of-two capacity bucket: arbitrary tail-batch sizes
+            # would each compile their own XLA program
+            cap = 1024
+            while cap < n:
+                cap <<= 1
+            block = build_record_block(keys, ets_arr, capacity=cap)
             drop, new_ets = compaction_filter_block(
                 np.asarray(block.keys), np.asarray(block.key_len),
                 np.asarray(block.hashkey_len), np.asarray(block.expire_ts),

@@ -315,13 +315,17 @@ class LSMStore:
         batch_keys: List[bytes] = []
         batch_vals: List[bytes] = []
         batch_ets: List[int] = []
+        # the FILTER batch is much larger than the write-block size: a
+        # high-RTT device pays per dispatch, so the compactor amortizes
+        # 16 blocks of records into each filter evaluation
+        filter_batch = self._block_capacity * 16
         for key, value, ets in merged:
             if value is None:  # tombstone: bottommost level -> drop
                 continue
             batch_keys.append(key)
             batch_vals.append(value)
             batch_ets.append(ets)
-            if len(batch_keys) >= self._block_capacity:
+            if len(batch_keys) >= filter_batch:
                 entry = submit(batch_keys, batch_vals, batch_ets)
                 if pending is not None:
                     drain(pending)
