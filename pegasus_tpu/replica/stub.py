@@ -369,6 +369,16 @@ class ReplicaStub:
                 "rid": rid, "err": gate, "results": []})
             return
         ops = [WriteOp(op, req) for op, req in payload["ops"]]
+        sgate = r.server._write_gate()
+        if sgate:
+            # deny/throttle rejections are STORAGE statuses per op (the
+            # standalone handlers return TryAgain the same way), not
+            # framework routing errors — the caller must see them, not
+            # retry into them
+            self.net.send(self.name, src, "client_write_reply", {
+                "rid": rid, "err": int(ErrorCode.ERR_OK),
+                "results": [sgate] * len(ops)})
+            return
 
         def reply(results) -> None:
             self.net.send(self.name, src, "client_write_reply", {

@@ -186,3 +186,39 @@ def test_scan_multi_matches_per_partition(cluster):
         got = results[pidx][0]
         assert [(kv.key, kv.value) for kv in got.kvs] == \
             [(kv.key, kv.value) for kv in solo.kvs], pidx
+
+
+def test_throttle_and_deny_envs_over_cluster(cluster):
+    """function_test/throttle parity: per-table deny and reject-mode
+    write throttling propagate through meta envs and gate the replicated
+    write path."""
+    cluster.create_table("th", partition_count=2)
+    c = cluster.client("th")
+    assert c.set(b"a", b"s", b"v") == OK
+    # deny all client requests
+    cluster.meta.update_app_envs(
+        "th", {"replica.deny_client_request": "reject*all"})
+    cluster.step()
+    from pegasus_tpu.utils.errors import PegasusError, StorageStatus
+
+    try:
+        err = c.set(b"b", b"s", b"v")
+        assert err == int(StorageStatus.TRY_AGAIN)
+    except PegasusError:
+        pass  # retries exhausted is equally a rejection
+    # lift the deny; writes flow again
+    cluster.meta.update_app_envs("th",
+                                 {"replica.deny_client_request": ""})
+    cluster.step()
+    assert c.set(b"b", b"s", b"v") == OK
+    # reject-mode throttling: 1 request burst then TryAgain
+    cluster.meta.update_app_envs(
+        "th", {"replica.write_throttling": "1*reject*0"})
+    cluster.step()
+    results = []
+    for i in range(6):
+        try:
+            results.append(c.set(b"t%d" % i, b"s", b"v"))
+        except PegasusError:
+            results.append(-1)
+    assert any(r != OK for r in results), results
