@@ -303,6 +303,23 @@ class PegasusClient:
                               lambda s, ph: s.on_check_and_mutate(
                                   req, partition_hash=ph))
 
+    @property
+    def partition_count(self) -> int:
+        return self._table.partition_count
+
+    def scan_multi(self, groups):
+        """Batched scans for many partitions (in-process form): the
+        node-level coordinator stacks every partition's blocks into one
+        device evaluation — same API shape as the cluster client's."""
+        from pegasus_tpu.base.value_schema import epoch_now
+        from pegasus_tpu.server.scan_coordinator import scan_multi
+
+        pairs = [(self._table.partitions[pidx], reqs)
+                 for pidx, reqs in groups.items()]
+        results = scan_multi(pairs, epoch_now())
+        return {pidx: resps for (pidx, _reqs), resps
+                in zip(groups.items(), results)}
+
     # ---- scanners -----------------------------------------------------
 
     def get_scanner(self, hash_key: bytes, start_sortkey: bytes = b"",

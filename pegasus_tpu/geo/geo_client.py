@@ -125,19 +125,14 @@ class GeoClient:
         cand_keys: List[Tuple[bytes, bytes, bytes]] = []
         cand_lat: List[float] = []
         cand_lng: List[float] = []
-        for cell in cells:
-            # one hashkey-scoped scan per covering cell (the reference
-            # fans these out in parallel; scans here are already batched
-            # device dispatches per partition)
-            scanner = self.index.get_scanner(cell.encode())
-            for _ih, isk, value in scanner:
-                coord = self.codec.decode(value)
-                if coord is None:
-                    continue
-                hk, sk = self._restore_raw_keys(isk)
-                cand_keys.append((hk, sk, value))
-                cand_lat.append(coord[0])
-                cand_lng.append(coord[1])
+        for _ih, isk, value in self._scan_cells(cells):
+            coord = self.codec.decode(value)
+            if coord is None:
+                continue
+            hk, sk = self._restore_raw_keys(isk)
+            cand_keys.append((hk, sk, value))
+            cand_lat.append(coord[0])
+            cand_lng.append(coord[1])
         if not cand_keys:
             return []
         # exact-distance filtering: ONE device dispatch for the batch
@@ -150,6 +145,59 @@ class GeoClient:
         if count >= 0:
             out = out[:count]
         return out
+
+    def _scan_cells(self, cells):
+        """All covering cells' index rows. When the index client batches
+        (scan_multi), every cell's FIRST page rides one coalesced
+        request wave — one stacked device evaluation per node — with
+        per-cell paging only for overflowing cells; otherwise one
+        scanner per cell (the reference's parallel fan-out shape)."""
+        scan_multi = getattr(self.index, "scan_multi", None)
+        if scan_multi is None:
+            for cell in cells:
+                for row in self.index.get_scanner(cell.encode()):
+                    yield row
+            return
+        from pegasus_tpu.base.key_schema import (
+            generate_key,
+            generate_next_bytes,
+            key_hash_parts,
+            restore_key,
+        )
+        from pegasus_tpu.server.types import GetScannerRequest
+
+        pcount = getattr(self.index, "partition_count", None)
+        if not pcount:
+            self.index.refresh_config()
+            pcount = self.index.partition_count
+        groups: dict = {}
+        for cell in cells:
+            hk = cell.encode()
+            req = GetScannerRequest(
+                start_key=generate_key(hk, b""),
+                stop_key=generate_next_bytes(hk),
+                stop_inclusive=False, batch_size=1000,
+                validate_partition_hash=True)
+            groups.setdefault(key_hash_parts(hk) % pcount,
+                              []).append((hk, req))
+        results = scan_multi({p: [r for _hk, r in reqs]
+                              for p, reqs in groups.items()})
+        for pidx, reqs in groups.items():
+            for (hk, _req), resp in zip(reqs, results[pidx]):
+                for kv in resp.kvs:
+                    rhk, rsk = restore_key(kv.key)
+                    yield rhk, rsk, kv.value
+                if resp.context_id >= 0:
+                    # rare: a cell overflowing the first page keeps its
+                    # own scanner for the tail
+                    from pegasus_tpu.client.client import ScanOptions
+
+                    tail = self.index.get_scanner(
+                        hk, options=ScanOptions(batch_size=1000))
+                    seen = len(resp.kvs)
+                    for i, row in enumerate(tail):
+                        if i >= seen:
+                            yield row
 
     def search_radial_by_key(self, hash_key: bytes, sort_key: bytes,
                              radius_m: float, count: int = -1
