@@ -48,6 +48,20 @@ def _err_of(resp) -> int:
     return resp.error
 
 
+def make_hashkey_scan_request(hash_key: bytes, batch_size: int = 1000,
+                              validate_partition_hash: bool = True):
+    """The one place the hashkey-range scan request shape lives (both
+    clients' get_scanner and the geo batched path build from here)."""
+    from pegasus_tpu.base.key_schema import generate_next_bytes
+    from pegasus_tpu.server.types import GetScannerRequest
+
+    return GetScannerRequest(
+        start_key=generate_key(hash_key, b""),
+        stop_key=generate_next_bytes(hash_key),
+        stop_inclusive=False, batch_size=batch_size,
+        validate_partition_hash=validate_partition_hash)
+
+
 @dataclass
 class ScanOptions:
     """Parity: pegasus_client::scan_options (client.h)."""
@@ -306,6 +320,13 @@ class PegasusClient:
     @property
     def partition_count(self) -> int:
         return self._table.partition_count
+
+    def scan_page(self, pidx: int, context_id: int):
+        """Continue a server-held scan context (batched-path paging)."""
+        return self._table.partitions[pidx].on_scan(context_id)
+
+    def scan_abort(self, pidx: int, context_id: int) -> None:
+        self._table.partitions[pidx].on_clear_scanner(context_id)
 
     def scan_multi(self, groups):
         """Batched scans for many partitions (in-process form): the

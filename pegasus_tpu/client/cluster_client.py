@@ -420,6 +420,16 @@ class ClusterClient:
                                f"unreachable")
         return out
 
+    def scan_page(self, pidx: int, context_id: int):
+        """Continue a server-held scan context (batched-path paging)."""
+        return self._read("scan", context_id, pidx)
+
+    def scan_abort(self, pidx: int, context_id: int) -> None:
+        try:
+            self._read("clear_scanner", context_id, pidx)
+        except PegasusError:
+            pass
+
     # ---- scanners ------------------------------------------------------
 
     def get_scanner(self, hash_key: bytes, start_sortkey: bytes = b"",

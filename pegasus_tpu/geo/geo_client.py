@@ -158,13 +158,8 @@ class GeoClient:
                 for row in self.index.get_scanner(cell.encode()):
                     yield row
             return
-        from pegasus_tpu.base.key_schema import (
-            generate_key,
-            generate_next_bytes,
-            key_hash_parts,
-            restore_key,
-        )
-        from pegasus_tpu.server.types import GetScannerRequest
+        from pegasus_tpu.base.key_schema import key_hash_parts, restore_key
+        from pegasus_tpu.client.client import make_hashkey_scan_request
 
         pcount = getattr(self.index, "partition_count", None)
         if not pcount:
@@ -173,31 +168,34 @@ class GeoClient:
         groups: dict = {}
         for cell in cells:
             hk = cell.encode()
-            req = GetScannerRequest(
-                start_key=generate_key(hk, b""),
-                stop_key=generate_next_bytes(hk),
-                stop_inclusive=False, batch_size=1000,
-                validate_partition_hash=True)
+            req = make_hashkey_scan_request(hk, batch_size=1000)
             groups.setdefault(key_hash_parts(hk) % pcount,
                               []).append((hk, req))
         results = scan_multi({p: [r for _hk, r in reqs]
                               for p, reqs in groups.items()})
         for pidx, reqs in groups.items():
             for (hk, _req), resp in zip(reqs, results[pidx]):
+                if resp.error != int(StorageStatus.OK):
+                    # a denied/throttled partition must not read as
+                    # "no nearby points" — match the scanner path
+                    raise RuntimeError(
+                        f"geo cell scan failed: error {resp.error}")
                 for kv in resp.kvs:
                     rhk, rsk = restore_key(kv.key)
                     yield rhk, rsk, kv.value
-                if resp.context_id >= 0:
-                    # rare: a cell overflowing the first page keeps its
-                    # own scanner for the tail
-                    from pegasus_tpu.client.client import ScanOptions
-
-                    tail = self.index.get_scanner(
-                        hk, options=ScanOptions(batch_size=1000))
-                    seen = len(resp.kvs)
-                    for i, row in enumerate(tail):
-                        if i >= seen:
-                            yield row
+                # overflowing cells RESUME the server-held context (no
+                # re-scan of served rows, no positional skipping, no
+                # leaked context)
+                cid = resp.context_id
+                while cid >= 0:
+                    page = self.index.scan_page(pidx, cid)
+                    if page.error != int(StorageStatus.OK):
+                        raise RuntimeError(
+                            f"geo cell scan failed: error {page.error}")
+                    for kv in page.kvs:
+                        rhk, rsk = restore_key(kv.key)
+                        yield rhk, rsk, kv.value
+                    cid = page.context_id
 
     def search_radial_by_key(self, hash_key: bytes, sort_key: bytes,
                              radius_m: float, count: int = -1

@@ -122,3 +122,26 @@ def test_geo_over_replicated_cluster(tmp_path):
                                               for i in range(6)}
     finally:
         cluster.close()
+
+
+def test_geo_overflowing_cell_pages_through_context(tmp_path):
+    """A covering cell with more points than one page must surface ALL
+    of them, resuming the server-held scan context (review regression:
+    the tail used to re-scan positionally and could skip/duplicate)."""
+    geo, raw, idx = make_geo(tmp_path, partitions=2)
+    try:
+        # ~1500 points in a tight 30m blob -> one covering cell, >1 page
+        import random
+
+        rng = random.Random(3)
+        for i in range(1500):
+            la = 40.0 + rng.uniform(-0.00013, 0.00013)
+            ln = -74.0 + rng.uniform(-0.00013, 0.00013)
+            assert geo.set(b"blob%05d" % i, b"s",
+                           b"%f|%f|x" % (la, ln)) == 0
+        hits = geo.search_radial(40.0, -74.0, 100)
+        assert len(hits) == 1500
+        assert len({h.hash_key for h in hits}) == 1500  # no duplicates
+    finally:
+        raw.close()
+        idx.close()
