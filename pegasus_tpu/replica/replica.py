@@ -533,6 +533,18 @@ class Replica:
         dup_floors: Dict[bytes, int] = {}
         cu = self.server.cu  # capacity-unit metering (parity: every
         # write handler feeds capacity_unit_calculator.h:62-104)
+        hc = self.server.hotkey_collectors["write"]
+        if hc.state.value != "stopped":
+            from pegasus_tpu.base.key_schema import restore_key as _rk
+
+            hks = []
+            for wo in mu.ops:
+                if wo.op in (OP_PUT, OP_REMOVE, OP_DUP_PUT,
+                             OP_DUP_REMOVE):
+                    hks.append(_rk(wo.request[0])[0])
+                elif wo.op in (OP_MULTI_PUT, OP_MULTI_REMOVE):
+                    hks.append(wo.request.hash_key)
+            hc.capture(hks)
         for wo in mu.ops:
             if wo.op == OP_PUT:
                 key, user_data, expire_ts = wo.request

@@ -183,6 +183,30 @@ class ReplicaStub:
             "replica.migrate", migrate,
             "replica.migrate <app_id> <pidx> <dest_data_dir>")
 
+        def hotkey(args):
+            """hotkey <start|query|stop> <app_id> <pidx> <read|write>
+            (parity: on_detect_hotkey, pegasus_server_impl.h:470)."""
+            action, app_id, pidx, kind = (args[0], int(args[1]),
+                                          int(args[2]), args[3])
+            r = self.replicas.get((app_id, pidx))
+            if r is None:
+                raise ValueError(f"replica {(app_id, pidx)} not here")
+            hc = r.server.hotkey_collectors[kind]
+            if action == "start":
+                hc.start()
+                return "started"
+            if action == "stop":
+                hc.stop()
+                return "stopped"
+            result = hc.result
+            return {"state": hc.state.value,
+                    "hot_key": result.decode(errors="replace")
+                    if result else None}
+
+        self.commands.register(
+            "hotkey", hotkey,
+            "hotkey <start|query|stop> <app_id> <pidx> <read|write>")
+
     def close(self) -> None:
         for r in self.replicas.values():
             r.close()

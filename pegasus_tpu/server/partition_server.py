@@ -122,6 +122,13 @@ class PartitionServer:
         from pegasus_tpu.utils.latency_tracer import SlowQueryLog
 
         self.slow_log = SlowQueryLog()
+        # on-demand hotkey detection (parity: hotkey_collector.h:93 —
+        # started via on_detect_hotkey; the request stream feeds capture
+        # while a detection runs, else a None-check costs nothing)
+        from pegasus_tpu.server.hotkey import HotkeyCollector
+
+        self.hotkey_collectors = {"read": HotkeyCollector(),
+                                  "write": HotkeyCollector()}
         # device-resident block cache: hot SST blocks stay in device memory
         # across scans (the HBM analogue of RocksDB's block cache), keyed by
         # (sst path, block offset) which is immutable per file
@@ -282,6 +289,11 @@ class PartitionServer:
         gate = self._write_gate()
         if gate:
             return gate
+        hc = self.hotkey_collectors["write"]
+        if hc.state.value != "stopped":
+            from pegasus_tpu.base.key_schema import restore_key
+
+            hc.capture([restore_key(key)[0]])
         with self._write_lock:
             gate = self._hash_gate(partition_hash)
             if gate:
@@ -399,6 +411,11 @@ class PartitionServer:
                partition_hash: Optional[int] = None) -> Tuple[int, bytes]:
         """Parity: on_get (pegasus_server_impl.cpp:418): expired records are
         NotFound and counted as abnormal reads."""
+        hc = self.hotkey_collectors["read"]
+        if hc.state.value != "stopped":
+            from pegasus_tpu.base.key_schema import restore_key
+
+            hc.capture([restore_key(key)[0]])
         gate = self._read_gate() or self._hash_gate(partition_hash)
         if gate:
             return gate, b""
@@ -707,6 +724,7 @@ class PartitionServer:
 
     def on_multi_get(self, req: MultiGetRequest) -> MultiGetResponse:
         """Parity: on_multi_get (pegasus_server_impl.cpp:496)."""
+        self.hotkey_collectors["read"].capture([req.hash_key])
         t0 = time.perf_counter()
         try:
             return self._on_multi_get(req)

@@ -196,3 +196,39 @@ def test_balancer_simulator_property():
         for app_id, counts in per_app.items():
             assert max(counts.values()) - min(counts.values()) <= 1, (
                 trial, app_id, counts)
+
+
+def test_hotkey_detection_wired_into_serving(tmp_path):
+    """on_detect_hotkey parity: start detection on a partition, drive a
+    skewed workload through the REPLICATED paths, query the hot key."""
+    from pegasus_tpu.tools.cluster import SimCluster
+
+    cluster = SimCluster(str(tmp_path / "c"), n_nodes=2)
+    try:
+        app_id = cluster.create_table("hot", partition_count=1,
+                                      replica_count=1)
+        c = cluster.client("hot")
+        pc = cluster.meta.state.get_partition(app_id, 0)
+        stub = cluster.stubs[pc.primary]
+        assert stub.commands.call(
+            "hotkey", ["start", str(app_id), "0", "write"]) == "started"
+        # skewed writes: one hashkey dominates
+        for i in range(400):
+            hk = b"whale" if i % 4 else b"minnow%d" % i
+            assert c.set(hk, b"s%03d" % i, b"v") == 0
+        out = stub.commands.call("hotkey",
+                                 ["query", str(app_id), "0", "write"])
+        assert out["state"] == "finished" and out["hot_key"] == "whale"
+        # read-side detection over point gets
+        assert stub.commands.call(
+            "hotkey", ["start", str(app_id), "0", "read"]) == "started"
+        for i in range(400):
+            hk = b"whale" if i % 4 else b"minnow%d" % (i % 40)
+            c.get(hk, b"s%03d" % (i if i % 4 == 0 else 0))
+        out = stub.commands.call("hotkey",
+                                 ["query", str(app_id), "0", "read"])
+        assert out["state"] in ("finished", "fine", "coarse")
+        if out["state"] == "finished":
+            assert out["hot_key"] == "whale"
+    finally:
+        cluster.close()
