@@ -1,0 +1,97 @@
+"""Model-based consistency fuzzing: random ops against the replicated
+cluster, checked against an in-memory reference model, with random
+failovers injected — the deterministic-simulator complement to the
+kill-test harness (same spirit as the reference's seeded schedule
+exploration, env.sim.h:36).
+
+Every acked mutation updates the model; reads must match the model
+exactly (linearizable single-client view). Unacked mutations may or may
+not have applied — the model forks on ambiguity and reads collapse it.
+"""
+
+import random
+
+import pytest
+
+from pegasus_tpu.tools.cluster import SimCluster
+from pegasus_tpu.utils.errors import PegasusError, StorageStatus
+
+OK = int(StorageStatus.OK)
+NOT_FOUND = int(StorageStatus.NOT_FOUND)
+
+
+@pytest.mark.parametrize("seed", [11, 23, 47])
+def test_randomized_ops_match_model(tmp_path, seed):
+    rng = random.Random(seed)
+    cluster = SimCluster(str(tmp_path / f"c{seed}"), n_nodes=4,
+                         seed=seed)
+    try:
+        cluster.create_table("fuzz", partition_count=4)
+        c = cluster.client("fuzz")
+        model = {}          # (hk, sk) -> value
+        ambiguous = {}      # (hk, sk) -> set of possible values
+        hks = [b"h%02d" % i for i in range(8)]
+        sks = [b"s%02d" % i for i in range(6)]
+        killed = []
+
+        for step in range(400):
+            op = rng.random()
+            hk, sk = rng.choice(hks), rng.choice(sks)
+            key = (hk, sk)
+            if op < 0.40:  # write
+                value = b"v%d" % step
+                try:
+                    if c.set(hk, sk, value) == OK:
+                        model[key] = value
+                        ambiguous.pop(key, None)
+                    else:
+                        ambiguous.setdefault(key, set()).add(value)
+                except PegasusError:
+                    ambiguous.setdefault(key, set()).add(value)
+            elif op < 0.50:  # delete
+                try:
+                    if c.delete(hk, sk) == OK:
+                        model.pop(key, None)
+                        ambiguous.pop(key, None)
+                    else:
+                        ambiguous.setdefault(key, set()).add(None)
+                except PegasusError:
+                    ambiguous.setdefault(key, set()).add(None)
+            elif op < 0.90:  # read, checked against the model
+                try:
+                    err, got = c.get(hk, sk)
+                except PegasusError:
+                    continue  # unavailable; no consistency claim
+                if key in ambiguous:
+                    # collapse the ambiguity to what the cluster holds
+                    allowed = set(ambiguous.pop(key))
+                    allowed.add(model.get(key))
+                    observed = got if err == OK else None
+                    assert observed in allowed, (
+                        step, key, observed, allowed)
+                    if observed is None:
+                        model.pop(key, None)
+                    else:
+                        model[key] = observed
+                elif key in model:
+                    assert (err, got) == (OK, model[key]), (step, key)
+                else:
+                    assert err == NOT_FOUND, (step, key, got)
+            elif op < 0.95 and len(killed) < 2:  # chaos: kill a node
+                alive = [n for n in cluster.stubs
+                         if n not in cluster._dead]
+                if len(alive) > 2:
+                    victim = rng.choice(alive)
+                    cluster.kill(victim)
+                    killed.append(victim)
+            else:  # let the cluster breathe / cure
+                cluster.step()
+
+        # final sweep: every unambiguous model entry must read back
+        cluster.step(rounds=4)
+        for (hk, sk), value in sorted(model.items()):
+            if (hk, sk) in ambiguous:
+                continue
+            assert c.get(hk, sk) == (OK, value), (hk, sk)
+    finally:
+        cluster.close()
