@@ -22,6 +22,10 @@ Case grammar (one `verb: args` per line; '#' comments):
     expect_ballot_ge: <pidx> <n>                ballot monotonicity
     expect_consistent: <hk> <sk>                every member agrees
     fail_point: <name> <action>                 e.g. node1::plog_append raise(io)
+    split: <table>                              start the online 2x split
+    expect_partition_count: <table> <n>         (after steps) count settled
+    dup: <master> <follower>                    add duplication
+    expect_follower_read: <follower> <hk> <sk> <value>
 """
 
 from __future__ import annotations
@@ -58,6 +62,7 @@ class ActRunner:
         self.cluster = SimCluster(data_dir, n_nodes=n_nodes, seed=seed)
         self.client = None
         self.app_id: Optional[int] = None
+        self._follower_clients: dict = {}
 
     def close(self) -> None:
         from pegasus_tpu.utils.fail_point import FAIL_POINTS
@@ -84,10 +89,15 @@ class ActRunner:
         c = self.cluster
         if verb == "create":
             kw = dict(kv.split("=") for kv in args[1:])
-            self.app_id = c.create_table(
+            app_id = c.create_table(
                 args[0], partition_count=int(kw.get("partitions", 4)),
                 replica_count=int(kw.get("replicas", 3)))
-            self.client = c.client(args[0])
+            if self.client is None:
+                # the FIRST table is the case's subject; later creates
+                # (dup followers etc.) are reached via their own verbs
+                self.app_id = app_id
+                self.client = c.client(args[0])
+                self.table_name = args[0]
         elif verb == "set":
             hk, sk, value = (a.encode() for a in args)
             err = self.client.set(hk, sk, value)
@@ -125,6 +135,30 @@ class ActRunner:
 
             FAIL_POINTS.setup()
             FAIL_POINTS.cfg(args[0], " ".join(args[1:]))
+        elif verb == "split":
+            c.meta.split.start_partition_split(args[0])
+        elif verb == "expect_partition_count":
+            app = c.meta.state.find_app(args[0])
+            if app is None or app.partition_count != int(args[1]):
+                raise ActError(
+                    f"partition_count "
+                    f"{app.partition_count if app else None}, "
+                    f"wanted {args[1]}")
+        elif verb == "dup":
+            c.meta.duplication.add_duplication(args[0], "meta", args[1])
+        elif verb == "expect_follower_read":
+            fc = self._follower_clients.get(args[0])
+            if fc is None:
+                # NOT setdefault: its eagerly-evaluated default would
+                # register a fresh client over the same transport name
+                # each call, stealing replies from the kept instance
+                fc = c.client(args[0], name=f"act-f-{args[0]}")
+                self._follower_clients[args[0]] = fc
+            hk, sk, want = (a.encode() for a in args[1:])
+            err, value = fc.get(hk, sk)
+            if err != OK or value != want:
+                raise ActError(f"follower got (err={err}, {value!r}), "
+                               f"wanted {want!r}")
         elif verb == "step":
             c.step(rounds=int(args[0]) if args else 1)
         elif verb == "expect_primary_not":
