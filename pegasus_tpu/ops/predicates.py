@@ -45,16 +45,29 @@ class FilterSpec(NamedTuple):
 
     @staticmethod
     def make(filter_type: int, pattern: bytes = b"") -> "FilterSpec":
-        width = next_bucket(len(pattern))
-        buf = np.zeros(width, dtype=np.uint8)
-        if pattern:
-            buf[:len(pattern)] = np.frombuffer(pattern, dtype=np.uint8)
-        return FilterSpec(int(filter_type), jnp.asarray(buf),
-                          jnp.asarray(len(pattern), jnp.int32))
+        return _make_cached(int(filter_type), bytes(pattern),
+                            jax.config.jax_default_device)
 
     @staticmethod
     def none() -> "FilterSpec":
-        return FilterSpec.make(FT_NO_FILTER)
+        return _make_cached(FT_NO_FILTER, b"",
+                            jax.config.jax_default_device)
+
+
+@functools.lru_cache(maxsize=256)
+def _make_cached(filter_type: int, pattern: bytes, _device) -> FilterSpec:
+    """FilterSpec fields are immutable (jax arrays), so identical
+    filters share one device copy — on a remote accelerator each
+    cache hit saves two host->device transfers per scan batch.
+    Keyed by the ambient default device so a multi-backend process
+    (e.g. bench.py's accel phase vs cpu-baseline phase) never leaks
+    one backend's arrays into the other's dispatches."""
+    width = next_bucket(len(pattern))
+    buf = np.zeros(width, dtype=np.uint8)
+    if pattern:
+        buf[:len(pattern)] = np.frombuffer(pattern, dtype=np.uint8)
+    return FilterSpec(filter_type, jnp.asarray(buf),
+                      jnp.asarray(len(pattern), jnp.int32))
 
 
 def match_filter(keys: jax.Array, region_start: jax.Array,

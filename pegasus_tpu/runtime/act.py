@@ -97,7 +97,6 @@ class ActRunner:
                 # (dup followers etc.) are reached via their own verbs
                 self.app_id = app_id
                 self.client = c.client(args[0])
-                self.table_name = args[0]
         elif verb == "set":
             hk, sk, value = (a.encode() for a in args)
             err = self.client.set(hk, sk, value)

@@ -36,6 +36,7 @@ from pegasus_tpu.base.value_schema import (
     extract_expire_ts,
     extract_user_data,
     expire_ts_from_ttl,
+    header_length,
 )
 from pegasus_tpu.ops.predicates import FT_NO_FILTER, FilterSpec, scan_block_predicate
 from pegasus_tpu.ops.record_block import build_record_block
@@ -1096,8 +1097,35 @@ class PartitionServer:
                 ov_hi = bisect.bisect_left(overlay_keys, frontier,
                                            ov_lo, ov_hi)
             ov_i = ov_lo
-            base = base_rows()
-            base_item = next(base, None)
+            if ov_lo >= ov_hi:
+                # fast path: no overlay rows shadow this window, so the
+                # kept base rows ARE the answer — take them in order
+                # without the per-record merge machinery
+                base = iter(())
+                base_item = None
+                hdr = header_length(self.data_version)
+                for ckey, blk, lo, hi in plan:
+                    hit = np.flatnonzero(keep_masks[ckey][lo:hi])
+                    take = (hit[:want - len(records)] + lo).tolist()
+                    keys_m, kl = blk.keys, blk.key_len
+                    ets = blk.expire_ts
+                    if req.no_value:
+                        records.extend(
+                            (keys_m[i, :kl[i]].tobytes(), b"",
+                             int(ets[i])) for i in take)
+                    else:
+                        vo, heap = blk.value_offs, blk.value_heap
+                        records.extend(
+                            (keys_m[i, :kl[i]].tobytes(),
+                             heap[vo[i] + hdr:vo[i + 1]],
+                             int(ets[i])) for i in take)
+                    if len(records) >= want:
+                        resume_key = _after(records[-1][0])
+                        stop_early = True
+                        break
+            else:
+                base = base_rows()
+                base_item = next(base, None)
             while len(records) < want:
                 ov_key = overlay_keys[ov_i] if ov_i < ov_hi else None
                 if base_item is None and ov_key is None:
