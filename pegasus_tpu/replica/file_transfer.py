@@ -22,6 +22,8 @@ from __future__ import annotations
 
 import itertools
 import os
+
+from pegasus_tpu.storage.efile import logical_size, open_data_file
 from typing import Callable, List, Optional, Tuple
 
 CHUNK_SIZE = 1 << 20
@@ -58,7 +60,7 @@ class TransferServer:
             full = os.path.join(path, name)
             if os.path.isfile(full):
                 files.append({"name": name,
-                              "size": os.path.getsize(full)})
+                              "size": logical_size(full)})
         self.net.send(self.name, src, "list_dir_reply", {
             "rid": rid, "err": 0, "files": files})
 
@@ -69,10 +71,10 @@ class TransferServer:
             self.net.send(self.name, src, "fetch_chunk_reply", {
                 "rid": rid, "err": 1, "data": b"", "eof": True})
             return
-        with open(path, "rb") as f:
+        with open_data_file(path, "rb") as f:
             f.seek(payload["offset"])
             data = f.read(payload["length"])
-            eof = f.tell() >= os.path.getsize(path)
+            eof = f.tell() >= logical_size(path)
         self.net.send(self.name, src, "fetch_chunk_reply", {
             "rid": rid, "err": 0, "data": data, "eof": eof})
 
@@ -162,10 +164,10 @@ class FileFetchSession:
         while self._file_idx < len(self._files):
             f = self._files[self._file_idx]
             if f["size"] == 0:
-                open(os.path.join(self.local_dir, f["name"]), "wb").close()
+                open_data_file(os.path.join(self.local_dir, f["name"]), "wb").close()
                 self._file_idx += 1
                 continue
-            self._fh = open(os.path.join(self.local_dir, f["name"]), "wb")
+            self._fh = open_data_file(os.path.join(self.local_dir, f["name"]), "wb")
             self._offset = 0
             self._send_chunk_req()
             return

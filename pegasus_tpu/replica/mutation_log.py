@@ -13,6 +13,8 @@ recovery contract as the storage WAL.
 from __future__ import annotations
 
 import os
+
+from pegasus_tpu.storage.efile import open_data_file, repair_truncate
 import struct
 from typing import Iterator, List, Optional, Tuple
 
@@ -30,9 +32,8 @@ class MutationLog:
         # at a fixed offset in the mutation header — no full decode needed)
         valid_end, self.max_decree = self._scan(path)
         if valid_end is not None:
-            with open(path, "r+b") as f:
-                f.truncate(valid_end)
-        self._f = open(path, "ab")
+            repair_truncate(path, valid_end)
+        self._f = open_data_file(path, "ab")
         # bumped whenever the file is rewritten (gc): readers holding byte
         # offsets must restart from 0 when the generation changes
         self.generation = 0
@@ -42,7 +43,7 @@ class MutationLog:
         """Returns (truncate_to | None-if-clean, max_decree)."""
         if not os.path.exists(path):
             return None, 0
-        with open(path, "rb") as f:
+        with open_data_file(path, "rb") as f:
             data = f.read()
         pos = 0
         max_decree = 0
@@ -69,7 +70,7 @@ class MutationLog:
     def replay(path: str) -> Iterator[Mutation]:
         if not os.path.exists(path):
             return
-        with open(path, "rb") as f:
+        with open_data_file(path, "rb") as f:
             data = f.read()
         pos = 0
         while pos + _FRAME.size <= len(data):
@@ -107,7 +108,7 @@ class MutationLog:
         stop mid-batch WITHOUT skipping unprocessed frames — it resumes
         from the last frame it actually consumed. Callers re-tail from 0
         when `generation` changes."""
-        with open(self.path, "rb") as f:
+        with open_data_file(self.path, "rb") as f:
             f.seek(offset)
             data = f.read()
         out: List[Tuple[Mutation, int]] = []
@@ -137,7 +138,7 @@ class MutationLog:
         keep = [mu for mu in self.replay(self.path)
                 if mu.decree > durable_decree]
         tmp = self.path + ".gc.tmp"
-        with open(tmp, "wb") as f:
+        with open_data_file(tmp, "wb") as f:
             for mu in keep:
                 blob = mu.encode()
                 f.write(_FRAME.pack(len(blob), crc32(blob)))
@@ -156,7 +157,7 @@ class MutationLog:
                 os.close(dir_fd)
         finally:
             self._f.close()
-            self._f = open(self.path, "ab")
+            self._f = open_data_file(self.path, "ab")
             self.generation += 1
 
     def close(self) -> None:

@@ -46,6 +46,30 @@ class ReplicaStub:
         dirs = [data_dir] if isinstance(data_dir, str) else list(data_dir)
         self.fs = FsManager(dirs)
         self.data_dir = dirs[0]
+        if os.environ.get("PEGASUS_ENCRYPT_AT_REST") == "1":
+            # at-rest encryption (parity: FLAGS_encrypt_data_at_rest +
+            # kms_key_provider): each data dir becomes an encryption
+            # zone keyed by one per-server data key, wrapped by the
+            # KMS root and stored beside the data it protects
+            from pegasus_tpu.security.kms import (
+                KeyProvider, LocalKmsClient, root_key_from_env)
+            from pegasus_tpu.storage.efile import enable_encryption
+
+            root = root_key_from_env()
+            if root is None:
+                # fail LOUDLY: a silent built-in fallback root would let
+                # a cluster believe its disks are protected while the
+                # key sits in the source tree
+                raise RuntimeError(
+                    "PEGASUS_ENCRYPT_AT_REST=1 requires PEGASUS_KMS_"
+                    "ROOT_KEY (hex) or PEGASUS_KMS_ROOT_KEY_FILE")
+            kms = LocalKmsClient(root)
+            # ONE data key per server, shared by all its data dirs:
+            # disk-migrate raw-copies files between dirs, which must
+            # stay decryptable at the destination
+            provider = KeyProvider(dirs[0], kms)
+            for d in dirs:
+                enable_encryption(d, provider)
         self.net = net
         self.clock = clock
         # FD timeline clock (sim time); defaults to the wall clock

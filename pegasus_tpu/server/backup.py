@@ -16,6 +16,8 @@ from __future__ import annotations
 
 import json
 import os
+
+from pegasus_tpu.storage.efile import open_data_file
 import tempfile
 import time
 from dataclasses import dataclass, field
@@ -60,7 +62,7 @@ class BackupEngine:
         base = f"{self.policy_name}/{backup_id}/{app_id}/{pidx}"
         files = []
         for name in sorted(os.listdir(ckpt_dir)):
-            with open(os.path.join(ckpt_dir, name), "rb") as f:
+            with open_data_file(os.path.join(ckpt_dir, name), "rb") as f:
                 self.bs.write_file(f"{base}/{name}", f.read())
             files.append(name)
         self.bs.write_file(f"{base}/meta.json", json.dumps({

@@ -12,6 +12,8 @@ from __future__ import annotations
 import hashlib
 import json
 import os
+
+from pegasus_tpu.storage.efile import open_data_file
 import shutil
 from typing import List, Optional
 
@@ -35,12 +37,12 @@ class BlockService:
         raise NotImplementedError
 
     def upload(self, local_path: str, remote_path: str) -> None:
-        with open(local_path, "rb") as f:
+        with open_data_file(local_path, "rb") as f:
             self.write_file(remote_path, f.read())
 
     def download(self, remote_path: str, local_path: str) -> None:
         os.makedirs(os.path.dirname(local_path) or ".", exist_ok=True)
-        with open(local_path, "wb") as f:
+        with open_data_file(local_path, "wb") as f:
             f.write(self.read_file(remote_path))
 
 

@@ -36,6 +36,8 @@ from typing import Iterator, List, Optional, Tuple
 
 import numpy as np
 
+from pegasus_tpu.storage.efile import open_data_file
+
 from pegasus_tpu.base.crc import crc32, crc64_batch
 from pegasus_tpu.ops.record_block import next_bucket
 
@@ -97,7 +99,7 @@ class SSTableWriter:
         self.path = path
         self._block_capacity = block_capacity
         self._meta = dict(meta or {})
-        self._f = open(path + ".tmp", "wb")
+        self._f = open_data_file(path + ".tmp", "wb")
         self._f.write(MAGIC)
         self._blocks: List[BlockMeta] = []
         self._pending: List[Tuple[bytes, bytes, int, int]] = []
@@ -203,7 +205,7 @@ class SSTable:
 
     def __init__(self, path: str, cache_blocks: int = 64) -> None:
         self.path = path
-        self._f = open(path, "rb")
+        self._f = open_data_file(path, "rb")
         self._f.seek(0, os.SEEK_END)
         file_size = self._f.tell()
         if file_size < len(MAGIC) + FOOTER.size:

@@ -26,6 +26,7 @@ from dataclasses import dataclass
 from typing import Iterator, List, Optional, Tuple
 
 from pegasus_tpu.base.crc import crc32
+from pegasus_tpu.storage.efile import open_data_file, repair_truncate
 
 OP_PUT = 0
 OP_DEL = 1
@@ -53,9 +54,8 @@ class WriteAheadLog:
         # second restart.
         valid_end = self._scan_valid_end(path)
         if valid_end is not None:
-            with open(path, "r+b") as f:
-                f.truncate(valid_end)
-        self._f = open(path, "ab")
+            repair_truncate(path, valid_end)
+        self._f = open_data_file(path, "ab")
 
     @staticmethod
     def _scan_valid_end(path: str) -> Optional[int]:
@@ -63,7 +63,7 @@ class WriteAheadLog:
         doesn't exist or is fully valid."""
         if not os.path.exists(path):
             return None
-        with open(path, "rb") as f:
+        with open_data_file(path, "rb") as f:
             data = f.read()
         pos = 0
         while pos + _FRAME_HDR.size <= len(data):
@@ -98,16 +98,16 @@ class WriteAheadLog:
     def truncate(self) -> None:
         """Drop all frames (called after a flush makes them durable)."""
         self._f.close()
-        self._f = open(self.path, "wb")
+        self._f = open_data_file(self.path, "wb")
         self._f.close()
-        self._f = open(self.path, "ab")
+        self._f = open_data_file(self.path, "ab")
 
     @staticmethod
     def replay(path: str) -> Iterator[Tuple[int, List[WalRecord]]]:
         """Yield (decree, records) batches; stop at the first torn frame."""
         if not os.path.exists(path):
             return
-        with open(path, "rb") as f:
+        with open_data_file(path, "rb") as f:
             data = f.read()
         pos = 0
         while pos + _FRAME_HDR.size <= len(data):

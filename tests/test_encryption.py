@@ -1,0 +1,277 @@
+"""At-rest encryption: KMS envelope keys + transparent file cipher
+(parity: security/kms_client.h, replica/kms_key_provider.h, and the
+encrypted-Env file path under FLAGS_encrypt_data_at_rest)."""
+
+import os
+
+import pytest
+
+from pegasus_tpu.security.kms import (
+    KeyProvider,
+    KmsError,
+    LocalKmsClient,
+    keystream,
+    xor_crypt,
+)
+from pegasus_tpu.storage import efile
+from pegasus_tpu.storage.efile import open_data_file
+from pegasus_tpu.storage.sstable import SSTable, SSTableWriter
+
+
+@pytest.fixture
+def zone(tmp_path):
+    """An encryption zone over tmp_path/data, torn down after the test."""
+    root = str(tmp_path / "data")
+    kms = LocalKmsClient(b"test-root-key-0123456789")
+    efile.enable_encryption(root, KeyProvider(root, kms))
+    try:
+        yield root
+    finally:
+        efile.disable_encryption(root)
+
+
+def test_keystream_is_seekable():
+    key, nonce = b"k" * 32, b"n" * 16
+    full = keystream(key, nonce, 0, 20_000)
+    for off, ln in ((0, 10), (4090, 20), (8192, 4096), (13_333, 777)):
+        assert keystream(key, nonce, off, ln) == full[off:off + ln]
+    data = os.urandom(9000)
+    ct = xor_crypt(key, nonce, 0, data)
+    assert xor_crypt(key, nonce, 0, ct) == data
+    # decrypting an interior slice needs only its offset
+    assert xor_crypt(key, nonce, 5000, ct[5000:6000]) == data[5000:6000]
+
+
+def test_kms_wrap_unwrap_and_tamper():
+    kms = LocalKmsClient(b"root-key-material-xyz")
+    key, wrapped = kms.generate_data_key()
+    assert kms.unwrap(wrapped) == key
+    bad = bytearray(wrapped)
+    bad[20] ^= 0xFF
+    with pytest.raises(KmsError):
+        kms.unwrap(bytes(bad))
+    with pytest.raises(KmsError):
+        LocalKmsClient(b"a-different-root-key").unwrap(wrapped)
+
+
+def test_key_provider_persists_key(tmp_path):
+    kms = LocalKmsClient(b"root-key-material-xyz")
+    p1 = KeyProvider(str(tmp_path), kms)
+    p2 = KeyProvider(str(tmp_path), kms)
+    assert p1.data_key == p2.data_key
+    with pytest.raises(KmsError):
+        KeyProvider(str(tmp_path), LocalKmsClient(b"wrong-root-key-..."))
+
+
+def test_cipher_file_random_access(zone):
+    path = os.path.join(zone, "blob")
+    os.makedirs(zone, exist_ok=True)
+    payload = os.urandom(50_000)
+    with open_data_file(path, "wb") as f:
+        f.write(payload)
+        f.flush()
+    with open(path, "rb") as f:
+        raw = f.read()
+    assert raw[:8] == efile.MAGIC and payload[:64] not in raw
+    with open_data_file(path, "rb") as f:
+        assert f.read() == payload
+        f.seek(40_000)
+        assert f.read(100) == payload[40_000:40_100]
+        f.seek(-500, os.SEEK_END)
+        assert f.read() == payload[-500:]
+    # append continues the stream where it left off
+    with open_data_file(path, "ab") as f:
+        assert f.tell() == len(payload)
+        f.write(b"tail-bytes")
+    with open_data_file(path, "rb") as f:
+        assert f.read() == payload + b"tail-bytes"
+    # truncate through r+b (the mutation-log repair path)
+    with open_data_file(path, "r+b") as f:
+        f.truncate(1000)
+    with open_data_file(path, "rb") as f:
+        assert f.read() == payload[:1000]
+
+
+def test_sstable_encrypted_round_trip(zone):
+    os.makedirs(zone, exist_ok=True)
+    path = os.path.join(zone, "t.sst")
+    w = SSTableWriter(path, block_capacity=8)
+    rows = [(b"\x00\x04hk%02d" % i + b"sortkey%02d" % i,
+             b"SECRETVALUE-%04d" % i) for i in range(40)]
+    for k, v in rows:
+        w.add(k, v)
+    w.finish()
+    assert efile.is_encrypted(path)
+    with open(path, "rb") as f:
+        raw = f.read()
+    assert b"SECRETVALUE" not in raw and b"sortkey" not in raw
+    t = SSTable(path)
+    got = []
+    for bi in range(len(t.blocks)):
+        blk = t.read_block(bi)
+        for i in range(blk.count):
+            got.append((blk.key_at(i), blk.value_at(i)))
+    assert got == rows
+    t.close()
+
+
+def test_legacy_plaintext_readable_inside_zone(tmp_path):
+    root = str(tmp_path / "data")
+    os.makedirs(root)
+    path = os.path.join(root, "old.sst")
+    w = SSTableWriter(path, block_capacity=8)  # plaintext: no zone yet
+    w.add(b"\x00\x02hharold", b"plain-old-value")
+    w.finish()
+    kms = LocalKmsClient(b"test-root-key-0123456789")
+    efile.enable_encryption(root, KeyProvider(root, kms))
+    try:
+        t = SSTable(path)  # sniffed as plaintext, still served
+        assert t.read_block(0).value_at(0) == b"plain-old-value"
+        t.close()
+        new = os.path.join(root, "new.sst")
+        w = SSTableWriter(new, block_capacity=8)
+        w.add(b"\x00\x02hhnew", b"fresh")
+        w.finish()
+        assert efile.is_encrypted(new) and not efile.is_encrypted(path)
+    finally:
+        efile.disable_encryption(root)
+
+
+def test_mutation_log_encrypted_restart(zone):
+    from pegasus_tpu.replica.mutation import Mutation, WriteOp
+    from pegasus_tpu.replica.mutation_log import MutationLog
+    from pegasus_tpu.rpc.codec import OP_PUT
+
+    os.makedirs(zone, exist_ok=True)
+    path = os.path.join(zone, "plog")
+    log = MutationLog(path)
+    for d in range(1, 8):
+        log.append(Mutation(ballot=1, decree=d, last_committed=d - 1,
+                            timestamp_us=d * 1000, ops=[
+                WriteOp(OP_PUT, (b"k%d" % d, b"v%d" % d, 0))]),
+            sync=True)
+    log.close()
+    assert efile.is_encrypted(path)
+    log2 = MutationLog(path)  # exercises scan + truncate-repair open
+    assert log2.max_decree == 7
+    replayed = [mu.decree for mu in MutationLog.replay(path)]
+    assert replayed == list(range(1, 8))
+    log2.gc(durable_decree=5)
+    assert [mu.decree for mu in MutationLog.replay(path)] == [6, 7]
+    assert efile.is_encrypted(path)
+    log2.close()
+
+
+def test_cluster_end_to_end_encrypted(tmp_path, monkeypatch):
+    from pegasus_tpu.tools.cluster import SimCluster
+
+    monkeypatch.setenv("PEGASUS_ENCRYPT_AT_REST", "1")
+    monkeypatch.setenv("PEGASUS_KMS_ROOT_KEY", b"cluster-root-secret!".hex())
+    cluster = SimCluster(str(tmp_path / "c"), n_nodes=3)
+    try:
+        cluster.create_table("enc", partition_count=4)
+        c = cluster.client("enc")
+        for i in range(30):
+            assert c.set(b"user%03d" % i, b"s", b"topsecret-%d" % i) == 0
+        for node in cluster.stubs.values():
+            for rep in list(node.replicas.values()):
+                rep.server.engine.flush()
+        assert c.get(b"user007", b"s") == (0, b"topsecret-7")
+        # NOTHING on disk leaks plaintext — every file under every
+        # node (SSTs, storage WAL, replica mutation log, metadata)
+        n_files = 0
+        for base, _dirs, files in os.walk(str(tmp_path / "c")):
+            for name in files:
+                n_files += 1
+                with open(os.path.join(base, name), "rb") as f:
+                    raw = f.read()
+                assert b"topsecret" not in raw, os.path.join(base, name)
+                assert b"user00" not in raw, os.path.join(base, name)
+        assert n_files > 0
+    finally:
+        cluster.close()
+        for z in list(efile._zones):
+            efile.disable_encryption(z)
+
+
+def test_learning_transfer_reencrypts_per_node(tmp_path, monkeypatch):
+    """LT_APP learning across nodes with encryption on: checkpoint files
+    travel as plaintext chunks (the nfs-analogue reads through the
+    cipher) and land re-encrypted under the LEARNER's own data key."""
+    from pegasus_tpu.base.key_schema import generate_key
+    from pegasus_tpu.tools.cluster import SimCluster
+    from pegasus_tpu.utils.errors import StorageStatus
+
+    OK = int(StorageStatus.OK)
+    monkeypatch.setenv("PEGASUS_ENCRYPT_AT_REST", "1")
+    monkeypatch.setenv("PEGASUS_KMS_ROOT_KEY", b"cluster-root-secret!".hex())
+    cluster = SimCluster(str(tmp_path / "c"), n_nodes=2)
+    try:
+        app_id = cluster.create_table("tx", partition_count=1,
+                                      replica_count=1)
+        c = cluster.client("tx")
+        for i in range(200):
+            assert c.set(b"t%04d" % i, b"s", b"v%d" % i) == OK
+        pc = cluster.meta.state.get_partition(app_id, 0)
+        primary = cluster.stubs[pc.primary]
+        primary.get_replica((app_id, 0)).flush_and_gc_log()
+        for stub in cluster.stubs.values():
+            stub.shared_fs = False
+            for r in stub.replicas.values():
+                r.shared_fs = False
+        cluster.meta.state.apps[app_id].max_replica_count = 2
+        for _ in range(12):
+            cluster.step()
+            pc = cluster.meta.state.get_partition(app_id, 0)
+            if len(pc.members()) == 2:
+                break
+        assert len(pc.members()) == 2, pc
+        other = [n for n in pc.members() if n != primary.name][0]
+        learner = cluster.stubs[other].get_replica((app_id, 0))
+        for i in (0, 100, 199):
+            assert learner.server.on_get(
+                generate_key(b"t%04d" % i, b"s")) == (OK, b"v%d" % i)
+        # the learned SSTs are ciphertext under the learner's key
+        n = 0
+        sst_dir = os.path.join(learner.server.engine.data_dir, "sst")
+        for name in os.listdir(sst_dir):
+            if name.endswith(".sst"):
+                n += 1
+                assert efile.is_encrypted(os.path.join(sst_dir, name))
+        assert n > 0
+        k1 = cluster.stubs[pc.primary].data_dir
+        k2 = cluster.stubs[other].data_dir
+        from pegasus_tpu.storage.efile import zone_for
+        assert zone_for(k1).data_key != zone_for(k2).data_key
+    finally:
+        cluster.close()
+        for z in list(efile._zones):
+            efile.disable_encryption(z)
+
+
+def test_boot_fails_loudly_without_root_key(tmp_path, monkeypatch):
+    from pegasus_tpu.tools.cluster import SimCluster
+
+    monkeypatch.setenv("PEGASUS_ENCRYPT_AT_REST", "1")
+    monkeypatch.delenv("PEGASUS_KMS_ROOT_KEY", raising=False)
+    monkeypatch.delenv("PEGASUS_KMS_ROOT_KEY_FILE", raising=False)
+    with pytest.raises(RuntimeError, match="PEGASUS_KMS_ROOT_KEY"):
+        SimCluster(str(tmp_path / "c"), n_nodes=1)
+
+
+def test_repair_truncate_uses_fresh_nonce(zone):
+    """Torn-tail repair must not re-emit keystream at reused offsets."""
+    os.makedirs(zone, exist_ok=True)
+    path = os.path.join(zone, "log")
+    with open_data_file(path, "wb") as f:
+        f.write(b"A" * 1000)
+    nonce_before = efile._sniff(path)
+    efile.repair_truncate(path, 400)
+    nonce_after = efile._sniff(path)
+    assert nonce_before != nonce_after
+    with open_data_file(path, "rb") as f:
+        assert f.read() == b"A" * 400
+    with open_data_file(path, "ab") as f:
+        f.write(b"B" * 100)
+    with open_data_file(path, "rb") as f:
+        assert f.read() == b"A" * 400 + b"B" * 100
