@@ -305,6 +305,8 @@ class MetaService:
                                               args["envs"])
             elif cmd == "rebalance":
                 result = len(self.rebalance())
+            elif cmd == "drain_node":
+                result = self.drain_node(args["node"])
             elif cmd == "list_nodes":
                 result = self.fd.alive_workers()
             elif cmd == "start_backup":
@@ -664,13 +666,7 @@ class MetaService:
             if prop.kind == "move_primary":
                 if prop.to_node not in pc.secondaries:
                     continue  # config changed since proposal generation
-                new_pc = PartitionConfig(
-                    ballot=pc.ballot + 1, primary=prop.to_node,
-                    secondaries=[s for s in pc.secondaries
-                                 if s != prop.to_node] + [pc.primary])
-                self.state.update_partition(prop.gpid[0], prop.gpid[1],
-                                            new_pc)
-                self._propose(prop.gpid[0], prop.gpid[1], new_pc)
+                self._move_primary(prop.gpid, prop.to_node)
             else:  # copy_secondary via the learner flow
                 if prop.gpid in self._pending_learns:
                     continue
@@ -681,6 +677,44 @@ class MetaService:
                 self.net.send(self.name, pc.primary, "add_learner_cmd", {
                     "gpid": prop.gpid, "learner": prop.to_node})
         return proposals
+
+    def drain_node(self, node: str) -> int:
+        """Move every primary OFF `node` (graceful offline — parity:
+        admin_tools/pegasus_offline_node.sh's migrate-primaries step).
+        Each affected partition promotes one remaining secondary via a
+        zero-copy config change; the drained node stays a secondary so
+        the operator can stop it without a read-availability dip and
+        let the guardian re-replicate afterwards. Returns the number of
+        primaries moved; partitions with no other member are skipped
+        (dropping their primary would lose the partition)."""
+        moved = 0
+        for app in self.list_apps():
+            for pidx in range(app.partition_count):
+                pc = self.state.get_partition(app.app_id, pidx)
+                if pc is None or pc.primary != node:
+                    continue
+                # only hand leadership to a LIVE secondary — in the
+                # beacon-timeout window a dead one still sits in the
+                # config and promoting it would black out the partition
+                live = [s for s in pc.secondaries
+                        if self.fd.is_alive(s)]
+                if not live:
+                    continue
+                self._move_primary((app.app_id, pidx), live[0])
+                moved += 1
+        return moved
+
+    def _move_primary(self, gpid, target: str) -> None:
+        """Zero-copy leadership move: the target secondary becomes
+        primary at ballot+1 and the old primary stays as a secondary
+        (shared by the balancer's move_primary and drain_node)."""
+        pc = self.state.get_partition(*gpid)
+        new_pc = PartitionConfig(
+            ballot=pc.ballot + 1, primary=target,
+            secondaries=[s for s in pc.secondaries
+                         if s != target] + [pc.primary])
+        self.state.update_partition(gpid[0], gpid[1], new_pc)
+        self._propose(gpid[0], gpid[1], new_pc)
 
     # ---- proposal delivery --------------------------------------------
 

@@ -842,7 +842,10 @@ class Replica:
         self.server.engine.close()
         sst_dir = os.path.join(app_dir, "sst")
         shutil.rmtree(sst_dir, ignore_errors=True)
-        shutil.copytree(checkpoint_dir, sst_dir)
+        # decrypt/re-encrypt aware: primary and learner hold different
+        # data keys when at-rest encryption is on
+        from pegasus_tpu.storage.efile import copy_data_tree
+        copy_data_tree(checkpoint_dir, sst_dir)
         wal = os.path.join(app_dir, "wal.log")
         if os.path.exists(wal):
             os.remove(wal)

@@ -66,10 +66,12 @@ class ReplicaStub:
             kms = LocalKmsClient(root)
             # ONE data key per server, shared by all its data dirs:
             # disk-migrate raw-copies files between dirs, which must
-            # stay decryptable at the destination
-            provider = KeyProvider(dirs[0], kms)
+            # stay decryptable at the destination; the wrapped key is
+            # replicated to every dir so no single disk is a key SPOF
+            provider = KeyProvider.for_dirs(dirs, kms)
             for d in dirs:
                 enable_encryption(d, provider)
+            self._encryption_dirs = list(dirs)
         self.net = net
         self.clock = clock
         # FD timeline clock (sim time); defaults to the wall clock
@@ -234,6 +236,11 @@ class ReplicaStub:
     def close(self) -> None:
         for r in self.replicas.values():
             r.close()
+        if getattr(self, "_encryption_dirs", None):
+            from pegasus_tpu.storage.efile import disable_encryption
+
+            for d in self._encryption_dirs:
+                disable_encryption(d)
 
     # ---- replica management -------------------------------------------
 

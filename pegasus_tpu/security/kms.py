@@ -105,6 +105,15 @@ class LocalKmsClient:
 KEY_FILE = ".pegasus_data_key"
 
 
+def _write_wrapped(path: str, wrapped: bytes) -> None:
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(wrapped)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
 class KeyProvider:
     """Loads-or-creates the server data key under a data root.
 
@@ -121,12 +130,27 @@ class KeyProvider:
                 self.data_key = kms.unwrap(f.read())
         else:
             self.data_key, wrapped = kms.generate_data_key()
-            tmp = path + ".tmp"
-            with open(tmp, "wb") as f:
-                f.write(wrapped)
-                f.flush()
-                os.fsync(f.fileno())
-            os.replace(tmp, path)
+            _write_wrapped(path, wrapped)
+
+    @classmethod
+    def for_dirs(cls, dirs: list, kms: LocalKmsClient) -> "KeyProvider":
+        """One provider for a multi-disk server: find the wrapped key in
+        ANY of the dirs (so losing or reordering disk 0 cannot orphan
+        the other disks' data), then replicate it to every dir."""
+        found = None
+        for d in dirs:
+            if os.path.exists(os.path.join(d, KEY_FILE)):
+                found = d
+                break
+        prov = cls(found if found is not None else dirs[0], kms)
+        with open(os.path.join(prov.data_root, KEY_FILE), "rb") as f:
+            wrapped = f.read()
+        for d in dirs:
+            os.makedirs(d, exist_ok=True)
+            p = os.path.join(d, KEY_FILE)
+            if not os.path.exists(p):
+                _write_wrapped(p, wrapped)
+        return prov
 
 
 def root_key_from_env(fallback: Optional[bytes] = None) -> Optional[bytes]:

@@ -206,3 +206,37 @@ def repair_truncate(path: str, valid_end: int) -> None:
         os.fsync(dir_fd)
     finally:
         os.close(dir_fd)
+
+
+def copy_data_tree(src_dir: str, dst_dir: str) -> None:
+    """Copy a data directory decrypt-at-source / re-encrypt-at-dest.
+
+    A raw byte copy (shutil.copytree) of encrypted files is only valid
+    when source and destination share a data key; a shared-fs learn
+    copies the PRIMARY's checkpoint into the LEARNER's zone, and each
+    server has its own key. Reading through open_data_file and writing
+    through it again makes the copy key-correct in every combination
+    (plain->plain, plain->encrypted, encrypted->re-encrypted)."""
+    os.makedirs(dst_dir, exist_ok=True)
+    for base, dirs, files in os.walk(src_dir):
+        rel = os.path.relpath(base, src_dir)
+        out_base = (dst_dir if rel == os.curdir
+                    else os.path.join(dst_dir, rel))
+        for d in dirs:
+            os.makedirs(os.path.join(out_base, d), exist_ok=True)
+        for name in files:
+            src = os.path.join(base, name)
+            if _sniff(src) is not None and zone_for(src) is None:
+                # an encrypted file we hold no key for: copying it (raw
+                # OR re-encrypted) can only produce garbage at the
+                # destination — fail here with the real cause instead
+                raise RuntimeError(
+                    f"{src} is encrypted but no key is registered for "
+                    "its path; cross-server shared-fs copies need the "
+                    "transfer path (which re-encrypts), not a file copy")
+            with open_data_file(src, "rb") as fin:
+                data = fin.read()
+            with open_data_file(os.path.join(out_base, name), "wb") as fout:
+                fout.write(data)
+                fout.flush()
+                os.fsync(fout.fileno())

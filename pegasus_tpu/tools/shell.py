@@ -133,6 +133,8 @@ def main(argv=None) -> int:
     p.add_argument("table")
     p = sub.add_parser("nodes")
     p = sub.add_parser("rebalance")
+    p = sub.add_parser("offline_node")
+    p.add_argument("node", help="drain all primaries off this node")
     # offline debugging (parity: shell sst_dump / mlog_dump and
     # src/tools/mutation_log_tool.*) — read files directly, no cluster
     p = sub.add_parser("sst_dump")
@@ -183,6 +185,61 @@ def _offline_dump(args, out) -> int:
         extract_expire_ts,
         extract_user_data,
     )
+
+    with _offline_key_zone(args.path, out):
+        return _offline_dump_body(args, out, restore_key,
+                                  extract_user_data)
+
+
+def _offline_key_zone(path, out):
+    """Offline forensics on an ENCRYPTED cluster's files: walk up from
+    the dump target to the server data root (the dir holding
+    .pegasus_data_key), unwrap it with the operator's exported
+    PEGASUS_KMS_ROOT_KEY(_FILE), and register a temporary zone so the
+    dump reads plaintext. Without the root key the dump fails with the
+    actual reason instead of showing ciphertext as an empty log."""
+    import contextlib
+    import os
+
+    from pegasus_tpu.security.kms import (
+        KEY_FILE, KeyProvider, LocalKmsClient, root_key_from_env)
+    from pegasus_tpu.storage import efile
+
+    @contextlib.contextmanager
+    def zone():
+        probe = os.path.abspath(path)
+        key_root = None
+        while True:
+            parent = (probe if os.path.isdir(probe)
+                      else os.path.dirname(probe))
+            if os.path.exists(os.path.join(parent, KEY_FILE)):
+                key_root = parent
+                break
+            up = os.path.dirname(parent)
+            if up == parent:
+                break
+            probe = up
+        if key_root is None:
+            yield  # plaintext cluster: nothing to do
+            return
+        root = root_key_from_env()
+        if root is None:
+            raise SystemExit(
+                f"{key_root} holds encrypted data "
+                f"({KEY_FILE} present) — export PEGASUS_KMS_ROOT_KEY "
+                "or PEGASUS_KMS_ROOT_KEY_FILE to dump it")
+        efile.enable_encryption(
+            key_root, KeyProvider(key_root, LocalKmsClient(root)))
+        try:
+            yield
+        finally:
+            efile.disable_encryption(key_root)
+
+    return zone()
+
+
+def _offline_dump_body(args, out, restore_key, extract_user_data) -> int:
+    import os
 
     if args.cmd == "sst_dump":
         from pegasus_tpu.storage.sstable import SSTable
@@ -490,6 +547,9 @@ def _dispatch(args, box, out) -> int:
     elif args.cmd == "rebalance":
         n = box.admin.call("rebalance")
         print(f"OK: {n} proposals", file=out)
+    elif args.cmd == "offline_node":
+        n = box.admin.call("drain_node", node=args.node)
+        print(f"OK: moved {n} primaries off {args.node}", file=out)
     elif args.cmd == "restore":
         if isinstance(box, _ClusterBox):
             raise NotImplementedError(

@@ -232,3 +232,35 @@ def test_hotkey_detection_wired_into_serving(tmp_path):
             assert out["hot_key"] == "whale"
     finally:
         cluster.close()
+
+
+def test_drain_node_moves_all_primaries(tmp_path):
+    """Graceful offline (pegasus_offline_node.sh parity): drain_node
+    promotes a secondary for every partition the node leads, the node
+    keeps serving as secondary, and acked data stays readable."""
+    from pegasus_tpu.tools.cluster import SimCluster
+
+    cluster = SimCluster(str(tmp_path / "c"), n_nodes=4)
+    try:
+        app_id = cluster.create_table("d", partition_count=8)
+        c = cluster.client("d")
+        for i in range(40):
+            assert c.set(b"k%03d" % i, b"s", b"v%d" % i) == 0
+        primaries = {cluster.meta.state.get_partition(app_id, p).primary
+                     for p in range(8)}
+        victim = sorted(primaries)[0]
+        had = sum(cluster.meta.state.get_partition(app_id, p).primary
+                  == victim for p in range(8))
+        assert had > 0
+        moved = cluster.meta.drain_node(victim)
+        assert moved == had
+        cluster.step(rounds=3)
+        for p in range(8):
+            pc = cluster.meta.state.get_partition(app_id, p)
+            assert pc.primary != victim, (p, pc)
+        for i in range(40):
+            assert c.get(b"k%03d" % i, b"s") == (0, b"v%d" % i)
+        # draining an already-drained node is a no-op
+        assert cluster.meta.drain_node(victim) == 0
+    finally:
+        cluster.close()
