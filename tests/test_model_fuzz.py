@@ -95,3 +95,92 @@ def test_randomized_ops_match_model(tmp_path, seed):
             assert c.get(hk, sk) == (OK, value), (hk, sk)
     finally:
         cluster.close()
+
+
+@pytest.mark.parametrize("seed", [5, 19])
+def test_partition_heal_fuzz(tmp_path, seed):
+    """Network-partition chaos (vs kill-based above): nodes get isolated
+    and healed mid-traffic; acked writes must survive and reads stay
+    linearizable per the fork-on-ambiguity model. Exercises lease
+    expiry, reconfiguration around isolated primaries, and catch-up on
+    heal — the message-loss classes the .act cases script explicitly,
+    here explored randomly (env.sim.h:36 spirit)."""
+    rng = random.Random(seed)
+    cluster = SimCluster(str(tmp_path / f"p{seed}"), n_nodes=4,
+                         seed=seed)
+    try:
+        cluster.create_table("pz", partition_count=4)
+        c = cluster.client("pz")
+        # current = last KNOWN value (None = absent); pending = values of
+        # timed-out writes that may STILL commit later: unlike the
+        # kill-based fuzz above, a partitioned-then-healed primary can
+        # drain a stuck write queue long after the client gave up, so a
+        # pending value stays possible until an ACKED write supersedes
+        # it (FIFO per-replica queues commit earlier-issued first) or it
+        # is observed (committed => the older value is gone)
+        current = {}
+        pending = {}
+        hks = [b"h%02d" % i for i in range(6)]
+        isolated = None
+
+        for step in range(300):
+            op = rng.random()
+            hk = rng.choice(hks)
+            key = (hk, b"s")
+            if op < 0.35:  # write
+                value = b"v%d" % step
+                try:
+                    if c.set(hk, b"s", value) == OK:
+                        current[key] = value
+                        pending.pop(key, None)
+                    else:
+                        pending.setdefault(key, set()).add(value)
+                except PegasusError:
+                    pending.setdefault(key, set()).add(value)
+            elif op < 0.75:  # read
+                try:
+                    err, got = c.get(hk, b"s")
+                except PegasusError:
+                    continue
+                observed = got if err == OK else None
+                allowed = set(pending.get(key, ()))
+                allowed.add(current.get(key))
+                assert observed in allowed, (step, key, observed,
+                                             allowed)
+                if observed != current.get(key):
+                    # a pending write is now committed: the prior value
+                    # can never be read again, other pending may remain
+                    pending[key].discard(observed)
+                    if observed is None:
+                        current.pop(key, None)
+                    else:
+                        current[key] = observed
+            elif op < 0.85:  # chaos: isolate ONE replica node at a time
+                if isolated is None:
+                    victim = rng.choice(list(cluster.stubs))
+                    cluster.net.partition(victim)
+                    isolated = victim
+                else:
+                    cluster.net.heal(isolated)
+                    isolated = None
+            else:
+                cluster.step()
+
+        if isolated is not None:
+            cluster.net.heal(isolated)
+        cluster.step(rounds=6)
+        for (hk, sk), value in sorted(current.items()):
+            if pending.get((hk, sk)):
+                continue
+            deadline_ok = False
+            for _ in range(6):
+                try:
+                    if c.get(hk, sk) == (OK, value):
+                        deadline_ok = True
+                        break
+                except PegasusError:
+                    pass
+                cluster.step()
+            assert deadline_ok, (hk, sk, value)
+    finally:
+        cluster.close()
