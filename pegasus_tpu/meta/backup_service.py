@@ -38,6 +38,9 @@ class MetaBackupService:
         # persisted: policies + in-flight backups survive a meta restart
         self._policies: Dict[str, dict] = {}
         self._inflight: Dict[int, dict] = {}
+        # finished ids (bounded): lets backup_status tell "done" from
+        # "never heard of it" — an unknown id must NOT read as complete
+        self._completed: Dict[int, dict] = {}
         self._last_policy_run: Dict[str, float] = {}
         self._load()
 
@@ -48,12 +51,16 @@ class MetaBackupService:
         self._policies = st.get("/backup/policies") or {}
         raw = st.get("/backup/inflight") or {}
         self._inflight = {int(k): v for k, v in raw.items()}
+        done = st.get("/backup/completed") or {}
+        self._completed = {int(k): v for k, v in done.items()}
 
     def _save(self) -> None:
         self.meta.state._storage.set_batch({
             "/backup/policies": self._policies,
             "/backup/inflight": {str(k): v
                                  for k, v in self._inflight.items()},
+            "/backup/completed": {str(k): v
+                                  for k, v in self._completed.items()},
         })
 
     # ---- policies (parity: add/ls/modify policy RPCs) ------------------
@@ -100,7 +107,11 @@ class MetaBackupService:
         if info is not None:
             return {"backup_id": backup_id, "complete": False,
                     "pending": list(info["pending"])}
-        return {"backup_id": backup_id, "complete": True, "pending": []}
+        if backup_id in self._completed:
+            return {"backup_id": backup_id, "complete": True,
+                    "pending": []}
+        return {"backup_id": backup_id, "complete": False,
+                "pending": [], "unknown": True}
 
     def _drive_backup(self, backup_id: int) -> None:
         info = self._inflight[backup_id]
@@ -135,6 +146,11 @@ class MetaBackupService:
             if hist:
                 engine.gc_old_backups(hist)
             del self._inflight[backup_id]
+            self._completed[backup_id] = {
+                "root": info["root"], "policy": info["policy"],
+                "app_name": info["app_name"]}
+            while len(self._completed) > 64:
+                self._completed.pop(min(self._completed))
         self._save()
 
     # ---- restore (parity: server_state_restore.cpp) --------------------

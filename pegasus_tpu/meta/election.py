@@ -130,6 +130,7 @@ class MetaElection:
         self.term = 0
         self.voted_term = 0
         self.is_leader = len(self.peers) == 0  # single-meta: always lead
+        self._peer_contact: Dict[str, float] = {}
         self.leader: Optional[str] = meta.name if self.is_leader else None
         # boot counts as a heartbeat: with -inf every member would
         # campaign on its FIRST tick simultaneously and split the vote;
@@ -188,6 +189,9 @@ class MetaElection:
         if len(self._votes) * 2 > len(self.group):
             self.is_leader = True
             self.leader = self.meta.name
+            self._peer_contact = {p: self.meta.clock()
+                                  for p in self._votes
+                                  if p != self.meta.name}
             self._last_sent_hb = float("-inf")
             self._send_heartbeats(self.meta.clock())
             # a fresh leader re-learns worker liveness before curing:
@@ -204,9 +208,19 @@ class MetaElection:
                     self._step_down(payload["term"])
                 self.leader = src
                 self._last_heartbeat = self.meta.clock()
+                # the ack is the leader's lease evidence: without it a
+                # partitioned leader would keep is_leader forever and
+                # serve stale leader-only reads (split-brain)
+                self.meta.net.send(self.meta.name, src,
+                                   "meta_heartbeat_ack",
+                                   {"term": payload["term"]})
                 if tuple(payload["version"]) > self.storage.version:
                     self.meta.net.send(self.meta.name, src,
                                        "meta_fetch_state", {})
+            return True
+        if msg_type == "meta_heartbeat_ack":
+            if self.is_leader and payload["term"] == self.term:
+                self._peer_contact[src] = self.meta.clock()
             return True
         if msg_type == "meta_replicate":
             if payload["term"] >= self.term:
@@ -228,7 +242,19 @@ class MetaElection:
                 # a stale-state member campaigning faster permanently
                 # outruns everyone else's term and no leader ever wins
                 self._step_down(payload["term"])
+            # lease-sticky voting: while our current leader's lease is
+            # fresh we refuse to elect anyone else — otherwise a node
+            # that merely lost its INBOUND link from the leader can win
+            # a majority while the leader (still acked by us) keeps its
+            # lease: two simultaneous leaders
+            now = self.meta.clock()
+            leader_fresh = (self.leader is not None
+                            and self.leader != self.meta.name
+                            and src != self.leader
+                            and now - self._last_heartbeat
+                            <= LEASE_SECONDS)
             grant = (payload["term"] > self.voted_term
+                     and not leader_fresh
                      and tuple(payload["version"])
                      >= self.storage.version)
             if grant:
@@ -269,6 +295,20 @@ class MetaElection:
         now = self.meta.clock()
         if self.is_leader:
             self._send_heartbeats(now)
+            # margin of one heartbeat below the followers' minimum
+            # election delay: the leader must demote strictly BEFORE
+            # any follower can start a winning campaign, even with the
+            # ack's one-way delay anchoring our clock later than theirs
+            fresh = 1 + sum(1 for t in self._peer_contact.values()
+                            if now - t <= LEASE_SECONDS
+                            - HEARTBEAT_EVERY)
+            if fresh * 2 <= len(self.group):
+                # contact lost with a majority: the lease can no longer
+                # be presumed held — demote BEFORE a newly elected peer
+                # and this node answer leader-only requests differently
+                self.is_leader = False
+                self.leader = None
+                self._last_heartbeat = now  # full (staggered) delay
         elif now - self._last_heartbeat > self._election_delay:
             # re-arm before campaigning so a failed round retries after
             # another full (still staggered) delay, not every tick

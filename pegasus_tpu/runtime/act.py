@@ -60,9 +60,11 @@ class ActRunner:
     def __init__(self, data_dir: str, n_nodes: int = 4,
                  seed: int = 0) -> None:
         self.cluster = SimCluster(data_dir, n_nodes=n_nodes, seed=seed)
+        self.dir = data_dir
         self.client = None
         self.app_id: Optional[int] = None
         self._follower_clients: dict = {}
+        self._backup_id = None
 
     def close(self) -> None:
         from pegasus_tpu.utils.fail_point import FAIL_POINTS
@@ -145,6 +147,39 @@ class ActRunner:
                     f"wanted {args[1]}")
         elif verb == "dup":
             c.meta.duplication.add_duplication(args[0], "meta", args[1])
+        elif verb == "config":
+            if self.client is not None:
+                raise ActError("config: must precede create:")
+            kw = dict(kv.split("=") for kv in args)
+            import shutil
+            self.cluster.close()
+            shutil.rmtree(self.dir, ignore_errors=True)
+            self.cluster = SimCluster(
+                self.dir, n_nodes=int(kw.get("nodes", 4)),
+                seed=int(kw.get("seed", 7)),
+                n_meta=int(kw.get("n_meta", 1)))
+        elif verb == "kill_meta_leader":
+            leader = [m for m in c.metas
+                      if m.election.is_leader]
+            if not leader:
+                raise ActError("no meta leader to kill")
+            c.kill(leader[0].name)
+        elif verb == "backup":
+            root = os.path.join(self.dir, "backup_root")
+            self._backup_id = c.meta.backup.start_backup(
+                args[0], root, "act")
+        elif verb == "expect_backup_done":
+            if self._backup_id is None:
+                raise ActError("expect_backup_done: no backup: ran")
+            st = c.meta.backup.backup_status(self._backup_id)
+            if not st["complete"]:
+                raise ActError(f"backup incomplete: {st}")
+        elif verb == "restore":
+            if self._backup_id is None:
+                raise ActError("restore: no backup: ran")
+            root = os.path.join(self.dir, "backup_root")
+            c.meta.backup.create_app_from_backup(
+                args[0], root, "act", self._backup_id, replica_count=3)
         elif verb == "expect_follower_read":
             fc = self._follower_clients.get(args[0])
             if fc is None:

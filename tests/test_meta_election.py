@@ -107,3 +107,61 @@ def test_revived_old_leader_steps_down(cluster):
     old = next(m for m in cluster.metas if m.name == old_leader)
     assert any(a.app_name == "while_you_were_out"
                for a in old.state.apps.values())
+
+
+def test_partitioned_leader_self_demotes(tmp_path):
+    """A leader that loses contact with a majority must drop is_leader
+    within the lease window (no split-brain leader-only reads)."""
+    from pegasus_tpu.tools.cluster import SimCluster
+
+    cluster = SimCluster(str(tmp_path / "c"), n_nodes=3, n_meta=3)
+    try:
+        cluster.create_table("t", partition_count=2)
+        leader = next(m for m in cluster.metas if m.election.is_leader)
+        cluster.net.partition(leader.name)
+        # let sim time pass beyond the lease; the isolated leader keeps
+        # ticking (partition drops messages, not timers)
+        for _ in range(12):
+            cluster.step()
+        assert not leader.election.is_leader
+        # and a new leader exists among the connected majority
+        alive_leaders = [m for m in cluster.metas
+                         if m.name != leader.name
+                         and m.election.is_leader]
+        assert len(alive_leaders) == 1
+        # heal: old leader rejoins as follower of the higher term
+        cluster.net.heal(leader.name)
+        for _ in range(8):
+            cluster.step()
+        leaders = [m for m in cluster.metas if m.election.is_leader]
+        assert len(leaders) == 1
+    finally:
+        cluster.close()
+
+
+def test_one_way_link_loss_no_split_brain(tmp_path):
+    """Asymmetric failure: one meta stops RECEIVING the leader's
+    heartbeats while the leader still reaches everyone else. The
+    isolated member campaigns, but lease-sticky voting denies it a
+    majority — at no observed point do two live metas claim leadership."""
+    from pegasus_tpu.tools.cluster import SimCluster
+
+    cluster = SimCluster(str(tmp_path / "c"), n_nodes=3, n_meta=3)
+    try:
+        cluster.create_table("t", partition_count=2)
+        leader = next(m for m in cluster.metas if m.election.is_leader)
+        victim = next(m for m in cluster.metas
+                      if not m.election.is_leader)
+        cluster.net.set_drop(1.0, src=leader.name, dst=victim.name)
+        for _ in range(25):
+            cluster.step()
+            leaders = [m.name for m in cluster.metas
+                       if m.election.is_leader]
+            assert len(leaders) <= 1, leaders
+        # the healthy majority still has a working leader and the
+        # cluster still serves writes
+        c = cluster.client("t")
+        assert c.set(b"k", b"s", b"v") == 0
+        assert c.get(b"k", b"s") == (0, b"v")
+    finally:
+        cluster.close()
