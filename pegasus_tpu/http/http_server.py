@@ -60,6 +60,58 @@ class _Handler(BaseHTTPRequestHandler):
                                   "result": mgr.call(verb, args)})
             except (KeyError, ValueError, TypeError) as e:
                 self._reply(400, {"error": str(e)})
+        elif url.path == "/pprof/heap":
+            # parity: pprof_http_service heap endpoint — Python-native:
+            # tracemalloc top allocations when tracing, else rss only
+            import resource
+            import tracemalloc
+
+            out = {"max_rss_kb": resource.getrusage(
+                resource.RUSAGE_SELF).ru_maxrss,
+                "tracing": tracemalloc.is_tracing()}
+            if tracemalloc.is_tracing():
+                snap = tracemalloc.take_snapshot()
+                out["top"] = [
+                    {"site": str(stat.traceback[0]),
+                     "size_kb": stat.size // 1024,
+                     "count": stat.count}
+                    for stat in snap.statistics("lineno")[:25]]
+            self._reply(200, out)
+        elif url.path == "/pprof/profile":
+            # parity: pprof cpu profile — sampled Python stacks over a
+            # short window; collapsed-stack counts, biggest first
+            import collections
+            import sys
+            import time as _time
+
+            try:
+                seconds = min(10.0, float(
+                    query.get("seconds", ["1"])[0]))
+            except ValueError:
+                self._reply(400, {"error": "seconds must be a number"})
+                return
+            hz = 50
+            me = threading.get_ident()
+            counts: collections.Counter = collections.Counter()
+            end = _time.monotonic() + seconds
+            while _time.monotonic() < end:
+                for tid, frame in sys._current_frames().items():
+                    if tid == me:
+                        continue
+                    stack = []
+                    f = frame
+                    while f is not None and len(stack) < 24:
+                        stack.append(
+                            f"{f.f_code.co_filename.rsplit('/', 1)[-1]}"
+                            f":{f.f_code.co_name}")
+                        f = f.f_back
+                    counts[";".join(reversed(stack))] += 1
+                _time.sleep(1.0 / hz)
+            self._reply(200, {
+                "seconds": seconds, "hz": hz,
+                "samples": sum(counts.values()),
+                "stacks": [{"stack": k, "count": v}
+                           for k, v in counts.most_common(40)]})
         elif url.path == "/metrics":
             entity_type = query.get("with_metric_entity_type",
                                     query.get("entity_type", [None]))[0]

@@ -149,8 +149,10 @@ class MetaBackupService:
             self._completed[backup_id] = {
                 "root": info["root"], "policy": info["policy"],
                 "app_name": info["app_name"]}
-            while len(self._completed) > 64:
-                self._completed.pop(min(self._completed))
+            # bounded history, oldest-FINISHED first (dict insertion
+            # order — ids may be caller-supplied and not time-ordered)
+            while len(self._completed) > 256:
+                self._completed.pop(next(iter(self._completed)))
         self._save()
 
     # ---- restore (parity: server_state_restore.cpp) --------------------

@@ -131,6 +131,7 @@ class MetaElection:
         self.voted_term = 0
         self.is_leader = len(self.peers) == 0  # single-meta: always lead
         self._peer_contact: Dict[str, float] = {}
+        self._prevotes: Optional[set] = None
         self.leader: Optional[str] = meta.name if self.is_leader else None
         # boot counts as a heartbeat: with -inf every member would
         # campaign on its FIRST tick simultaneously and split the vote;
@@ -172,6 +173,23 @@ class MetaElection:
                 "version": list(self.storage.version)})
 
     # ---- follower/candidate side ---------------------------------------
+
+    def _start_prevote(self) -> None:
+        """Raft-style pre-vote: ask whether a majority WOULD grant a
+        vote at term+1 before touching self.term. An isolated member
+        (e.g. one-way link loss from the leader) fails the pre-vote and
+        never inflates its term — so it cannot force the healthy
+        majority to adopt a higher term, silence their heartbeat acks,
+        and dethrone a leader they can still reach; and after the link
+        heals, its un-inflated term lets the leader's heartbeats
+        reintegrate it immediately."""
+        self._prevotes = {self.meta.name}
+        for peer in self.peers:
+            self.meta.net.send(self.meta.name, peer, "meta_prevote_req", {
+                "term": self.term + 1,
+                "version": list(self.storage.version)})
+        if len(self._prevotes) * 2 > len(self.group):  # single-member
+            self._start_election()
 
     def _start_election(self) -> None:
         self.term += 1
@@ -235,6 +253,30 @@ class MetaElection:
                                                   dict(payload["updates"]))
                     self.meta.reload_state()
                 # seq <= ours: stale duplicate, ignore
+            return True
+        if msg_type == "meta_prevote_req":
+            now = self.meta.clock()
+            leader_fresh = (self.leader is not None
+                            and self.leader != self.meta.name
+                            and src != self.leader
+                            and now - self._last_heartbeat
+                            <= LEASE_SECONDS)
+            if (payload["term"] > self.voted_term and not leader_fresh
+                    and tuple(payload["version"])
+                    >= self.storage.version):
+                # NO state change: a pre-vote promises nothing
+                self.meta.net.send(self.meta.name, src,
+                                   "meta_prevote_ack",
+                                   {"term": payload["term"]})
+            return True
+        if msg_type == "meta_prevote_ack":
+            if (not self.is_leader
+                    and payload["term"] == self.term + 1
+                    and self._prevotes is not None):
+                self._prevotes.add(src)
+                if len(self._prevotes) * 2 > len(self.group):
+                    self._prevotes = None  # one real campaign per round
+                    self._start_election()
             return True
         if msg_type == "meta_vote_req":
             if payload["term"] > self.term:
@@ -313,7 +355,7 @@ class MetaElection:
             # re-arm before campaigning so a failed round retries after
             # another full (still staggered) delay, not every tick
             self._last_heartbeat = now
-            self._start_election()
+            self._start_prevote()
 
     def forward_to_leader(self, src: str, msg_type: str,
                           payload: dict) -> bool:

@@ -158,6 +158,11 @@ def test_one_way_link_loss_no_split_brain(tmp_path):
             leaders = [m.name for m in cluster.metas
                        if m.election.is_leader]
             assert len(leaders) <= 1, leaders
+            # pre-vote: the victim cannot assemble a majority, so the
+            # healthy leader is never dethroned (availability holds,
+            # not just safety) and terms do not inflate
+            assert leaders == [leader.name], leaders
+        assert victim.election.term <= leader.election.term + 1
         # the healthy majority still has a working leader and the
         # cluster still serves writes
         c = cluster.client("t")

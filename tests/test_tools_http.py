@@ -117,3 +117,31 @@ def test_http_endpoints(tmp_path):
         assert err.value.code == 404
     finally:
         srv.stop()
+
+
+def test_pprof_endpoints():
+    import threading
+    import time
+
+    srv = MetricsHttpServer().start()
+    try:
+        # a busy worker thread gives the profiler something to sample
+        stop = threading.Event()
+
+        def spin():
+            while not stop.is_set():
+                sum(i * i for i in range(1000))
+
+        t = threading.Thread(target=spin, daemon=True)
+        t.start()
+        base = f"http://127.0.0.1:{srv.port}"
+        heap = json.loads(urllib.request.urlopen(
+            base + "/pprof/heap", timeout=10).read())
+        assert heap["max_rss_kb"] > 0 and "tracing" in heap
+        prof = json.loads(urllib.request.urlopen(
+            base + "/pprof/profile?seconds=0.4", timeout=15).read())
+        assert prof["samples"] > 0
+        assert any("spin" in s["stack"] for s in prof["stacks"])
+        stop.set()
+    finally:
+        srv.stop()
