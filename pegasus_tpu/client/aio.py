@@ -1,0 +1,84 @@
+"""Async client facade (parity: the async half of the reference C++
+client API, include/pegasus/client.h async_get/async_set/async_multi_get
+/... :42-1180, and the twisted-based python client).
+
+A sync client instance is a SERIAL protocol endpoint (one
+request/reply pump, one config cache), so the facade runs every call on
+one dedicated worker thread guarded by a lock: the asyncio event loop
+is never blocked, calls from many tasks interleave safely, and there is
+ONE code path for the actual protocol. `gather_*` helpers express the
+scatter/join shape of the reference's async API; for true wire-level
+parallelism, shard work across several AsyncPegasusClient instances
+(each wrapping its own sync client), exactly as the reference scales
+with multiple sessions."""
+
+from __future__ import annotations
+
+import asyncio
+from concurrent.futures import ThreadPoolExecutor
+from typing import Optional, Sequence, Tuple
+
+
+class AsyncPegasusClient:
+    """Wraps any sync client (PegasusClient or ClusterClient-backed)."""
+
+    _FORWARDED = (
+        "set", "get", "delete", "exist", "ttl", "incr",
+        "multi_set", "multi_get", "multi_get_sortkeys", "multi_del",
+        "batch_get", "sortkey_count", "check_and_set",
+        "check_and_mutate", "scan_multi", "scan_page", "scan_abort",
+    )
+
+    def __init__(self, client, max_workers: int = 1) -> None:
+        import threading
+
+        self._c = client
+        self._lock = threading.Lock()
+        self._pool = ThreadPoolExecutor(
+            max_workers=max_workers,
+            thread_name_prefix="pegasus-aio")
+
+    def __getattr__(self, name: str):
+        if name not in self._FORWARDED:
+            raise AttributeError(name)
+        fn = getattr(self._c, name)
+
+        async def call(*args, **kwargs):
+            loop = asyncio.get_running_loop()
+
+            def locked():
+                with self._lock:
+                    return fn(*args, **kwargs)
+
+            return await loop.run_in_executor(self._pool, locked)
+
+        return call
+
+    async def gather_get(self, keys: Sequence[Tuple[bytes, bytes]]):
+        """Concurrent point gets; returns [(err, value)] in key order."""
+        return await asyncio.gather(
+            *(self.get(hk, sk) for hk, sk in keys))
+
+    async def gather_set(self, items: Sequence[Tuple[bytes, bytes, bytes]],
+                         ttl_seconds: int = 0):
+        """Concurrent puts; returns [err] in item order."""
+        return await asyncio.gather(
+            *(self.set(hk, sk, v, ttl_seconds) for hk, sk, v in items))
+
+    async def scan_all(self, hash_key: bytes, batch_size: int = 100):
+        """Drain a hashkey scan without blocking the event loop between
+        pages; returns [(hashkey, sortkey, value)]."""
+        from pegasus_tpu.client.client import ScanOptions
+
+        loop = asyncio.get_running_loop()
+
+        def scan():
+            with self._lock:
+                scanner = self._c.get_scanner(
+                    hash_key, options=ScanOptions(batch_size=batch_size))
+                return list(scanner)
+
+        return await loop.run_in_executor(self._pool, scan)
+
+    def close(self) -> None:
+        self._pool.shutdown(wait=False)

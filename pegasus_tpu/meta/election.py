@@ -174,6 +174,24 @@ class MetaElection:
 
     # ---- follower/candidate side ---------------------------------------
 
+    def _refuses_depose(self, src: str, now: float) -> bool:
+        """Live evidence the cluster already has a working leader, so
+        this member should neither grant (pre-)votes nor campaign:
+        - as LEADER: fresh ack contact with a majority (check-quorum —
+          a seated leader must not help a flaky-linked member assemble
+          a deposing majority);
+        - as follower: a fresh lease from a leader other than `src`
+          (the leader itself re-campaigning is never refused)."""
+        if self.is_leader:
+            fresh = 1 + sum(1 for t in self._peer_contact.values()
+                            if now - t <= LEASE_SECONDS
+                            - HEARTBEAT_EVERY)
+            return fresh * 2 > len(self.group)
+        return (self.leader is not None
+                and self.leader != self.meta.name
+                and src != self.leader
+                and now - self._last_heartbeat <= LEASE_SECONDS)
+
     def _start_prevote(self) -> None:
         """Raft-style pre-vote: ask whether a majority WOULD grant a
         vote at term+1 before touching self.term. An isolated member
@@ -184,12 +202,16 @@ class MetaElection:
         heals, its un-inflated term lets the leader's heartbeats
         reintegrate it immediately."""
         self._prevotes = {self.meta.name}
+        # we campaign because the lease EXPIRED — drop the leader
+        # binding now, or tick()'s re-arm of _last_heartbeat would make
+        # the dead leader look fresh to our own _refuses_depose and we
+        # would discard every prevote ack; a real heartbeat re-binds it
+        # and cancels this round
+        self.leader = None
         for peer in self.peers:
             self.meta.net.send(self.meta.name, peer, "meta_prevote_req", {
                 "term": self.term + 1,
                 "version": list(self.storage.version)})
-        if len(self._prevotes) * 2 > len(self.group):  # single-member
-            self._start_election()
 
     def _start_election(self) -> None:
         self.term += 1
@@ -226,6 +248,7 @@ class MetaElection:
                     self._step_down(payload["term"])
                 self.leader = src
                 self._last_heartbeat = self.meta.clock()
+                self._prevotes = None  # live leader: cancel any prevote
                 # the ack is the leader's lease evidence: without it a
                 # partitioned leader would keep is_leader forever and
                 # serve stale leader-only reads (split-brain)
@@ -255,13 +278,8 @@ class MetaElection:
                 # seq <= ours: stale duplicate, ignore
             return True
         if msg_type == "meta_prevote_req":
-            now = self.meta.clock()
-            leader_fresh = (self.leader is not None
-                            and self.leader != self.meta.name
-                            and src != self.leader
-                            and now - self._last_heartbeat
-                            <= LEASE_SECONDS)
-            if (payload["term"] > self.voted_term and not leader_fresh
+            if (payload["term"] > self.voted_term
+                    and not self._refuses_depose(src, self.meta.clock())
                     and tuple(payload["version"])
                     >= self.storage.version):
                 # NO state change: a pre-vote promises nothing
@@ -272,7 +290,11 @@ class MetaElection:
         if msg_type == "meta_prevote_ack":
             if (not self.is_leader
                     and payload["term"] == self.term + 1
-                    and self._prevotes is not None):
+                    and self._prevotes is not None
+                    # a heartbeat may have landed between our prevote
+                    # and this (possibly jitter-delayed) ack — a fresh
+                    # leader cancels the round
+                    and not self._refuses_depose("", self.meta.clock())):
                 self._prevotes.add(src)
                 if len(self._prevotes) * 2 > len(self.group):
                     self._prevotes = None  # one real campaign per round
@@ -284,19 +306,14 @@ class MetaElection:
                 # a stale-state member campaigning faster permanently
                 # outruns everyone else's term and no leader ever wins
                 self._step_down(payload["term"])
-            # lease-sticky voting: while our current leader's lease is
-            # fresh we refuse to elect anyone else — otherwise a node
-            # that merely lost its INBOUND link from the leader can win
-            # a majority while the leader (still acked by us) keeps its
-            # lease: two simultaneous leaders
-            now = self.meta.clock()
-            leader_fresh = (self.leader is not None
-                            and self.leader != self.meta.name
-                            and src != self.leader
-                            and now - self._last_heartbeat
-                            <= LEASE_SECONDS)
+            # lease-sticky voting / check-quorum: while we hold live
+            # evidence of a working leader we refuse to elect anyone
+            # else — otherwise a node that merely lost its INBOUND link
+            # from the leader can win a majority while the leader
+            # (still acked by the rest) keeps its lease: split brain
             grant = (payload["term"] > self.voted_term
-                     and not leader_fresh
+                     and not self._refuses_depose(src,
+                                                  self.meta.clock())
                      and tuple(payload["version"])
                      >= self.storage.version)
             if grant:

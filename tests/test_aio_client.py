@@ -1,0 +1,56 @@
+"""Async client facade (parity: client.h async_* API family)."""
+
+import asyncio
+
+from pegasus_tpu.client import PegasusClient, Table
+from pegasus_tpu.client.aio import AsyncPegasusClient
+
+
+def test_async_client_round_trip(tmp_path):
+    t = Table(str(tmp_path / "t"), partition_count=4)
+    ac = AsyncPegasusClient(PegasusClient(t))
+
+    async def drive():
+        errs = await ac.gather_set(
+            [(b"hk%02d" % i, b"s", b"v%d" % i) for i in range(20)])
+        assert errs == [0] * 20
+        res = await ac.gather_get(
+            [(b"hk%02d" % i, b"s") for i in range(20)])
+        assert res == [(0, b"v%d" % i) for i in range(20)]
+        err, val = await ac.get(b"hk07", b"s")
+        assert (err, val) == (0, b"v7")
+        assert await ac.exist(b"hk07", b"s")
+        resp = await ac.incr(b"cnt", b"c", 5)
+        assert (resp.error, resp.new_value) == (0, 5)
+        await ac.multi_set(b"cart", {b"a": b"1", b"b": b"2"})
+        err, kvs = await ac.multi_get(b"cart")
+        assert err == 0 and dict(kvs) == {b"a": b"1", b"b": b"2"}
+        rows = await ac.scan_all(b"cart")
+        assert len(rows) == 2
+        # concurrency really happens: many gets in flight at once
+        many = await ac.gather_get(
+            [(b"hk%02d" % (i % 20), b"s") for i in range(200)])
+        assert len(many) == 200
+
+    try:
+        asyncio.run(drive())
+    finally:
+        ac.close()
+        t.close()
+
+
+def test_async_client_cluster_backend(tmp_path):
+    from pegasus_tpu.tools.cluster import SimCluster
+
+    cluster = SimCluster(str(tmp_path / "c"), n_nodes=2)
+    try:
+        cluster.create_table("t", partition_count=4)
+        ac = AsyncPegasusClient(cluster.client("t"), max_workers=1)
+
+        async def drive():
+            assert await ac.set(b"h", b"s", b"v") == 0
+            assert await ac.get(b"h", b"s") == (0, b"v")
+        asyncio.run(drive())
+        ac.close()
+    finally:
+        cluster.close()

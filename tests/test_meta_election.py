@@ -170,3 +170,25 @@ def test_one_way_link_loss_no_split_brain(tmp_path):
         assert c.get(b"k", b"s") == (0, b"v")
     finally:
         cluster.close()
+
+
+def test_flaky_link_does_not_dethrone_leader(tmp_path):
+    """Check-quorum: a LOSSY (not fully dead) leader->victim link lets
+    the victim's pre-vote reach the leader — a seated leader with fresh
+    majority contact must refuse to help depose itself."""
+    from pegasus_tpu.tools.cluster import SimCluster
+
+    cluster = SimCluster(str(tmp_path / "c"), n_nodes=3, n_meta=3)
+    try:
+        cluster.create_table("t", partition_count=2)
+        leader = next(m for m in cluster.metas if m.election.is_leader)
+        victim = next(m for m in cluster.metas
+                      if not m.election.is_leader)
+        cluster.net.set_drop(0.7, src=leader.name, dst=victim.name)
+        for _ in range(40):
+            cluster.step()
+            leaders = [m.name for m in cluster.metas
+                       if m.election.is_leader]
+            assert leaders == [leader.name], leaders
+    finally:
+        cluster.close()

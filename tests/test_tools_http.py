@@ -142,6 +142,6 @@ def test_pprof_endpoints():
             base + "/pprof/profile?seconds=0.4", timeout=15).read())
         assert prof["samples"] > 0
         assert any("spin" in s["stack"] for s in prof["stacks"])
-        stop.set()
     finally:
+        stop.set()
         srv.stop()
