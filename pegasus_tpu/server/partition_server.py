@@ -1107,17 +1107,23 @@ class PartitionServer:
                 for ckey, blk, lo, hi in plan:
                     hit = np.flatnonzero(keep_masks[ckey][lo:hi])
                     take = (hit[:want - len(records)] + lo).tolist()
-                    keys_m, kl = blk.keys, blk.key_len
+                    if not take:
+                        continue
+                    if blk._key_list is not None or len(take) * 8 >= blk.count:
+                        # taking a large share of the block (or it is
+                        # already materialized): slice-free row keys
+                        klist = blk.key_list()
+                        row_key = klist.__getitem__
+                    else:
+                        row_key = blk.key_at
                     ets = blk.expire_ts
                     if req.no_value:
                         records.extend(
-                            (keys_m[i, :kl[i]].tobytes(), b"",
-                             int(ets[i])) for i in take)
+                            (row_key(i), b"", int(ets[i])) for i in take)
                     else:
                         vo, heap = blk.value_offs, blk.value_heap
                         records.extend(
-                            (keys_m[i, :kl[i]].tobytes(),
-                             heap[vo[i] + hdr:vo[i + 1]],
+                            (row_key(i), heap[vo[i] + hdr:vo[i + 1]],
                              int(ets[i])) for i in take)
                     if len(records) >= want:
                         resume_key = _after(records[-1][0])

@@ -28,6 +28,7 @@ analogue (src/base/meta_store.h:41).
 
 from __future__ import annotations
 
+import bisect
 import json
 import os
 import struct
@@ -62,13 +63,15 @@ class BlockMeta:
 
 
 class Block:
-    """A decoded columnar block; arrays are views over the file bytes."""
+    """A decoded columnar block; arrays are views over the file bytes\n    (plus, for blocks that prove hot, one lazily materialized Python\n    key list — see key_list())."""
 
     __slots__ = ("keys", "key_len", "expire_ts", "hash_lo", "flags",
-                 "value_offs", "value_heap")
+                 "value_offs", "value_heap", "_key_list", "_gets")
 
     def __init__(self, keys, key_len, expire_ts, hash_lo, flags, value_offs,
                  value_heap):
+        self._key_list = None
+        self._gets = 0
         self.keys = keys              # uint8[N, W]
         self.key_len = key_len        # int32[N]
         self.expire_ts = expire_ts    # uint32[N]
@@ -83,6 +86,19 @@ class Block:
 
     def key_at(self, i: int) -> bytes:
         return self.keys[i, :self.key_len[i]].tobytes()
+
+    def key_list(self) -> list:
+        """All keys as a sorted Python list, materialized at most once
+        per cached block (trades ~key bytes of heap for slice-free
+        bisects — worth it only on blocks that are read repeatedly, so
+        callers on one-shot paths should not force it)."""
+        kl = self._key_list
+        if kl is None:
+            keys, lens = self.keys, self.key_len
+            kl = [keys[i, :lens[i]].tobytes()
+                  for i in range(keys.shape[0])]
+            self._key_list = kl
+        return kl
 
     def value_at(self, i: int) -> bytes:
         return self.value_heap[self.value_offs[i]:self.value_offs[i + 1]]
@@ -281,14 +297,24 @@ class SSTable:
         if idx is None:
             return None
         blk = self.read_block(idx)
-        lo, hi = 0, blk.count
-        while lo < hi:
-            mid = (lo + hi) // 2
-            if blk.key_at(mid) < key:
-                lo = mid + 1
-            else:
-                hi = mid
-        if lo < blk.count and blk.key_at(lo) == key:
+        kl = blk._key_list
+        if kl is None and blk._gets >= 4:
+            kl = blk.key_list()  # hot block: slice-free bisects from now on
+        if kl is not None:
+            lo = bisect.bisect_left(kl, key)
+            found = lo < blk.count and kl[lo] == key
+        else:
+            # cold block: O(log N) row probes, no full materialization
+            blk._gets += 1
+            lo, hi = 0, blk.count
+            while lo < hi:
+                mid = (lo + hi) // 2
+                if blk.key_at(mid) < key:
+                    lo = mid + 1
+                else:
+                    hi = mid
+            found = lo < blk.count and blk.key_at(lo) == key
+        if found:
             if blk.is_tombstone(lo):
                 return (None, 0)
             return (blk.value_at(lo), int(blk.expire_ts[lo]))
