@@ -164,6 +164,28 @@ class ActRunner:
             if not leader:
                 raise ActError("no meta leader to kill")
             c.kill(leader[0].name)
+        elif verb == "bulkload_stage":
+            # stage offline SSTs for the FIRST table: keys k<000..n-1>
+            from pegasus_tpu.server.bulk_load import SSTGenerator
+            from pegasus_tpu.storage.block_service import LocalBlockService
+
+            opts = dict(kv.split("=") for kv in args)
+            n = int(opts.get("records", 40))
+            app = c.meta.state.apps[self.app_id]
+            root = os.path.join(self.dir, "bulk_root")
+            gen = SSTGenerator(LocalBlockService(root), app.app_name,
+                               partition_count=app.partition_count)
+            gen.generate([(b"bl%04d" % i, b"s", b"ingested-%d" % i, 0)
+                          for i in range(n)])
+        elif verb == "bulkload_start":
+            app = c.meta.state.apps[self.app_id]
+            root = os.path.join(self.dir, "bulk_root")
+            c.meta.bulk_load.start_bulk_load(app.app_name, root)
+        elif verb == "expect_bulkload_done":
+            app = c.meta.state.apps[self.app_id]
+            st = c.meta.bulk_load.bulk_load_status(app.app_name)
+            if not st.get("complete"):
+                raise ActError(f"bulk load incomplete: {st}")
         elif verb == "backup":
             root = os.path.join(self.dir, "backup_root")
             self._backup_id = c.meta.backup.start_backup(
