@@ -70,15 +70,79 @@ class MetaBackupService:
                    backup_history_count: int = 3) -> None:
         if name in self._policies:
             raise PegasusError(ErrorCode.ERR_LOCK_ALREADY_EXIST, name)
+        if interval_seconds < 1 or backup_history_count < 1:
+            raise PegasusError(
+                ErrorCode.ERR_INVALID_PARAMETERS,
+                f"interval {interval_seconds} / history "
+                f"{backup_history_count}")
         self._policies[name] = {
             "name": name, "app_names": list(app_names), "root": root,
             "interval_seconds": interval_seconds,
             "backup_history_count": backup_history_count,
+            "enabled": True,
         }
         self._save()
 
     def list_policies(self) -> List[dict]:
         return list(self._policies.values())
+
+    def query_policy(self, name: str) -> dict:
+        pol = self._policies.get(name)
+        if pol is None:
+            raise PegasusError(ErrorCode.ERR_OBJECT_NOT_FOUND, name)
+        recent = [{"backup_id": bid, **info}
+                  for bid, info in self._completed.items()
+                  if info["policy"] == name][-8:]
+        return dict(pol, recent_backups=recent)
+
+    def modify_policy(self, name: str,
+                      add_apps: Optional[List[str]] = None,
+                      remove_apps: Optional[List[str]] = None,
+                      interval_seconds: Optional[int] = None,
+                      backup_history_count: Optional[int] = None) -> dict:
+        """Parity: modify_backup_policy — add/remove covered tables,
+        retune the schedule."""
+        pol = self._policies.get(name)
+        if pol is None:
+            raise PegasusError(ErrorCode.ERR_OBJECT_NOT_FOUND, name)
+        for a in add_apps or []:
+            if a not in pol["app_names"]:
+                pol["app_names"].append(a)
+        for a in remove_apps or []:
+            if a in pol["app_names"]:
+                pol["app_names"].remove(a)
+        if interval_seconds is not None:
+            if interval_seconds < 1:
+                raise PegasusError(ErrorCode.ERR_INVALID_PARAMETERS,
+                                   f"interval {interval_seconds}")
+            pol["interval_seconds"] = interval_seconds
+        if backup_history_count is not None:
+            if backup_history_count < 1:
+                raise PegasusError(ErrorCode.ERR_INVALID_PARAMETERS,
+                                   f"history count {backup_history_count}")
+            pol["backup_history_count"] = backup_history_count
+        self._save()
+        return pol
+
+    def on_app_renamed(self, old_name: str, new_name: str) -> None:
+        """Keep name-keyed policy coverage intact across a rename."""
+        changed = False
+        for pol in self._policies.values():
+            if old_name in pol["app_names"]:
+                pol["app_names"] = [new_name if a == old_name else a
+                                    for a in pol["app_names"]]
+                changed = True
+        if changed:
+            self._save()
+
+    def enable_policy(self, name: str, enabled: bool) -> None:
+        """Parity: enable/disable_backup_policy — a disabled policy keeps
+        its history and config but schedules nothing."""
+        pol = self._policies.get(name)
+        if pol is None:
+            raise PegasusError(ErrorCode.ERR_OBJECT_NOT_FOUND, name)
+        pol["enabled"] = enabled
+        self._save()
 
     # ---- one-shot backup ----------------------------------------------
 
@@ -192,6 +256,8 @@ class MetaBackupService:
     def tick(self) -> None:
         now = self.meta.clock()
         for name, pol in self._policies.items():
+            if not pol.get("enabled", True):
+                continue
             last = self._last_policy_run.get(name)
             if last is not None and now - last < pol["interval_seconds"]:
                 continue

@@ -90,8 +90,52 @@ class MetaBulkLoadService:
             return {"complete": True, "failed": False,
                     "pending": [], "inflight": []}
         return {"complete": False, "failed": False,
+                "paused": bool(info.get("paused")),
                 "pending": list(info["pending"]),
                 "inflight": list(info["inflight"])}
+
+    def _find_load(self, app_name: str) -> Tuple[int, dict]:
+        app = self.meta.state.find_app(app_name)
+        if app is None:
+            raise PegasusError(ErrorCode.ERR_APP_NOT_EXIST, app_name)
+        info = self._loads.get(app.app_id)
+        if info is None:
+            raise PegasusError(ErrorCode.ERR_INVALID_STATE,
+                               f"no bulk load in progress on {app_name}")
+        return app.app_id, info
+
+    def pause_bulk_load(self, app_name: str) -> None:
+        """Parity: pause_bulk_load — in-flight partition ingests finish,
+        no new ones start until restart."""
+        app_id, info = self._find_load(app_name)
+        info["paused"] = True
+        self._save()
+
+    def restart_bulk_load(self, app_name: str) -> None:
+        app_id, info = self._find_load(app_name)
+        info["paused"] = False
+        self._save()
+        self._drive(app_id)
+
+    def cancel_bulk_load(self, app_name: str) -> None:
+        """Parity: cancel_bulk_load — abandon the remaining partitions.
+        Already-ingested partitions keep their data (the reference's
+        cancel likewise leaves ingested SSTs in place); the operator
+        clears or re-runs as needed."""
+        app_id, info = self._find_load(app_name)
+        self._failed[app_id] = "canceled by operator"
+        del self._loads[app_id]
+        self._save()
+
+    def clear_bulk_load(self, app_name: str) -> None:
+        """Parity: clear_bulk_load — drop any load state / failure record
+        so a fresh start_bulk_load begins clean."""
+        app = self.meta.state.find_app(app_name)
+        if app is None:
+            raise PegasusError(ErrorCode.ERR_APP_NOT_EXIST, app_name)
+        self._loads.pop(app.app_id, None)
+        self._failed.pop(app.app_id, None)
+        self._save()
 
     # ---- state machine -------------------------------------------------
 
@@ -99,7 +143,7 @@ class MetaBulkLoadService:
         """Fill the rolling window (parity: the ingestion context caps
         concurrent ingests so compaction debt stays bounded)."""
         info = self._loads.get(app_id)
-        if info is None:
+        if info is None or info.get("paused"):
             return
         while (info["pending"]
                and len(info["inflight"]) < self.max_concurrent):

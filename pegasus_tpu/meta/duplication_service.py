@@ -138,11 +138,58 @@ class MetaDuplicationService:
         self._save()
         if info is None:
             return
+        self._stop_sessions(dupid, info)
+
+    def _stop_sessions(self, dupid: int, info: dict) -> None:
         for pidx in range(len(info["progress"])):
             pc = self.meta.state.get_partition(info["app_id"], pidx)
             for node in pc.members():
                 self.meta.net.send(self.meta.name, node, "dup_remove", {
                     "gpid": (info["app_id"], pidx), "dupid": dupid})
+
+    def pause_duplication(self, dupid: int) -> None:
+        """Parity: the shell's pause_dup (dup status DS_PAUSE). Replica
+        sessions are torn down; confirmed progress stays at meta, so
+        resuming re-ships from the confirmed decree (idempotent on the
+        follower via timetags)."""
+        info = self._dups.get(dupid)
+        if info is None:
+            raise PegasusError(ErrorCode.ERR_OBJECT_NOT_FOUND, str(dupid))
+        if info["status"] != "start":
+            raise PegasusError(
+                ErrorCode.ERR_INVALID_STATE,
+                f"dup {dupid} is {info['status']}, not started")
+        info["status"] = "pause"
+        self._save()
+        self._stop_sessions(dupid, info)
+
+    def resume_duplication(self, dupid: int) -> None:
+        """Parity: start_dup on a paused duplication (DS_PAUSE->DS_START)."""
+        info = self._dups.get(dupid)
+        if info is None:
+            raise PegasusError(ErrorCode.ERR_OBJECT_NOT_FOUND, str(dupid))
+        if info["status"] != "pause":
+            raise PegasusError(
+                ErrorCode.ERR_INVALID_STATE,
+                f"dup {dupid} is {info['status']}, not paused")
+        info["status"] = "start"
+        self._save()
+        self._drive(dupid)
+
+    def set_fail_mode(self, dupid: int, fail_mode: str) -> None:
+        """Parity: set_dup_fail_mode FAIL_SLOW|FAIL_SKIP
+        (duplication_info fail_mode): slow = retry the same mutation
+        forever; skip = give up on a mutation after bounded retries and
+        advance (data loss accepted by the operator)."""
+        if fail_mode not in ("slow", "skip"):
+            raise PegasusError(ErrorCode.ERR_INVALID_PARAMETERS, fail_mode)
+        info = self._dups.get(dupid)
+        if info is None:
+            raise PegasusError(ErrorCode.ERR_OBJECT_NOT_FOUND, str(dupid))
+        info["fail_mode"] = fail_mode
+        self._save()
+        if info["status"] == "start":
+            self._drive(dupid)  # re-announce so live sessions pick it up
 
     # ---- progress sync (parity: RPC_CM_DUPLICATION_SYNC) ---------------
 
@@ -169,7 +216,8 @@ class MetaDuplicationService:
                 "gpid": (info["app_id"], pidx), "dupid": dupid,
                 "follower_meta": info["follower_meta"],
                 "follower_app": info["follower_app"],
-                "confirmed": confirmed})
+                "confirmed": confirmed,
+                "fail_mode": info.get("fail_mode", "slow")})
 
     def tick(self) -> None:
         for dupid, info in list(self._dups.items()):

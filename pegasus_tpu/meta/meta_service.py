@@ -79,6 +79,13 @@ class MetaService:
         self.bulk_load = MetaBulkLoadService(self)
         self.duplication = MetaDuplicationService(self)
         self.split = MetaSplitService(self)
+        # cluster function level (parity: meta_function_level / shell
+        # get_meta_level|set_meta_level): "freezed" = no guardian cures
+        # or proposals; "steady" = cures but manual balance only
+        # (default); "lively" = auto-rebalance on the guardian timer
+        self.function_level = self.storage.get("/meta_level") or "steady"
+        self._lively_last_balance = 0.0
+        self._lively_interval = 30.0
         from pegasus_tpu.utils.command_manager import CommandManager
 
         self.commands = CommandManager()
@@ -115,6 +122,7 @@ class MetaService:
         self.bulk_load._load_state()
         self.duplication._load()
         self.split._load()
+        self.function_level = self.storage.get("/meta_level") or "steady"
 
     # ---- messages -----------------------------------------------------
 
@@ -229,12 +237,24 @@ class MetaService:
         self.election.tick()
         if not self.election.is_leader:
             return
-        self.fd.check(self.clock())
-        self._guardian_pass()
+        if self.function_level != "freezed":
+            # frozen: beacons still refresh leases but nothing is
+            # DECLARED dead (fd.check skipped) and no cures run —
+            # unfreezing replays missed death declarations on the next
+            # tick. Orchestration (backup/bulk-load/dup/split) below
+            # keeps ticking either way: fl_freezed stops cure/balance
+            # CONFIG actions, not in-flight operational state machines.
+            self.fd.check(self.clock())
+            self._guardian_pass()
         self.backup.tick()
         self.bulk_load.tick()
         self.duplication.tick()
         self.split.tick()
+        if self.function_level == "lively":
+            now = self.clock()
+            if now - self._lively_last_balance >= self._lively_interval:
+                self._lively_last_balance = now
+                self.rebalance()
 
     def http_routes(self) -> dict:
         """The cluster/table info REST surface (parity:
@@ -345,6 +365,65 @@ class MetaService:
                     args["app_name"])
             elif cmd == "split_status":
                 result = self.split.split_status(args["app_name"])
+            elif cmd == "del_app_envs":
+                result = self.del_app_envs(args["app_name"], args["keys"])
+            elif cmd == "clear_app_envs":
+                result = self.clear_app_envs(args["app_name"],
+                                             args.get("prefix", ""))
+            elif cmd == "rename_app":
+                result = self.rename_app(args["old_name"],
+                                         args["new_name"])
+            elif cmd == "get_meta_level":
+                result = self.function_level
+            elif cmd == "set_meta_level":
+                result = self.set_meta_level(args["level"])
+            elif cmd == "get_replica_count":
+                app = self.state.find_app(args["app_name"])
+                if app is None:
+                    raise PegasusError(ErrorCode.ERR_APP_NOT_EXIST,
+                                       args["app_name"])
+                result = app.max_replica_count
+            elif cmd == "set_replica_count":
+                result = self.set_app_replica_count(args["app_name"],
+                                                    args["count"])
+            elif cmd == "cluster_info":
+                result = self.cluster_info()
+            elif cmd == "ddd_diagnose":
+                result = self.ddd_diagnose()
+            elif cmd == "propose":
+                result = self.propose(args["app_name"], args["pidx"],
+                                      args["action"], args["node"],
+                                      force=bool(args.get("force")))
+            elif cmd == "ls_backup_policy":
+                result = self.backup.list_policies()
+            elif cmd == "query_backup_policy":
+                result = self.backup.query_policy(args["name"])
+            elif cmd == "modify_backup_policy":
+                result = self.backup.modify_policy(
+                    args["name"], add_apps=args.get("add_apps"),
+                    remove_apps=args.get("remove_apps"),
+                    interval_seconds=args.get("interval_seconds"),
+                    backup_history_count=args.get("backup_history_count"))
+            elif cmd == "enable_backup_policy":
+                result = self.backup.enable_policy(args["name"], True)
+            elif cmd == "disable_backup_policy":
+                result = self.backup.enable_policy(args["name"], False)
+            elif cmd == "pause_dup":
+                result = self.duplication.pause_duplication(args["dupid"])
+            elif cmd == "start_dup":
+                result = self.duplication.resume_duplication(args["dupid"])
+            elif cmd == "set_dup_fail_mode":
+                result = self.duplication.set_fail_mode(args["dupid"],
+                                                        args["fail_mode"])
+            elif cmd == "pause_bulk_load":
+                result = self.bulk_load.pause_bulk_load(args["app_name"])
+            elif cmd == "restart_bulk_load":
+                result = self.bulk_load.restart_bulk_load(
+                    args["app_name"])
+            elif cmd == "cancel_bulk_load":
+                result = self.bulk_load.cancel_bulk_load(args["app_name"])
+            elif cmd == "clear_bulk_load":
+                result = self.bulk_load.clear_bulk_load(args["app_name"])
             else:
                 self.net.send(self.name, src, "admin_reply", {
                     "rid": rid,
@@ -526,6 +605,169 @@ class MetaService:
         self.state.put_app(app)
         self._propagate_envs(app)
 
+    def del_app_envs(self, app_name: str, keys: List[str]) -> int:
+        """Parity: shell del_app_envs — drop named per-table envs; the
+        full (reduced) set re-propagates so nodes converge on removal."""
+        app = self.state.find_app(app_name)
+        if app is None:
+            raise PegasusError(ErrorCode.ERR_APP_NOT_EXIST, app_name)
+        removed = 0
+        for k in keys:
+            removed += app.envs.pop(k, None) is not None
+        self.state.put_app(app)
+        self._propagate_envs(app)
+        return removed
+
+    def clear_app_envs(self, app_name: str,
+                       prefix: str = "") -> int:
+        """Parity: shell clear_app_envs [-p prefix]."""
+        app = self.state.find_app(app_name)
+        if app is None:
+            raise PegasusError(ErrorCode.ERR_APP_NOT_EXIST, app_name)
+        victims = [k for k in app.envs if k.startswith(prefix)]
+        for k in victims:
+            del app.envs[k]
+        self.state.put_app(app)
+        self._propagate_envs(app)
+        return len(victims)
+
+    def rename_app(self, old_name: str, new_name: str) -> None:
+        """Parity: shell rename (RPC_CM_RENAME_APP). Routing is by
+        app_id, so a rename is pure metadata — clients resolving the new
+        name pick up the same partitions on their next config query."""
+        if self.state.find_app(new_name) is not None:
+            raise PegasusError(ErrorCode.ERR_INVALID_PARAMETERS,
+                               f"{new_name} already exists")
+        app = self.state.find_app(old_name)
+        if app is None:
+            raise PegasusError(ErrorCode.ERR_APP_NOT_EXIST, old_name)
+        app.app_name = new_name
+        self.state.put_app(app)
+        # backup policies cover tables BY NAME — follow the rename or
+        # the table silently drops out of its backup schedule
+        self.backup.on_app_renamed(old_name, new_name)
+
+    def set_meta_level(self, level: str) -> str:
+        """Parity: shell set_meta_level (RPC_CM_CONTROL_META).
+        freezed|steady|lively — see function_level in __init__."""
+        if level not in ("freezed", "steady", "lively"):
+            raise PegasusError(ErrorCode.ERR_INVALID_PARAMETERS, level)
+        self.function_level = level
+        self.storage.set("/meta_level", level)
+        return level
+
+    def set_app_replica_count(self, app_name: str, count: int) -> int:
+        """Parity: shell set_replica_count (online max_replica_count
+        update, RPC_CM_SET_MAX_REPLICA_COUNT). The guardian converges
+        membership: add-learner cures grow under-replicated partitions;
+        the over-replication shed path drains extras one per tick."""
+        if count < 1:
+            raise PegasusError(ErrorCode.ERR_INVALID_PARAMETERS,
+                               str(count))
+        app = self.state.find_app(app_name)
+        if app is None:
+            raise PegasusError(ErrorCode.ERR_APP_NOT_EXIST, app_name)
+        app.max_replica_count = count
+        self.state.put_app(app)
+        return count
+
+    def cluster_info(self) -> dict:
+        """Parity: shell cluster_info."""
+        apps = self.list_apps()
+        return {
+            "meta": self.name,
+            "meta_leader": self.election.leader,
+            "term": self.election.term,
+            "meta_level": self.function_level,
+            "alive_nodes": self.fd.alive_workers(),
+            "app_count": len(apps),
+            "partition_count": sum(a.partition_count for a in apps),
+            "state_seq": self.storage.seq,
+        }
+
+    def ddd_diagnose(self) -> List[dict]:
+        """Parity: shell ddd_diagnose (DDD = 'double-dead diagnosis',
+        partition_guardian's on_ddd): partitions with no live primary —
+        the guardian cannot cure them without operator action (a member
+        returning, or a `propose` forcing a primary)."""
+        out = []
+        for app in self.list_apps():
+            for pidx in range(app.partition_count):
+                pc = self.state.get_partition(app.app_id, pidx)
+                dead_primary = bool(pc.primary) and not self.fd.is_alive(
+                    pc.primary)
+                if pc.primary and not dead_primary:
+                    continue
+                out.append({
+                    "gpid": [app.app_id, pidx],
+                    "app_name": app.app_name,
+                    "ballot": pc.ballot,
+                    "last_primary": pc.primary,
+                    "secondaries": list(pc.secondaries),
+                    "alive_members": [m for m in pc.members()
+                                      if self.fd.is_alive(m)],
+                })
+        return out
+
+    def propose(self, app_name: str, pidx: int, action: str,
+                node: str, force: bool = False) -> None:
+        """Parity: shell propose — a manual config proposal
+        (ASSIGN_PRIMARY / ADD_SECONDARY / DOWNGRADE_TO_INACTIVE) for
+        operator-driven recovery of partitions the guardian won't touch.
+
+        assign_primary requires `node` to be alive and (unless `force`)
+        already a member holding the partition's data — promoting a
+        non-member opens an EMPTY replica there and serves empty reads.
+        `force=True` is the operator's explicit data-loss acknowledgment
+        for unrecoverable partitions."""
+        app = self.state.find_app(app_name)
+        if app is None:
+            raise PegasusError(ErrorCode.ERR_APP_NOT_EXIST, app_name)
+        if not 0 <= pidx < app.partition_count:
+            raise PegasusError(ErrorCode.ERR_INVALID_PARAMETERS,
+                               f"pidx {pidx}")
+        gpid = (app.app_id, pidx)
+        pc = self.state.get_partition(app.app_id, pidx)
+        if action in ("assign_primary", "add_secondary"):
+            if not self.fd.is_alive(node):
+                raise PegasusError(ErrorCode.ERR_INVALID_PARAMETERS,
+                                   f"{node} is not alive")
+        if action == "assign_primary":
+            if pc.primary == node:
+                return
+            if node not in pc.members() and not force:
+                raise PegasusError(
+                    ErrorCode.ERR_INVALID_PARAMETERS,
+                    f"{node} holds no replica of {app_name}.{pidx} — "
+                    "pass force=true to accept an empty primary")
+            new_pc = PartitionConfig(
+                ballot=pc.ballot + 1, primary=node,
+                secondaries=[s for s in pc.secondaries if s != node] +
+                            ([pc.primary] if pc.primary else []))
+        elif action == "add_secondary":
+            if node in pc.members():
+                return
+            if not pc.primary:
+                raise PegasusError(ErrorCode.ERR_INVALID_STATE,
+                                   "no primary to learn from")
+            self._pending_learns[gpid] = (node, self.clock())
+            self.net.send(self.name, pc.primary, "add_learner_cmd", {
+                "gpid": gpid, "learner": node})
+            return
+        elif action == "downgrade":
+            if node not in pc.secondaries:
+                raise PegasusError(ErrorCode.ERR_INVALID_PARAMETERS,
+                                   f"{node} is not a secondary")
+            new_pc = PartitionConfig(
+                ballot=pc.ballot + 1, primary=pc.primary,
+                secondaries=[s for s in pc.secondaries if s != node])
+        else:
+            raise PegasusError(ErrorCode.ERR_INVALID_PARAMETERS, action)
+        self.state.update_partition(app.app_id, pidx, new_pc)
+        self._propose(app.app_id, pidx, new_pc)
+        if action == "downgrade":
+            self._send_proposal(node, app, pidx, new_pc)
+
     # ---- guardian (parity: partition_guardian.h:41) -------------------
 
     def _on_node_dead(self, node: str) -> None:
@@ -594,6 +836,23 @@ class MetaService:
                                 # removal or a later unrelated learn would
                                 # strip a healthy secondary
                                 self._pending_moves.pop(gpid, None)
+                    elif (len(pc.members()) > app.max_replica_count
+                            and pc.secondaries):
+                        # over-replicated (set_replica_count lowered the
+                        # target): shed one secondary per pass — gradual,
+                        # like the guardian's one-cure-per-tick style.
+                        # Prefer shedding a dead one.
+                        victim = next((s for s in pc.secondaries
+                                       if not self.fd.is_alive(s)),
+                                      pc.secondaries[-1])
+                        new_pc = PartitionConfig(
+                            ballot=pc.ballot + 1, primary=pc.primary,
+                            secondaries=[s for s in pc.secondaries
+                                         if s != victim])
+                        self.state.update_partition(app.app_id, pidx,
+                                                    new_pc)
+                        self._propose(app.app_id, pidx, new_pc)
+                        self._send_proposal(victim, app, pidx, new_pc)
                     continue
                 if pending is not None:
                     learner, started = pending

@@ -38,6 +38,10 @@ from pegasus_tpu.rpc.codec import (
 
 _RIDS = itertools.count(1_000_000)
 
+# fail_mode "skip": rejections of the same decree tolerated before the
+# mutation is abandoned (each retry is a full re-resolve + re-ship round)
+_FAIL_SKIP_RETRIES = 3
+
 
 class ClusterDuplicator:
     """One partition's dup session on its primary's node.
@@ -53,8 +57,8 @@ class ClusterDuplicator:
                  follower_meta: str, follower_app: str,
                  confirmed_decree: int = 0,
                  source_cluster_id: int = 1,
-                 on_progress: Optional[Callable[[int, int], None]] = None
-                 ) -> None:
+                 on_progress: Optional[Callable[[int, int], None]] = None,
+                 fail_mode: str = "slow") -> None:
         self.stub = stub
         self.gpid = gpid
         self.dupid = dupid
@@ -63,6 +67,13 @@ class ClusterDuplicator:
         self.confirmed_decree = confirmed_decree
         self.source_cluster_id = source_cluster_id
         self.on_progress = on_progress
+        # "slow": retry a rejected mutation forever (default, lossless);
+        # "skip": after _FAIL_SKIP_RETRIES rejections of the SAME decree,
+        # confirm past it (parity: duplication fail_mode FAIL_SKIP —
+        # operator-sanctioned loss to un-wedge a stuck pipeline)
+        self.fail_mode = fail_mode
+        self._fail_decree: Optional[int] = None
+        self._fail_count = 0
         self._fconfig: Optional[dict] = None  # follower app config
         self._config_rid: Optional[int] = None
         # in-flight mutation: decree + outstanding write rids
@@ -210,6 +221,20 @@ class ClusterDuplicator:
         if rid not in self._outstanding:
             return False
         if payload["err"] != 0:
+            decree = self._inflight_decree
+            if self.fail_mode == "skip" and decree is not None:
+                if self._fail_decree == decree:
+                    self._fail_count += 1
+                else:
+                    self._fail_decree, self._fail_count = decree, 1
+                if self._fail_count >= _FAIL_SKIP_RETRIES:
+                    # operator chose loss over a wedged pipeline: confirm
+                    # past the poison mutation and move on
+                    self._advance(decree, self._inflight_frame_end)
+                    self._fail_decree, self._fail_count = None, 0
+                    self._inflight_decree = None
+                    self._outstanding = {}
+                    return True
             # follower rejected (failover/stale config): re-resolve and
             # re-ship the whole mutation — idempotent on the follower
             self._fconfig = None

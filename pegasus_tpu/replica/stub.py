@@ -573,7 +573,9 @@ class ReplicaStub:
         """Meta propagates table envs (parity: config-sync env delivery)."""
         for gpid, r in self.replicas.items():
             if gpid[0] == payload["app_id"]:
-                r.server.update_app_envs(payload["envs"])
+                # meta always sends the table's complete env map, so
+                # absent keys are deletions to un-apply
+                r.server.update_app_envs(payload["envs"], full_set=True)
 
     # ---- meta-driven backup / restore (parity: the replica-side cold
     # backup flow, replica/replica_backup.cpp, and restore,
@@ -910,6 +912,8 @@ class ReplicaStub:
             return  # meta re-sends to the current primary on its tick
         key = (gpid, dupid)
         if key in self._dup_sessions:
+            self._dup_sessions[key].fail_mode = payload.get("fail_mode",
+                                                            "slow")
             return
 
         def progress(dup_id: int, confirmed: int) -> None:
@@ -924,7 +928,8 @@ class ReplicaStub:
             payload["follower_app"],
             confirmed_decree=payload.get("confirmed", 0),
             source_cluster_id=payload.get("source_cluster_id", 1),
-            on_progress=progress)
+            on_progress=progress,
+            fail_mode=payload.get("fail_mode", "slow"))
 
     def dup_tick(self) -> None:
         """Timer: drive every dup session (parity: duplication_sync_timer).
@@ -989,8 +994,10 @@ class ReplicaStub:
             r = self._open_replica(gpid, entry["partition_count"])
             r.assign_config(ReplicaConfig(entry["ballot"], entry["primary"],
                                           list(entry["secondaries"])))
-            if entry.get("envs"):
-                r.server.update_app_envs(entry["envs"])
+            if "envs" in entry:
+                # authoritative full set from meta — empty means ALL
+                # table envs were deleted and must be un-applied
+                r.server.update_app_envs(entry["envs"], full_set=True)
         for gpid in payload.get("gc", []):
             gpid = tuple(gpid)
             r = self.replicas.pop(gpid, None)

@@ -167,17 +167,39 @@ class PartitionServer:
             "rules_filter": self._compaction_rules,
         }
 
-    def update_app_envs(self, envs: dict) -> None:
+    # env key -> (derived attr, reset-to-default parsed value); used when
+    # a FULL env set arrives and a previously-set key is now absent
+    # (del_app_envs/clear_app_envs must un-apply, not just stop updating)
+    _ENV_DEFAULTS = {
+        "replica.deny_client_request": ("_deny_client", ""),
+        "replica.write_throttling": ("_write_throttle", None),
+        "replica.read_throttling": ("_read_throttle", None),
+        "default_ttl": ("_default_ttl", 0),
+        "replica.slow_query_threshold_ms": ("_slow_threshold_ms", 20.0),
+        "rocksdb.usage_scenario": ("_usage_scenario", "normal"),
+        "user_specified_compaction": ("_compaction_rules", None),
+    }
+
+    def update_app_envs(self, envs: dict, full_set: bool = False) -> None:
         """Apply per-table dynamic settings (parity: replica_envs keys
         ROCKSDB_ENV_* / deny_client_request / *throttling /
         user_specified_compaction / default_ttl). Validation is two-phase:
         every value parses first, then everything applies — a malformed
         env never leaves half-applied state (parity:
-        meta/app_env_validator rejects before propagation)."""
+        meta/app_env_validator rejects before propagation).
+
+        `full_set=True` means `envs` is the table's COMPLETE env map
+        (meta propagation / config sync): recognized keys that were set
+        before but are absent now reset to their defaults, so
+        del_app_envs/clear_app_envs converge on the replicas."""
         from pegasus_tpu.ops.compaction_rules import compile_rules
         from pegasus_tpu.utils.token_bucket import parse_throttle_env
 
         staged = []
+        if full_set:
+            for key, (attr, dflt) in self._ENV_DEFAULTS.items():
+                if key in self.app_envs and key not in envs:
+                    staged.append((attr, dflt))
         for key, value in envs.items():
             try:
                 if key == "replica.deny_client_request":
@@ -211,7 +233,10 @@ class PartitionServer:
                 self._apply_usage_scenario(parsed)
             else:
                 setattr(self, attr, parsed)
-        self.app_envs.update(envs)
+        if full_set:
+            self.app_envs = dict(envs)
+        else:
+            self.app_envs.update(envs)
 
     def _apply_usage_scenario(self, scenario: str) -> None:
         """Parity: the usage-scenario dynamic tuning

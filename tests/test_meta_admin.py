@@ -1,0 +1,287 @@
+"""Operator-surface meta features: function level, online replica-count
+update, rename, DDD diagnosis, manual proposals, backup-policy controls,
+bulk-load pause/cancel, duplication pause/fail-mode.
+
+Parity: meta_service.cpp admin RPC surface (RPC_CM_CONTROL_META,
+RPC_CM_SET_MAX_REPLICA_COUNT, RPC_CM_RENAME_APP, ddd_diagnose,
+RPC_CM_PROPOSE_BALANCER), meta_backup_service policy RPCs,
+meta_bulk_load_service control RPCs, duplication fail_mode.
+"""
+
+import pytest
+
+from pegasus_tpu.tools.cluster import SimCluster
+from pegasus_tpu.utils.errors import PegasusError, StorageStatus
+
+OK = int(StorageStatus.OK)
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    c = SimCluster(str(tmp_path / "cluster"), n_nodes=4)
+    yield c
+    c.close()
+
+
+def _fill(client, n=20, prefix=b"k"):
+    for i in range(n):
+        assert client.set(b"%s%03d" % (prefix, i), b"s", b"v%d" % i) == OK
+
+
+# ---- meta function level -------------------------------------------------
+
+def test_freezed_level_blocks_cures_until_unfrozen(cluster):
+    app_id = cluster.create_table("fl", partition_count=4)
+    c = cluster.client("fl")
+    _fill(c)
+    assert cluster.meta.set_meta_level("freezed") == "freezed"
+    victim = cluster.meta.state.get_partition(app_id, 0).primary
+    ballot_before = cluster.meta.state.get_partition(app_id, 0).ballot
+    cluster.kill(victim)
+    cluster.step(rounds=10)
+    # frozen: nothing was declared dead, no promote happened
+    pc = cluster.meta.state.get_partition(app_id, 0)
+    assert pc.primary == victim
+    assert pc.ballot == ballot_before
+    # unfreeze: the missed death is declared and the cure runs
+    cluster.meta.set_meta_level("steady")
+    cluster.step(rounds=8)
+    pc = cluster.meta.state.get_partition(app_id, 0)
+    assert pc.primary and pc.primary != victim
+    assert c.get(b"k000", b"s") == (OK, b"v0")
+
+
+def test_meta_level_persists_and_validates(cluster):
+    with pytest.raises(PegasusError):
+        cluster.meta.set_meta_level("bogus")
+    cluster.meta.set_meta_level("lively")
+    assert cluster.meta.storage.get("/meta_level") == "lively"
+    assert cluster.meta.cluster_info()["meta_level"] == "lively"
+
+
+# ---- online replica count ------------------------------------------------
+
+def test_set_replica_count_grows_membership(cluster):
+    app_id = cluster.create_table("rc", partition_count=4,
+                                  replica_count=2)
+    c = cluster.client("rc")
+    _fill(c)
+    assert cluster.meta.set_app_replica_count("rc", 3) == 3
+    for _ in range(20):
+        cluster.step()
+        if all(len(cluster.meta.state.get_partition(app_id, p).members())
+               == 3 for p in range(4)):
+            break
+    for p in range(4):
+        assert len(cluster.meta.state.get_partition(
+            app_id, p).members()) == 3, p
+    # data still served
+    assert c.get(b"k001", b"s") == (OK, b"v1")
+
+
+def test_set_replica_count_sheds_extras(cluster):
+    app_id = cluster.create_table("rcd", partition_count=4,
+                                  replica_count=3)
+    c = cluster.client("rcd")
+    _fill(c)
+    cluster.meta.set_app_replica_count("rcd", 2)
+    for _ in range(20):
+        cluster.step()
+        if all(len(cluster.meta.state.get_partition(app_id, p).members())
+               == 2 for p in range(4)):
+            break
+    for p in range(4):
+        pc = cluster.meta.state.get_partition(app_id, p)
+        assert len(pc.members()) == 2, (p, pc)
+        assert pc.primary  # the primary is never the shed victim
+    assert c.get(b"k002", b"s") == (OK, b"v2")
+
+
+# ---- rename --------------------------------------------------------------
+
+def test_rename_app(cluster):
+    cluster.create_table("old_name", partition_count=2)
+    c = cluster.client("old_name")
+    _fill(c, 5)
+    cluster.meta.rename_app("old_name", "new_name")
+    assert cluster.meta.state.find_app("old_name") is None
+    c2 = cluster.client("new_name")
+    assert c2.get(b"k000", b"s") == (OK, b"v0")
+    with pytest.raises(PegasusError):
+        cluster.meta.rename_app("nope", "other")
+    cluster.create_table("third", partition_count=2)
+    with pytest.raises(PegasusError):
+        cluster.meta.rename_app("third", "new_name")  # collision
+
+
+def test_del_app_envs_unapplies_on_replicas(cluster):
+    """A deleted env must be UN-applied, not just stop updating: deny
+    gate lifted, throttle removed, default TTL back to none."""
+    cluster.create_table("ev", partition_count=2)
+    c = cluster.client("ev")
+    _fill(c, 3)
+    cluster.meta.update_app_envs(
+        "ev", {"replica.deny_client_request": "timeout*all",
+               "default_ttl": "60"})
+    cluster.step(rounds=2)
+    assert c.set(b"blocked", b"s", b"x") != OK  # deny active
+    assert cluster.meta.del_app_envs(
+        "ev", ["replica.deny_client_request", "default_ttl"]) == 2
+    cluster.step(rounds=2)
+    assert c.set(b"unblocked", b"s", b"x") == OK  # deny lifted
+    _err, ttl = c.ttl(b"unblocked", b"s")
+    assert ttl < 0  # default_ttl reset: no implicit ttl
+    # clear_app_envs converges too
+    cluster.meta.update_app_envs(
+        "ev", {"replica.deny_client_request": "timeout*write"})
+    cluster.step(rounds=2)
+    assert c.set(b"again", b"s", b"x") != OK
+    cluster.meta.clear_app_envs("ev")
+    cluster.step(rounds=2)
+    assert c.set(b"again", b"s", b"x") == OK
+
+
+# ---- DDD diagnose + propose ----------------------------------------------
+
+def test_ddd_diagnose_and_manual_propose(cluster):
+    app_id = cluster.create_table("dd", partition_count=2,
+                                  replica_count=3)
+    c = cluster.client("dd")
+    _fill(c, 10)
+    members = cluster.meta.state.get_partition(app_id, 0).members()
+    for m in members:
+        cluster.kill(m)
+    cluster.step(rounds=8)  # FD grace expiry; no cure possible
+    ddd = cluster.meta.ddd_diagnose()
+    assert any(tuple(d["gpid"]) == (app_id, 0) for d in ddd), ddd
+    # operator revives one former member and forces primaryship onto it
+    cluster.revive(members[0])
+    cluster.step(rounds=6)
+    if cluster.meta.state.get_partition(app_id, 0).primary != members[0]:
+        cluster.meta.propose("dd", 0, "assign_primary", members[0])
+        cluster.step(rounds=4)
+    pc = cluster.meta.state.get_partition(app_id, 0)
+    assert pc.primary == members[0]
+    # downgrade proposal removes a secondary
+    app2 = cluster.create_table("dd2", partition_count=1,
+                                replica_count=3)
+    cluster.step(rounds=2)
+    pc2 = cluster.meta.state.get_partition(app2, 0)
+    sec = pc2.secondaries[0]
+    cluster.meta.propose("dd2", 0, "downgrade", sec)
+    pc2 = cluster.meta.state.get_partition(app2, 0)
+    assert sec not in pc2.members()
+
+
+# ---- backup policy controls ----------------------------------------------
+
+def test_backup_policy_enable_disable_modify(cluster, tmp_path):
+    cluster.create_table("bp", partition_count=2)
+    c = cluster.client("bp")
+    _fill(c, 8)
+    root = str(tmp_path / "bucket")
+    cluster.meta.backup.add_policy("daily", ["bp"], root,
+                                   interval_seconds=5)
+    cluster.meta.backup.enable_policy("daily", False)
+    cluster.step(rounds=8)
+    from pegasus_tpu.server.backup import BackupEngine
+    from pegasus_tpu.storage.block_service import LocalBlockService
+
+    be = BackupEngine(LocalBlockService(root), "daily")
+    assert be.list_backups() == []  # disabled: nothing scheduled
+    cluster.meta.backup.enable_policy("daily", True)
+    cluster.step(rounds=8)
+    assert len(be.list_backups()) >= 1
+    pol = cluster.meta.backup.modify_policy(
+        "daily", add_apps=["bp2"], interval_seconds=60)
+    assert pol["interval_seconds"] == 60
+    assert "bp2" in pol["app_names"]
+    pol = cluster.meta.backup.modify_policy("daily",
+                                            remove_apps=["bp2"])
+    assert "bp2" not in pol["app_names"]
+    q = cluster.meta.backup.query_policy("daily")
+    assert q["name"] == "daily" and q["recent_backups"]
+    with pytest.raises(PegasusError):
+        cluster.meta.backup.query_policy("nope")
+
+
+# ---- bulk load controls --------------------------------------------------
+
+def test_bulk_load_pause_restart_cancel_clear(cluster, tmp_path):
+    from pegasus_tpu.server.bulk_load import SSTGenerator
+    from pegasus_tpu.storage.block_service import LocalBlockService
+
+    cluster.create_table("bl", partition_count=4)
+    root = str(tmp_path / "staged")
+    gen = SSTGenerator(LocalBlockService(root), "bl", partition_count=4)
+    gen.generate([(b"bl%04d" % i, b"s", b"v%d" % i, 0)
+                  for i in range(40)])
+    cluster.meta.bulk_load.max_concurrent = 1
+    cluster.meta.bulk_load.start_bulk_load("bl", root)
+    cluster.meta.bulk_load.pause_bulk_load("bl")
+    cluster.step(rounds=6)
+    st = cluster.meta.bulk_load.bulk_load_status("bl")
+    assert not st["complete"] and st["paused"]
+    assert st["pending"]  # the window never refilled while paused
+    cluster.meta.bulk_load.restart_bulk_load("bl")
+    for _ in range(15):
+        cluster.step()
+        if cluster.meta.bulk_load.bulk_load_status("bl")["complete"]:
+            break
+    assert cluster.meta.bulk_load.bulk_load_status("bl")["complete"]
+    c = cluster.client("bl")
+    cluster.step(rounds=2)
+    assert c.get(b"bl0000", b"s") == (OK, b"v0")
+
+    # cancel: visible failure record; clear: clean slate for a re-run
+    gen2 = SSTGenerator(LocalBlockService(str(tmp_path / "s2")), "bl2",
+                        partition_count=2)
+    gen2.generate([(b"x%d" % i, b"s", b"y", 0) for i in range(10)])
+    cluster.create_table("bl2", partition_count=2)
+    cluster.meta.bulk_load.max_concurrent = 0  # stall: nothing ingests
+    cluster.meta.bulk_load.start_bulk_load("bl2", str(tmp_path / "s2"))
+    cluster.meta.bulk_load.cancel_bulk_load("bl2")
+    st = cluster.meta.bulk_load.bulk_load_status("bl2")
+    assert st["failed"] and "cancel" in st["reason"]
+    cluster.meta.bulk_load.clear_bulk_load("bl2")
+    st = cluster.meta.bulk_load.bulk_load_status("bl2")
+    assert not st["failed"]
+    with pytest.raises(PegasusError):
+        cluster.meta.bulk_load.pause_bulk_load("bl2")  # nothing running
+
+
+# ---- duplication pause / fail mode ---------------------------------------
+
+def test_dup_pause_resume_and_fail_mode(cluster):
+    cluster.create_table("dm", partition_count=2)
+    cluster.create_table("df", partition_count=2)
+    c = cluster.client("dm")
+    _fill(c, 10, prefix=b"d")
+    dupid = cluster.meta.duplication.add_duplication("dm", "meta", "df")
+    for _ in range(8):
+        cluster.step()
+    fc = cluster.client("df")
+    assert fc.get(b"d000", b"s") == (OK, b"v0")
+
+    cluster.meta.duplication.pause_duplication(dupid)
+    cluster.step(rounds=2)
+    assert c.set(b"paused", b"s", b"pv") == OK
+    cluster.step(rounds=6)
+    assert fc.get(b"paused", b"s")[0] != OK  # not shipped while paused
+    st = cluster.meta.duplication.query_duplication("dm")[0]
+    assert st["status"] == "pause"
+
+    cluster.meta.duplication.resume_duplication(dupid)
+    for _ in range(8):
+        cluster.step()
+    assert fc.get(b"paused", b"s") == (OK, b"pv")
+
+    # fail mode reaches the live replica session
+    cluster.meta.duplication.set_fail_mode(dupid, "skip")
+    cluster.step(rounds=3)
+    sessions = [s for stub in cluster.stubs.values()
+                for k, s in stub._dup_sessions.items()
+                if k[1] == dupid]
+    assert sessions and all(s.fail_mode == "skip" for s in sessions)
+    with pytest.raises(PegasusError):
+        cluster.meta.duplication.set_fail_mode(dupid, "bogus")
