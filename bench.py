@@ -281,7 +281,25 @@ def run_point_gets(bc, n_ops, n_hashkeys, seed):
 
 def measure_scan_phase(jax, device, bc, n_ops, n_partitions, n_hashkeys,
                       seed):
-    """reset -> warmup (compile + device block caches) -> measure."""
+    """reset -> warmup (compile + device block caches) -> measure.
+
+    A MaskPrefresher runs for the whole phase (as on a production node,
+    node_main.py): the per-second mask refresh — the only device work in
+    steady-state serving — happens in the background, so the measured
+    path is the host assembly speed both backends share plus whatever
+    device latency the prefresher FAILS to hide."""
+    from pegasus_tpu.server.scan_coordinator import MaskPrefresher
+
+    prefresher = MaskPrefresher(bc.servers, device=device).start()
+    try:
+        return _measure_scan_phase(jax, device, bc, n_ops, n_partitions,
+                                   n_hashkeys, seed)
+    finally:
+        prefresher.stop()
+
+
+def _measure_scan_phase(jax, device, bc, n_ops, n_partitions, n_hashkeys,
+                        seed):
     with jax.default_device(device):
         bc.manual_compact_all()
         # warmup covers both compiled stack shapes AND the overlay path

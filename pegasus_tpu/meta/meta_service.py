@@ -740,10 +740,15 @@ class MetaService:
                     ErrorCode.ERR_INVALID_PARAMETERS,
                     f"{node} holds no replica of {app_name}.{pidx} — "
                     "pass force=true to accept an empty primary")
+            # keep the old primary only if it is alive — appending a
+            # dead node would park it in the config forever (its death
+            # event already fired and will not fire again)
+            keep_old = (pc.primary and pc.primary != node
+                        and self.fd.is_alive(pc.primary))
             new_pc = PartitionConfig(
                 ballot=pc.ballot + 1, primary=node,
                 secondaries=[s for s in pc.secondaries if s != node] +
-                            ([pc.primary] if pc.primary else []))
+                            ([pc.primary] if keep_old else []))
         elif action == "add_secondary":
             if node in pc.members():
                 return

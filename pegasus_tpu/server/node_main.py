@@ -89,6 +89,12 @@ def run_node(cfg: dict, name: str) -> None:
         transport.run_timer(1.0, stub.dup_tick)
         transport.run_timer(1.0, stub.split_tick)
         transport.run_timer(2.0, stub.transfer_tick)
+        # keep device predicate masks warm across TTL-seconds so scans
+        # never block on an accelerator round-trip (scan_coordinator)
+        from pegasus_tpu.server.scan_coordinator import MaskPrefresher
+
+        MaskPrefresher(lambda: [r.server
+                                for r in stub.replicas.values()]).start()
         # disk cleaner (parity: replica/disk_cleaner.*): age out trashed
         # replica dirs so rebalancing churn cannot fill the disk
         transport.run_timer(600.0, stub.fs.clean_trash)

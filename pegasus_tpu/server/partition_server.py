@@ -143,6 +143,12 @@ class PartitionServer:
         # once per second, proportional to data instead of requests
         self._mask_cache: "OrderedDict[tuple, np.ndarray]" = OrderedDict()
         self._mask_cache_cap = 4096
+        # mask/device caches are shared with the MaskPrefresher thread
+        self._mask_lock = threading.Lock()
+        # recently-scanned blocks: ckey -> (block, validate, wall_ts);
+        # the prefresher warms these ahead of each TTL-second
+        self._hot_blocks: "OrderedDict[tuple, tuple]" = OrderedDict()
+        self._hot_blocks_cap = 2048
         # per-table dynamic app-envs (parity: src/common/replica_envs.h:39-83
         # propagated through config-sync; here set via update_app_envs)
         self.app_envs: dict = {}
@@ -1036,28 +1042,70 @@ class PartitionServer:
 
     def planned_misses(self, state) -> "OrderedDict[tuple, object]":
         """Unique planned blocks whose masks are NOT cached (the device
-        work remaining); uploads happen here via the block cache."""
+        work remaining); uploads happen here via the block cache. Every
+        planned block — hit or miss — is noted as HOT so the
+        MaskPrefresher keeps warming it across TTL-seconds."""
         keep_masks = {}
         expired_masks = {}
         misses: "OrderedDict[tuple, object]" = OrderedDict()
         now, validate = state["now"], state["validate"]
-        for ckey, (run, bm, blk) in state["unique"].items():
-            mkey = (ckey, now, self.partition_version, validate)
-            cached = self._mask_cache.get(mkey)
-            if cached is not None:
-                self._mask_cache.move_to_end(mkey)
-                keep_masks[ckey], expired_masks[ckey] = cached
-                continue
+        wall = time.monotonic()
+        with self._mask_lock:
+            for ckey, (run, bm, blk) in state["unique"].items():
+                self._hot_blocks[ckey] = (blk, validate, wall)
+                self._hot_blocks.move_to_end(ckey)
+                mkey = (ckey, now, self.partition_version, validate)
+                cached = self._mask_cache.get(mkey)
+                if cached is not None:
+                    self._mask_cache.move_to_end(mkey)
+                    keep_masks[ckey], expired_masks[ckey] = cached
+                    continue
+                misses[ckey] = (run, bm, blk)
+            while len(self._hot_blocks) > self._hot_blocks_cap:
+                self._hot_blocks.popitem(last=False)
+        for ckey, (run, bm, blk) in misses.items():
             misses[ckey] = self._device_cached_block(ckey, blk)
         state["cached_keep"] = keep_masks
         state["cached_expired"] = expired_masks
         return misses
 
     def store_mask(self, state, ckey, keep, expired) -> None:
-        self._mask_cache[(ckey, state["now"], self.partition_version,
-                          state["validate"])] = (keep, expired)
-        if len(self._mask_cache) > self._mask_cache_cap:
-            self._mask_cache.popitem(last=False)
+        self.store_mask_for(ckey, state["now"], state["validate"],
+                            keep, expired,
+                            computed_pv=self.partition_version)
+
+    def store_mask_for(self, ckey, now: int, validate: bool,
+                       keep, expired, computed_pv: int) -> None:
+        """Publish a mask under the partition_version it was COMPUTED
+        with. The prefresher evaluates on its own thread — if a split
+        flipped the version mid-evaluation, publishing under the new
+        version would serve pre-split masks (rows now owned by the
+        sibling); drop instead."""
+        with self._mask_lock:
+            if computed_pv != self.partition_version:
+                return
+            self._mask_cache[(ckey, now, computed_pv,
+                              validate)] = (keep, expired)
+            if len(self._mask_cache) > self._mask_cache_cap:
+                self._mask_cache.popitem(last=False)
+
+    def hot_block_entries(self, wall: float, horizon_s: float,
+                          target_now: int):
+        """(ckey, block, validate) for recently-scanned blocks missing a
+        mask for `target_now` — the MaskPrefresher's work list. Prunes
+        entries idle past the horizon."""
+        out = []
+        with self._mask_lock:
+            for ckey in list(self._hot_blocks):
+                blk, validate, ts = self._hot_blocks[ckey]
+                if wall - ts > horizon_s:
+                    del self._hot_blocks[ckey]
+                    continue
+                mkey = (ckey, target_now, self.partition_version,
+                        validate)
+                if mkey not in self._mask_cache:
+                    out.append((ckey, blk, validate))
+        return out
 
     def eval_planned_masks(self, state):
         """Phase 2 (solo-node form): evaluate this partition's misses."""
@@ -1274,26 +1322,30 @@ class PartitionServer:
         from pegasus_tpu.ops.record_block import RecordBlock, block_from_columns
         from pegasus_tpu.storage.sstable import BLOCK_CAPACITY
 
-        dev_block = self._device_block_cache.get(cache_key)
-        if dev_block is None:
-            n = blk.count
-            cap = max(BLOCK_CAPACITY, n)
-            nb = block_from_columns(blk.keys, blk.key_len, blk.expire_ts,
-                                    hash_lo=blk.hash_lo)
-            pad = cap - n
-            dev_block = RecordBlock(
-                jnp.asarray(np.pad(nb.keys, ((0, pad), (0, 0)))),
-                jnp.asarray(np.pad(nb.key_len, (0, pad))),
-                jnp.asarray(np.pad(nb.hashkey_len, (0, pad))),
-                jnp.asarray(np.pad(nb.expire_ts, (0, pad))),
-                jnp.asarray(np.pad(nb.valid, (0, pad))),
-                None if nb.hash_lo is None
-                else jnp.asarray(np.pad(nb.hash_lo, (0, pad))))
+        with self._mask_lock:
+            dev_block = self._device_block_cache.get(cache_key)
+            if dev_block is not None:
+                self._device_block_cache.move_to_end(cache_key)
+                return dev_block
+        # upload outside the lock (serving and the prefresher may race
+        # to a duplicate upload of the same block — harmless, last wins)
+        n = blk.count
+        cap = max(BLOCK_CAPACITY, n)
+        nb = block_from_columns(blk.keys, blk.key_len, blk.expire_ts,
+                                hash_lo=blk.hash_lo)
+        pad = cap - n
+        dev_block = RecordBlock(
+            jnp.asarray(np.pad(nb.keys, ((0, pad), (0, 0)))),
+            jnp.asarray(np.pad(nb.key_len, (0, pad))),
+            jnp.asarray(np.pad(nb.hashkey_len, (0, pad))),
+            jnp.asarray(np.pad(nb.expire_ts, (0, pad))),
+            jnp.asarray(np.pad(nb.valid, (0, pad))),
+            None if nb.hash_lo is None
+            else jnp.asarray(np.pad(nb.hash_lo, (0, pad))))
+        with self._mask_lock:
             self._device_block_cache[cache_key] = dev_block
             if len(self._device_block_cache) > self._device_block_cache_cap:
                 self._device_block_cache.popitem(last=False)
-        else:
-            self._device_block_cache.move_to_end(cache_key)
         return dev_block
 
     # ---- maintenance --------------------------------------------------
@@ -1334,6 +1386,10 @@ class PartitionServer:
                 validate_hash=self.validate_partition_hash,
                 rules_filter=rules_filter)
             # the old L1 file is gone; its cached device blocks can never
-            # hit again — drop them instead of pinning dead HBM
-            self._device_block_cache.clear()
+            # hit again — drop them instead of pinning dead HBM, and
+            # forget their hot-block entries or the prefresher would
+            # re-upload the dead blocks on its next pass
+            with self._mask_lock:
+                self._device_block_cache.clear()
+                self._hot_blocks.clear()
             self._prepared_cache.clear()

@@ -64,41 +64,64 @@ def scan_multi(servers_and_reqs: List[Tuple[object, list]],
 def stacked_block_eval(blocks, now: int, validate: bool, pv: int):
     """The ONE stacking implementation both the per-partition and the
     cross-partition paths use. `blocks`: [(tag, dev_block, pidx)] —
-    yields (tag, keep, expired). Buckets by (key width, capacity) so
-    differently-capped tail blocks can never misalign mask slices; the
-    padded count rounds to a power of two to bound compilations; a
-    stack mixing hash_lo and non-hash_lo blocks drops the precomputed
-    column (the kernel computes the hash on device instead)."""
-    import jax.numpy as jnp
+    yields (tag, keep, expired).
 
-    from pegasus_tpu.ops.record_block import RecordBlock
+    Two phases: SUBMIT every chunk's program to the device (async — XLA
+    queues them all), then GATHER every result with the transfers
+    started together. On a tunneled accelerator each synchronous fetch
+    of a fresh result pays a full round-trip (~tens of ms measured), so
+    starting all copies before the first wait overlaps compute and
+    transfer across chunks instead of serializing round-trips."""
+    submitted = list(stacked_block_submit(blocks, now, validate, pv))
+    for o in submitted:
+        _start_host_copy(o[2])
+        _start_host_copy(o[3])
+    for group, cap, keep_dev, exp_dev in submitted:
+        keep_all = np.asarray(keep_dev)
+        exp_all = np.asarray(exp_dev)
+        if len(group) == 1:
+            yield group[0][0], keep_all, exp_all
+            continue
+        for i, (tag, _d, _p) in enumerate(group):
+            yield (tag, keep_all[i * cap:(i + 1) * cap],
+                   exp_all[i * cap:(i + 1) * cap])
 
+
+def stacked_block_submit(blocks, now: int, validate: bool, pv: int):
+    """Phase 1: dispatch predicate programs WITHOUT waiting. Yields
+    (group, cap, keep_device_array, expired_device_array). Buckets by
+    (key width, capacity) so differently-capped tail blocks can never
+    misalign mask slices; fixed STACK_CHUNK keeps exactly two compiled
+    shapes per key width ([cap, W] and [STACK_CHUNK*cap, W]) — variable
+    stack sizes made every batch a fresh XLA compile. A stack mixing
+    hash_lo and non-hash_lo blocks drops the precomputed column (the
+    kernel computes the hash on device instead)."""
     none_f = FilterSpec.none()
     buckets: "OrderedDict[tuple, list]" = OrderedDict()
     for tag, dev, pidx in blocks:
         key = (int(dev.keys.shape[1]), int(dev.keys.shape[0]))
         buckets.setdefault(key, []).append((tag, dev, pidx))
     for (_w, cap), group in buckets.items():
-        if len(group) == 1:
-            tag, dev, pidx = group[0]
-            m = scan_block_predicate(
-                dev, now, hash_filter=none_f, sort_filter=none_f,
-                validate_hash=validate, pidx=pidx,
-                partition_version=pv)
-            yield tag, np.asarray(m.keep), np.asarray(m.expired)
-            continue
-        # FIXED chunk size: exactly two compiled shapes per key width
-        # ([cap, W] and [STACK_CHUNK*cap, W]) — variable power-of-two
-        # buckets made every batch's stack a fresh XLA compile
         for off in range(0, len(group), STACK_CHUNK):
-            yield from _eval_chunk(group[off:off + STACK_CHUNK], cap,
-                                   now, validate, pv, none_f)
+            yield _submit_chunk(group[off:off + STACK_CHUNK], cap,
+                                now, validate, pv, none_f)
 
 
 STACK_CHUNK = 16
 
 
-def _eval_chunk(group, cap, now, validate, pv, none_f):
+def _start_host_copy(arr) -> None:
+    """Begin the device->host transfer without blocking (no-op for
+    backends/arrays that don't support it)."""
+    start = getattr(arr, "copy_to_host_async", None)
+    if start is not None:
+        try:
+            start()
+        except Exception:  # noqa: BLE001 - purely an overlap hint
+            pass
+
+
+def _submit_chunk(group, cap, now, validate, pv, none_f):
     import jax.numpy as jnp
 
     from pegasus_tpu.ops.record_block import RecordBlock
@@ -108,8 +131,7 @@ def _eval_chunk(group, cap, now, validate, pv, none_f):
         m = scan_block_predicate(
             dev, now, hash_filter=none_f, sort_filter=none_f,
             validate_hash=validate, pidx=pidx, partition_version=pv)
-        yield tag, np.asarray(m.keep), np.asarray(m.expired)
-        return
+        return group, cap, m.keep, m.expired
     padded = group + [group[0]] * (STACK_CHUNK - len(group))
     pidx_col = np.concatenate([
         np.full(cap, pidx, dtype=np.uint32)
@@ -127,11 +149,7 @@ def _eval_chunk(group, cap, now, validate, pv, none_f):
         stacked, now, hash_filter=none_f, sort_filter=none_f,
         validate_hash=validate, pidx=pidx_col,
         partition_version=pv)
-    keep_all = np.asarray(m.keep)
-    exp_all = np.asarray(m.expired)
-    for i, (tag, _d, _p) in enumerate(group):
-        yield (tag, keep_all[i * cap:(i + 1) * cap],
-               exp_all[i * cap:(i + 1) * cap])
+    return group, cap, m.keep, m.expired
 
 
 def _eval_cross_partition(entries, now: int, validate: bool,
@@ -145,3 +163,112 @@ def _eval_cross_partition(entries, now: int, validate: bool,
         state["cached_keep"][ckey] = keep
         state["cached_expired"][ckey] = expired
         server.store_mask(state, ckey, keep, expired)
+
+
+class MaskPrefresher:
+    """Background mask warmer — the piece that takes the accelerator OFF
+    the serving path's critical latency.
+
+    Predicate masks are keyed by TTL-second (`epoch_now()`), so in
+    steady state every touched block needs exactly one device evaluation
+    per second. Serving that miss synchronously costs a full
+    device round-trip per refresh wave — on a tunneled accelerator tens
+    of milliseconds of dead wait inside a client's scan. This thread
+    recomputes masks for every recently-scanned block for BOTH the
+    current second and the next one, slightly ahead of time, so the
+    serving path finds them in the per-partition mask caches and never
+    blocks on the device (SURVEY §7 'host iteration ∥ device eval'
+    realized as pipelining across the TTL-second boundary).
+
+    Semantics are unchanged: a scan at second T always uses the mask
+    computed FOR second T; the prefresher only moves WHEN that mask is
+    computed (during second T-1), never what it contains.
+
+    One per node (replica stub / bench cluster). Scans register touched
+    blocks in PartitionServer.planned_misses (the `_hot_blocks` map);
+    entries age out after `horizon_s` without a scan. Daemon thread;
+    safe to leave running.
+    """
+
+    def __init__(self, servers, horizon_s: float = 15.0,
+                 poll_s: float = 0.2, device=None):
+        import threading
+
+        # `servers`: a list of PartitionServers, or a zero-arg callable
+        # returning one (a replica stub's live set changes over time)
+        self._servers = servers if callable(servers) \
+            else (lambda s=list(servers): s)
+        self.horizon_s = horizon_s
+        self.poll_s = poll_s
+        # jax.default_device is THREAD-local: a caller pinning a device
+        # for serving must pin the warmer thread too or it computes on
+        # the global default
+        self.device = device
+        self._stop = threading.Event()
+        self._thread = None
+        self.refreshed = 0  # masks warmed (for tests/metrics)
+
+    @property
+    def servers(self):
+        return self._servers()
+
+    def start(self) -> "MaskPrefresher":
+        import threading
+
+        if self._thread is None:
+            self._thread = threading.Thread(target=self._run,
+                                            name="mask-prefresher",
+                                            daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def _run(self) -> None:
+        import contextlib
+
+        from pegasus_tpu.base.value_schema import epoch_now
+
+        ctx = contextlib.nullcontext()
+        if self.device is not None:
+            import jax
+
+            ctx = jax.default_device(self.device)
+        with ctx:
+            while not self._stop.is_set():
+                try:
+                    self.refresh_once(epoch_now())
+                except Exception:  # noqa: BLE001 - a dead warmer only
+                    pass           # costs latency; serving recomputes
+                self._stop.wait(self.poll_s)
+
+    def refresh_once(self, now: int) -> int:
+        """One warm pass for seconds {now, now+1}; returns masks stored.
+        Synchronous; tests call this directly with a pinned clock."""
+        import time as _time
+
+        wall = _time.monotonic()
+        warmed = 0
+        for target in (now, now + 1):
+            flavors: Dict[tuple, list] = {}
+            for srv in self.servers:
+                for ckey, blk, validate in srv.hot_block_entries(
+                        wall, self.horizon_s, target):
+                    dev = srv._device_cached_block(ckey, blk)
+                    flavors.setdefault(
+                        (validate, srv.partition_version), []).append(
+                        (srv, ckey, dev, validate))
+            for (validate, pv), entries in flavors.items():
+                blocks = [((srv, ckey, v), dev, srv.pidx)
+                          for srv, ckey, dev, v in entries]
+                for (srv, ckey, v), keep, expired in stacked_block_eval(
+                        blocks, target, validate, pv):
+                    srv.store_mask_for(ckey, target, v, keep, expired,
+                                       computed_pv=pv)
+                    warmed += 1
+        self.refreshed += warmed
+        return warmed
