@@ -295,6 +295,11 @@ class ClusterClient:
         return self._write([(OP_MULTI_REMOVE, req)],
                            key_hash_parts(hash_key))[0]
 
+    def multi_get_sortkeys(self, hash_key: bytes
+                           ) -> Tuple[int, List[bytes]]:
+        err, kvs = self.multi_get(hash_key, no_value=True)
+        return err, sorted(kvs)
+
     def sortkey_count(self, hash_key: bytes) -> Tuple[int, int]:
         if not hash_key:
             return int(StorageStatus.INVALID_ARGUMENT), 0

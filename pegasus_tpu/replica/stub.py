@@ -76,6 +76,7 @@ class ReplicaStub:
         self.clock = clock
         # FD timeline clock (sim time); defaults to the wall clock
         self.sim_clock = sim_clock or clock or (lambda: 0.0)
+        self._start_clock = self.sim_clock()
         self.replicas: Dict[Gpid, Replica] = {}
         # the meta group (parity: failure_detector_multimaster — workers
         # beacon the whole group; only the leader acts, followers forward)
@@ -232,6 +233,53 @@ class ReplicaStub:
         self.commands.register(
             "hotkey", hotkey,
             "hotkey <start|query|stop> <app_id> <pidx> <read|write>")
+
+        def server_info(_args):
+            """Parity: shell server_info / server_stat basics."""
+            import pegasus_tpu
+
+            by_status = {}
+            for r in self.replicas.values():
+                s = PartitionStatus(r.status).name
+                by_status[s] = by_status.get(s, 0) + 1
+            return {"node": self.name,
+                    "version": pegasus_tpu.__version__,
+                    "uptime_s": round(self.sim_clock()
+                                      - self._start_clock, 1),
+                    "replica_count": len(self.replicas),
+                    "by_status": by_status}
+
+        def replica_disk(_args):
+            """Per-replica on-disk footprint (parity: shell app_disk —
+            sst + plog bytes per hosted replica)."""
+            def size_of(path):
+                try:
+                    return os.path.getsize(path)
+                except OSError:
+                    return 0  # compaction/gc raced the stat — skip
+
+            out = []
+            for gpid, r in sorted(self.replicas.items()):
+                d = r.server.engine.data_dir
+                sst = os.path.join(d, "sst")
+                try:
+                    names = os.listdir(sst)
+                except OSError:
+                    names = []
+                sst_bytes = sum(size_of(os.path.join(sst, f))
+                                for f in names)
+                log_bytes = size_of(r.log.path)
+                out.append({"gpid": list(gpid),
+                            "status": PartitionStatus(r.status).name,
+                            "sst_bytes": sst_bytes,
+                            "log_bytes": log_bytes,
+                            "dir": d})
+            return out
+
+        self.commands.register("server.info", server_info,
+                               "node version/uptime/replica summary")
+        self.commands.register("replica.disk", replica_disk,
+                               "per-replica sst+plog bytes")
 
     def close(self) -> None:
         for r in self.replicas.values():

@@ -6,12 +6,27 @@ serves both roles here:
 
     python -m pegasus_tpu.tools.shell --root /data/onebox <command> ...
 
-Commands (subset mirroring the reference's most used):
-  table mgmt : create_app, drop_app, ls, app
+Run with no command for the interactive REPL (`use <table>` scopes data
+verbs, parity: the linenoise REPL + `use`). Command families:
+  table mgmt : create_app, drop_app, recall_app, rename, ls, app,
+               get/set_replica_count
   data       : set, get, del, exist, ttl, incr, multi_set, multi_get,
-               count, scan
-  admin      : set_app_envs, get_app_envs, manual_compact, flush,
-               metrics, backup, restore
+               multi_get_range, multi_get_sortkeys, multi_del,
+               multi_del_range, check_and_set, check_and_mutate, count,
+               scan, hash_scan, full_scan, copy_data, clear_data,
+               count_data, hash
+  envs       : set/get/del/clear_app_envs
+  ops        : manual_compact, partition_split, start_split, flush,
+               flush_log, backup, restore, start/query_backup,
+               restore_app, *_backup_policy, start/query/pause/restart/
+               cancel/clear_bulk_load, add/query/remove/pause/start_dup,
+               set_dup_fail_mode
+  cluster    : cluster_info, nodes, server_info, server_stat, app_stat,
+               app_disk, ddd_diagnose, propose, rebalance, offline_node,
+               get/set_meta_level, detect_hotkey, remote_command,
+               slow_queries, metrics
+  offline    : sst_dump, mlog_dump, local_get, rdb_key_str2hex,
+               rdb_key_hex2str, rdb_value_hex2str
 
 Bytes arguments accept UTF-8 strings.
 """
@@ -36,7 +51,7 @@ def main(argv=None) -> int:
                         help="multi-process onebox directory (wire mode: "
                              "commands go over TCP through meta and the "
                              "replica servers)")
-    sub = parser.add_subparsers(dest="cmd", required=True)
+    sub = parser.add_subparsers(dest="cmd", required=False)
 
     p = sub.add_parser("create_app")
     p.add_argument("name")
@@ -74,6 +89,80 @@ def main(argv=None) -> int:
     p.add_argument("table")
     p.add_argument("--hash_prefix", default="")
     p.add_argument("--max", type=int, default=100)
+    # extended data surface (parity: shell data commands, commands.h)
+    p = sub.add_parser("check_and_set")
+    p.add_argument("table")
+    p.add_argument("hash_key")
+    p.add_argument("check_sort_key")
+    p.add_argument("check_type", help="not_exist|exist|match_prefix|"
+                                      "match_anywhere|match_postfix|"
+                                      "bytes_less|bytes_equal|...")
+    p.add_argument("check_operand")
+    p.add_argument("set_sort_key")
+    p.add_argument("set_value")
+    p.add_argument("--ttl", type=int, default=0)
+    p = sub.add_parser("check_and_mutate")
+    p.add_argument("table")
+    p.add_argument("hash_key")
+    p.add_argument("check_sort_key")
+    p.add_argument("check_type")
+    p.add_argument("check_operand")
+    p.add_argument("mutations", nargs="+",
+                   help="sortkey=value (put; empty value allowed) or "
+                        "del:sortkey (delete)")
+    p = sub.add_parser("multi_del")
+    p.add_argument("table")
+    p.add_argument("hash_key")
+    p.add_argument("sort_keys", nargs="+")
+    p = sub.add_parser("multi_del_range")
+    p.add_argument("table")
+    p.add_argument("hash_key")
+    p.add_argument("--start", default="")
+    p.add_argument("--stop", default="")
+    p = sub.add_parser("multi_get_range")
+    p.add_argument("table")
+    p.add_argument("hash_key")
+    p.add_argument("--start", default="")
+    p.add_argument("--stop", default="")
+    p.add_argument("--max", type=int, default=100)
+    p = sub.add_parser("multi_get_sortkeys")
+    p.add_argument("table")
+    p.add_argument("hash_key")
+    p = sub.add_parser("hash_scan")
+    p.add_argument("table")
+    p.add_argument("hash_key")
+    p.add_argument("--start", default="")
+    p.add_argument("--stop", default="")
+    p.add_argument("--max", type=int, default=100)
+    p = sub.add_parser("full_scan")
+    p.add_argument("table")
+    p.add_argument("--max", type=int, default=100)
+    p = sub.add_parser("copy_data")
+    p.add_argument("src_table")
+    p.add_argument("dst_table")
+    p.add_argument("--max", type=int, default=0,
+                   help="0 = everything")
+    p = sub.add_parser("clear_data")
+    p.add_argument("table")
+    p.add_argument("--force", action="store_true",
+                   help="required: deletes every record")
+    p = sub.add_parser("count_data")
+    p.add_argument("table")
+    p = sub.add_parser("hash")
+    p.add_argument("table")
+    p.add_argument("hash_key")
+    p.add_argument("sort_key")
+    p = sub.add_parser("local_get")
+    p.add_argument("path", help="a replica's sst dir (offline read)")
+    p.add_argument("hash_key")
+    p.add_argument("sort_key")
+    p = sub.add_parser("rdb_key_str2hex")
+    p.add_argument("hash_key")
+    p.add_argument("sort_key")
+    p = sub.add_parser("rdb_key_hex2str")
+    p.add_argument("hex_key")
+    p = sub.add_parser("rdb_value_hex2str")
+    p.add_argument("hex_value")
 
     p = sub.add_parser("set_app_envs")
     p.add_argument("table")
@@ -149,11 +238,95 @@ def main(argv=None) -> int:
     p.add_argument("cmd_args", nargs="*")
     p = sub.add_parser("slow_queries")
     p.add_argument("node")
+    # cluster/node admin breadth (parity: shell admin commands)
+    sub.add_parser("cluster_info")
+    p = sub.add_parser("server_info")
+    p.add_argument("node", nargs="?", default=None,
+                   help="one node, or all when omitted")
+    p = sub.add_parser("server_stat")
+    p.add_argument("node", nargs="?", default=None)
+    p = sub.add_parser("app_stat")
+    p.add_argument("table")
+    p = sub.add_parser("app_disk")
+    p.add_argument("table")
+    sub.add_parser("ddd_diagnose")
+    p = sub.add_parser("detect_hotkey")
+    p.add_argument("node")
+    p.add_argument("action", choices=["start", "query", "stop"])
+    p.add_argument("app_id", type=int)
+    p.add_argument("pidx", type=int)
+    p.add_argument("kind", choices=["read", "write"])
+    sub.add_parser("get_meta_level")
+    p = sub.add_parser("set_meta_level")
+    p.add_argument("level", choices=["freezed", "steady", "lively"])
+    p = sub.add_parser("get_replica_count")
+    p.add_argument("table")
+    p = sub.add_parser("set_replica_count")
+    p.add_argument("table")
+    p.add_argument("count", type=int)
+    p = sub.add_parser("propose")
+    p.add_argument("table")
+    p.add_argument("pidx", type=int)
+    p.add_argument("action",
+                   choices=["assign_primary", "add_secondary",
+                            "downgrade"])
+    p.add_argument("node")
+    p.add_argument("--force", action="store_true")
+    p = sub.add_parser("recall_app")
+    p.add_argument("table")
+    p = sub.add_parser("rename")
+    p.add_argument("old_name")
+    p.add_argument("new_name")
+    p = sub.add_parser("del_app_envs")
+    p.add_argument("table")
+    p.add_argument("keys", nargs="+")
+    p = sub.add_parser("clear_app_envs")
+    p.add_argument("table")
+    p.add_argument("--prefix", default="")
+    p = sub.add_parser("add_backup_policy")
+    p.add_argument("name")
+    p.add_argument("--tables", nargs="+", required=True)
+    p.add_argument("--bucket", required=True)
+    p.add_argument("--interval", type=int, default=86400)
+    p.add_argument("--history", type=int, default=3)
+    sub.add_parser("ls_backup_policy")
+    p = sub.add_parser("query_backup_policy")
+    p.add_argument("name")
+    p = sub.add_parser("modify_backup_policy")
+    p.add_argument("name")
+    p.add_argument("--add_tables", nargs="*", default=None)
+    p.add_argument("--remove_tables", nargs="*", default=None)
+    p.add_argument("--interval", type=int, default=None)
+    p.add_argument("--history", type=int, default=None)
+    p = sub.add_parser("enable_backup_policy")
+    p.add_argument("name")
+    p = sub.add_parser("disable_backup_policy")
+    p.add_argument("name")
+    p = sub.add_parser("pause_dup")
+    p.add_argument("dupid", type=int)
+    p = sub.add_parser("start_dup")
+    p.add_argument("dupid", type=int)
+    p = sub.add_parser("set_dup_fail_mode")
+    p.add_argument("dupid", type=int)
+    p.add_argument("fail_mode", choices=["slow", "skip"])
+    p = sub.add_parser("pause_bulk_load")
+    p.add_argument("table")
+    p = sub.add_parser("restart_bulk_load")
+    p.add_argument("table")
+    p = sub.add_parser("cancel_bulk_load")
+    p.add_argument("table")
+    p = sub.add_parser("clear_bulk_load")
+    p.add_argument("table")
+    p = sub.add_parser("flush_log")
+    p.add_argument("node")
 
     args = parser.parse_args(argv)
 
-    if args.cmd in ("sst_dump", "mlog_dump"):
+    if args.cmd in ("sst_dump", "mlog_dump", "local_get"):
         return _offline_dump(args, sys.stdout)
+    if args.cmd in ("rdb_key_str2hex", "rdb_key_hex2str",
+                    "rdb_value_hex2str"):
+        return _dispatch(args, None, sys.stdout)  # pure codec tools
     if (args.root is None) == (args.cluster is None):
         print("error: exactly one of --root / --cluster is required",
               file=sys.stderr)
@@ -168,13 +341,99 @@ def main(argv=None) -> int:
 
     out = sys.stdout
     try:
+        if args.cmd is None:
+            return _repl(parser, box, out)
         return _dispatch(args, box, out)
+    except AttributeError as exc:
+        print(f"error: {exc} (this command may need wire mode: "
+              f"--cluster)", file=sys.stderr)
+        return 1
     except (KeyError, ValueError, NotImplementedError,
             PegasusError) as exc:
         print(f"error: {exc}", file=sys.stderr)
         return 1
     finally:
         box.close()
+
+
+# data verbs that take the current table as their first argument when a
+# `use <table>` is active in the REPL (parity: the shell's use/cc model)
+_TABLE_VERBS = frozenset({
+    "set", "get", "del", "exist", "ttl", "incr", "multi_set",
+    "multi_get", "count", "scan", "check_and_set", "check_and_mutate",
+    "multi_del", "multi_del_range", "multi_get_range",
+    "multi_get_sortkeys", "hash_scan", "full_scan", "count_data",
+    "clear_data", "hash", "set_app_envs", "get_app_envs",
+    "manual_compact", "partition_split", "flush", "app_stat",
+    "app_disk", "get_replica_count",
+})
+
+
+def _repl(parser, box, out) -> int:
+    """Interactive mode (parity: the shell's linenoise REPL,
+    src/shell/main.cpp:874): `use <table>` scopes data commands,
+    `help` lists verbs, `exit`/`quit` leaves. Errors never kill the
+    session."""
+    import shlex
+
+    from pegasus_tpu.utils.errors import PegasusError
+
+    import pegasus_tpu
+
+    current_table = None
+    print(f"pegasus_tpu shell {pegasus_tpu.__version__} — 'help' for "
+          f"commands, 'exit' to leave", file=out)
+    while True:
+        try:
+            prompt = f"{current_table or ''}> "
+            line = input(prompt)
+        except EOFError:
+            return 0
+        except KeyboardInterrupt:
+            print(file=out)
+            continue
+        try:
+            words = shlex.split(line)
+        except ValueError as exc:
+            print(f"error: {exc}", file=out)
+            continue
+        if not words:
+            continue
+        verb = words[0]
+        if verb in ("exit", "quit"):
+            return 0
+        if verb == "use":
+            if len(words) != 2:
+                print("usage: use <table>", file=out)
+                continue
+            current_table = words[1]
+            print(f"OK: using {current_table}", file=out)
+            continue
+        if verb == "version":
+            print(pegasus_tpu.__version__, file=out)
+            continue
+        if verb == "help":
+            choices = parser._subparsers._group_actions[0].choices
+            print("  ".join(sorted(choices)) +
+                  "\n  plus: use <table>, version, exit", file=out)
+            continue
+        if verb in _TABLE_VERBS and current_table is not None:
+            words = [verb, current_table] + words[1:]
+        try:
+            cmd_args = parser.parse_args(words)
+        except SystemExit:
+            continue  # argparse already printed the usage error
+        try:
+            if verb in ("sst_dump", "mlog_dump", "local_get"):
+                _offline_dump(cmd_args, out)
+            else:
+                _dispatch(cmd_args, box, out)
+        except AttributeError as exc:
+            print(f"error: {exc} (this command may need wire mode: "
+                  f"--cluster)", file=out)
+        except (KeyError, ValueError, NotImplementedError,
+                PegasusError) as exc:
+            print(f"error: {exc}", file=out)
 
 
 def _offline_dump(args, out) -> int:
@@ -241,6 +500,44 @@ def _offline_key_zone(path, out):
 def _offline_dump_body(args, out, restore_key, extract_user_data) -> int:
     import os
 
+    if args.cmd == "local_get":
+        # parity: shell local_get — read one key straight from a replica's
+        # sst files, newest first (no running cluster needed)
+        from pegasus_tpu.base.key_schema import generate_key
+        from pegasus_tpu.storage.sstable import SSTable
+
+        key = generate_key(args.hash_key.encode(),
+                           args.sort_key.encode())
+
+        def newest_first(name):
+            # files are "l<level>-<seq>.sst": lower level = newer data,
+            # higher seq = newer within a level
+            level, _, seq = name[:-4].partition("-")
+            try:
+                return (int(level.lstrip("l")), -int(seq))
+            except ValueError:
+                return (99, 0)
+
+        paths = [os.path.join(args.path, n)
+                 for n in sorted((n for n in os.listdir(args.path)
+                                  if n.endswith(".sst")),
+                                 key=newest_first)]
+        for path in paths:
+            t = SSTable(path)
+            hit = t.get(key)
+            t.close()
+            if hit is None:
+                continue
+            value, ets = hit
+            if value is None:
+                print("DELETED (tombstone)", file=out)
+                return 1
+            data = extract_user_data(1, value)
+            print(f"{data.decode(errors='replace')} (ets={ets}, "
+                  f"from {os.path.basename(path)})", file=out)
+            return 0
+        print("not found", file=out)
+        return 1
     if args.cmd == "sst_dump":
         from pegasus_tpu.storage.sstable import SSTable
 
@@ -360,6 +657,40 @@ class _ClusterBox:
         self.admin.close()
 
 
+_CHECK_TYPES = {
+    "no_check": 0, "not_exist": 1, "not_exist_or_empty": 2, "exist": 3,
+    "not_empty": 4, "match_anywhere": 5, "match_prefix": 6,
+    "match_postfix": 7, "bytes_less": 8, "bytes_less_or_equal": 9,
+    "bytes_equal": 10, "bytes_greater_or_equal": 11, "bytes_greater": 12,
+    "int_less": 13, "int_less_or_equal": 14, "int_equal": 15,
+    "int_greater_or_equal": 16, "int_greater": 17,
+}
+
+
+def _check_type(name: str) -> int:
+    try:
+        return _CHECK_TYPES[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown check type {name!r}; one of "
+            f"{', '.join(_CHECK_TYPES)}") from None
+
+
+def _full_scan_records(box, table, limit):
+    """Iterate every record of a table via unordered scanners (parity:
+    full_scan's total-order seek across partitions)."""
+    from pegasus_tpu.client import ScanOptions
+
+    c = box.client(table)
+    n = 0
+    for sc in c.get_unordered_scanners(4, ScanOptions(batch_size=500)):
+        for hk, sk, v in sc:
+            yield hk, sk, v
+            n += 1
+            if limit and n >= limit:
+                return
+
+
 def _dispatch(args, box, out) -> int:
     from pegasus_tpu.ops.predicates import FT_MATCH_PREFIX
     from pegasus_tpu.utils.errors import StorageStatus
@@ -465,6 +796,192 @@ def _dispatch(args, box, out) -> int:
             if n >= args.max:
                 break
         print(f"{n} record(s)", file=out)
+    elif args.cmd == "check_and_set":
+        c = box.client(args.table)
+        resp = c.check_and_set(
+            _b(args.hash_key), _b(args.check_sort_key),
+            _check_type(args.check_type), _b(args.check_operand),
+            _b(args.set_sort_key), _b(args.set_value),
+            ttl_seconds=args.ttl, return_check_value=True)
+        # TRY_AGAIN is ambiguous: a FAILED CHECK carries the check value
+        # back (we asked for it); a gate rejection (throttle/deny) is a
+        # bare error and must not read as "check failed"
+        check_failed = resp.error == 13 and resp.check_value_returned
+        if resp.error != 0 and not check_failed:
+            print(f"error {resp.error}", file=out)
+            return 1
+        print("set" if resp.error == 0 else "not set (check failed)",
+              file=out)
+        if resp.check_value_returned:
+            print(f"check value: "
+                  f"{resp.check_value.decode(errors='replace')}",
+                  file=out)
+    elif args.cmd == "check_and_mutate":
+        from pegasus_tpu.server.types import Mutate, MutateOperation
+        c = box.client(args.table)
+        muts = []
+        for m in args.mutations:
+            if m.startswith("del:"):
+                muts.append(Mutate(MutateOperation.MO_DELETE,
+                                   _b(m[4:])))
+            elif "=" in m:
+                sk, _, v = m.partition("=")
+                muts.append(Mutate(MutateOperation.MO_PUT, _b(sk),
+                                   _b(v)))
+            else:
+                raise ValueError(
+                    f"mutation {m!r}: use sortkey=value (put, empty "
+                    "value allowed) or del:sortkey (delete)")
+        resp = c.check_and_mutate(
+            _b(args.hash_key), _b(args.check_sort_key),
+            _check_type(args.check_type), _b(args.check_operand), muts,
+            return_check_value=True)
+        check_failed = resp.error == 13 and resp.check_value_returned
+        if resp.error != 0 and not check_failed:
+            print(f"error {resp.error}", file=out)
+            return 1
+        print("mutated" if resp.error == 0
+              else "not mutated (check failed)", file=out)
+    elif args.cmd == "multi_del":
+        c = box.client(args.table)
+        err, n = c.multi_del(_b(args.hash_key),
+                             [_b(s) for s in args.sort_keys])
+        if err != 0:
+            print(f"error {err}", file=out)
+            return 1
+        print(f"deleted {n} record(s)", file=out)
+    elif args.cmd == "multi_del_range":
+        c = box.client(args.table)
+        # paginate: the server caps one multi_get at its read-limiter
+        # budget (INCOMPLETE=7); delete page by page until exhausted
+        deleted = 0
+        cursor = _b(args.start)
+        inclusive = True
+        while True:
+            err, kvs = c.multi_get(_b(args.hash_key),
+                                   start_sortkey=cursor,
+                                   stop_sortkey=_b(args.stop),
+                                   start_inclusive=inclusive,
+                                   no_value=True)
+            if err not in (0, 7):
+                print(f"error {err}", file=out)
+                return 1
+            if kvs:
+                derr, n = c.multi_del(_b(args.hash_key), sorted(kvs))
+                if derr != 0:
+                    print(f"error {derr}", file=out)
+                    return 1
+                deleted += n
+            if err == 0 or not kvs:
+                break
+            cursor = max(kvs)  # resume past the page's last sort key
+            inclusive = False
+        print(f"deleted {deleted} record(s)", file=out)
+    elif args.cmd == "multi_get_range":
+        c = box.client(args.table)
+        err, kvs = c.multi_get(_b(args.hash_key),
+                               start_sortkey=_b(args.start),
+                               stop_sortkey=_b(args.stop),
+                               max_kv_count=args.max)
+        if err not in (0, 7):  # 7 = INCOMPLETE (capped)
+            print(f"error {err}", file=out)
+            return 1
+        for k, v in sorted(kvs.items()):
+            print(f"{k.decode(errors='replace')} : "
+                  f"{v.decode(errors='replace')}", file=out)
+        print(f"{len(kvs)} record(s)", file=out)
+    elif args.cmd == "multi_get_sortkeys":
+        c = box.client(args.table)
+        err, sks = c.multi_get_sortkeys(_b(args.hash_key))
+        if err not in (0, 7):
+            print(f"error {err}", file=out)
+            return 1
+        for sk in sks:
+            print(sk.decode(errors="replace"), file=out)
+        print(f"{len(sks)} sort key(s)", file=out)
+    elif args.cmd == "hash_scan":
+        c = box.client(args.table)
+        sc = c.get_scanner(_b(args.hash_key), _b(args.start),
+                           _b(args.stop))
+        n = 0
+        for hk, sk, v in sc:
+            print(f"{sk.decode(errors='replace')} => "
+                  f"{v.decode(errors='replace')}", file=out)
+            n += 1
+            if n >= args.max:
+                sc.close()
+                break
+        print(f"{n} record(s)", file=out)
+    elif args.cmd == "full_scan":
+        n = 0
+        for hk, sk, v in _full_scan_records(box, args.table, args.max):
+            print(f"{hk.decode(errors='replace')} : "
+                  f"{sk.decode(errors='replace')} => "
+                  f"{v.decode(errors='replace')}", file=out)
+            n += 1
+        print(f"{n} record(s)", file=out)
+    elif args.cmd == "count_data":
+        n = 0
+        for _ in _full_scan_records(box, args.table, 0):
+            n += 1
+        print(n, file=out)
+    elif args.cmd == "copy_data":
+        dst = box.client(args.dst_table)
+        n = 0
+        for hk, sk, v in _full_scan_records(box, args.src_table,
+                                            args.max):
+            err = dst.set(hk, sk, v)
+            if err != 0:
+                print(f"error {err} at {hk!r}:{sk!r}", file=out)
+                return 1
+            n += 1
+        print(f"copied {n} record(s)", file=out)
+    elif args.cmd == "clear_data":
+        if not args.force:
+            print("refusing without --force (deletes every record)",
+                  file=out)
+            return 1
+        c = box.client(args.table)
+        by_hk = {}
+        for hk, sk, _v in _full_scan_records(box, args.table, 0):
+            by_hk.setdefault(hk, []).append(sk)
+        n = 0
+        for hk, sks in by_hk.items():
+            err, deleted = c.multi_del(hk, sks)
+            if err != 0:
+                print(f"error {err} at {hk!r}", file=out)
+                return 1
+            n += deleted
+        print(f"deleted {n} record(s)", file=out)
+    elif args.cmd == "hash":
+        from pegasus_tpu.base.key_schema import (
+            generate_key, key_hash_parts)
+        h = key_hash_parts(_b(args.hash_key), _b(args.sort_key))
+        count = next((row["partition_count"]
+                      for row in box.list_tables()
+                      if row["name"] == args.table), None)
+        key = generate_key(_b(args.hash_key), _b(args.sort_key))
+        print(f"key_hash: {h}", file=out)
+        print(f"encoded_key: {key.hex()}", file=out)
+        if count:
+            print(f"partition: {h % count} (of {count})", file=out)
+    elif args.cmd == "rdb_key_str2hex":
+        from pegasus_tpu.base.key_schema import generate_key
+        print(generate_key(_b(args.hash_key), _b(args.sort_key)).hex(),
+              file=out)
+    elif args.cmd == "rdb_key_hex2str":
+        from pegasus_tpu.base.key_schema import restore_key
+        hk, sk = restore_key(bytes.fromhex(args.hex_key))
+        print(f"hash_key: {hk.decode(errors='replace')}", file=out)
+        print(f"sort_key: {sk.decode(errors='replace')}", file=out)
+    elif args.cmd == "rdb_value_hex2str":
+        from pegasus_tpu.base.value_schema import (
+            extract_expire_ts, extract_user_data)
+        raw = bytes.fromhex(args.hex_value)
+        print(f"expire_ts: {extract_expire_ts(1, raw)}", file=out)
+        print(f"user_data: "
+              f"{extract_user_data(1, raw).decode(errors='replace')}",
+              file=out)
     elif args.cmd == "set_app_envs":
         envs = dict(kv.split("=", 1) for kv in args.envs)
         box.update_app_envs(args.table, envs)
@@ -534,6 +1051,126 @@ def _dispatch(args, box, out) -> int:
     elif args.cmd == "query_split":
         print(json.dumps(box.admin.call("split_status",
                                         app_name=args.table)), file=out)
+    elif args.cmd == "cluster_info":
+        print(json.dumps(box.admin.call("cluster_info"), indent=1),
+              file=out)
+    elif args.cmd in ("server_info", "server_stat"):
+        nodes = ([args.node] if args.node
+                 else box.admin.call("list_nodes"))
+        verb = ("server.info" if args.cmd == "server_info"
+                else "metrics")
+        for n in nodes:
+            print(json.dumps({n: box.remote_command(n, verb, [])},
+                             indent=1), file=out)
+    elif args.cmd == "app_stat":
+        rows = []
+        for n in box.admin.call("list_nodes"):
+            for rep in box.remote_command(n, "replica.info", []):
+                rows.append(dict(rep, node=n))
+        app_ids = {row["app_id"] for row in box.list_tables()
+                   if row["name"] == args.table}
+        for rep in sorted(rows, key=lambda r: tuple(r["gpid"])):
+            if rep["gpid"][0] in app_ids:
+                print(json.dumps(rep), file=out)
+    elif args.cmd == "app_disk":
+        app_ids = {row["app_id"] for row in box.list_tables()
+                   if row["name"] == args.table}
+        total = 0
+        for n in box.admin.call("list_nodes"):
+            for rep in box.remote_command(n, "replica.disk", []):
+                if rep["gpid"][0] in app_ids:
+                    print(json.dumps(dict(rep, node=n)), file=out)
+                    total += rep["sst_bytes"] + rep["log_bytes"]
+        print(f"total: {total} bytes", file=out)
+    elif args.cmd == "ddd_diagnose":
+        for d in box.admin.call("ddd_diagnose"):
+            print(json.dumps(d), file=out)
+    elif args.cmd == "detect_hotkey":
+        print(json.dumps(box.remote_command(
+            args.node, "hotkey",
+            [args.action, str(args.app_id), str(args.pidx),
+             args.kind])), file=out)
+    elif args.cmd == "get_meta_level":
+        print(box.admin.call("get_meta_level"), file=out)
+    elif args.cmd == "set_meta_level":
+        print(box.admin.call("set_meta_level", level=args.level),
+              file=out)
+    elif args.cmd == "get_replica_count":
+        print(box.admin.call("get_replica_count", app_name=args.table),
+              file=out)
+    elif args.cmd == "set_replica_count":
+        print(box.admin.call("set_replica_count", app_name=args.table,
+                             count=args.count), file=out)
+    elif args.cmd == "propose":
+        box.admin.call("propose", app_name=args.table, pidx=args.pidx,
+                       action=args.action, node=args.node,
+                       force=args.force)
+        print("OK", file=out)
+    elif args.cmd == "recall_app":
+        app_id = box.admin.call("recall_app", app_name=args.table)
+        print(f"OK: recalled {args.table} (app {app_id})", file=out)
+    elif args.cmd == "rename":
+        box.admin.call("rename_app", old_name=args.old_name,
+                       new_name=args.new_name)
+        print("OK", file=out)
+    elif args.cmd == "del_app_envs":
+        n = box.admin.call("del_app_envs", app_name=args.table,
+                           keys=args.keys)
+        print(f"OK: removed {n}", file=out)
+    elif args.cmd == "clear_app_envs":
+        n = box.admin.call("clear_app_envs", app_name=args.table,
+                           prefix=args.prefix)
+        print(f"OK: removed {n}", file=out)
+    elif args.cmd == "add_backup_policy":
+        box.admin.call("add_backup_policy", name=args.name,
+                       app_names=args.tables, root=args.bucket,
+                       interval_seconds=args.interval,
+                       backup_history_count=args.history)
+        print("OK", file=out)
+    elif args.cmd == "ls_backup_policy":
+        for pol in box.admin.call("ls_backup_policy"):
+            print(json.dumps(pol), file=out)
+    elif args.cmd == "query_backup_policy":
+        print(json.dumps(box.admin.call("query_backup_policy",
+                                        name=args.name), indent=1),
+              file=out)
+    elif args.cmd == "modify_backup_policy":
+        pol = box.admin.call(
+            "modify_backup_policy", name=args.name,
+            add_apps=args.add_tables, remove_apps=args.remove_tables,
+            interval_seconds=args.interval,
+            backup_history_count=args.history)
+        print(json.dumps(pol), file=out)
+    elif args.cmd == "enable_backup_policy":
+        box.admin.call("enable_backup_policy", name=args.name)
+        print("OK", file=out)
+    elif args.cmd == "disable_backup_policy":
+        box.admin.call("disable_backup_policy", name=args.name)
+        print("OK", file=out)
+    elif args.cmd == "pause_dup":
+        box.admin.call("pause_dup", dupid=args.dupid)
+        print("OK", file=out)
+    elif args.cmd == "start_dup":
+        box.admin.call("start_dup", dupid=args.dupid)
+        print("OK", file=out)
+    elif args.cmd == "set_dup_fail_mode":
+        box.admin.call("set_dup_fail_mode", dupid=args.dupid,
+                       fail_mode=args.fail_mode)
+        print("OK", file=out)
+    elif args.cmd == "pause_bulk_load":
+        box.admin.call("pause_bulk_load", app_name=args.table)
+        print("OK", file=out)
+    elif args.cmd == "restart_bulk_load":
+        box.admin.call("restart_bulk_load", app_name=args.table)
+        print("OK", file=out)
+    elif args.cmd == "cancel_bulk_load":
+        box.admin.call("cancel_bulk_load", app_name=args.table)
+        print("OK", file=out)
+    elif args.cmd == "clear_bulk_load":
+        box.admin.call("clear_bulk_load", app_name=args.table)
+        print("OK", file=out)
+    elif args.cmd == "flush_log":
+        print(box.remote_command(args.node, "flush", []), file=out)
     elif args.cmd == "remote_command":
         print(json.dumps(box.remote_command(args.node, args.verb,
                                             args.cmd_args), indent=1),

@@ -1,0 +1,260 @@
+"""Shell command breadth (parity: src/shell/main.cpp's 87-command
+surface): extended data verbs, offline forensics, codec tools, the
+interactive REPL, and admin verbs over a real multi-process onebox.
+"""
+
+import json
+import os
+import shutil
+import time
+
+import pytest
+
+from pegasus_tpu.tools.shell import main as shell_main
+
+
+def run(capsys, *argv):
+    code = shell_main(list(argv))
+    return code, capsys.readouterr().out
+
+
+@pytest.fixture
+def root(tmp_path, capsys):
+    root = str(tmp_path / "box")
+    assert shell_main(["--root", root, "create_app", "demo",
+                       "-p", "4"]) == 0
+    capsys.readouterr()
+    return root
+
+
+def test_check_and_set_and_mutate(root, capsys):
+    code, out = run(capsys, "--root", root, "check_and_set", "demo",
+                    "h", "ck", "not_exist", "", "sk", "v1")
+    assert code == 0 and "set" in out
+    # check now fails: ck still absent is false? ck was never written —
+    # set it, then not_exist fails
+    assert run(capsys, "--root", root, "set", "demo", "h", "ck",
+               "present")[0] == 0
+    code, out = run(capsys, "--root", root, "check_and_set", "demo",
+                    "h", "ck", "not_exist", "", "sk", "v2")
+    assert "not set" in out
+    code, out = run(capsys, "--root", root, "check_and_set", "demo",
+                    "h", "ck", "match_prefix", "pre", "sk", "v3")
+    assert "set" in out and "not set" not in out
+    # check_and_mutate: put two, delete one
+    code, out = run(capsys, "--root", root, "check_and_mutate", "demo",
+                    "h", "ck", "exist", "", "m1=a", "m2=b", "del:sk")
+    assert code == 0 and "mutated" in out
+    code, out = run(capsys, "--root", root, "get", "demo", "h", "m1")
+    assert out.strip() == "a"
+    code, out = run(capsys, "--root", root, "exist", "demo", "h", "sk")
+    assert out.strip() == "false"
+
+
+def test_multi_range_verbs(root, capsys):
+    kvs = ["s%02d=v%d" % (i, i) for i in range(10)]
+    assert run(capsys, "--root", root, "multi_set", "demo", "h",
+               *kvs)[0] == 0
+    code, out = run(capsys, "--root", root, "multi_get_range", "demo",
+                    "h", "--start", "s02", "--stop", "s05")
+    assert code == 0 and "s02" in out and "s05" not in out
+    code, out = run(capsys, "--root", root, "multi_get_sortkeys",
+                    "demo", "h")
+    assert "10 sort key(s)" in out
+    code, out = run(capsys, "--root", root, "hash_scan", "demo", "h",
+                    "--start", "s03", "--stop", "s07")
+    assert "s03" in out and "4 record(s)" in out
+    code, out = run(capsys, "--root", root, "multi_del", "demo", "h",
+                    "s00", "s01")
+    assert "deleted 2" in out
+    code, out = run(capsys, "--root", root, "multi_del_range", "demo",
+                    "h", "--start", "s02", "--stop", "s04")
+    assert "deleted 2" in out
+    code, out = run(capsys, "--root", root, "count", "demo", "h")
+    assert out.strip() == "6"
+
+
+def test_multi_del_range_paginates_past_read_limit(root, capsys):
+    """Ranges larger than the server's one-shot read budget (the
+    INCOMPLETE cap) must delete everything via pagination."""
+    kvs = ["s%04d=v" % i for i in range(1500)]
+    # multi_set in chunks (arg list size)
+    for off in range(0, 1500, 500):
+        assert run(capsys, "--root", root, "multi_set", "demo", "big",
+                   *kvs[off:off + 500])[0] == 0
+    code, out = run(capsys, "--root", root, "multi_del_range", "demo",
+                    "big")
+    assert code == 0 and "deleted 1500" in out
+    code, out = run(capsys, "--root", root, "count", "demo", "big")
+    assert out.strip() == "0"
+
+
+def test_check_and_mutate_rejects_ambiguous_token(root, capsys):
+    assert run(capsys, "--root", root, "set", "demo", "h", "ck",
+               "x")[0] == 0
+    # no '=' and no del: prefix -> error, nothing executed
+    code, out = run(capsys, "--root", root, "check_and_mutate", "demo",
+                    "h", "ck", "exist", "", "forgot_equals")
+    assert code == 1
+    # del: prefix deletes; sk= puts an empty value
+    assert run(capsys, "--root", root, "set", "demo", "h", "gone",
+               "x")[0] == 0
+    code, out = run(capsys, "--root", root, "check_and_mutate", "demo",
+                    "h", "ck", "exist", "", "del:gone", "empty=")
+    assert code == 0 and "mutated" in out
+    code, out = run(capsys, "--root", root, "exist", "demo", "h",
+                    "gone")
+    assert out.strip() == "false"
+    code, out = run(capsys, "--root", root, "exist", "demo", "h",
+                    "empty")
+    assert out.strip() == "true"
+
+
+def test_full_scan_copy_clear_count(root, capsys):
+    for i in range(12):
+        assert run(capsys, "--root", root, "set", "demo",
+                   "hk%d" % i, "s", "v%d" % i)[0] == 0
+    code, out = run(capsys, "--root", root, "count_data", "demo")
+    assert out.strip() == "12"
+    code, out = run(capsys, "--root", root, "full_scan", "demo",
+                    "--max", "5")
+    assert "5 record(s)" in out
+    assert run(capsys, "--root", root, "create_app", "copy",
+               "-p", "2")[0] == 0
+    code, out = run(capsys, "--root", root, "copy_data", "demo", "copy")
+    assert "copied 12" in out
+    code, out = run(capsys, "--root", root, "count_data", "copy")
+    assert out.strip() == "12"
+    code, out = run(capsys, "--root", root, "clear_data", "copy")
+    assert code == 1 and "force" in out
+    code, out = run(capsys, "--root", root, "clear_data", "copy",
+                    "--force")
+    assert "deleted 12" in out
+    code, out = run(capsys, "--root", root, "count_data", "copy")
+    assert out.strip() == "0"
+
+
+def test_hash_and_codec_tools(root, capsys):
+    code, out = run(capsys, "--root", root, "hash", "demo", "hk", "sk")
+    assert code == 0 and "partition:" in out and "key_hash" in out
+    code, hex_out = run(capsys, "rdb_key_str2hex", "hk", "sk")
+    assert code == 0
+    code, out = run(capsys, "rdb_key_hex2str", hex_out.strip())
+    assert "hash_key: hk" in out and "sort_key: sk" in out
+    # value: [u32 expire_ts][data] v1 layout via a real stored value
+    from pegasus_tpu.base.value_schema import generate_value
+
+    raw = generate_value(1, b"payload", 0)
+    code, out = run(capsys, "rdb_value_hex2str", raw.hex())
+    assert "payload" in out
+
+
+def test_local_get_offline(root, capsys, tmp_path):
+    assert run(capsys, "--root", root, "set", "demo", "off", "s",
+               "offline-value")[0] == 0
+    assert run(capsys, "--root", root, "flush", "demo")[0] == 0
+    # find the partition dir holding the key
+    from pegasus_tpu.base.key_schema import key_hash_parts
+
+    pidx = key_hash_parts(b"off", b"s") % 4
+    sst_dir = None
+    for dirpath, dirnames, filenames in os.walk(root):
+        # partition dirs are "<app_id>.<pidx>/sst"
+        if (os.path.basename(dirpath) == "sst"
+                and os.path.dirname(dirpath).endswith(f".{pidx}")
+                and filenames):
+            sst_dir = dirpath
+    assert sst_dir, f"no sst dir for p{pidx} under {root}"
+    code, out = run(capsys, "local_get", sst_dir, "off", "s")
+    assert code == 0 and "offline-value" in out
+    code, out = run(capsys, "local_get", sst_dir, "nope", "s")
+    assert code == 1
+
+
+def test_repl(root, capsys, monkeypatch):
+    # find an sst file to prove offline verbs work inside the REPL
+    assert run(capsys, "--root", root, "set", "demo", "rk", "s",
+               "rv")[0] == 0
+    assert run(capsys, "--root", root, "flush", "demo")[0] == 0
+    sst = None
+    for dirpath, _dn, filenames in os.walk(root):
+        for f in filenames:
+            if f.endswith(".sst"):
+                sst = os.path.join(dirpath, f)
+    assert sst
+    lines = iter(["use demo", "set hk sk repl-value", "get hk sk",
+                  "hash hk sk", "version", "help", "bogus_verb",
+                  f"sst_dump {sst}", "exit"])
+    monkeypatch.setattr("builtins.input",
+                        lambda prompt="": next(lines))
+    assert shell_main(["--root", root]) == 0
+    out = capsys.readouterr().out
+    assert "using demo" in out
+    assert "repl-value" in out
+    assert "key_hash" in out
+    assert "full_scan" in out  # help listing
+    assert "records" in out  # sst_dump ran offline inside the REPL
+
+
+def test_admin_verbs_over_wire(tmp_path, capsys):
+    """Admin breadth against a real 1-meta + 2-replica process cluster."""
+    from pegasus_tpu.tools import onebox_cluster as ob
+    from pegasus_tpu.utils.errors import PegasusError
+
+    d = str(tmp_path / "onebox")
+    shutil.rmtree(d, ignore_errors=True)
+    ob.start(d, n_replica=2)
+    try:
+        admin = ob.OneboxAdmin(d)
+        deadline = time.monotonic() + 90
+        while time.monotonic() < deadline:
+            try:
+                if len(admin.call("list_nodes", timeout=6)) == 2:
+                    break
+            except PegasusError:
+                pass
+            time.sleep(0.5)
+        admin.create_table("wt", partition_count=2, replica_count=2)
+        admin.close()
+        c = ob.connect("wt", d)
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            try:
+                if c.set(b"k", b"s", b"v") == 0:
+                    break
+            except PegasusError:
+                time.sleep(1)
+        c.net.close()
+
+        code, out = run(capsys, "--cluster", d, "cluster_info")
+        info = json.loads(out)
+        assert info["app_count"] == 1 and len(info["alive_nodes"]) == 2
+        code, out = run(capsys, "--cluster", d, "server_info")
+        assert code == 0 and "replica_count" in out
+        code, out = run(capsys, "--cluster", d, "get_meta_level")
+        assert out.strip() == "steady"
+        code, out = run(capsys, "--cluster", d, "set_meta_level",
+                        "lively")
+        assert out.strip() == "lively"
+        code, out = run(capsys, "--cluster", d, "get_replica_count",
+                        "wt")
+        assert out.strip() == "2"
+        code, out = run(capsys, "--cluster", d, "app_stat", "wt")
+        assert code == 0 and '"gpid"' in out
+        code, out = run(capsys, "--cluster", d, "app_disk", "wt")
+        assert "total:" in out
+        code, out = run(capsys, "--cluster", d, "ddd_diagnose")
+        assert code == 0
+        code, out = run(capsys, "--cluster", d, "rename", "wt", "wt2")
+        assert "OK" in out
+        code, out = run(capsys, "--cluster", d, "ls")
+        assert "wt2" in out
+        code, out = run(capsys, "--cluster", d, "flush_log", "node0")
+        assert "flushed" in out
+        code, out = run(capsys, "--cluster", d, "set", "wt2", "a",
+                        "s", "x")
+        assert code == 0
+        code, out = run(capsys, "--cluster", d, "full_scan", "wt2")
+        assert code == 0 and "record(s)" in out
+    finally:
+        ob.stop(d)
