@@ -139,9 +139,13 @@ class BenchCluster:
         self.replicas = [node.get_replica((self.app_id, pidx))
                          for pidx in range(n_partitions)]
 
-    def manual_compact_all(self, rules_filter=None):
-        for srv in self.servers:
-            srv.manual_compact(rules_filter=rules_filter)
+    def manual_compact_all(self, rules_filter=None, device=None):
+        """Partitions overlap on a thread pool: each one's device-filter
+        round-trip would otherwise serialize (64 x tunnel RTT)."""
+        from pegasus_tpu.client.table import compact_partitions_parallel
+
+        compact_partitions_parallel(self.servers, device=device,
+                                    rules_filter=rules_filter)
 
     def close(self):
         self.cluster.close()
@@ -301,12 +305,12 @@ def measure_scan_phase(jax, device, bc, n_ops, n_partitions, n_hashkeys,
 def _measure_scan_phase(jax, device, bc, n_ops, n_partitions, n_hashkeys,
                         seed):
     with jax.default_device(device):
-        bc.manual_compact_all()
+        bc.manual_compact_all(device=device)
         # warmup covers both compiled stack shapes AND the overlay path
         # (inserts) so the measured phase pays no first-touch compiles
         run_scans(bc, 120, n_partitions, n_hashkeys, seed, insert_frac=0)
         run_scans(bc, 60, n_partitions, n_hashkeys, seed + 1)
-        bc.manual_compact_all()
+        bc.manual_compact_all(device=device)
         # steady-state pre-touch: the compact above rewrote the SSTs, so
         # without this pass the measured run pays one first-touch
         # host->device block upload per block — a load-time cost, not
@@ -328,7 +332,7 @@ def _measure_scan_phase(jax, device, bc, n_ops, n_partitions, n_hashkeys,
                 # state — pass 1's 5% inserts would otherwise push later
                 # passes onto the overlay-merge path and 'best' would
                 # just mean 'first'
-                bc.manual_compact_all()
+                bc.manual_compact_all(device=device)
                 run_scans(bc, n_ops, n_partitions, n_hashkeys, seed,
                           insert_frac=0)
             ops, recs, secs = run_scans(bc, n_ops, n_partitions,
@@ -366,7 +370,7 @@ def measure_compaction(jax, device, bc, mode: str):
     size_before = data_bytes(bc)
     with jax.default_device(device):
         t0 = time.perf_counter()
-        bc.manual_compact_all(rules_filter=rules_filter)
+        bc.manual_compact_all(rules_filter=rules_filter, device=device)
         secs = time.perf_counter() - t0
     return size_before / max(secs, 1e-9), secs
 
@@ -480,6 +484,10 @@ def main() -> None:
             # client->gate->engine path; the accel/cpu ratio shows the
             # device path does not tax point reads)
             g_ops = max(2000, n_ops)
+            # warm once for BOTH phases: the engine builds per-block
+            # key lists lazily on first bisect — whichever phase runs
+            # first would otherwise pay that construction and read slow
+            run_point_gets(bc, g_ops, n_hashkeys, seed + 3)
             with jax.default_device(accel):
                 ops_g, hits_g, accel_g = run_point_gets(
                     bc, g_ops, n_hashkeys, seed + 3)

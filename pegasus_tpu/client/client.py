@@ -100,13 +100,25 @@ class PegasusScanner:
         return self
 
     def __next__(self) -> Tuple[bytes, bytes, bytes]:
+        kv = self._next_kv()
+        hk, sk = restore_key(kv.key)
+        return hk, sk, kv.value
+
+    def next_record(self) -> Tuple[bytes, bytes, bytes, int]:
+        """Like next(), plus the record's expire_ts (0 = no TTL).
+        Meaningful only when the scan was opened with
+        ScanOptions.return_expire_ts."""
+        kv = self._next_kv()
+        hk, sk = restore_key(kv.key)
+        return hk, sk, kv.value, kv.expire_ts_seconds or 0
+
+    def _next_kv(self):
         while True:
             if self._buf_pos < len(self._buffer):
                 kv = self._buffer[self._buf_pos]
                 self._buf_pos += 1
                 self._last_key = kv.key
-                hk, sk = restore_key(kv.key)
-                return hk, sk, kv.value
+                return kv
             if not self._fetch_next_batch():
                 raise StopIteration
 
@@ -245,8 +257,21 @@ class PegasusClient:
 
     def multi_get_sortkeys(self, hash_key: bytes
                            ) -> Tuple[int, List[bytes]]:
-        err, kvs = self.multi_get(hash_key, no_value=True)
-        return err, sorted(kvs.keys())
+        """All sort keys under a hash key, paginating past the server's
+        one-shot read budget (INCOMPLETE pages resume after their last
+        key — without this, large hash keys silently truncate)."""
+        out: List[bytes] = []
+        cursor, inclusive = b"", True
+        while True:
+            err, kvs = self.multi_get(hash_key, no_value=True,
+                                      start_sortkey=cursor,
+                                      start_inclusive=inclusive)
+            out.extend(kvs)
+            if err != int(StorageStatus.INCOMPLETE):
+                return err, sorted(out)
+            if not kvs:
+                return int(StorageStatus.OK), sorted(out)
+            cursor, inclusive = max(kvs), False
 
     def multi_del(self, hash_key: bytes, sort_keys: Sequence[bytes]
                   ) -> Tuple[int, int]:

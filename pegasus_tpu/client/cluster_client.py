@@ -297,8 +297,20 @@ class ClusterClient:
 
     def multi_get_sortkeys(self, hash_key: bytes
                            ) -> Tuple[int, List[bytes]]:
-        err, kvs = self.multi_get(hash_key, no_value=True)
-        return err, sorted(kvs)
+        """Paginates past the server's one-shot read budget, like the
+        in-process client's version."""
+        out: List[bytes] = []
+        cursor, inclusive = b"", True
+        while True:
+            err, kvs = self.multi_get(hash_key, no_value=True,
+                                      start_sortkey=cursor,
+                                      start_inclusive=inclusive)
+            out.extend(kvs)
+            if err != int(StorageStatus.INCOMPLETE):
+                return err, sorted(out)
+            if not kvs:
+                return int(StorageStatus.OK), sorted(out)
+            cursor, inclusive = max(kvs), False
 
     def sortkey_count(self, hash_key: bytes) -> Tuple[int, int]:
         if not hash_key:
@@ -513,13 +525,24 @@ class ClusterScanner:
         return self
 
     def __next__(self) -> Tuple[bytes, bytes, bytes]:
+        kv = self._next_kv()
+        hk, sk = restore_key(kv.key)
+        return hk, sk, kv.value
+
+    def next_record(self) -> Tuple[bytes, bytes, bytes, int]:
+        """Like next(), plus the record's expire_ts (0 = no TTL);
+        meaningful only with GetScannerRequest.return_expire_ts."""
+        kv = self._next_kv()
+        hk, sk = restore_key(kv.key)
+        return hk, sk, kv.value, kv.expire_ts_seconds or 0
+
+    def _next_kv(self):
         while True:
             if self._pos < len(self._buffer):
                 kv = self._buffer[self._pos]
                 self._pos += 1
                 self._last_key = kv.key
-                hk, sk = restore_key(kv.key)
-                return hk, sk, kv.value
+                return kv
             if not self._fetch(self._request):
                 raise StopIteration
 

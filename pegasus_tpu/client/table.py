@@ -18,6 +18,32 @@ from pegasus_tpu.base.key_schema import partition_index
 from pegasus_tpu.server.partition_server import PartitionServer
 
 
+def compact_partitions_parallel(servers, parallel: int = 8, device=None,
+                                **compact_kwargs) -> None:
+    """Manually compact many PartitionServers on a small thread pool
+    (parity: the manual compact service's
+    max_concurrent_running_count). Each partition's device filter pays a
+    synchronous result round-trip, which on a tunneled accelerator is
+    tens of ms — overlapping partitions hides it (device waits release
+    the GIL). `device` pins workers' jax dispatch: jax.default_device is
+    thread-local, so the caller's context does not reach the pool."""
+    import contextlib
+    from concurrent.futures import ThreadPoolExecutor
+
+    def one(srv):
+        ctx = contextlib.nullcontext()
+        if device is not None:
+            import jax
+
+            ctx = jax.default_device(device)
+        with ctx:
+            srv.manual_compact(**compact_kwargs)
+
+    with ThreadPoolExecutor(max_workers=max(1, parallel)) as ex:
+        for f in [ex.submit(one, s) for s in servers]:
+            f.result()
+
+
 class Table:
     def __init__(self, data_dir: str, app_id: int = 1, app_name: str = "temp",
                  partition_count: int = 8, data_version: int = 1) -> None:
@@ -62,10 +88,13 @@ class Table:
         for p in self.all_partitions():
             p.flush()
 
-    def manual_compact_all(self, default_ttl=None, rules_filter=None) -> None:
-        """None defaults defer to each partition's app-envs."""
-        for p in self.all_partitions():
-            p.manual_compact(default_ttl=default_ttl, rules_filter=rules_filter)
+    def manual_compact_all(self, default_ttl=None, rules_filter=None,
+                           parallel: int = 8, device=None) -> None:
+        """None defaults defer to each partition's app-envs. Partitions
+        overlap via compact_partitions_parallel."""
+        compact_partitions_parallel(
+            self.all_partitions(), parallel=parallel, device=device,
+            default_ttl=default_ttl, rules_filter=rules_filter)
 
     def update_app_envs(self, envs: dict) -> None:
         """Propagate per-table envs to every partition (parity: meta

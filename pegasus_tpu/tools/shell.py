@@ -51,6 +51,10 @@ def main(argv=None) -> int:
                         help="multi-process onebox directory (wire mode: "
                              "commands go over TCP through meta and the "
                              "replica servers)")
+    parser.add_argument("-i", "--interactive", action="store_true",
+                        help="force the REPL even when stdin is not a "
+                             "tty (the REPL also starts when no command "
+                             "is given on an interactive terminal)")
     sub = parser.add_subparsers(dest="cmd", required=False)
 
     p = sub.add_parser("create_app")
@@ -342,6 +346,12 @@ def main(argv=None) -> int:
     out = sys.stdout
     try:
         if args.cmd is None:
+            if not (args.interactive or sys.stdin.isatty()):
+                # a script that lost its verb must fail loudly, not
+                # hang on (or EOF out of) an accidental REPL
+                print("error: no command given and stdin is not a tty "
+                      "(pass -i to force the REPL)", file=sys.stderr)
+                return 2
             return _repl(parser, box, out)
         return _dispatch(args, box, out)
     except AttributeError as exc:
@@ -676,19 +686,29 @@ def _check_type(name: str) -> int:
             f"{', '.join(_CHECK_TYPES)}") from None
 
 
-def _full_scan_records(box, table, limit):
+def _full_scan_records(box, table, limit, with_ttl=False):
     """Iterate every record of a table via unordered scanners (parity:
-    full_scan's total-order seek across partitions)."""
+    full_scan's total-order seek across partitions). Yields
+    (hk, sk, value) — or (hk, sk, value, expire_ts) with `with_ttl`.
+    Open server scan contexts are closed even on early exit."""
     from pegasus_tpu.client import ScanOptions
 
     c = box.client(table)
+    opts = ScanOptions(batch_size=500, return_expire_ts=with_ttl)
     n = 0
-    for sc in c.get_unordered_scanners(4, ScanOptions(batch_size=500)):
-        for hk, sk, v in sc:
-            yield hk, sk, v
-            n += 1
-            if limit and n >= limit:
-                return
+    for sc in c.get_unordered_scanners(4, opts):
+        try:
+            while True:
+                try:
+                    rec = sc.next_record() if with_ttl else next(sc)
+                except StopIteration:
+                    break
+                yield rec
+                n += 1
+                if limit and n >= limit:
+                    return
+        finally:
+            sc.close()
 
 
 def _dispatch(args, box, out) -> int:
@@ -806,7 +826,8 @@ def _dispatch(args, box, out) -> int:
         # TRY_AGAIN is ambiguous: a FAILED CHECK carries the check value
         # back (we asked for it); a gate rejection (throttle/deny) is a
         # bare error and must not read as "check failed"
-        check_failed = resp.error == 13 and resp.check_value_returned
+        check_failed = (resp.error == int(StorageStatus.TRY_AGAIN)
+                        and resp.check_value_returned)
         if resp.error != 0 and not check_failed:
             print(f"error {resp.error}", file=out)
             return 1
@@ -836,7 +857,8 @@ def _dispatch(args, box, out) -> int:
             _b(args.hash_key), _b(args.check_sort_key),
             _check_type(args.check_type), _b(args.check_operand), muts,
             return_check_value=True)
-        check_failed = resp.error == 13 and resp.check_value_returned
+        check_failed = (resp.error == int(StorageStatus.TRY_AGAIN)
+                        and resp.check_value_returned)
         if resp.error != 0 and not check_failed:
             print(f"error {resp.error}", file=out)
             return 1
@@ -863,7 +885,7 @@ def _dispatch(args, box, out) -> int:
                                    stop_sortkey=_b(args.stop),
                                    start_inclusive=inclusive,
                                    no_value=True)
-            if err not in (0, 7):
+            if err not in (0, int(StorageStatus.INCOMPLETE)):
                 print(f"error {err}", file=out)
                 return 1
             if kvs:
@@ -883,17 +905,20 @@ def _dispatch(args, box, out) -> int:
                                start_sortkey=_b(args.start),
                                stop_sortkey=_b(args.stop),
                                max_kv_count=args.max)
-        if err not in (0, 7):  # 7 = INCOMPLETE (capped)
+        incomplete = err == int(StorageStatus.INCOMPLETE)
+        if err != 0 and not incomplete:
             print(f"error {err}", file=out)
             return 1
         for k, v in sorted(kvs.items()):
             print(f"{k.decode(errors='replace')} : "
                   f"{v.decode(errors='replace')}", file=out)
-        print(f"{len(kvs)} record(s)", file=out)
+        print(f"{len(kvs)} record(s)"
+              + (" (truncated — narrow the range or raise --max)"
+                 if incomplete else ""), file=out)
     elif args.cmd == "multi_get_sortkeys":
         c = box.client(args.table)
         err, sks = c.multi_get_sortkeys(_b(args.hash_key))
-        if err not in (0, 7):
+        if err != 0:
             print(f"error {err}", file=out)
             return 1
         for sk in sks:
@@ -926,11 +951,22 @@ def _dispatch(args, box, out) -> int:
             n += 1
         print(n, file=out)
     elif args.cmd == "copy_data":
+        from pegasus_tpu.base.value_schema import epoch_now
+
         dst = box.client(args.dst_table)
         n = 0
-        for hk, sk, v in _full_scan_records(box, args.src_table,
-                                            args.max):
-            err = dst.set(hk, sk, v)
+        now = epoch_now()
+        for hk, sk, v, ets in _full_scan_records(
+                box, args.src_table, args.max, with_ttl=True):
+            # preserve remaining TTL (the reference's copy_data keeps
+            # expire timestamps); records that expired mid-scan skip
+            if ets > 0:
+                ttl = ets - now
+                if ttl <= 0:
+                    continue
+            else:
+                ttl = 0
+            err = dst.set(hk, sk, v, ttl_seconds=ttl)
             if err != 0:
                 print(f"error {err} at {hk!r}:{sk!r}", file=out)
                 return 1
@@ -942,16 +978,26 @@ def _dispatch(args, box, out) -> int:
                   file=out)
             return 1
         c = box.client(args.table)
-        by_hk = {}
-        for hk, sk, _v in _full_scan_records(box, args.table, 0):
-            by_hk.setdefault(hk, []).append(sk)
+        # stream: records arrive in key order per partition, so one
+        # hash key's sort keys are contiguous — flush per hash key
+        # instead of materializing the whole table's keys
         n = 0
-        for hk, sks in by_hk.items():
-            err, deleted = c.multi_del(hk, sks)
-            if err != 0:
-                print(f"error {err} at {hk!r}", file=out)
-                return 1
-            n += deleted
+        cur_hk, cur_sks = None, []
+
+        def flush_hk():
+            nonlocal n
+            if cur_hk is not None and cur_sks:
+                err, deleted = c.multi_del(cur_hk, cur_sks)
+                if err != 0:
+                    raise ValueError(f"error {err} at {cur_hk!r}")
+                n += deleted
+
+        for hk, sk, _v in _full_scan_records(box, args.table, 0):
+            if hk != cur_hk:
+                flush_hk()
+                cur_hk, cur_sks = hk, []
+            cur_sks.append(sk)
+        flush_hk()
         print(f"deleted {n} record(s)", file=out)
     elif args.cmd == "hash":
         from pegasus_tpu.base.key_schema import (

@@ -187,7 +187,9 @@ def test_repl(root, capsys, monkeypatch):
                   f"sst_dump {sst}", "exit"])
     monkeypatch.setattr("builtins.input",
                         lambda prompt="": next(lines))
-    assert shell_main(["--root", root]) == 0
+    assert shell_main(["--root", root, "-i"]) == 0
+    # without -i on a non-tty stdin, the missing verb fails loudly
+    # instead of dropping into an accidental REPL
     out = capsys.readouterr().out
     assert "using demo" in out
     assert "repl-value" in out
