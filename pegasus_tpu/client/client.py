@@ -48,6 +48,33 @@ def _err_of(resp) -> int:
     return resp.error
 
 
+def paginate_sortkeys(fetch) -> "Tuple[int, List[bytes]]":
+    """Drive `fetch(cursor, inclusive) -> MultiGetResponse` (a no_value
+    range multi_get) to exhaustion, paging past the server's one-shot
+    read budget. Resumes from the response's resume_sort_key, so even a
+    page whose every record was filtered (a long expired run) makes
+    progress; if a server provides neither kvs nor a resume point, the
+    truncation is reported as INCOMPLETE — never silently as OK. Shared
+    by both clients' multi_get_sortkeys."""
+    out: List[bytes] = []
+    cursor, inclusive = b"", True
+    while True:
+        resp = fetch(cursor, inclusive)
+        out.extend(kv.key for kv in resp.kvs)
+        if resp.error != int(StorageStatus.INCOMPLETE):
+            return resp.error, sorted(out)
+        if resp.resume_sort_key is not None:
+            nxt = (resp.resume_sort_key, True)
+        elif resp.kvs:
+            nxt = (max(kv.key for kv in resp.kvs), False)
+        else:
+            return int(StorageStatus.INCOMPLETE), sorted(out)
+        if nxt == (cursor, inclusive):
+            # a server that stops making progress must not spin us
+            return int(StorageStatus.INCOMPLETE), sorted(out)
+        cursor, inclusive = nxt
+
+
 def make_hashkey_scan_request(hash_key: bytes, batch_size: int = 1000,
                               validate_partition_hash: bool = True):
     """The one place the hashkey-range scan request shape lives (both
@@ -258,20 +285,17 @@ class PegasusClient:
     def multi_get_sortkeys(self, hash_key: bytes
                            ) -> Tuple[int, List[bytes]]:
         """All sort keys under a hash key, paginating past the server's
-        one-shot read budget (INCOMPLETE pages resume after their last
-        key — without this, large hash keys silently truncate)."""
-        out: List[bytes] = []
-        cursor, inclusive = b"", True
-        while True:
-            err, kvs = self.multi_get(hash_key, no_value=True,
-                                      start_sortkey=cursor,
-                                      start_inclusive=inclusive)
-            out.extend(kvs)
-            if err != int(StorageStatus.INCOMPLETE):
-                return err, sorted(out)
-            if not kvs:
-                return int(StorageStatus.OK), sorted(out)
-            cursor, inclusive = max(kvs), False
+        one-shot read budget (INCOMPLETE pages resume from the server's
+        resume_sort_key — without this, large hash keys silently
+        truncate)."""
+
+        def fetch(cursor: bytes, inclusive: bool):
+            req = MultiGetRequest(hash_key, no_value=True,
+                                  start_sortkey=cursor,
+                                  start_inclusive=inclusive)
+            return self._table.resolve(hash_key).on_multi_get(req)
+
+        return paginate_sortkeys(fetch)
 
     def multi_del(self, hash_key: bytes, sort_keys: Sequence[bytes]
                   ) -> Tuple[int, int]:

@@ -297,20 +297,18 @@ class ClusterClient:
 
     def multi_get_sortkeys(self, hash_key: bytes
                            ) -> Tuple[int, List[bytes]]:
-        """Paginates past the server's one-shot read budget, like the
-        in-process client's version."""
-        out: List[bytes] = []
-        cursor, inclusive = b"", True
-        while True:
-            err, kvs = self.multi_get(hash_key, no_value=True,
-                                      start_sortkey=cursor,
-                                      start_inclusive=inclusive)
-            out.extend(kvs)
-            if err != int(StorageStatus.INCOMPLETE):
-                return err, sorted(out)
-            if not kvs:
-                return int(StorageStatus.OK), sorted(out)
-            cursor, inclusive = max(kvs), False
+        """Paginates past the server's one-shot read budget (shared
+        paginate_sortkeys driver)."""
+        from pegasus_tpu.client.client import paginate_sortkeys
+
+        def fetch(cursor: bytes, inclusive: bool):
+            req = MultiGetRequest(hash_key, no_value=True,
+                                  start_sortkey=cursor,
+                                  start_inclusive=inclusive)
+            return self._read("multi_get", req, -1,
+                              key_hash_parts(hash_key))
+
+        return paginate_sortkeys(fetch)
 
     def sortkey_count(self, hash_key: bytes) -> Tuple[int, int]:
         if not hash_key:

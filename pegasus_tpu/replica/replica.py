@@ -65,6 +65,20 @@ from pegasus_tpu.rpc.codec import (
 )
 from pegasus_tpu.server.partition_server import PartitionServer
 from pegasus_tpu.utils.errors import ErrorCode
+from pegasus_tpu.utils.thread_check import SerialAccessChecker
+
+
+def _serial(fn):
+    """Guard a replica entry point with the single-writer checker
+    (parity: _checker.only_one_thread_access(), replica_2pc.cpp:115):
+    concurrent entry from a second thread = a missing node lock, raised
+    loudly at the site instead of corrupting replication state."""
+    def wrapped(self, *args, **kwargs):
+        with self._access:
+            return fn(self, *args, **kwargs)
+    wrapped.__name__ = fn.__name__
+    wrapped.__doc__ = fn.__doc__
+    return wrapped
 
 PREPARE_LIST_CAPACITY = 1024
 
@@ -110,6 +124,8 @@ class Replica:
 
         self.status = PartitionStatus.INACTIVE
         self.config = ReplicaConfig(ballot=0, primary="", secondaries=[])
+        self._access = SerialAccessChecker(
+            f"replica {app_id}.{pidx}@{name}")
         self.prepare_list = PrepareList(
             self.server.engine.last_committed_decree, PREPARE_LIST_CAPACITY,
             self._apply_mutation)
@@ -190,6 +206,7 @@ class Replica:
 
     # ---- config (driven by meta / tests) ------------------------------
 
+    @_serial
     def assign_config(self, config: ReplicaConfig) -> None:
         """Parity: replica_config.cpp ballot-gated role changes."""
         if config.ballot < self.config.ballot:
@@ -208,8 +225,17 @@ class Replica:
                 # by re-preparing its own window under the new ballot
                 self._reprepare_window()
             else:
-                # membership change while primary (e.g. a failed secondary
-                # removed): open decrees stop waiting for ex-members
+                # membership change while primary. First retire learner
+                # entries that this config PROMOTES to secondary — they
+                # were kept in _learners through the promotion gap so no
+                # prepare could miss them, but leaving them forever means
+                # a LATER config that removes the node still finds it in
+                # _learners and keeps demanding its acks (observed: a
+                # shed ex-learner wedging every subsequent write).
+                for node in list(self._learners):
+                    if node in config.secondaries:
+                        del self._learners[node]
+                # open decrees stop waiting for ex-members
                 members = set(config.secondaries) | set(self._learners)
                 for decree in sorted(self._pending_acks):
                     self._pending_acks[decree] &= members
@@ -272,6 +298,7 @@ class Replica:
     # bounded-staleness pipelining window)
     PIPELINE_DEPTH = 2
 
+    @_serial
     def client_write(self, ops: List[WriteOp],
                      callback: Optional[Callable[[List[Any]], None]] = None
                      ) -> int:
@@ -370,6 +397,7 @@ class Replica:
             raise ValueError(f"unknown message type {msg_type}")
         handler(src, payload)
 
+    @_serial
     def _on_prepare(self, src: str, blob: bytes) -> None:
         """Parity: on_prepare (replica_2pc.cpp:532)."""
         mu = Mutation.decode(blob)
@@ -434,6 +462,7 @@ class Replica:
             "decree": mu.decree, "ballot": mu.ballot,
             "err": int(ErrorCode.ERR_OK)})
 
+    @_serial
     def _on_prepare_ack(self, src: str, ack: dict) -> None:
         """Parity: on_prepare_reply (replica_2pc.cpp:731)."""
         if self.status != PartitionStatus.PRIMARY:
@@ -736,6 +765,7 @@ class Replica:
 
     # ---- learning (parity: replica_learn.cpp) -------------------------
 
+    @_serial
     def add_learner(self, learner: str) -> None:
         """Primary: start shipping new prepares to the learner and tell it
         to init_learn (parity: RPC_LEARN_ADD_LEARNER)."""

@@ -65,6 +65,7 @@ class ActRunner:
         self.app_id: Optional[int] = None
         self._follower_clients: dict = {}
         self._backup_id = None
+        self.last_killed: Optional[str] = None
 
     def close(self) -> None:
         from pegasus_tpu.utils.fail_point import FAIL_POINTS
@@ -158,6 +159,25 @@ class ActRunner:
                 self.dir, n_nodes=int(kw.get("nodes", 4)),
                 seed=int(kw.get("seed", 7)),
                 n_meta=int(kw.get("n_meta", 1)))
+        elif verb == "kill_primary":
+            # kill partition <pidx>'s current primary; remembered for
+            # expect_primary_unchanged / expect_primary_recovered
+            pc = c.meta.state.get_partition(self.app_id, int(args[0]))
+            if not pc.primary:
+                raise ActError("partition has no primary to kill")
+            self.last_killed = pc.primary
+            c.kill(pc.primary)
+        elif verb == "expect_primary_unchanged":
+            pc = c.meta.state.get_partition(self.app_id, int(args[0]))
+            if pc.primary != self.last_killed:
+                raise ActError(
+                    f"primary moved to {pc.primary!r} (expected still "
+                    f"{self.last_killed!r})")
+        elif verb == "expect_primary_recovered":
+            pc = c.meta.state.get_partition(self.app_id, int(args[0]))
+            if not pc.primary or pc.primary == self.last_killed:
+                raise ActError(f"primary {pc.primary!r} not recovered "
+                               f"away from {self.last_killed!r}")
         elif verb == "kill_meta_leader":
             leader = [m for m in c.metas
                       if m.election.is_leader]
@@ -232,6 +252,21 @@ class ActRunner:
             pc = c.meta.state.get_partition(self.app_id, int(args[0]))
             if pc.ballot < int(args[1]):
                 raise ActError(f"ballot {pc.ballot} < {args[1]}")
+        elif verb == "set_replica_count":
+            c.meta.set_app_replica_count(
+                c.meta.state.apps[self.app_id].app_name, int(args[0]))
+        elif verb == "meta_level":
+            c.meta.set_meta_level(args[0])
+        elif verb == "expect_ddd":
+            gpids = {tuple(d["gpid"]) for d in c.meta.ddd_diagnose()}
+            want = (self.app_id, int(args[0]))
+            if want not in gpids:
+                raise ActError(f"{want} not in ddd list {gpids}")
+        elif verb == "propose":
+            # propose: <pidx> <action> <node> [force]
+            c.meta.propose(c.meta.state.apps[self.app_id].app_name,
+                           int(args[0]), args[1], args[2],
+                           force="force" in args[3:])
         elif verb == "expect_consistent":
             from pegasus_tpu.base.key_schema import (
                 generate_key,

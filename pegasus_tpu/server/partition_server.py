@@ -813,7 +813,7 @@ class PartitionServer:
             return resp
 
         limiter = RangeReadLimiter()
-        records, exhausted, _ = self._batched_scan(
+        records, exhausted, resume_key = self._batched_scan(
             start_key, stop_key or None, now,
             FilterSpec.none(),
             FilterSpec.make(req.sort_key_filter_type,
@@ -831,6 +831,11 @@ class PartitionServer:
         self.cu.add_read(size)
         resp.error = (int(StorageStatus.OK) if exhausted
                       else int(StorageStatus.INCOMPLETE))
+        if (not exhausted and not req.reverse
+                and resume_key is not None):
+            # even a fully-filtered page (e.g. a long expired run) stays
+            # resumable: the follow-up starts at this sort key
+            resp.resume_sort_key = restore_key(resume_key)[1]
         return resp
 
     def on_sortkey_count(self, hash_key: bytes) -> Tuple[int, int]:

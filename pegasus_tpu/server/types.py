@@ -87,6 +87,10 @@ class MultiGetRequest:
 class MultiGetResponse:
     error: int = 0
     kvs: List[KeyValue] = field(default_factory=list)
+    # set on INCOMPLETE (forward range mode): the sort key a follow-up
+    # page should start FROM (inclusive). Lets clients resume even when
+    # an entire page was filtered out (all-expired run) and kvs is empty.
+    resume_sort_key: Optional[bytes] = None
 
 
 @dataclass

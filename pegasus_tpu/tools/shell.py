@@ -955,13 +955,14 @@ def _dispatch(args, box, out) -> int:
 
         dst = box.client(args.dst_table)
         n = 0
-        now = epoch_now()
         for hk, sk, v, ets in _full_scan_records(
                 box, args.src_table, args.max, with_ttl=True):
             # preserve remaining TTL (the reference's copy_data keeps
-            # expire timestamps); records that expired mid-scan skip
+            # expire timestamps) — `now` per record, or a long scan
+            # would inflate TTLs and resurrect records that expired
+            # mid-scan
             if ets > 0:
-                ttl = ets - now
+                ttl = ets - epoch_now()
                 if ttl <= 0:
                     continue
             else:

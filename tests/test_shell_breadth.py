@@ -89,6 +89,32 @@ def test_multi_del_range_paginates_past_read_limit(root, capsys):
     assert out.strip() == "0"
 
 
+def test_sortkeys_resume_across_all_expired_run(root, capsys):
+    """An expired-but-uncompacted run longer than the one-shot read
+    budget must not truncate multi_get_sortkeys: the server's
+    resume_sort_key lets the client page THROUGH a fully-filtered page."""
+    import time as _time
+
+    from pegasus_tpu.tools.onebox import Onebox
+
+    box = Onebox(root)
+    try:
+        c = box.client("demo")
+        # >1000 (the read budget) doomed records, then live ones AFTER
+        # them in sort order
+        for i in range(1100):
+            assert c.set(b"exp", b"a%04d" % i, b"v",
+                         ttl_seconds=1) == 0
+        for i in range(30):
+            assert c.set(b"exp", b"z%02d" % i, b"v") == 0
+        _time.sleep(1.2)  # the run expires in place (no compaction)
+        err, sks = c.multi_get_sortkeys(b"exp")
+        assert err == 0
+        assert sks == [b"z%02d" % i for i in range(30)]
+    finally:
+        box.close()
+
+
 def test_check_and_mutate_rejects_ambiguous_token(root, capsys):
     assert run(capsys, "--root", root, "set", "demo", "h", "ck",
                "x")[0] == 0
