@@ -32,6 +32,21 @@ FT_MATCH_ANYWHERE = 1
 FT_MATCH_PREFIX = 2
 FT_MATCH_POSTFIX = 3
 
+
+def host_match_filter(data: bytes, filter_type: int,
+                      pattern: bytes) -> bool:
+    """Scalar twin of match_filter for host-side paths (overlay rows,
+    tests). Empty pattern matches everything, like the device kernel."""
+    if filter_type == FT_NO_FILTER or not pattern:
+        return True
+    if filter_type == FT_MATCH_ANYWHERE:
+        return pattern in data
+    if filter_type == FT_MATCH_PREFIX:
+        return data.startswith(pattern)
+    if filter_type == FT_MATCH_POSTFIX:
+        return data.endswith(pattern)
+    raise ValueError(f"unknown filter type {filter_type}")
+
 _PATTERN_MIN_WIDTH = 32
 
 

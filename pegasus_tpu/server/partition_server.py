@@ -38,7 +38,32 @@ from pegasus_tpu.base.value_schema import (
     expire_ts_from_ttl,
     header_length,
 )
-from pegasus_tpu.ops.predicates import FT_NO_FILTER, FilterSpec, scan_block_predicate
+from pegasus_tpu.ops.predicates import (
+    FT_MATCH_ANYWHERE,
+    FT_MATCH_POSTFIX,
+    FT_MATCH_PREFIX,
+    FT_NO_FILTER,
+    FilterSpec,
+    scan_block_predicate,
+)
+
+# the no-filter flavor's mask key component (and the normal form of any
+# empty-pattern filter, which matches everything)
+_NO_FILTER_KEY = (FT_NO_FILTER, b"", FT_NO_FILTER, b"")
+
+
+def _normalize_filter_key(r) -> tuple:
+    """(hash type, hash pattern, sort type, sort pattern), with
+    empty-pattern components collapsed to FT_NO_FILTER — both the host
+    and device matchers treat an empty pattern as match-all, so distinct
+    keys for them would only split batches and duplicate masks."""
+    hft, hfp = r.hash_key_filter_type, r.hash_key_filter_pattern
+    sft, sfp = r.sort_key_filter_type, r.sort_key_filter_pattern
+    if not hfp:
+        hft, hfp = FT_NO_FILTER, b""
+    if not sfp:
+        sft, sfp = FT_NO_FILTER, b""
+    return (hft, hfp, sft, sfp)
 from pegasus_tpu.ops.record_block import build_record_block
 from pegasus_tpu.server.capacity_units import CapacityUnitCalculator
 from pegasus_tpu.server.read_limiter import RangeReadLimiter
@@ -988,23 +1013,28 @@ class PartitionServer:
         overlay_count = len(lsm.memtable) + sum(t.total_count
                                                 for t in lsm.l0)
         # the shared-mask trick needs every request to share the mask
-        # inputs: no per-request filters/count mode, and ONE effective
-        # validate flag (a request-level opt-out would need its own mask)
+        # inputs: ONE effective validate flag and ONE filter spec across
+        # the batch (no count-only mode). A batch-wide SHARED filter —
+        # the geo covering-cell / prefix-scan shape — rides the same
+        # cached-mask machinery: the filter is simply part of the mask
+        # key, so repeated popular filters hit like unfiltered scans.
         validates = {bool(r.validate_partition_hash
                           and self.validate_partition_hash)
                      for r in reqs}
+        filters = {_normalize_filter_key(r) for r in reqs}
+        known = (FT_NO_FILTER, FT_MATCH_ANYWHERE, FT_MATCH_PREFIX,
+                 FT_MATCH_POSTFIX)
         simple = (runs and overlay_count <= self.OVERLAY_MERGE_LIMIT
-                  and len(validates) == 1 and all(
-                      r.hash_key_filter_type == FT_NO_FILTER
-                      and r.sort_key_filter_type == FT_NO_FILTER
-                      and not r.only_return_count
-                      for r in reqs))
+                  and len(validates) == 1 and len(filters) == 1
+                  and all(f[0] in known and f[2] in known
+                          for f in filters)
+                  and not any(r.only_return_count for r in reqs))
         if not simple:
             return None
         now = epoch_now() if now is None else now
-        none_f = FilterSpec.none()
         validate = validates.pop()
-        overlay = self._overlay_snapshot(now, validate) \
+        filter_key = filters.pop()
+        overlay = self._overlay_snapshot(now, validate, filter_key) \
             if overlay_count else ([], {})
         # 1 — per request: the block list + boundary bounds, capped a bit
         # beyond batch_size so expiry/hash drops don't starve the page
@@ -1043,7 +1073,7 @@ class PartitionServer:
             req_plans.append((req, start_key, stop_key, want, plan))
         return {"reqs": reqs, "req_plans": req_plans, "unique": unique,
                 "validate": validate, "now": now, "overlay": overlay,
-                "none_f": none_f, "t0": t0}
+                "filter_key": filter_key, "t0": t0}
 
     def planned_misses(self, state) -> "OrderedDict[tuple, object]":
         """Unique planned blocks whose masks are NOT cached (the device
@@ -1054,13 +1084,22 @@ class PartitionServer:
         expired_masks = {}
         misses: "OrderedDict[tuple, object]" = OrderedDict()
         now, validate = state["now"], state["validate"]
+        filter_key = state["filter_key"]
         wall = time.monotonic()
         with self._mask_lock:
             for ckey, (run, bm, blk) in state["unique"].items():
-                self._hot_blocks[ckey] = (blk, validate, wall)
-                self._hot_blocks.move_to_end(ckey)
-                mkey = (ckey, now, self.partition_version, validate)
+                mkey = (ckey, now, self.partition_version, validate,
+                        filter_key)
                 cached = self._mask_cache.get(mkey)
+                # hot registration drives prefresher work: the no-filter
+                # flavor always registers; a FILTERED flavor registers
+                # only once it repeats (a cache hit proves recurrence) —
+                # one-shot filter patterns must not multiply background
+                # device work or evict the long-lived hot set
+                if filter_key == _NO_FILTER_KEY or cached is not None:
+                    hkey = (ckey, validate, filter_key)
+                    self._hot_blocks[hkey] = (blk, wall)
+                    self._hot_blocks.move_to_end(hkey)
                 if cached is not None:
                     self._mask_cache.move_to_end(mkey)
                     keep_masks[ckey], expired_masks[ckey] = cached
@@ -1076,10 +1115,10 @@ class PartitionServer:
 
     def store_mask(self, state, ckey, keep, expired) -> None:
         self.store_mask_for(ckey, state["now"], state["validate"],
-                            keep, expired,
+                            state["filter_key"], keep, expired,
                             computed_pv=self.partition_version)
 
-    def store_mask_for(self, ckey, now: int, validate: bool,
+    def store_mask_for(self, ckey, now: int, validate: bool, filter_key,
                        keep, expired, computed_pv: int) -> None:
         """Publish a mask under the partition_version it was COMPUTED
         with. The prefresher evaluates on its own thread — if a split
@@ -1089,27 +1128,28 @@ class PartitionServer:
         with self._mask_lock:
             if computed_pv != self.partition_version:
                 return
-            self._mask_cache[(ckey, now, computed_pv,
-                              validate)] = (keep, expired)
+            self._mask_cache[(ckey, now, computed_pv, validate,
+                              filter_key)] = (keep, expired)
             if len(self._mask_cache) > self._mask_cache_cap:
                 self._mask_cache.popitem(last=False)
 
     def hot_block_entries(self, wall: float, horizon_s: float,
                           target_now: int):
-        """(ckey, block, validate) for recently-scanned blocks missing a
-        mask for `target_now` — the MaskPrefresher's work list. Prunes
-        entries idle past the horizon."""
+        """(ckey, block, validate, filter_key) for recently-scanned
+        blocks missing a mask for `target_now` — the MaskPrefresher's
+        work list. Prunes entries idle past the horizon."""
         out = []
         with self._mask_lock:
-            for ckey in list(self._hot_blocks):
-                blk, validate, ts = self._hot_blocks[ckey]
+            for hkey in list(self._hot_blocks):
+                blk, ts = self._hot_blocks[hkey]
                 if wall - ts > horizon_s:
-                    del self._hot_blocks[ckey]
+                    del self._hot_blocks[hkey]
                     continue
+                ckey, validate, filter_key = hkey
                 mkey = (ckey, target_now, self.partition_version,
-                        validate)
+                        validate, filter_key)
                 if mkey not in self._mask_cache:
-                    out.append((ckey, blk, validate))
+                    out.append((ckey, blk, validate, filter_key))
         return out
 
     def eval_planned_masks(self, state):
@@ -1118,7 +1158,7 @@ class PartitionServer:
         keep_masks = state["cached_keep"]
         expired_masks = state["cached_expired"]
         for ckey, keep, expired in self._eval_blocks_stacked(
-                misses, state["now"], state["none_f"],
+                misses, state["now"], state["filter_key"],
                 state["validate"]):
             keep_masks[ckey] = keep
             expired_masks[ckey] = expired
@@ -1271,14 +1311,21 @@ class PartitionServer:
     # back to per-request merged serving
     OVERLAY_MERGE_LIMIT = 4096
 
-    def _overlay_snapshot(self, now: int, validate: bool):
+    def _overlay_snapshot(self, now: int, validate: bool,
+                          filter_key=None):
         """(sorted_keys, key -> None|(user_data, ets)) for the memtable +
         L0 overlay, newest-wins, with the scan predicates (TTL, stale-
-        split hash) evaluated HOST-side — the overlay is tiny by the
-        fast-path qualifier, so a device dispatch would cost more than
-        it filters."""
-        from pegasus_tpu.base.key_schema import check_key_hash
+        split hash, and the batch's shared key filter) evaluated
+        HOST-side — the overlay is tiny by the fast-path qualifier, so a
+        device dispatch would cost more than it filters. A key failing
+        the filter is excluded entirely (its base copies fail the same
+        filter in the device mask, so nothing needs shadowing)."""
+        from pegasus_tpu.base.key_schema import check_key_hash, restore_key
+        from pegasus_tpu.ops.predicates import host_match_filter
         from pegasus_tpu.storage.memtable import TOMBSTONE
+
+        hft, hfp, sft, sfp = filter_key or (FT_NO_FILTER, b"",
+                                            FT_NO_FILTER, b"")
 
         lsm = self.engine.lsm
         merged: dict = {}
@@ -1290,9 +1337,13 @@ class PartitionServer:
                 if key not in merged:
                     merged[key] = (None if value is None
                                    else (value, ets))
-        keys = sorted(merged)
         out: dict = {}
-        for key in keys:
+        for key in sorted(merged):
+            if hft != FT_NO_FILTER or sft != FT_NO_FILTER:
+                hk, sk = restore_key(key)
+                if not (host_match_filter(hk, hft, hfp)
+                        and host_match_filter(sk, sft, sfp)):
+                    continue  # fails the batch filter everywhere
             entry = merged[key]
             if entry is None:
                 out[key] = None  # tombstone: shadows the base
@@ -1307,9 +1358,9 @@ class PartitionServer:
                 out[key] = None
                 continue
             out[key] = (extract_user_data(self.data_version, value), ets)
-        return keys, out
+        return list(out), out  # insertion order is already sorted
 
-    def _eval_blocks_stacked(self, misses, now, none_f, validate):
+    def _eval_blocks_stacked(self, misses, now, filter_key, validate):
         """Evaluate MANY blocks' predicates in as few device dispatches
         as possible via the shared stacker (scan_coordinator): blocks
         sharing (width, cap) become one [B*cap, W] program — records are
@@ -1318,7 +1369,8 @@ class PartitionServer:
 
         blocks = [(ckey, dev, self.pidx) for ckey, dev in misses.items()]
         yield from stacked_block_eval(blocks, now, validate,
-                                      self.partition_version)
+                                      self.partition_version,
+                                      filter_key=filter_key)
 
     def _device_cached_block(self, cache_key, blk):
         """The shared device-upload cache used by both scan paths."""

@@ -41,13 +41,14 @@ def scan_multi(servers_and_reqs: List[Tuple[object, list]],
         if state is None or "precomputed" in state:
             continue
         misses = server.planned_misses(state)
-        flavor = (state["validate"], server.partition_version)
+        flavor = (state["validate"], server.partition_version,
+                  state["filter_key"])
         for ckey, dev in misses.items():
             flavor_groups.setdefault(flavor, []).append(
                 (server, state, ckey, dev))
 
-    for (validate, pv), entries in flavor_groups.items():
-        _eval_cross_partition(entries, now, validate, pv)
+    for (validate, pv, filter_key), entries in flavor_groups.items():
+        _eval_cross_partition(entries, now, validate, pv, filter_key)
 
     out = []
     for server, reqs, state in states:
@@ -61,7 +62,8 @@ def scan_multi(servers_and_reqs: List[Tuple[object, list]],
     return out
 
 
-def stacked_block_eval(blocks, now: int, validate: bool, pv: int):
+def stacked_block_eval(blocks, now: int, validate: bool, pv: int,
+                       filter_key=None):
     """The ONE stacking implementation both the per-partition and the
     cross-partition paths use. `blocks`: [(tag, dev_block, pidx)] —
     yields (tag, keep, expired).
@@ -72,7 +74,8 @@ def stacked_block_eval(blocks, now: int, validate: bool, pv: int):
     of a fresh result pays a full round-trip (~tens of ms measured), so
     starting all copies before the first wait overlaps compute and
     transfer across chunks instead of serializing round-trips."""
-    submitted = list(stacked_block_submit(blocks, now, validate, pv))
+    submitted = list(stacked_block_submit(blocks, now, validate, pv,
+                                          filter_key))
     for o in submitted:
         _start_host_copy(o[2])
         _start_host_copy(o[3])
@@ -87,7 +90,8 @@ def stacked_block_eval(blocks, now: int, validate: bool, pv: int):
                    exp_all[i * cap:(i + 1) * cap])
 
 
-def stacked_block_submit(blocks, now: int, validate: bool, pv: int):
+def stacked_block_submit(blocks, now: int, validate: bool, pv: int,
+                         filter_key=None):
     """Phase 1: dispatch predicate programs WITHOUT waiting. Yields
     (group, cap, keep_device_array, expired_device_array). Buckets by
     (key width, capacity) so differently-capped tail blocks can never
@@ -96,7 +100,9 @@ def stacked_block_submit(blocks, now: int, validate: bool, pv: int):
     stack sizes made every batch a fresh XLA compile. A stack mixing
     hash_lo and non-hash_lo blocks drops the precomputed column (the
     kernel computes the hash on device instead)."""
-    none_f = FilterSpec.none()
+    hft, hfp, sft, sfp = filter_key or (0, b"", 0, b"")
+    hash_f = FilterSpec.make(hft, hfp)
+    sort_f = FilterSpec.make(sft, sfp)
     buckets: "OrderedDict[tuple, list]" = OrderedDict()
     for tag, dev, pidx in blocks:
         key = (int(dev.keys.shape[1]), int(dev.keys.shape[0]))
@@ -104,7 +110,7 @@ def stacked_block_submit(blocks, now: int, validate: bool, pv: int):
     for (_w, cap), group in buckets.items():
         for off in range(0, len(group), STACK_CHUNK):
             yield _submit_chunk(group[off:off + STACK_CHUNK], cap,
-                                now, validate, pv, none_f)
+                                now, validate, pv, hash_f, sort_f)
 
 
 STACK_CHUNK = 16
@@ -121,7 +127,7 @@ def _start_host_copy(arr) -> None:
             pass
 
 
-def _submit_chunk(group, cap, now, validate, pv, none_f):
+def _submit_chunk(group, cap, now, validate, pv, hash_f, sort_f):
     import jax.numpy as jnp
 
     from pegasus_tpu.ops.record_block import RecordBlock
@@ -129,7 +135,7 @@ def _submit_chunk(group, cap, now, validate, pv, none_f):
     if len(group) == 1:
         tag, dev, pidx = group[0]
         m = scan_block_predicate(
-            dev, now, hash_filter=none_f, sort_filter=none_f,
+            dev, now, hash_filter=hash_f, sort_filter=sort_f,
             validate_hash=validate, pidx=pidx, partition_version=pv)
         return group, cap, m.keep, m.expired
     padded = group + [group[0]] * (STACK_CHUNK - len(group))
@@ -146,20 +152,20 @@ def _submit_chunk(group, cap, now, validate, pv, none_f):
         (jnp.concatenate([d.hash_lo for _t, d, _p in padded])
          if all_hash_lo else None))
     m = scan_block_predicate(
-        stacked, now, hash_filter=none_f, sort_filter=none_f,
+        stacked, now, hash_filter=hash_f, sort_filter=sort_f,
         validate_hash=validate, pidx=pidx_col,
         partition_version=pv)
     return group, cap, m.keep, m.expired
 
 
 def _eval_cross_partition(entries, now: int, validate: bool,
-                          pv: int) -> None:
+                          pv: int, filter_key=None) -> None:
     """Stack blocks from MANY partitions; each record carries its owning
     partition index so one program validates all."""
     blocks = [((server, state, ckey), dev, server.pidx)
               for server, state, ckey, dev in entries]
     for (server, state, ckey), keep, expired in stacked_block_eval(
-            blocks, now, validate, pv):
+            blocks, now, validate, pv, filter_key=filter_key):
         state["cached_keep"][ckey] = keep
         state["cached_expired"][ckey] = expired
         server.store_mask(state, ckey, keep, expired)
@@ -256,19 +262,19 @@ class MaskPrefresher:
         for target in (now, now + 1):
             flavors: Dict[tuple, list] = {}
             for srv in self.servers:
-                for ckey, blk, validate in srv.hot_block_entries(
+                for ckey, blk, validate, fkey in srv.hot_block_entries(
                         wall, self.horizon_s, target):
                     dev = srv._device_cached_block(ckey, blk)
                     flavors.setdefault(
-                        (validate, srv.partition_version), []).append(
-                        (srv, ckey, dev, validate))
-            for (validate, pv), entries in flavors.items():
-                blocks = [((srv, ckey, v), dev, srv.pidx)
-                          for srv, ckey, dev, v in entries]
-                for (srv, ckey, v), keep, expired in stacked_block_eval(
-                        blocks, target, validate, pv):
-                    srv.store_mask_for(ckey, target, v, keep, expired,
-                                       computed_pv=pv)
+                        (validate, srv.partition_version, fkey),
+                        []).append((srv, ckey, dev))
+            for (validate, pv, fkey), entries in flavors.items():
+                blocks = [((srv, ckey), dev, srv.pidx)
+                          for srv, ckey, dev in entries]
+                for (srv, ckey), keep, expired in stacked_block_eval(
+                        blocks, target, validate, pv, filter_key=fkey):
+                    srv.store_mask_for(ckey, target, validate, fkey,
+                                       keep, expired, computed_pv=pv)
                     warmed += 1
         self.refreshed += warmed
         return warmed

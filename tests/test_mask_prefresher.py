@@ -82,6 +82,73 @@ def test_prefreshed_masks_match_synchronous_eval(table):
             [(kv.key, kv.value) for kv in cold[0].kvs]
 
 
+def test_filtered_scans_ride_the_batched_path(table):
+    """A batch sharing one filter qualifies for the stacked/cached-mask
+    path (filter is part of the mask key) and returns exactly what
+    per-request serving returns."""
+    from pegasus_tpu.ops.predicates import FT_MATCH_PREFIX
+
+    t, _c = table
+    srv = t.all_partitions()[0]
+    now = epoch_now()
+    reqs = [GetScannerRequest(start_key=generate_key(b"pk", b""),
+                              batch_size=60,
+                              hash_key_filter_type=FT_MATCH_PREFIX,
+                              hash_key_filter_pattern=b"pk00",
+                              validate_partition_hash=True)
+            for _ in range(3)]
+    state = srv.plan_scan_batch(reqs, now=now)
+    assert state is not None and "precomputed" not in state
+    keep, exp = srv.eval_planned_masks(state)
+    batched = srv.finish_scan_batch(state, keep, exp)
+    solo = [srv.on_get_scanner(r) for r in reqs]
+    for b, s in zip(batched, solo):
+        assert [(kv.key, kv.value) for kv in b.kvs] == \
+            [(kv.key, kv.value) for kv in s.kvs]
+        assert len(b.kvs) > 0
+    # same filter again: all masks cached (no misses)
+    state2 = srv.plan_scan_batch(reqs, now=now)
+    assert srv.planned_misses(state2) == {}
+    # a DIFFERENT filter gets its own masks (no false sharing)
+    reqs2 = [GetScannerRequest(start_key=generate_key(b"pk", b""),
+                               batch_size=60,
+                               hash_key_filter_type=FT_MATCH_PREFIX,
+                               hash_key_filter_pattern=b"pk01",
+                               validate_partition_hash=True)]
+    state3 = srv.plan_scan_batch(reqs2, now=now)
+    assert srv.planned_misses(state3) != {}
+    # and the prefresher warms filtered masks too
+    pre = MaskPrefresher(t.all_partitions())
+    assert pre.refresh_once(now) > 0
+    state4 = srv.plan_scan_batch(reqs, now=now + 1)
+    assert srv.planned_misses(state4) == {}
+
+
+def test_filtered_batch_respects_overlay(table):
+    """Overlay rows (unflushed writes) obey the batch's shared filter:
+    matching rows surface, non-matching rows neither appear nor shadow."""
+    from pegasus_tpu.ops.predicates import FT_MATCH_PREFIX
+
+    t, c = table
+    # unflushed overlay writes: one matches the filter, one doesn't
+    assert c.set(b"pk0001", b"zz-new", b"overlay-hit") == 0
+    assert c.set(b"other", b"s", b"overlay-miss") == 0
+    srv = t.resolve(b"pk0001")
+    req = GetScannerRequest(start_key=b"", batch_size=500,
+                            hash_key_filter_type=FT_MATCH_PREFIX,
+                            hash_key_filter_pattern=b"pk",
+                            validate_partition_hash=True)
+    state = srv.plan_scan_batch([req])
+    assert state is not None and "precomputed" not in state
+    keep, exp = srv.eval_planned_masks(state)
+    resp = srv.finish_scan_batch(state, keep, exp)[0]
+    keys = {kv.key for kv in resp.kvs}
+    from pegasus_tpu.base.key_schema import generate_key as gk
+    from pegasus_tpu.base.key_schema import restore_key
+    assert gk(b"pk0001", b"zz-new") in keys
+    assert all(restore_key(k)[0].startswith(b"pk") for k in keys)
+
+
 def test_hot_blocks_age_out(table):
     t, _c = table
     now = epoch_now()
