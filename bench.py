@@ -29,7 +29,8 @@ PEGBENCH_PARTITIONS (default 64), PEGBENCH_SEED, PEGBENCH_COMPACT=1,
 PEGBENCH_GEO=1 (radius-search phase, BASELINE row 5),
 PEGBENCH_SCAN_BATCH (default 32: scans coalesced per device dispatch —
 the request-batching unit of SURVEY §2.6; 1 disables coalescing),
-PEGBENCH_PROBE_TIMEOUT (s, default 180), PEGBENCH_PROBE_RETRIES (default 4).
+PEGBENCH_PROBE_TIMEOUT (s, default 180), PEGBENCH_PROBE_RETRIES (default 4),
+PEGBENCH_FORCE_CPU=1 (CPU-only dry run: never dials the TPU tunnel).
 """
 
 import json
@@ -44,7 +45,22 @@ def _log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
-_PROBE_SRC = r"""
+_ISOLATE_SRC = r"""
+import os
+if os.environ.get("PEGBENCH_FORCE_CPU") == "1":
+    # CPU-only run (CI / wedged-tunnel dry runs): never dial the axon
+    # TPU tunnel — its plugin dials the pool even under
+    # JAX_PLATFORMS=cpu (see tests/conftest.py)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    import jax._src.xla_bridge as _xb
+    jax.config.update("jax_platforms", "cpu")
+    _xb._backend_factories.pop("axon", None)
+"""
+
+exec(_ISOLATE_SRC)
+
+_PROBE_SRC = _ISOLATE_SRC + r"""
 import sys
 import jax
 devs = jax.devices()

@@ -168,7 +168,15 @@ def compile_rules(spec) -> Callable:
     def rules_filter(keys: Sequence[bytes], expire_ts, now: int
                      ) -> Tuple[np.ndarray, np.ndarray]:
         n = len(keys)
-        block = build_record_block(list(keys), list(np.asarray(expire_ts)))
+        # power-of-two capacity bucket: every distinct batch size would
+        # otherwise compile its own XLA program — 64 partitions with 64
+        # different record counts meant 64 compiles (observed 35x slower
+        # than the TTL-only path on identical data)
+        cap = 1024
+        while cap < n:
+            cap <<= 1
+        block = build_record_block(list(keys), list(np.asarray(expire_ts)),
+                                   capacity=cap)
         drop, ets = _eval(jnp.asarray(block.keys), jnp.asarray(block.key_len),
                           jnp.asarray(block.hashkey_len),
                           jnp.asarray(block.expire_ts),

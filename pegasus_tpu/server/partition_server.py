@@ -47,23 +47,6 @@ from pegasus_tpu.ops.predicates import (
     scan_block_predicate,
 )
 
-# the no-filter flavor's mask key component (and the normal form of any
-# empty-pattern filter, which matches everything)
-_NO_FILTER_KEY = (FT_NO_FILTER, b"", FT_NO_FILTER, b"")
-
-
-def _normalize_filter_key(r) -> tuple:
-    """(hash type, hash pattern, sort type, sort pattern), with
-    empty-pattern components collapsed to FT_NO_FILTER — both the host
-    and device matchers treat an empty pattern as match-all, so distinct
-    keys for them would only split batches and duplicate masks."""
-    hft, hfp = r.hash_key_filter_type, r.hash_key_filter_pattern
-    sft, sfp = r.sort_key_filter_type, r.sort_key_filter_pattern
-    if not hfp:
-        hft, hfp = FT_NO_FILTER, b""
-    if not sfp:
-        sft, sfp = FT_NO_FILTER, b""
-    return (hft, hfp, sft, sfp)
 from pegasus_tpu.ops.record_block import build_record_block
 from pegasus_tpu.server.capacity_units import CapacityUnitCalculator
 from pegasus_tpu.server.read_limiter import RangeReadLimiter
@@ -89,9 +72,28 @@ from pegasus_tpu.server.types import (
     ScanResponse,
 )
 from pegasus_tpu.server.write_service import WriteService
+
 from pegasus_tpu.storage.engine import StorageEngine
 from pegasus_tpu.utils.errors import ErrorCode, StorageStatus
 from pegasus_tpu.utils.metrics import METRICS
+
+# the no-filter flavor's mask key component (and the normal form of any
+# empty-pattern filter, which matches everything)
+_NO_FILTER_KEY = (FT_NO_FILTER, b"", FT_NO_FILTER, b"")
+
+
+def _normalize_filter_key(r) -> tuple:
+    """(hash type, hash pattern, sort type, sort pattern), with
+    empty-pattern components collapsed to FT_NO_FILTER and patterns
+    under FT_NO_FILTER dropped — the matchers treat both as match-all,
+    so distinct keys would only split batches and duplicate masks."""
+    hft, hfp = r.hash_key_filter_type, r.hash_key_filter_pattern
+    sft, sfp = r.sort_key_filter_type, r.sort_key_filter_pattern
+    if hft == FT_NO_FILTER or not hfp:
+        hft, hfp = FT_NO_FILTER, b""
+    if sft == FT_NO_FILTER or not sfp:
+        sft, sfp = FT_NO_FILTER, b""
+    return (hft, hfp, sft, sfp)
 
 # candidate records gathered per device predicate dispatch
 PREDICATE_BATCH = 2048
@@ -174,6 +176,14 @@ class PartitionServer:
         # the prefresher warms these ahead of each TTL-second
         self._hot_blocks: "OrderedDict[tuple, tuple]" = OrderedDict()
         self._hot_blocks_cap = 2048
+        # filter flavors seen recently: filter_key -> last wall_ts. A
+        # filtered flavor joins the hot set on its SECOND occurrence
+        # within the window — recurrence must be judged across
+        # TTL-seconds (mask-cache hits can't prove it: the key includes
+        # `now`, so a once-per-second filter never hits the cache)
+        self._filter_seen: "OrderedDict[tuple, float]" = OrderedDict()
+        self._filter_seen_cap = 256
+        self._filter_seen_window = 30.0
         # per-table dynamic app-envs (parity: src/common/replica_envs.h:39-83
         # propagated through config-sync; here set via update_app_envs)
         self.app_envs: dict = {}
@@ -1087,16 +1097,25 @@ class PartitionServer:
         filter_key = state["filter_key"]
         wall = time.monotonic()
         with self._mask_lock:
+            # hot registration drives prefresher work: the no-filter
+            # flavor always registers; a FILTERED flavor registers once
+            # it RECURS within the window — one-shot filter patterns
+            # must not multiply background device work or evict the
+            # long-lived hot set
+            register_hot = filter_key == _NO_FILTER_KEY
+            if not register_hot:
+                last = self._filter_seen.get(filter_key)
+                register_hot = (last is not None
+                                and wall - last <= self._filter_seen_window)
+                self._filter_seen[filter_key] = wall
+                self._filter_seen.move_to_end(filter_key)
+                while len(self._filter_seen) > self._filter_seen_cap:
+                    self._filter_seen.popitem(last=False)
             for ckey, (run, bm, blk) in state["unique"].items():
                 mkey = (ckey, now, self.partition_version, validate,
                         filter_key)
                 cached = self._mask_cache.get(mkey)
-                # hot registration drives prefresher work: the no-filter
-                # flavor always registers; a FILTERED flavor registers
-                # only once it repeats (a cache hit proves recurrence) —
-                # one-shot filter patterns must not multiply background
-                # device work or evict the long-lived hot set
-                if filter_key == _NO_FILTER_KEY or cached is not None:
+                if register_hot:
                     hkey = (ckey, validate, filter_key)
                     self._hot_blocks[hkey] = (blk, wall)
                     self._hot_blocks.move_to_end(hkey)

@@ -17,7 +17,11 @@ from typing import Dict, List, Tuple
 
 import numpy as np
 
-from pegasus_tpu.ops.predicates import FilterSpec, scan_block_predicate
+from pegasus_tpu.ops.predicates import (
+    FT_NO_FILTER,
+    FilterSpec,
+    scan_block_predicate,
+)
 
 
 def scan_multi(servers_and_reqs: List[Tuple[object, list]],
@@ -100,7 +104,8 @@ def stacked_block_submit(blocks, now: int, validate: bool, pv: int,
     stack sizes made every batch a fresh XLA compile. A stack mixing
     hash_lo and non-hash_lo blocks drops the precomputed column (the
     kernel computes the hash on device instead)."""
-    hft, hfp, sft, sfp = filter_key or (0, b"", 0, b"")
+    hft, hfp, sft, sfp = filter_key or (FT_NO_FILTER, b"",
+                                        FT_NO_FILTER, b"")
     hash_f = FilterSpec.make(hft, hfp)
     sort_f = FilterSpec.make(sft, sfp)
     buckets: "OrderedDict[tuple, list]" = OrderedDict()

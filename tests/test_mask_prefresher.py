@@ -129,10 +129,16 @@ def test_filtered_batch_respects_overlay(table):
     matching rows surface, non-matching rows neither appear nor shadow."""
     from pegasus_tpu.ops.predicates import FT_MATCH_PREFIX
 
+    from pegasus_tpu.base.key_schema import partition_index
+
     t, c = table
-    # unflushed overlay writes: one matches the filter, one doesn't
+    # unflushed overlay writes on the SAME partition: one matches the
+    # filter, one doesn't — the miss must exercise the exclusion branch
+    target = partition_index(b"pk0001", 4)
+    miss_hk = next(b"other%02d" % i for i in range(100)
+                   if partition_index(b"other%02d" % i, 4) == target)
     assert c.set(b"pk0001", b"zz-new", b"overlay-hit") == 0
-    assert c.set(b"other", b"s", b"overlay-miss") == 0
+    assert c.set(miss_hk, b"s", b"overlay-miss") == 0
     srv = t.resolve(b"pk0001")
     req = GetScannerRequest(start_key=b"", batch_size=500,
                             hash_key_filter_type=FT_MATCH_PREFIX,
