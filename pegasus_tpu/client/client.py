@@ -76,15 +76,21 @@ def paginate_sortkeys(fetch) -> "Tuple[int, List[bytes]]":
 
 
 def make_hashkey_scan_request(hash_key: bytes, batch_size: int = 1000,
-                              validate_partition_hash: bool = True):
+                              validate_partition_hash: bool = True,
+                              start_sortkey: bytes = b"",
+                              stop_sortkey: bytes = b""):
     """The one place the hashkey-range scan request shape lives (both
-    clients' get_scanner and the geo batched path build from here)."""
+    clients' get_scanner and the geo batched path build from here).
+    Optional sortkey bounds narrow to [start_sortkey, stop_sortkey)
+    within the hashkey (empty stop = to the hashkey's end)."""
     from pegasus_tpu.base.key_schema import generate_next_bytes
     from pegasus_tpu.server.types import GetScannerRequest
 
+    stop_key = (generate_key(hash_key, stop_sortkey) if stop_sortkey
+                else generate_next_bytes(hash_key))
     return GetScannerRequest(
-        start_key=generate_key(hash_key, b""),
-        stop_key=generate_next_bytes(hash_key),
+        start_key=generate_key(hash_key, start_sortkey),
+        stop_key=stop_key,
         stop_inclusive=False, batch_size=batch_size,
         validate_partition_hash=validate_partition_hash)
 

@@ -117,11 +117,37 @@ class GeoClient:
 
     # ---- radius search (parity: async_search_radial :295-335) ----------
 
+    def _cover_level(self, radius_m: float) -> int:
+        """Covering level whose cell edge is comparable to the radius
+        (parity: S2RegionCoverer's adaptive cells between min and max
+        level, geo_client.h:374). Covering a small circle with
+        index_level cells scans the whole coarse cell — orders of
+        magnitude more candidates than the circle needs; the index
+        sortkey carries the cell digits down to max_level, so finer
+        covering cells narrow each scan to a SORTKEY RANGE."""
+        import math
+
+        # cell edge at level L is ~(180 deg * 111km/deg) / 2^L
+        edge0_m = 180.0 * 111_000.0
+        level = int(math.log2(edge0_m / max(radius_m, 1.0)))
+        return max(self.index_level, min(self.max_level, level))
+
     def search_radial(self, lat: float, lng: float, radius_m: float,
                       count: int = -1,
                       sort_by_distance: bool = True
                       ) -> List[GeoSearchResult]:
-        cells = covering_cells(lat, lng, radius_m, self.index_level)
+        # near the poles the longitude span scales by 1/cos(lat), so the
+        # radius-based level can overflow the covering budget — coarsen
+        # until it fits (index_level always fits or raises legitimately)
+        level = self._cover_level(radius_m)
+        while True:
+            try:
+                cells = covering_cells(lat, lng, radius_m, level)
+                break
+            except ValueError:
+                if level <= self.index_level:
+                    raise
+                level -= 1
         cand_keys: List[Tuple[bytes, bytes, bytes]] = []
         cand_lat: List[float] = []
         cand_lng: List[float] = []
@@ -146,16 +172,32 @@ class GeoClient:
             out = out[:count]
         return out
 
+    @staticmethod
+    def _sub_stop(sub: bytes) -> bytes:
+        """Exclusive sortkey stop bound for a cell-digit prefix (digits
+        are '0'-'3', so bumping the last byte covers every deeper cell
+        and the SORT_SEP continuation)."""
+        return sub[:-1] + bytes([sub[-1] + 1]) if sub else b""
+
     def _scan_cells(self, cells):
-        """All covering cells' index rows. When the index client batches
-        (scan_multi), every cell's FIRST page rides one coalesced
-        request wave — one stacked device evaluation per node — with
-        per-cell paging only for overflowing cells; otherwise one
-        scanner per cell (the reference's parallel fan-out shape)."""
+        """All covering cells' index rows. A covering cell FINER than
+        index_level becomes a sortkey-range scan inside its coarse
+        hashkey cell (the cell digits continue into the sortkey). When
+        the index client batches (scan_multi), every cell's FIRST page
+        rides one coalesced request wave — one stacked device evaluation
+        per node — with per-cell paging only for overflowing cells;
+        otherwise one scanner per cell (the reference's parallel
+        fan-out shape)."""
+        specs = []  # (hashkey cell, sortkey sub-cell prefix)
+        for cell in cells:
+            specs.append((cell[:self.index_level].encode(),
+                          cell[self.index_level:].encode()))
         scan_multi = getattr(self.index, "scan_multi", None)
         if scan_multi is None:
-            for cell in cells:
-                for row in self.index.get_scanner(cell.encode()):
+            for hk, sub in specs:
+                for row in self.index.get_scanner(
+                        hk, start_sortkey=sub,
+                        stop_sortkey=self._sub_stop(sub)):
                     yield row
             return
         from pegasus_tpu.base.key_schema import key_hash_parts, restore_key
@@ -166,9 +208,10 @@ class GeoClient:
             self.index.refresh_config()
             pcount = self.index.partition_count
         groups: dict = {}
-        for cell in cells:
-            hk = cell.encode()
-            req = make_hashkey_scan_request(hk, batch_size=1000)
+        for hk, sub in specs:
+            req = make_hashkey_scan_request(
+                hk, batch_size=1000, start_sortkey=sub,
+                stop_sortkey=self._sub_stop(sub))
             groups.setdefault(key_hash_parts(hk) % pcount,
                               []).append((hk, req))
         results = scan_multi({p: [r for _hk, r in reqs]

@@ -1,0 +1,108 @@
+#!/usr/bin/env python
+# Diagnostic sidecar (not part of the framework): reproduces the tunnel
+# transfer measurements that motivated the MaskPrefresher design.
+"""Profile the TPU scan path: where do the ~400ms/flush go?
+
+Instruments scan_block_predicate + pallas path with counters/timers and
+measures raw tunnel dispatch latency. Not part of the framework; a
+diagnostic sidecar for bench tuning.
+"""
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+devs = jax.devices()
+accel = [d for d in devs if d.platform != "cpu"]
+dev = accel[0] if accel else devs[0]
+print(f"device: {dev}", flush=True)
+
+# --- raw dispatch latency through the tunnel ---
+with jax.default_device(dev):
+    f = jax.jit(lambda x: x * 2 + 1)
+    x = jnp.arange(1024)
+    f(x).block_until_ready()  # compile
+    t0 = time.perf_counter()
+    N = 30
+    for _ in range(N):
+        f(x).block_until_ready()
+    per = (time.perf_counter() - t0) / N * 1000
+    print(f"raw jit dispatch round-trip: {per:.2f} ms", flush=True)
+
+    # transfer latency: 1MB up
+    big = np.zeros((1 << 20,), dtype=np.uint8)
+    t0 = time.perf_counter()
+    for _ in range(10):
+        jax.device_put(big, dev).block_until_ready()
+    print(f"1MB device_put: {(time.perf_counter()-t0)/10*1000:.2f} ms",
+          flush=True)
+    # download of a small mask
+    m = jnp.zeros((2048,), dtype=bool)
+    t0 = time.perf_counter()
+    for _ in range(30):
+        np.asarray(m)
+    print(f"2048-bool download: {(time.perf_counter()-t0)/30*1000:.2f} ms",
+          flush=True)
+
+# --- instrument the scan path ---
+import pegasus_tpu.ops.predicates as preds
+
+orig = preds.scan_block_predicate
+stats = {"calls": 0, "time": 0.0, "shapes": {}}
+
+
+def wrapped(dev_block, now, **kw):
+    t0 = time.perf_counter()
+    m = orig(dev_block, now, **kw)
+    # force completion for honest timing
+    np.asarray(m.keep)
+    dt = time.perf_counter() - t0
+    stats["calls"] += 1
+    stats["time"] += dt
+    shape = tuple(dev_block.keys.shape)
+    s = stats["shapes"].setdefault(shape, [0, 0.0])
+    s[0] += 1
+    s[1] += dt
+    return m
+
+
+preds.scan_block_predicate = wrapped
+import pegasus_tpu.server.scan_coordinator as sc
+sc.scan_block_predicate = wrapped
+import pegasus_tpu.server.partition_server as psrv
+if hasattr(psrv, "scan_block_predicate"):
+    psrv.scan_block_predicate = wrapped
+
+sys.argv = ["bench"]
+os.environ.setdefault("PEGBENCH_RECORDS", "20000")
+import bench
+
+with tempfile.TemporaryDirectory() as td:
+    with jax.default_device(dev):
+        bc = bench.build_cluster(td, 20000, 64, 7)
+        n_hashkeys = max(1, 20000 // 10)
+        bc.manual_compact_all()
+        bench.run_scans(bc, 60, 64, n_hashkeys, 7, insert_frac=0)
+        bench.run_scans(bc, 30, 64, n_hashkeys, 8)
+        bc.manual_compact_all()
+        bench.run_scans(bc, 300, 64, n_hashkeys, 7, insert_frac=0)
+        stats["calls"] = 0
+        stats["time"] = 0.0
+        stats["shapes"].clear()
+        t0 = time.perf_counter()
+        ops, recs, secs = bench.run_scans(bc, 300, 64, n_hashkeys, 7)
+        print(f"\nmeasured: {ops} ops, {recs} recs in {secs:.2f}s "
+              f"-> {ops/secs:.1f} ops/s", flush=True)
+        print(f"device predicate calls: {stats['calls']}, "
+              f"total {stats['time']*1000:.0f} ms "
+              f"({stats['time']/secs*100:.0f}% of wall)", flush=True)
+        for shape, (n, t) in sorted(stats["shapes"].items()):
+            print(f"  shape {shape}: {n} calls, {t/n*1000:.1f} ms avg",
+                  flush=True)
+        bc.close()

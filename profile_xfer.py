@@ -1,0 +1,49 @@
+#!/usr/bin/env python
+# Diagnostic sidecar (not part of the framework): reproduces the tunnel
+# transfer measurements that motivated the MaskPrefresher design.
+"""Characterize device->host transfer cost through the axon tunnel."""
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+dev = [d for d in jax.devices() if d.platform != "cpu"][0]
+print(f"device: {dev}", flush=True)
+
+
+def bench(label, fn, n=20):
+    fn()  # warm
+    t0 = time.perf_counter()
+    for _ in range(n):
+        fn()
+    print(f"{label}: {(time.perf_counter()-t0)/n*1000:.2f} ms", flush=True)
+
+
+with jax.default_device(dev):
+    for dtype in ("bool", "uint8", "int32"):
+        for size in (2048, 16384, 1 << 20):
+            x = jnp.zeros((size,), dtype=dtype)
+            x.block_until_ready()
+            bench(f"asarray {dtype}[{size}]", lambda x=x: np.asarray(x))
+    x = jnp.zeros((16384,), dtype="bool")
+    y = jnp.zeros((16384,), dtype="bool")
+    bench("two separate bool[16384]",
+          lambda: (np.asarray(x), np.asarray(y)))
+    xy = jnp.stack([x, y])
+    bench("one stacked bool[2,16384]", lambda: np.asarray(xy))
+    # device_get vs asarray
+    bench("device_get bool[16384]", lambda: jax.device_get(x))
+    # packed: 16384 bools -> 2048 uint8 on device, then download
+    pack = jax.jit(lambda m: jnp.packbits(m))
+    p = pack(x)
+    p.block_until_ready()
+    bench("packbits+download uint8[2048]",
+          lambda: np.asarray(pack(x)))
+    # jit returning bool vs uint8
+    f_bool = jax.jit(lambda a: a > 0)
+    f_u8 = jax.jit(lambda a: (a > 0).astype(jnp.uint8))
+    a = jnp.zeros((16384,), dtype=jnp.int32)
+    f_bool(a).block_until_ready(); f_u8(a).block_until_ready()
+    bench("jit->bool[16384] download", lambda: np.asarray(f_bool(a)))
+    bench("jit->uint8[16384] download", lambda: np.asarray(f_u8(a)))

@@ -1,0 +1,68 @@
+#!/usr/bin/env python
+# Diagnostic sidecar (not part of the framework): reproduces the tunnel
+# transfer measurements that motivated the MaskPrefresher design.
+"""Is the ~85ms fetch per-array or per-sync-round? Test batched fetch
+strategies for N fresh computation results."""
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+dev = [d for d in jax.devices() if d.platform != "cpu"][0]
+f = jax.jit(lambda x, s: (x + s > 0).astype(jnp.uint8))
+
+with jax.default_device(dev):
+    xs = [jnp.zeros((16384,), dtype=jnp.int32) + i for i in range(8)]
+    for x in xs:
+        x.block_until_ready()
+
+    def fresh(i):
+        return [f(x, i) for x in xs]  # 8 fresh results
+
+    # warm compile
+    jax.block_until_ready(fresh(0))
+
+    t0 = time.perf_counter()
+    outs = fresh(1)
+    res = [np.asarray(o) for o in outs]
+    print(f"8x asarray loop: {(time.perf_counter()-t0)*1000:.1f} ms",
+          flush=True)
+
+    t0 = time.perf_counter()
+    outs = fresh(2)
+    res = jax.device_get(outs)
+    print(f"device_get(list of 8): {(time.perf_counter()-t0)*1000:.1f} ms",
+          flush=True)
+
+    cat = jax.jit(lambda *a: jnp.concatenate(a))
+    jax.block_until_ready(cat(*fresh(3)))
+    t0 = time.perf_counter()
+    outs = fresh(4)
+    res = np.asarray(cat(*outs))
+    print(f"device concat + 1 asarray: "
+          f"{(time.perf_counter()-t0)*1000:.1f} ms", flush=True)
+
+    # copy_to_host_async then gather
+    t0 = time.perf_counter()
+    outs = fresh(5)
+    for o in outs:
+        o.copy_to_host_async()
+    res = [np.asarray(o) for o in outs]
+    print(f"copy_to_host_async + gather: "
+          f"{(time.perf_counter()-t0)*1000:.1f} ms", flush=True)
+
+    # 64 arrays, async-copy strategy
+    f2 = jax.jit(lambda x, s: (x + s > 0).astype(jnp.uint8))
+    xs64 = [jnp.zeros((16384,), dtype=jnp.int32) + i for i in range(64)]
+    for x in xs64:
+        x.block_until_ready()
+    outs = [f2(x, 0) for x in xs64]
+    jax.block_until_ready(outs)
+    t0 = time.perf_counter()
+    outs = [f2(x, 1) for x in xs64]
+    for o in outs:
+        o.copy_to_host_async()
+    res = [np.asarray(o) for o in outs]
+    print(f"64 arrays async-copy+gather: "
+          f"{(time.perf_counter()-t0)*1000:.1f} ms", flush=True)
