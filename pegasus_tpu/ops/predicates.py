@@ -52,11 +52,14 @@ _PATTERN_MIN_WIDTH = 32
 
 class FilterSpec(NamedTuple):
     """A filter pattern padded for device dispatch. `filter_type` stays a
-    Python int (static); pattern bytes + length are device operands."""
+    Python int (static); pattern bytes + length are device operands.
+    `raw` keeps the original pattern bytes host-side so cache keys never
+    need a device->host fetch of `pattern`."""
 
     filter_type: int
     pattern: jax.Array      # uint8[P] padded
     pattern_len: jax.Array  # int32 scalar
+    raw: bytes = b""
 
     @staticmethod
     def make(filter_type: int, pattern: bytes = b"") -> "FilterSpec":
@@ -67,6 +70,11 @@ class FilterSpec(NamedTuple):
     def none() -> "FilterSpec":
         return _make_cached(FT_NO_FILTER, b"",
                             jax.config.jax_default_device)
+
+    @property
+    def key(self) -> tuple:
+        """Hashable host-side identity (for mask cache keys)."""
+        return (self.filter_type, self.raw)
 
 
 @functools.lru_cache(maxsize=256)
@@ -82,7 +90,7 @@ def _make_cached(filter_type: int, pattern: bytes, _device) -> FilterSpec:
     if pattern:
         buf[:len(pattern)] = np.frombuffer(pattern, dtype=np.uint8)
     return FilterSpec(filter_type, jnp.asarray(buf),
-                      jnp.asarray(len(pattern), jnp.int32))
+                      jnp.asarray(len(pattern), jnp.int32), pattern)
 
 
 def match_filter(keys: jax.Array, region_start: jax.Array,
@@ -179,6 +187,84 @@ def _scan_block_predicate(keys, key_len, hashkey_len, expire_ts, valid,
 
     keep = valid & ~expired & ~hash_invalid & ~filtered
     return ScanMasks(keep, expired, hash_invalid, filtered)
+
+
+@functools.partial(jax.jit, static_argnames=("hash_filter_type",
+                                             "sort_filter_type",
+                                             "validate_hash",
+                                             "use_hash_lo"))
+def _static_block_predicate(keys, key_len, hashkey_len, valid,
+                            hash_pattern, hash_pattern_len,
+                            sort_pattern, sort_pattern_len,
+                            pidx, partition_version,
+                            hash_filter_type: int, sort_filter_type: int,
+                            validate_hash: bool, hash_lo=None,
+                            use_hash_lo: bool = False) -> jax.Array:
+    """The `now`-independent part of the scan predicate.
+
+    For an IMMUTABLE columnar block, filter matching and partition-hash
+    validation never change; only TTL expiry depends on the current
+    second — and `expire_ts` is already host-resident, so the host can
+    apply expiry with one vectorized AND at assembly time. Splitting the
+    predicate this way means each (block, filter, partition_version)
+    needs exactly ONE device evaluation for the block's whole lifetime:
+    steady-state serving performs zero device round-trips (the decisive
+    property on a high-latency accelerator link).
+    """
+    if validate_hash:
+        if use_hash_lo:
+            lo = hash_lo  # precomputed at SST write time
+        else:
+            _, lo = key_hash_device(keys, key_len, hashkey_len)
+        pv = jnp.asarray(partition_version, jnp.uint32)
+        hash_ok = (lo & pv) == jnp.asarray(pidx, jnp.uint32)
+    else:
+        hash_ok = jnp.ones_like(valid)
+    hk_ok = match_filter(keys, jnp.full_like(key_len, 2), hashkey_len,
+                         hash_pattern, hash_pattern_len, hash_filter_type)
+    sort_start = 2 + hashkey_len
+    sort_len = key_len - sort_start
+    sk_ok = match_filter(keys, sort_start, sort_len,
+                         sort_pattern, sort_pattern_len, sort_filter_type)
+    return valid & hash_ok & hk_ok & sk_ok
+
+
+def static_block_predicate(block: RecordBlock,
+                           hash_filter: Optional[FilterSpec] = None,
+                           sort_filter: Optional[FilterSpec] = None,
+                           validate_hash: bool = False,
+                           pidx=0,
+                           partition_version: int = -1) -> jax.Array:
+    """bool[B]: records passing every `now`-independent predicate.
+
+    keep(now) == static_keep & ~expired(now), applied host-side from the
+    block's expire_ts column. Same reject-all split-safety gate as
+    scan_block_predicate (pegasus_server_impl.cpp:2392-2401)."""
+    hash_filter = hash_filter or FilterSpec.none()
+    sort_filter = sort_filter or FilterSpec.none()
+    pidx_is_array = not isinstance(pidx, int)
+    if (validate_hash and not pidx_is_array
+            and (partition_version < 0 or pidx > partition_version)):
+        return jnp.zeros((block.capacity,), dtype=bool)
+    use_hash_lo = validate_hash and block.hash_lo is not None
+    return _static_block_predicate(
+        jnp.asarray(block.keys), jnp.asarray(block.key_len),
+        jnp.asarray(block.hashkey_len), jnp.asarray(block.valid),
+        hash_filter.pattern, hash_filter.pattern_len,
+        sort_filter.pattern, sort_filter.pattern_len,
+        jnp.asarray(pidx, jnp.uint32)
+        if not pidx_is_array else jnp.asarray(pidx),
+        jnp.asarray(partition_version & 0xFFFFFFFF, jnp.uint32),
+        hash_filter.filter_type, sort_filter.filter_type, validate_hash,
+        hash_lo=(jnp.asarray(block.hash_lo) if use_hash_lo
+                 else jnp.zeros((1,), jnp.uint32)),
+        use_hash_lo=use_hash_lo)
+
+
+def host_alive_mask(expire_ts: np.ndarray, now: int) -> np.ndarray:
+    """bool[B] numpy twin of ~ttl_expired: rows NOT expired at `now`."""
+    ets = np.asarray(expire_ts)
+    return ~((ets > 0) & (ets <= np.uint32(now)))
 
 
 def scan_block_predicate(block: RecordBlock, now,

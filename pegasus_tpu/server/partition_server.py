@@ -165,33 +165,30 @@ class PartitionServer:
         # materialized keep-mask cache keyed by (block, now, pv): the
         # predicate is a deterministic function of immutable block content
         # + the CURRENT SECOND (epoch_now granularity) + the partition
-        # version, so within a second a hot block's mask is reusable
-        # across every unfiltered scan — the device evaluates each block
-        # once per second, proportional to data instead of requests
+        # static masks: (ckey, pv, validate, filter_key) -> bool[cap].
+        # `now`-independent (TTL applies host-side at assembly), so a
+        # block's mask lives as long as the block — the device evaluates
+        # each block ONCE, proportional to data instead of requests or
+        # elapsed seconds
         self._mask_cache: "OrderedDict[tuple, np.ndarray]" = OrderedDict()
         self._mask_cache_cap = 4096
         # mask/device caches are shared with the MaskPrefresher thread
         self._mask_lock = threading.Lock()
-        # recently-scanned blocks: ckey -> (block, validate, wall_ts);
-        # the prefresher warms these ahead of each TTL-second
-        self._hot_blocks: "OrderedDict[tuple, tuple]" = OrderedDict()
-        self._hot_blocks_cap = 2048
+        # scan flavors (validate, filter_key) seen recently: after a
+        # flush/compaction replaces the SSTs, the prefresher re-evaluates
+        # the NEW blocks for these flavors in the background
+        self._warm_flavors: "OrderedDict[tuple, float]" = OrderedDict()
+        self._warm_flavors_cap = 64
         # filter flavors seen recently: filter_key -> last wall_ts. A
-        # filtered flavor joins the hot set on its SECOND occurrence
-        # within the window — recurrence must be judged across
-        # TTL-seconds (mask-cache hits can't prove it: the key includes
-        # `now`, so a once-per-second filter never hits the cache)
+        # filtered flavor joins the warm set on its SECOND occurrence
+        # within the window — one-shot filter patterns must not multiply
+        # background device work
         self._filter_seen: "OrderedDict[tuple, float]" = OrderedDict()
         self._filter_seen_cap = 256
         self._filter_seen_window = 30.0
         # per-table dynamic app-envs (parity: src/common/replica_envs.h:39-83
         # propagated through config-sync; here set via update_app_envs)
         self.app_envs: dict = {}
-        # fused Pallas scan kernel (ops/pallas_scan.py): opt-in until
-        # validated on real hardware; covers scans without a hashkey filter
-        import os as _os
-        self._use_fused_kernel = _os.environ.get("PEGASUS_TPU_FUSED") == "1"
-        self._prepared_cache: "OrderedDict[tuple, tuple]" = OrderedDict()
         self._deny_client = ""          # "", "all", "write", "read"
         self._write_throttle = None     # TokenBucket (reject mode)
         self._read_throttle = None
@@ -638,26 +635,27 @@ class PartitionServer:
         with_values: bool,
     ) -> Tuple[List[Tuple[bytes, bytes, int]], bool, Optional[bytes]]:
         """Fast path: the store is a sequence of non-overlapping sorted L1
-        runs with no overlay, so SST blocks stream columnar to the device
-        with ZERO per-record host work before the predicate — the
-        TPU-first replacement for the reference's per-record iterator
-        loop. Only returned survivors are materialized per record
-        (response assembly). Runs are visited in key order, skipping runs
-        outside the range.
-
-        Boundary trimming (records outside [start_key, stop_key)) happens
-        in the same device program via numpy prefix masks computed per
-        block (at most 2 partial blocks per scan).
+        runs with no overlay, so SST blocks stream columnar through the
+        CACHED static device predicate — the TPU-first replacement for
+        the reference's per-record iterator loop. The static mask
+        (filters + partition-hash, `now`-independent) is evaluated on
+        device once per block lifetime; this scan combines it with TTL
+        expiry host-side (one vectorized AND over the expire_ts column)
+        and materializes only survivors per record. Runs are visited in
+        key order, skipping runs outside the range; boundary trimming
+        ([start_key, stop_key)) is a host slice of the mask (at most 2
+        partial blocks per scan).
         """
-        import jax.numpy as jnp
-
-        from pegasus_tpu.ops.record_block import RecordBlock, block_from_columns
-        from pegasus_tpu.storage.sstable import BLOCK_CAPACITY
+        from pegasus_tpu.ops.predicates import host_alive_mask
 
         out: List[Tuple[bytes, bytes, int]] = []
         out_bytes = 0
         exhausted = True
         resume_key: Optional[bytes] = None
+        filter_key = hash_filter.key + sort_filter.key
+        with self._mask_lock:
+            self._register_flavor(validate_hash, filter_key,
+                                  time.monotonic())
 
         def ranged_blocks():
             for run in sorted_runs:
@@ -668,104 +666,69 @@ class PartitionServer:
                 for bm_blk in run.iter_blocks(start_key, stop_key or None):
                     yield run, bm_blk
 
-        # one-deep pipeline: while the device evaluates block N's
-        # predicate, the host gathers/uploads block N+1 (jax dispatch is
-        # asynchronous; np.asarray in _drain is the sync point). Stopping
-        # one block late costs a dispatched-but-unused mask, never
-        # correctness — resume_key always comes from the drained block.
-        pending = None
+        # look-ahead windows: gather up to LOOKAHEAD blocks, evaluate
+        # every window miss in ONE stacked device wave (a cold cache
+        # after compaction would otherwise pay one serialized round-trip
+        # PER block), then assemble host-side. Fetching one window past
+        # the stop point costs unused masks, never correctness.
+        LOOKAHEAD = 8
+        blocks_iter = ranged_blocks()
+        done_iter = False
         stopped = False
-
-        def _drain(entry) -> bool:
-            """Materialize one block's result; True = stop the scan."""
-            nonlocal out_bytes, exhausted, resume_key
-            blk, n, keep_x, expired_x = entry
-            keep = np.asarray(keep_x)
-            expired = int(np.asarray(expired_x).sum())
-            if expired:
-                self._abnormal_reads.increment(expired)
-            stop_early = False
-            for i in np.flatnonzero(keep):
-                key = blk.key_at(i)
-                data = (extract_user_data(self.data_version,
-                                          blk.value_at(i))
-                        if with_values else b"")
-                out.append((key, data, int(blk.expire_ts[i])))
-                out_bytes += len(key) + len(data)
-                if ((max_records > 0 and len(out) >= max_records)
-                        or (max_bytes > 0 and out_bytes >= max_bytes)):
-                    resume_key = _after(key)
-                    stop_early = True
+        while not stopped:
+            window = []
+            while not done_iter and len(window) < LOOKAHEAD:
+                nxt = next(blocks_iter, None)
+                if nxt is None:
+                    done_iter = True
                     break
-            if stop_early or not limiter.valid():
-                if not stop_early:
-                    resume_key = _after(blk.key_at(blk.count - 1))
-                exhausted = False
-                return True
-            return False
-
-        for run, (bm, blk) in ranged_blocks():
-            n = blk.count
-            valid = None
-            # boundary blocks: mask rows outside the range (bisect on the
-            # block's sorted keys — O(log n) key materializations)
-            lo, hi = 0, n
-            if start_key and bm.first_key < start_key:
-                lo = _lower_bound(blk, start_key)
-            if stop_key is not None and bm.last_key >= stop_key:
-                hi = _lower_bound(blk, stop_key)
-            # only in-range rows count against the iteration budget (the
-            # slow path counts per examined record; out-of-range rows in a
-            # boundary block were never "examined")
-            limiter.add_count(hi - lo)
-            # pad to the fixed block capacity so every block shares one
-            # compiled shape per key-width bucket (partial tail blocks must
-            # not each trigger a recompile)
-            cap = max(BLOCK_CAPACITY, n)
-            if lo > 0 or hi < n:
-                valid = np.zeros(cap, dtype=bool)
-                valid[lo:hi] = True
-            # device block cache: keyed by immutable (file, offset)
-            cache_key = (run.path, bm.offset)
-            dev_block = self._device_cached_block(cache_key, blk)
-            block = (dev_block if valid is None
-                     else dev_block._replace(valid=jnp.asarray(valid)))
-            fused_ok = (self._use_fused_kernel
-                        and hash_filter.filter_type == FT_NO_FILTER
-                        and int(sort_filter.pattern_len) <= 32
-                        and valid is None
-                        and dev_block.hash_lo is not None)
-            if fused_ok:
-                from pegasus_tpu.ops.pallas_scan import (
-                    fused_scan_block, prepare_transposed)
-                prepared = self._prepared_cache.get(cache_key)
-                if prepared is None:
-                    prepared = prepare_transposed(dev_block)
-                    self._prepared_cache[cache_key] = prepared
-                    if len(self._prepared_cache) > self._device_block_cache_cap:
-                        self._prepared_cache.popitem(last=False)
-                else:
-                    self._prepared_cache.move_to_end(cache_key)
-                keep, expired_lazy = fused_scan_block(
-                    dev_block, now, sort_filter=sort_filter, pidx=self.pidx,
-                    partition_version=self.partition_version,
-                    validate_hash=validate_hash, prepared=prepared)
-            else:
-                masks = scan_block_predicate(
-                    block, now, hash_filter=hash_filter,
-                    sort_filter=sort_filter, validate_hash=validate_hash,
-                    pidx=self.pidx,
-                    partition_version=self.partition_version)
-                keep = masks.keep
-                expired_lazy = masks.expired
-            entry = (blk, n, keep, expired_lazy)
-            if pending is not None and _drain(pending):
-                pending = None
-                stopped = True
+                run, (bm, blk) = nxt
+                n = blk.count
+                # boundary blocks: trim rows outside the range (bisect on
+                # the block's sorted keys — O(log n) materializations)
+                lo, hi = 0, n
+                if start_key and bm.first_key < start_key:
+                    lo = _lower_bound(blk, start_key)
+                if stop_key is not None and bm.last_key >= stop_key:
+                    hi = _lower_bound(blk, stop_key)
+                # only in-range rows count against the iteration budget
+                # (out-of-range rows in a boundary block were never
+                # "examined")
+                limiter.add_count(hi - lo)
+                window.append(((run.path, bm.offset), blk, lo, hi))
+            if not window:
                 break
-            pending = entry
-        if pending is not None and not stopped:
-            _drain(pending)
+            keeps = self._static_keep_window(window, validate_hash,
+                                             hash_filter, sort_filter,
+                                             filter_key)
+            for (ckey, blk, lo, hi), static_keep in zip(window, keeps):
+                n = blk.count
+                ets = blk.expire_ts
+                alive = host_alive_mask(ets, now)
+                expired = int(np.count_nonzero(~alive[lo:hi]))
+                if expired:
+                    self._abnormal_reads.increment(expired)
+                keep = static_keep[:n] & alive
+                stop_early = False
+                for i in np.flatnonzero(keep[lo:hi]):
+                    idx = lo + int(i)
+                    key = blk.key_at(idx)
+                    data = (extract_user_data(self.data_version,
+                                              blk.value_at(idx))
+                            if with_values else b"")
+                    out.append((key, data, int(ets[idx])))
+                    out_bytes += len(key) + len(data)
+                    if ((max_records > 0 and len(out) >= max_records)
+                            or (max_bytes > 0 and out_bytes >= max_bytes)):
+                        resume_key = _after(key)
+                        stop_early = True
+                        break
+                if stop_early or not limiter.valid():
+                    if not stop_early:
+                        resume_key = _after(blk.key_at(n - 1))
+                    exhausted = False
+                    stopped = True
+                    break
         return out, exhausted, resume_key
 
     def _validate_batch(self, batch: List[Tuple[bytes, bytes, int]],
@@ -998,8 +961,8 @@ class PartitionServer:
             return [self.on_get_scanner(r) for r in reqs]
         if "precomputed" in state:  # read gate rejected the whole batch
             return state["precomputed"]
-        keep_masks, expired_masks = self.eval_planned_masks(state)
-        return self.finish_scan_batch(state, keep_masks, expired_masks)
+        keep_masks = self.eval_planned_masks(state)
+        return self.finish_scan_batch(state, keep_masks)
 
     def plan_scan_batch(self, reqs: List[GetScannerRequest],
                         now: Optional[int] = None):
@@ -1086,131 +1049,199 @@ class PartitionServer:
                 "filter_key": filter_key, "t0": t0}
 
     def planned_misses(self, state) -> "OrderedDict[tuple, object]":
-        """Unique planned blocks whose masks are NOT cached (the device
-        work remaining); uploads happen here via the block cache. Every
-        planned block — hit or miss — is noted as HOT so the
-        MaskPrefresher keeps warming it across TTL-seconds."""
+        """Unique planned blocks whose STATIC masks are NOT cached (the
+        device work remaining); uploads happen here via the block cache.
+        Masks are `now`-independent (TTL applies host-side at assembly),
+        so a cached block never needs re-evaluation — misses only occur
+        on first touch after a flush/compaction or for a new filter.
+        Planned misses are noted as HOT so the MaskPrefresher can warm
+        sibling flavors ahead of the next scan."""
         keep_masks = {}
-        expired_masks = {}
         misses: "OrderedDict[tuple, object]" = OrderedDict()
-        now, validate = state["now"], state["validate"]
+        validate = state["validate"]
         filter_key = state["filter_key"]
         wall = time.monotonic()
         with self._mask_lock:
-            # hot registration drives prefresher work: the no-filter
-            # flavor always registers; a FILTERED flavor registers once
-            # it RECURS within the window — one-shot filter patterns
-            # must not multiply background device work or evict the
-            # long-lived hot set
-            register_hot = filter_key == _NO_FILTER_KEY
-            if not register_hot:
-                last = self._filter_seen.get(filter_key)
-                register_hot = (last is not None
-                                and wall - last <= self._filter_seen_window)
-                self._filter_seen[filter_key] = wall
-                self._filter_seen.move_to_end(filter_key)
-                while len(self._filter_seen) > self._filter_seen_cap:
-                    self._filter_seen.popitem(last=False)
+            self._register_flavor(validate, filter_key, wall)
             for ckey, (run, bm, blk) in state["unique"].items():
-                mkey = (ckey, now, self.partition_version, validate,
+                mkey = (ckey, self.partition_version, validate,
                         filter_key)
                 cached = self._mask_cache.get(mkey)
-                if register_hot:
-                    hkey = (ckey, validate, filter_key)
-                    self._hot_blocks[hkey] = (blk, wall)
-                    self._hot_blocks.move_to_end(hkey)
                 if cached is not None:
                     self._mask_cache.move_to_end(mkey)
-                    keep_masks[ckey], expired_masks[ckey] = cached
+                    keep_masks[ckey] = cached
                     continue
                 misses[ckey] = (run, bm, blk)
-            while len(self._hot_blocks) > self._hot_blocks_cap:
-                self._hot_blocks.popitem(last=False)
         for ckey, (run, bm, blk) in misses.items():
             misses[ckey] = self._device_cached_block(ckey, blk)
         state["cached_keep"] = keep_masks
-        state["cached_expired"] = expired_masks
         return misses
 
-    def store_mask(self, state, ckey, keep, expired) -> None:
-        self.store_mask_for(ckey, state["now"], state["validate"],
-                            state["filter_key"], keep, expired,
+    def _register_flavor(self, validate: bool, filter_key,
+                         wall: float) -> None:
+        """Remember a scan flavor for background warming (caller holds
+        _mask_lock). The no-filter flavor always registers; a FILTERED
+        flavor registers once it RECURS within the window — one-shot
+        filter patterns must not multiply background device work or
+        evict the long-lived warm set. Flavors (not blocks) are
+        remembered: compaction replaces the block set, and the warmer's
+        job is exactly to re-evaluate the NEW blocks for the flavors
+        serving has been using."""
+        register = filter_key == _NO_FILTER_KEY
+        if not register:
+            last = self._filter_seen.get(filter_key)
+            register = (last is not None
+                        and wall - last <= self._filter_seen_window)
+            self._filter_seen[filter_key] = wall
+            self._filter_seen.move_to_end(filter_key)
+            while len(self._filter_seen) > self._filter_seen_cap:
+                self._filter_seen.popitem(last=False)
+        if register:
+            fl = (validate, filter_key)
+            self._warm_flavors[fl] = wall
+            self._warm_flavors.move_to_end(fl)
+            while len(self._warm_flavors) > self._warm_flavors_cap:
+                self._warm_flavors.popitem(last=False)
+
+    def store_mask(self, state, ckey, keep) -> None:
+        self.store_mask_for(ckey, state["validate"],
+                            state["filter_key"], keep,
                             computed_pv=self.partition_version)
 
-    def store_mask_for(self, ckey, now: int, validate: bool, filter_key,
-                       keep, expired, computed_pv: int) -> None:
-        """Publish a mask under the partition_version it was COMPUTED
-        with. The prefresher evaluates on its own thread — if a split
-        flipped the version mid-evaluation, publishing under the new
-        version would serve pre-split masks (rows now owned by the
+    def _effective_mask_cap(self) -> int:
+        """Mask-cache capacity scaled to the data: every current L1 block
+        x every warm flavor must fit, or the prefresher and the LRU fight
+        forever (warm one mask, evict another still-wanted one) and the
+        'each block evaluated once' invariant breaks on large
+        partitions."""
+        n_blocks = sum(len(run.blocks) for run in self.engine.lsm.l1_runs)
+        flavors = max(1, len(self._warm_flavors))
+        return max(self._mask_cache_cap, n_blocks * flavors + 256)
+
+    def store_mask_for(self, ckey, validate: bool, filter_key,
+                       keep, computed_pv: int) -> None:
+        """Publish a static mask under the partition_version it was
+        COMPUTED with. The prefresher evaluates on its own thread — if a
+        split flipped the version mid-evaluation, publishing under the
+        new version would serve pre-split masks (rows now owned by the
         sibling); drop instead."""
+        keep = np.asarray(keep)
+        cap = self._effective_mask_cap()
         with self._mask_lock:
             if computed_pv != self.partition_version:
                 return
-            self._mask_cache[(ckey, now, computed_pv, validate,
-                              filter_key)] = (keep, expired)
-            if len(self._mask_cache) > self._mask_cache_cap:
+            self._mask_cache[(ckey, computed_pv, validate,
+                              filter_key)] = keep
+            while len(self._mask_cache) > cap:
                 self._mask_cache.popitem(last=False)
 
-    def hot_block_entries(self, wall: float, horizon_s: float,
-                          target_now: int):
-        """(ckey, block, validate, filter_key) for recently-scanned
-        blocks missing a mask for `target_now` — the MaskPrefresher's
-        work list. Prunes entries idle past the horizon."""
-        out = []
+    WARM_BATCH_LIMIT = 256  # blocks loaded per warm pass (bounds IO)
+
+    def hot_block_entries(self, wall: float, horizon_s: float):
+        """(ckey, block, validate, filter_key) for CURRENT L1 blocks
+        missing a static mask for a recently-used scan flavor — the
+        MaskPrefresher's work list. After a flush/compaction replaces
+        the SSTs, this is how the new blocks get their masks evaluated
+        in the background before the next scan pays the device
+        round-trip. Prunes flavors idle past the horizon."""
         with self._mask_lock:
-            for hkey in list(self._hot_blocks):
-                blk, ts = self._hot_blocks[hkey]
-                if wall - ts > horizon_s:
-                    del self._hot_blocks[hkey]
+            flavors = []
+            for fl in list(self._warm_flavors):
+                if wall - self._warm_flavors[fl] > horizon_s:
+                    del self._warm_flavors[fl]
                     continue
-                ckey, validate, filter_key = hkey
-                mkey = (ckey, target_now, self.partition_version,
-                        validate, filter_key)
-                if mkey not in self._mask_cache:
-                    out.append((ckey, blk, validate, filter_key))
+                flavors.append(fl)
+        if not flavors:
+            return []
+        # cache probing runs WITHOUT the lock (GIL-atomic dict gets; a
+        # racing store just makes this pass warm one mask twice) so the
+        # serving path never stalls behind a full-data-size iteration
+        pv = self.partition_version
+        cache_get = self._mask_cache.get
+        missing = []
+        for run in list(self.engine.lsm.l1_runs):
+            for i, bm in enumerate(run.blocks):
+                ckey = (run.path, bm.offset)
+                for validate, filter_key in flavors:
+                    if cache_get((ckey, pv, validate,
+                                  filter_key)) is None:
+                        missing.append((run, i, ckey, validate,
+                                        filter_key))
+                        if len(missing) >= self.WARM_BATCH_LIMIT:
+                            break
+                if len(missing) >= self.WARM_BATCH_LIMIT:
+                    break
+            if len(missing) >= self.WARM_BATCH_LIMIT:
+                break
+        # block loads (disk IO) also happen outside the lock
+        out = []
+        for run, i, ckey, validate, filter_key in missing:
+            try:
+                blk = run.read_block(i)
+            except Exception:  # noqa: BLE001 - run replaced mid-pass
+                continue
+            out.append((ckey, blk, validate, filter_key))
         return out
 
     def eval_planned_masks(self, state):
         """Phase 2 (solo-node form): evaluate this partition's misses."""
         misses = self.planned_misses(state)
         keep_masks = state["cached_keep"]
-        expired_masks = state["cached_expired"]
-        for ckey, keep, expired in self._eval_blocks_stacked(
-                misses, state["now"], state["filter_key"],
-                state["validate"]):
+        for ckey, keep in self._eval_blocks_stacked(
+                misses, state["filter_key"], state["validate"]):
             keep_masks[ckey] = keep
-            expired_masks[ckey] = expired
-            self.store_mask(state, ckey, keep, expired)
-        return keep_masks, expired_masks
+            self.store_mask(state, ckey, keep)
+        return keep_masks
 
-    def finish_scan_batch(self, state, keep_masks, expired_masks
+    def finish_scan_batch(self, state, keep_masks
                           ) -> List[ScanResponse]:
-        """Phase 3: assemble responses from (shared) masks."""
+        """Phase 3: assemble responses from (shared) STATIC masks.
+
+        TTL expiry is applied here, host-side: one vectorized AND of the
+        static mask with the block's expire_ts column per unique block
+        (`now` is the batch's single clock reading). This is the other
+        half of the static/dynamic predicate split — the device never
+        re-evaluates a block just because the clock ticked."""
         if "precomputed" in state:
             return state["precomputed"]
         reqs = state["reqs"]
         req_plans = state["req_plans"]
         overlay = state["overlay"]
         unique = state["unique"]
+        now = state["now"]
         t0 = state["t0"]
-        # 3 — assemble each response from the shared masks, merging the
+        # 3 — combine static keep with host TTL once per unique block,
+        # then assemble each response from the shared masks, merging the
         # host-side overlay in key order (overlay rows SHADOW base rows:
         # newest wins, tombstones hide)
         import bisect
 
+        from pegasus_tpu.ops.predicates import host_alive_mask
+
+        live_masks = {}
+        alive_all = {}
+        for ckey, (_run, _bm, blk) in unique.items():
+            ets = blk.expire_ts
+            alive = host_alive_mask(ets, now)
+            alive_all[ckey] = alive
+            live_masks[ckey] = keep_masks[ckey][:len(ets)] & alive
+
         overlay_keys, overlay_map = overlay
+        hdr = header_length(self.data_version)
         out = []
         for req, start_key, stop_key, want, plan in req_plans:
-            records = []
+            kvs: list = []
+            size = 0
             exhausted = True
             resume_key = None
             stop_early = False
             req_expired = 0
+            want_ets = req.return_expire_ts
+            no_value = req.no_value
 
             def base_rows(plan=plan):
                 for ckey, blk, lo, hi in plan:
-                    keep = keep_masks[ckey]
+                    keep = live_masks[ckey]
                     for i in np.flatnonzero(keep[lo:hi]):
                         idx = lo + int(i)
                         yield blk.key_at(idx), blk, idx
@@ -1218,7 +1249,8 @@ class PartitionServer:
             for ckey, _blk, lo, hi in plan:
                 # per-REQUEST expired accounting (the solo path counts
                 # per request served, not per block evaluated)
-                req_expired += int(expired_masks[ckey][lo:hi].sum())
+                req_expired += int(np.count_nonzero(
+                    ~alive_all[ckey][lo:hi]))
             # plan frontier: where a budget-capped base plan ends — the
             # overlay must not run ahead of it (resume correctness)
             capped = (plan and sum(hi - lo for _c, _b, lo, hi in plan)
@@ -1236,16 +1268,17 @@ class PartitionServer:
             ov_i = ov_lo
             if ov_lo >= ov_hi:
                 # fast path: no overlay rows shadow this window, so the
-                # kept base rows ARE the answer — take them in order
-                # without the per-record merge machinery
-                base = iter(())
-                base_item = None
-                hdr = header_length(self.data_version)
+                # kept base rows ARE the answer — take them in order,
+                # building final KeyValues in ONE pass with the byte-size
+                # accounting vectorized off the columnar offsets
                 for ckey, blk, lo, hi in plan:
-                    hit = np.flatnonzero(keep_masks[ckey][lo:hi])
-                    take = (hit[:want - len(records)] + lo).tolist()
-                    if not take:
+                    hit = np.flatnonzero(live_masks[ckey][lo:hi])
+                    if hit.size > want - len(kvs):
+                        hit = hit[:want - len(kvs)]
+                    if not hit.size:
                         continue
+                    take_arr = hit + lo
+                    take = take_arr.tolist()
                     if blk._key_list is not None or len(take) * 8 >= blk.count:
                         # taking a large share of the block (or it is
                         # already materialized): slice-free row keys
@@ -1253,49 +1286,66 @@ class PartitionServer:
                         row_key = klist.__getitem__
                     else:
                         row_key = blk.key_at
-                    ets = blk.expire_ts
-                    if req.no_value:
-                        records.extend(
-                            (row_key(i), b"", int(ets[i])) for i in take)
+                    size += int(blk.key_len[take_arr].sum())
+                    start_n = len(kvs)
+                    if no_value:
+                        kvs.extend(KeyValue(row_key(i), b"")
+                                   for i in take)
                     else:
                         vo, heap = blk.value_offs, blk.value_heap
-                        records.extend(
-                            (row_key(i), heap[vo[i] + hdr:vo[i + 1]],
-                             int(ets[i])) for i in take)
-                    if len(records) >= want:
-                        resume_key = _after(records[-1][0])
+                        kvs.extend(
+                            KeyValue(row_key(i), heap[vo[i] + hdr:vo[i + 1]])
+                            for i in take)
+                        size += (int(vo[take_arr + 1].astype(np.int64).sum())
+                                 - int(vo[take_arr].astype(np.int64).sum())
+                                 - hdr * len(take))
+                    if want_ets:
+                        ets = blk.expire_ts
+                        for kv, i in zip(kvs[start_n:], take):
+                            kv.expire_ts_seconds = int(ets[i])
+                    if len(kvs) >= want:
+                        resume_key = _after(kvs[-1].key)
                         stop_early = True
                         break
             else:
+                # merge path: interleave overlay rows in key order
+                # (overlay rows SHADOW base rows: newest wins,
+                # tombstones hide)
                 base = base_rows()
                 base_item = next(base, None)
-            while len(records) < want:
-                ov_key = overlay_keys[ov_i] if ov_i < ov_hi else None
-                if base_item is None and ov_key is None:
-                    break
-                take_overlay = (ov_key is not None
-                                and (base_item is None
-                                     or ov_key <= base_item[0]))
-                if take_overlay:
-                    if base_item is not None and ov_key == base_item[0]:
-                        base_item = next(base, None)  # shadowed
-                    ov_i += 1
-                    entry = overlay_map[ov_key]
-                    if entry is None:
-                        continue  # tombstone / hidden overlay row
-                    data = b"" if req.no_value else entry[0]
-                    records.append((ov_key, data, entry[1]))
-                    key = ov_key
-                else:
-                    key, blk, idx = base_item
-                    base_item = next(base, None)
-                    data = (b"" if req.no_value
-                            else extract_user_data(self.data_version,
-                                                   blk.value_at(idx)))
-                    records.append((key, data, int(blk.expire_ts[idx])))
-                if len(records) >= want:
-                    resume_key = _after(key)
-                    stop_early = True
+                while len(kvs) < want:
+                    ov_key = overlay_keys[ov_i] if ov_i < ov_hi else None
+                    if base_item is None and ov_key is None:
+                        break
+                    take_overlay = (ov_key is not None
+                                    and (base_item is None
+                                         or ov_key <= base_item[0]))
+                    if take_overlay:
+                        if base_item is not None and ov_key == base_item[0]:
+                            base_item = next(base, None)  # shadowed
+                        ov_i += 1
+                        entry = overlay_map[ov_key]
+                        if entry is None:
+                            continue  # tombstone / hidden overlay row
+                        data = b"" if no_value else entry[0]
+                        kv = KeyValue(ov_key, data)
+                        if want_ets:
+                            kv.expire_ts_seconds = entry[1]
+                        key = ov_key
+                    else:
+                        key, blk, idx = base_item
+                        base_item = next(base, None)
+                        data = (b"" if no_value
+                                else extract_user_data(self.data_version,
+                                                       blk.value_at(idx)))
+                        kv = KeyValue(key, data)
+                        if want_ets:
+                            kv.expire_ts_seconds = int(blk.expire_ts[idx])
+                    kvs.append(kv)
+                    size += len(key) + len(data)
+                    if len(kvs) >= want:
+                        resume_key = _after(key)
+                        stop_early = True
             if stop_early:
                 exhausted = False
             elif capped:
@@ -1304,13 +1354,7 @@ class PartitionServer:
             if req_expired:
                 self._abnormal_reads.increment(req_expired)
             resp = ScanResponse()
-            size = 0
-            for key, data, ets in records:
-                kv = KeyValue(key, data)
-                if req.return_expire_ts:
-                    kv.expire_ts_seconds = ets
-                resp.kvs.append(kv)
-                size += len(key) + len(data)
+            resp.kvs = kvs
             self.cu.add_read(size)
             resp.error = int(StorageStatus.OK)
             if exhausted:
@@ -1379,17 +1423,53 @@ class PartitionServer:
             out[key] = (extract_user_data(self.data_version, value), ets)
         return list(out), out  # insertion order is already sorted
 
-    def _eval_blocks_stacked(self, misses, now, filter_key, validate):
-        """Evaluate MANY blocks' predicates in as few device dispatches
-        as possible via the shared stacker (scan_coordinator): blocks
-        sharing (width, cap) become one [B*cap, W] program — records are
-        independent, so block boundaries carry no meaning there."""
+    def _eval_blocks_stacked(self, misses, filter_key, validate):
+        """Evaluate MANY blocks' static predicates in as few device
+        dispatches as possible via the shared stacker (scan_coordinator):
+        blocks sharing (width, cap) become one [B*cap, W] program —
+        records are independent, so block boundaries carry no meaning
+        there."""
         from pegasus_tpu.server.scan_coordinator import stacked_block_eval
 
         blocks = [(ckey, dev, self.pidx) for ckey, dev in misses.items()]
-        yield from stacked_block_eval(blocks, now, validate,
+        yield from stacked_block_eval(blocks, validate,
                                       self.partition_version,
                                       filter_key=filter_key)
+
+    def _static_keep_window(self, window, validate: bool,
+                            hash_filter: FilterSpec,
+                            sort_filter: FilterSpec,
+                            filter_key) -> list:
+        """Cached static keep masks for a window of blocks (solo-path
+        form): filter match + partition-hash validation,
+        `now`-independent. Window misses are evaluated in ONE stacked
+        device wave — one round-trip per window instead of per block —
+        and cached for every later scan to combine with TTL host-side.
+        `window`: [(ckey, blk, lo, hi)]; returns masks aligned to it."""
+        from pegasus_tpu.server.scan_coordinator import stacked_block_eval
+
+        pv = self.partition_version
+        keeps: list = [None] * len(window)
+        misses = []
+        with self._mask_lock:
+            for j, (ckey, blk, _lo, _hi) in enumerate(window):
+                mkey = (ckey, pv, validate, filter_key)
+                cached = self._mask_cache.get(mkey)
+                if cached is not None:
+                    self._mask_cache.move_to_end(mkey)
+                    keeps[j] = cached
+                else:
+                    misses.append((j, ckey, blk))
+        if misses:
+            blocks = [((j, ckey), self._device_cached_block(ckey, blk),
+                       self.pidx) for j, ckey, blk in misses]
+            for (j, ckey), keep in stacked_block_eval(
+                    blocks, validate, pv, filter_key=filter_key):
+                keep = np.asarray(keep)
+                keeps[j] = keep
+                self.store_mask_for(ckey, validate, filter_key, keep,
+                                    computed_pv=pv)
+        return keeps
 
     def _device_cached_block(self, cache_key, blk):
         """The shared device-upload cache used by both scan paths."""
@@ -1461,11 +1541,11 @@ class PartitionServer:
                 partition_version=self.partition_version,
                 validate_hash=self.validate_partition_hash,
                 rules_filter=rules_filter)
-            # the old L1 file is gone; its cached device blocks can never
-            # hit again — drop them instead of pinning dead HBM, and
-            # forget their hot-block entries or the prefresher would
-            # re-upload the dead blocks on its next pass
+            # the old L1 files are gone; their cached device blocks and
+            # static masks can never hit again — drop them instead of
+            # pinning dead HBM/host memory. Warm FLAVORS survive: the
+            # prefresher uses them to evaluate the new blocks' masks in
+            # the background before the next scan pays the round-trip.
             with self._mask_lock:
                 self._device_block_cache.clear()
-                self._hot_blocks.clear()
-            self._prepared_cache.clear()
+                self._mask_cache.clear()

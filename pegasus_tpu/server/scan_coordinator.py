@@ -8,6 +8,13 @@ one [B*cap, W] program with a per-record partition-index column for the
 stale-split check), evaluates once, and hands each partition its masks
 back. Per-flush device dispatches drop from
 O(partitions × blocks) to O(key-width buckets).
+
+Masks are STATIC per (block, filter, partition_version): TTL expiry —
+the only `now`-dependent predicate — is applied host-side from the
+block's expire_ts column at assembly time (ops/predicates.py
+static_block_predicate). A block therefore needs exactly one device
+evaluation in its lifetime, and steady-state serving performs zero
+device round-trips.
 """
 
 from __future__ import annotations
@@ -20,7 +27,7 @@ import numpy as np
 from pegasus_tpu.ops.predicates import (
     FT_NO_FILTER,
     FilterSpec,
-    scan_block_predicate,
+    static_block_predicate,
 )
 
 
@@ -52,7 +59,7 @@ def scan_multi(servers_and_reqs: List[Tuple[object, list]],
                 (server, state, ckey, dev))
 
     for (validate, pv, filter_key), entries in flavor_groups.items():
-        _eval_cross_partition(entries, now, validate, pv, filter_key)
+        _eval_cross_partition(entries, validate, pv, filter_key)
 
     out = []
     for server, reqs, state in states:
@@ -62,15 +69,15 @@ def scan_multi(servers_and_reqs: List[Tuple[object, list]],
             out.append(state["precomputed"])
         else:
             out.append(server.finish_scan_batch(
-                state, state["cached_keep"], state["cached_expired"]))
+                state, state["cached_keep"]))
     return out
 
 
-def stacked_block_eval(blocks, now: int, validate: bool, pv: int,
+def stacked_block_eval(blocks, validate: bool, pv: int,
                        filter_key=None):
     """The ONE stacking implementation both the per-partition and the
     cross-partition paths use. `blocks`: [(tag, dev_block, pidx)] —
-    yields (tag, keep, expired).
+    yields (tag, static_keep).
 
     Two phases: SUBMIT every chunk's program to the device (async — XLA
     queues them all), then GATHER every result with the transfers
@@ -78,32 +85,29 @@ def stacked_block_eval(blocks, now: int, validate: bool, pv: int,
     of a fresh result pays a full round-trip (~tens of ms measured), so
     starting all copies before the first wait overlaps compute and
     transfer across chunks instead of serializing round-trips."""
-    submitted = list(stacked_block_submit(blocks, now, validate, pv,
+    submitted = list(stacked_block_submit(blocks, validate, pv,
                                           filter_key))
     for o in submitted:
         _start_host_copy(o[2])
-        _start_host_copy(o[3])
-    for group, cap, keep_dev, exp_dev in submitted:
+    for group, cap, keep_dev in submitted:
         keep_all = np.asarray(keep_dev)
-        exp_all = np.asarray(exp_dev)
         if len(group) == 1:
-            yield group[0][0], keep_all, exp_all
+            yield group[0][0], keep_all
             continue
         for i, (tag, _d, _p) in enumerate(group):
-            yield (tag, keep_all[i * cap:(i + 1) * cap],
-                   exp_all[i * cap:(i + 1) * cap])
+            yield tag, keep_all[i * cap:(i + 1) * cap]
 
 
-def stacked_block_submit(blocks, now: int, validate: bool, pv: int,
+def stacked_block_submit(blocks, validate: bool, pv: int,
                          filter_key=None):
     """Phase 1: dispatch predicate programs WITHOUT waiting. Yields
-    (group, cap, keep_device_array, expired_device_array). Buckets by
-    (key width, capacity) so differently-capped tail blocks can never
-    misalign mask slices; fixed STACK_CHUNK keeps exactly two compiled
-    shapes per key width ([cap, W] and [STACK_CHUNK*cap, W]) — variable
-    stack sizes made every batch a fresh XLA compile. A stack mixing
-    hash_lo and non-hash_lo blocks drops the precomputed column (the
-    kernel computes the hash on device instead)."""
+    (group, cap, keep_device_array). Buckets by (key width, capacity) so
+    differently-capped tail blocks can never misalign mask slices; fixed
+    STACK_CHUNK keeps exactly two compiled shapes per key width
+    ([cap, W] and [STACK_CHUNK*cap, W]) — variable stack sizes made
+    every batch a fresh XLA compile. A stack mixing hash_lo and
+    non-hash_lo blocks drops the precomputed column (the kernel computes
+    the hash on device instead)."""
     hft, hfp, sft, sfp = filter_key or (FT_NO_FILTER, b"",
                                         FT_NO_FILTER, b"")
     hash_f = FilterSpec.make(hft, hfp)
@@ -115,7 +119,7 @@ def stacked_block_submit(blocks, now: int, validate: bool, pv: int,
     for (_w, cap), group in buckets.items():
         for off in range(0, len(group), STACK_CHUNK):
             yield _submit_chunk(group[off:off + STACK_CHUNK], cap,
-                                now, validate, pv, hash_f, sort_f)
+                                validate, pv, hash_f, sort_f)
 
 
 STACK_CHUNK = 16
@@ -132,17 +136,17 @@ def _start_host_copy(arr) -> None:
             pass
 
 
-def _submit_chunk(group, cap, now, validate, pv, hash_f, sort_f):
+def _submit_chunk(group, cap, validate, pv, hash_f, sort_f):
     import jax.numpy as jnp
 
     from pegasus_tpu.ops.record_block import RecordBlock
 
     if len(group) == 1:
         tag, dev, pidx = group[0]
-        m = scan_block_predicate(
-            dev, now, hash_filter=hash_f, sort_filter=sort_f,
+        keep = static_block_predicate(
+            dev, hash_filter=hash_f, sort_filter=sort_f,
             validate_hash=validate, pidx=pidx, partition_version=pv)
-        return group, cap, m.keep, m.expired
+        return group, cap, keep
     padded = group + [group[0]] * (STACK_CHUNK - len(group))
     pidx_col = np.concatenate([
         np.full(cap, pidx, dtype=np.uint32)
@@ -156,49 +160,41 @@ def _submit_chunk(group, cap, now, validate, pv, hash_f, sort_f):
         jnp.concatenate([d.valid for _t, d, _p in padded]),
         (jnp.concatenate([d.hash_lo for _t, d, _p in padded])
          if all_hash_lo else None))
-    m = scan_block_predicate(
-        stacked, now, hash_filter=hash_f, sort_filter=sort_f,
+    keep = static_block_predicate(
+        stacked, hash_filter=hash_f, sort_filter=sort_f,
         validate_hash=validate, pidx=pidx_col,
         partition_version=pv)
-    return group, cap, m.keep, m.expired
+    return group, cap, keep
 
 
-def _eval_cross_partition(entries, now: int, validate: bool,
+def _eval_cross_partition(entries, validate: bool,
                           pv: int, filter_key=None) -> None:
     """Stack blocks from MANY partitions; each record carries its owning
     partition index so one program validates all."""
     blocks = [((server, state, ckey), dev, server.pidx)
               for server, state, ckey, dev in entries]
-    for (server, state, ckey), keep, expired in stacked_block_eval(
-            blocks, now, validate, pv, filter_key=filter_key):
+    for (server, state, ckey), keep in stacked_block_eval(
+            blocks, validate, pv, filter_key=filter_key):
         state["cached_keep"][ckey] = keep
-        state["cached_expired"][ckey] = expired
-        server.store_mask(state, ckey, keep, expired)
+        server.store_mask(state, ckey, keep)
 
 
 class MaskPrefresher:
-    """Background mask warmer — the piece that takes the accelerator OFF
-    the serving path's critical latency.
+    """Background mask warmer — keeps first-touch device work off the
+    serving path's critical latency.
 
-    Predicate masks are keyed by TTL-second (`epoch_now()`), so in
-    steady state every touched block needs exactly one device evaluation
-    per second. Serving that miss synchronously costs a full
-    device round-trip per refresh wave — on a tunneled accelerator tens
-    of milliseconds of dead wait inside a client's scan. This thread
-    recomputes masks for every recently-scanned block for BOTH the
-    current second and the next one, slightly ahead of time, so the
-    serving path finds them in the per-partition mask caches and never
-    blocks on the device (SURVEY §7 'host iteration ∥ device eval'
-    realized as pipelining across the TTL-second boundary).
+    Static masks never expire (TTL is host-applied), so in steady state
+    this thread has NOTHING to do: it only evaluates masks for blocks
+    that recently appeared (flush/compaction rewrote the SSTs) or for a
+    filter flavor seen for the first time, slightly ahead of the next
+    scan. Serving that miss synchronously would cost a full device
+    round-trip inside a client's scan — on a tunneled accelerator tens
+    of milliseconds of dead wait.
 
-    Semantics are unchanged: a scan at second T always uses the mask
-    computed FOR second T; the prefresher only moves WHEN that mask is
-    computed (during second T-1), never what it contains.
-
-    One per node (replica stub / bench cluster). Scans register touched
-    blocks in PartitionServer.planned_misses (the `_hot_blocks` map);
-    entries age out after `horizon_s` without a scan. Daemon thread;
-    safe to leave running.
+    One per node (replica stub / bench cluster). Scans register their
+    flavor (validate + filter) in PartitionServer.planned_misses (the
+    `_warm_flavors` map); flavors age out after `horizon_s` without a
+    scan. Daemon thread; safe to leave running.
     """
 
     def __init__(self, servers, horizon_s: float = 15.0,
@@ -242,8 +238,6 @@ class MaskPrefresher:
     def _run(self) -> None:
         import contextlib
 
-        from pegasus_tpu.base.value_schema import epoch_now
-
         ctx = contextlib.nullcontext()
         if self.device is not None:
             import jax
@@ -252,34 +246,35 @@ class MaskPrefresher:
         with ctx:
             while not self._stop.is_set():
                 try:
-                    self.refresh_once(epoch_now())
+                    self.refresh_once()
                 except Exception:  # noqa: BLE001 - a dead warmer only
                     pass           # costs latency; serving recomputes
                 self._stop.wait(self.poll_s)
 
-    def refresh_once(self, now: int) -> int:
-        """One warm pass for seconds {now, now+1}; returns masks stored.
-        Synchronous; tests call this directly with a pinned clock."""
+    def refresh_once(self, now: int = 0) -> int:
+        """One warm pass over hot blocks missing their static mask;
+        returns masks stored. Synchronous; tests call this directly.
+        (`now` accepted for back-compat; static masks don't depend on
+        it.)"""
         import time as _time
 
         wall = _time.monotonic()
         warmed = 0
-        for target in (now, now + 1):
-            flavors: Dict[tuple, list] = {}
-            for srv in self.servers:
-                for ckey, blk, validate, fkey in srv.hot_block_entries(
-                        wall, self.horizon_s, target):
-                    dev = srv._device_cached_block(ckey, blk)
-                    flavors.setdefault(
-                        (validate, srv.partition_version, fkey),
-                        []).append((srv, ckey, dev))
-            for (validate, pv, fkey), entries in flavors.items():
-                blocks = [((srv, ckey), dev, srv.pidx)
-                          for srv, ckey, dev in entries]
-                for (srv, ckey), keep, expired in stacked_block_eval(
-                        blocks, target, validate, pv, filter_key=fkey):
-                    srv.store_mask_for(ckey, target, validate, fkey,
-                                       keep, expired, computed_pv=pv)
-                    warmed += 1
+        flavors: Dict[tuple, list] = {}
+        for srv in self.servers:
+            for ckey, blk, validate, fkey in srv.hot_block_entries(
+                    wall, self.horizon_s):
+                dev = srv._device_cached_block(ckey, blk)
+                flavors.setdefault(
+                    (validate, srv.partition_version, fkey),
+                    []).append((srv, ckey, dev))
+        for (validate, pv, fkey), entries in flavors.items():
+            blocks = [((srv, ckey), dev, srv.pidx)
+                      for srv, ckey, dev in entries]
+            for (srv, ckey), keep in stacked_block_eval(
+                    blocks, validate, pv, filter_key=fkey):
+                srv.store_mask_for(ckey, validate, fkey,
+                                   keep, computed_pv=pv)
+                warmed += 1
         self.refreshed += warmed
         return warmed

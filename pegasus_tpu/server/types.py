@@ -47,8 +47,11 @@ class MutateOperation(enum.IntEnum):
     MO_DELETE = 1
 
 
-@dataclass
+@dataclass(slots=True)
 class KeyValue:
+    """slots=True: scan responses create one per returned record — the
+    single hottest allocation in the serving path."""
+
     key: bytes                    # sort_key in multi_* responses
     value: bytes = b""
     expire_ts_seconds: Optional[int] = None

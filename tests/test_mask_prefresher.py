@@ -1,14 +1,17 @@
-"""MaskPrefresher: background mask warming across TTL-seconds.
+"""MaskPrefresher: background static-mask warming.
 
 Parity intent: SURVEY §7's 'host iteration ∥ device eval' hard part —
-steady-state scans must not synchronously wait on the accelerator; the
-per-second predicate-mask refresh runs ahead of the serving second.
+steady-state scans must not synchronously wait on the accelerator.
+Static masks (filters + partition-hash) are `now`-independent, so the
+warmer's job is re-evaluating NEW blocks after a flush/compaction for
+the scan flavors serving has been using; TTL expiry is applied
+host-side at assembly and needs no warming at all.
 """
 
 import pytest
 
 from pegasus_tpu.base.key_schema import generate_key
-from pegasus_tpu.base.value_schema import epoch_now, expire_ts_from_ttl
+from pegasus_tpu.base.value_schema import epoch_now
 from pegasus_tpu.client import PegasusClient, Table
 from pegasus_tpu.server.scan_coordinator import MaskPrefresher
 from pegasus_tpu.server.types import GetScannerRequest
@@ -18,7 +21,6 @@ from pegasus_tpu.server.types import GetScannerRequest
 def table(tmp_path):
     t = Table(str(tmp_path / "t"), app_id=1, partition_count=4)
     c = PegasusClient(t)
-    now = epoch_now()
     for i in range(200):
         ttl = 0 if i % 5 else 2  # some records expire soon
         assert c.set(b"pk%04d" % i, b"s", b"v%d" % i,
@@ -36,50 +38,71 @@ def _scan_batch(srv, now):
                               validate_partition_hash=True)]
     state = srv.plan_scan_batch(reqs, now=now)
     assert state is not None and "precomputed" not in state
-    keep, exp = srv.eval_planned_masks(state)
-    return srv.finish_scan_batch(state, keep, exp)
+    keep = srv.eval_planned_masks(state)
+    return srv.finish_scan_batch(state, keep)
 
 
-def test_prefresher_warms_next_second(table):
+def test_prefresher_warms_new_blocks_after_compaction(table):
     t, _c = table
     now = epoch_now()
-    # a served scan marks its blocks hot
+    # a served scan registers its flavor and caches its static masks
     for srv in t.all_partitions():
         _scan_batch(srv, now)
-        assert srv.hot_block_entries(0.0, 60.0, now + 1)
+        # masks cached -> nothing to warm
+        assert srv.hot_block_entries(0.0, 60.0) == []
+    # compaction replaces the SSTs: masks are gone, flavor remains
+    for srv in t.all_partitions():
+        srv.manual_compact()
+        assert srv.hot_block_entries(0.0, 60.0)
     pre = MaskPrefresher(t.all_partitions())
-    warmed = pre.refresh_once(now)
+    warmed = pre.refresh_once()
     assert warmed > 0
-    # next-second masks are in cache: planning at now+1 has NO misses
+    # new blocks' masks are in cache: planning now has NO misses
     for srv in t.all_partitions():
         reqs = [GetScannerRequest(start_key=generate_key(b"pk", b""),
                                   batch_size=50,
                                   validate_partition_hash=True)]
-        state = srv.plan_scan_batch(reqs, now=now + 1)
+        state = srv.plan_scan_batch(reqs, now=now)
         assert srv.planned_misses(state) == {}
     # and a second pass has nothing left to warm
-    assert pre.refresh_once(now) == 0
+    assert pre.refresh_once() == 0
 
 
 def test_prefreshed_masks_match_synchronous_eval(table):
     """The warmed mask must be BIT-IDENTICAL to what synchronous serving
-    would compute for that second — the prefresher moves when, not what."""
+    would compute — the prefresher moves when, not what."""
     t, _c = table
     now = epoch_now()
-    target = now + 2  # beyond the records' 2s TTL: expiry flips masks
+    target = now + 3  # beyond the records' 2s TTL: expiry flips results
     for srv in t.all_partitions():
         _scan_batch(srv, now)
-    MaskPrefresher(t.all_partitions()).refresh_once(target - 1)
+        srv.manual_compact()
+    MaskPrefresher(t.all_partitions()).refresh_once()
     for srv in t.all_partitions():
-        reqs = [GetScannerRequest(start_key=generate_key(b"pk", b""),
-                                  batch_size=50,
-                                  validate_partition_hash=True)]
         warmed = _scan_batch(srv, target)
         with srv._mask_lock:
             srv._mask_cache.clear()  # force cold recompute
         cold = _scan_batch(srv, target)
         assert [(kv.key, kv.value) for kv in warmed[0].kvs] == \
             [(kv.key, kv.value) for kv in cold[0].kvs]
+
+
+def test_ttl_expiry_needs_no_rewarm(table):
+    """The static mask computed at second T serves second T+k correctly:
+    expiry is host-applied, so results differ while masks are shared."""
+    t, _c = table
+    now = epoch_now()
+    srv = t.all_partitions()[0]
+    early = _scan_batch(srv, now)[0]
+    state = srv.plan_scan_batch(
+        [GetScannerRequest(start_key=generate_key(b"pk", b""),
+                           batch_size=50, validate_partition_hash=True)],
+        now=now + 10)
+    assert srv.planned_misses(state) == {}  # no new device work
+    late = _scan_batch(srv, now + 10)[0]
+    early_keys = {kv.key for kv in early.kvs}
+    late_keys = {kv.key for kv in late.kvs}
+    assert late_keys < early_keys  # TTL=2 records dropped, nothing new
 
 
 def test_filtered_scans_ride_the_batched_path(table):
@@ -99,8 +122,8 @@ def test_filtered_scans_ride_the_batched_path(table):
             for _ in range(3)]
     state = srv.plan_scan_batch(reqs, now=now)
     assert state is not None and "precomputed" not in state
-    keep, exp = srv.eval_planned_masks(state)
-    batched = srv.finish_scan_batch(state, keep, exp)
+    keep = srv.eval_planned_masks(state)
+    batched = srv.finish_scan_batch(state, keep)
     solo = [srv.on_get_scanner(r) for r in reqs]
     for b, s in zip(batched, solo):
         assert [(kv.key, kv.value) for kv in b.kvs] == \
@@ -117,10 +140,11 @@ def test_filtered_scans_ride_the_batched_path(table):
                                validate_partition_hash=True)]
     state3 = srv.plan_scan_batch(reqs2, now=now)
     assert srv.planned_misses(state3) != {}
-    # and the prefresher warms filtered masks too
+    # the recurring filtered flavor is warmed on new blocks too
+    srv.manual_compact()
     pre = MaskPrefresher(t.all_partitions())
-    assert pre.refresh_once(now) > 0
-    state4 = srv.plan_scan_batch(reqs, now=now + 1)
+    assert pre.refresh_once() > 0
+    state4 = srv.plan_scan_batch(reqs, now=now)
     assert srv.planned_misses(state4) == {}
 
 
@@ -146,8 +170,8 @@ def test_filtered_batch_respects_overlay(table):
                             validate_partition_hash=True)
     state = srv.plan_scan_batch([req])
     assert state is not None and "precomputed" not in state
-    keep, exp = srv.eval_planned_masks(state)
-    resp = srv.finish_scan_batch(state, keep, exp)[0]
+    keep = srv.eval_planned_masks(state)
+    resp = srv.finish_scan_batch(state, keep)[0]
     keys = {kv.key for kv in resp.kvs}
     from pegasus_tpu.base.key_schema import generate_key as gk
     from pegasus_tpu.base.key_schema import restore_key
@@ -155,15 +179,16 @@ def test_filtered_batch_respects_overlay(table):
     assert all(restore_key(k)[0].startswith(b"pk") for k in keys)
 
 
-def test_hot_blocks_age_out(table):
+def test_warm_flavors_age_out(table):
     t, _c = table
     now = epoch_now()
     srv = t.all_partitions()[0]
     _scan_batch(srv, now)
-    assert srv.hot_block_entries(0.0, 60.0, now + 1)
-    # far-future wall clock: everything idle past the horizon
-    assert srv.hot_block_entries(1e9, 15.0, now + 1) == []
-    assert not srv._hot_blocks
+    srv.manual_compact()
+    assert srv.hot_block_entries(0.0, 60.0)
+    # far-future wall clock: every flavor idle past the horizon
+    assert srv.hot_block_entries(1e9, 15.0) == []
+    assert not srv._warm_flavors
 
 
 def test_prefresher_thread_smoke(table):
@@ -174,6 +199,7 @@ def test_prefresher_thread_smoke(table):
     now = epoch_now()
     for srv in t.all_partitions():
         _scan_batch(srv, now)
+        srv.manual_compact()
     pre = MaskPrefresher(t.all_partitions(), poll_s=0.05).start()
     try:
         deadline = time.monotonic() + 10
