@@ -131,39 +131,50 @@ def parse_rules(spec) -> List[Operation]:
     return [Operation(s) for s in spec]
 
 
+def apply_rules_ops(operations, keys, key_len, hashkey_len, expire_ts,
+                    valid, now):
+    """Apply a parsed ruleset inside a jit: (drop, new_ets).
+
+    Every operation evaluates against the ORIGINAL (pre-rules)
+    expire_ts — the reference fixes existing_value before its op loop
+    (key_ttl_compaction_filter.h:94-108); only the output ets
+    accumulates updates. Shared by the per-batch wrapper below and the
+    fused bulk-compaction program (ops/compaction.py)."""
+    drop = jnp.zeros_like(valid)
+    ets = expire_ts
+    for op in operations:  # static unroll: ruleset structure is fixed
+        matched = valid & ~drop
+        for rule in op.rules:
+            matched = matched & rule.evaluate(keys, key_len, hashkey_len,
+                                              expire_ts, now)
+        if op.op == "delete_key":
+            drop = drop | matched
+        else:
+            if op.utot == UTOT_FROM_NOW:
+                new_ts = now + jnp.uint32(op.value)
+            elif op.utot == UTOT_FROM_CURRENT:
+                # no-op for records without a TTL, judged on the
+                # original value (compaction_operation.cpp:93-96)
+                matched = matched & (expire_ts != 0)
+                new_ts = expire_ts + jnp.uint32(op.value)
+            else:  # UTOT_TIMESTAMP: expire at unix ts `value`
+                new_ts = jnp.uint32(max(0, op.value - PEGASUS_EPOCH_BEGIN))
+            ets = jnp.where(matched, new_ts, ets)
+    return drop, ets
+
+
 def compile_rules(spec) -> Callable:
     """Returns `rules_filter(keys, expire_ts, now) -> (drop, new_ets)`
     matching StorageEngine.manual_compact's hook signature; the predicate
-    pipeline for the whole ruleset is one jitted device program."""
+    pipeline for the whole ruleset is one jitted device program. The
+    parsed ruleset is exposed as `rules_filter.operations` so the bulk
+    block-level compactor can fuse it into its own program."""
     operations = parse_rules(spec)
 
     @jax.jit
     def _eval(keys, key_len, hashkey_len, expire_ts, valid, now):
-        # every operation evaluates against the ORIGINAL (pre-rules)
-        # expire_ts — the reference fixes existing_value before its op loop
-        # (key_ttl_compaction_filter.h:94-108); only the output ets
-        # accumulates updates
-        drop = jnp.zeros_like(valid)
-        ets = expire_ts
-        for op in operations:  # static unroll: ruleset structure is fixed
-            matched = valid & ~drop
-            for rule in op.rules:
-                matched = matched & rule.evaluate(keys, key_len, hashkey_len,
-                                                  expire_ts, now)
-            if op.op == "delete_key":
-                drop = drop | matched
-            else:
-                if op.utot == UTOT_FROM_NOW:
-                    new_ts = now + jnp.uint32(op.value)
-                elif op.utot == UTOT_FROM_CURRENT:
-                    # no-op for records without a TTL, judged on the
-                    # original value (compaction_operation.cpp:93-96)
-                    matched = matched & (expire_ts != 0)
-                    new_ts = expire_ts + jnp.uint32(op.value)
-                else:  # UTOT_TIMESTAMP: expire at unix ts `value`
-                    new_ts = jnp.uint32(max(0, op.value - PEGASUS_EPOCH_BEGIN))
-                ets = jnp.where(matched, new_ts, ets)
-        return drop, ets
+        return apply_rules_ops(operations, keys, key_len, hashkey_len,
+                               expire_ts, valid, now)
 
     def rules_filter(keys: Sequence[bytes], expire_ts, now: int
                      ) -> Tuple[np.ndarray, np.ndarray]:
@@ -183,4 +194,5 @@ def compile_rules(spec) -> Callable:
                           jnp.asarray(block.valid), jnp.uint32(now))
         return np.asarray(drop)[:n], np.asarray(ets)[:n]
 
+    rules_filter.operations = tuple(operations)
     return rules_filter

@@ -239,6 +239,52 @@ class StorageEngine:
 
     # ---- compaction ---------------------------------------------------
 
+    def _manual_compact_bulk(self, now_s: int, default_ttl: int,
+                             pidx: int, partition_version: int,
+                             do_validate: bool, operations) -> None:
+        """Block-level compaction over a pure-L1 store.
+
+        Windowed: load a window of blocks, evaluate every window miss in
+        a handful of stacked programs (ops/compaction.py — placed on the
+        accelerator or the host XLA backend by the link cost model),
+        rewrite survivors with vectorized gathers, release, repeat —
+        memory stays bounded by the window regardless of table size."""
+        from pegasus_tpu.ops.compaction import (
+            choose_eval_device,
+            compaction_eval_stacked,
+        )
+
+        ttl_may_change = bool(default_ttl) or bool(
+            operations and any(op.op == "update_ttl" for op in operations))
+        eval_device = choose_eval_device()
+        entries = self.lsm.bulk_compact_entries()
+        meta = {
+            "last_flushed_decree": self.last_committed_decree,
+            "data_version": self.data_version,
+            "manual_compact_finish_time": epoch_now(),
+        }
+
+        WINDOW = 512  # blocks per load->eval->rewrite window
+
+        def results():
+            for off in range(0, len(entries), WINDOW):
+                window = entries[off:off + WINDOW]
+                blocks = [((run, i), run.read_block(i), pidx)
+                          for run, i, _bm in window]
+                got = {}
+                for tag, drop, new_ets in compaction_eval_stacked(
+                        blocks, now_s, default_ttl, partition_version,
+                        do_validate, operations=operations,
+                        eval_device=eval_device):
+                    got[tag] = (drop, new_ets)
+                by_tag = {tag: blk for tag, blk, _p in blocks}
+                for run, i, _bm in window:
+                    drop, new_ets = got[(run, i)]
+                    yield run, i, by_tag[(run, i)], drop, new_ets
+
+        self.lsm.bulk_compact_rewrite(results(), meta,
+                                      ttl_may_change=ttl_may_change)
+
     def manual_compact(self, default_ttl: int = 0, pidx: int = 0,
                        partition_version: int = -1,
                        validate_hash: bool = False,
@@ -256,6 +302,20 @@ class StorageEngine:
         # check_if_stale_split_data.
         do_validate = bool(validate_hash and partition_version >= 0
                            and pidx <= partition_version)
+
+        # bulk block-level path (the GB/s shape): a pure-L1 store needs
+        # no merge, so whole columnar blocks are evaluated in a handful
+        # of stacked programs and surviving rows rewritten with numpy
+        # gathers — no per-record Python. Custom rules callables without
+        # a parsed ruleset fall back to the merge path.
+        operations = getattr(rules_filter, "operations", None)
+        if (self.lsm.bulk_compact_eligible()
+                and (rules_filter is None or operations is not None)):
+            self._compact_with_epilogue(
+                lambda: self._manual_compact_bulk(
+                    now_s, default_ttl, pidx, partition_version,
+                    do_validate, operations))
+            return
 
         def record_filter(keys: List[bytes], ets: List[int]):
             n = len(keys)
@@ -297,14 +357,21 @@ class StorageEngine:
             drop = jnp.logical_or(drop[:n], jnp.asarray(rule_drop))
             return drop, new_ets[:n]
 
+        self._compact_with_epilogue(
+            lambda: self.lsm.compact(record_filter=record_filter, meta={
+                "last_flushed_decree": self.last_committed_decree,
+                "data_version": self.data_version,
+                "manual_compact_finish_time": epoch_now(),
+            }))
+
+    def _compact_with_epilogue(self, body) -> None:
+        """Shared post-compaction bookkeeping for both compaction paths:
+        advance the flushed watermark (everything committed is now in the
+        SSTs), truncate the WAL, and record metrics."""
         import time as _time
 
         t0 = _time.perf_counter()
-        self.lsm.compact(record_filter=record_filter, meta={
-            "last_flushed_decree": self.last_committed_decree,
-            "data_version": self.data_version,
-            "manual_compact_finish_time": epoch_now(),
-        })
+        body()
         self.last_flushed_decree = self.last_committed_decree
         self.wal.truncate()
         self._ev_compact_count.increment()

@@ -356,6 +356,129 @@ class LSMStore:
             t.close()
             os.remove(t.path)
 
+    # ---- bulk block-level compaction (the GB/s path) -------------------
+
+    def bulk_compact_eligible(self) -> bool:
+        """The store is pure non-overlapping L1 (manual-compact steady
+        state): no merge is needed, so compaction can rewrite block-wise
+        with vectorized gathers instead of streaming per-record Python.
+        v1 files (no hash_lo column) fall back to the merge path."""
+        return (len(self.memtable) == 0 and not self.l0
+                and bool(self.l1_runs)
+                and all(getattr(r, "_has_hash_lo", False)
+                        for r in self.l1_runs))
+
+    def bulk_compact_entries(self):
+        """Every L1 block in global key order: [(run, idx, BlockMeta)]."""
+        out = []
+        for run in self.l1_runs:
+            for i, bm in enumerate(run.blocks):
+                out.append((run, i, bm))
+        return out
+
+    def bulk_compact_rewrite(self, per_block, meta,
+                             ttl_may_change: bool) -> None:
+        """Rewrite the L1 level from precomputed per-block filter results.
+
+        `per_block`: [(run, idx, blk, drop, new_ets)] in key order (drop
+        / new_ets sized to the block's real count). Untouched blocks are
+        copied VERBATIM (no decode/re-encode/crc); touched blocks are
+        rebuilt with numpy gathers — the value heap survivor bytes via
+        one boolean-repeat mask, expire_ts headers patched with scatter
+        stores — so no per-record Python runs at any drop rate."""
+        from pegasus_tpu.storage.sstable import SSTable, SSTableWriter
+
+        new_runs: List[SSTable] = []
+        writer: Optional[SSTableWriter] = None
+        written_in_run = 0
+
+        def roll_writer() -> SSTableWriter:
+            nonlocal writer, written_in_run
+            if writer is not None and written_in_run >= self._l1_run_capacity:
+                writer.finish()
+                new_runs.append(SSTable(writer.path))
+                writer = None
+                written_in_run = 0
+            if writer is None:
+                writer = SSTableWriter(self._next_path("l1"),
+                                       block_capacity=self._block_capacity,
+                                       meta=meta)
+            return writer
+
+        for run, idx, blk, drop, new_ets in per_block:
+            bm = run.blocks[idx]
+            dropped = bool(drop.any())
+            if not dropped and not ttl_may_change:
+                w = roll_writer()
+                w.add_raw_block(run.read_raw_block(idx), bm)
+                written_in_run += bm.count
+                continue
+            n = blk.count
+            ets_changed = (ttl_may_change
+                           and not np.array_equal(new_ets, blk.expire_ts))
+            if not dropped and not ets_changed:
+                w = roll_writer()
+                w.add_raw_block(run.read_raw_block(idx), bm)
+                written_in_run += bm.count
+                continue
+            keep = ~drop
+            if blk.flags is not None:
+                keep &= blk.flags == 0  # defensive: tombstones never stay
+            kept = np.flatnonzero(keep)
+            if kept.size == 0:
+                continue
+            vo = blk.value_offs.astype(np.int64)
+            lens = vo[1:] - vo[:-1]
+            heap_arr = np.frombuffer(blk.value_heap, dtype=np.uint8)
+            ets_col = new_ets if ets_changed else blk.expire_ts
+            if ets_changed:
+                # patch the big-endian u32 expire_ts value header in
+                # place (vectorized scatter, value_schema.h: header
+                # starts every encoded value)
+                heap_arr = heap_arr.copy()
+                chg = np.flatnonzero((new_ets != blk.expire_ts) & keep)
+                if chg.size:
+                    pos = vo[chg]
+                    vals = new_ets[chg].astype(np.uint32)
+                    heap_arr[pos] = (vals >> 24).astype(np.uint8)
+                    heap_arr[pos + 1] = ((vals >> 16) & 0xFF).astype(np.uint8)
+                    heap_arr[pos + 2] = ((vals >> 8) & 0xFF).astype(np.uint8)
+                    heap_arr[pos + 3] = (vals & 0xFF).astype(np.uint8)
+            if kept.size == n:
+                new_heap = heap_arr.tobytes()
+                new_offs = blk.value_offs
+                keys2d, klen = blk.keys, blk.key_len
+                hlo, flg = blk.hash_lo, blk.flags
+                ets_out = ets_col
+            else:
+                keep_bytes = np.repeat(keep, lens)
+                new_heap = heap_arr[keep_bytes].tobytes()
+                kept_lens = lens[kept]
+                new_offs = np.zeros(kept.size + 1, dtype=np.uint32)
+                new_offs[1:] = np.cumsum(kept_lens)
+                keys2d = blk.keys[kept]
+                klen = blk.key_len[kept]
+                ets_out = np.asarray(ets_col)[kept]
+                hlo = blk.hash_lo[kept]
+                flg = blk.flags[kept]
+            w = roll_writer()
+            w.add_block_columnar(keys2d, klen, ets_out, hlo, flg,
+                                 new_offs, new_heap)
+            written_in_run += kept.size
+        if writer is not None:
+            writer.finish()
+            new_runs.append(SSTable(writer.path))
+
+        # publish exactly like compact(): manifest first (atomic), then
+        # remove inputs. memtable/L0 are untouched by construction
+        # (bulk_compact_eligible requires them empty).
+        self._write_manifest([os.path.basename(t.path) for t in new_runs])
+        old_runs = self.l1_runs
+        self.l1_runs = new_runs
+        for t in old_runs:
+            t.close()
+            os.remove(t.path)
+
 
 class _HeapEntry:
     """Heap ordering: key asc (or desc when reverse), then source index asc —

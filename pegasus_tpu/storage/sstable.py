@@ -179,6 +179,57 @@ class SSTableWriter:
             offset=offset, size=self._f.tell() - offset, count=n,
             key_width=width, first_key=recs[0][0], last_key=recs[-1][0]))
 
+    def add_raw_block(self, raw: bytes, bm: "BlockMeta") -> None:
+        """Append an UNMODIFIED block verbatim (bulk compaction's
+        untouched-block fast path: no decode, no re-encode, no crc
+        recompute — the block bytes are already exactly right)."""
+        self._flush_block()
+        if self._last_key is not None and bm.first_key <= self._last_key:
+            raise ValueError("blocks must be added in key order")
+        offset = self._f.tell()
+        self._f.write(raw)
+        self._blocks.append(BlockMeta(
+            offset=offset, size=len(raw), count=bm.count,
+            key_width=bm.key_width, first_key=bm.first_key,
+            last_key=bm.last_key))
+        self._count += bm.count
+        self._last_key = bm.last_key
+
+    def add_block_columnar(self, keys: np.ndarray, key_len: np.ndarray,
+                           ets: np.ndarray, hash_lo: np.ndarray,
+                           flags: np.ndarray, value_offs: np.ndarray,
+                           heap: bytes) -> None:
+        """Append a block from ALREADY-COLUMNAR arrays (bulk compaction's
+        rewrite path): no per-record Python, and hash_lo is carried over
+        from the source block instead of recomputed."""
+        n = int(keys.shape[0])
+        if n == 0:
+            return
+        self._flush_block()
+        first_key = bytes(keys[0, :int(key_len[0])])
+        last_key = bytes(keys[-1, :int(key_len[-1])])
+        if self._last_key is not None and first_key <= self._last_key:
+            raise ValueError("blocks must be added in key order")
+        width = int(keys.shape[1])
+        offset = self._f.tell()
+        self._f.write(_BLOCK_HDR.pack(n, width, len(heap)))
+        self._f.write(np.ascontiguousarray(keys, dtype=np.uint8).tobytes())
+        self._f.write(np.ascontiguousarray(key_len,
+                                           dtype=np.int32).tobytes())
+        self._f.write(np.ascontiguousarray(ets, dtype=np.uint32).tobytes())
+        self._f.write(np.ascontiguousarray(hash_lo,
+                                           dtype=np.uint32).tobytes())
+        self._f.write(np.ascontiguousarray(flags,
+                                           dtype=np.uint8).tobytes())
+        self._f.write(np.ascontiguousarray(value_offs,
+                                           dtype=np.uint32).tobytes())
+        self._f.write(heap)
+        self._blocks.append(BlockMeta(
+            offset=offset, size=self._f.tell() - offset, count=n,
+            key_width=width, first_key=first_key, last_key=last_key))
+        self._count += n
+        self._last_key = last_key
+
     def finish(self) -> None:
         self._flush_block()
         index = {
@@ -258,6 +309,13 @@ class SSTable:
     @property
     def last_key(self) -> Optional[bytes]:
         return self.blocks[-1].last_key if self.blocks else None
+
+    def read_raw_block(self, idx: int) -> bytes:
+        """The block's on-disk bytes, verbatim (bulk compaction's
+        untouched-block copy path)."""
+        bm = self.blocks[idx]
+        self._f.seek(bm.offset)
+        return self._f.read(bm.size)
 
     def read_block(self, idx: int) -> Block:
         blk = self._cache.get(idx)
