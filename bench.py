@@ -369,14 +369,14 @@ def data_bytes(bc) -> int:
     return total
 
 
-def _seed_compact_work(bc, mode: str, n_partitions: int):
+def _seed_compact_work(bc, mode: str, n_partitions: int, margin_s: int):
     """Write records the next compaction pass will DROP, so the timed
     pass measures real filter-driven rewriting instead of a no-op
-    verbatim block copy. ttl: 10% of a partition's worth of records with
-    a short future expiry (folded into L1 while still live, expired by
-    measure time). rules: the hashkey-prefix records the delete rule
-    targets (re-seeded identically before every pass, so the accel and
-    cpu phases face the same work)."""
+    verbatim block copy. ttl: records with a `margin_s` future expiry
+    (folded into L1 while still live, expired by measure time). rules:
+    the hashkey-prefix records the delete rule targets (re-seeded
+    identically before every pass, so the accel and cpu phases face the
+    same work). Returns the seed expiry (0 for rules mode)."""
     from pegasus_tpu.base.key_schema import generate_key, partition_index
     from pegasus_tpu.base.value_schema import epoch_now
     from pegasus_tpu.replica.mutation import WriteOp
@@ -386,7 +386,7 @@ def _seed_compact_work(bc, mode: str, n_partitions: int):
     per_pidx = {}
     if mode == "ttl":
         hks = [b"ttlseed%06d" % i for i in range(200)]
-        ets = now + 3
+        ets = now + margin_s
     else:
         hks = [b"user0000001%d" % i for i in range(10)]
         ets = 0
@@ -398,27 +398,31 @@ def _seed_compact_work(bc, mode: str, n_partitions: int):
     for pidx, ops in per_pidx.items():
         bc.replicas[pidx].client_write(ops)
     bc.cluster.loop.run_until_idle()
-    return 3.2 if mode == "ttl" else 0.0  # settle time before measuring
+    return ets if mode == "ttl" else 0
 
 
 def _warm_compaction_programs(jax, device, rules_filter):
     """Compile the (no-rules and rules) eval programs on whatever device
-    the adaptive placement picks, against a throwaway table — so the
-    FIRST measured backend does not pay XLA compilation the second one
-    skips (the eval device is shared under adaptive placement)."""
+    the adaptive placement picks, against a throwaway table whose keys
+    share the bench table's SHAPE BUCKETS (same "user%08d"/"s%02d" key
+    generator -> same key-width bucket; <=4096 rows -> same minimum row
+    bucket) — so the FIRST measured backend does not pay XLA
+    compilation the second one skips (the eval device is shared under
+    adaptive placement)."""
     from pegasus_tpu.client import PegasusClient, Table
 
     with tempfile.TemporaryDirectory(prefix="pegwarm") as tmp:
         t = Table(os.path.join(tmp, "w"), app_id=9, partition_count=2)
         c = PegasusClient(t)
         for i in range(64):
-            c.set(b"user%07d" % i, b"s", b"v")
+            c.set(b"user%08d" % i, b"s%02d" % (i % 10), b"v")
         t.flush_all()
         with jax.default_device(device):
             for srv in t.all_partitions():
                 srv.manual_compact()           # merge path -> L1
                 srv.manual_compact()           # bulk, no rules
-                srv.manual_compact(rules_filter=rules_filter)  # bulk, rules
+                if rules_filter is not None:
+                    srv.manual_compact(rules_filter=rules_filter)
         t.close()
 
 
@@ -430,7 +434,12 @@ def measure_compaction(jax, device, bc, mode: str, n_partitions: int):
     (BASELINE config #4, compaction_filter_rule.h:99,121,141).
 
     Seeds drop-work, folds it into L1 (untimed prep pass), then times
-    ONE full compaction that actually rewrites blocks."""
+    ONE full compaction that actually rewrites blocks. The ttl seeds
+    must still be LIVE when the fold pass evaluates them — if the fold
+    outlives the expiry margin (big tables), reseed with a wider margin
+    so the timed pass never degrades to a verbatim-copy no-op."""
+    from pegasus_tpu.base.value_schema import epoch_now
+
     rules_filter = None
     if mode == "rules":
         from pegasus_tpu.ops.compaction_rules import compile_rules
@@ -439,12 +448,25 @@ def measure_compaction(jax, device, bc, mode: str, n_partitions: int):
             "rules": [{"type": "hashkey_pattern", "match": "prefix",
                        "pattern": "user0000001"}],
         }])
-        _warm_compaction_programs(jax, device, rules_filter)
-    settle = _seed_compact_work(bc, mode, n_partitions)
-    with jax.default_device(device):
-        bc.manual_compact_all(device=device)  # untimed: fold seeds to L1
-    if settle:
-        time.sleep(settle)
+    _warm_compaction_programs(jax, device, rules_filter)
+
+    margin = 4
+    while True:
+        seed_ets = _seed_compact_work(bc, mode, n_partitions, margin)
+        with jax.default_device(device):
+            bc.manual_compact_all(device=device)  # untimed: fold to L1
+        if mode != "ttl":
+            break
+        err, _v = bc.client.get(b"ttlseed000000", b"s00")
+        if err == 0 and epoch_now() < seed_ets:
+            break  # seeds survived the fold and are still live
+        if margin > 256:
+            _log("compact seed fold kept outrunning the margin; "
+                 "measuring without ttl drop-work")
+            break
+        margin *= 4
+    if mode == "ttl":
+        time.sleep(max(0.0, seed_ets - epoch_now()) + 0.3)
     size_before = data_bytes(bc)
     with jax.default_device(device):
         t0 = time.perf_counter()

@@ -143,41 +143,9 @@ def make_compaction_eval(operations=None):
 COMPACT_CHUNK_ROWS = 1 << 18  # 256k records per stacked program
 
 
-def choose_eval_device():
-    """Adaptive placement for bulk compaction eval.
-
-    Compaction must move every key byte host->device and the masks back;
-    on a co-located accelerator that is nearly free, but behind a
-    high-latency tunnel the movement dwarfs the compute. Probe the link
-    once per process (one tiny round-trip, measured) and place the eval
-    program on the accelerator only when the round-trip is fast enough
-    to amortize; otherwise the SAME jitted program runs on the host XLA
-    backend. Returns a jax.Device or None (= ambient default)."""
-    global _EVAL_DEVICE_CHOICE
-    try:
-        return _EVAL_DEVICE_CHOICE
-    except NameError:
-        pass
-    import time
-
-    import jax as _jax
-
-    choice = None
-    try:
-        default = jnp.zeros(1).devices().pop()
-        if default.platform != "cpu":
-            x = np.zeros(1024, dtype=np.uint8)
-            _jax.device_put(x, default)  # warm any lazy session setup
-            t0 = time.perf_counter()
-            np.asarray(_jax.device_put(x, default))
-            rtt = time.perf_counter() - t0
-            if rtt > 0.005:  # >5ms round-trip: movement-bound link
-                cpus = _jax.local_devices(backend="cpu")
-                choice = cpus[0] if cpus else None
-    except Exception:  # noqa: BLE001 - probe failure = keep default
-        choice = None
-    _EVAL_DEVICE_CHOICE = choice
-    return choice
+# compaction must move every key byte host->device and the masks back,
+# so eval placement is decided by the shared link probe
+from pegasus_tpu.ops.placement import choose_eval_device  # noqa: F401 (re-export)
 
 
 def compaction_eval_stacked(blocks, now, default_ttl, partition_version,

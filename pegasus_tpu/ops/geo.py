@@ -36,7 +36,17 @@ def radius_filter(lats: np.ndarray, lngs: np.ndarray,
                   radius_m: float, valid=None):
     """(keep_mask, distances_m) for a candidate batch. Arrays are padded
     to a power-of-two bucket so repeated searches reuse one compiled
-    program (the same static-shape discipline as the scan kernels)."""
+    program (the same static-shape discipline as the scan kernels).
+
+    Every search moves its whole candidate batch to the eval device and
+    the mask back, so placement follows the shared link probe
+    (ops/placement.py): co-located accelerators run it on-chip; behind a
+    high-latency tunnel the same program runs on the host XLA backend
+    instead of paying two link round-trips per query."""
+    import contextlib
+
+    from pegasus_tpu.ops.placement import choose_eval_device
+
     n = len(lats)
     if n == 0:
         return np.zeros(0, dtype=bool), np.zeros(0, dtype=np.float64)
@@ -47,8 +57,13 @@ def radius_filter(lats: np.ndarray, lngs: np.ndarray,
     la[:n] = lats
     lo[:n] = lngs
     va[:n] = True if valid is None else valid
-    keep, dist = _haversine_mask(
-        jnp.asarray(la), jnp.asarray(lo), jnp.asarray(va),
-        jnp.float32(center_lat), jnp.float32(center_lng),
-        jnp.float32(radius_m))
-    return np.asarray(keep)[:n], np.asarray(dist)[:n]
+    dev = choose_eval_device()
+    ctx = contextlib.nullcontext()
+    if dev is not None:
+        ctx = jax.default_device(dev)
+    with ctx:
+        keep, dist = _haversine_mask(
+            jnp.asarray(la), jnp.asarray(lo), jnp.asarray(va),
+            jnp.float32(center_lat), jnp.float32(center_lng),
+            jnp.float32(radius_m))
+        return np.asarray(keep)[:n], np.asarray(dist)[:n]

@@ -1,0 +1,59 @@
+"""Adaptive device placement for data-movement-bound programs.
+
+Serving scans keep their inputs DEVICE-RESIDENT (uploaded once, masks
+cached), so accelerator latency never sits on the steady-state path.
+But some programs must move their whole input per call — compaction
+filters (every key byte), geo distance batches (fresh candidates per
+search). On a co-located accelerator that movement is nearly free; on a
+high-latency tunnel it dwarfs the compute. These programs therefore ask
+`choose_eval_device()` once per process: a measured round-trip probe
+decides whether they run on the ambient accelerator or on the host XLA
+backend — the SAME jitted code either way (jax.default_device does the
+placement; nothing is duplicated).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+_EVAL_DEVICE_CHOICE: object = ...  # ... = unprobed (None is a real answer)
+
+# round-trips slower than this mean the link, not the compute, would
+# dominate any per-call data-movement-bound program
+LINK_RTT_BUDGET_S = 0.005
+
+
+def choose_eval_device():
+    """jax.Device to place movement-bound programs on, or None to keep
+    the ambient default. Probes the accelerator link once per process
+    with one tiny measured round-trip."""
+    global _EVAL_DEVICE_CHOICE
+    if _EVAL_DEVICE_CHOICE is not ...:
+        return _EVAL_DEVICE_CHOICE
+    import time
+
+    import jax
+    import jax.numpy as jnp
+
+    choice = None
+    try:
+        default = jnp.zeros(1).devices().pop()
+        if default.platform != "cpu":
+            x = np.zeros(1024, dtype=np.uint8)
+            jax.device_put(x, default)  # warm any lazy session setup
+            t0 = time.perf_counter()
+            np.asarray(jax.device_put(x, default))
+            rtt = time.perf_counter() - t0
+            if rtt > LINK_RTT_BUDGET_S:
+                cpus = jax.local_devices(backend="cpu")
+                choice = cpus[0] if cpus else None
+    except Exception:  # noqa: BLE001 - probe failure = keep default
+        choice = None
+    _EVAL_DEVICE_CHOICE = choice
+    return choice
+
+
+def reset_probe() -> None:
+    """Forget the cached probe (tests / backend swaps)."""
+    global _EVAL_DEVICE_CHOICE
+    _EVAL_DEVICE_CHOICE = ...

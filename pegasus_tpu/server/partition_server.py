@@ -134,7 +134,8 @@ class PartitionServer:
         self.validate_partition_hash = (
             partition_count > 1 and (partition_count & (partition_count - 1)) == 0)
         self.data_version = data_version
-        self.engine = StorageEngine(data_dir, data_version=data_version)
+        self.engine = StorageEngine(data_dir, data_version=data_version,
+                                    values_carry_expire_header=True)
         self.write_service = WriteService(self.engine, data_version,
                                           cluster_id)
         self._write_lock = threading.Lock()  # single-writer invariant

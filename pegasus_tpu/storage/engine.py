@@ -43,10 +43,17 @@ class WriteBatchItem:
 
 class StorageEngine:
     def __init__(self, data_dir: str, data_version: int = 1,
-                 block_capacity: int = 1024) -> None:
+                 block_capacity: int = 1024,
+                 values_carry_expire_header: bool = False) -> None:
         self.data_dir = data_dir
         os.makedirs(data_dir, exist_ok=True)
         self.data_version = data_version
+        # the engine's expire_ts COLUMN is authoritative; values are
+        # opaque bytes here. The server layer stores pegasus-encoded
+        # values whose leading BE-u32 duplicates the TTL — it sets this
+        # flag so compaction TTL rewrites also patch the embedded header
+        # (keeping forensic readers of the raw value consistent).
+        self.values_carry_expire_header = values_carry_expire_header
         self.lsm = LSMStore(os.path.join(data_dir, "sst"),
                             block_capacity=block_capacity)
 
@@ -282,8 +289,9 @@ class StorageEngine:
                     drop, new_ets = got[(run, i)]
                     yield run, i, by_tag[(run, i)], drop, new_ets
 
-        self.lsm.bulk_compact_rewrite(results(), meta,
-                                      ttl_may_change=ttl_may_change)
+        self.lsm.bulk_compact_rewrite(
+            results(), meta, ttl_may_change=ttl_may_change,
+            patch_headers=self.values_carry_expire_header)
 
     def manual_compact(self, default_ttl: int = 0, pidx: int = 0,
                        partition_version: int = -1,
@@ -358,11 +366,14 @@ class StorageEngine:
             return drop, new_ets[:n]
 
         self._compact_with_epilogue(
-            lambda: self.lsm.compact(record_filter=record_filter, meta={
-                "last_flushed_decree": self.last_committed_decree,
-                "data_version": self.data_version,
-                "manual_compact_finish_time": epoch_now(),
-            }))
+            lambda: self.lsm.compact(
+                record_filter=record_filter,
+                patch_headers=self.values_carry_expire_header,
+                meta={
+                    "last_flushed_decree": self.last_committed_decree,
+                    "data_version": self.data_version,
+                    "manual_compact_finish_time": epoch_now(),
+                }))
 
     def _compact_with_epilogue(self, body) -> None:
         """Shared post-compaction bookkeeping for both compaction paths:

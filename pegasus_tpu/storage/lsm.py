@@ -254,6 +254,7 @@ class LSMStore:
         self,
         record_filter: Optional[Callable[..., np.ndarray]] = None,
         meta: Optional[dict] = None,
+        patch_headers: bool = False,
     ) -> None:
         """Full compaction as a sequence of BOUNDED range steps.
 
@@ -278,14 +279,23 @@ class LSMStore:
                                  block_capacity=self._block_capacity,
                                  meta=meta)
 
-        def write_records(keys, vals, drop, new_ets) -> None:
+        def write_records(keys, vals, ets_orig, drop, new_ets) -> None:
             nonlocal writer, written_in_run
+            from pegasus_tpu.base.value_schema import update_expire_ts
+
             for i, k in enumerate(keys):
                 if drop is not None and drop[i]:
                     continue
                 if writer is None:
                     writer = open_writer()
-                writer.add(k, vals[i], int(new_ets[i]))
+                ne = int(new_ets[i])
+                v = vals[i]
+                if patch_headers and ne != ets_orig[i]:
+                    # a TTL rewrite must reach the encoded value header
+                    # too, or readers of the raw header see the old TTL
+                    # (the bulk path patches it the same way)
+                    v = update_expire_ts(1, v, ne)
+                writer.add(k, v, ne)
                 written_in_run += 1
                 if written_in_run >= self._l1_run_capacity:
                     writer.finish()
@@ -298,19 +308,19 @@ class LSMStore:
 
         def submit(keys, vals, ets):
             if record_filter is None:
-                return (keys, vals, None, ets)
+                return (keys, vals, ets, None, ets)
             drop, new_ets = record_filter(keys, ets)
             # jax returns asynchronously-evaluated arrays; conversion to
             # numpy in drain() is the synchronization point
-            return (keys, vals, drop, new_ets)
+            return (keys, vals, ets, drop, new_ets)
 
         def drain(entry) -> None:
-            keys, vals, drop, new_ets = entry
+            keys, vals, ets_orig, drop, new_ets = entry
             if drop is not None:
                 # materialize = the device synchronization point
                 drop = np.asarray(drop)
                 new_ets = np.asarray(new_ets)
-            write_records(keys, vals, drop, new_ets)
+            write_records(keys, vals, ets_orig, drop, new_ets)
 
         batch_keys: List[bytes] = []
         batch_vals: List[bytes] = []
@@ -342,13 +352,23 @@ class LSMStore:
             writer.finish()
             new_runs.append(SSTable(writer.path))
 
-        # publish: manifest first (atomic), then remove inputs — boot
-        # cleans up either crash window
+        self._publish_l1(new_runs, reset_overlay=True)
+
+    def _publish_l1(self, new_runs: List[SSTable],
+                    reset_overlay: bool) -> None:
+        """Swap in a freshly-compacted L1: manifest first (atomic), then
+        remove inputs — boot cleans up either crash window. Both
+        compaction paths share this so the crash-safety ordering lives
+        in exactly one place. `reset_overlay` also clears memtable+L0
+        (merge compaction consumed them; the bulk path never touches
+        them)."""
         self._write_manifest([os.path.basename(t.path) for t in new_runs])
-        old_l0, old_runs = self.l0, self.l1_runs
+        old_runs = self.l1_runs
         self.l1_runs = new_runs
-        self.l0 = []
-        self.memtable = Memtable()
+        old_l0: List[SSTable] = []
+        if reset_overlay:
+            old_l0, self.l0 = self.l0, []
+            self.memtable = Memtable()
         for t in old_l0:
             t.close()
             os.remove(t.path)
@@ -377,15 +397,18 @@ class LSMStore:
         return out
 
     def bulk_compact_rewrite(self, per_block, meta,
-                             ttl_may_change: bool) -> None:
+                             ttl_may_change: bool,
+                             patch_headers: bool = False) -> None:
         """Rewrite the L1 level from precomputed per-block filter results.
 
         `per_block`: [(run, idx, blk, drop, new_ets)] in key order (drop
         / new_ets sized to the block's real count). Untouched blocks are
-        copied VERBATIM (no decode/re-encode/crc); touched blocks are
-        rebuilt with numpy gathers — the value heap survivor bytes via
-        one boolean-repeat mask, expire_ts headers patched with scatter
-        stores — so no per-record Python runs at any drop rate."""
+        re-serialized straight from their already-decoded columns (no
+        gather, no crc recompute, no second disk read); touched blocks
+        are rebuilt with numpy gathers — the value heap survivor bytes
+        via one boolean-repeat mask, expire_ts headers patched with
+        scatter stores — so no per-record Python runs at any drop
+        rate."""
         from pegasus_tpu.storage.sstable import SSTable, SSTableWriter
 
         new_runs: List[SSTable] = []
@@ -405,21 +428,24 @@ class LSMStore:
                                        meta=meta)
             return writer
 
+        def copy_block(blk) -> None:
+            nonlocal written_in_run
+            w = roll_writer()
+            w.add_block_columnar(blk.keys, blk.key_len, blk.expire_ts,
+                                 blk.hash_lo, blk.flags, blk.value_offs,
+                                 blk.value_heap)
+            written_in_run += blk.count
+
         for run, idx, blk, drop, new_ets in per_block:
-            bm = run.blocks[idx]
             dropped = bool(drop.any())
             if not dropped and not ttl_may_change:
-                w = roll_writer()
-                w.add_raw_block(run.read_raw_block(idx), bm)
-                written_in_run += bm.count
+                copy_block(blk)
                 continue
             n = blk.count
             ets_changed = (ttl_may_change
                            and not np.array_equal(new_ets, blk.expire_ts))
             if not dropped and not ets_changed:
-                w = roll_writer()
-                w.add_raw_block(run.read_raw_block(idx), bm)
-                written_in_run += bm.count
+                copy_block(blk)
                 continue
             keep = ~drop
             if blk.flags is not None:
@@ -431,7 +457,7 @@ class LSMStore:
             lens = vo[1:] - vo[:-1]
             heap_arr = np.frombuffer(blk.value_heap, dtype=np.uint8)
             ets_col = new_ets if ets_changed else blk.expire_ts
-            if ets_changed:
+            if ets_changed and patch_headers:
                 # patch the big-endian u32 expire_ts value header in
                 # place (vectorized scatter, value_schema.h: header
                 # starts every encoded value)
@@ -468,16 +494,9 @@ class LSMStore:
         if writer is not None:
             writer.finish()
             new_runs.append(SSTable(writer.path))
-
-        # publish exactly like compact(): manifest first (atomic), then
-        # remove inputs. memtable/L0 are untouched by construction
-        # (bulk_compact_eligible requires them empty).
-        self._write_manifest([os.path.basename(t.path) for t in new_runs])
-        old_runs = self.l1_runs
-        self.l1_runs = new_runs
-        for t in old_runs:
-            t.close()
-            os.remove(t.path)
+        # memtable/L0 are untouched by construction
+        # (bulk_compact_eligible requires them empty)
+        self._publish_l1(new_runs, reset_overlay=False)
 
 
 class _HeapEntry:

@@ -179,22 +179,6 @@ class SSTableWriter:
             offset=offset, size=self._f.tell() - offset, count=n,
             key_width=width, first_key=recs[0][0], last_key=recs[-1][0]))
 
-    def add_raw_block(self, raw: bytes, bm: "BlockMeta") -> None:
-        """Append an UNMODIFIED block verbatim (bulk compaction's
-        untouched-block fast path: no decode, no re-encode, no crc
-        recompute — the block bytes are already exactly right)."""
-        self._flush_block()
-        if self._last_key is not None and bm.first_key <= self._last_key:
-            raise ValueError("blocks must be added in key order")
-        offset = self._f.tell()
-        self._f.write(raw)
-        self._blocks.append(BlockMeta(
-            offset=offset, size=len(raw), count=bm.count,
-            key_width=bm.key_width, first_key=bm.first_key,
-            last_key=bm.last_key))
-        self._count += bm.count
-        self._last_key = bm.last_key
-
     def add_block_columnar(self, keys: np.ndarray, key_len: np.ndarray,
                            ets: np.ndarray, hash_lo: np.ndarray,
                            flags: np.ndarray, value_offs: np.ndarray,
@@ -309,13 +293,6 @@ class SSTable:
     @property
     def last_key(self) -> Optional[bytes]:
         return self.blocks[-1].last_key if self.blocks else None
-
-    def read_raw_block(self, idx: int) -> bytes:
-        """The block's on-disk bytes, verbatim (bulk compaction's
-        untouched-block copy path)."""
-        bm = self.blocks[idx]
-        self._f.seek(bm.offset)
-        return self._f.read(bm.size)
 
     def read_block(self, idx: int) -> Block:
         blk = self._cache.get(idx)
