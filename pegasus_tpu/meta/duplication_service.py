@@ -125,6 +125,12 @@ class MetaDuplicationService:
         self._save()
         self._drive(dupid)
 
+    def list_all(self) -> List[dict]:
+        """Every duplication on the cluster (parity: shell `dups` —
+        the cluster-wide listing, vs query_dup's per-table view)."""
+        return [dict(info, dupid=dupid)
+                for dupid, info in sorted(self._dups.items())]
+
     def query_duplication(self, app_name: str) -> List[dict]:
         app = self.meta.state.find_app(app_name)
         if app is None:

@@ -54,6 +54,11 @@ class MetaService:
         self.election = MetaElection(self, list(peers or [name]),
                                      self.storage)
         self.fd = FailureDetector(on_worker_dead=self._on_node_dead)
+        # latest stored-replica report per node (config_sync payloads):
+        # the `recover` verb rebuilds lost app state from these — the
+        # replicas are the recovery source of truth (parity: shell
+        # `recover` from replica list, commands.h:209)
+        self._stored_reports: Dict[str, list] = {}
         # in-flight learner adds: gpid -> (learner, started_at); prevents
         # every guardian tick from restarting a slow learn from scratch
         self._pending_learns: Dict[Gpid, Tuple[str, float]] = {}
@@ -390,6 +395,13 @@ class MetaService:
                 result = self.cluster_info()
             elif cmd == "ddd_diagnose":
                 result = self.ddd_diagnose()
+            elif cmd == "recover":
+                result = self.recover_from_reports()
+            elif cmd == "list_dups":
+                result = self.duplication.list_all()
+            elif cmd == "query_restore_status":
+                result = self.query_restore_status(
+                    args.get("app_name", ""))
             elif cmd == "propose":
                 result = self.propose(args["app_name"], args["pidx"],
                                       args["action"], args["node"],
@@ -460,6 +472,7 @@ class MetaService:
         dropped-recall window) are listed — a replica missing from its
         partition's member list may be an in-flight learner."""
         node = payload["node"]
+        self._stored_reports[node] = list(payload.get("stored", []))
         # recovery adoption: a replica holding a HIGHER ballot than our
         # state knows (e.g. updates lost across a leader change) is the
         # truth — adopt its view before answering
@@ -485,12 +498,17 @@ class MetaService:
                         "envs": dict(app.envs),
                     })
         gc = []
-        for entry in payload.get("stored", []):
-            app_id = tuple(entry["gpid"])[0]
-            # dropped apps stay in state (recall window) — only replicas
-            # of apps unknown to meta entirely are garbage
-            if app_id not in self.state.apps:
-                gc.append(tuple(entry["gpid"]))
+        # freezed level suspends GC entirely: an operator recovering a
+        # meta that lost its state sets freezed FIRST, so replicas of
+        # apps this meta does not know yet are never deleted before
+        # `recover` can adopt them
+        if self.function_level != "freezed":
+            for entry in payload.get("stored", []):
+                app_id = tuple(entry["gpid"])[0]
+                # dropped apps stay in state (recall window) — only
+                # replicas of apps unknown to meta entirely are garbage
+                if app_id not in self.state.apps:
+                    gc.append(tuple(entry["gpid"]))
         self.net.send(self.name, src, "config_sync_reply", {
             "configs": configs, "gc": gc})
 
@@ -684,6 +702,70 @@ class MetaService:
             "partition_count": sum(a.partition_count for a in apps),
             "state_seq": self.storage.seq,
         }
+
+    def query_restore_status(self, app_name: str = "") -> List[dict]:
+        """Restore progress per pending partition (parity: shell
+        query_restore_status): which partitions of a
+        created-from-backup app are still downloading their
+        checkpoint."""
+        want_id = None
+        if app_name:
+            app = self.state.find_app(app_name)
+            if app is None:
+                raise PegasusError(ErrorCode.ERR_APP_NOT_EXIST, app_name)
+            want_id = app.app_id
+        out = []
+        for gpid, info in sorted(self.pending_restores.items()):
+            if want_id is not None and gpid[0] != want_id:
+                continue
+            out.append({"gpid": list(gpid), "status": "restoring",
+                        **{k: info[k] for k in ("policy", "backup_id")
+                           if k in info}})
+        return out
+
+    def recover_from_reports(self) -> dict:
+        """Rebuild app state for replicas this meta does not know
+        (parity: shell `recover` from replica list, commands.h:209 —
+        used after total meta-state loss). For each unknown app_id in
+        the nodes' config-sync reports, recreate the app (named
+        recovered_<id>; rename_app afterwards) adopting each partition's
+        HIGHEST-ballot reported config. Run under `freezed` level so
+        config-sync GC cannot delete the orphans first."""
+        by_app: Dict[int, Dict[int, dict]] = {}
+        for _node, stored in self._stored_reports.items():
+            for entry in stored:
+                gpid = tuple(entry["gpid"])
+                if gpid[0] in self.state.apps or "ballot" not in entry:
+                    continue
+                cur = by_app.setdefault(gpid[0], {}).get(gpid[1])
+                if cur is None or entry["ballot"] > cur["ballot"]:
+                    by_app[gpid[0]][gpid[1]] = entry
+        created = []
+        for app_id in sorted(by_app):
+            parts = by_app[app_id]
+            partition_count = max(
+                int(e.get("partition_count") or 0)
+                for e in parts.values()) or (max(parts) + 1)
+            app = AppState(app_id, f"recovered_{app_id}",
+                           partition_count, AS_AVAILABLE, {}, 3)
+            configs = []
+            for pidx in range(partition_count):
+                e = parts.get(pidx)
+                if e is None:
+                    # no survivor reported this partition: leave it
+                    # empty for ddd_diagnose / propose to resolve
+                    configs.append(PartitionConfig(ballot=0, primary="",
+                                                   secondaries=[]))
+                else:
+                    configs.append(PartitionConfig(
+                        ballot=e["ballot"], primary=e.get("primary", ""),
+                        secondaries=list(e.get("secondaries") or [])))
+            self.state.put_app(app, configs)
+            created.append({"app_id": app_id, "app_name": app.app_name,
+                            "partition_count": partition_count,
+                            "recovered_partitions": len(parts)})
+        return {"created": created,
+                "nodes_reporting": sorted(self._stored_reports)}
 
     def ddd_diagnose(self) -> List[dict]:
         """Parity: shell ddd_diagnose (DDD = 'double-dead diagnosis',

@@ -24,9 +24,6 @@ import jax.numpy as jnp
 
 from pegasus_tpu.base.crc import TABLE64_HI_NP, TABLE64_LO_NP
 
-_TABLE64_HI = jnp.asarray(TABLE64_HI_NP)
-_TABLE64_LO = jnp.asarray(TABLE64_LO_NP)
-
 
 def crc64_device(data: jax.Array, lengths: jax.Array,
                  start: jax.Array | int = 0) -> tuple[jax.Array, jax.Array]:
@@ -37,6 +34,13 @@ def crc64_device(data: jax.Array, lengths: jax.Array,
     start:   int32[B] or scalar — region start offset
     Returns (hi, lo): uint32[B] lanes of the 64-bit CRC.
     """
+    # materialized per call, NOT at module scope: importing the library
+    # must never initialize a jax backend (an admin CLI on a TPU-tunnel
+    # image would dial the chip just by importing). Under jit these
+    # become compile-time constants; the rare un-jitted call pays a
+    # 64KB transfer.
+    table_hi = jnp.asarray(TABLE64_HI_NP)
+    table_lo = jnp.asarray(TABLE64_LO_NP)
     b, k = data.shape
     data32 = data.astype(jnp.uint32)
     starts = jnp.broadcast_to(jnp.asarray(start, jnp.int32), (b,))
@@ -49,8 +53,8 @@ def crc64_device(data: jax.Array, lengths: jax.Array,
         byte = jnp.take_along_axis(data32, pos[:, None].astype(jnp.int32),
                                    axis=1)[:, 0]
         idx = ((lo ^ byte) & jnp.uint32(0xFF)).astype(jnp.int32)
-        nhi = (hi >> 8) ^ _TABLE64_HI[idx]
-        nlo = ((lo >> 8) | (hi << 24)) ^ _TABLE64_LO[idx]
+        nhi = (hi >> 8) ^ table_hi[idx]
+        nlo = ((lo >> 8) | (hi << 24)) ^ table_lo[idx]
         active = j < lengths
         return jnp.where(active, nhi, hi), jnp.where(active, nlo, lo)
 

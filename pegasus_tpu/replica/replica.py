@@ -328,8 +328,14 @@ class Replica:
         decree = self.last_prepared_decree() + 1
         ts = max(int(self.clock() * 1_000_000), self._last_timestamp_us + 1)
         idem_responses = None
-        if self.duplicators and any(wo.op in (OP_INCR, OP_CAS, OP_CAM)
-                                    for wo in ops):
+        # forced translation (parity: the atomic-idempotent toggle,
+        # enable/disable/get_atomic_idempotent): the app-env makes atomic
+        # ops ship as concrete puts even without active duplication
+        force_idem = (self.server.app_envs.get(
+            "replica.atomic_idempotent") == "true")
+        if ((self.duplicators or force_idem)
+                and any(wo.op in (OP_INCR, OP_CAS, OP_CAM)
+                        for wo in ops)):
             # idempotent translation (parity: make_idempotent,
             # replica_2pc.cpp:283 + idempotent_writer.h): a duplicated
             # table must log atomic ops as the CONCRETE puts they

@@ -42,7 +42,51 @@ def _b(s: str) -> bytes:
     return s.encode()
 
 
+_ESCAPE_ALL = False  # REPL `escape_all` setting (parity: shell escape_all)
+
+
+def _s(b: bytes) -> str:
+    """Render bytes for output: UTF-8 with replacement, or fully
+    C-escaped when the REPL's escape_all setting is on (parity:
+    c_escape_sensitive_string in base/pegasus_utils.h)."""
+    if _ESCAPE_ALL:
+        return "".join(chr(c) if 32 <= c < 127 else "\\x%02x" % c
+                       for c in b)
+    return b.decode(errors="replace")
+
+
+# reference verb spellings -> canonical verbs (argparse keeps the ALIAS
+# in args.cmd, so dispatch normalizes through this map)
+_CANONICAL = {
+    "create": "create_app", "drop": "drop_app", "recall": "recall_app",
+    "balance": "rebalance", "query_bulk_load_status": "query_bulk_load",
+    "local_partition_split": "partition_split",
+}
+
+
+def _isolate_cpu() -> None:
+    """Admin/data CLI work never needs the accelerator: force the CPU
+    backend BEFORE any jax init so the shell neither dials a TPU tunnel
+    (this image's axon plugin dials even under JAX_PLATFORMS=cpu) nor
+    claims a chip another process is using. PEGASUS_SHELL_DEVICE=accel
+    opts back in."""
+    import os
+
+    if os.environ.get("PEGASUS_SHELL_DEVICE") == "accel":
+        return
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    try:
+        import jax
+        import jax._src.xla_bridge as _xb
+
+        jax.config.update("jax_platforms", "cpu")
+        _xb._backend_factories.pop("axon", None)
+    except Exception:  # noqa: BLE001 - jax-free verbs still work
+        pass
+
+
 def main(argv=None) -> int:
+    _isolate_cpu()
     parser = argparse.ArgumentParser(prog="pegasus-shell",
                                      description=__doc__)
     parser.add_argument("--root", default=None,
@@ -57,10 +101,10 @@ def main(argv=None) -> int:
                              "is given on an interactive terminal)")
     sub = parser.add_subparsers(dest="cmd", required=False)
 
-    p = sub.add_parser("create_app")
+    p = sub.add_parser("create_app", aliases=["create"])
     p.add_argument("name")
     p.add_argument("-p", "--partition_count", type=int, default=8)
-    p = sub.add_parser("drop_app")
+    p = sub.add_parser("drop_app", aliases=["drop"])
     p.add_argument("name")
     sub.add_parser("ls")
     p = sub.add_parser("app")
@@ -175,7 +219,7 @@ def main(argv=None) -> int:
     p.add_argument("table")
     p = sub.add_parser("manual_compact")
     p.add_argument("table")
-    p = sub.add_parser("partition_split")
+    p = sub.add_parser("partition_split", aliases=["local_partition_split"])
     p.add_argument("table")
     p = sub.add_parser("flush")
     p.add_argument("table")
@@ -210,7 +254,7 @@ def main(argv=None) -> int:
     p.add_argument("table")
     p.add_argument("--bucket", required=True)
     p.add_argument("--staged_app", default=None)
-    p = sub.add_parser("query_bulk_load")
+    p = sub.add_parser("query_bulk_load", aliases=["query_bulk_load_status"])
     p.add_argument("table")
     p = sub.add_parser("add_dup")
     p.add_argument("table")
@@ -225,7 +269,7 @@ def main(argv=None) -> int:
     p = sub.add_parser("query_split")
     p.add_argument("table")
     p = sub.add_parser("nodes")
-    p = sub.add_parser("rebalance")
+    p = sub.add_parser("rebalance", aliases=["balance"])
     p = sub.add_parser("offline_node")
     p.add_argument("node", help="drain all primaries off this node")
     # offline debugging (parity: shell sst_dump / mlog_dump and
@@ -276,7 +320,7 @@ def main(argv=None) -> int:
                             "downgrade"])
     p.add_argument("node")
     p.add_argument("--force", action="store_true")
-    p = sub.add_parser("recall_app")
+    p = sub.add_parser("recall_app", aliases=["recall"])
     p.add_argument("table")
     p = sub.add_parser("rename")
     p.add_argument("old_name")
@@ -323,8 +367,17 @@ def main(argv=None) -> int:
     p.add_argument("table")
     p = sub.add_parser("flush_log")
     p.add_argument("node")
+    sub.add_parser("dups")
+    sub.add_parser("recover")
+    p = sub.add_parser("query_restore_status")
+    p.add_argument("table", nargs="?", default="")
+    for cmd in ("enable_atomic_idempotent", "disable_atomic_idempotent",
+                "get_atomic_idempotent"):
+        p = sub.add_parser(cmd)
+        p.add_argument("table")
 
     args = parser.parse_args(argv)
+    args.cmd = _CANONICAL.get(args.cmd, args.cmd)
 
     if args.cmd in ("sst_dump", "mlog_dump", "local_get"):
         return _offline_dump(args, sys.stdout)
@@ -375,7 +428,8 @@ _TABLE_VERBS = frozenset({
     "multi_get_sortkeys", "hash_scan", "full_scan", "count_data",
     "clear_data", "hash", "set_app_envs", "get_app_envs",
     "manual_compact", "partition_split", "flush", "app_stat",
-    "app_disk", "get_replica_count",
+    "app_disk", "get_replica_count", "enable_atomic_idempotent",
+    "disable_atomic_idempotent", "get_atomic_idempotent",
 })
 
 
@@ -409,7 +463,8 @@ def _repl(parser, box, out) -> int:
             continue
         if not words:
             continue
-        verb = words[0]
+        verb = _CANONICAL.get(words[0], words[0])
+        words[0] = verb
         if verb in ("exit", "quit"):
             return 0
         if verb == "use":
@@ -422,15 +477,58 @@ def _repl(parser, box, out) -> int:
         if verb == "version":
             print(pegasus_tpu.__version__, file=out)
             continue
+        if verb == "mycluster":
+            print(getattr(box, "root", None) or getattr(box, "path", "?"),
+                  file=out)
+            continue
+        if verb == "cc":
+            # switch cluster (parity: shell cc — change cluster): point
+            # the session at another onebox catalog / cluster dir
+            if len(words) != 2:
+                print("usage: cc <onebox-dir>", file=out)
+                continue
+            try:
+                new_box = type(box)(words[1])
+            except Exception as exc:  # noqa: BLE001 - operator feedback
+                print(f"error: {exc}", file=out)
+                continue
+            box.close()
+            box = new_box
+            current_table = None
+            print(f"OK: now on {words[1]}", file=out)
+            continue
+        if verb == "timeout":
+            # REPL setting (parity: shell `timeout`): admin RPC deadline
+            if len(words) == 1:
+                print(f"{getattr(box, 'admin_timeout', 15.0)}s", file=out)
+                continue
+            try:
+                box.admin_timeout = float(words[1])
+            except ValueError:
+                print("usage: timeout [seconds]", file=out)
+                continue
+            print("OK", file=out)
+            continue
+        if verb == "escape_all":
+            # REPL setting (parity: shell escape_all): escape every
+            # non-printable byte in printed values, not just invalid
+            # UTF-8
+            global _ESCAPE_ALL
+            if len(words) == 2 and words[1] in ("true", "false"):
+                _ESCAPE_ALL = words[1] == "true"
+            print("escape_all: %s" % str(_ESCAPE_ALL).lower(), file=out)
+            continue
         if verb == "help":
             choices = parser._subparsers._group_actions[0].choices
             print("  ".join(sorted(choices)) +
-                  "\n  plus: use <table>, version, exit", file=out)
+                  "\n  plus: use <table>, cc <dir>, mycluster, timeout, "
+                  "escape_all, version, exit", file=out)
             continue
         if verb in _TABLE_VERBS and current_table is not None:
             words = [verb, current_table] + words[1:]
         try:
             cmd_args = parser.parse_args(words)
+            cmd_args.cmd = _CANONICAL.get(cmd_args.cmd, cmd_args.cmd)
         except SystemExit:
             continue  # argparse already printed the usage error
         try:
@@ -543,7 +641,7 @@ def _offline_dump_body(args, out, restore_key, extract_user_data) -> int:
                 print("DELETED (tombstone)", file=out)
                 return 1
             data = extract_user_data(1, value)
-            print(f"{data.decode(errors='replace')} (ets={ets}, "
+            print(f"{_s(data)} (ets={ets}, "
                   f"from {os.path.basename(path)})", file=out)
             return 0
         print("not found", file=out)
@@ -746,7 +844,7 @@ def _dispatch(args, box, out) -> int:
         if err == int(StorageStatus.NOT_FOUND):
             print("not found", file=out)
             return 1
-        print(value.decode(errors="replace"), file=out)
+        print(_s(value), file=out)
     elif args.cmd == "del":
         c = box.client(args.table)
         err = c.delete(_b(args.hash_key), _b(args.sort_key))
@@ -787,8 +885,8 @@ def _dispatch(args, box, out) -> int:
             print(f"error {err}", file=out)
             return 1
         for k, v in sorted(kvs.items()):
-            print(f"{k.decode(errors='replace')} : "
-                  f"{v.decode(errors='replace')}", file=out)
+            print(f"{_s(k)} : "
+                  f"{_s(v)}", file=out)
         print(f"{len(kvs)} record(s)", file=out)
     elif args.cmd == "count":
         c = box.client(args.table)
@@ -807,9 +905,9 @@ def _dispatch(args, box, out) -> int:
         n = 0
         for sc in c.get_unordered_scanners(1, opts):
             for hk, sk, v in sc:
-                print(f"{hk.decode(errors='replace')} : "
-                      f"{sk.decode(errors='replace')} => "
-                      f"{v.decode(errors='replace')}", file=out)
+                print(f"{_s(hk)} : "
+                      f"{_s(sk)} => "
+                      f"{_s(v)}", file=out)
                 n += 1
                 if n >= args.max:
                     break
@@ -835,7 +933,7 @@ def _dispatch(args, box, out) -> int:
               file=out)
         if resp.check_value_returned:
             print(f"check value: "
-                  f"{resp.check_value.decode(errors='replace')}",
+                  f"{_s(resp.check_value)}",
                   file=out)
     elif args.cmd == "check_and_mutate":
         from pegasus_tpu.server.types import Mutate, MutateOperation
@@ -910,8 +1008,8 @@ def _dispatch(args, box, out) -> int:
             print(f"error {err}", file=out)
             return 1
         for k, v in sorted(kvs.items()):
-            print(f"{k.decode(errors='replace')} : "
-                  f"{v.decode(errors='replace')}", file=out)
+            print(f"{_s(k)} : "
+                  f"{_s(v)}", file=out)
         print(f"{len(kvs)} record(s)"
               + (" (truncated — narrow the range or raise --max)"
                  if incomplete else ""), file=out)
@@ -922,7 +1020,7 @@ def _dispatch(args, box, out) -> int:
             print(f"error {err}", file=out)
             return 1
         for sk in sks:
-            print(sk.decode(errors="replace"), file=out)
+            print(_s(sk), file=out)
         print(f"{len(sks)} sort key(s)", file=out)
     elif args.cmd == "hash_scan":
         c = box.client(args.table)
@@ -930,8 +1028,8 @@ def _dispatch(args, box, out) -> int:
                            _b(args.stop))
         n = 0
         for hk, sk, v in sc:
-            print(f"{sk.decode(errors='replace')} => "
-                  f"{v.decode(errors='replace')}", file=out)
+            print(f"{_s(sk)} => "
+                  f"{_s(v)}", file=out)
             n += 1
             if n >= args.max:
                 sc.close()
@@ -940,9 +1038,9 @@ def _dispatch(args, box, out) -> int:
     elif args.cmd == "full_scan":
         n = 0
         for hk, sk, v in _full_scan_records(box, args.table, args.max):
-            print(f"{hk.decode(errors='replace')} : "
-                  f"{sk.decode(errors='replace')} => "
-                  f"{v.decode(errors='replace')}", file=out)
+            print(f"{_s(hk)} : "
+                  f"{_s(sk)} => "
+                  f"{_s(v)}", file=out)
             n += 1
         print(f"{n} record(s)", file=out)
     elif args.cmd == "count_data":
@@ -1019,8 +1117,8 @@ def _dispatch(args, box, out) -> int:
     elif args.cmd == "rdb_key_hex2str":
         from pegasus_tpu.base.key_schema import restore_key
         hk, sk = restore_key(bytes.fromhex(args.hex_key))
-        print(f"hash_key: {hk.decode(errors='replace')}", file=out)
-        print(f"sort_key: {sk.decode(errors='replace')}", file=out)
+        print(f"hash_key: {_s(hk)}", file=out)
+        print(f"sort_key: {_s(sk)}", file=out)
     elif args.cmd == "rdb_value_hex2str":
         from pegasus_tpu.base.value_schema import (
             extract_expire_ts, extract_user_data)
@@ -1092,6 +1190,23 @@ def _dispatch(args, box, out) -> int:
     elif args.cmd == "remove_dup":
         box.admin.call("remove_dup", dupid=args.dupid)
         print("OK", file=out)
+    elif args.cmd == "dups":
+        print(json.dumps(box.admin.call("list_dups")), file=out)
+    elif args.cmd == "recover":
+        print(json.dumps(box.admin.call("recover")), file=out)
+    elif args.cmd == "query_restore_status":
+        print(json.dumps(box.admin.call("query_restore_status",
+                                        app_name=args.table)), file=out)
+    elif args.cmd in ("enable_atomic_idempotent",
+                      "disable_atomic_idempotent"):
+        val = "true" if args.cmd.startswith("enable") else "false"
+        box.update_app_envs(args.table,
+                            {"replica.atomic_idempotent": val})
+        print("OK", file=out)
+    elif args.cmd == "get_atomic_idempotent":
+        t = box.open_table(args.table)
+        envs = t.partitions[0].app_envs
+        print(envs.get("replica.atomic_idempotent", "false"), file=out)
     elif args.cmd == "start_split":
         n = box.admin.call("start_partition_split", app_name=args.table)
         print(f"OK: splitting to {n} partitions", file=out)

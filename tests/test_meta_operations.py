@@ -381,3 +381,60 @@ def test_duplicated_atomic_ops_ship_idempotently(cluster):
     assert fc.get(b"cas", b"k") == (OK, b"first")
     # and the master itself reads its own atomic results
     assert c.get(b"cnt", b"x") == (OK, b"42")
+
+
+def test_recover_rebuilds_lost_apps_and_freezed_blocks_gc(cluster):
+    """Parity: shell `recover` from replica list (commands.h:209) +
+    meta_function_level gating. A meta that lost its state must not GC
+    the orphan replicas while freezed, and `recover` readopts them from
+    the nodes' config-sync reports."""
+    cluster.create_table("rt", partition_count=2, replica_count=2)
+    c = cluster.client("rt")
+    _fill(c, prefix=b"rc")
+    meta = cluster.meta
+    app = meta.state.find_app("rt")
+    app_id = app.app_id
+
+    # simulate total meta-state loss for this app
+    meta.set_meta_level("freezed")
+    del meta.state.apps[app_id]
+    meta.state.configs.pop(app_id, None)
+
+    # nodes report their stored replicas; freezed meta must NOT list
+    # them for garbage collection
+    def hosted():
+        return sum(1 for stub in cluster.stubs.values()
+                   for gpid in stub.replicas if gpid[0] == app_id)
+
+    before = hosted()
+    assert before > 0
+    for stub in cluster.stubs.values():
+        stub.config_sync()
+    cluster.loop.run_until_idle()
+    assert hosted() == before, \
+        "freezed meta must not GC unknown replicas"
+
+    res = meta.recover_from_reports()
+    assert [r["app_id"] for r in res["created"]] == [app_id]
+    meta.rename_app(f"recovered_{app_id}", "rt")
+    meta.set_meta_level("steady")
+    cluster.step(rounds=2)
+
+    c2 = cluster.client("rt", name="post-recover")
+    c2.refresh_config()
+    err, v = c2.get(b"rc001", b"s")
+    assert err == OK and v == b"v1"
+
+    # steady meta DOES gc replicas of apps that are truly gone
+    assert meta.function_level == "steady"
+
+
+def test_dups_lists_cluster_wide(cluster, tmp_path):
+    cluster.create_table("d1", partition_count=2)
+    cluster.create_table("d2", partition_count=2)
+    meta = cluster.meta
+    id1 = meta.duplication.add_duplication("d1", "meta-x", "f1")
+    id2 = meta.duplication.add_duplication("d2", "meta-x", "f2")
+    rows = meta.duplication.list_all()
+    assert {r["dupid"] for r in rows} == {id1, id2}
+    assert {r["follower_app"] for r in rows} == {"f1", "f2"}

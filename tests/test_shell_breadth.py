@@ -286,3 +286,47 @@ def test_admin_verbs_over_wire(tmp_path, capsys):
         assert code == 0 and "record(s)" in out
     finally:
         ob.stop(d)
+
+
+def test_reference_verb_aliases(root, capsys):
+    """The reference's verb spellings work: create/drop/recall/balance/
+    local_partition_split/query_bulk_load_status (commands.h names)."""
+    assert run(capsys, "--root", root, "create", "ali", "-p", "2")[0] == 0
+    assert run(capsys, "--root", root, "set", "ali", "h", "s", "v")[0] == 0
+    code, out = run(capsys, "--root", root, "local_partition_split", "ali")
+    assert code == 0
+    assert run(capsys, "--root", root, "drop", "ali")[0] == 0
+
+
+def test_atomic_idempotent_verbs(root, capsys):
+    code, out = run(capsys, "--root", root, "get_atomic_idempotent",
+                    "demo")
+    assert code == 0 and "false" in out
+    assert run(capsys, "--root", root, "enable_atomic_idempotent",
+               "demo")[0] == 0
+    code, out = run(capsys, "--root", root, "get_atomic_idempotent",
+                    "demo")
+    assert code == 0 and "true" in out
+    assert run(capsys, "--root", root, "disable_atomic_idempotent",
+               "demo")[0] == 0
+
+
+def test_repl_settings_and_cc(root, capsys, monkeypatch, tmp_path):
+    other = str(tmp_path / "box2")
+    assert shell_main(["--root", other, "create_app", "t2",
+                       "-p", "2"]) == 0
+    capsys.readouterr()
+    lines = iter(["mycluster", "timeout 30", "timeout",
+                  "escape_all true", "use demo",
+                  "set ek s ÿ-bin", "get ek s",
+                  "escape_all false",
+                  f"cc {other}", "mycluster", "use t2",
+                  "set a b c", "get a b", "exit"])
+    monkeypatch.setattr("builtins.input", lambda prompt="": next(lines))
+    assert shell_main(["--root", root, "-i"]) == 0
+    out = capsys.readouterr().out
+    assert "30.0s" in out
+    assert "escape_all: true" in out
+    assert "\\xc3\\xbf-bin" in out   # escaped utf-8 bytes of ÿ
+    assert other in out              # cc switched, mycluster shows it
+    assert "c" in out
