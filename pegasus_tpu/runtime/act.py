@@ -267,6 +267,29 @@ class ActRunner:
             c.meta.propose(c.meta.state.apps[self.app_id].app_name,
                            int(args[0]), args[1], args[2],
                            force="force" in args[3:])
+        elif verb == "wipe_meta_state":
+            # simulate total meta-state loss for the case's table (the
+            # `recover` scenario: replicas become the source of truth)
+            c.meta.state.apps.pop(self.app_id, None)
+            c.meta.state.configs.pop(self.app_id, None)
+        elif verb == "config_sync":
+            for stub in c.stubs.values():
+                if stub.name not in c._dead:
+                    stub.config_sync()
+            c.loop.run_until_idle()
+        elif verb == "recover":
+            res = c.meta.recover_from_reports()
+            if not res["created"]:
+                raise ActError(f"recover created nothing: {res}")
+        elif verb == "rename":
+            c.meta.rename_app(args[0], args[1])
+        elif verb == "expect_hosted_count":
+            # replicas of the case's table still hosted across the
+            # cluster (freezed GC protection assertion)
+            n = sum(1 for stub in c.stubs.values()
+                    for gpid in stub.replicas if gpid[0] == self.app_id)
+            if n != int(args[0]):
+                raise ActError(f"hosted {n} != expected {args[0]}")
         elif verb == "expect_consistent":
             from pegasus_tpu.base.key_schema import (
                 generate_key,
