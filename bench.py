@@ -25,7 +25,7 @@ device bring-up happens in a probe SUBPROCESS with bounded retries and
 backoff; on permanent failure the one JSON line is a structured error
 record rather than a traceback.
 
-Env knobs: PEGBENCH_RECORDS (default 100_000), PEGBENCH_OPS (default 1200),
+Env knobs: PEGBENCH_RECORDS (default 100_000), PEGBENCH_OPS (default 3000),
 PEGBENCH_PARTITIONS (default 64), PEGBENCH_SEED, PEGBENCH_COMPACT=0 /
 PEGBENCH_GEO=0 (skip those phases),
 PEGBENCH_SCAN_BATCH (default 32: scans coalesced per device dispatch —
@@ -202,7 +202,10 @@ def build_cluster(tmpdir, n_records, n_partitions, seed):
         for off in range(0, len(ops), 1000):
             r.client_write(ops[off:off + 1000])
         bc.cluster.loop.run_until_idle()
-    _log(f"loaded {i} records in {time.perf_counter() - t0:.1f}s")
+    load_s = time.perf_counter() - t0
+    bc.load_write_qps = round(i / load_s, 1)  # replicated write path rate
+    _log(f"loaded {i} records in {load_s:.1f}s "
+         f"({bc.load_write_qps:.0f} writes/s through 2PC)")
 
     t0 = time.perf_counter()
     bc.manual_compact_all()
@@ -519,7 +522,7 @@ def measure_geo(jax, device, n_points=20_000, n_searches=150, seed=11):
 
 def main() -> None:
     n_records = int(os.environ.get("PEGBENCH_RECORDS", 100_000))
-    n_ops = int(os.environ.get("PEGBENCH_OPS", 1200))
+    n_ops = int(os.environ.get("PEGBENCH_OPS", 3000))
     n_partitions = int(os.environ.get("PEGBENCH_PARTITIONS", 64))
     seed = int(os.environ.get("PEGBENCH_SEED", 7))
     probe_timeout = float(os.environ.get("PEGBENCH_PROBE_TIMEOUT", 180))
@@ -580,6 +583,10 @@ def main() -> None:
             cpu_qps = ops_c / cpu_s
             _log(f"cpu:   {ops_c} ops / {recs_c} records in {cpu_s:.2f}s "
                  f"-> {cpu_qps:.1f} ops/s")
+            details["phases"]["load_write"] = {
+                "write_qps_2pc": bc.load_write_qps,
+                "records": n_records,
+            }
             details["phases"]["scan"] = {
                 "accel_qps": round(accel_qps, 2),
                 "cpu_qps": round(cpu_qps, 2),
