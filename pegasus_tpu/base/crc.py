@@ -69,12 +69,38 @@ def crc64(data: bytes, init_crc: int = 0) -> int:
     return ~crc & _M64
 
 
-def crc32(data: bytes, init_crc: int = 0) -> int:
-    """Scalar crc32 (CRC-32C), parity: dsn::utils::crc32_calc."""
+def _crc32_py(data: bytes, init_crc: int = 0) -> int:
+    """Pure-Python CRC-32C (the spec twin the native path is pinned to)."""
     crc = ~init_crc & _M32
     for b in data:
         crc = _TABLE32[(crc ^ b) & 0xFF] ^ (crc >> 8)
     return ~crc & _M32
+
+
+_crc32_native = None
+_crc32_native_tried = False
+
+
+def crc32(data: bytes, init_crc: int = 0) -> int:
+    """Scalar crc32 (CRC-32C), parity: dsn::utils::crc32_calc.
+
+    Framing checksums (WAL frames, plog frames, SST index, wire
+    messages) run this over every payload byte — the Python table loop
+    is ~2 MB/s and dominated the replicated write path, so the C
+    implementation (native/packer.cpp, same polynomial spec, golden
+    vectors shared) takes over when the toolchain built it."""
+    global _crc32_native, _crc32_native_tried
+    if not _crc32_native_tried:
+        _crc32_native_tried = True
+        try:
+            from pegasus_tpu.native import crc32_fn
+
+            _crc32_native = crc32_fn()
+        except Exception:  # noqa: BLE001 - fall back to the Python loop
+            _crc32_native = None
+    if _crc32_native is not None:
+        return _crc32_native(data, init_crc)
+    return _crc32_py(data, init_crc)
 
 
 def crc64_batch(data: np.ndarray, lengths: np.ndarray,

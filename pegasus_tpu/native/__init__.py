@@ -50,18 +50,36 @@ def _load() -> Optional[ctypes.CDLL]:
                 os.path.getmtime(_SO) < os.path.getmtime(_SRC)):
             if not _build():
                 return None
-        try:
+        def bind():
             lib = ctypes.CDLL(_SO)
-        except OSError:
-            return None
-        lib.pegasus_crc64.restype = ctypes.c_uint64
-        lib.pegasus_crc64.argtypes = [ctypes.c_char_p, ctypes.c_int64]
-        lib.pegasus_pack_records.restype = ctypes.c_int32
-        lib.pegasus_pack_records.argtypes = [
-            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
-            ctypes.c_int64, ctypes.c_void_p, ctypes.c_void_p,
-            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p]
-        _lib = lib
+            lib.pegasus_crc64.restype = ctypes.c_uint64
+            lib.pegasus_crc64.argtypes = [ctypes.c_char_p, ctypes.c_int64]
+            lib.pegasus_crc32.restype = ctypes.c_uint32
+            lib.pegasus_crc32.argtypes = [ctypes.c_char_p, ctypes.c_int64,
+                                          ctypes.c_uint32]
+            lib.pegasus_pack_records.restype = ctypes.c_int32
+            lib.pegasus_pack_records.argtypes = [
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+                ctypes.c_int64, ctypes.c_void_p, ctypes.c_void_p,
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p]
+            return lib
+
+        try:
+            _lib = bind()
+        except (OSError, AttributeError):
+            # unloadable, or a STALE prebuilt .so missing a newer symbol
+            # (mtime-preserving restore tools defeat the rebuild check):
+            # one rebuild attempt, else degrade to the Python paths
+            try:
+                os.remove(_SO)
+            except OSError:
+                pass
+            if not _build():
+                return None
+            try:
+                _lib = bind()
+            except (OSError, AttributeError):
+                return None
         return _lib
 
 
@@ -74,6 +92,20 @@ def crc64_native(data: bytes) -> int:
     if lib is None:
         raise RuntimeError("native library unavailable")
     return int(lib.pegasus_crc64(data, len(data)))
+
+
+def crc32_fn():
+    """The CRC-32C buffer function, or None when the native library is
+    unavailable (base.crc falls back to its Python loop)."""
+    lib = _load()
+    if lib is None:
+        return None
+
+    def crc32_native(data: bytes, init_crc: int = 0) -> int:
+        return int(lib.pegasus_crc32(bytes(data), len(data),
+                                     init_crc & 0xFFFFFFFF))
+
+    return crc32_native
 
 
 def pack_records(keys, key_width: int):

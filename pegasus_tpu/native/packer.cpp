@@ -51,6 +51,34 @@ inline uint64_t crc64(const uint8_t* data, int64_t len, uint64_t init) {
   return ~crc;
 }
 
+struct Crc32cTable {
+  uint32_t entries[256];
+  Crc32cTable() {
+    // CRC-32C (Castagnoli), reflected poly 0x82F63B78 — derived from
+    // the polynomial spec, same construction as the Python twin
+    // (pegasus_tpu/base/crc.py); golden vectors pin equivalence.
+    for (uint32_t i = 0; i < 256; ++i) {
+      uint32_t k = i;
+      for (int j = 0; j < 8; ++j)
+        k = (k & 1) ? (k >> 1) ^ 0x82F63B78u : k >> 1;
+      entries[i] = k;
+    }
+  }
+};
+
+const Crc32cTable& table32() {
+  static const Crc32cTable t;
+  return t;
+}
+
+inline uint32_t crc32c(const uint8_t* data, int64_t len, uint32_t init) {
+  const Crc32cTable& t = table32();
+  uint32_t crc = ~init;
+  for (int64_t i = 0; i < len; ++i)
+    crc = t.entries[(crc ^ data[i]) & 0xFF] ^ (crc >> 8);
+  return ~crc;
+}
+
 }  // namespace
 
 extern "C" {
@@ -58,6 +86,12 @@ extern "C" {
 // Scalar crc64 (compatibility checks / tests).
 uint64_t pegasus_crc64(const uint8_t* data, int64_t len) {
   return crc64(data, len, 0);
+}
+
+// CRC-32C over a buffer — the WAL/SST/wire framing checksum hot loop
+// (the Python table loop runs ~2 MB/s; this runs at memory speed).
+uint32_t pegasus_crc32(const uint8_t* data, int64_t len, uint32_t init) {
+  return crc32c(data, len, init);
 }
 
 // Pack n encoded keys (concatenated in `heap`, row i spanning
