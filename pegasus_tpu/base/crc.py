@@ -61,12 +61,36 @@ TABLE64_LO_NP = (TABLE64_NP & np.uint64(0xFFFFFFFF)).astype(np.uint32)
 TABLE64_HI_NP = (TABLE64_NP >> np.uint64(32)).astype(np.uint32)
 
 
-def crc64(data: bytes, init_crc: int = 0) -> int:
-    """Scalar crc64, parity: dsn::utils::crc64_calc (src/utils/crc.cpp:464)."""
+def _crc64_py(data: bytes, init_crc: int = 0) -> int:
     crc = ~init_crc & _M64
     for b in data:
         crc = _TABLE64[(crc ^ b) & 0xFF] ^ (crc >> 8)
     return ~crc & _M64
+
+
+_crc64_native = None
+_crc64_native_tried = False
+
+
+def crc64(data: bytes, init_crc: int = 0) -> int:
+    """Scalar crc64, parity: dsn::utils::crc64_calc (src/utils/crc.cpp:464).
+
+    Every point get routes through crc64(hashkey); the native C
+    implementation takes over when built (init-chaining stays on the
+    Python loop — the C ABI exposes init=0 only)."""
+    global _crc64_native, _crc64_native_tried
+    if not _crc64_native_tried:
+        _crc64_native_tried = True
+        try:
+            from pegasus_tpu import native
+
+            if native.available():
+                _crc64_native = native.crc64_native
+        except Exception:  # noqa: BLE001 - fall back to the Python loop
+            _crc64_native = None
+    if init_crc == 0 and _crc64_native is not None:
+        return _crc64_native(bytes(data))
+    return _crc64_py(data, init_crc)
 
 
 def _crc32_py(data: bytes, init_crc: int = 0) -> int:
