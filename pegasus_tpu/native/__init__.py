@@ -62,6 +62,12 @@ def _load() -> Optional[ctypes.CDLL]:
                 ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
                 ctypes.c_int64, ctypes.c_void_p, ctypes.c_void_p,
                 ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p]
+            lib.pegasus_gather_page.restype = None
+            lib.pegasus_gather_page.argtypes = [
+                ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+                ctypes.c_int64, ctypes.c_int32, ctypes.c_void_p,
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p]
             return lib
 
         try:
@@ -138,3 +144,11 @@ def pack_records(keys, key_width: int):
     if rc != 0:
         return None
     return keys_out, key_len, hkl, hash_lo, valid.astype(bool)
+
+
+def gather_page_fn():
+    """The raw page-gather entry point (see packer.cpp
+    pegasus_gather_page), or None when the native library is
+    unavailable. server/page.py owns the calling convention."""
+    lib = _load()
+    return None if lib is None else lib.pegasus_gather_page

@@ -139,4 +139,49 @@ int32_t pegasus_pack_records(const uint8_t* heap, const int64_t* offsets,
   return 0;
 }
 
+// Gather `m` selected rows of a columnar block into a packed response
+// page: keys concatenated into key_blob, user-data (value minus `hdr`
+// header bytes) into val_blob, with running offset columns.
+//
+// Role parity: the reference's response-assembly loop
+// (src/server/pegasus_server_impl.cpp append_key_value_for_multi_get /
+// validate_key_value_for_scan) copies each surviving record into the
+// response one at a time in C++; our survivors are already columnar, so
+// one call packs the whole page.
+//
+//   keys        uint8[.., key_width]  padded key rows
+//   key_len     int32[..]
+//   value_offs  uint32[..+1]          row i's value = heap[offs[i],offs[i+1])
+//   take        int64[m]              row indices to gather (ascending)
+//   hdr         value-header bytes to strip (user data starts after it)
+//   key_offs    uint32[m+1]; [0] preset by the caller (chaining base)
+//   val_offs    uint32[m+1]; [0] preset; pass val_blob=NULL to skip
+//                            values (no_value mode) — offsets still run
+// The caller sizes key_blob/val_blob exactly (numpy sums of the same
+// columns); this routine only copies.
+void pegasus_gather_page(const uint8_t* keys, int64_t key_width,
+                         const int32_t* key_len, const uint32_t* value_offs,
+                         const uint8_t* heap, const int64_t* take, int64_t m,
+                         int32_t hdr, uint8_t* key_blob, uint32_t* key_offs,
+                         uint8_t* val_blob, uint32_t* val_offs) {
+  uint32_t kpos = key_offs[0];
+  uint32_t vpos = val_offs[0];
+  for (int64_t i = 0; i < m; ++i) {
+    const int64_t row = take[i];
+    const int32_t kl = key_len[row];
+    std::memcpy(key_blob + kpos, keys + row * key_width, kl);
+    kpos += static_cast<uint32_t>(kl);
+    key_offs[i + 1] = kpos;
+    const uint32_t v0 = value_offs[row];
+    const uint32_t v1 = value_offs[row + 1];
+    const uint32_t vl = v1 - v0 > static_cast<uint32_t>(hdr)
+                            ? v1 - v0 - static_cast<uint32_t>(hdr)
+                            : 0;
+    if (val_blob != nullptr && vl > 0)
+      std::memcpy(val_blob + vpos, heap + v0 + hdr, vl);
+    vpos += val_blob != nullptr ? vl : 0;
+    val_offs[i + 1] = vpos;
+  }
+}
+
 }  // extern "C"

@@ -59,7 +59,8 @@ def _register_defaults() -> None:
                 t.IncrRequest, t.IncrResponse, t.CheckAndSetRequest,
                 t.CheckAndSetResponse, t.Mutate, t.CheckAndMutateRequest,
                 t.CheckAndMutateResponse, t.GetScannerRequest,
-                t.ScanRequest, t.ScanResponse, PartitionConfig):
+                t.ScanRequest, t.ScanResponse, t.ScanPage,
+                PartitionConfig):
         register_message_type(cls)
 
 

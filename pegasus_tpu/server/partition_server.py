@@ -1127,6 +1127,10 @@ class PartitionServer:
         new version would serve pre-split masks (rows now owned by the
         sibling); drop instead."""
         keep = np.asarray(keep)
+        if keep.base is not None:
+            # slices of stacked multi-flavor eval outputs would pin the
+            # whole [K, S*cap] base array per ~1KB cache entry
+            keep = keep.copy()
         cap = self._effective_mask_cap()
         with self._mask_lock:
             if computed_pv != self.partition_version:
@@ -1218,13 +1222,19 @@ class PartitionServer:
         import bisect
 
         from pegasus_tpu.ops.predicates import host_alive_mask
+        from pegasus_tpu.server.page import build_page
 
         live_masks = {}
         alive_all = {}
+        exp_full = {}
         for ckey, (_run, _bm, blk) in unique.items():
             ets = blk.expire_ts
             alive = host_alive_mask(ets, now)
             alive_all[ckey] = alive
+            # whole-block expired count once per unique block; requests
+            # spanning the full block (the common case) reuse the
+            # scalar, boundary slices recount
+            exp_full[ckey] = len(alive) - int(np.count_nonzero(alive))
             live_masks[ckey] = keep_masks[ckey][:len(ets)] & alive
 
         overlay_keys, overlay_map = overlay
@@ -1247,11 +1257,14 @@ class PartitionServer:
                         idx = lo + int(i)
                         yield blk.key_at(idx), blk, idx
 
-            for ckey, _blk, lo, hi in plan:
+            for ckey, blk_, lo, hi in plan:
                 # per-REQUEST expired accounting (the solo path counts
                 # per request served, not per block evaluated)
-                req_expired += int(np.count_nonzero(
-                    ~alive_all[ckey][lo:hi]))
+                if lo == 0 and hi == blk_.count:
+                    req_expired += exp_full[ckey]
+                else:
+                    req_expired += int(np.count_nonzero(
+                        ~alive_all[ckey][lo:hi]))
             # plan frontier: where a budget-capped base plan ends — the
             # overlay must not run ahead of it (resume correctness)
             capped = (plan and sum(hi - lo for _c, _b, lo, hi in plan)
@@ -1269,45 +1282,27 @@ class PartitionServer:
             ov_i = ov_lo
             if ov_lo >= ov_hi:
                 # fast path: no overlay rows shadow this window, so the
-                # kept base rows ARE the answer — take them in order,
-                # building final KeyValues in ONE pass with the byte-size
-                # accounting vectorized off the columnar offsets
+                # kept base rows ARE the answer — gather every survivor
+                # into ONE columnar ScanPage (native batched
+                # gather/serialize, server/page.py) instead of building
+                # per-record KeyValues
+                chunks = []
+                taken = 0
                 for ckey, blk, lo, hi in plan:
                     hit = np.flatnonzero(live_masks[ckey][lo:hi])
-                    if hit.size > want - len(kvs):
-                        hit = hit[:want - len(kvs)]
+                    if hit.size > want - taken:
+                        hit = hit[:want - taken]
                     if not hit.size:
                         continue
-                    take_arr = hit + lo
-                    take = take_arr.tolist()
-                    if blk._key_list is not None or len(take) * 8 >= blk.count:
-                        # taking a large share of the block (or it is
-                        # already materialized): slice-free row keys
-                        klist = blk.key_list()
-                        row_key = klist.__getitem__
-                    else:
-                        row_key = blk.key_at
-                    size += int(blk.key_len[take_arr].sum())
-                    start_n = len(kvs)
-                    if no_value:
-                        kvs.extend(KeyValue(row_key(i), b"")
-                                   for i in take)
-                    else:
-                        vo, heap = blk.value_offs, blk.value_heap
-                        kvs.extend(
-                            KeyValue(row_key(i), heap[vo[i] + hdr:vo[i + 1]])
-                            for i in take)
-                        size += (int(vo[take_arr + 1].astype(np.int64).sum())
-                                 - int(vo[take_arr].astype(np.int64).sum())
-                                 - hdr * len(take))
-                    if want_ets:
-                        ets = blk.expire_ts
-                        for kv, i in zip(kvs[start_n:], take):
-                            kv.expire_ts_seconds = int(ets[i])
-                    if len(kvs) >= want:
-                        resume_key = _after(kvs[-1].key)
-                        stop_early = True
+                    chunks.append((blk, hit + lo))
+                    taken += int(hit.size)
+                    if taken >= want:
                         break
+                kvs, size, last_key = build_page(
+                    chunks, hdr, no_value=no_value, want_ets=want_ets)
+                if taken >= want and last_key is not None:
+                    resume_key = _after(last_key)
+                    stop_early = True
             else:
                 # merge path: interleave overlay rows in key order
                 # (overlay rows SHADOW base rows: newest wins,

@@ -9,6 +9,19 @@ stale-split check), evaluates once, and hands each partition its masks
 back. Per-flush device dispatches drop from
 O(partitions × blocks) to O(key-width buckets).
 
+Two further batch axes target the tunnel-accelerator cost model
+(~70 ms fixed per dispatched program, ~25 MB/s device→host, measured):
+
+- FLAVOR axis: requests carrying DIFFERENT filter patterns of the same
+  filter type are planned as separate per-flavor groups, but their
+  missing masks evaluate in ONE program ([K flavors × stacked records],
+  ops/predicates.multi_static_block_predicate_submit) over the union of
+  their blocks — each uploaded byte does K flavors of work, and every
+  (flavor, block) pair in the union gets its mask cached (free sibling
+  warming).
+- PACKED masks: device programs return bit-packed uint8 masks (8x
+  fewer bytes over the link); hosts unpack with numpy.
+
 Masks are STATIC per (block, filter, partition_version): TTL expiry —
 the only `now`-dependent predicate — is applied host-side from the
 block's expire_ts column at assembly time (ops/predicates.py
@@ -27,49 +40,82 @@ import numpy as np
 from pegasus_tpu.ops.predicates import (
     FT_NO_FILTER,
     FilterSpec,
+    multi_static_block_predicate_submit,
     static_block_predicate,
+    unpack_masks,
 )
+from pegasus_tpu.ops.record_block import next_bucket
 
 
 def scan_multi(servers_and_reqs: List[Tuple[object, list]],
                now: int) -> List[list]:
     """[(PartitionServer, [GetScannerRequest])] -> [[ScanResponse]].
 
-    Partitions that cannot take the batched fast path (filters, big
-    overlay, gates) serve per-request; qualifying ones share one stacked
-    evaluation wave.
+    Requests are grouped per (validate, filter) flavor so a batch mixing
+    filter patterns still rides the batched device path (one plan per
+    flavor, one multi-flavor evaluation wave); partitions that cannot
+    take the fast path (big overlay, gates, exotic filters) serve
+    per-request.
     """
+    from pegasus_tpu.server.partition_server import _normalize_filter_key
+
     states = []
     for server, reqs in servers_and_reqs:
-        state = server.plan_scan_batch(reqs, now=now)
-        states.append((server, reqs, state))
+        groups: "OrderedDict[tuple, list]" = OrderedDict()
+        for i, r in enumerate(reqs):
+            fl = (bool(r.validate_partition_hash
+                       and server.validate_partition_hash),
+                  _normalize_filter_key(r))
+            groups.setdefault(fl, []).append(i)
+        sub = []
+        for _fl, idxs in groups.items():
+            state = server.plan_scan_batch([reqs[i] for i in idxs],
+                                           now=now)
+            sub.append((idxs, state))
+        states.append((server, reqs, sub))
 
-    # gather misses across partitions; stacking requires a shared
-    # effective (validate, partition_version) — one table's partitions
-    # satisfy that; mixed groups fall back to per-server evaluation
-    flavor_groups: Dict[tuple, list] = {}
-    for server, reqs, state in states:
-        if state is None or "precomputed" in state:
-            continue
-        misses = server.planned_misses(state)
-        flavor = (state["validate"], server.partition_version,
-                  state["filter_key"])
-        for ckey, dev in misses.items():
-            flavor_groups.setdefault(flavor, []).append(
-                (server, state, ckey, dev))
+    # gather misses across partitions AND flavors; an eval group shares
+    # (validate, partition_version, filter types, pattern pad widths) —
+    # everything that must be static/uniform in one device program
+    eval_groups: Dict[tuple, dict] = {}
+    for server, reqs, sub in states:
+        for _idxs, state in sub:
+            if state is None or "precomputed" in state:
+                continue
+            misses = server.planned_misses(state)
+            if not misses:
+                continue
+            hft, hfp, sft, sfp = state["filter_key"]
+            gkey = (state["validate"], server.partition_version,
+                    hft, sft, next_bucket(len(hfp)),
+                    next_bucket(len(sfp)))
+            grp = eval_groups.setdefault(gkey, {})
+            flavor = grp.setdefault(state["filter_key"], [])
+            for ckey, dev in misses.items():
+                flavor.append((server, state, ckey, dev))
 
-    for (validate, pv, filter_key), entries in flavor_groups.items():
-        _eval_cross_partition(entries, validate, pv, filter_key)
+    for (validate, pv, _hft, _sft, _hw, _sw), flavors in \
+            eval_groups.items():
+        if len(flavors) == 1:
+            (fkey, entries), = flavors.items()
+            _eval_cross_partition(entries, validate, pv, fkey)
+        else:
+            _eval_cross_partition_multi(flavors, validate, pv)
 
     out = []
-    for server, reqs, state in states:
-        if state is None:
-            out.append([server.on_get_scanner(r) for r in reqs])
-        elif "precomputed" in state:
-            out.append(state["precomputed"])
-        else:
-            out.append(server.finish_scan_batch(
-                state, state["cached_keep"]))
+    for server, reqs, sub in states:
+        resps = [None] * len(reqs)
+        for idxs, state in sub:
+            if state is None:
+                rs = [server.on_get_scanner(reqs[i]) for i in idxs]
+            elif "precomputed" in state:
+                rs = state["precomputed"]
+            else:
+                rs = server.finish_scan_batch(state,
+                                              state["cached_keep"])
+            for i, r in zip(idxs, rs):
+                resps[i] = r
+        out.append(resps)
     return out
 
 
@@ -84,13 +130,15 @@ def stacked_block_eval(blocks, validate: bool, pv: int,
     started together. On a tunneled accelerator each synchronous fetch
     of a fresh result pays a full round-trip (~tens of ms measured), so
     starting all copies before the first wait overlaps compute and
-    transfer across chunks instead of serializing round-trips."""
+    transfer across chunks instead of serializing round-trips. Masks
+    come back bit-packed (8x smaller on the link) and unpack host-side.
+    """
     submitted = list(stacked_block_submit(blocks, validate, pv,
                                           filter_key))
     for o in submitted:
         _start_host_copy(o[2])
     for group, cap, keep_dev in submitted:
-        keep_all = np.asarray(keep_dev)
+        keep_all = unpack_masks(keep_dev, len(group) * cap)
         if len(group) == 1:
             yield group[0][0], keep_all
             continue
@@ -101,28 +149,66 @@ def stacked_block_eval(blocks, validate: bool, pv: int,
 def stacked_block_submit(blocks, validate: bool, pv: int,
                          filter_key=None):
     """Phase 1: dispatch predicate programs WITHOUT waiting. Yields
-    (group, cap, keep_device_array). Buckets by (key width, capacity) so
-    differently-capped tail blocks can never misalign mask slices; fixed
-    STACK_CHUNK keeps exactly two compiled shapes per key width
-    ([cap, W] and [STACK_CHUNK*cap, W]) — variable stack sizes made
-    every batch a fresh XLA compile. A stack mixing hash_lo and
+    (group, cap, packed_keep_device_array). Buckets by (key width,
+    capacity) so differently-capped tail blocks can never misalign mask
+    slices; fixed STACK_CHUNK keeps exactly two compiled shapes per key
+    width ([cap, W] and [STACK_CHUNK*cap, W]) — variable stack sizes
+    made every batch a fresh XLA compile. A stack mixing hash_lo and
     non-hash_lo blocks drops the precomputed column (the kernel computes
     the hash on device instead)."""
     hft, hfp, sft, sfp = filter_key or (FT_NO_FILTER, b"",
                                         FT_NO_FILTER, b"")
     hash_f = FilterSpec.make(hft, hfp)
     sort_f = FilterSpec.make(sft, sfp)
+    for group, cap, stacked, pidx in _stacked_chunks(blocks):
+        keep = static_block_predicate(
+            stacked, hash_filter=hash_f, sort_filter=sort_f,
+            validate_hash=validate, pidx=pidx, partition_version=pv,
+            pack=True)
+        yield group, cap, keep
+
+
+STACK_CHUNK = 16
+
+# flavor-axis sizes are bucketed to powers of two (list padded by
+# repeating the last flavor) so K distinct patterns never compile more
+# than log2(MULTI_FLAVOR_MAX) program shapes per (type, width) combo
+MULTI_FLAVOR_MAX = 64
+
+
+def _stacked_chunks(blocks):
+    """Shared chunking: yields (group, cap, stacked RecordBlock, pidx)
+    where pidx is a scalar (single block) or per-record column."""
+    import jax.numpy as jnp
+
+    from pegasus_tpu.ops.record_block import RecordBlock
+
     buckets: "OrderedDict[tuple, list]" = OrderedDict()
     for tag, dev, pidx in blocks:
         key = (int(dev.keys.shape[1]), int(dev.keys.shape[0]))
         buckets.setdefault(key, []).append((tag, dev, pidx))
     for (_w, cap), group in buckets.items():
         for off in range(0, len(group), STACK_CHUNK):
-            yield _submit_chunk(group[off:off + STACK_CHUNK], cap,
-                                validate, pv, hash_f, sort_f)
-
-
-STACK_CHUNK = 16
+            chunk = group[off:off + STACK_CHUNK]
+            if len(chunk) == 1:
+                tag, dev, pidx = chunk[0]
+                yield chunk, cap, dev, pidx
+                continue
+            padded = chunk + [chunk[0]] * (STACK_CHUNK - len(chunk))
+            pidx_col = np.concatenate([
+                np.full(cap, pidx, dtype=np.uint32)
+                for _t, _d, pidx in padded])
+            all_hash_lo = all(d.hash_lo is not None
+                              for _t, d, _p in padded)
+            stacked = RecordBlock(
+                jnp.concatenate([d.keys for _t, d, _p in padded]),
+                jnp.concatenate([d.key_len for _t, d, _p in padded]),
+                jnp.concatenate([d.hashkey_len for _t, d, _p in padded]),
+                jnp.concatenate([d.expire_ts for _t, d, _p in padded]),
+                jnp.concatenate([d.valid for _t, d, _p in padded]),
+                (jnp.concatenate([d.hash_lo for _t, d, _p in padded])
+                 if all_hash_lo else None))
+            yield chunk, cap, stacked, pidx_col
 
 
 def _start_host_copy(arr) -> None:
@@ -136,37 +222,6 @@ def _start_host_copy(arr) -> None:
             pass
 
 
-def _submit_chunk(group, cap, validate, pv, hash_f, sort_f):
-    import jax.numpy as jnp
-
-    from pegasus_tpu.ops.record_block import RecordBlock
-
-    if len(group) == 1:
-        tag, dev, pidx = group[0]
-        keep = static_block_predicate(
-            dev, hash_filter=hash_f, sort_filter=sort_f,
-            validate_hash=validate, pidx=pidx, partition_version=pv)
-        return group, cap, keep
-    padded = group + [group[0]] * (STACK_CHUNK - len(group))
-    pidx_col = np.concatenate([
-        np.full(cap, pidx, dtype=np.uint32)
-        for _t, _d, pidx in padded])
-    all_hash_lo = all(d.hash_lo is not None for _t, d, _p in padded)
-    stacked = RecordBlock(
-        jnp.concatenate([d.keys for _t, d, _p in padded]),
-        jnp.concatenate([d.key_len for _t, d, _p in padded]),
-        jnp.concatenate([d.hashkey_len for _t, d, _p in padded]),
-        jnp.concatenate([d.expire_ts for _t, d, _p in padded]),
-        jnp.concatenate([d.valid for _t, d, _p in padded]),
-        (jnp.concatenate([d.hash_lo for _t, d, _p in padded])
-         if all_hash_lo else None))
-    keep = static_block_predicate(
-        stacked, hash_filter=hash_f, sort_filter=sort_f,
-        validate_hash=validate, pidx=pidx_col,
-        partition_version=pv)
-    return group, cap, keep
-
-
 def _eval_cross_partition(entries, validate: bool,
                           pv: int, filter_key=None) -> None:
     """Stack blocks from MANY partitions; each record carries its owning
@@ -177,6 +232,76 @@ def _eval_cross_partition(entries, validate: bool,
             blocks, validate, pv, filter_key=filter_key):
         state["cached_keep"][ckey] = keep
         server.store_mask(state, ckey, keep)
+
+
+def _flavor_specs(fkeys):
+    """[(hash_FilterSpec, sort_FilterSpec)] for the flavor axis, padded
+    to a power-of-two K by repeating the last flavor (bounded compile
+    shapes)."""
+    specs = [(FilterSpec.make(hft, hfp), FilterSpec.make(sft, sfp))
+             for hft, hfp, sft, sfp in fkeys]
+    k = 1
+    while k < len(specs):
+        k <<= 1
+    specs = specs + [specs[-1]] * (k - len(specs))
+    return specs
+
+
+def _eval_cross_partition_multi(flavors: dict, validate: bool,
+                                pv: int) -> None:
+    """K filter flavors × the UNION of their missing blocks in one
+    program per stack chunk. Every (flavor, block) mask that comes back
+    is cached — pairs beyond the flavor's own miss set are free warm
+    masks for the next scan with that pattern."""
+    fkeys = list(flavors.keys())
+    if len(fkeys) > MULTI_FLAVOR_MAX:
+        # beyond the cap: evaluate in slabs
+        items = list(flavors.items())
+        mid = len(items) // 2
+        _eval_cross_partition_multi(dict(items[:mid]), validate, pv)
+        _eval_cross_partition_multi(dict(items[mid:]), validate, pv)
+        return
+    specs = _flavor_specs(fkeys)
+
+    # union of blocks across flavors (a block may be missed by several)
+    union: "OrderedDict[tuple, tuple]" = OrderedDict()
+    wanted: Dict[tuple, list] = {}
+    for fkey, entries in flavors.items():
+        for server, state, ckey, dev in entries:
+            ukey = (id(server), ckey)
+            union.setdefault(ukey, (server, ckey, dev))
+            wanted.setdefault((fkey, ukey), []).append(state)
+
+    blocks = [((server, ckey), dev, server.pidx)
+              for server, ckey, dev in union.values()]
+    submitted = []
+    for group, cap, stacked, pidx in _stacked_chunks(blocks):
+        packed = multi_static_block_predicate_submit(
+            stacked, specs, validate, pidx, pv)
+        submitted.append((group, cap, packed))
+    for _g, _c, packed in submitted:
+        _start_host_copy(packed)
+    for group, cap, packed in submitted:
+        masks = unpack_masks(packed, len(group) * cap)     # [K, S*cap]
+        for ki, fkey in enumerate(fkeys):
+            row = masks[ki]
+            for i, ((server, ckey), _d, _p) in enumerate(group):
+                keep = row[i * cap:(i + 1) * cap] if len(group) > 1 \
+                    else row
+                ukey = (id(server), ckey)
+                states = wanted.get((fkey, ukey))
+                # sibling (flavor, block) pairs beyond a flavor's own
+                # miss set are cached only for WARM flavors — a flood of
+                # one-shot patterns must not LRU-evict the long-lived
+                # warm masks steady-state serving depends on (the same
+                # guard _register_flavor applies to background warming)
+                if states is None and (validate, fkey) \
+                        not in server._warm_flavors:
+                    continue
+                server.store_mask_for(ckey, validate, fkey, keep,
+                                      computed_pv=pv)
+                for state in states or ():
+                    state["cached_keep"][ckey] = np.asarray(keep)
 
 
 class MaskPrefresher:
@@ -255,26 +380,49 @@ class MaskPrefresher:
         """One warm pass over hot blocks missing their static mask;
         returns masks stored. Synchronous; tests call this directly.
         (`now` accepted for back-compat; static masks don't depend on
-        it.)"""
+        it.) Flavors sharing filter types and pattern widths warm in
+        one multi-flavor program per stack chunk."""
         import time as _time
 
         wall = _time.monotonic()
         warmed = 0
-        flavors: Dict[tuple, list] = {}
+        groups: Dict[tuple, dict] = {}
         for srv in self.servers:
             for ckey, blk, validate, fkey in srv.hot_block_entries(
                     wall, self.horizon_s):
                 dev = srv._device_cached_block(ckey, blk)
-                flavors.setdefault(
-                    (validate, srv.partition_version, fkey),
-                    []).append((srv, ckey, dev))
-        for (validate, pv, fkey), entries in flavors.items():
-            blocks = [((srv, ckey), dev, srv.pidx)
-                      for srv, ckey, dev in entries]
-            for (srv, ckey), keep in stacked_block_eval(
-                    blocks, validate, pv, filter_key=fkey):
-                srv.store_mask_for(ckey, validate, fkey,
-                                   keep, computed_pv=pv)
-                warmed += 1
+                hft, hfp, sft, sfp = fkey
+                gkey = (validate, srv.partition_version, hft, sft,
+                        next_bucket(len(hfp)), next_bucket(len(sfp)))
+                grp = groups.setdefault(gkey, {})
+                grp.setdefault(fkey, []).append((srv, ckey, dev))
+        for (validate, pv, *_rest), flavors in groups.items():
+            if len(flavors) == 1:
+                (fkey, entries), = flavors.items()
+                blocks = [((srv, ckey), dev, srv.pidx)
+                          for srv, ckey, dev in entries]
+                for (srv, ckey), keep in stacked_block_eval(
+                        blocks, validate, pv, filter_key=fkey):
+                    srv.store_mask_for(ckey, validate, fkey,
+                                       keep, computed_pv=pv)
+                    warmed += 1
+            else:
+                # no serving batch to hand masks back to: store-only
+                _eval_cross_partition_multi(
+                    {fkey: [(srv, _NO_STATE, ckey, dev)
+                            for srv, ckey, dev in entries]
+                     for fkey, entries in flavors.items()}, validate, pv)
+                warmed += sum(len(e) for e in flavors.values())
         self.refreshed += warmed
         return warmed
+
+
+class _NoStateType:
+    """Placeholder state for prefresher-driven multi evals (no serving
+    batch to hand masks back to) — swallows cached_keep writes."""
+
+    def __getitem__(self, k):
+        return {}
+
+
+_NO_STATE = _NoStateType()

@@ -57,6 +57,90 @@ class KeyValue:
     expire_ts_seconds: Optional[int] = None
 
 
+_EMPTY_OFFS = b"\x00\x00\x00\x00"
+
+
+@dataclass
+class ScanPage:
+    """A whole response page as FOUR packed blobs instead of a
+    per-record KeyValue list — the columnar twin of the SST block
+    layout, assembled by one native gather call (native/packer.cpp
+    pegasus_gather_page) and wire-encoded in O(1) fields rather than
+    O(records) values.
+
+    Parity role: the kvs list of idl/rrdb.thrift scan_response — the
+    reference serializes each key_value via thrift per record
+    (src/server/pegasus_server_impl.cpp append_key_value_for_multi_get);
+    here survivors are gathered straight from the columnar block into
+    the page.  Supports the sequence protocol (len / index / iterate →
+    KeyValue) so every existing kvs consumer works unchanged; iteration
+    is the lazy path clients pay only for records they actually touch.
+
+    key_offs/val_offs are little-endian uint32[n+1]; ets (present only
+    when the scanner asked for expire timestamps) is uint32[n].
+    """
+
+    key_offs: bytes = _EMPTY_OFFS
+    key_blob: bytes = b""
+    val_offs: bytes = _EMPTY_OFFS
+    val_blob: bytes = b""
+    ets: bytes = b""
+
+    def _offs(self):
+        import numpy as np
+
+        ko = self.__dict__.get("_ko")
+        if ko is None:
+            ko = np.frombuffer(self.key_offs, dtype="<u4")
+            self.__dict__["_ko"] = ko
+            self.__dict__["_vo"] = np.frombuffer(self.val_offs,
+                                                 dtype="<u4")
+        return ko, self.__dict__["_vo"]
+
+    def __len__(self) -> int:
+        return max(0, len(self.key_offs) // 4 - 1)
+
+    def __bool__(self) -> bool:
+        return len(self) > 0
+
+    def key_at(self, i: int) -> bytes:
+        ko, _ = self._offs()
+        return self.key_blob[ko[i]:ko[i + 1]]
+
+    def value_at(self, i: int) -> bytes:
+        _, vo = self._offs()
+        return self.val_blob[vo[i]:vo[i + 1]]
+
+    def ets_at(self, i: int) -> Optional[int]:
+        if not self.ets:
+            return None
+        import struct as _s
+
+        return _s.unpack_from("<I", self.ets, 4 * i)[0]
+
+    def __getitem__(self, i: int) -> KeyValue:
+        n = len(self)
+        if i < 0:
+            i += n
+        if not 0 <= i < n:
+            raise IndexError(i)
+        return KeyValue(self.key_at(i), self.value_at(i), self.ets_at(i))
+
+    def __iter__(self):
+        ko, vo = self._offs()
+        kb, vb = self.key_blob, self.val_blob
+        if self.ets:
+            import numpy as np
+
+            ets = np.frombuffer(self.ets, dtype="<u4")
+            for i in range(len(self)):
+                yield KeyValue(kb[ko[i]:ko[i + 1]], vb[vo[i]:vo[i + 1]],
+                               int(ets[i]))
+        else:
+            for i in range(len(self)):
+                yield KeyValue(kb[ko[i]:ko[i + 1]], vb[vo[i]:vo[i + 1]])
+
+
 @dataclass
 class MultiPutRequest:
     hash_key: bytes

@@ -282,6 +282,7 @@ class SSTable:
         self.total_count: int = index.get("total_count", 0)
         self._cache: dict[int, Block] = {}
         self._cache_cap = cache_blocks
+        self._last_keys: Optional[List[bytes]] = None  # iter_blocks bisect
 
     def close(self) -> None:
         self._f.close()
@@ -401,10 +402,16 @@ class SSTable:
     def iter_blocks(self, start: bytes = b"", stop: Optional[bytes] = None
                     ) -> Iterator[Tuple[BlockMeta, Block]]:
         """Yield whole blocks intersecting [start, stop) — the device fast
-        path: callers feed Block columns directly to the predicate kernels."""
-        for bi, bm in enumerate(self.blocks):
+        path: callers feed Block columns directly to the predicate kernels.
+        The first candidate is found by bisect over the cached last-key
+        column (scans start mid-table constantly; a linear walk from
+        block 0 was the planner's hottest loop)."""
+        lk = self._last_keys
+        if lk is None:
+            lk = self._last_keys = [b.last_key for b in self.blocks]
+        bi = bisect.bisect_left(lk, start) if start else 0
+        for bi in range(bi, len(self.blocks)):
+            bm = self.blocks[bi]
             if stop is not None and bm.first_key >= stop:
                 break
-            if start and bm.last_key < start:
-                continue
             yield bm, self.read_block(bi)
