@@ -105,10 +105,12 @@ def make_compaction_eval(operations=None):
         return cached
 
     @functools.partial(jax.jit, static_argnames=("validate_hash",
-                                                 "use_hash_lo"))
+                                                 "use_hash_lo",
+                                                 "want_ets", "pack"))
     def eval_block(keys, key_len, hashkey_len, expire_ts, valid, hash_lo,
                    now, default_ttl, pidx, partition_version,
-                   validate_hash: bool, use_hash_lo: bool):
+                   validate_hash: bool, use_hash_lo: bool,
+                   want_ets: bool = True, pack: bool = False):
         from pegasus_tpu.ops.compaction_rules import apply_rules_ops
 
         now = jnp.asarray(now, jnp.uint32)
@@ -132,7 +134,13 @@ def make_compaction_eval(operations=None):
         else:
             stale = jnp.zeros_like(valid)
         drop = ((expired | stale) & valid) | rule_drop
-        return drop, ets2
+        # pack: bit-pack the drop mask on device (the tunnel's
+        # device->host link is the scarce resource); want_ets=False skips
+        # returning the rewritten-TTL column entirely when no rule or
+        # default-TTL can change it (the caller never reads it)
+        if pack:
+            drop = jnp.packbits(drop)
+        return (drop, ets2) if want_ets else (drop,)
 
     _EVAL_CACHE[key] = eval_block
     while len(_EVAL_CACHE) > _EVAL_CACHE_CAP:
@@ -148,18 +156,42 @@ COMPACT_CHUNK_ROWS = 1 << 18  # 256k records per stacked program
 from pegasus_tpu.ops.placement import choose_eval_device  # noqa: F401 (re-export)
 
 
-def compaction_eval_stacked(blocks, now, default_ttl, partition_version,
-                            validate_hash: bool, operations=None,
-                            eval_device=None):
-    """Evaluate the compaction filter for MANY blocks in few dispatches.
+def rules_workload(operations) -> str:
+    """Placement class for a parsed ruleset (ops/placement.py).
+
+    The accelerator's upload cost (~32 key bytes/record at ~0.5 GB/s)
+    buys ALL rules' compute at once, while the host pays per pattern —
+    measured break-even on this image is around two substring
+    (MATCH_ANYWHERE) patterns or a handful of cheaper prefix/postfix
+    ones. Rulesets below that stay compute-trivial ("ttl" class)."""
+    if not operations:
+        return "ttl"
+    anywhere = 0
+    patterns = 0
+    for op in operations:
+        for r in op.rules:
+            if r.kind == "ttl_range":
+                continue
+            patterns += 1
+            ft = getattr(r.filter, "filter_type", None)
+            if ft == 1:  # FT_MATCH_ANYWHERE
+                anywhere += 1
+    return "rules" if (anywhere >= 2 or patterns >= 4) else "ttl"
+
+
+def compaction_eval_submit(blocks, now, default_ttl, partition_version,
+                           validate_hash: bool, operations=None,
+                           eval_device=None, want_ets: bool = True):
+    """Phase 1: dispatch compaction-filter programs WITHOUT waiting.
 
     `blocks`: [(tag, host_block, pidx)] — host_block is a columnar SST
     Block (storage/sstable.py), `pidx` the owning partition (one wave
     can span a whole table). Blocks are concatenated host-side into
     ~COMPACT_CHUNK_ROWS-record programs per key width (ONE transfer set
-    per chunk, not per block), all programs are submitted before the
-    first result is awaited, and device->host copies start together.
-    Yields (tag, drop[:n], new_ets[:n]) per block.
+    per chunk, not per block). Returns an opaque list for
+    compaction_eval_drain. Drop masks come back bit-packed; the
+    rewritten-TTL column transfers only when `want_ets` (a pass with no
+    default-TTL and no update_ttl rule never reads it).
 
     `eval_device`: jax device to run on ("auto" via choose_eval_device
     when None is resolved by the caller)."""
@@ -214,23 +246,49 @@ def compaction_eval_stacked(blocks, now, default_ttl, partition_version,
                 hkl = ((key_len > 0)
                        * ((keys[:, 0].astype(np.int32) << 8)
                           | keys[:, 1].astype(np.int32)))
-                drop, new_ets = eval_block(
+                out = eval_block(
                     keys, key_len, hkl, ets, valid, hash_lo,
                     np.uint32(now), np.uint32(default_ttl), pidx_col,
                     np.uint32(max(partition_version, 0) & 0xFFFFFFFF),
-                    validate_hash, use_lo)
-                submitted.append((spans, drop, new_ets))
+                    validate_hash, use_lo, want_ets=want_ets, pack=True)
+                drop = out[0]
+                new_ets = out[1] if want_ets else None
+                submitted.append((spans, cap, drop, new_ets))
+    return submitted
 
-    for _spans, drop, new_ets in submitted:
-        for arr in (drop, new_ets):
-            start = getattr(arr, "copy_to_host_async", None)
-            if start is not None:
-                try:
-                    start()
-                except Exception:  # noqa: BLE001 - overlap hint only
-                    pass
-    for spans, drop, new_ets in submitted:
-        drop_all = np.asarray(drop)
-        ets_all = np.asarray(new_ets)
+
+def compaction_eval_drain(submitted, want_ets: bool = True):
+    """Phase 2: fetch EVERY submitted result in one transfer round (the
+    tunnel charges ~69 ms per synchronous fetch regardless of size) and
+    yield (tag, drop[:n], new_ets[:n]|None) per block."""
+    import jax as _jax
+
+    arrays = [d for _s, _c, d, _e in submitted]
+    if want_ets:
+        arrays += [e for _s, _c, _d, e in submitted]
+    try:
+        fetched = _jax.device_get(arrays)
+    except Exception:  # noqa: BLE001 - fall back to per-array fetch
+        fetched = [np.asarray(a) for a in arrays]
+    n_chunks = len(submitted)
+    for i, (spans, cap, _d, _e) in enumerate(submitted):
+        drop_all = np.unpackbits(fetched[i], count=cap).astype(bool)
+        ets_all = fetched[n_chunks + i] if want_ets else None
         for tag, pos, n in spans:
-            yield tag, drop_all[pos:pos + n], ets_all[pos:pos + n]
+            yield (tag, drop_all[pos:pos + n],
+                   ets_all[pos:pos + n] if want_ets else None)
+
+
+def compaction_eval_stacked(blocks, now, default_ttl, partition_version,
+                            validate_hash: bool, operations=None,
+                            eval_device=None, want_ets: bool = True):
+    """Submit + drain in one call (the non-pipelined form; the engine's
+    windowed compactor overlaps a window's drain/rewrite with the next
+    window's submit)."""
+    yield from compaction_eval_drain(
+        compaction_eval_submit(blocks, now, default_ttl,
+                               partition_version, validate_hash,
+                               operations=operations,
+                               eval_device=eval_device,
+                               want_ets=want_ets),
+        want_ets=want_ets)

@@ -57,7 +57,10 @@ def radius_filter(lats: np.ndarray, lngs: np.ndarray,
     la[:n] = lats
     lo[:n] = lngs
     va[:n] = True if valid is None else valid
-    dev = choose_eval_device()
+    # per-query latency-bound movement (two link round-trips per search):
+    # "ttl"-class placement — host XLA unless the accelerator is
+    # co-located
+    dev = choose_eval_device(workload="ttl")
     ctx = contextlib.nullcontext()
     if dev is not None:
         ctx = jax.default_device(dev)

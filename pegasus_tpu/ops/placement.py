@@ -4,11 +4,22 @@ Serving scans keep their inputs DEVICE-RESIDENT (uploaded once, masks
 cached), so accelerator latency never sits on the steady-state path.
 But some programs must move their whole input per call — compaction
 filters (every key byte), geo distance batches (fresh candidates per
-search). On a co-located accelerator that movement is nearly free; on a
-high-latency tunnel it dwarfs the compute. These programs therefore ask
-`choose_eval_device()` once per process: a measured round-trip probe
-decides whether they run on the ambient accelerator or on the host XLA
-backend — the SAME jitted code either way (jax.default_device does the
+search). Placement is decided per WORKLOAD SHAPE from one measured link
+probe, because the tunnel's cost model (measured on this image:
+~70 ms fixed per program round, ~0.5 GB/s host->device, ~37 MB/s
+device->host marginal) splits these programs into two classes:
+
+- "ttl" — compute-trivial per byte (a compare against `now`). The host
+  XLA backend streams these at memory speed with zero movement; the
+  accelerator can never win unless it is co-located (sub-ms RTT).
+- "rules" / "match" — compute-dense per byte (multi-pattern substring
+  matching over wide key rows, K-flavor batches). Upload cost buys K
+  patterns of compute, results return bit-packed; the accelerator wins
+  once the link RTT is amortizable (deep pipelining), so these stay on
+  the ambient accelerator even over a moderate-latency link, and fall
+  back to host only when the link is pathological (probe failure).
+
+The SAME jitted code runs either way (jax.default_device does the
 placement; nothing is duplicated).
 """
 
@@ -16,26 +27,32 @@ from __future__ import annotations
 
 import numpy as np
 
-_EVAL_DEVICE_CHOICE: object = ...  # ... = unprobed (None is a real answer)
+_PROBE_RTT: object = ...       # ... = unprobed; None = no accelerator
+_PROBE_DEFAULT = None          # the probed non-cpu device (if any)
 
-# round-trips slower than this mean the link, not the compute, would
-# dominate any per-call data-movement-bound program
-LINK_RTT_BUDGET_S = 0.005
+# a round-trip under this means effectively co-located: even
+# compute-trivial movement-bound programs can ride the accelerator
+LINK_RTT_COLOCATED_S = 0.005
+
+# a round-trip above this means the link is pathological: nothing
+# movement-bound belongs on the accelerator, however compute-dense
+LINK_RTT_BROKEN_S = 2.0
 
 
-def choose_eval_device():
-    """jax.Device to place movement-bound programs on, or None to keep
-    the ambient default. Probes the accelerator link once per process
-    with one tiny measured round-trip."""
-    global _EVAL_DEVICE_CHOICE
-    if _EVAL_DEVICE_CHOICE is not ...:
-        return _EVAL_DEVICE_CHOICE
+def _probe_rtt():
+    """One tiny measured round-trip to the ambient accelerator; cached
+    per process. Returns (rtt_seconds, device) or (None, None) when the
+    ambient default is the CPU already (or the probe fails)."""
+    global _PROBE_RTT, _PROBE_DEFAULT
+    if _PROBE_RTT is not ...:
+        return _PROBE_RTT, _PROBE_DEFAULT
     import time
 
     import jax
     import jax.numpy as jnp
 
-    choice = None
+    rtt = None
+    dev = None
     try:
         default = jnp.zeros(1).devices().pop()
         if default.platform != "cpu":
@@ -44,16 +61,41 @@ def choose_eval_device():
             t0 = time.perf_counter()
             np.asarray(jax.device_put(x, default))
             rtt = time.perf_counter() - t0
-            if rtt > LINK_RTT_BUDGET_S:
-                cpus = jax.local_devices(backend="cpu")
-                choice = cpus[0] if cpus else None
-    except Exception:  # noqa: BLE001 - probe failure = keep default
-        choice = None
-    _EVAL_DEVICE_CHOICE = choice
-    return choice
+            dev = default
+    except Exception:  # noqa: BLE001 - probe failure = no accelerator
+        rtt = None
+        dev = None
+    _PROBE_RTT, _PROBE_DEFAULT = rtt, dev
+    return rtt, dev
+
+
+def choose_eval_device(workload: str = "rules"):
+    """jax.Device to place a movement-bound program on, or None to keep
+    the ambient default.
+
+    workload: "ttl" (compute-trivial per byte) or "rules"/"match"
+    (compute-dense). See the module docstring for the policy.
+    """
+    import jax
+
+    rtt, _dev = _probe_rtt()
+    if rtt is None:
+        return None  # ambient default is already the host
+    if workload == "ttl":
+        route_host = rtt > LINK_RTT_COLOCATED_S
+    else:
+        route_host = rtt > LINK_RTT_BROKEN_S
+    if route_host:
+        try:
+            cpus = jax.local_devices(backend="cpu")
+        except Exception:  # noqa: BLE001 - no cpu backend registered
+            return None
+        return cpus[0] if cpus else None
+    return None
 
 
 def reset_probe() -> None:
     """Forget the cached probe (tests / backend swaps)."""
-    global _EVAL_DEVICE_CHOICE
-    _EVAL_DEVICE_CHOICE = ...
+    global _PROBE_RTT, _PROBE_DEFAULT
+    _PROBE_RTT = ...
+    _PROBE_DEFAULT = None

@@ -258,12 +258,14 @@ class StorageEngine:
         memory stays bounded by the window regardless of table size."""
         from pegasus_tpu.ops.compaction import (
             choose_eval_device,
-            compaction_eval_stacked,
+            compaction_eval_drain,
+            compaction_eval_submit,
+            rules_workload,
         )
 
         ttl_may_change = bool(default_ttl) or bool(
             operations and any(op.op == "update_ttl" for op in operations))
-        eval_device = choose_eval_device()
+        eval_device = choose_eval_device(workload=rules_workload(operations))
         entries = self.lsm.bulk_compact_entries()
         meta = {
             "last_flushed_decree": self.last_committed_decree,
@@ -273,16 +275,30 @@ class StorageEngine:
 
         WINDOW = 512  # blocks per load->eval->rewrite window
 
+        def submit(off):
+            window = entries[off:off + WINDOW]
+            blocks = [((run, i), run.read_block(i), pidx)
+                      for run, i, _bm in window]
+            pend = compaction_eval_submit(
+                blocks, now_s, default_ttl, partition_version,
+                do_validate, operations=operations,
+                eval_device=eval_device, want_ets=ttl_may_change)
+            return window, blocks, pend
+
         def results():
-            for off in range(0, len(entries), WINDOW):
-                window = entries[off:off + WINDOW]
-                blocks = [((run, i), run.read_block(i), pidx)
-                          for run, i, _bm in window]
+            # one-window lookahead: while window w's masks drain and its
+            # survivors rewrite to disk, window w+1's blocks are already
+            # loaded, uploaded, and evaluating — device (or host XLA)
+            # filter time hides behind the disk time and vice versa
+            ahead = submit(0) if entries else None
+            off = WINDOW
+            while ahead is not None:
+                window, blocks, pend = ahead
+                ahead = submit(off) if off < len(entries) else None
+                off += WINDOW
                 got = {}
-                for tag, drop, new_ets in compaction_eval_stacked(
-                        blocks, now_s, default_ttl, partition_version,
-                        do_validate, operations=operations,
-                        eval_device=eval_device):
+                for tag, drop, new_ets in compaction_eval_drain(
+                        pend, want_ets=ttl_may_change):
                     got[tag] = (drop, new_ets)
                 by_tag = {tag: blk for tag, blk, _p in blocks}
                 for run, i, _bm in window:
