@@ -54,6 +54,12 @@ def build_page(chunks: List[Tuple[object, np.ndarray]], hdr: int,
         if not no_value:
             vo = blk.value_offs
             val_cap += int(vo[int(take[-1]) + 1]) - int(vo[int(take[0])])
+    if key_cap >= 1 << 32 or val_cap >= 1 << 32:
+        # offsets are uint32 (here and in pegasus_gather_page); callers
+        # cap batch_size (SCAN_BATCH_CAP) so this only trips on a bug
+        raise ValueError(
+            f"scan page exceeds 4GiB blob limit "
+            f"(keys={key_cap}, values={val_cap}); split the batch")
 
     key_offs = np.zeros(n + 1, dtype=np.uint32)
     val_offs = np.zeros(n + 1, dtype=np.uint32)

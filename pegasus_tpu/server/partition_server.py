@@ -104,6 +104,17 @@ def _after(key: bytes) -> bytes:
     return key + b"\x00"
 
 
+# Server-side caps on one scan page: client-supplied batch_size is
+# untrusted, and page blob offsets are uint32 (ScanPage /
+# pegasus_gather_page) — a >4GiB page would silently wrap them. The
+# byte cap bounds the page by VALUE weight too (values can be multi-MB
+# each); a capped page returns stop_early with a resume cursor, exactly
+# like a record-capped one. The reference likewise caps scan batches
+# server-side (pegasus_server_impl scan batch limits).
+SCAN_BATCH_CAP = 65536
+SCAN_BYTES_CAP = 64 << 20
+
+
 def _lower_bound(blk, key: bytes) -> int:
     """First row index in a sorted SST block whose key >= `key`."""
     lo, hi = 0, blk.count
@@ -906,7 +917,8 @@ class PartitionServer:
         now = epoch_now()
         resp = ScanResponse()
         limiter = RangeReadLimiter()
-        batch_size = req.batch_size if req.batch_size > 0 else 1000
+        batch_size = min(req.batch_size if req.batch_size > 0 else 1000,
+                         SCAN_BATCH_CAP)
         if req.only_return_count:
             batch_size = -1  # count the whole (limiter-bounded) range
         records, exhausted, resume_key = self._batched_scan(
@@ -917,7 +929,8 @@ class PartitionServer:
                             req.sort_key_filter_pattern),
             validate_hash=(req.validate_partition_hash
                            and self.validate_partition_hash),
-            limiter=limiter, max_records=batch_size, max_bytes=-1,
+            limiter=limiter, max_records=batch_size,
+            max_bytes=-1 if req.only_return_count else SCAN_BYTES_CAP,
             with_values=not req.no_value and not req.only_return_count)
         if req.only_return_count:
             resp.kv_count = len(records)
@@ -1021,7 +1034,8 @@ class PartitionServer:
             stop_key = req.stop_key or b""
             if stop_key and req.stop_inclusive:
                 stop_key = _after(stop_key)
-            want = (req.batch_size if req.batch_size > 0 else 1000)
+            want = min(req.batch_size if req.batch_size > 0 else 1000,
+                       SCAN_BATCH_CAP)
             plan = []
             budget = want * 2 + 64
             for run in runs:
@@ -1288,19 +1302,46 @@ class PartitionServer:
                 # per-record KeyValues
                 chunks = []
                 taken = 0
+                byte_est = 0
+                truncated = False
                 for ckey, blk, lo, hi in plan:
                     hit = np.flatnonzero(live_masks[ckey][lo:hi])
                     if hit.size > want - taken:
                         hit = hit[:want - taken]
                     if not hit.size:
                         continue
-                    chunks.append((blk, hit + lo))
+                    hit = hit + lo
+                    # byte budget (keys + value-heap span upper bound):
+                    # page blob offsets are uint32 and one RPC response
+                    # must stay bounded whatever the values weigh
+                    vo = blk.value_offs
+                    chunk_bytes = (int(hit.size) * blk.keys.shape[1]
+                                   + int(vo[int(hit[-1]) + 1])
+                                   - int(vo[int(hit[0])]))
+                    if byte_est + chunk_bytes > SCAN_BYTES_CAP:
+                        if byte_est == 0:
+                            # a single oversized chunk: binary-search the
+                            # row prefix that fits (per-row byte cumsum
+                            # only for this rare path)
+                            row_bytes = (vo[hit + 1].astype(np.int64)
+                                         - vo[hit].astype(np.int64)
+                                         + blk.keys.shape[1])
+                            fit = int(np.searchsorted(
+                                np.cumsum(row_bytes), SCAN_BYTES_CAP,
+                                side="right"))
+                            hit = hit[:max(1, fit)]
+                            chunks.append((blk, hit))
+                            taken += int(hit.size)
+                        truncated = True
+                        break
+                    byte_est += chunk_bytes
+                    chunks.append((blk, hit))
                     taken += int(hit.size)
                     if taken >= want:
                         break
                 kvs, size, last_key = build_page(
                     chunks, hdr, no_value=no_value, want_ets=want_ets)
-                if taken >= want and last_key is not None:
+                if (taken >= want or truncated) and last_key is not None:
                     resume_key = _after(last_key)
                     stop_early = True
             else:
@@ -1339,9 +1380,10 @@ class PartitionServer:
                             kv.expire_ts_seconds = int(blk.expire_ts[idx])
                     kvs.append(kv)
                     size += len(key) + len(data)
-                    if len(kvs) >= want:
+                    if len(kvs) >= want or size >= SCAN_BYTES_CAP:
                         resume_key = _after(key)
                         stop_early = True
+                        break
             if stop_early:
                 exhausted = False
             elif capped:

@@ -299,9 +299,11 @@ def _eval_cross_partition_multi(flavors: dict, validate: bool,
                 # one-shot patterns must not LRU-evict the long-lived
                 # warm masks steady-state serving depends on (the same
                 # guard _register_flavor applies to background warming)
-                if states is None and (validate, fkey) \
-                        not in server._warm_flavors:
-                    continue
+                if states is None:
+                    with server._mask_lock:
+                        warm = (validate, fkey) in server._warm_flavors
+                    if not warm:
+                        continue
                 server.store_mask_for(ckey, validate, fkey, keep,
                                       computed_pv=pv)
                 for state in states or ():

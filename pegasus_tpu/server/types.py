@@ -118,8 +118,10 @@ class ScanPage:
 
         return _s.unpack_from("<I", self.ets, 4 * i)[0]
 
-    def __getitem__(self, i: int) -> KeyValue:
+    def __getitem__(self, i):
         n = len(self)
+        if isinstance(i, slice):
+            return [self[j] for j in range(*i.indices(n))]
         if i < 0:
             i += n
         if not 0 <= i < n:

@@ -421,18 +421,24 @@ class LSMStore:
         # (all runs durable, then manifest) is unchanged.
         finish_pool = _cf.ThreadPoolExecutor(max_workers=2)
         finishing: List[_cf.Future] = []
+        finishing_writers: List[SSTableWriter] = []
 
         def _finish_one(w: SSTableWriter) -> SSTable:
             w.finish()
             return SSTable(w.path)
 
+        def _submit_finish(w: SSTableWriter) -> None:
+            finishing_writers.append(w)
+            finishing.append(finish_pool.submit(_finish_one, w))
+
         writer: Optional[SSTableWriter] = None
         written_in_run = 0
+        ok = False
 
         def roll_writer() -> SSTableWriter:
             nonlocal writer, written_in_run
             if writer is not None and written_in_run >= self._l1_run_capacity:
-                finishing.append(finish_pool.submit(_finish_one, writer))
+                _submit_finish(writer)
                 writer = None
                 written_in_run = 0
             if writer is None:
@@ -449,67 +455,98 @@ class LSMStore:
                                  blk.value_heap)
             written_in_run += blk.count
 
-        for run, idx, blk, drop, new_ets in per_block:
-            dropped = bool(drop.any())
-            if not dropped and not ttl_may_change:
-                copy_block(blk)
-                continue
-            n = blk.count
-            ets_changed = (ttl_may_change
-                           and not np.array_equal(new_ets, blk.expire_ts))
-            if not dropped and not ets_changed:
-                copy_block(blk)
-                continue
-            keep = ~drop
-            if blk.flags is not None:
-                keep &= blk.flags == 0  # defensive: tombstones never stay
-            kept = np.flatnonzero(keep)
-            if kept.size == 0:
-                continue
-            vo = blk.value_offs.astype(np.int64)
-            lens = vo[1:] - vo[:-1]
-            heap_arr = np.frombuffer(blk.value_heap, dtype=np.uint8)
-            ets_col = new_ets if ets_changed else blk.expire_ts
-            if ets_changed and patch_headers:
-                # patch the big-endian u32 expire_ts value header in
-                # place (vectorized scatter, value_schema.h: header
-                # starts every encoded value)
-                heap_arr = heap_arr.copy()
-                chg = np.flatnonzero((new_ets != blk.expire_ts) & keep)
-                if chg.size:
-                    pos = vo[chg]
-                    vals = new_ets[chg].astype(np.uint32)
-                    heap_arr[pos] = (vals >> 24).astype(np.uint8)
-                    heap_arr[pos + 1] = ((vals >> 16) & 0xFF).astype(np.uint8)
-                    heap_arr[pos + 2] = ((vals >> 8) & 0xFF).astype(np.uint8)
-                    heap_arr[pos + 3] = (vals & 0xFF).astype(np.uint8)
-            if kept.size == n:
-                new_heap = heap_arr.tobytes()
-                new_offs = blk.value_offs
-                keys2d, klen = blk.keys, blk.key_len
-                hlo, flg = blk.hash_lo, blk.flags
-                ets_out = ets_col
-            else:
-                keep_bytes = np.repeat(keep, lens)
-                new_heap = heap_arr[keep_bytes].tobytes()
-                kept_lens = lens[kept]
-                new_offs = np.zeros(kept.size + 1, dtype=np.uint32)
-                new_offs[1:] = np.cumsum(kept_lens)
-                keys2d = blk.keys[kept]
-                klen = blk.key_len[kept]
-                ets_out = np.asarray(ets_col)[kept]
-                hlo = blk.hash_lo[kept]
-                flg = blk.flags[kept]
-            w = roll_writer()
-            w.add_block_columnar(keys2d, klen, ets_out, hlo, flg,
-                                 new_offs, new_heap)
-            written_in_run += kept.size
-        if writer is not None:
-            finishing.append(finish_pool.submit(_finish_one, writer))
         try:
+            for run, idx, blk, drop, new_ets in per_block:
+                dropped = bool(drop.any())
+                if not dropped and not ttl_may_change:
+                    copy_block(blk)
+                    continue
+                n = blk.count
+                ets_changed = (ttl_may_change
+                               and not np.array_equal(new_ets,
+                                                      blk.expire_ts))
+                if not dropped and not ets_changed:
+                    copy_block(blk)
+                    continue
+                keep = ~drop
+                if blk.flags is not None:
+                    keep &= blk.flags == 0  # tombstones never stay
+                kept = np.flatnonzero(keep)
+                if kept.size == 0:
+                    continue
+                vo = blk.value_offs.astype(np.int64)
+                lens = vo[1:] - vo[:-1]
+                heap_arr = np.frombuffer(blk.value_heap, dtype=np.uint8)
+                ets_col = new_ets if ets_changed else blk.expire_ts
+                if ets_changed and patch_headers:
+                    # patch the big-endian u32 expire_ts value header in
+                    # place (vectorized scatter, value_schema.h: header
+                    # starts every encoded value)
+                    heap_arr = heap_arr.copy()
+                    chg = np.flatnonzero((new_ets != blk.expire_ts)
+                                         & keep)
+                    if chg.size:
+                        pos = vo[chg]
+                        vals = new_ets[chg].astype(np.uint32)
+                        heap_arr[pos] = (vals >> 24).astype(np.uint8)
+                        heap_arr[pos + 1] = \
+                            ((vals >> 16) & 0xFF).astype(np.uint8)
+                        heap_arr[pos + 2] = \
+                            ((vals >> 8) & 0xFF).astype(np.uint8)
+                        heap_arr[pos + 3] = (vals & 0xFF).astype(np.uint8)
+                if kept.size == n:
+                    new_heap = heap_arr.tobytes()
+                    new_offs = blk.value_offs
+                    keys2d, klen = blk.keys, blk.key_len
+                    hlo, flg = blk.hash_lo, blk.flags
+                    ets_out = ets_col
+                else:
+                    keep_bytes = np.repeat(keep, lens)
+                    new_heap = heap_arr[keep_bytes].tobytes()
+                    kept_lens = lens[kept]
+                    new_offs = np.zeros(kept.size + 1, dtype=np.uint32)
+                    new_offs[1:] = np.cumsum(kept_lens)
+                    keys2d = blk.keys[kept]
+                    klen = blk.key_len[kept]
+                    ets_out = np.asarray(ets_col)[kept]
+                    hlo = blk.hash_lo[kept]
+                    flg = blk.flags[kept]
+                w = roll_writer()
+                w.add_block_columnar(keys2d, klen, ets_out, hlo, flg,
+                                     new_offs, new_heap)
+                written_in_run += kept.size
+            if writer is not None:
+                _submit_finish(writer)
+                writer = None
             new_runs = [f.result() for f in finishing]
+            ok = True
         finally:
+            # an exception mid-rewrite must not leak the pool, in-flight
+            # finish futures, a half-written SSTable handle, or —
+            # critically — already-renamed partial l1-*.sst outputs (a
+            # legacy pre-manifest boot would adopt the highest-seq
+            # orphan as the whole L1)
             finish_pool.shutdown(wait=True)
+            if not ok:
+                for f, w in zip(finishing, finishing_writers):
+                    try:
+                        t = f.result()
+                    except Exception:  # noqa: BLE001 - finish() died
+                        try:
+                            w.abandon()
+                        except Exception:  # noqa: BLE001 - best-effort
+                            pass
+                        continue
+                    try:
+                        t.close()
+                        os.remove(t.path)
+                    except OSError:
+                        pass
+                if writer is not None:
+                    try:
+                        writer.abandon()
+                    except Exception:  # noqa: BLE001 - best-effort
+                        pass
         # memtable/L0 are untouched by construction
         # (bulk_compact_eligible requires them empty)
         self._publish_l1(new_runs, reset_overlay=False)

@@ -1,0 +1,41 @@
+"""Measure tunnel RTT + concurrency scaling: N threads doing tiny
+device_put+device_get rounds. If aggregate round rate scales with
+threads, the link is latency-bound and pipelinable."""
+import time, threading
+import numpy as np
+import jax, jax.numpy as jnp
+
+dev = jax.devices()[0]
+print("device:", dev)
+x = np.zeros(128, np.uint8)
+
+@jax.jit
+def bump(a):
+    return a + 1
+
+# warm
+with jax.default_device(dev):
+    xb = jax.device_put(x, dev)
+    np.asarray(bump(xb))
+
+def rounds(n):
+    with jax.default_device(dev):
+        for _ in range(n):
+            xb = jax.device_put(x, dev)
+            np.asarray(bump(xb))
+
+# serial RTT
+t0 = time.perf_counter(); rounds(10); dt = time.perf_counter() - t0
+print(f"serial RTT: {dt/10*1000:.1f} ms/round")
+
+for nthreads in (2, 4, 8, 16, 32):
+    per = 6
+    ts = [threading.Thread(target=rounds, args=(per,)) for _ in range(nthreads)]
+    t0 = time.perf_counter()
+    for t in ts: t.start()
+    for t in ts: t.join()
+    dt = time.perf_counter() - t0
+    total = nthreads * per
+    print(f"{nthreads:2d} threads: {total} rounds in {dt:.2f}s -> "
+          f"{dt/total*1000:.1f} ms/round effective, "
+          f"{total/dt:.1f} rounds/s")
