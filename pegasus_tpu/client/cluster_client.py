@@ -171,7 +171,13 @@ class ClusterClient:
         last_err = int(ErrorCode.ERR_TIMEOUT)
         for attempt in range(self._max_retries):
             if attempt:
-                self.refresh_config()
+                try:
+                    self.refresh_config()
+                except PegasusError as e:
+                    # an unreachable meta burns this retry, it doesn't
+                    # abort the op: the cached config may still be right
+                    # (and the meta may heal before the next attempt)
+                    last_err = int(e.code)
             p = pidx if partition_hash is None else (
                 partition_hash % self.partition_count)
             primary = self._primary_of(p)
@@ -201,7 +207,10 @@ class ClusterClient:
         last_err = int(ErrorCode.ERR_TIMEOUT)
         for attempt in range(self._max_retries):
             if attempt:
-                self.refresh_config()
+                try:
+                    self.refresh_config()
+                except PegasusError as e:
+                    last_err = int(e.code)
             pidx = partition_hash % self.partition_count
             primary = self._primary_of(pidx)
             if not primary:

@@ -63,6 +63,7 @@ class MetaService:
         # every guardian tick from restarting a slow learn from scratch
         self._pending_learns: Dict[Gpid, Tuple[str, float]] = {}
         self._learn_timeout = 60.0
+        self._learn_resend = 9.0  # re-drive lost add-learner cmds
         # balancer copy-secondary moves waiting on a learn: gpid -> node to
         # remove once the learner lands
         self._pending_moves: Dict[Gpid, str] = {}
@@ -942,10 +943,24 @@ class MetaService:
                         self._send_proposal(victim, app, pidx, new_pc)
                     continue
                 if pending is not None:
-                    learner, started = pending
+                    learner, started = pending[0], pending[1]
+                    last_sent = pending[2] if len(pending) > 2 else started
                     if (now - started < self._learn_timeout
                             and self.fd.is_alive(learner)):
-                        continue  # learn in flight; don't restart it
+                        # learn in flight: re-send the command at a slow
+                        # cadence — the one-shot cmd (or its learn RPCs)
+                        # may have been LOST in a partition/storm, and
+                        # without a re-drive the cure stalls a full
+                        # learn_timeout. The primary's add_learner and
+                        # the learner's learn_request are idempotent.
+                        if now - last_sent >= self._learn_resend:
+                            self._pending_learns[gpid] = (learner,
+                                                          started, now)
+                            self.net.send(self.name, pc.primary,
+                                          "add_learner_cmd",
+                                          {"gpid": gpid,
+                                           "learner": learner})
+                        continue
                     self._pending_moves.pop(gpid, None)  # stale move, if any
                 spare = [n for n in self.fd.alive_workers()
                          if n not in pc.members()]

@@ -79,6 +79,7 @@ class ClusterDuplicator:
         # in-flight mutation: decree + outstanding write rids
         self._inflight_decree: Optional[int] = None
         self._outstanding: Dict[int, bool] = {}
+        self._inflight_ticks = 0
         self._log_offset = 0
         self._log_generation: Optional[int] = None
         replica = stub.get_replica(gpid)
@@ -109,6 +110,8 @@ class ClusterDuplicator:
 
     # ---- shipping ------------------------------------------------------
 
+    RETRY_TICKS = 3  # in-flight ship attempts re-drive after this many
+
     def tick(self) -> None:
         """Load → ship the next committed mutation (one at a time)."""
         from pegasus_tpu.replica.replica import PartitionStatus
@@ -117,7 +120,18 @@ class ClusterDuplicator:
         if replica is None or replica.status != PartitionStatus.PRIMARY:
             return  # dup runs on the primary only (meta re-homes us)
         if self._inflight_decree is not None:
-            return  # waiting on follower acks; replies drive progress
+            # waiting on follower acks — but a LOST shipped write (or a
+            # lost ack) must not wedge the pipeline forever: after a few
+            # ticks, re-resolve and re-ship the same decree. Re-shipping
+            # is safe — dup ops are idempotent on the follower (timetag
+            # conflict resolution discards the stale double-apply).
+            self._inflight_ticks += 1
+            if self._inflight_ticks < self.RETRY_TICKS:
+                return
+            self._fconfig = None
+            self._inflight_decree = None
+            self._outstanding = {}
+            self._inflight_ticks = 0
         if self._fconfig is None:
             if self._config_rid is None:
                 self._request_follower_config()
@@ -153,6 +167,7 @@ class ClusterDuplicator:
         self._inflight_decree = mu.decree
         self._inflight_frame_end = frame_end
         self._outstanding = {}
+        self._inflight_ticks = 0
         for pidx, ops in by_pidx.items():
             primary = self._fconfig["configs"][pidx]["primary"]
             if not primary:

@@ -366,6 +366,16 @@ class Replica:
             ballot=self.config.ballot, decree=decree,
             last_committed=self.last_committed_decree,
             timestamp_us=ts, ops=ops)
+        # fault site: the PRIMARY's own plog write (parity: the 200-series
+        # disk faults hit the primary too — a primary that cannot log must
+        # not ack, and must not send prepares it hasn't durably staged)
+        from pegasus_tpu.utils.fail_point import fail_point
+
+        if fail_point(f"{self.name}::primary_plog_append") is not None:
+            self._traces.pop(decree, None)
+            self._idempotent_responses.pop(decree, None)
+            raise RuntimeError(
+                f"{self.name}: primary plog append failed (fault)")
         self.prepare_list.prepare(mu)
         tracer.add_point("prepare_local")
         self.log.append(mu)
@@ -793,6 +803,13 @@ class Replica:
 
     def _on_learn_request(self, src: str, payload: dict) -> None:
         """Primary chooses the learn type (parity: on_learn :361)."""
+        from pegasus_tpu.utils.fail_point import fail_point
+
+        if fail_point(f"{self.name}::learn_checkpoint") is not None:
+            # checkpoint materialization failed on the learn source: no
+            # response — the learner stays POTENTIAL_SECONDARY and the
+            # guardian's next add-learner proposal retries the learn
+            return
         learner_lc = payload["last_committed"]
         gc_floor = self.server.engine.last_flushed_decree
         if learner_lc >= gc_floor:
@@ -838,6 +855,13 @@ class Replica:
         on_copy_remote_state_completed :1001). An LT_APP checkpoint on a
         DIFFERENT host (no shared fs) is pulled asynchronously through
         the file-transfer service first — the nfs copy_remote_files leg."""
+        from pegasus_tpu.utils.fail_point import fail_point
+
+        if fail_point(f"{self.name}::learn_apply") is not None:
+            # aio failure applying learned state: abort THIS attempt;
+            # the replica stays POTENTIAL_SECONDARY and a later
+            # add-learner round retries from scratch
+            return
         if payload["type"] == LT_APP:
             ckpt = payload["checkpoint_dir"]
             if not (self.shared_fs and os.path.exists(ckpt)):
@@ -912,6 +936,12 @@ class Replica:
         survive GC or duplication stalls forever (parity: the reference
         holds plog GC back by the dup confirmed decree,
         mutation_log.h:213 + duplication progress plumbing)."""
+        from pegasus_tpu.utils.fail_point import fail_point
+
+        if fail_point(f"{self.name}::checkpoint") is not None:
+            # a failed checkpoint must leave the WAL un-GC'd: nothing
+            # durable moved, so recovery still replays everything
+            return
         self.server.engine.flush()
         floor = self.server.engine.last_flushed_decree
         for dup in self.duplicators:

@@ -350,6 +350,11 @@ class ReplicaStub:
             return
         if msg_type == "beacon_ack":
             self._last_beacon_ack = self.sim_clock()
+            # ONLY the meta leader acks beacons, so the acker identifies
+            # the current leader — route direct notifications
+            # (learn_completed / replication_error) there, or they'd
+            # keep going to a dead ex-leader after a meta failover
+            self.meta_addr = src
             return
         if msg_type == "config_sync_reply":
             self._on_config_sync_reply(src, payload)
@@ -659,7 +664,14 @@ class ReplicaStub:
             raise
 
         def upload() -> None:
+            from pegasus_tpu.utils.fail_point import fail_point
+
             try:
+                if fail_point(f"{self.name}::backup_upload") is not None:
+                    # upload to the block service failed: report nothing;
+                    # the meta backup tick re-commands this partition
+                    # until an upload completes
+                    return
                 engine = BackupEngine(LocalBlockService(payload["root"]),
                                       payload["policy"])
                 engine.upload_checkpoint(payload["backup_id"], gpid[0],
@@ -710,10 +722,16 @@ class ReplicaStub:
         from pegasus_tpu.replica.replica import PartitionStatus
         from pegasus_tpu.rpc.codec import OP_INGEST
 
+        from pegasus_tpu.utils.fail_point import fail_point
+
         gpid = tuple(payload["gpid"])
         r = self.replicas.get(gpid)
         if r is None or r.status != PartitionStatus.PRIMARY:
             return  # meta's tick retries against the current primary
+        if fail_point(f"{self.name}::ingest") is not None:
+            # download/ingest failure before the 2PC round: no ack; the
+            # meta bulk-load tick keeps re-commanding until it succeeds
+            return
         load_id = payload.get("load_id", 0)
         key = (gpid, load_id)
         if r.has_ingested(load_id):

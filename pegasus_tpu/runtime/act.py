@@ -128,15 +128,52 @@ class ActRunner:
             c.kill(args[0])
         elif verb == "revive":
             c.revive(args[0])
+        elif verb == "revive_last_killed":
+            if self.last_killed is None:
+                raise ActError("nothing was killed via kill_primary")
+            c.revive(self.last_killed)
         elif verb == "drop":
             c.net.set_drop(float(args[2]), args[0], args[1])
+        elif verb == "drop_all":
+            c.net.set_drop(float(args[0]))
+        elif verb == "delay":
+            # delay: <src> <dst> <ms> — extra fixed latency on one link
+            c.net.set_delay(float(args[2]) / 1000.0, args[0], args[1])
+        elif verb == "partition":
+            # cut a live node off the network entirely (unlike kill:, the
+            # process keeps running — lease expiry, not crash recovery)
+            c.net.partition(args[0])
+        elif verb == "heal":
+            c.net.heal(args[0])
         elif verb == "heal_links":
             c.net._drop_prob.clear()
+            c.net._extra_delay.clear()
         elif verb == "fail_point":
             from pegasus_tpu.utils.fail_point import FAIL_POINTS
 
             FAIL_POINTS.setup()
             FAIL_POINTS.cfg(args[0], " ".join(args[1:]))
+        elif verb == "fail_point_primary":
+            # fail_point_primary: <pidx> <site> <action> — configure
+            # <current primary of pidx>::<site> (cases must not hardcode
+            # which node the seed elected)
+            from pegasus_tpu.utils.fail_point import FAIL_POINTS
+
+            pc = c.meta.state.get_partition(self.app_id, int(args[0]))
+            if not pc.primary:
+                raise ActError("partition has no primary")
+            self.last_fault_node = pc.primary
+            FAIL_POINTS.setup()
+            FAIL_POINTS.cfg(f"{pc.primary}::{args[1]}",
+                            " ".join(args[2:]))
+        elif verb == "fail_point_all":
+            # fail_point_all: <site> <action> — every node
+            from pegasus_tpu.utils.fail_point import FAIL_POINTS
+
+            FAIL_POINTS.setup()
+            for name in c.stubs:
+                FAIL_POINTS.cfg(f"{name}::{args[0]}",
+                                " ".join(args[1:]))
         elif verb == "split":
             c.meta.split.start_partition_split(args[0])
         elif verb == "expect_partition_count":
@@ -235,6 +272,58 @@ class ActRunner:
             if err != OK or value != want:
                 raise ActError(f"follower got (err={err}, {value!r}), "
                                f"wanted {want!r}")
+        elif verb == "write_many":
+            # write_many: <prefix> <n> — n writes fanned over hashkeys;
+            # each must ack (drives schedule diversity under faults)
+            prefix, n = args[0], int(args[1])
+            for i in range(n):
+                hk = f"{prefix}{i % max(1, n // 4)}".encode()
+                err = self.client.set(hk, b"s%04d" % i,
+                                      b"v%04d" % i)
+                if err != OK:
+                    raise ActError(f"write {i} not acked (err {err})")
+        elif verb == "write_many_any":
+            # like write_many but individual writes MAY fail (loss storms,
+            # dead primaries); remembers which acked for expect_many
+            prefix, n = args[0], int(args[1])
+            acked = self.__dict__.setdefault("_acked", {})
+            for i in range(n):
+                hk = f"{prefix}{i % max(1, n // 4)}".encode()
+                try:
+                    err = self.client.set(hk, b"s%04d" % i, b"v%04d" % i)
+                except PegasusError:
+                    continue
+                if err == OK:
+                    acked[(hk, b"s%04d" % i)] = b"v%04d" % i
+        elif verb == "expect_many":
+            # every ACKED write from write_many/_any must read back
+            prefix, n = args[0], int(args[1])
+            acked = self.__dict__.get("_acked")
+            if acked is None:
+                acked = {}
+                for i in range(n):
+                    hk = f"{prefix}{i % max(1, n // 4)}".encode()
+                    acked[(hk, b"s%04d" % i)] = b"v%04d" % i
+            missing = []
+            for (hk, sk), want in acked.items():
+                err, value = self.client.get(hk, sk)
+                if err != OK or value != want:
+                    missing.append((hk, sk, err, value))
+            if missing:
+                raise ActError(
+                    f"{len(missing)}/{len(acked)} acked writes lost; "
+                    f"first: {missing[0]}")
+        elif verb == "flush":
+            # flush: <node>|all — checkpoint storage + GC the WAL on a
+            # node's replicas (pushes later learns onto the LT_APP path)
+            for name, stub in c.stubs.items():
+                if args and args[0] != "all" and name != args[0]:
+                    continue
+                if name in c._dead:
+                    continue
+                for r in list(stub.replicas.values()):
+                    r.flush_and_gc_log()
+            c.loop.run_until_idle()
         elif verb == "step":
             c.step(rounds=int(args[0]) if args else 1)
         elif verb == "expect_primary_not":

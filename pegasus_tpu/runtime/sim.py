@@ -67,6 +67,7 @@ class SimNetwork:
         self.jitter = jitter
         self._handlers: Dict[str, Callable[[str, str, Any], None]] = {}
         self._drop_prob: Dict[Optional[Tuple[str, str]], float] = {}
+        self._extra_delay: Dict[Optional[Tuple[str, str]], float] = {}
         self._partitioned: set = set()
         # per-link FIFO: messages on one (src, dst) link never reorder
         # (parity: rDSN rides TCP; the 2PC protocol assumes ordered
@@ -90,6 +91,16 @@ class SimNetwork:
         key = None if src is None and dst is None else (src, dst)
         self._drop_prob[key] = prob
 
+    def set_delay(self, extra_s: float, src: Optional[str] = None,
+                  dst: Optional[str] = None) -> None:
+        """Add a fixed extra latency to a link (or globally) — the
+        fault_injector's rpc-delay knob. Per-link FIFO order holds."""
+        key = None if src is None and dst is None else (src, dst)
+        if extra_s <= 0:
+            self._extra_delay.pop(key, None)
+        else:
+            self._extra_delay[key] = extra_s
+
     def partition(self, addr: str) -> None:
         """Cut a node off entirely (both directions)."""
         self._partitioned.add(addr)
@@ -106,7 +117,9 @@ class SimNetwork:
         if prob > 0 and self.loop.rng.random() < prob:
             self.dropped += 1
             return
-        delay = self.base_delay + self.loop.rng.random() * self.jitter
+        delay = (self.base_delay + self.loop.rng.random() * self.jitter
+                 + self._extra_delay.get((src, dst),
+                                         self._extra_delay.get(None, 0.0)))
         deliver_at = max(self.loop.now + delay,
                          self._link_clock.get((src, dst), 0.0))
         self._link_clock[(src, dst)] = deliver_at

@@ -96,6 +96,12 @@ class SimCluster:
                     for r in stub.replicas.values():
                         if r.status == PartitionStatus.PRIMARY:
                             r.broadcast_group_check()
+                    # config-sync timer (parity: replica_stub.cpp:944
+                    # query_configuration_by_node): pull reconciliation
+                    # re-delivers config changes whose one-shot proposal
+                    # was LOST — without it a dropped promotion wedges
+                    # the partition until manual intervention
+                    stub.config_sync()
                     stub.dup_tick()
                     stub.split_tick()
                     stub.transfer_tick()
