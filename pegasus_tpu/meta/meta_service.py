@@ -818,7 +818,16 @@ class MetaService:
         if action == "assign_primary":
             if pc.primary == node:
                 return
-            if node not in pc.members() and not force:
+            # a revived ex-member is out of pc.members() (its death was
+            # reconciled away) but still HOLDS the data on disk — its
+            # config-sync stored-replica report proves it. That is the
+            # DDD-recovery case propose exists for (parity: shell
+            # `propose`/`recover`, commands.h:209-211); only a node with
+            # neither membership nor stored data needs `force`.
+            holds_data = any(
+                tuple(e["gpid"]) == gpid
+                for e in self._stored_reports.get(node, []))
+            if node not in pc.members() and not holds_data and not force:
                 raise PegasusError(
                     ErrorCode.ERR_INVALID_PARAMETERS,
                     f"{node} holds no replica of {app_name}.{pidx} — "
@@ -914,7 +923,7 @@ class MetaService:
                     # learner lands, dies, or times out (dropping it early
                     # would let a second move start and over-replicate)
                     if pending is not None:
-                        learner, started = pending
+                        learner, started = pending[0], pending[1]
                         if (learner in pc.members()
                                 or now - started >= self._learn_timeout
                                 or not self.fd.is_alive(learner)):

@@ -76,10 +76,16 @@ class ClusterDuplicator:
         self._fail_count = 0
         self._fconfig: Optional[dict] = None  # follower app config
         self._config_rid: Optional[int] = None
-        # in-flight mutation: decree + outstanding write rids
+        # in-flight mutation: decree + outstanding write rids. rid →
+        # follower pidx, so a LATE ack from a superseded ship attempt of
+        # the same decree still completes that pidx (acks slower than the
+        # re-drive cadence must not be discarded — that livelocks).
         self._inflight_decree: Optional[int] = None
-        self._outstanding: Dict[int, bool] = {}
+        self._outstanding: Dict[int, int] = {}
+        self._pending_pidx: set = set()
+        self._redrive_decree: Optional[int] = None
         self._inflight_ticks = 0
+        self._retry_limit = self.RETRY_TICKS
         self._log_offset = 0
         self._log_generation: Optional[int] = None
         replica = stub.get_replica(gpid)
@@ -125,12 +131,16 @@ class ClusterDuplicator:
             # ticks, re-resolve and re-ship the same decree. Re-shipping
             # is safe — dup ops are idempotent on the follower (timetag
             # conflict resolution discards the stale double-apply).
+            # The old rids stay registered (see _ship) and the re-drive
+            # interval backs off exponentially, so a follower whose RTT
+            # exceeds the base cadence converges instead of livelocking.
             self._inflight_ticks += 1
-            if self._inflight_ticks < self.RETRY_TICKS:
+            if self._inflight_ticks < self._retry_limit:
                 return
+            self._retry_limit = min(self._retry_limit * 2, 64)
             self._fconfig = None
+            self._redrive_decree = self._inflight_decree
             self._inflight_decree = None
-            self._outstanding = {}
             self._inflight_ticks = 0
         if self._fconfig is None:
             if self._config_rid is None:
@@ -166,7 +176,10 @@ class ClusterDuplicator:
             return
         self._inflight_decree = mu.decree
         self._inflight_frame_end = frame_end
-        self._outstanding = {}
+        if mu.decree != self._redrive_decree:
+            self._outstanding = {}  # new decree: prior rids are dead
+        self._redrive_decree = None
+        self._pending_pidx = set(by_pidx)
         self._inflight_ticks = 0
         for pidx, ops in by_pidx.items():
             primary = self._fconfig["configs"][pidx]["primary"]
@@ -176,7 +189,7 @@ class ClusterDuplicator:
                 self._inflight_decree = None
                 return
             rid = next(_RIDS)
-            self._outstanding[rid] = True
+            self._outstanding[rid] = pidx
             auth = None
             if getattr(self.stub, "auth_secret", None):
                 from pegasus_tpu.security.auth import (
@@ -256,10 +269,13 @@ class ClusterDuplicator:
             self._inflight_decree = None
             self._outstanding = {}
             return True
-        del self._outstanding[rid]
-        if not self._outstanding and self._inflight_decree is not None:
+        pidx = self._outstanding.pop(rid)
+        self._pending_pidx.discard(pidx)
+        if not self._pending_pidx and self._inflight_decree is not None:
             self._advance(self._inflight_decree, self._inflight_frame_end)
             self._inflight_decree = None
+            self._outstanding = {}
+            self._retry_limit = self.RETRY_TICKS
         return True
 
     def _advance(self, decree: int, frame_end: int) -> None:

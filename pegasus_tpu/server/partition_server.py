@@ -1313,19 +1313,26 @@ class PartitionServer:
                     hit = hit + lo
                     # byte budget (keys + value-heap span upper bound):
                     # page blob offsets are uint32 and one RPC response
-                    # must stay bounded whatever the values weigh
+                    # must stay bounded whatever the values weigh. A
+                    # keys-only scan serializes no values, so only key
+                    # bytes count — else large-value blocks force
+                    # needless pagination.
                     vo = blk.value_offs
-                    chunk_bytes = (int(hit.size) * blk.keys.shape[1]
-                                   + int(vo[int(hit[-1]) + 1])
-                                   - int(vo[int(hit[0])]))
+                    chunk_bytes = int(hit.size) * blk.keys.shape[1]
+                    if not no_value:
+                        chunk_bytes += (int(vo[int(hit[-1]) + 1])
+                                        - int(vo[int(hit[0])]))
                     if byte_est + chunk_bytes > SCAN_BYTES_CAP:
                         if byte_est == 0:
                             # a single oversized chunk: binary-search the
                             # row prefix that fits (per-row byte cumsum
                             # only for this rare path)
-                            row_bytes = (vo[hit + 1].astype(np.int64)
-                                         - vo[hit].astype(np.int64)
-                                         + blk.keys.shape[1])
+                            row_bytes = np.full(hit.size,
+                                                blk.keys.shape[1],
+                                                dtype=np.int64)
+                            if not no_value:
+                                row_bytes += (vo[hit + 1].astype(np.int64)
+                                              - vo[hit].astype(np.int64))
                             fit = int(np.searchsorted(
                                 np.cumsum(row_bytes), SCAN_BYTES_CAP,
                                 side="right"))

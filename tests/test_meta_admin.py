@@ -173,6 +173,52 @@ def test_ddd_diagnose_and_manual_propose(cluster):
     assert sec not in pc2.members()
 
 
+def test_propose_accepts_revived_node_with_stored_replica(cluster):
+    """A revived ex-member is out of pc.members() after reconciliation
+    but still holds the partition on disk (its config-sync report proves
+    it) — propose assign_primary must accept it WITHOUT force. Parity:
+    DDD recovery, shell propose/recover (commands.h:209-211)."""
+    app_id = cluster.create_table("pr", partition_count=1,
+                                  replica_count=3)
+    c = cluster.client("pr")
+    _fill(c, 10)
+    members = cluster.meta.state.get_partition(app_id, 0).members()
+    for m in members:
+        cluster.kill(m)
+    cluster.step(rounds=8)
+    cluster.revive(members[0])
+    cluster.step(rounds=6)
+    pc = cluster.meta.state.get_partition(app_id, 0)
+    if pc.primary != members[0]:
+        # no force: the stored-replica report must carry the gate
+        cluster.meta.propose("pr", 0, "assign_primary", members[0])
+        cluster.step(rounds=4)
+    assert cluster.meta.state.get_partition(app_id, 0).primary == \
+        members[0]
+    # data survived: the revived replica serves what was written
+    c2 = cluster.client("pr")
+    assert c2.get(b"k000", b"s")[1] == b"v0"
+
+
+def test_propose_rejects_empty_node_without_force(cluster):
+    """A live node holding NEITHER membership NOR stored data is still
+    rejected without force — promoting it would serve empty reads."""
+    app_id = cluster.create_table("pe", partition_count=1,
+                                  replica_count=2)
+    _fill(cluster.client("pe"), 5)
+    pc = cluster.meta.state.get_partition(app_id, 0)
+    outsider = next(n for n in cluster.meta.fd.alive_workers()
+                    if n not in pc.members())
+    cluster.step(rounds=2)  # let config_sync report stored replicas
+    with pytest.raises(PegasusError):
+        cluster.meta.propose("pe", 0, "assign_primary", outsider)
+    # force is the explicit data-loss override and still works
+    cluster.meta.propose("pe", 0, "assign_primary", outsider,
+                         force=True)
+    assert cluster.meta.state.get_partition(app_id, 0).primary == \
+        outsider
+
+
 # ---- backup policy controls ----------------------------------------------
 
 def test_backup_policy_enable_disable_modify(cluster, tmp_path):
