@@ -258,7 +258,7 @@ def run_scans(bc, n_ops, n_partitions, n_hashkeys, seed, record_goal=100,
         for pidx, resps in results.items():
             for resp in resps:
                 records += len(resp.kvs)
-                if resp.context_id >= 0:
+                if resp.context_id >= 0:  # defensive: one_page set
                     client._read("clear_scanner", resp.context_id, pidx)
         pending.clear()
         pending_n = 0
@@ -276,7 +276,8 @@ def run_scans(bc, n_ops, n_partitions, n_hashkeys, seed, record_goal=100,
         pending.setdefault(pidx, []).append(GetScannerRequest(
             start_key=generate_key(start_hk, b""),
             batch_size=scan_len,
-            validate_partition_hash=True))
+            validate_partition_hash=True,
+            one_page=True))
         pending_n += 1
         if pending_n >= scan_batch:
             flush_pending()

@@ -68,6 +68,17 @@ def _load() -> Optional[ctypes.CDLL]:
                 ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
                 ctypes.c_int64, ctypes.c_int32, ctypes.c_void_p,
                 ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p]
+            lib.pegasus_scan_serve_batch.restype = None
+            lib.pegasus_scan_serve_batch.argtypes = [
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+                ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+                ctypes.c_int32, ctypes.c_void_p, ctypes.c_int64,
+                ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p]
             return lib
 
         try:
@@ -152,3 +163,11 @@ def gather_page_fn():
     unavailable. server/page.py owns the calling convention."""
     lib = _load()
     return None if lib is None else lib.pegasus_gather_page
+
+
+def scan_serve_fn():
+    """The whole-batch scan-assembly entry point (see packer.cpp
+    pegasus_scan_serve_batch), or None when the native library is
+    unavailable. server/page.py owns the calling convention."""
+    lib = _load()
+    return None if lib is None else lib.pegasus_scan_serve_batch

@@ -151,6 +151,17 @@ def make_compaction_eval(operations=None):
 COMPACT_CHUNK_ROWS = 1 << 18  # 256k records per stacked program
 
 
+def _row_bucket(n: int) -> int:
+    """Power-of-two row capacity for a stacked program (bounds distinct
+    XLA compilations). Unlike record_block.next_bucket this is a ROW
+    count, not a key width — no 64k ceiling (chunking already bounds it
+    at COMPACT_CHUNK_ROWS plus one block)."""
+    w = 4096
+    while w < n:
+        w <<= 1
+    return w
+
+
 # compaction must move every key byte host->device and the masks back,
 # so eval placement is decided by the shared link probe
 from pegasus_tpu.ops.placement import choose_eval_device  # noqa: F401 (re-export)
@@ -215,11 +226,14 @@ def compaction_eval_submit(blocks, now, default_ttl, partition_version,
             while off < len(group):
                 chunk = []
                 rows = 0
-                while off < len(group) and rows < COMPACT_CHUNK_ROWS:
+                while off < len(group):
+                    n_blk = group[off][1].count
+                    if chunk and rows + n_blk > COMPACT_CHUNK_ROWS:
+                        break  # close the chunk at the row target
                     chunk.append(group[off])
-                    rows += group[off][1].count
+                    rows += n_blk
                     off += 1
-                cap = max(4096, next_bucket(rows))
+                cap = _row_bucket(rows)
                 keys = np.zeros((cap, _w), dtype=np.uint8)
                 key_len = np.zeros(cap, dtype=np.int32)
                 ets = np.zeros(cap, dtype=np.uint32)

@@ -26,6 +26,168 @@ from pegasus_tpu import native
 from pegasus_tpu.server.types import ScanPage
 
 
+def block_native_ptrs(blk):
+    """Cached static pointer row for one Block: (keys, key_len, voffs,
+    heap, ets, width). `.ctypes.data` costs ~a µs per access, so the
+    serving path resolves each block's pointers once per process, not
+    once per request."""
+    nat = getattr(blk, "_nat", None)
+    if nat is None:
+        heap = blk.value_heap
+        if not isinstance(heap, np.ndarray):
+            heap = np.frombuffer(heap, dtype=np.uint8)
+        nat = (blk.keys.ctypes.data, blk.key_len.ctypes.data,
+               blk.value_offs.ctypes.data,
+               heap.ctypes.data if heap.size else 0,
+               blk.expire_ts.ctypes.data, blk.keys.shape[1], heap)
+        blk._nat = nat
+    return nat
+
+
+def serve_batch(req_windows, unique, byte_cap: int, hdr: int):
+    """Whole-BATCH base-path assembly in ONE native call.
+
+    req_windows: per fast-path request (plan, want, no_value,
+    want_ets, live_masks) where plan is [(ckey, Block, lo, hi)] in key
+    order and live_masks maps ckey -> bool[count] (that request's
+    static keep AND host TTL — PER WINDOW, because filter flavors
+    sharing a block carry different masks); unique: OrderedDict
+    ckey -> (run, bm, blk) covering every planned block (may span
+    partitions).
+
+    Packs every request's surviving rows into shared arenas via
+    packer.cpp pegasus_scan_serve_batch — the C++ twin of the
+    reference's per-record serving loop
+    (src/server/pegasus_server_impl.cpp:643) — then cuts per-request
+    ScanPages out of the arenas.
+
+    Returns [(page, size, last_key, truncated) | None] per request
+    (None = re-serve that request in Python: arena capacity hit), or
+    None entirely when the native library is unavailable.
+    """
+    fn = native.scan_serve_fn()
+    if fn is None or not req_windows:
+        return None
+    want_ets = any(w[3] for w in req_windows)
+    n_blocks = len(unique)
+    ptrs = np.empty((6, n_blocks), dtype=np.uint64)
+    block_idx = {}
+    for b, (ckey, (_run, _bm, blk)) in enumerate(unique.items()):
+        kp, lp, vp, hp, ep, w, _heap = block_native_ptrs(blk)
+        ptrs[0, b] = kp
+        ptrs[1, b] = w
+        ptrs[2, b] = lp
+        ptrs[3, b] = vp
+        ptrs[4, b] = hp
+        ptrs[5, b] = ep
+        block_idx[ckey] = b
+    widths = ptrs[1].astype(np.int64)
+
+    n_reqs = len(req_windows)
+    n_entries = sum(len(w[0]) for w in req_windows)
+    entry_start = np.zeros(n_reqs + 1, dtype=np.int64)
+    entry_block = np.empty(n_entries, dtype=np.int64)
+    entry_mask = np.empty(n_entries, dtype=np.uint64)
+    entry_lo = np.empty(n_entries, dtype=np.int64)
+    entry_hi = np.empty(n_entries, dtype=np.int64)
+    wants = np.empty(n_reqs, dtype=np.int64)
+    no_values = np.empty(n_reqs, dtype=np.uint8)
+    row_base = np.empty(n_reqs, dtype=np.int64)
+    mask_refs = []  # keep per-flavor mask arrays alive across the call
+    mask_ptr_cache = {}
+    e = 0
+    rows_total = 0
+    key_cap = 0
+    val_cap = 0
+    for r, (plan, want, no_value, _we, live_masks) in \
+            enumerate(req_windows):
+        row_base[r] = rows_total + r  # +r: offsets windows are count+1
+        total_rows = 0
+        span = 0
+        max_w = 2
+        for ckey, blk, lo, hi in plan:
+            b = block_idx[ckey]
+            entry_block[e] = b
+            mkey = (id(live_masks), ckey)
+            mp = mask_ptr_cache.get(mkey)
+            if mp is None:
+                mask = live_masks[ckey]
+                mask_refs.append(mask)
+                mp = mask.ctypes.data
+                mask_ptr_cache[mkey] = mp
+            entry_mask[e] = mp
+            entry_lo[e] = lo
+            entry_hi[e] = hi
+            e += 1
+            total_rows += hi - lo
+            if not no_value:
+                vo = blk.value_offs
+                span += int(vo[hi]) - int(vo[lo])
+            w = blk.keys.shape[1]
+            if w > max_w:
+                max_w = w
+        entry_start[r + 1] = e
+        cap_rows = min(want, total_rows)
+        wants[r] = cap_rows
+        no_values[r] = no_value
+        rows_total += cap_rows
+        key_cap += cap_rows * max_w
+        val_cap += min(byte_cap + (64 << 10), span)
+    if key_cap >= 1 << 32 or val_cap >= 1 << 32:
+        # running arena offsets are uint32: a flush whose combined
+        # spans pass 4 GiB must take the per-request Python path (which
+        # enforces its own per-request caps) instead of wrapping
+        return None
+    key_blob = np.empty(max(1, key_cap), dtype=np.uint8)
+    val_blob = np.empty(max(1, val_cap), dtype=np.uint8)
+    key_offs = np.zeros(rows_total + n_reqs + 1, dtype=np.uint32)
+    val_offs = np.zeros(rows_total + n_reqs + 1, dtype=np.uint32)
+    ets_arena = (np.empty(max(1, rows_total), dtype=np.uint32)
+                 if want_ets else None)
+    out_count = np.zeros(n_reqs, dtype=np.int64)
+    out_bytes = np.zeros(n_reqs, dtype=np.int64)
+    out_state = np.zeros(n_reqs, dtype=np.int32)
+    fn(ptrs[0].ctypes.data, widths.ctypes.data, ptrs[2].ctypes.data,
+       entry_mask.ctypes.data, ptrs[3].ctypes.data, ptrs[4].ctypes.data,
+       ptrs[5].ctypes.data, n_reqs, entry_start.ctypes.data,
+       entry_block.ctypes.data, entry_lo.ctypes.data,
+       entry_hi.ctypes.data, wants.ctypes.data, no_values.ctypes.data,
+       byte_cap, hdr, key_blob.ctypes.data, key_cap,
+       val_blob.ctypes.data, val_cap, key_offs.ctypes.data,
+       val_offs.ctypes.data, row_base.ctypes.data,
+       ets_arena.ctypes.data if want_ets else None,
+       out_count.ctypes.data, out_bytes.ctypes.data,
+       out_state.ctypes.data)
+
+    results = []
+    for r in range(n_reqs):
+        state = int(out_state[r])
+        if state == 3:
+            results.append(None)  # arena full: Python re-serves
+            continue
+        count = int(out_count[r])
+        truncated = state == 2
+        if count == 0:
+            results.append((ScanPage(), 0, None, truncated))
+            continue
+        base = int(row_base[r])
+        ko = key_offs[base:base + count + 1]
+        vo = val_offs[base:base + count + 1]
+        k0, k1 = int(ko[0]), int(ko[count])
+        v0, v1 = int(vo[0]), int(vo[count])
+        page = ScanPage(
+            key_offs=(ko - np.uint32(k0)).tobytes(),
+            key_blob=key_blob[k0:k1].tobytes(),
+            val_offs=(vo - np.uint32(v0)).tobytes(),
+            val_blob=val_blob[v0:v1].tobytes())
+        if req_windows[r][3]:
+            page.ets = ets_arena[base - r:base - r + count].astype(
+                "<u4").tobytes()
+        last_key = key_blob[int(ko[count - 1]):k1].tobytes()
+        results.append((page, int(out_bytes[r]), last_key, truncated))
+    return results
+
+
 def build_page(chunks: List[Tuple[object, np.ndarray]], hdr: int,
                no_value: bool = False, want_ets: bool = False,
                ) -> Tuple[ScanPage, int, Optional[bytes]]:
@@ -74,9 +236,12 @@ def build_page(chunks: List[Tuple[object, np.ndarray]], hdr: int,
         m = len(take)
         take = np.ascontiguousarray(take, dtype=np.int64)
         if fn is not None:
+            heap = blk.value_heap
+            if not isinstance(heap, np.ndarray):
+                heap = np.frombuffer(heap, dtype=np.uint8)
             fn(blk.keys.ctypes.data, blk.keys.shape[1],
                blk.key_len.ctypes.data, blk.value_offs.ctypes.data,
-               bytes(blk.value_heap),
+               heap.ctypes.data if heap.size else None,
                take.ctypes.data, m, hdr,
                kb.ctypes.data, key_offs[pos:].ctypes.data,
                (vb.ctypes.data if not no_value and vb is not None
@@ -117,7 +282,8 @@ def _gather_python(blk, take, hdr, no_value, kb, key_offs, vb, val_offs,
         vl = max(0, v1 - v0 - hdr)
         if not no_value:
             if vl:
-                vb[vpos:vpos + vl] = np.frombuffer(
-                    heap, dtype=np.uint8, count=vl, offset=v0 + hdr)
+                if not isinstance(heap, np.ndarray):
+                    heap = np.frombuffer(heap, dtype=np.uint8)
+                vb[vpos:vpos + vl] = heap[v0 + hdr:v1]
             vpos += vl
         val_offs[pos + j + 1] = vpos

@@ -116,7 +116,21 @@ SCAN_BYTES_CAP = 64 << 20
 
 
 def _lower_bound(blk, key: bytes) -> int:
-    """First row index in a sorted SST block whose key >= `key`."""
+    """First row index in a sorted SST block whose key >= `key`.
+
+    Hot blocks (zipfian traffic re-plans the same boundaries) bisect
+    C-speed over the materialized key list; cold blocks keep the
+    O(log n) row-probe loop so a one-shot uniform scan never pays the
+    full materialization (same gating as SSTable.get)."""
+    import bisect as _b
+
+    kl = blk._key_list
+    if kl is None:
+        blk._gets += 1
+        if blk._gets >= 4:
+            kl = blk.key_list()
+    if kl is not None:
+        return _b.bisect_left(kl, key)
     lo, hi = 0, blk.count
     while lo < hi:
         mid = (lo + hi) // 2
@@ -944,7 +958,9 @@ class PartitionServer:
                 size += len(key) + len(data)
             self.cu.add_read(size)
         resp.error = int(StorageStatus.OK)
-        if exhausted:
+        if exhausted or req.one_page:
+            # one_page: the client promised not to page further — no
+            # context to cache, no clear_scanner round-trip later
             resp.context_id = SCAN_CONTEXT_ID_COMPLETED
         else:
             resp.context_id = self._scan_cache.put(ScanContext(
@@ -1212,7 +1228,61 @@ class PartitionServer:
             self.store_mask(state, ckey, keep)
         return keep_masks
 
-    def finish_scan_batch(self, state, keep_masks
+    def prepare_serve(self, state, keep_masks) -> list:
+        """Phase 2.5: combine static keep with host TTL per unique
+        block, compute each request's overlay window + plan frontier,
+        and return the batch's fast-path (overlay-free) request windows
+        `(plan, want, no_value, want_ets)` for native assembly. The
+        node-level coordinator concatenates these ACROSS partitions so
+        one native call (page.serve_batch) packs every fast request of
+        a whole flush. Everything is stashed in `state`; idempotent."""
+        if "precomputed" in state or "windows" in state:
+            return state.get("fast", [])
+        import bisect as _bisect
+
+        unique = state["unique"]
+        now = state["now"]
+        live_masks = {}
+        alive_all = {}
+        exp_full = {}
+        for ckey, (_run, _bm, blk) in unique.items():
+            ets = blk.expire_ts
+            alive = blk.alive_mask(now)
+            alive_all[ckey] = alive
+            # whole-block expired count once per unique block; requests
+            # spanning the full block (the common case) reuse the
+            # scalar, boundary slices recount
+            exp_full[ckey] = len(alive) - int(np.count_nonzero(alive))
+            live_masks[ckey] = keep_masks[ckey][:len(ets)] & alive
+        overlay_keys, _overlay_map = state["overlay"]
+        windows = []
+        fast = []
+        for req, start_key, stop_key, want, plan in state["req_plans"]:
+            capped = (plan and sum(hi - lo for _c, _b, lo, hi in plan)
+                      >= want * 2 + 64)
+            frontier = (_after(plan[-1][1].key_at(plan[-1][1].count - 1))
+                        if capped else None)
+            ov_lo = (_bisect.bisect_left(overlay_keys, start_key)
+                     if start_key else 0)
+            ov_hi = len(overlay_keys)
+            if stop_key:
+                ov_hi = _bisect.bisect_left(overlay_keys, stop_key,
+                                            ov_lo)
+            if frontier is not None:
+                ov_hi = _bisect.bisect_left(overlay_keys, frontier,
+                                            ov_lo, ov_hi)
+            windows.append((capped, frontier, ov_lo, ov_hi))
+            if ov_lo >= ov_hi:
+                fast.append((plan, want, req.no_value,
+                             req.return_expire_ts, live_masks))
+        state["live_masks"] = live_masks
+        state["alive_all"] = alive_all
+        state["exp_full"] = exp_full
+        state["windows"] = windows
+        state["fast"] = fast
+        return fast
+
+    def finish_scan_batch(self, state, keep_masks, served=None
                           ) -> List[ScanResponse]:
         """Phase 3: assemble responses from (shared) STATIC masks.
 
@@ -1220,41 +1290,36 @@ class PartitionServer:
         static mask with the block's expire_ts column per unique block
         (`now` is the batch's single clock reading). This is the other
         half of the static/dynamic predicate split — the device never
-        re-evaluates a block just because the clock ticked."""
+        re-evaluates a block just because the clock ticked.
+
+        `served`: this batch's slice of the coordinator's cross-
+        partition native assembly (aligned with prepare_serve's fast
+        list); None = run the native assembly here (solo callers)."""
         if "precomputed" in state:
             return state["precomputed"]
         reqs = state["reqs"]
         req_plans = state["req_plans"]
-        overlay = state["overlay"]
         unique = state["unique"]
         now = state["now"]
         t0 = state["t0"]
-        # 3 — combine static keep with host TTL once per unique block,
-        # then assemble each response from the shared masks, merging the
-        # host-side overlay in key order (overlay rows SHADOW base rows:
-        # newest wins, tombstones hide)
-        import bisect
 
-        from pegasus_tpu.ops.predicates import host_alive_mask
-        from pegasus_tpu.server.page import build_page
+        from pegasus_tpu.server.page import build_page, serve_batch
 
-        live_masks = {}
-        alive_all = {}
-        exp_full = {}
-        for ckey, (_run, _bm, blk) in unique.items():
-            ets = blk.expire_ts
-            alive = host_alive_mask(ets, now)
-            alive_all[ckey] = alive
-            # whole-block expired count once per unique block; requests
-            # spanning the full block (the common case) reuse the
-            # scalar, boundary slices recount
-            exp_full[ckey] = len(alive) - int(np.count_nonzero(alive))
-            live_masks[ckey] = keep_masks[ckey][:len(ets)] & alive
-
-        overlay_keys, overlay_map = overlay
+        fast = self.prepare_serve(state, keep_masks)
+        live_masks = state["live_masks"]
+        alive_all = state["alive_all"]
+        exp_full = state["exp_full"]
+        windows = state["windows"]
+        overlay_keys, overlay_map = state["overlay"]
         hdr = header_length(self.data_version)
+        if served is None and fast:
+            served = serve_batch(fast, unique, SCAN_BYTES_CAP, hdr)
+        served_iter = iter(served) if served is not None else None
+
         out = []
-        for req, start_key, stop_key, want, plan in req_plans:
+        for (req, start_key, stop_key, want, plan), \
+                (capped, frontier, ov_lo, ov_hi) in zip(req_plans,
+                                                        windows):
             kvs: list = []
             size = 0
             exhausted = True
@@ -1279,28 +1344,28 @@ class PartitionServer:
                 else:
                     req_expired += int(np.count_nonzero(
                         ~alive_all[ckey][lo:hi]))
-            # plan frontier: where a budget-capped base plan ends — the
-            # overlay must not run ahead of it (resume correctness)
-            capped = (plan and sum(hi - lo for _c, _b, lo, hi in plan)
-                      >= want * 2 + 64)
-            frontier = (_after(plan[-1][1].key_at(plan[-1][1].count - 1))
-                        if capped else None)
-            ov_lo = (bisect.bisect_left(overlay_keys, start_key)
-                     if start_key else 0)
-            ov_hi = len(overlay_keys)
-            if stop_key:
-                ov_hi = bisect.bisect_left(overlay_keys, stop_key, ov_lo)
-            if frontier is not None:
-                ov_hi = bisect.bisect_left(overlay_keys, frontier,
-                                           ov_lo, ov_hi)
             ov_i = ov_lo
             if ov_lo >= ov_hi:
                 # fast path: no overlay rows shadow this window, so the
-                # kept base rows ARE the answer — gather every survivor
-                # into ONE columnar ScanPage (native batched
-                # gather/serialize, server/page.py) instead of building
-                # per-record KeyValues
-                chunks = []
+                # kept base rows ARE the answer — already assembled by
+                # the batch native call (page.serve_batch -> packer.cpp
+                # pegasus_scan_serve_batch); the vectorized-numpy path
+                # below is the no-toolchain / arena-overflow fallback.
+                served = (next(served_iter) if served_iter is not None
+                          else None)
+                if served is not None:
+                    kvs, size, last_key, truncated = served
+                    taken = len(kvs)
+                    if ((taken >= want or truncated)
+                            and last_key is not None):
+                        resume_key = _after(last_key)
+                        stop_early = True
+                    chunks = None
+                else:
+                    chunks = []
+            else:
+                chunks = None
+            if chunks is not None:
                 taken = 0
                 byte_est = 0
                 truncated = False
@@ -1351,7 +1416,7 @@ class PartitionServer:
                 if (taken >= want or truncated) and last_key is not None:
                     resume_key = _after(last_key)
                     stop_early = True
-            else:
+            elif ov_lo < ov_hi:
                 # merge path: interleave overlay rows in key order
                 # (overlay rows SHADOW base rows: newest wins,
                 # tombstones hide)
@@ -1402,7 +1467,7 @@ class PartitionServer:
             resp.kvs = kvs
             self.cu.add_read(size)
             resp.error = int(StorageStatus.OK)
-            if exhausted:
+            if exhausted or req.one_page:
                 resp.context_id = SCAN_CONTEXT_ID_COMPLETED
             else:
                 resp.context_id = self._scan_cache.put(ScanContext(

@@ -102,6 +102,42 @@ def scan_multi(servers_and_reqs: List[Tuple[object, list]],
         else:
             _eval_cross_partition_multi(flavors, validate, pv)
 
+    # cross-partition native assembly: concatenate every partition's
+    # fast-path (overlay-free) requests and pack them with ONE native
+    # call per flush (page.serve_batch -> pegasus_scan_serve_batch) —
+    # per-partition batches are tiny (a 32-scan flush spread over 64
+    # partitions), so amortizing the call setup across the whole flush
+    # is what makes the C++ path pay
+    from pegasus_tpu.server.page import serve_batch
+    from pegasus_tpu.server.partition_server import (
+        SCAN_BYTES_CAP,
+        header_length,
+    )
+
+    fast_all: list = []
+    fast_refs: list = []
+    uniq_all: "OrderedDict[tuple, tuple]" = OrderedDict()
+    hdr_set = set()
+    for server, reqs, sub in states:
+        for _idxs, state in sub:
+            if state is None or "precomputed" in state:
+                continue
+            fast = server.prepare_serve(state, state["cached_keep"])
+            if not fast:
+                continue
+            hdr_set.add(header_length(server.data_version))
+            fast_refs.append((state, len(fast)))
+            fast_all.extend(fast)
+            uniq_all.update(state["unique"])
+    if fast_all and len(hdr_set) == 1:
+        served_all = serve_batch(fast_all, uniq_all,
+                                 SCAN_BYTES_CAP, hdr_set.pop())
+        if served_all is not None:
+            off = 0
+            for state, n in fast_refs:
+                state["_served"] = served_all[off:off + n]
+                off += n
+
     out = []
     for server, reqs, sub in states:
         resps = [None] * len(reqs)
@@ -111,8 +147,9 @@ def scan_multi(servers_and_reqs: List[Tuple[object, list]],
             elif "precomputed" in state:
                 rs = state["precomputed"]
             else:
-                rs = server.finish_scan_batch(state,
-                                              state["cached_keep"])
+                rs = server.finish_scan_batch(
+                    state, state["cached_keep"],
+                    served=state.pop("_served", None))
             for i, r in zip(idxs, rs):
                 resps[i] = r
         out.append(resps)

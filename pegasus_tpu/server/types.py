@@ -285,6 +285,10 @@ class GetScannerRequest:
     return_expire_ts: bool = False
     full_scan: bool = False
     only_return_count: bool = False
+    # one-shot ranged read: serve a single page and never cache a scan
+    # context — the client promises not to page further, saving it the
+    # clear_scanner round-trip (the YCSB-E "scan N records" shape)
+    one_page: bool = False
 
 
 @dataclass

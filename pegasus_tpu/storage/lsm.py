@@ -444,7 +444,7 @@ class LSMStore:
             if writer is None:
                 writer = SSTableWriter(self._next_path("l1"),
                                        block_capacity=self._block_capacity,
-                                       meta=meta)
+                                       meta=meta, async_io=True)
             return writer
 
         def copy_block(blk) -> None:
@@ -476,7 +476,9 @@ class LSMStore:
                     continue
                 vo = blk.value_offs.astype(np.int64)
                 lens = vo[1:] - vo[:-1]
-                heap_arr = np.frombuffer(blk.value_heap, dtype=np.uint8)
+                heap_arr = blk.value_heap
+                if not isinstance(heap_arr, np.ndarray):
+                    heap_arr = np.frombuffer(heap_arr, dtype=np.uint8)
                 ets_col = new_ets if ets_changed else blk.expire_ts
                 if ets_changed and patch_headers:
                     # patch the big-endian u32 expire_ts value header in
@@ -495,14 +497,14 @@ class LSMStore:
                             ((vals >> 8) & 0xFF).astype(np.uint8)
                         heap_arr[pos + 3] = (vals & 0xFF).astype(np.uint8)
                 if kept.size == n:
-                    new_heap = heap_arr.tobytes()
+                    new_heap = heap_arr
                     new_offs = blk.value_offs
                     keys2d, klen = blk.keys, blk.key_len
                     hlo, flg = blk.hash_lo, blk.flags
                     ets_out = ets_col
                 else:
                     keep_bytes = np.repeat(keep, lens)
-                    new_heap = heap_arr[keep_bytes].tobytes()
+                    new_heap = heap_arr[keep_bytes]
                     kept_lens = lens[kept]
                     new_offs = np.zeros(kept.size + 1, dtype=np.uint32)
                     new_offs[1:] = np.cumsum(kept_lens)

@@ -66,7 +66,8 @@ class Block:
     """A decoded columnar block; arrays are views over the file bytes\n    (plus, for blocks that prove hot, one lazily materialized Python\n    key list — see key_list())."""
 
     __slots__ = ("keys", "key_len", "expire_ts", "hash_lo", "flags",
-                 "value_offs", "value_heap", "_key_list", "_gets")
+                 "value_offs", "value_heap", "_key_list", "_gets",
+                 "_nat", "_cmp")
 
     def __init__(self, keys, key_len, expire_ts, hash_lo, flags, value_offs,
                  value_heap):
@@ -78,7 +79,7 @@ class Block:
         self.hash_lo = hash_lo        # uint32[N]
         self.flags = flags            # uint8[N]
         self.value_offs = value_offs  # uint32[N+1]
-        self.value_heap = value_heap  # bytes
+        self.value_heap = value_heap  # uint8[heap] (zero-copy file view)
 
     @property
     def count(self) -> int:
@@ -86,6 +87,19 @@ class Block:
 
     def key_at(self, i: int) -> bytes:
         return self.keys[i, :self.key_len[i]].tobytes()
+
+    def alive_mask(self, now: int):
+        """bool[count] TTL-alive mask, cached per `now` second — every
+        batch in the same second reuses it (TTL validity granularity is
+        one second)."""
+        cached = getattr(self, "_cmp", None)
+        if cached is not None and cached[0] == now:
+            return cached[1]
+        from pegasus_tpu.ops.predicates import host_alive_mask
+
+        mask = host_alive_mask(self.expire_ts, now)
+        self._cmp = (now, mask)
+        return mask
 
     def key_list(self) -> list:
         """All keys as a sorted Python list, materialized at most once
@@ -101,26 +115,73 @@ class Block:
         return kl
 
     def value_at(self, i: int) -> bytes:
-        return self.value_heap[self.value_offs[i]:self.value_offs[i + 1]]
+        return self.value_heap[
+            self.value_offs[i]:self.value_offs[i + 1]].tobytes()
 
     def is_tombstone(self, i: int) -> bool:
         return bool(self.flags[i] & FLAG_TOMBSTONE)
 
 
 class SSTableWriter:
-    """Writes a sorted record stream into a columnar SST."""
+    """Writes a sorted record stream into a columnar SST.
+
+    `async_io=True` moves file writes onto a background thread (bounded
+    queue): the caller's (single) core keeps gathering/evaluating while
+    the kernel drains the write stream — the IO half of the compaction
+    double-buffering. Ordering per writer is preserved (one thread, one
+    FIFO); finish() joins the queue before writing the index, so the
+    durability contract (data before index before rename) is unchanged."""
 
     def __init__(self, path: str, block_capacity: int = BLOCK_CAPACITY,
-                 meta: Optional[dict] = None) -> None:
+                 meta: Optional[dict] = None,
+                 async_io: bool = False) -> None:
         self.path = path
         self._block_capacity = block_capacity
         self._meta = dict(meta or {})
         self._f = open_data_file(path + ".tmp", "wb")
-        self._f.write(MAGIC)
         self._blocks: List[BlockMeta] = []
         self._pending: List[Tuple[bytes, bytes, int, int]] = []
         self._last_key: Optional[bytes] = None
         self._count = 0
+        self._offset = 0  # logical file position (writes may be queued)
+        self._io_q = None
+        self._io_thread = None
+        self._io_err: List[BaseException] = []
+        if async_io:
+            import queue
+            import threading
+
+            self._io_q = queue.Queue(maxsize=8)
+            self._io_thread = threading.Thread(
+                target=self._io_loop, name="sst-io", daemon=True)
+            self._io_thread.start()
+        self._write(MAGIC)
+
+    def _io_loop(self) -> None:
+        while True:
+            buf = self._io_q.get()
+            if buf is None:
+                return
+            try:
+                if not self._io_err:
+                    self._f.write(buf)
+            except BaseException as e:  # noqa: BLE001 - surfaced at join
+                self._io_err.append(e)
+
+    def _write(self, buf) -> None:
+        self._offset += len(buf)
+        if self._io_q is not None:
+            self._io_q.put(buf)
+        else:
+            self._f.write(buf)
+
+    def _join_io(self) -> None:
+        if self._io_thread is not None:
+            self._io_q.put(None)
+            self._io_thread.join()
+            self._io_thread = None
+            if self._io_err:
+                raise self._io_err[0]
 
     def add(self, key: bytes, value: bytes, expire_ts: int = 0,
             tombstone: bool = False) -> None:
@@ -166,17 +227,15 @@ class SSTableWriter:
         hash_lo = (crc64_batch(keys, region_len, start=2)
                    & np.uint64(0xFFFFFFFF)).astype(np.uint32)
 
-        offset = self._f.tell()
-        self._f.write(_BLOCK_HDR.pack(n, width, len(heap)))
-        self._f.write(keys.tobytes())
-        self._f.write(key_len.tobytes())
-        self._f.write(ets.tobytes())
-        self._f.write(hash_lo.tobytes())
-        self._f.write(flags.tobytes())
-        self._f.write(offs.tobytes())
-        self._f.write(heap)
+        offset = self._offset
+        # ONE buffer per block: a single kernel copy + syscall instead of
+        # eight, and a single unit for the async-IO queue
+        self._write(b"".join((
+            _BLOCK_HDR.pack(n, width, len(heap)), keys.tobytes(),
+            key_len.tobytes(), ets.tobytes(), hash_lo.tobytes(),
+            flags.tobytes(), offs.tobytes(), heap)))
         self._blocks.append(BlockMeta(
-            offset=offset, size=self._f.tell() - offset, count=n,
+            offset=offset, size=self._offset - offset, count=n,
             key_width=width, first_key=recs[0][0], last_key=recs[-1][0]))
 
     def add_block_columnar(self, keys: np.ndarray, key_len: np.ndarray,
@@ -195,27 +254,25 @@ class SSTableWriter:
         if self._last_key is not None and first_key <= self._last_key:
             raise ValueError("blocks must be added in key order")
         width = int(keys.shape[1])
-        offset = self._f.tell()
-        self._f.write(_BLOCK_HDR.pack(n, width, len(heap)))
-        self._f.write(np.ascontiguousarray(keys, dtype=np.uint8).tobytes())
-        self._f.write(np.ascontiguousarray(key_len,
-                                           dtype=np.int32).tobytes())
-        self._f.write(np.ascontiguousarray(ets, dtype=np.uint32).tobytes())
-        self._f.write(np.ascontiguousarray(hash_lo,
-                                           dtype=np.uint32).tobytes())
-        self._f.write(np.ascontiguousarray(flags,
-                                           dtype=np.uint8).tobytes())
-        self._f.write(np.ascontiguousarray(value_offs,
-                                           dtype=np.uint32).tobytes())
-        self._f.write(heap)
+        offset = self._offset
+        self._write(b"".join((
+            _BLOCK_HDR.pack(n, width, len(heap)),
+            np.ascontiguousarray(keys, dtype=np.uint8).tobytes(),
+            np.ascontiguousarray(key_len, dtype=np.int32).tobytes(),
+            np.ascontiguousarray(ets, dtype=np.uint32).tobytes(),
+            np.ascontiguousarray(hash_lo, dtype=np.uint32).tobytes(),
+            np.ascontiguousarray(flags, dtype=np.uint8).tobytes(),
+            np.ascontiguousarray(value_offs, dtype=np.uint32).tobytes(),
+            heap)))
         self._blocks.append(BlockMeta(
-            offset=offset, size=self._f.tell() - offset, count=n,
+            offset=offset, size=self._offset - offset, count=n,
             key_width=width, first_key=first_key, last_key=last_key))
         self._count += n
         self._last_key = last_key
 
     def finish(self) -> None:
         self._flush_block()
+        self._join_io()
         index = {
             "blocks": [
                 {"off": b.offset, "size": b.size, "count": b.count,
@@ -244,6 +301,10 @@ class SSTableWriter:
             os.close(dir_fd)
 
     def abandon(self) -> None:
+        try:
+            self._join_io()
+        except BaseException:  # noqa: BLE001 - abandoning anyway
+            pass
         self._f.close()
         try:
             os.remove(self.path + ".tmp")
@@ -255,8 +316,24 @@ class SSTable:
     """Reader with an in-memory index and a small block cache."""
 
     def __init__(self, path: str, cache_blocks: int = 64) -> None:
+        import io as _io
+        import mmap as _mmap
+
         self.path = path
         self._f = open_data_file(path, "rb")
+        # plaintext files are mmapped: read_block decodes ZERO-COPY numpy
+        # views straight over the page cache (no read() copy, no seek
+        # syscalls). Encrypted files (CipherFile) keep the read() path.
+        # The map is never explicitly closed — cached Blocks hold views
+        # into it, and Linux keeps the mapping alive past close()/unlink
+        # until the last view dies.
+        self._mv: Optional[memoryview] = None
+        if isinstance(self._f, _io.BufferedReader):
+            try:
+                self._mv = memoryview(_mmap.mmap(
+                    self._f.fileno(), 0, access=_mmap.ACCESS_READ))
+            except (ValueError, OSError):
+                self._mv = None  # empty file or no-mmap fs
         self._f.seek(0, os.SEEK_END)
         file_size = self._f.tell()
         if file_size < len(MAGIC) + FOOTER.size:
@@ -300,8 +377,11 @@ class SSTable:
         if blk is not None:
             return blk
         bm = self.blocks[idx]
-        self._f.seek(bm.offset)
-        raw = self._f.read(bm.size)
+        if self._mv is not None:
+            raw = self._mv[bm.offset:bm.offset + bm.size]
+        else:
+            self._f.seek(bm.offset)
+            raw = self._f.read(bm.size)
         n, width, heap_size = _BLOCK_HDR.unpack_from(raw, 0)
         pos = _BLOCK_HDR.size
         keys = np.frombuffer(raw, dtype=np.uint8, count=n * width,
@@ -320,7 +400,8 @@ class SSTable:
         pos += n
         offs = np.frombuffer(raw, dtype=np.uint32, count=n + 1, offset=pos)
         pos += 4 * (n + 1)
-        heap = raw[pos:pos + heap_size]
+        heap = np.frombuffer(raw, dtype=np.uint8, count=heap_size,
+                             offset=pos)
         blk = Block(keys, key_len, ets, hash_lo, flags, offs, heap)
         if len(self._cache) >= self._cache_cap:
             self._cache.pop(next(iter(self._cache)))
