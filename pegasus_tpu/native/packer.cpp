@@ -184,31 +184,6 @@ void pegasus_gather_page(const uint8_t* keys, int64_t key_width,
   }
 }
 
-// Serve one scan request's base-path assembly over its planned blocks
-// in ONE call: walk each block's surviving rows (live mask) in key
-// order, pack keys + user-data (value minus `hdr` bytes) into the
-// response blobs with running offsets, and stop at the row target or
-// the byte budget.
-//
-// Role parity: the whole per-record serving loop of
-// src/server/pegasus_server_impl.cpp:643 (on_scan iteration +
-// validate/append per record) — here one native call per request
-// replaces the per-block flatnonzero/slice/gather Python.
-//
-//   *_ptrs      uint64[n_blocks]  addresses of each block's column
-//                                 arrays (keys / key_len int32 /
-//                                 live-mask uint8 / value_offs uint32 /
-//                                 heap / expire_ts uint32)
-//   los, his    int64[n_blocks]   row windows per block
-//   want        max rows to take
-//   byte_budget response-byte cap (keys + values; keys only when
-//               no_value)
-//   key_offs / val_offs  uint32[want+1]; [0] preset by the caller
-//   ets_out     uint32[want] (want_ets) or NULL
-//   out_state   0 = plan exhausted, 1 = stopped at want,
-//               2 = stopped by byte budget / blob capacity (truncated),
-//               3 = first row exceeds blob capacity (caller falls back)
-// Returns rows taken.
 // Serve a whole BATCH of scan requests' base-path assembly in one
 // call. The caller passes a table of the batch's unique blocks
 // (pointer columns) and each request's plan as CSR rows into that

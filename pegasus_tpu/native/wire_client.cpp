@@ -73,8 +73,9 @@ struct Value;
 using ValueList = std::vector<Value>;
 
 struct Value {
-  enum Kind { NONE, BOOL, INT, UINT, BYTES, STR, LIST, TUPLE, DICT } kind =
-      NONE;
+  enum Kind {
+    NONE, BOOL, INT, UINT, BYTES, STR, LIST, TUPLE, DICT, DATACLASS
+  } kind = NONE;
   bool b = false;
   int64_t i = 0;
   uint64_t u = 0;
@@ -93,6 +94,27 @@ struct Value {
   }
   static Value str(const std::string& v) {
     Value x; x.kind = STR; x.s = v; return x;
+  }
+  // registered-dataclass value: s = registry name, items = fields in
+  // the dataclass's declaration order (rpc/message.py 'D' grammar)
+  static Value dataclass(const std::string& name,
+                         std::vector<Value> fields) {
+    Value x;
+    x.kind = DATACLASS;
+    x.s = name;
+    x.items = std::move(fields);
+    return x;
+  }
+  // decoded-dataclass field access (Decoder surfaces 'D' as a DICT of
+  // {__dataclass__: name, 0: f0, 1: f1, ...})
+  const Value* field(int64_t i) const {
+    for (auto& p : kv)
+      if (p.first.kind == INT && p.first.i == i) return &p.second;
+    return nullptr;
+  }
+  bool is_dataclass(const char* name) const {
+    const Value* d = get("__dataclass__");
+    return d && d->kind == STR && d->s == name;
   }
   const Value* get(const std::string& key) const {
     for (auto& p : kv)
@@ -154,6 +176,13 @@ void encode(std::string& out, const Value& v) {
         encode(out, p.first);
         encode(out, p.second);
       }
+      break;
+    case Value::DATACLASS:
+      out += 'D';
+      put_u32(out, v.s.size());
+      out += v.s;
+      put_u32(out, v.items.size());
+      for (auto& item : v.items) encode(out, item);
       break;
   }
 }
@@ -542,6 +571,241 @@ struct Client {
     last_error = "read retries exhausted";
     return -1;
   }
+
+  // ---- generic routed calls (retry + refresh-on-stale, the same
+  // discipline as write_op/read_get) ----------------------------------
+
+  static bool retryable(int64_t err) {
+    return err == 13 || err == 14 || err == 53 || err == 56 || err == 5 ||
+           err == 6;
+  }
+
+  // op result into *result; returns 0 ok, >0 server error, -1 transport
+  int read_call(int64_t pidx, const std::string& op, const Value& args,
+                bool with_hash, uint64_t h, Value* result) {
+    if (app_id < 0 && !refresh_config()) return -1;
+    for (int attempt = 0; attempt < 4; attempt++) {
+      if (attempt && !refresh_config()) return -1;
+      int64_t p =
+          with_hash ? (int64_t)(h % (uint64_t)partition_count) : pidx;
+      const std::string& primary = primaries[(size_t)p];
+      if (primary.empty()) continue;
+      uint64_t rid = next_rid++;
+      Value req;
+      req.kind = Value::DICT;
+      req.kv.emplace_back(Value::str("gpid"), make_gpid(p));
+      req.kv.emplace_back(Value::str("rid"), Value::integer((int64_t)rid));
+      req.kv.emplace_back(Value::str("op"), Value::str(op));
+      req.kv.emplace_back(Value::str("args"), args);
+      req.kv.emplace_back(Value::str("auth"), auth_value());
+      if (with_hash)
+        req.kv.emplace_back(Value::str("partition_hash"),
+                            Value::uinteger(h));
+      else
+        req.kv.emplace_back(Value::str("partition_hash"), Value::none());
+      Value reply;
+      if (!call(primary, "client_read", std::move(req),
+                "client_read_reply", rid, &reply))
+        continue;
+      int64_t err = reply.get("err")->as_int();
+      if (err != 0) {
+        if (retryable(err)) continue;
+        return (int)err;
+      }
+      const Value* r = reply.get("result");
+      if (r) *result = *r;
+      return 0;
+    }
+    last_error = "read retries exhausted";
+    return -1;
+  }
+
+  // one-op write with a prebuilt (op_code, request-dataclass) tuple;
+  // NOT retried on lost replies (atomic ops would double-apply —
+  // same discipline as the Python client for cas/cam)
+  int write_call(uint64_t h, int op_code, Value op_args, Value* result) {
+    if (app_id < 0 && !refresh_config()) return -1;
+    for (int attempt = 0; attempt < 2; attempt++) {
+      if (attempt && !refresh_config()) return -1;
+      int64_t pidx = (int64_t)(h % (uint64_t)partition_count);
+      const std::string& primary = primaries[(size_t)pidx];
+      if (primary.empty()) continue;
+      uint64_t rid = next_rid++;
+      Value wop;
+      wop.kind = Value::TUPLE;
+      wop.items.push_back(Value::integer(op_code));
+      wop.items.push_back(std::move(op_args));
+      Value ops;
+      ops.kind = Value::LIST;
+      ops.items.push_back(std::move(wop));
+      Value req;
+      req.kind = Value::DICT;
+      req.kv.emplace_back(Value::str("gpid"), make_gpid(pidx));
+      req.kv.emplace_back(Value::str("rid"), Value::integer((int64_t)rid));
+      req.kv.emplace_back(Value::str("ops"), std::move(ops));
+      req.kv.emplace_back(Value::str("auth"), auth_value());
+      req.kv.emplace_back(Value::str("partition_hash"),
+                          Value::uinteger(h));
+      Value reply;
+      if (!call(primary, "client_write", std::move(req),
+                "client_write_reply", rid, &reply))
+        return -1;  // ambiguous: do NOT auto-retry an atomic write
+      int64_t err = reply.get("err")->as_int();
+      if (err == 0) {
+        const Value* results = reply.get("results");
+        if (!results || results->items.empty()) return -1;
+        *result = results->items[0];
+        return 0;
+      }
+      if (retryable(err)) continue;
+      return (int)err;
+    }
+    last_error = "write retries exhausted";
+    return -1;
+  }
+};
+
+// kvs in responses arrive either as a list of KeyValue dataclasses or
+// as one columnar ScanPage (key_offs/key_blob/val_offs/val_blob);
+// flatten both to (full_key, value) pairs
+bool decode_kvs(const Value& kvs,
+                std::vector<std::pair<std::string, std::string>>* out) {
+  if (kvs.kind == Value::LIST) {
+    for (auto& item : kvs.items) {
+      if (item.kind != Value::DICT || !item.is_dataclass("KeyValue"))
+        return false;
+      const Value* k = item.field(0);
+      const Value* v = item.field(1);
+      if (!k) return false;
+      out->emplace_back(k->s, v ? v->s : std::string());
+    }
+    return true;
+  }
+  if (kvs.kind == Value::DICT && kvs.is_dataclass("ScanPage")) {
+    const Value* ko = kvs.field(0);
+    const Value* kb = kvs.field(1);
+    const Value* vo = kvs.field(2);
+    const Value* vb = kvs.field(3);
+    if (!ko || !kb || !vo || !vb) return false;
+    size_t n = ko->s.size() / 4;
+    if (n == 0) return true;
+    n -= 1;
+    auto off = [](const std::string& s, size_t i) {
+      uint32_t v;
+      memcpy(&v, s.data() + 4 * i, 4);
+      return v;
+    };
+    for (size_t i = 0; i < n; i++) {
+      out->emplace_back(
+          kb->s.substr(off(ko->s, i), off(ko->s, i + 1) - off(ko->s, i)),
+          vb->s.substr(off(vo->s, i), off(vo->s, i + 1) - off(vo->s, i)));
+    }
+    return true;
+  }
+  return false;
+}
+
+// GetScannerRequest in declaration order (server/types.py:273) — the
+// wire 'D' grammar is positional, so this list must track the registry
+Value make_scanner_request(const std::string& start_key,
+                           const std::string& stop_key,
+                           int64_t batch_size) {
+  std::vector<Value> f;
+  f.push_back(Value::bytes(start_key));         // start_key
+  f.push_back(Value::bytes(stop_key));          // stop_key
+  f.push_back(Value::boolean(true));            // start_inclusive
+  f.push_back(Value::boolean(false));           // stop_inclusive
+  f.push_back(Value::integer(batch_size));      // batch_size
+  f.push_back(Value::boolean(false));           // no_value
+  f.push_back(Value::integer(0));               // hash_key_filter_type
+  f.push_back(Value::bytes(""));                // hash_key_filter_pattern
+  f.push_back(Value::integer(0));               // sort_key_filter_type
+  f.push_back(Value::bytes(""));                // sort_key_filter_pattern
+  f.push_back(Value::boolean(false));           // validate_partition_hash
+  f.push_back(Value::boolean(false));           // return_expire_ts
+  f.push_back(Value::boolean(false));           // full_scan
+  f.push_back(Value::boolean(false));           // only_return_count
+  f.push_back(Value::boolean(false));           // one_page
+  return Value::dataclass("GetScannerRequest", std::move(f));
+}
+
+// Hashkey scanner: pages through [generate_key(hk, ""), next(hk))
+// exactly like the Python ClusterScanner (cluster_client.py:540-586),
+// including the context-expired restart past the last served key.
+struct Scanner {
+  Client* c;
+  int64_t pidx;
+  std::string start_key, stop_key;
+  int64_t batch_size;
+  int64_t context_id = INT64_MIN;  // INT64_MIN = no context yet
+  std::string last_key;
+  std::vector<std::pair<std::string, std::string>> buffer;
+  size_t pos = 0;
+  bool done = false;
+  bool completed = false;  // server said COMPLETED: never restart
+  int error = 0;
+
+  bool fetch() {
+    while (!done) {
+      if (completed) {
+        done = true;
+        return false;
+      }
+      Value result;
+      int rc;
+      if (context_id == INT64_MIN) {
+        std::string sk = start_key;
+        if (!last_key.empty()) sk = last_key + std::string(1, '\0');
+        rc = c->read_call(pidx, "get_scanner",
+                          make_scanner_request(sk, stop_key, batch_size),
+                          false, 0, &result);
+      } else {
+        rc = c->read_call(pidx, "scan", Value::integer(context_id),
+                          false, 0, &result);
+      }
+      if (rc != 0) {
+        error = rc;
+        done = true;
+        return false;
+      }
+      if (result.kind != Value::DICT ||
+          !result.is_dataclass("ScanResponse")) {
+        error = -1;
+        done = true;
+        return false;
+      }
+      const Value* err = result.field(0);
+      const Value* kvs = result.field(1);
+      const Value* ctx = result.field(2);
+      if (!err || err->as_int() != 0) {
+        error = err ? (int)err->as_int() : -1;
+        done = true;
+        return false;
+      }
+      int64_t new_ctx = ctx ? ctx->as_int() : -1;
+      if (new_ctx == -2) {  // SCAN_CONTEXT_ID_NOT_EXIST: restart
+        context_id = INT64_MIN;
+        continue;
+      }
+      buffer.clear();
+      pos = 0;
+      if (kvs && !decode_kvs(*kvs, &buffer)) {
+        error = -1;
+        done = true;
+        return false;
+      }
+      if (!buffer.empty()) last_key = buffer.back().first;
+      if (new_ctx == -1) {
+        completed = true;
+      } else {
+        context_id = new_ctx;
+      }
+      if (!buffer.empty()) return true;
+      // empty page: COMPLETED ends the scan (next loop pass), a live
+      // context keeps paging
+    }
+    return false;
+  }
 };
 
 }  // namespace
@@ -636,5 +900,222 @@ const char* pegc_last_error(void* handle) {
 
 uint64_t pegc_crc64(const char* data, int len) {
   return crc64((const uint8_t*)data, len);
+}
+
+// ---- multi_get: all sort keys of one hash key --------------------------
+// Packs results into `out` as [u32 n] then n x [u32 sk_len][sk]
+// [u32 v_len][v] (sort keys decomposed from the full keys). Returns the
+// storage status, or -2 when the packed blob exceeds out_cap (caller
+// retries with a bigger buffer; *out_len carries the needed size).
+int pegc_multi_get(void* handle, const char* hk, int hklen, char* out,
+                   long out_cap, long* out_len) {
+  auto* c = (Client*)handle;
+  std::string hash_key(hk, hklen);
+  uint64_t h = c->route_hash(hash_key, "");
+  std::vector<std::pair<std::string, std::string>> rows;
+  std::string start_sortkey;
+  // the server's one-shot range-read budget returns INCOMPLETE with a
+  // resume sort key — page until the range is exhausted, exactly like
+  // the Python client's paginate_sortkeys driver
+  for (int page = 0; page < 1 << 20; page++) {
+    // MultiGetRequest in declaration order (server/types.py:160)
+    std::vector<Value> f;
+    f.push_back(Value::bytes(hash_key));   // hash_key
+    Value empty_list;
+    empty_list.kind = Value::LIST;
+    f.push_back(empty_list);               // sort_keys (all)
+    f.push_back(Value::integer(-1));       // max_kv_count
+    f.push_back(Value::integer(-1));       // max_kv_size
+    f.push_back(Value::boolean(false));    // no_value
+    f.push_back(Value::bytes(start_sortkey));
+    f.push_back(Value::bytes(""));         // stop_sortkey
+    f.push_back(Value::boolean(true));     // start_inclusive
+    f.push_back(Value::boolean(false));    // stop_inclusive
+    f.push_back(Value::integer(0));        // sort_key_filter_type
+    f.push_back(Value::bytes(""));         // sort_key_filter_pattern
+    f.push_back(Value::boolean(false));    // reverse
+    Value result;
+    int rc = c->read_call(
+        0, "multi_get",
+        Value::dataclass("MultiGetRequest", std::move(f)), true, h,
+        &result);
+    if (rc != 0) return rc;
+    if (result.kind != Value::DICT ||
+        !result.is_dataclass("MultiGetResponse"))
+      return -1;
+    const Value* err = result.field(0);
+    if (!err) return -1;
+    int64_t status = err->as_int();
+    if (status != 0 && status != 7 /*INCOMPLETE*/) return (int)status;
+    const Value* kvs = result.field(1);
+    if (kvs && !decode_kvs(*kvs, &rows)) return -1;
+    if (status == 0) break;
+    const Value* resume = result.field(2);
+    if (!resume || resume->kind == Value::NONE) break;
+    start_sortkey = resume->s;
+  }
+  std::string blob;
+  put_u32(blob, rows.size());
+  for (auto& r : rows) {
+    // multi_get kvs carry the SORT KEY in KeyValue.key already
+    put_u32(blob, r.first.size());
+    blob += r.first;
+    put_u32(blob, r.second.size());
+    blob += r.second;
+  }
+  *out_len = (long)blob.size();
+  if ((long)blob.size() > out_cap) return -2;
+  memcpy(out, blob.data(), blob.size());
+  return 0;
+}
+
+// ---- scanner: hashkey range scan with paging ---------------------------
+void* pegc_scan_open(void* handle, const char* hk, int hklen,
+                     long batch_size) {
+  auto* c = (Client*)handle;
+  if (c->app_id < 0 && !c->refresh_config()) return nullptr;
+  std::string hash_key(hk, hklen);
+  auto* s = new Scanner();
+  s->c = c;
+  s->batch_size = batch_size > 0 ? batch_size : 100;
+  s->start_key = c->full_key(hash_key, "");
+  // adjacent successor of every key with this hashkey prefix
+  // (key_schema.generate_next_bytes): drop trailing 0xFF, bump last
+  std::string buf = s->start_key;
+  int i = (int)buf.size() - 1;
+  while (i >= 0 && (uint8_t)buf[i] == 0xFF) i--;
+  if (i < 0) {
+    s->stop_key = "";  // unbounded
+  } else {
+    buf[i] = (char)((uint8_t)buf[i] + 1);
+    s->stop_key = buf.substr(0, i + 1);
+  }
+  uint64_t h = c->route_hash(hash_key, "");
+  s->pidx = (int64_t)(h % (uint64_t)c->partition_count);
+  return s;
+}
+
+// 0 = row produced (sort key + value written, lengths via out params,
+// truncated at the caps), 1 = exhausted, <0 / >1 = error status
+// -3 = a buffer is too small: *sk_len / *v_len carry the needed sizes
+// and the row is NOT consumed — the caller re-calls with bigger buffers
+int pegc_scan_next(void* scanner, char* sk_out, int sk_cap, int* sk_len,
+                   char* v_out, int v_cap, int* v_len) {
+  auto* s = (Scanner*)scanner;
+  while (true) {
+    if (s->pos < s->buffer.size()) {
+      auto& row = s->buffer[s->pos];
+      // full key = [u16 BE hklen][hashkey][sortkey]
+      if (row.first.size() < 2) {
+        s->pos++;
+        return -1;
+      }
+      int hkl = ((uint8_t)row.first[0] << 8) | (uint8_t)row.first[1];
+      std::string sk = row.first.substr(2 + hkl);
+      *sk_len = (int)sk.size();
+      *v_len = (int)row.second.size();
+      if ((int)sk.size() > sk_cap || (int)row.second.size() > v_cap)
+        return -3;
+      s->pos++;
+      memcpy(sk_out, sk.data(), sk.size());
+      memcpy(v_out, row.second.data(), row.second.size());
+      return 0;
+    }
+    if (s->done || !s->fetch()) return s->error ? s->error : 1;
+  }
+}
+
+void pegc_scan_close(void* scanner) {
+  auto* s = (Scanner*)scanner;
+  if (s->context_id != INT64_MIN && !s->completed && !s->done) {
+    Value result;  // best-effort context release
+    s->c->read_call(s->pidx, "clear_scanner",
+                    Value::integer(s->context_id), false, 0, &result);
+  }
+  delete s;
+}
+
+// ---- check_and_set / check_and_mutate ----------------------------------
+// Returns the storage status; *check_exist reports whether the checked
+// value existed (meaningful when return_check_value was requested).
+int pegc_check_and_set(void* handle, const char* hk, int hklen,
+                       const char* check_sk, int check_sklen,
+                       int check_type, const char* operand, int operand_len,
+                       const char* set_sk, int set_sklen,
+                       const char* set_value, int set_vlen,
+                       long ttl_seconds, int* check_exist) {
+  auto* c = (Client*)handle;
+  std::string hash_key(hk, hklen);
+  std::string csk(check_sk, check_sklen);
+  std::string ssk(set_sk, set_sklen);
+  // CheckAndSetRequest in declaration order (server/types.py:224)
+  std::vector<Value> f;
+  f.push_back(Value::bytes(hash_key));
+  f.push_back(Value::bytes(csk));
+  f.push_back(Value::integer(check_type));
+  f.push_back(Value::bytes(std::string(operand, operand_len)));
+  f.push_back(Value::boolean(csk != ssk));       // set_diff_sort_key
+  f.push_back(Value::bytes(ssk));
+  f.push_back(Value::bytes(std::string(set_value, set_vlen)));
+  f.push_back(Value::integer(ttl_seconds));      // set_expire_ts_seconds
+  f.push_back(Value::boolean(true));             // return_check_value
+  Value result;
+  int rc = c->write_call(
+      c->route_hash(hash_key, ""), 6 /*OP_CAS*/,
+      Value::dataclass("CheckAndSetRequest", std::move(f)), &result);
+  if (rc != 0) return rc;
+  if (result.kind == Value::INT || result.kind == Value::UINT)
+    return (int)result.as_int();  // per-op status (gate deny/throttle)
+  if (result.kind != Value::DICT ||
+      !result.is_dataclass("CheckAndSetResponse"))
+    return -1;
+  const Value* err = result.field(0);
+  const Value* exist = result.field(2);
+  if (check_exist) *check_exist = exist && exist->b ? 1 : 0;
+  return err ? (int)err->as_int() : -1;
+}
+
+// One-mutate check_and_mutate: mutate_op 0 = SET, 1 = DELETE
+// (MutateOperation, server/types.py:45).
+int pegc_check_and_mutate(void* handle, const char* hk, int hklen,
+                          const char* check_sk, int check_sklen,
+                          int check_type, const char* operand,
+                          int operand_len, int mutate_op,
+                          const char* m_sk, int m_sklen,
+                          const char* m_value, int m_vlen,
+                          int* check_exist) {
+  auto* c = (Client*)handle;
+  std::string hash_key(hk, hklen);
+  // Mutate in declaration order (server/types.py:246)
+  std::vector<Value> mf;
+  mf.push_back(Value::integer(mutate_op));
+  mf.push_back(Value::bytes(std::string(m_sk, m_sklen)));
+  mf.push_back(Value::bytes(std::string(m_value, m_vlen)));
+  mf.push_back(Value::integer(0));
+  Value mutates;
+  mutates.kind = Value::LIST;
+  mutates.items.push_back(Value::dataclass("Mutate", std::move(mf)));
+  // CheckAndMutateRequest in declaration order (server/types.py:254)
+  std::vector<Value> f;
+  f.push_back(Value::bytes(hash_key));
+  f.push_back(Value::bytes(std::string(check_sk, check_sklen)));
+  f.push_back(Value::integer(check_type));
+  f.push_back(Value::bytes(std::string(operand, operand_len)));
+  f.push_back(std::move(mutates));
+  f.push_back(Value::boolean(true));             // return_check_value
+  Value result;
+  int rc = c->write_call(
+      c->route_hash(hash_key, ""), 7 /*OP_CAM*/,
+      Value::dataclass("CheckAndMutateRequest", std::move(f)), &result);
+  if (rc != 0) return rc;
+  if (result.kind == Value::INT || result.kind == Value::UINT)
+    return (int)result.as_int();  // per-op status (gate deny/throttle)
+  if (result.kind != Value::DICT ||
+      !result.is_dataclass("CheckAndMutateResponse"))
+    return -1;
+  const Value* err = result.field(0);
+  const Value* exist = result.field(2);
+  if (check_exist) *check_exist = exist && exist->b ? 1 : 0;
+  return err ? (int)err->as_int() : -1;
 }
 }

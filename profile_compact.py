@@ -1,8 +1,9 @@
 #!/usr/bin/env python
 # Diagnostic sidecar (not part of the framework): bulk-compaction
-# throughput at BASELINE scale (>=1 GB), with a phase breakdown, to
-# locate the host-side GB/s ceiling. CPU-only by default; run with
-# PEGPROF_DEVICE=accel to place eval on the ambient accelerator.
+# throughput at BASELINE scale, reusing the bench's fixture builder
+# (bench.build_compact_store) so the synthetic-SST layout lives in ONE
+# place. CPU-only by default; PEGPROF_DEVICE=accel places eval on the
+# ambient accelerator. PEGPROF_PROFILE=1 wraps the pass in cProfile.
 import os
 import sys
 import time
@@ -13,122 +14,50 @@ if os.environ.get("PEGPROF_DEVICE", "cpu") == "cpu":
     import jax._src.xla_bridge as _xb
     jax.config.update("jax_platforms", "cpu")
     _xb._backend_factories.pop("axon", None)
-import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-from pegasus_tpu.base.crc import crc64_batch
-from pegasus_tpu.base.value_schema import epoch_now
-from pegasus_tpu.storage.engine import StorageEngine
-from pegasus_tpu.storage.lsm import L1_RUN_CAPACITY
-from pegasus_tpu.storage.sstable import SSTableWriter
+import bench as B  # noqa: E402
 
 GB = float(os.environ.get("PEGPROF_GB", "1"))
-VALUE = int(os.environ.get("PEGPROF_VALUE", "100"))
-BLOCK = 1024
-
-
-def build(data_dir: str, n_records: int) -> int:
-    """Write n_records directly as columnar L1 runs (10% expired)."""
-    sst = os.path.join(data_dir, "sst")
-    os.makedirs(sst, exist_ok=True)
-    now = epoch_now()
-    rng = np.random.default_rng(7)
-    names = []
-    seq = 0
-    writer = None
-    in_run = 0
-    t0 = time.perf_counter()
-    meta = {"last_flushed_decree": 1, "data_version": 1}
-    total_bytes = 0
-    for base in range(0, n_records, BLOCK):
-        n = min(BLOCK, n_records - base)
-        idx = np.arange(base, base + n)
-        hks = idx // 10
-        sks = idx % 10
-        keys = np.zeros((n, 32), dtype=np.uint8)
-        # big-endian u16 hashkey length prefix (12) + "user%08d" + "s%02d"
-        keys[:, 1] = 12
-        ascii_hk = np.frombuffer(
-            b"".join(b"user%08d" % h for h in hks), dtype=np.uint8
-        ).reshape(n, 12)
-        ascii_sk = np.frombuffer(
-            b"".join(b"s%02d" % s for s in sks), dtype=np.uint8
-        ).reshape(n, 3)
-        keys[:, 2:14] = ascii_hk
-        keys[:, 14:17] = ascii_sk
-        key_len = np.full(n, 17, dtype=np.int32)
-        ets = np.where(rng.random(n) < 0.10, np.uint32(max(1, now - 100)),
-                       np.uint32(0)).astype(np.uint32)
-        flags = np.zeros(n, dtype=np.uint8)
-        offs = (np.arange(n + 1, dtype=np.uint32) * VALUE)
-        heap = rng.integers(32, 126, size=n * VALUE,
-                            dtype=np.uint8).tobytes()
-        hash_lo = (crc64_batch(keys, np.full(n, 12, dtype=np.int64),
-                               start=2)
-                   & np.uint64(0xFFFFFFFF)).astype(np.uint32)
-        if writer is None:
-            writer = SSTableWriter(os.path.join(sst, f"l1-{seq}.sst"),
-                                   meta=meta)
-            seq += 1
-        writer.add_block_columnar(keys, key_len, ets, hash_lo, flags,
-                                  offs, heap)
-        in_run += n
-        total_bytes += n * (32 + 4 + 4 + 4 + 1 + 4) + len(heap)
-        if in_run >= L1_RUN_CAPACITY:
-            writer.finish()
-            names.append(os.path.basename(writer.path))
-            writer = None
-            in_run = 0
-    if writer is not None:
-        writer.finish()
-        names.append(os.path.basename(writer.path))
-    import json
-    with open(os.path.join(sst, "MANIFEST.json"), "w") as f:
-        json.dump({"seq": seq, "l1": names}, f)
-    print(f"built {n_records} records (~{total_bytes/1e9:.2f} GB cols) "
-          f"in {time.perf_counter()-t0:.1f}s, {len(names)} runs",
-          flush=True)
-    return total_bytes
-
-
-def data_bytes(engine) -> int:
-    sst = os.path.join(engine.data_dir, "sst")
-    return sum(os.path.getsize(os.path.join(sst, n))
-               for n in os.listdir(sst) if n.endswith(".sst"))
+EXPIRED = float(os.environ.get("PEGPROF_EXPIRED", "0.3"))
+PARTS = int(os.environ.get("PEGPROF_PARTS", "1"))
 
 
 def main() -> None:
     import tempfile
+    from concurrent.futures import ThreadPoolExecutor
 
-    n_records = int(GB * 1e9 / (VALUE + 45))
+    n_records = int(GB * 1e9 / 145)
     with tempfile.TemporaryDirectory(prefix="pegprof",
                                      dir=os.environ.get("PEGPROF_TMP")
                                      ) as tmp:
-        build(tmp, n_records)
-        eng = StorageEngine(tmp)
-        assert eng.lsm.bulk_compact_eligible(), "bulk path not eligible"
-        size = data_bytes(eng)
-        print(f"on-disk: {size/1e9:.2f} GB in "
-              f"{len(eng.lsm.bulk_compact_entries())} blocks", flush=True)
+        t0 = time.perf_counter()
+        engines = B.build_compact_store(tmp, n_records, EXPIRED, PARTS, 7)
+        size = B._store_bytes(engines)
+        print(f"built {n_records} records ({size/1e9:.2f} GB, "
+              f"{PARTS} parts) in {time.perf_counter()-t0:.1f}s",
+              flush=True)
+        pr = None
         if os.environ.get("PEGPROF_PROFILE") == "1":
             import cProfile
-            import pstats
             pr = cProfile.Profile()
             pr.enable()
-            t0 = time.perf_counter()
-            eng.manual_compact()
-            secs = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        with ThreadPoolExecutor(max_workers=min(4, PARTS)) as ex:
+            for f in [ex.submit(lambda e: e.manual_compact(), e)
+                      for e in engines]:
+                f.result()
+        secs = time.perf_counter() - t0
+        if pr is not None:
+            import pstats
             pr.disable()
             pstats.Stats(pr).sort_stats("cumulative").print_stats(30)
-        else:
-            t0 = time.perf_counter()
-            eng.manual_compact()
-            secs = time.perf_counter() - t0
-        size2 = data_bytes(eng)
+        size2 = B._store_bytes(engines)
         print(f"compact: {secs:.2f}s -> {size/1e9/secs:.3f} GB/s "
               f"({size/1e9:.2f} GB -> {size2/1e9:.2f} GB)", flush=True)
-        eng.close()
+        for e in engines:
+            e.close()
 
 
 if __name__ == "__main__":

@@ -69,6 +69,47 @@ def test_native_client_against_onebox(tmp_path):
         assert pc.get(b"ck01", b"s") == (0, b"cv1")
         assert pc.set(b"from-python", b"s", b"pv") == 0
         assert nc.get(b"from-python", b"s") == (0, b"pv")
+
+        # multi_get: every sort key of one hash key in one native call
+        for i in range(12):
+            assert nc.set(b"mgk", b"s%02d" % i, b"mv%d" % i) == 0
+        st, kvs = nc.multi_get(b"mgk")
+        assert st == 0
+        assert kvs == {b"s%02d" % i: b"mv%d" % i for i in range(12)}
+
+        # scanner: PAGED native scan round-trip (batch_size forces
+        # multiple get_scanner/scan pages over the wire)
+        for i in range(57):
+            assert nc.set(b"scanhk", b"r%03d" % i, b"sv%d" % i) == 0
+        rows = list(nc.scan(b"scanhk", batch_size=10))
+        assert [sk for sk, _v in rows] == [b"r%03d" % i
+                                           for i in range(57)]
+        assert rows[13] == (b"r013", b"sv13")
+
+        # check_and_set: value-exist check gates the write (parity:
+        # pegasus client.h check_and_set, CT_VALUE_EXIST=3)
+        CT_EXIST = 3  # CasCheckType.CT_VALUE_EXIST
+        st, exist = nc.check_and_set(b"cask", b"guard", CT_EXIST, b"",
+                                     b"dest", b"won't-win")
+        assert st != 0 and not exist  # guard missing: rejected
+        assert nc.set(b"cask", b"guard", b"here") == 0
+        st, exist = nc.check_and_set(b"cask", b"guard", CT_EXIST, b"",
+                                     b"dest", b"wins")
+        assert st == 0 and exist
+        assert nc.get(b"cask", b"dest") == (0, b"wins")
+
+        # check_and_mutate: guarded single-mutate (SET)
+        st, exist = nc.check_and_mutate(b"cask", b"guard", CT_EXIST,
+                                        b"", 0, b"dest2", b"mutated")
+        assert st == 0 and exist
+        assert nc.get(b"cask", b"dest2") == (0, b"mutated")
+        st, _ = nc.check_and_mutate(b"cask", b"nope", CT_EXIST, b"",
+                                    0, b"dest3", b"never")
+        assert st != 0
+        assert nc.get(b"cask", b"dest3")[0] == 1
+
+        # python client sees the C++ CAS results (wire interop both ways)
+        assert pc.get(b"cask", b"dest") == (0, b"wins")
     finally:
         if nc is not None:
             nc.close()
