@@ -690,6 +690,39 @@ def main() -> None:
                     "hit_rate": round(hits_g / ops_g, 4),
                 }
                 save_details()
+
+                # batching-margin sweep: the same scan workload with
+                # coalescing DISABLED (batch=1) on both backends — the
+                # accel/cpu margin should GROW with the batch size,
+                # since batching is what amortizes device dispatch
+                m_ops = max(1500, n_ops // 8)
+                with jax.default_device(accel):
+                    run_scans(bc, m_ops, n_partitions, n_hashkeys,
+                              seed + 5, insert_frac=0, scan_batch=1)
+                    o1, _r1, a1 = run_scans(bc, m_ops, n_partitions,
+                                            n_hashkeys, seed + 5,
+                                            scan_batch=1)
+                with jax.default_device(cpu):
+                    run_scans(bc, m_ops, n_partitions, n_hashkeys,
+                              seed + 5, insert_frac=0, scan_batch=1)
+                    _o, _r, c1 = run_scans(bc, m_ops, n_partitions,
+                                           n_hashkeys, seed + 5,
+                                           scan_batch=1)
+                ratio_b1 = (o1 / a1) / (o1 / c1) if a1 and c1 else 0
+                base_batch = details["phases"]["scan"]["scan_batch"]
+                ratio_bn = (details["phases"]["scan"]["accel_qps"]
+                            / max(details["phases"]["scan"]["cpu_qps"],
+                                  1e-9))
+                details["phases"]["scan_batch_margin"] = {
+                    "batch1_accel_qps": round(o1 / a1, 2),
+                    "batch1_cpu_qps": round(o1 / c1, 2),
+                    "batch1_vs_baseline": round(ratio_b1, 3),
+                    "baseline_batch": base_batch,
+                    f"batch{base_batch}_vs_baseline": round(ratio_bn, 3),
+                }
+                save_details()
+                _log(f"scan margin: batch=1 ratio {ratio_b1:.3f}, "
+                     f"batch={base_batch} ratio {ratio_bn:.3f}")
                 _log(f"point-get: accel {ops_g / accel_g:.0f} q/s, "
                      f"cpu {ops_g / cpu_g:.0f} q/s, hits {hits_g}/{ops_g}")
 

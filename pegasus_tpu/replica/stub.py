@@ -452,7 +452,7 @@ class ReplicaStub:
         gpid = tuple(payload["gpid"])
         rid = payload["rid"]
         r = self.replicas.get(gpid)
-        if not self._client_allowed(r, payload):
+        if not self._client_allowed(r, payload, access="w"):
             self.net.send(self.name, src, "client_write_reply", {
                 "rid": rid, "err": int(ErrorCode.ERR_ACL_DENY),
                 "results": []})
@@ -517,7 +517,7 @@ class ReplicaStub:
         rid = payload["rid"]
         op = payload.get("op", "get")
         r = self.replicas.get(gpid)
-        if not self._client_allowed(r, payload):
+        if not self._client_allowed(r, payload, access="r"):
             self.net.send(self.name, src, "client_read_reply", {
                 "rid": rid, "err": int(ErrorCode.ERR_ACL_DENY),
                 "result": None})
@@ -780,7 +780,7 @@ class ReplicaStub:
         for gpid, reqs in groups:
             gpid = tuple(gpid)
             r = self.replicas.get(gpid)
-            if not self._client_allowed(r, payload):
+            if not self._client_allowed(r, payload, access="r"):
                 # auth/ACL is PERMANENT — distinct from stale-primary so
                 # the client doesn't burn retries re-resolving
                 errs = []
@@ -828,15 +828,21 @@ class ReplicaStub:
         self.net.send(self.name, src, "client_read_reply", {
             "rid": rid, "err": int(ErrorCode.ERR_OK), "result": slots})
 
-    def _client_allowed(self, r, payload: dict) -> bool:
+    def _client_allowed(self, r, payload: dict,
+                        access: str = "") -> bool:
         """Auth + table-ACL gate (parity: the ACL gate leading the client
-        gate stack, replica_2pc.cpp:117 / replica.cpp:388)."""
+        gate stack, replica_2pc.cpp:117 / replica.cpp:388), with the
+        Ranger-style per-verb access class (access_type.h) when the
+        table carries a `replica.access_policy` env."""
         from pegasus_tpu.security.auth import check_client
 
         allowed = ""
+        policy = ""
         if r is not None:
             allowed = r.server.app_envs.get("replica.allowed_users", "")
-        return check_client(payload.get("auth"), self.auth_secret, allowed)
+            policy = r.server.app_envs.get("replica.access_policy", "")
+        return check_client(payload.get("auth"), self.auth_secret,
+                            allowed, policy=policy, access=access)
 
     # ---- partition split (parity: replica_split_manager.h:58 — the
     # replica-side parent/child state copy + catch-up; meta owns the

@@ -34,14 +34,47 @@ def make_credentials(user: str, secret: str) -> Tuple[str, str]:
     return user, sign(user, secret)
 
 
+# per-verb access classes (parity: src/ranger/access_type.h — READ /
+# WRITE / and the control-plane classes collapsed to "a" here; meta
+# admin verbs run under the operator identity)
+ACCESS_READ = "r"
+ACCESS_WRITE = "w"
+ACCESS_ADMIN = "a"
+
+
+def parse_policy(policy: str) -> dict:
+    """`replica.access_policy` app-env: "alice=rw;bob=r;*=r" ->
+    {user: set-of-access-chars}. "*" is the any-authenticated-user
+    entry. Malformed segments are ignored (a typo must not open the
+    table)."""
+    out = {}
+    for seg in policy.split(";"):
+        seg = seg.strip()
+        if not seg or "=" not in seg:
+            continue
+        user, grants = seg.split("=", 1)
+        out[user.strip()] = {c for c in grants.strip()
+                             if c in (ACCESS_READ, ACCESS_WRITE,
+                                      ACCESS_ADMIN)}
+    return out
+
+
 def check_client(auth: Optional[tuple], secret: Optional[str],
-                 allowed_users: str = "") -> bool:
+                 allowed_users: str = "", policy: str = "",
+                 access: str = "") -> bool:
     """The gate servers run per request: authentication (when the
-    cluster has a secret) then the table allow-list.
+    cluster has a secret), then the per-verb access policy, then the
+    legacy table allow-list.
 
     `allowed_users`: comma-separated env value; empty = every
     authenticated user (parity: tables without ranger policies are
-    governed by legacy allowed-user lists; empty list = open)."""
+    governed by legacy allowed-user lists; empty list = open).
+
+    `policy` + `access`: the Ranger-style per-verb layer
+    (access_type.h) — when the table carries a `replica.access_policy`
+    env, the request's access class ("r"/"w"/"a") must be granted to
+    the user (or to "*"); inter-node traffic (NODE_USER) is exempt, as
+    the reference exempts intra-cluster RPCs."""
     if secret:
         if not auth:
             return False
@@ -50,6 +83,11 @@ def check_client(auth: Optional[tuple], secret: Optional[str],
             return False
     else:
         user = auth[0] if auth else ""
+    if policy and access and user != NODE_USER:
+        grants = parse_policy(policy)
+        g = grants.get(user, grants.get("*"))
+        if g is None or access not in g:
+            return False
     if allowed_users:
         allowed = {u.strip() for u in allowed_users.split(",") if u.strip()}
         return user in allowed or user == NODE_USER

@@ -165,6 +165,9 @@ class PartitionServer:
                                           cluster_id)
         self._write_lock = threading.Lock()  # single-writer invariant
         self._scan_cache = ScanContextCache()
+        # (ckey, static-mask-id) -> (second, alive, expired_count, live):
+        # per-second TTL-applied serving masks (see prepare_serve)
+        self._live_cache: dict = {}
         self.metrics = METRICS.entity(
             "replica", f"{app_id}.{pidx}",
             {"table": str(app_id), "partition": str(pidx)})
@@ -1245,15 +1248,37 @@ class PartitionServer:
         live_masks = {}
         alive_all = {}
         exp_full = {}
+        cache = self._live_cache
         for ckey, (_run, _bm, blk) in unique.items():
             ets = blk.expire_ts
+            static = keep_masks[ckey]
+            # (block, flavor-mask, second) live-mask cache: TTL validity
+            # is one second, so every batch within the second reuses the
+            # same static AND alive result instead of recomputing it —
+            # zipfian traffic hits the same hot blocks thousands of
+            # times per second
+            lkey = (ckey, id(static))
+            hit = cache.get(lkey)
+            # the entry pins the static array it was built from (id()
+            # alone could be a recycled address after a mask evict)
+            if hit is not None and hit[0] == now and hit[1] is static:
+                _now, _st, alive, exp, live = hit
+                alive_all[ckey] = alive
+                exp_full[ckey] = exp
+                live_masks[ckey] = live
+                continue
             alive = blk.alive_mask(now)
             alive_all[ckey] = alive
             # whole-block expired count once per unique block; requests
             # spanning the full block (the common case) reuse the
             # scalar, boundary slices recount
-            exp_full[ckey] = len(alive) - int(np.count_nonzero(alive))
-            live_masks[ckey] = keep_masks[ckey][:len(ets)] & alive
+            exp = len(alive) - int(np.count_nonzero(alive))
+            exp_full[ckey] = exp
+            live = static[:len(ets)] & alive
+            live_masks[ckey] = live
+            if len(cache) >= 4096:
+                cache.pop(next(iter(cache)))
+            cache[lkey] = (now, static, alive, exp, live)
         overlay_keys, _overlay_map = state["overlay"]
         windows = []
         fast = []

@@ -77,6 +77,52 @@ def test_table_acl_allow_list(secure_cluster):
     assert mallory.get(b"k", b"s") == (OK, b"v")
 
 
+def test_access_policy_parse():
+    from pegasus_tpu.security.auth import parse_policy
+
+    pol = parse_policy("alice=rw;bob=r; ops = rwa ;broken;*=r")
+    assert pol["alice"] == {"r", "w"}
+    assert pol["bob"] == {"r"}
+    assert pol["ops"] == {"r", "w", "a"}
+    assert pol["*"] == {"r"}
+    assert "broken" not in pol
+    # unknown grant chars are dropped, not granted
+    assert parse_policy("eve=rx")["eve"] == {"r"}
+
+
+def test_per_verb_access_policy(secure_cluster):
+    """Ranger-style per-verb split (access_type.h): a read-only user is
+    denied writes at the client gate; a writer without read is denied
+    reads; wildcard grants any authenticated user."""
+    secure_cluster.create_table("rbac", partition_count=2)
+    secure_cluster.meta.update_app_envs(
+        "rbac", {"replica.access_policy": "writer=rw;reader=r"})
+    secure_cluster.step()
+    writer = secure_cluster.client("rbac", name="c-w", user="writer")
+    reader = secure_cluster.client("rbac", name="c-r", user="reader")
+    assert writer.set(b"k", b"s", b"v") == OK
+    assert reader.get(b"k", b"s") == (OK, b"v")
+    # read-only user denied the write verb
+    with pytest.raises(PegasusError) as e:
+        reader.set(b"k2", b"s", b"v")
+    assert e.value.code == ErrorCode.ERR_ACL_DENY
+    # scans are reads: allowed for reader, and an unlisted user is
+    # denied both verbs
+    assert [x for x in reader.get_scanner(b"k")] == [(b"k", b"s", b"v")]
+    nobody = secure_cluster.client("rbac", name="c-n", user="nobody")
+    with pytest.raises(PegasusError):
+        nobody.get(b"k", b"s")
+    with pytest.raises(PegasusError):
+        nobody.set(b"k3", b"s", b"v")
+    # wildcard read grant admits any AUTHENTICATED user to reads only
+    secure_cluster.meta.update_app_envs(
+        "rbac", {"replica.access_policy": "writer=rw;*=r"})
+    secure_cluster.step()
+    assert nobody.get(b"k", b"s") == (OK, b"v")
+    with pytest.raises(PegasusError):
+        nobody.set(b"k3", b"s", b"v")
+
+
 def test_duplication_works_on_secured_cluster(secure_cluster):
     """Inter-node duplication authenticates as the reserved node user."""
     secure_cluster.create_table("sm", partition_count=2)
