@@ -104,7 +104,7 @@ class ClusterClient:
 
     def _on_message(self, src: str, msg_type: str, payload) -> None:
         if msg_type in ("client_read_reply", "client_write_reply",
-                        "query_config_reply"):
+                        "query_config_reply", "negotiate_reply"):
             rid = payload.get("rid")
             # only requests still being awaited are stored: a reply that
             # straggles in after its _await gave up (e.g. delivered once a
@@ -128,6 +128,21 @@ class ClusterClient:
             return self._replies.pop(rid, None)
         finally:
             self._pending.discard(rid)
+
+    def negotiate(self, node: str, user: str, secret: str) -> bool:
+        """Run the SASL-style connection handshake with `node`
+        (security/negotiation.py; parity negotiation.h:37). On success
+        the server binds `user` to this client's address and requests
+        to that node may omit per-request credentials."""
+        from pegasus_tpu.security.negotiation import NegotiationClient
+
+        nc = NegotiationClient(user, secret)
+
+        def call(payload):
+            rid = self._send_request(node, "negotiate", dict(payload))
+            return self._await(rid) or {}
+
+        return nc.negotiate(call)
 
     # ---- config cache (parity: partition_resolver_simple) -------------
 

@@ -26,7 +26,7 @@ import time
 from typing import Dict, List, Optional, Set, Tuple
 
 from pegasus_tpu.server.backup import BackupEngine, BackupPolicy
-from pegasus_tpu.storage.block_service import LocalBlockService
+from pegasus_tpu.storage.block_service import block_service_for
 from pegasus_tpu.utils.errors import ErrorCode, PegasusError
 
 Gpid = Tuple[int, int]
@@ -200,7 +200,7 @@ class MetaBackupService:
             info["pending"].remove(gpid[1])
             info["decrees"][str(gpid[1])] = payload["decree"]
         if not info["pending"]:
-            engine = BackupEngine(LocalBlockService(info["root"]),
+            engine = BackupEngine(block_service_for(info["root"]),
                                   info["policy"])
             engine.finish_backup(backup_id, info["app_id"],
                                  info["app_name"],
@@ -224,7 +224,7 @@ class MetaBackupService:
     def create_app_from_backup(self, new_name: str, root: str,
                                policy: str, backup_id: int,
                                replica_count: int = 3) -> int:
-        engine = BackupEngine(LocalBlockService(root), policy)
+        engine = BackupEngine(block_service_for(root), policy)
         meta_blob = engine.read_backup_metadata(backup_id)
         app_id = self.meta.create_app(
             new_name, meta_blob["partition_count"], replica_count,

@@ -18,7 +18,7 @@ from __future__ import annotations
 import json
 from typing import Dict, Optional, Tuple
 
-from pegasus_tpu.storage.block_service import LocalBlockService
+from pegasus_tpu.storage.block_service import block_service_for
 from pegasus_tpu.utils.errors import ErrorCode, PegasusError
 
 Gpid = Tuple[int, int]
@@ -59,7 +59,7 @@ class MetaBulkLoadService:
         if app.app_id in self._loads:
             raise PegasusError(ErrorCode.ERR_BUSY, "bulk load in progress")
         src_app = src_app or app_name
-        bs = LocalBlockService(root)
+        bs = block_service_for(root)
         info = json.loads(bs.read_file(f"{src_app}/{BULK_LOAD_INFO}"))
         if info["partition_count"] != app.partition_count:
             raise PegasusError(

@@ -97,7 +97,7 @@ class MetaDuplicationService:
         """Completion signal for the bootstrap's restore_app verb."""
         import json as _json
 
-        from pegasus_tpu.storage.block_service import LocalBlockService
+        from pegasus_tpu.storage.block_service import block_service_for
 
         rid = payload.get("rid")
         if not isinstance(rid, str) or not rid.startswith("dupboot-"):
@@ -115,7 +115,7 @@ class MetaDuplicationService:
                 self._save()
             return  # transient failures: the tick re-sends
         policy = f"dup{dupid}"
-        bs = LocalBlockService(info["bootstrap_root"])
+        bs = block_service_for(info["bootstrap_root"])
         for pidx_s in list(info["progress"]):
             meta_blob = _json.loads(bs.read_file(
                 f"{policy}/{info['backup_id']}/{info['app_id']}/"

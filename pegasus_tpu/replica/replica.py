@@ -743,7 +743,7 @@ class Replica:
             BULK_LOAD_FILE,
             BULK_LOAD_INFO,
         )
-        from pegasus_tpu.storage.block_service import LocalBlockService
+        from pegasus_tpu.storage.block_service import block_service_for
         from pegasus_tpu.utils.errors import StorageStatus
 
         root, src_app, load_id = request
@@ -752,7 +752,7 @@ class Replica:
             # data does not re-apply
             self.server.write_service.apply_items([], decree)
             return int(StorageStatus.OK)
-        bs = LocalBlockService(root)
+        bs = block_service_for(root)
         info = _json.loads(bs.read_file(f"{src_app}/{BULK_LOAD_INFO}"))
         if info["partition_count"] != self.server.partition_count:
             # still stamp the decree: the mutation is committed groupwide

@@ -104,6 +104,7 @@ class ReplicaStub:
         # cluster auth secret (None = auth disabled); parity:
         # security/negotiation + ranger table ACLs
         self.auth_secret: Optional[str] = None
+        self._negotiation = None  # lazy NegotiationServer (needs secret)
         self.shared_fs = True
         self.transfer = TransferServer(net, name, self.fs.data_dirs)
         self._fetch_sessions: Dict = {}
@@ -170,6 +171,16 @@ class ReplicaStub:
                                "metrics snapshot [entity_type]")
         self.commands.register("flush", flush_all,
                                "flush every hosted replica's memtable")
+
+        def task_profiler(args):
+            from pegasus_tpu.utils.profiler import PROFILER
+
+            return PROFILER.control(args)
+
+        self.commands.register(
+            "task-profiler", task_profiler,
+            "per-task-code profiler toollet: enable|disable|clear|dump "
+            "(queue/exec latency + qps per message type)")
 
         def fs_stats(_args):
             return self.fs.stats()
@@ -339,6 +350,30 @@ class ReplicaStub:
             if r is not None:
                 r.on_message(src, payload["type"], payload["payload"])
             return
+        if msg_type == "negotiate":
+            # SASL-style connection auth handshake (negotiation.h:37).
+            # The identity binds to the CONNECTION session id, never to
+            # the frame's self-reported src (any TCP peer could forge
+            # that name); identities die with their connection.
+            from pegasus_tpu.security.negotiation import (
+                NegotiationServer,
+            )
+
+            if not self.auth_secret:
+                reply = {"stage": "fail", "reason": "auth disabled",
+                         "rid": payload.get("rid")}
+            else:
+                if self._negotiation is None:
+                    self._negotiation = NegotiationServer(
+                        self.auth_secret)
+                    closed = getattr(self.net, "on_session_closed",
+                                     None)
+                    if closed is not None:
+                        closed(self._negotiation.forget_session)
+                reply = self._negotiation.on_message(
+                    self._peer_key(src), payload)
+            self.net.send(self.name, src, "negotiate_reply", reply)
+            return
         if msg_type == "config_proposal":
             self._on_config_proposal(src, payload)
             return
@@ -452,7 +487,7 @@ class ReplicaStub:
         gpid = tuple(payload["gpid"])
         rid = payload["rid"]
         r = self.replicas.get(gpid)
-        if not self._client_allowed(r, payload, access="w"):
+        if not self._client_allowed(r, payload, access="w", src=src):
             self.net.send(self.name, src, "client_write_reply", {
                 "rid": rid, "err": int(ErrorCode.ERR_ACL_DENY),
                 "results": []})
@@ -517,7 +552,7 @@ class ReplicaStub:
         rid = payload["rid"]
         op = payload.get("op", "get")
         r = self.replicas.get(gpid)
-        if not self._client_allowed(r, payload, access="r"):
+        if not self._client_allowed(r, payload, access="r", src=src):
             self.net.send(self.name, src, "client_read_reply", {
                 "rid": rid, "err": int(ErrorCode.ERR_ACL_DENY),
                 "result": None})
@@ -637,7 +672,7 @@ class ReplicaStub:
     def _on_backup_partition(self, src: str, payload: dict) -> None:
         from pegasus_tpu.replica.replica import PartitionStatus
         from pegasus_tpu.server.backup import BackupEngine
-        from pegasus_tpu.storage.block_service import LocalBlockService
+        from pegasus_tpu.storage.block_service import block_service_for
 
         gpid = tuple(payload["gpid"])
         r = self.replicas.get(gpid)
@@ -672,7 +707,7 @@ class ReplicaStub:
                     # the meta backup tick re-commands this partition
                     # until an upload completes
                     return
-                engine = BackupEngine(LocalBlockService(payload["root"]),
+                engine = BackupEngine(block_service_for(payload["root"]),
                                       payload["policy"])
                 engine.upload_checkpoint(payload["backup_id"], gpid[0],
                                          gpid[1], ckpt_dir, decree)
@@ -688,7 +723,7 @@ class ReplicaStub:
     def _on_restore_partition(self, src: str, payload: dict) -> None:
         from pegasus_tpu.replica.replica import PartitionStatus
         from pegasus_tpu.server.backup import BackupEngine
-        from pegasus_tpu.storage.block_service import LocalBlockService
+        from pegasus_tpu.storage.block_service import block_service_for
 
         gpid = tuple(payload["gpid"])
         r = self.replicas.get(gpid)
@@ -701,7 +736,7 @@ class ReplicaStub:
             self.net.send(self.name, src, "restore_partition_done",
                           {"gpid": gpid})
             return
-        engine = BackupEngine(LocalBlockService(payload["root"]),
+        engine = BackupEngine(block_service_for(payload["root"]),
                               payload["policy"])
         app_dir = r.server.engine.data_dir
         r.server.engine.close()
@@ -780,7 +815,7 @@ class ReplicaStub:
         for gpid, reqs in groups:
             gpid = tuple(gpid)
             r = self.replicas.get(gpid)
-            if not self._client_allowed(r, payload, access="r"):
+            if not self._client_allowed(r, payload, access="r", src=src):
                 # auth/ACL is PERMANENT — distinct from stale-primary so
                 # the client doesn't burn retries re-resolving
                 errs = []
@@ -828,12 +863,24 @@ class ReplicaStub:
         self.net.send(self.name, src, "client_read_reply", {
             "rid": rid, "err": int(ErrorCode.ERR_OK), "result": slots})
 
+    def _peer_key(self, src: str):
+        """Session-scoped peer key for negotiation state: (src,
+        connection id). On the TCP transport the connection id is
+        unforgeable; the sim transport (in-process, trusted) has no
+        sessions and keys on the name alone."""
+        current = getattr(self.net, "current_session", None)
+        return (src, current() if current is not None else "")
+
     def _client_allowed(self, r, payload: dict,
-                        access: str = "") -> bool:
+                        access: str = "", src: str = None) -> bool:
         """Auth + table-ACL gate (parity: the ACL gate leading the client
         gate stack, replica_2pc.cpp:117 / replica.cpp:388), with the
         Ranger-style per-verb access class (access_type.h) when the
-        table carries a `replica.access_policy` env."""
+        table carries a `replica.access_policy` env. A peer that
+        completed the connection negotiation (security/negotiation.py)
+        may omit per-request credentials: its SESSION identity applies,
+        exactly like the reference attaches the negotiated user to the
+        RPC session."""
         from pegasus_tpu.security.auth import check_client
 
         allowed = ""
@@ -841,7 +888,15 @@ class ReplicaStub:
         if r is not None:
             allowed = r.server.app_envs.get("replica.allowed_users", "")
             policy = r.server.app_envs.get("replica.access_policy", "")
-        return check_client(payload.get("auth"), self.auth_secret,
+        auth = payload.get("auth")
+        if (auth is None and src is not None and self.auth_secret
+                and self._negotiation is not None):
+            user = self._negotiation.identity(self._peer_key(src))
+            if user is not None:
+                # authenticated at negotiation time; only ACLs remain
+                return check_client((user, ""), None, allowed,
+                                    policy=policy, access=access)
+        return check_client(auth, self.auth_secret,
                             allowed, policy=policy, access=access)
 
     # ---- partition split (parity: replica_split_manager.h:58 — the

@@ -35,6 +35,9 @@ from pegasus_tpu.rpc.message import decode_message, encode_message, read_frames
 
 Addr = Tuple[str, int]
 
+import itertools as _itertools
+_SESSION_IDS = _itertools.count(1)
+
 
 class TcpTransport:
     def __init__(self, listen: Optional[Addr],
@@ -48,6 +51,8 @@ class TcpTransport:
         self.address_book = dict(address_book)
         self.lock = threading.RLock()  # node-wide handler serialization
         self._handlers: Dict[str, Callable[[str, str, Any], None]] = {}
+        self._current_session: str = ""
+        self._session_closed_cbs: list = []
         # name -> (socket, write-lock); outbound dials and learned inbound
         # routes share this table (latest wins — a reconnecting peer's new
         # connection replaces the dead one)
@@ -82,6 +87,17 @@ class TcpTransport:
 
     # ---- public interface (SimNetwork-compatible) ----------------------
 
+    def current_session(self) -> str:
+        """The connection id of the message being dispatched (empty
+        outside a dispatch). Security state keys on THIS, not on the
+        frame's self-reported src."""
+        return self._current_session
+
+    def on_session_closed(self, cb) -> None:
+        """Subscribe to connection teardown (sess id) — negotiated
+        identities die with their connection."""
+        self._session_closed_cbs.append(cb)
+
     def register(self, addr: str,
                  handler: Callable[[str, str, Any], None]) -> None:
         self._handlers[addr] = handler
@@ -89,7 +105,8 @@ class TcpTransport:
     def send(self, src: str, dst: str, msg_type: str, payload: Any) -> None:
         if dst in self._handlers:
             # loopback: still through the inbox so delivery stays serial
-            self._inbox.put((src, dst, msg_type, payload))
+            self._inbox.put((time.perf_counter(), src, dst, msg_type,
+                             payload, "loopback"))
             return
         # encode HERE so an unencodable payload raises at the caller (a
         # programming error, not network loss); network IO happens on the
@@ -229,6 +246,10 @@ class TcpTransport:
             self._spawn(self._read_loop, conn)
 
     def _read_loop(self, conn: socket.socket) -> None:
+        # connection-scoped session id: security state (negotiated
+        # identities) must bind to the CONNECTION, never to the
+        # forgeable self-reported `src` name in the frame
+        sess = f"conn-{id(conn)}-{_SESSION_IDS.__next__()}"
         buf = bytearray()
         while not self._closing:
             try:
@@ -248,11 +269,17 @@ class TcpTransport:
                 except (ValueError, TypeError):
                     continue
                 self._learn_route(src, conn)
-                self._inbox.put((src, dst, msg_type, payload))
+                self._inbox.put((time.perf_counter(), src, dst, msg_type,
+                                 payload, sess))
         try:
             conn.close()
         except OSError:
             pass
+        for cb in list(self._session_closed_cbs):
+            try:
+                cb(sess)
+            except Exception:  # noqa: BLE001 - observer must not kill IO
+                pass
 
     def _dispatch_loop(self) -> None:
         from pegasus_tpu.utils.metrics import METRICS
@@ -260,6 +287,8 @@ class TcpTransport:
         # profiler toollet (parity: runtime/profiler.cpp:90-198 —
         # per-task-code execute latency/counts from engine join points;
         # here the join point is handler dispatch, keyed by message type)
+        from pegasus_tpu.utils.profiler import PROFILER
+
         prof = METRICS.entity("rpc", "dispatch", {})
         lat: Dict[str, Any] = {}
         cnt: Dict[str, Any] = {}
@@ -267,12 +296,16 @@ class TcpTransport:
             item = self._inbox.get()
             if item is None:
                 return
-            src, dst, msg_type, payload = item
+            t_enq, src, dst, msg_type, payload, sess = item
             handler = self._handlers.get(dst)
             if handler is None:
                 continue
             t0 = time.perf_counter()
             try:
+                # the dispatcher is the node's single handler thread, so
+                # a plain attribute safely exposes the CONNECTION the
+                # in-flight message arrived on (see current_session())
+                self._current_session = sess
                 with self.lock:
                     handler(src, msg_type, payload)
             except Exception:  # noqa: BLE001 - a bad message must not
@@ -280,10 +313,16 @@ class TcpTransport:
 
                 traceback.print_exc()
             finally:
+                t1 = time.perf_counter()
                 p_lat = lat.get(msg_type)
                 if p_lat is None:
                     p_lat = lat[msg_type] = prof.percentile(
                         f"{msg_type}_exec_ms")
                     cnt[msg_type] = prof.counter(f"{msg_type}_count")
-                p_lat.set((time.perf_counter() - t0) * 1000.0)
+                p_lat.set((t1 - t0) * 1000.0)
                 cnt[msg_type].increment()
+                if PROFILER.enabled:
+                    # toollet join point: queue delay + exec latency
+                    # per task code (profiler.cpp:90-198)
+                    PROFILER.observe(msg_type, (t0 - t_enq) * 1000.0,
+                                     (t1 - t0) * 1000.0)

@@ -10,12 +10,16 @@ replays exactly from its seed — the property the reference's simple_kv
 
 from __future__ import annotations
 
+from time import perf_counter as _perf_counter
+
 import heapq
 import itertools
 from typing import Any, Callable, Dict, Optional, Tuple
 
 import numpy as np
 
+
+from pegasus_tpu.utils.profiler import PROFILER as _PROFILER
 
 class SimLoop:
     """Virtual-clock event loop. Time only advances between events."""
@@ -129,6 +133,14 @@ class SimNetwork:
             handler = self._handlers.get(dst)
             if handler is not None and dst not in self._partitioned:
                 self.delivered += 1
-                handler(src, msg_type, payload)
+                if _PROFILER.enabled:
+                    # toollet join point (profiler.cpp:90-198): queue
+                    # delay is the SIM link latency; exec is wall time
+                    t0 = _perf_counter()
+                    handler(src, msg_type, payload)
+                    _PROFILER.observe(msg_type, delay * 1000.0,
+                                      (_perf_counter() - t0) * 1000.0)
+                else:
+                    handler(src, msg_type, payload)
 
         self.loop.schedule(delay, deliver)

@@ -2,9 +2,17 @@
 
 Parity: src/block_service/block_service.h:273,337 — the abstract remote
 file system (create_file / write / read / list_dir / remove_path /
-upload / download) used by cold backup, restore, and bulk load. Backends:
-LocalFS here (parity: block_service/local/local_service.h:47); an object
-store (GCS/HDFS-style) backend slots in behind the same interface.
+upload / download) used by cold backup, restore, and bulk load.
+Backends: LocalFS (parity: block_service/local/local_service.h:47) and
+RemoteBlockService, a network blob store speaking the blob daemon's
+HTTP protocol (storage/blob_server.py — the HDFS-backend role,
+block_service/hdfs/hdfs_service.h:47).
+
+Every subsystem resolves its configured root through
+`block_service_for(root)`: a plain path is local, `remote://host:port[/
+bucket]` is the network backend — so pointing a backup policy / bulk
+load / duplication bootstrap at a remote store is a config change, not
+a code change.
 """
 
 from __future__ import annotations
@@ -44,6 +52,98 @@ class BlockService:
         os.makedirs(os.path.dirname(local_path) or ".", exist_ok=True)
         with open_data_file(local_path, "wb") as f:
             f.write(self.read_file(remote_path))
+
+
+class RemoteBlockService(BlockService):
+    """Network blob store over the blob daemon's HTTP protocol
+    (storage/blob_server.py). Content md5 is verified on read against
+    the server's X-Content-MD5 header — the same end-to-end integrity
+    LocalBlockService gets from its sidecar files."""
+
+    def __init__(self, url: str) -> None:
+        # url: "remote://host:port[/bucket]"
+        rest = url[len("remote://"):]
+        hostport, _, bucket = rest.partition("/")
+        host, _, port = hostport.partition(":")
+        self.host = host
+        self.port = int(port or 8950)
+        self.bucket = bucket.strip("/")
+        self._base = f"http://{self.host}:{self.port}"
+
+    def _url(self, kind: str, path: str) -> str:
+        p = "/".join(x for x in (self.bucket, path.lstrip("/")) if x)
+        return f"{self._base}/{kind}/{p}"
+
+    def _request(self, method: str, url: str, data: bytes = None):
+        import urllib.request
+
+        req = urllib.request.Request(url, data=data, method=method)
+        return urllib.request.urlopen(req, timeout=60)
+
+    def write_file(self, path: str, data: bytes) -> None:
+        with self._request("PUT", self._url("blob", path), data) as r:
+            if r.status != 200:
+                raise IOError(f"blob PUT {path}: {r.status}")
+            want = hashlib.md5(data).hexdigest()
+            got = r.headers.get("X-Content-MD5", "")
+            if got and got != want:
+                # the server stored bytes that do not match what we
+                # sent: surface NOW, not at some future restore
+                raise IOError(f"blob PUT {path}: stored md5 {got} != "
+                              f"sent {want}")
+
+    def read_file(self, path: str) -> bytes:
+        import urllib.error
+
+        try:
+            with self._request("GET", self._url("blob", path)) as r:
+                data = r.read()
+                want = r.headers.get("X-Content-MD5", "")
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                raise FileNotFoundError(
+                    f"blob GET {path}: not found") from e
+            # 5xx / integrity failures are SERVER errors, not absence —
+            # a corrupt backup must not read as "never taken"
+            raise IOError(f"blob GET {path}: HTTP {e.code}") from e
+        if want and hashlib.md5(data).hexdigest() != want:
+            raise IOError(f"blob md5 mismatch for {path}")
+        return data
+
+    def exists(self, path: str) -> bool:
+        import urllib.error
+
+        try:
+            with self._request("HEAD", self._url("blob", path)) as r:
+                return r.status == 200
+        except urllib.error.HTTPError:
+            return False
+
+    def list_dir(self, path: str) -> List[str]:
+        import urllib.error
+
+        try:
+            with self._request("GET", self._url("list", path)) as r:
+                return json.loads(r.read())
+        except urllib.error.HTTPError:
+            return []
+
+    def remove_path(self, path: str) -> None:
+        import urllib.error
+
+        try:
+            self._request("DELETE", self._url("blob", path)).close()
+        except urllib.error.HTTPError:
+            pass
+
+
+def block_service_for(root: str) -> BlockService:
+    """Resolve a configured backup/bulk-load/bootstrap root to its
+    backend (the block_service_manager role,
+    block_service/block_service_manager.h)."""
+    if root.startswith("remote://"):
+        return RemoteBlockService(root)
+    return LocalBlockService(root)
 
 
 class LocalBlockService(BlockService):

@@ -1149,9 +1149,9 @@ def _dispatch(args, box, out) -> int:
               file=out)
     elif args.cmd == "backup":
         from pegasus_tpu.server.backup import BackupEngine
-        from pegasus_tpu.storage.block_service import LocalBlockService
+        from pegasus_tpu.storage.block_service import block_service_for
         t = box.open_table(args.table)  # NotImplementedError in wire mode
-        be = BackupEngine(LocalBlockService(args.bucket), args.policy)
+        be = BackupEngine(block_service_for(args.bucket), args.policy)
         for p_ in t.all_partitions():
             be.backup_partition(args.backup_id, t.app_id, p_.pidx,
                                 p_.engine)
@@ -1354,8 +1354,8 @@ def _dispatch(args, box, out) -> int:
             raise NotImplementedError(
                 "restore needs local table access — use --root mode")
         from pegasus_tpu.server.backup import BackupEngine
-        from pegasus_tpu.storage.block_service import LocalBlockService
-        be = BackupEngine(LocalBlockService(args.bucket), args.policy)
+        from pegasus_tpu.storage.block_service import block_service_for
+        be = BackupEngine(block_service_for(args.bucket), args.policy)
         meta = be.read_backup_metadata(args.backup_id)
         new_name = args.new_name or f"{args.table}_restored"
         t = box.create_table(new_name, meta["partition_count"])

@@ -1,0 +1,63 @@
+"""Per-task-code profiler toollet (parity: runtime/profiler.cpp:90-198
+— per-code queue/exec latency + throughput, opt-in, dumped via the
+remote-command surface)."""
+
+import pytest
+
+from pegasus_tpu.tools.cluster import SimCluster
+from pegasus_tpu.utils.profiler import PROFILER
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    PROFILER.disable()
+    PROFILER.clear()
+    c = SimCluster(str(tmp_path / "cl"), n_nodes=2)
+    yield c
+    c.close()
+    PROFILER.disable()
+    PROFILER.clear()
+
+
+def test_profiler_collects_per_code_stats(cluster):
+    cluster.create_table("pf", partition_count=2)
+    client = cluster.client("pf")
+    # off by default: traffic leaves no rows
+    assert client.set(b"a", b"s", b"v") == 0
+    assert PROFILER.dump() == []
+    PROFILER.enable()
+    for i in range(30):
+        assert client.set(b"k%d" % i, b"s", b"v") == 0
+        assert client.get(b"k%d" % i, b"s") == (0, b"v")
+    rows = {r["code"]: r for r in PROFILER.dump()}
+    assert "client_write" in rows and "client_read" in rows
+    w = rows["client_write"]
+    assert w["count"] >= 30
+    assert w["exec_ms_p99"] >= w["exec_ms_p50"] >= 0
+    assert w["queue_ms_p50"] >= 0 and "qps" in w
+    # disable stops collection; clear empties it
+    PROFILER.disable()
+    before = rows["client_write"]["count"]
+    client.set(b"z", b"s", b"v")
+    after = {r["code"]: r for r in PROFILER.dump()}["client_write"]
+    assert after["count"] == before
+    PROFILER.clear()
+    assert PROFILER.dump() == []
+
+
+def test_profiler_remote_command_surface(cluster):
+    """Operators drive it through the stub's command registry (shell
+    remote_command <node> task-profiler ...)."""
+    cluster.create_table("pc", partition_count=1)
+    stub = next(iter(cluster.stubs.values()))
+    assert "task-profiler" in stub.commands.verbs()
+    assert "enabled" in stub.commands.call("task-profiler", ["enable"])
+    client = cluster.client("pc")
+    for i in range(10):
+        client.set(b"x%d" % i, b"s", b"v")
+    rows = stub.commands.call("task-profiler", [])
+    assert any(r["code"] == "client_write" for r in rows)
+    assert "cleared" in stub.commands.call("task-profiler", ["clear"])
+    assert stub.commands.call("task-profiler", ["dump"]) == []
+    assert "disabled" in stub.commands.call("task-profiler",
+                                            ["disable"])

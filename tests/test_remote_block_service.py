@@ -1,0 +1,75 @@
+"""Remote blob-store backend (parity: block_service/hdfs/
+hdfs_service.h:47 — the NETWORK backend behind block_service.h:273):
+the blob daemon + RemoteBlockService client, and a full backup/restore
+cycle whose root is a remote:// URL."""
+
+import pytest
+
+from pegasus_tpu.storage.blob_server import BlobServer
+from pegasus_tpu.storage.block_service import (
+    RemoteBlockService,
+    block_service_for,
+)
+from pegasus_tpu.tools.cluster import SimCluster
+
+
+@pytest.fixture
+def blob(tmp_path):
+    srv = BlobServer(str(tmp_path / "blobroot"))
+    yield srv
+    srv.close()
+
+
+def test_remote_interface_roundtrip(blob, tmp_path):
+    bs = block_service_for(blob.url + "/bucket1")
+    assert isinstance(bs, RemoteBlockService)
+    assert not bs.exists("a/b.txt")
+    bs.write_file("a/b.txt", b"hello-blob")
+    assert bs.exists("a/b.txt")
+    assert bs.read_file("a/b.txt") == b"hello-blob"
+    bs.write_file("a/c.txt", b"two")
+    assert bs.list_dir("a") == ["b.txt", "c.txt"]
+    # upload/download ride the same verbs
+    p = tmp_path / "local.bin"
+    p.write_bytes(b"\x00\x01\xffpayload")
+    bs.upload(str(p), "up/l.bin")
+    q = tmp_path / "out" / "l.bin"
+    bs.download("up/l.bin", str(q))
+    assert q.read_bytes() == b"\x00\x01\xffpayload"
+    # buckets isolate
+    other = block_service_for(blob.url + "/bucket2")
+    assert not other.exists("a/b.txt")
+    bs.remove_path("a")
+    assert bs.list_dir("a") == []
+    with pytest.raises(FileNotFoundError):
+        bs.read_file("a/b.txt")
+
+
+def test_backup_restore_over_remote_backend(blob, tmp_path):
+    """The same cold-backup -> restore flow the local backend serves,
+    with the policy root pointed at the network store — proving the
+    abstraction the way the reference's HDFS backend does."""
+    c = SimCluster(str(tmp_path / "cl"), n_nodes=3)
+    try:
+        c.create_table("rb", partition_count=2)
+        cl = c.client("rb")
+        for i in range(30):
+            assert cl.set(b"k%03d" % i, b"s", b"v%d" % i) == 0
+        root = blob.url + "/backups"
+        c.meta.backup.add_policy("net", ["rb"], root,
+                                 interval_seconds=5)
+        c.step(rounds=10)
+        from pegasus_tpu.server.backup import BackupEngine
+
+        be = BackupEngine(block_service_for(root), "net")
+        backups = be.list_backups()
+        assert backups, "no backup landed on the remote store"
+        # restore into a NEW table from the remote artifacts
+        c.meta.backup.create_app_from_backup(
+            "rb_restored", root, "net", backups[-1], replica_count=3)
+        c.step(rounds=12)
+        rc = c.client("rb_restored")
+        for i in range(30):
+            assert rc.get(b"k%03d" % i, b"s") == (0, b"v%d" % i)
+    finally:
+        c.close()

@@ -135,3 +135,142 @@ def test_duplication_works_on_secured_cluster(secure_cluster):
         secure_cluster.step()
     fc = secure_cluster.client("sf", user="alice")
     assert fc.get(b"sk", b"s") == (OK, b"sv")
+
+
+def test_negotiation_state_machine_unit():
+    """Unit-level transitions (parity: negotiation.cpp rejects invalid
+    transitions): happy path, out-of-order stages, bad proof, restart
+    voiding the old identity."""
+    from pegasus_tpu.security.negotiation import (
+        NegotiationClient,
+        NegotiationServer,
+    )
+
+    srv = NegotiationServer("k")
+
+    def drive(payloads):
+        return [srv.on_message("peer", p) for p in payloads]
+
+    # out-of-order: respond before anything
+    (r,) = drive([{"stage": "respond", "proof": "x"}])
+    assert r["stage"] == "fail"
+    # select before list
+    (r,) = drive([{"stage": "select", "mechanism": "HMAC-SHA256",
+                   "user": "u"}])
+    assert r["stage"] == "fail"
+    # happy path through the client driver
+    def call(payload):
+        return srv.on_message("peer", payload)
+
+    assert NegotiationClient("alice", "k").negotiate(call)
+    assert srv.identity("peer") == "alice"
+    # wrong secret fails at the proof step and clears the identity
+    assert not NegotiationClient("alice", "WRONG").negotiate(call)
+    assert srv.identity("peer") is None
+    # restart voids a previously negotiated identity immediately
+    assert NegotiationClient("bob", "k").negotiate(call)
+    srv.on_message("peer", {"stage": "list_mechanisms"})
+    assert srv.identity("peer") is None
+    srv.forget("peer")
+
+
+def test_negotiated_session_serves_without_per_request_tokens(
+        secure_cluster):
+    """End-to-end: an anonymous client is denied; after the handshake
+    its SESSION identity authenticates requests (and the per-verb
+    policy applies to that identity)."""
+    secure_cluster.create_table("neg", partition_count=1)
+    secure_cluster.meta.update_app_envs(
+        "neg", {"replica.access_policy": "alice=rw"})
+    secure_cluster.step()
+    c = secure_cluster.client("neg", name="c-neg")
+    c.auth = None  # no per-request credentials at all
+    with pytest.raises(PegasusError):
+        c.set(b"k", b"s", b"v")
+    c.refresh_config()
+    node = c._primary_of(0)
+    # wrong secret: handshake fails, still denied
+    assert not c.negotiate(node, "alice", "WRONG")
+    with pytest.raises(PegasusError):
+        c.set(b"k", b"s", b"v")
+    # correct handshake: session identity serves both verbs
+    assert c.negotiate(node, "alice", "topsecret")
+    assert c.set(b"k", b"s", b"v") == OK
+    assert c.get(b"k", b"s") == (OK, b"v")
+    # the session identity is still subject to the ACL policy
+    secure_cluster.meta.update_app_envs(
+        "neg", {"replica.access_policy": "alice=r"})
+    secure_cluster.step()
+    with pytest.raises(PegasusError) as e:
+        c.set(b"k2", b"s", b"v")
+    assert e.value.code == ErrorCode.ERR_ACL_DENY
+
+
+def test_negotiated_identity_binds_to_connection_not_name():
+    """Over REAL TCP, a negotiated identity must bind to the
+    connection, not to the frame's self-reported src name — a second
+    connection claiming the same name must NOT inherit the identity
+    (the impersonation the session keying exists to stop)."""
+    import time as _time
+
+    from pegasus_tpu.rpc.transport import TcpTransport
+    from pegasus_tpu.security.negotiation import NegotiationServer
+
+    server = TcpTransport(("127.0.0.1", 0), {})
+    host, port = server.listen_addr
+    neg = NegotiationServer("shh")
+    seen = []
+
+    def srv_handler(src, msg_type, payload):
+        sess = server.current_session()
+        key = (src, sess)
+        if msg_type == "negotiate":
+            server.send("srv", src, "negotiate_reply",
+                        neg.on_message(key, payload))
+        elif msg_type == "whoami":
+            seen.append(neg.identity(key))
+    server.register("srv", srv_handler)
+    server.on_session_closed(neg.forget_session)
+
+    def mk_client(name):
+        t = TcpTransport(None, {"srv": (host, port)})
+        replies = []
+        t.register(name, lambda s, mt, p: replies.append(p))
+        return t, replies
+
+    c1, r1 = mk_client("cli")
+
+    def call(t, replies, payload):
+        n = len(replies)
+        t.send("cli", "srv", "negotiate", payload)
+        deadline = _time.monotonic() + 5
+        while len(replies) == n and _time.monotonic() < deadline:
+            _time.sleep(0.005)
+        return replies[-1] if len(replies) > n else {}
+
+    from pegasus_tpu.security.negotiation import NegotiationClient
+
+    ok = NegotiationClient("alice", "shh").negotiate(
+        lambda p: call(c1, r1, p))
+    assert ok
+    c1.send("cli", "srv", "whoami", {})
+    # ATTACKER: a fresh TCP connection forging src="cli", no handshake
+    c2, _r2 = mk_client("cli")
+    c2.send("cli", "srv", "whoami", {})
+    deadline = _time.monotonic() + 5
+    while len(seen) < 2 and _time.monotonic() < deadline:
+        _time.sleep(0.005)
+    assert seen[0] == "alice"      # the negotiated connection
+    assert seen[1] is None, "forged src inherited the identity!"
+    # teardown drops the identity with the connection
+    c1.close()
+    _time.sleep(0.3)
+    c3, _ = mk_client("cli")
+    c3.send("cli", "srv", "whoami", {})
+    deadline = _time.monotonic() + 5
+    while len(seen) < 3 and _time.monotonic() < deadline:
+        _time.sleep(0.005)
+    assert seen[2] is None
+    c2.close()
+    c3.close()
+    server.close()
