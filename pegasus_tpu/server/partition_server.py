@@ -165,6 +165,12 @@ class PartitionServer:
                                           cluster_id)
         self._write_lock = threading.Lock()  # single-writer invariant
         self._scan_cache = ScanContextCache()
+        # (store-instance, generation, {(start, stop, want-bucket) ->
+        # (plan, unique-entries)}): one dict PER GENERATION, replaced
+        # wholesale when the run set (or the whole engine — learner
+        # checkpoint apply / restore swap it) changes, so stale plans
+        # can neither serve pre-swap blocks nor pin dead files
+        self._plan_cache = None
         # (ckey, static-mask-id) -> (second, alive, expired_count, live):
         # per-second TTL-applied serving masks (see prepare_serve)
         self._live_cache: dict = {}
@@ -1043,9 +1049,20 @@ class PartitionServer:
         overlay = self._overlay_snapshot(now, validate, filter_key) \
             if overlay_count else ([], {})
         # 1 — per request: the block list + boundary bounds, capped a bit
-        # beyond batch_size so expiry/hash drops don't starve the page
+        # beyond batch_size so expiry/hash drops don't starve the page.
+        # Plans are CACHED per (range, want-bucket, store generation):
+        # zipfian traffic re-issues the same popular scans constantly,
+        # and a plan is pure over the immutable run set (the generation
+        # key invalidates on flush/ingest/compaction). The want bucket
+        # (pow2) keeps variants bounded; an over-budgeted cached plan
+        # only means a further frontier, never a wrong page.
         req_plans = []
         unique: "OrderedDict[tuple, tuple]" = OrderedDict()
+        gen = lsm.generation
+        pc = self._plan_cache
+        if pc is None or pc[0] is not lsm or pc[1] != gen:
+            pc = self._plan_cache = (lsm, gen, {})
+        cache = pc[2]
         for req in reqs:
             start_key = req.start_key or b""
             if start_key and not req.start_inclusive:
@@ -1055,28 +1072,40 @@ class PartitionServer:
                 stop_key = _after(stop_key)
             want = min(req.batch_size if req.batch_size > 0 else 1000,
                        SCAN_BATCH_CAP)
-            plan = []
-            budget = want * 2 + 64
-            for run in runs:
-                if stop_key and (run.first_key or b"") >= stop_key:
-                    continue
-                if start_key and (run.last_key or b"") < start_key:
-                    continue
-                for bm, blk in run.iter_blocks(start_key,
-                                               stop_key or None):
-                    lo, hi = 0, blk.count
-                    if start_key and bm.first_key < start_key:
-                        lo = _lower_bound(blk, start_key)
-                    if stop_key and bm.last_key >= stop_key:
-                        hi = _lower_bound(blk, stop_key)
-                    ckey = (run.path, bm.offset)
-                    unique.setdefault(ckey, (run, bm, blk))
-                    plan.append((ckey, blk, lo, hi))
-                    budget -= hi - lo
+            wb = 1 << (want - 1).bit_length() if want > 1 else 1
+            pkey = (start_key, stop_key, wb)
+            hit = cache.get(pkey)
+            if hit is not None:
+                plan, uniq_entries = hit
+            else:
+                plan = []
+                uniq_entries = []
+                budget = wb * 2 + 64
+                for run in runs:
+                    if stop_key and (run.first_key or b"") >= stop_key:
+                        continue
+                    if start_key and (run.last_key or b"") < start_key:
+                        continue
+                    for bm, blk in run.iter_blocks(start_key,
+                                                   stop_key or None):
+                        lo, hi = 0, blk.count
+                        if start_key and bm.first_key < start_key:
+                            lo = _lower_bound(blk, start_key)
+                        if stop_key and bm.last_key >= stop_key:
+                            hi = _lower_bound(blk, stop_key)
+                        ckey = (run.path, bm.offset)
+                        uniq_entries.append((ckey, run, bm, blk))
+                        plan.append((ckey, blk, lo, hi))
+                        budget -= hi - lo
+                        if budget <= 0:
+                            break
                     if budget <= 0:
                         break
-                if budget <= 0:
-                    break
+                if len(cache) >= 8192:
+                    cache.pop(next(iter(cache)))
+                cache[pkey] = (plan, uniq_entries)
+            for ckey, run, bm, blk in uniq_entries:
+                unique.setdefault(ckey, (run, bm, blk))
             req_plans.append((req, start_key, stop_key, want, plan))
         return {"reqs": reqs, "req_plans": req_plans, "unique": unique,
                 "validate": validate, "now": now, "overlay": overlay,

@@ -63,15 +63,14 @@ class _Handler(BaseHTTPRequestHandler):
             if not self.store.exists(p):
                 return self._reply(404)
             try:
-                data = self.store.read_file(p)
+                data, digest = self.store.read_file_with_md5(p)
             except ValueError:
                 return self._reply(400)
             except OSError:
                 # includes the sidecar md5 mismatch: an INTEGRITY
                 # failure, which must not masquerade as absence
                 return self._reply(500)
-            return self._reply(200, data,
-                               content_md5=hashlib.md5(data).hexdigest())
+            return self._reply(200, data, content_md5=digest)
         if self.path.startswith("/list/"):
             names = self.store.list_dir(self._path("/list/"))
             return self._reply(200, json.dumps(names).encode())

@@ -125,16 +125,22 @@ class RemoteBlockService(BlockService):
         try:
             with self._request("GET", self._url("list", path)) as r:
                 return json.loads(r.read())
-        except urllib.error.HTTPError:
-            return []
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                return []
+            # a server fault must not read as "no backups exist"
+            raise IOError(f"blob LIST {path}: HTTP {e.code}") from e
 
     def remove_path(self, path: str) -> None:
         import urllib.error
 
         try:
             self._request("DELETE", self._url("blob", path)).close()
-        except urllib.error.HTTPError:
-            pass
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                return  # already absent: removal is idempotent
+            # a failed delete silently "succeeding" leaks artifacts
+            raise IOError(f"blob DELETE {path}: HTTP {e.code}") from e
 
 
 def block_service_for(root: str) -> BlockService:
@@ -176,16 +182,23 @@ class LocalBlockService(BlockService):
             f.write(hashlib.md5(data).hexdigest())
 
     def read_file(self, path: str) -> bytes:
+        return self.read_file_with_md5(path)[0]
+
+    def read_file_with_md5(self, path: str):
+        """(data, md5hex) with the digest computed exactly once —
+        verified against the sidecar when present (the blob daemon
+        serves the digest in X-Content-MD5 without re-hashing)."""
         abs_path = self._abs(path)
         with open(abs_path, "rb") as f:
             data = f.read()
+        digest = hashlib.md5(data).hexdigest()
         md5_path = abs_path + ".md5"
         if os.path.exists(md5_path):
             with open(md5_path) as f:
                 want = f.read().strip()
-            if hashlib.md5(data).hexdigest() != want:
+            if digest != want:
                 raise IOError(f"block service md5 mismatch for {path}")
-        return data
+        return data, digest
 
     def exists(self, path: str) -> bool:
         return os.path.exists(self._abs(path))

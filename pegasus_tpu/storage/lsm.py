@@ -62,6 +62,10 @@ class LSMStore:
         self.l0: List[SSTable] = []   # newest first
         self.l1_runs: List[SSTable] = []  # key-ordered, non-overlapping
         self._file_seq = 0
+        # bumped whenever the visible run set changes (flush / ingest /
+        # compaction publish): callers key derived caches (scan plans)
+        # on it so they invalidate exactly when the block set does
+        self.generation = 0
         self._load_existing()
 
     # ---- files --------------------------------------------------------
@@ -173,6 +177,7 @@ class LSMStore:
         table = SSTable(writer.path)
         self.l0.insert(0, table)
         self.memtable = Memtable()
+        self.generation += 1
         return table
 
     def ingest(self, build_sst, meta: Optional[dict] = None):
@@ -183,6 +188,7 @@ class LSMStore:
         build_sst(dest, meta)
         table = SSTable(dest)
         self.l0.insert(0, table)
+        self.generation += 1
         return table
 
     def should_compact(self) -> bool:
@@ -365,6 +371,7 @@ class LSMStore:
         self._write_manifest([os.path.basename(t.path) for t in new_runs])
         old_runs = self.l1_runs
         self.l1_runs = new_runs
+        self.generation += 1
         old_l0: List[SSTable] = []
         if reset_overlay:
             old_l0, self.l0 = self.l0, []
