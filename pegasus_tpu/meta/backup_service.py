@@ -208,7 +208,14 @@ class MetaBackupService:
             hist = self._policies.get(info["policy"], {}).get(
                 "backup_history_count")
             if hist:
-                engine.gc_old_backups(hist)
+                try:
+                    engine.gc_old_backups(hist)
+                except IOError:
+                    # history GC is best-effort housekeeping: a blob-
+                    # store fault here must not wedge the backup's
+                    # COMPLETION bookkeeping (the next policy-driven
+                    # backup retries the GC)
+                    pass
             del self._inflight[backup_id]
             self._completed[backup_id] = {
                 "root": info["root"], "policy": info["policy"],

@@ -137,8 +137,13 @@ class ActRunner:
         elif verb == "drop_all":
             c.net.set_drop(float(args[0]))
         elif verb == "delay":
-            # delay: <src> <dst> <ms> — extra fixed latency on one link
-            c.net.set_delay(float(args[2]) / 1000.0, args[0], args[1])
+            # delay: [<src> <dst>] <ms> — extra fixed latency on one
+            # link, or on EVERY link when only <ms> is given
+            if len(args) == 1:
+                c.net.set_delay(float(args[0]) / 1000.0)
+            else:
+                c.net.set_delay(float(args[2]) / 1000.0, args[0],
+                                args[1])
         elif verb == "partition":
             # cut a live node off the network entirely (unlike kill:, the
             # process keeps running — lease expiry, not crash recovery)
