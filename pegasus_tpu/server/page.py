@@ -44,16 +44,34 @@ def block_native_ptrs(blk):
     return nat
 
 
+def plan_geometry(plan):
+    """(total_rows, value-heap span upper bound, max key width) of a
+    plan — the native assembly's arena sizing. Computed once per cached
+    plan (partition_server.plan_scan_batch) and carried in the window
+    tuple; recomputed here only for callers without a cache."""
+    total_rows = 0
+    span = 0
+    max_w = 2
+    for _ckey, blk, lo, hi in plan:
+        total_rows += hi - lo
+        vo = blk.value_offs
+        span += int(vo[hi]) - int(vo[lo])
+        if blk.keys.shape[1] > max_w:
+            max_w = blk.keys.shape[1]
+    return total_rows, span, max_w
+
+
 def serve_batch(req_windows, unique, byte_cap: int, hdr: int):
     """Whole-BATCH base-path assembly in ONE native call.
 
     req_windows: per fast-path request (plan, want, no_value,
-    want_ets, live_masks) where plan is [(ckey, Block, lo, hi)] in key
-    order and live_masks maps ckey -> bool[count] (that request's
+    want_ets, live_masks, geom) where plan is [(ckey, Block, lo, hi)]
+    in key order, live_masks maps ckey -> bool[count] (that request's
     static keep AND host TTL — PER WINDOW, because filter flavors
-    sharing a block carry different masks); unique: OrderedDict
-    ckey -> (run, bm, blk) covering every planned block (may span
-    partitions).
+    sharing a block carry different masks), and geom is
+    plan_geometry(plan) (may be omitted — recomputed then); unique:
+    OrderedDict ckey -> (run, bm, blk) covering every planned block
+    (may span partitions).
 
     Packs every request's surviving rows into shared arenas via
     packer.cpp pegasus_scan_serve_batch — the C++ twin of the
@@ -99,12 +117,10 @@ def serve_batch(req_windows, unique, byte_cap: int, hdr: int):
     rows_total = 0
     key_cap = 0
     val_cap = 0
-    for r, (plan, want, no_value, _we, live_masks) in \
-            enumerate(req_windows):
+    for r, window in enumerate(req_windows):
+        plan, want, no_value, _we, live_masks = window[:5]
+        geom = window[5] if len(window) > 5 else None
         row_base[r] = rows_total + r  # +r: offsets windows are count+1
-        total_rows = 0
-        span = 0
-        max_w = 2
         for ckey, blk, lo, hi in plan:
             b = block_idx[ckey]
             entry_block[e] = b
@@ -119,20 +135,15 @@ def serve_batch(req_windows, unique, byte_cap: int, hdr: int):
             entry_lo[e] = lo
             entry_hi[e] = hi
             e += 1
-            total_rows += hi - lo
-            if not no_value:
-                vo = blk.value_offs
-                span += int(vo[hi]) - int(vo[lo])
-            w = blk.keys.shape[1]
-            if w > max_w:
-                max_w = w
+        total_rows, span, max_w = (geom if geom is not None
+                                   else plan_geometry(plan))
         entry_start[r + 1] = e
         cap_rows = min(want, total_rows)
         wants[r] = cap_rows
         no_values[r] = no_value
         rows_total += cap_rows
         key_cap += cap_rows * max_w
-        val_cap += min(byte_cap + (64 << 10), span)
+        val_cap += 0 if no_value else min(byte_cap + (64 << 10), span)
     if key_cap >= 1 << 32 or val_cap >= 1 << 32:
         # running arena offsets are uint32: a flush whose combined
         # spans pass 4 GiB must take the per-request Python path (which

@@ -1076,7 +1076,7 @@ class PartitionServer:
             pkey = (start_key, stop_key, wb)
             hit = cache.get(pkey)
             if hit is not None:
-                plan, uniq_entries = hit
+                plan, uniq_entries, geom = hit
             else:
                 plan = []
                 uniq_entries = []
@@ -1101,12 +1101,19 @@ class PartitionServer:
                             break
                     if budget <= 0:
                         break
+                # plan geometry, computed once per cached plan —
+                # the native assembly's arena sizing (page.serve_batch)
+                # reads it instead of per-entry numpy scalar reads
+                from pegasus_tpu.server.page import plan_geometry
+
+                geom = plan_geometry(plan)
                 if len(cache) >= 8192:
                     cache.pop(next(iter(cache)))
-                cache[pkey] = (plan, uniq_entries)
+                cache[pkey] = (plan, uniq_entries, geom)
             for ckey, run, bm, blk in uniq_entries:
                 unique.setdefault(ckey, (run, bm, blk))
-            req_plans.append((req, start_key, stop_key, want, plan))
+            req_plans.append((req, start_key, stop_key, want, plan,
+                              geom))
         return {"reqs": reqs, "req_plans": req_plans, "unique": unique,
                 "validate": validate, "now": now, "overlay": overlay,
                 "filter_key": filter_key, "t0": t0}
@@ -1264,7 +1271,8 @@ class PartitionServer:
         """Phase 2.5: combine static keep with host TTL per unique
         block, compute each request's overlay window + plan frontier,
         and return the batch's fast-path (overlay-free) request windows
-        `(plan, want, no_value, want_ets)` for native assembly. The
+        `(plan, want, no_value, want_ets, live_masks, geom)` for
+        native assembly (page.serve_batch's req_windows shape). The
         node-level coordinator concatenates these ACROSS partitions so
         one native call (page.serve_batch) packs every fast request of
         a whole flush. Everything is stashed in `state`; idempotent."""
@@ -1311,9 +1319,9 @@ class PartitionServer:
         overlay_keys, _overlay_map = state["overlay"]
         windows = []
         fast = []
-        for req, start_key, stop_key, want, plan in state["req_plans"]:
-            capped = (plan and sum(hi - lo for _c, _b, lo, hi in plan)
-                      >= want * 2 + 64)
+        for req, start_key, stop_key, want, plan, geom in \
+                state["req_plans"]:
+            capped = bool(plan) and geom[0] >= want * 2 + 64
             frontier = (_after(plan[-1][1].key_at(plan[-1][1].count - 1))
                         if capped else None)
             ov_lo = (_bisect.bisect_left(overlay_keys, start_key)
@@ -1328,7 +1336,7 @@ class PartitionServer:
             windows.append((capped, frontier, ov_lo, ov_hi))
             if ov_lo >= ov_hi:
                 fast.append((plan, want, req.no_value,
-                             req.return_expire_ts, live_masks))
+                             req.return_expire_ts, live_masks, geom))
         state["live_masks"] = live_masks
         state["alive_all"] = alive_all
         state["exp_full"] = exp_full
@@ -1371,7 +1379,7 @@ class PartitionServer:
         served_iter = iter(served) if served is not None else None
 
         out = []
-        for (req, start_key, stop_key, want, plan), \
+        for (req, start_key, stop_key, want, plan, _geom), \
                 (capped, frontier, ov_lo, ov_hi) in zip(req_plans,
                                                         windows):
             kvs: list = []
