@@ -62,6 +62,7 @@ class ActRunner:
         self.cluster = SimCluster(data_dir, n_nodes=n_nodes, seed=seed)
         self.dir = data_dir
         self.client = None
+        self._auth_clients: dict = {}
         self.app_id: Optional[int] = None
         self._follower_clients: dict = {}
         self._backup_id = None
@@ -200,7 +201,24 @@ class ActRunner:
             self.cluster = SimCluster(
                 self.dir, n_nodes=int(kw.get("nodes", 4)),
                 seed=int(kw.get("seed", 7)),
-                n_meta=int(kw.get("n_meta", 1)))
+                n_meta=int(kw.get("n_meta", 1)),
+                auth_secret=kw.get("auth_secret"))
+        elif verb == "app_env":
+            # app_env: <key> <value> — set a table env (ACLs, throttles)
+            # on the acting app; config-sync delivers it to replicas
+            app_name = c.meta.state.apps[self.app_id].app_name
+            c.meta.update_app_envs(app_name, {args[0]: args[1]})
+            c.step()
+        elif verb == "auth":
+            # auth: <user> — subsequent client ops run as this identity
+            app_name = c.meta.state.apps[self.app_id].app_name
+            key = args[0]
+            cl = self._auth_clients.get(key)
+            if cl is None:
+                cl = c.client(app_name, name=f"act-auth-{key}",
+                              user=key)
+                self._auth_clients[key] = cl
+            self.client = cl
         elif verb == "kill_primary":
             # kill partition <pidx>'s current primary; remembered for
             # expect_primary_unchanged / expect_primary_recovered

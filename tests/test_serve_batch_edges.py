@@ -1,0 +1,90 @@
+"""Edge paths of the native batch scan assembly (page.serve_batch):
+byte-budget truncation (state 2) and arena-capacity overflow (state 3
+-> per-request Python re-serve). These are the fallback seams the
+serving fast path relies on under pathological values."""
+
+import numpy as np
+import pytest
+
+from pegasus_tpu import native
+from pegasus_tpu.server.page import plan_geometry, serve_batch
+from pegasus_tpu.storage.sstable import SSTable, SSTableWriter
+
+
+@pytest.fixture
+def table(tmp_path):
+    w = SSTableWriter(str(tmp_path / "t.sst"))
+    for i in range(100):
+        # 2-byte length prefix + hashkey + sortkey, 50-byte values
+        key = b"\x00\x02hk" + b"s%03d" % i
+        w.add(key, b"v" * 50, 0)
+    w.finish()
+    t = SSTable(str(tmp_path / "t.sst"))
+    yield t
+    t.close()
+
+
+def _window(t):
+    blk = t.read_block(0)
+    ckey = (t.path, t.blocks[0].offset)
+    plan = [(ckey, blk, 0, blk.count)]
+    masks = {ckey: np.ones(blk.count, dtype=bool)}
+    return plan, masks, {ckey: (t, t.blocks[0], blk)}
+
+
+def test_serve_batch_byte_budget_truncates(table):
+    if native.scan_serve_fn() is None:
+        pytest.skip("no native toolchain")
+    plan, masks, unique = _window(table)
+    win = (plan, 100, False, False, masks, plan_geometry(plan))
+    # each row is ~9 key bytes + 50 value bytes; a 200-byte budget fits
+    # ~3 rows (the first row always lands: forward progress)
+    (res,) = serve_batch([win], unique, 200, 0)
+    page, size, last_key, truncated = res
+    assert truncated
+    assert 1 <= len(page) <= 4
+    assert size <= 200 + 59  # budget + at most one overshoot row
+    assert last_key == page.key_at(len(page) - 1)
+
+
+def test_serve_batch_row_count_and_exhaustion(table):
+    if native.scan_serve_fn() is None:
+        pytest.skip("no native toolchain")
+    plan, masks, unique = _window(table)
+    win = (plan, 7, False, False, masks, plan_geometry(plan))
+    (res,) = serve_batch([win], unique, 1 << 20, 0)
+    page, _size, last_key, truncated = res
+    assert len(page) == 7 and not truncated
+    assert page.key_at(0) == b"\x00\x02hks000"
+    # want beyond the table: exhausted, not truncated
+    win = (plan, 1000, False, False, masks, plan_geometry(plan))
+    (res,) = serve_batch([win], unique, 1 << 20, 0)
+    page, _s, _lk, truncated = res
+    assert len(page) == 100 and not truncated
+
+
+def test_serve_batch_arena_overflow_returns_none(table):
+    """A row that cannot fit the arena (forged tiny geometry) must
+    surface as None (state 3) so the caller re-serves in Python — not
+    as a silently truncated page."""
+    if native.scan_serve_fn() is None:
+        pytest.skip("no native toolchain")
+    plan, masks, unique = _window(table)
+    # lie about the span so the value arena is far too small for row 1
+    geom = (100, 10, 32)
+    win = (plan, 100, False, False, masks, geom)
+    (res,) = serve_batch([win], unique, 1 << 20, 0)
+    assert res is None
+
+
+def test_serve_batch_no_value_and_ets(table):
+    if native.scan_serve_fn() is None:
+        pytest.skip("no native toolchain")
+    plan, masks, unique = _window(table)
+    win = (plan, 5, True, True, masks, plan_geometry(plan))
+    (res,) = serve_batch([win], unique, 1 << 20, 0)
+    page, size, _lk, _tr = res
+    assert len(page) == 5
+    assert all(page.value_at(i) == b"" for i in range(5))
+    assert page.ets_at(0) == 0
+    assert size == sum(len(page.key_at(i)) for i in range(5))
