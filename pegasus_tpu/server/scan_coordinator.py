@@ -10,7 +10,7 @@ back. Per-flush device dispatches drop from
 O(partitions × blocks) to O(key-width buckets).
 
 Two further batch axes target the tunnel-accelerator cost model
-(~70 ms fixed per dispatched program, ~25 MB/s device→host, measured):
+(~70 ms fixed per dispatched program, ~25-37 MB/s device→host, measured):
 
 - FLAVOR axis: requests carrying DIFFERENT filter patterns of the same
   filter type are planned as separate per-flavor groups, but their
