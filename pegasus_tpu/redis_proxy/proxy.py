@@ -150,6 +150,20 @@ class RedisHandler:
             raise ValueError("GEO commands need a geo-enabled proxy")
         return self.geo
 
+    @staticmethod
+    def _geo_unit_scale(unit: bytes) -> float:
+        scale = {b"m": 1.0, b"km": 1000.0}.get(unit.lower())
+        if scale is None:
+            raise ValueError("unsupported unit")
+        return scale
+
+    @staticmethod
+    def _geo_count(args, start: int) -> int:
+        rest = [a.upper() for a in args[start:]]
+        if b"COUNT" in rest:
+            return int(args[start + rest.index(b"COUNT") + 1])
+        return -1
+
     def cmd_GEOADD(self, args):
         geo = self._need_geo()
         key = args[0]
@@ -168,10 +182,7 @@ class RedisHandler:
         d = geo.distance(key, m1, key, m2)
         if d is None:
             return resp.bulk(None)
-        unit = args[3].lower() if len(args) > 3 else b"m"
-        scale = {b"m": 1.0, b"km": 1000.0}.get(unit)
-        if scale is None:
-            raise ValueError("unsupported unit")
+        scale = self._geo_unit_scale(args[3] if len(args) > 3 else b"m")
         return resp.bulk(b"%.4f" % (d / scale))
 
     def cmd_GEORADIUS(self, args):
@@ -180,14 +191,52 @@ class RedisHandler:
         geo = self._need_geo()
         _key = args[0]
         lng, lat, radius = float(args[1]), float(args[2]), float(args[3])
-        unit = args[4].lower()
-        scale = {b"m": 1.0, b"km": 1000.0}.get(unit)
-        if scale is None:
-            raise ValueError("unsupported unit")
-        count = -1
-        rest = [a.upper() for a in args[5:]]
-        if b"COUNT" in rest:
-            count = int(args[5 + rest.index(b"COUNT") + 1])
+        scale = self._geo_unit_scale(args[4])
+        count = self._geo_count(args, 5)
+        hits = geo.search_radial(lat, lng, radius * scale, count=count)
+        return resp.array([h.sort_key for h in hits])
+
+    def cmd_GEOPOS(self, args):
+        """GEOPOS key member [member ...] — (lng, lat) per member, a
+        NIL ARRAY (*-1, the Redis wire shape) for absent ones
+        (redis_parser g_geo_pos parity). Storage faults other than
+        NOT_FOUND surface as -ERR, never as a silent nil."""
+        from pegasus_tpu.utils.errors import StorageStatus
+
+        geo = self._need_geo()
+        key = args[0]
+        parts = [b"*%d\r\n" % (len(args) - 1)]
+        for member in args[1:]:
+            err, value = geo.get(key, member)
+            if err == int(StorageStatus.NOT_FOUND):
+                parts.append(b"*-1\r\n")
+                continue
+            if err != OK:
+                raise ValueError(f"storage error {err}")
+            coords = geo.codec.decode(value)
+            if coords is None:
+                parts.append(b"*-1\r\n")
+                continue
+            lat, lng = coords
+            parts.append(resp.array([b"%.17g" % lng, b"%.17g" % lat]))
+        return b"".join(parts)
+
+    def cmd_GEORADIUSBYMEMBER(self, args):
+        """GEORADIUSBYMEMBER key member radius m|km [COUNT n] — like
+        GEORADIUS but centered on an EXISTING member
+        (g_geo_radius_by_member parity). A missing / undecodable center
+        is an ERROR, as in Redis ("could not decode requested zset
+        member") — an empty array must mean 'nobody in radius', never
+        'the center lookup failed'."""
+        geo = self._need_geo()
+        key, member = args[0], args[1]
+        radius = float(args[2])
+        scale = self._geo_unit_scale(args[3])
+        count = self._geo_count(args, 4)
+        err, value = geo.get(key, member)
+        if err != OK or geo.codec.decode(value) is None:
+            raise ValueError("could not decode requested member")
+        lat, lng = geo.codec.decode(value)
         hits = geo.search_radial(lat, lng, radius * scale, count=count)
         return resp.array([h.sort_key for h in hits])
 

@@ -82,6 +82,22 @@ def test_geo_commands(tmp_path):
     assert b"center" in out and b"north200m" not in out
     dist = h([b"GEODIST", b"places", b"center", b"north200m"])
     assert 150 < float(dist.split(b"\r\n")[1]) < 250
+    # GEOPOS: (lng, lat) per member, nil for absent (g_geo_pos parity)
+    pos = h([b"GEOPOS", b"places", b"center", b"missing"])
+    assert pos.startswith(b"*2\r\n*2\r\n")
+    lng = float(pos.split(b"\r\n")[3])
+    assert abs(lng - (-74.0)) < 1e-6
+    assert pos.endswith(b"*-1\r\n")  # absent member = NIL ARRAY
+    # GEORADIUSBYMEMBER: centered on an existing member
+    out = h([b"GEORADIUSBYMEMBER", b"places", b"north200m", b"300",
+             b"m"])
+    assert b"center" in out and b"north200m" in out
+    out = h([b"GEORADIUSBYMEMBER", b"places", b"north200m", b"50",
+             b"m"])
+    assert b"north200m" in out and b"center" not in out
+    # a missing CENTER is an error, never an empty result
+    assert h([b"GEORADIUSBYMEMBER", b"places", b"missing", b"300",
+              b"m"]).startswith(b"-ERR")
     raw.close()
     idx.close()
 
