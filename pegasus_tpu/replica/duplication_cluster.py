@@ -76,6 +76,7 @@ class ClusterDuplicator:
         self._fail_count = 0
         self._fconfig: Optional[dict] = None  # follower app config
         self._config_rid: Optional[int] = None
+        self._config_ticks = 0  # ticks since the in-flight config ask
         # in-flight mutation: decree + outstanding write rids. rid →
         # follower pidx, so a LATE ack from a superseded ship attempt of
         # the same decree still completes that pidx (acks slower than the
@@ -137,14 +138,29 @@ class ClusterDuplicator:
             self._inflight_ticks += 1
             if self._inflight_ticks < self._retry_limit:
                 return
-            self._retry_limit = min(self._retry_limit * 2, 64)
+            # modest backoff cap: retained rids (below) already let a
+            # slow follower converge via LATE acks, so the backoff only
+            # reduces re-ship traffic — a large cap would instead gut
+            # convergence under LINK LOSS, where re-drives are the only
+            # recovery (seed-sweep regression on case-608)
+            self._retry_limit = min(self._retry_limit * 2, 12)
             self._fconfig = None
             self._redrive_decree = self._inflight_decree
             self._inflight_decree = None
             self._inflight_ticks = 0
         if self._fconfig is None:
+            # the config ask (or its reply) can be LOST: re-issue with a
+            # fresh rid after a few ticks, or a single dropped message
+            # wedges the whole pipeline forever (seed-sweep finding —
+            # the canonical schedule never dropped this message)
             if self._config_rid is None:
                 self._request_follower_config()
+                self._config_ticks = 0
+            else:
+                self._config_ticks += 1
+                if self._config_ticks >= self.RETRY_TICKS:
+                    self._request_follower_config()
+                    self._config_ticks = 0
             return
         log = replica.log
         if log.generation != self._log_generation:
@@ -271,11 +287,15 @@ class ClusterDuplicator:
             return True
         pidx = self._outstanding.pop(rid)
         self._pending_pidx.discard(pidx)
+        # an ack is PROGRESS: the link works — stop backing off AND
+        # restart the re-drive clock (without resetting the tick count a
+        # shrunken limit would fire a spurious re-drive next tick)
+        self._retry_limit = self.RETRY_TICKS
+        self._inflight_ticks = 0
         if not self._pending_pidx and self._inflight_decree is not None:
             self._advance(self._inflight_decree, self._inflight_frame_end)
             self._inflight_decree = None
             self._outstanding = {}
-            self._retry_limit = self.RETRY_TICKS
         return True
 
     def _advance(self, decree: int, frame_end: int) -> None:

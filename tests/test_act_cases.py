@@ -49,3 +49,21 @@ def test_act_assertion_failures_surface(tmp_path):
                 "expect_read: hk sk nope\n", "inline")
     finally:
         runner.close()
+
+
+@pytest.mark.parametrize("seed", [1, 13, 42])
+def test_act_fault600_seed_diversity(seed, tmp_path):
+    """The duplication/backup/recovery cases must hold under DIFFERENT
+    simulator schedules, not just the canonical seed — a round-5 sweep
+    found a real livelock (a dropped follower-config ask wedging
+    duplication forever) that the canonical schedule never exercised."""
+    cases = [c for c in CASES
+             if os.path.basename(c).startswith("case-6")]
+    assert cases
+    for path in cases:
+        runner = ActRunner(str(tmp_path / f"s{seed}-{os.path.basename(path)}"),
+                           n_nodes=4, seed=seed)
+        try:
+            runner.run_file(path)
+        finally:
+            runner.close()
