@@ -1,0 +1,409 @@
+package PegasusTpu;
+
+# Pure-Perl wire client for pegasus_tpu — no FFI, no C library: the
+# PGT1 frame + tagged-value grammar (pegasus_tpu/rpc/message.py) and
+# crc64 partition routing implemented directly, proving the wire
+# format is speakable from any language with a socket library.
+#
+# Parity role: one of the reference's native client family
+# (go/java/python/nodejs/scala clients, src/include/pegasus/client.h);
+# surface: query_config routing + set / get / del / multi_get.
+#
+# CRC tables re-derive from the same polynomial bit-specs as the other
+# implementations (base/crc.py, native/packer.cpp); golden vectors in
+# tests/test_perl_client.py pin bit-identity.
+
+use strict;
+use warnings;
+use IO::Socket::INET;
+use Socket qw(IPPROTO_TCP TCP_NODELAY);
+
+# Mid-failover errors worth a config refresh + retry — mirrors
+# client/cluster_client.py _RETRYABLE (utils/errors.py values).
+my %RETRYABLE = map { $_ => 1 } (5, 6, 13, 14, 53, 56);
+
+# ---- crc64 (reflected; ~init/~final) --------------------------------
+
+my @CRC64;
+{
+    my @bits = (63,61,59,58,56,55,52,49,48,47,46,44,41,37,36,34,32,31,
+                28,26,23,22,19,16,13,12,10,9,6,4,3,0);
+    my $poly = 0;
+    $poly |= (1 << (63 - $_)) for @bits;
+    for my $i (0 .. 255) {
+        my $k = $i;
+        for (1 .. 8) {
+            $k = ($k & 1) ? (($k >> 1) ^ $poly) : ($k >> 1);
+        }
+        $CRC64[$i] = $k;
+    }
+}
+
+sub crc64 {
+    my ($data) = @_;
+    my $crc = ~0;
+    for my $b (unpack "C*", $data) {
+        $crc = $CRC64[($crc ^ $b) & 0xFF] ^ ($crc >> 8);
+    }
+    return ~$crc & ~0;
+}
+
+# ---- crc32c (Castagnoli, the PGT1 frame checksum) --------------------
+
+my @CRC32C;
+for my $i (0 .. 255) {
+    my $k = $i;
+    for (1 .. 8) {
+        $k = ($k & 1) ? (($k >> 1) ^ 0x82F63B78) : ($k >> 1);
+    }
+    $CRC32C[$i] = $k;
+}
+
+sub crc32c {
+    my ($data) = @_;
+    my $crc = 0xFFFFFFFF;
+    for my $b (unpack "C*", $data) {
+        $crc = $CRC32C[($crc ^ $b) & 0xFF] ^ ($crc >> 8);
+    }
+    return (~$crc) & 0xFFFFFFFF;
+}
+
+# ---- tagged value grammar (encode) -----------------------------------
+
+sub enc_str   { my ($s) = @_; return "s" . pack("V", length $s) . $s }
+sub enc_bytes { my ($s) = @_; return "b" . pack("V", length $s) . $s }
+sub enc_int   { my ($i) = @_; return "i" . pack("q<", $i) }
+sub enc_uint  { my ($u) = @_; return "u" . pack("Q<", $u) }
+sub enc_none  { return "N" }
+
+sub enc_list {
+    my ($tag, @items) = @_;
+    return $tag . pack("V", scalar @items) . join("", @items);
+}
+
+# dict from pre-encoded (key, value) pairs, in order
+sub enc_dict {
+    my (@kv) = @_;
+    die "odd kv" if @kv % 2;
+    my $out = "m" . pack("V", @kv / 2);
+    $out .= $_ for @kv;
+    return $out;
+}
+
+# ---- tagged value grammar (decode) -----------------------------------
+# returns (perl-value, next-pos); dataclasses decode to
+# {__dataclass__ => name, 0 => f0, 1 => f1, ...}
+
+sub dec_value {
+    my ($buf, $pos) = @_;
+    my $tag = substr($buf, $pos, 1);
+    $pos++;
+    if ($tag eq "N") { return (undef, $pos) }
+    if ($tag eq "T") { return (1, $pos) }
+    if ($tag eq "F") { return (0, $pos) }
+    if ($tag eq "i") { return (unpack("q<", substr($buf, $pos, 8)), $pos + 8) }
+    if ($tag eq "u") { return (unpack("Q<", substr($buf, $pos, 8)), $pos + 8) }
+    if ($tag eq "d") { return (unpack("d<", substr($buf, $pos, 8)), $pos + 8) }
+    if ($tag eq "b" or $tag eq "s") {
+        my $n = unpack("V", substr($buf, $pos, 4));
+        return (substr($buf, $pos + 4, $n), $pos + 4 + $n);
+    }
+    if ($tag eq "l" or $tag eq "t") {
+        my $n = unpack("V", substr($buf, $pos, 4));
+        $pos += 4;
+        my @items;
+        for (1 .. $n) {
+            (my $v, $pos) = dec_value($buf, $pos);
+            push @items, $v;
+        }
+        return (\@items, $pos);
+    }
+    if ($tag eq "m") {
+        my $n = unpack("V", substr($buf, $pos, 4));
+        $pos += 4;
+        my %h;
+        for (1 .. $n) {
+            (my $k, $pos) = dec_value($buf, $pos);
+            (my $v, $pos) = dec_value($buf, $pos);
+            $h{defined $k ? $k : ""} = $v;
+        }
+        return (\%h, $pos);
+    }
+    if ($tag eq "D") {
+        my $nn = unpack("V", substr($buf, $pos, 4));
+        $pos += 4;
+        my $name = substr($buf, $pos, $nn);
+        $pos += $nn;
+        my $nf = unpack("V", substr($buf, $pos, 4));
+        $pos += 4;
+        my %h = (__dataclass__ => $name);
+        for my $i (0 .. $nf - 1) {
+            (my $v, $pos) = dec_value($buf, $pos);
+            $h{$i} = $v;
+        }
+        return (\%h, $pos);
+    }
+    die "unknown value tag '$tag' at $pos";
+}
+
+# ---- frame ------------------------------------------------------------
+
+sub make_frame {
+    my ($src, $dst, $type, $payload) = @_;
+    my $body = enc_str($src) . enc_str($dst) . enc_str($type) . $payload;
+    return "PGT1" . pack("V V", length $body, crc32c($body)) . $body;
+}
+
+# ---- client -----------------------------------------------------------
+
+sub new {
+    my ($class, %args) = @_;
+    my $self = {
+        name  => $args{name} || "perl-client",
+        app   => $args{app},
+        book  => $args{book},    # { node => [host, port] }
+        metas => $args{metas},   # [node, ...]
+        socks => {},
+        rid   => 1000,
+        app_id => undef,
+        partition_count => 0,
+        primaries => [],
+    };
+    return bless $self, $class;
+}
+
+sub _sock {
+    my ($self, $node) = @_;
+    return $self->{socks}{$node} if $self->{socks}{$node};
+    my ($host, $port) = @{ $self->{book}{$node} or die "unknown node $node" };
+    my $s = IO::Socket::INET->new(
+        PeerAddr => $host, PeerPort => $port,
+        Proto => "tcp", Timeout => 10) or die "connect $node: $!";
+    $s->setsockopt(IPPROTO_TCP, TCP_NODELAY, 1);
+    $self->{socks}{$node} = $s;
+    return $s;
+}
+
+sub _call {
+    my ($self, $node, $type, $payload, $reply_type, $rid) = @_;
+    my $s = $self->_sock($node);
+    print $s make_frame($self->{name}, $node, $type, $payload);
+    for (1 .. 64) {   # tolerate unrelated frames
+        my $hdr = _read_exact($s, 12);
+        die "bad magic" unless substr($hdr, 0, 4) eq "PGT1";
+        my ($blen, $want) = unpack("V V", substr($hdr, 4));
+        my $body = _read_exact($s, $blen);
+        die "crc mismatch" unless crc32c($body) == $want;
+        my $pos = 0;
+        (my $fsrc, $pos) = dec_value($body, $pos);
+        (my $fdst, $pos) = dec_value($body, $pos);
+        (my $mt,   $pos) = dec_value($body, $pos);
+        (my $pl,   $pos) = dec_value($body, $pos);
+        next unless $mt eq $reply_type;
+        next unless ($pl->{rid} // -1) == $rid;
+        return $pl;
+    }
+    die "no matching reply for $type";
+}
+
+sub _read_exact {
+    my ($s, $n) = @_;
+    my $buf = "";
+    while (length($buf) < $n) {
+        my $got = "";
+        my $r = $s->sysread($got, $n - length($buf));
+        die "connection closed" unless $r;
+        $buf .= $got;
+    }
+    return $buf;
+}
+
+sub refresh_config {
+    my ($self) = @_;
+    for my $meta (@{ $self->{metas} }) {
+        my $rid = $self->{rid}++;
+        my $payload = enc_dict(
+            enc_str("app_name"), enc_str($self->{app}),
+            enc_str("rid"),      enc_int($rid));
+        my $pl = eval {
+            $self->_call($meta, "query_config", $payload,
+                         "query_config_reply", $rid);
+        };
+        next unless $pl && ($pl->{err} // -1) == 0;
+        $self->{app_id} = $pl->{app_id};
+        $self->{partition_count} = $pl->{partition_count};
+        $self->{primaries} =
+            [ map { $_->{primary} } @{ $pl->{configs} } ];
+        return 1;
+    }
+    return 0;
+}
+
+sub _full_key {
+    my ($hk, $sk) = @_;
+    return pack("n", length $hk) . $hk . $sk;
+}
+
+sub _route {
+    my ($self, $hk, $sk) = @_;
+    unless (defined $self->{app_id}) {
+        $self->refresh_config()
+            or die "cannot resolve config for app '$self->{app}' "
+                 . "(no meta reachable or app missing)";
+    }
+    # an empty hash key routes by the sort key — key_hash_parts
+    # (base/key_schema.py:73-78); multi-key ops pass sk="" like the
+    # other clients
+    my $h = crc64(length($hk) ? $hk : ($sk // ""));
+    my $pidx = $h % $self->{partition_count};
+    return ($pidx, $h, $self->{primaries}[$pidx]);
+}
+
+sub _gpid {
+    my ($self, $pidx) = @_;
+    return enc_list("t", enc_int($self->{app_id}), enc_int($pidx));
+}
+
+# Refresh-on-error retry around one routed request — the same
+# discipline as ClusterClient._read/_write (cluster_client.py:181-243)
+# and wire_client.cpp's 4-attempt loop. Every op this client exposes
+# (put/remove/get/multi_get) is retry-safe; the non-idempotent ops
+# (incr/cas/cam) are not in this surface. $op->($pidx,$h,$primary)
+# must return the reply payload (with an `err` field) or die on a
+# transport fault.
+sub _with_retry {
+    my ($self, $hk, $sk, $op) = @_;
+    my $last = "no attempt";
+    for my $attempt (1 .. 8) {
+        select(undef, undef, undef, 0.05 * $attempt) if $attempt > 1;
+        my ($pidx, $h, $primary) = eval { $self->_route($hk, $sk) };
+        if ($@ or !defined($primary) or $primary eq "") {
+            # mid-failover: partition momentarily unowned, or config
+            # unresolvable — force a re-resolve next attempt
+            $last = $@ || "partition momentarily unowned";
+            $self->{app_id} = undef;
+            next;
+        }
+        my $pl = eval { $op->($pidx, $h, $primary) };
+        if ($@) {
+            $last = $@;
+            my $s = delete $self->{socks}{$primary};
+            close $s if $s;
+            $self->{app_id} = undef;
+            next;
+        }
+        my $err = $pl->{err} // -1;
+        if ($err != 0 && $RETRYABLE{$err}) {
+            $last = "retryable err $err";
+            $self->{app_id} = undef;
+            next;
+        }
+        return $pl;
+    }
+    die "retries exhausted: $last";
+}
+
+# returns the per-op status (0 = OK)
+sub set {
+    my ($self, $hk, $sk, $value, $expire_ts) = @_;
+    $expire_ts ||= 0;
+    my $pl = $self->_with_retry($hk, $sk, sub {
+        my ($pidx, $h, $primary) = @_;
+        my $rid = $self->{rid}++;
+        my $wop = enc_list("t", enc_int(1),   # OP_PUT
+            enc_list("t", enc_bytes(_full_key($hk, $sk)),
+                     enc_bytes($value), enc_int($expire_ts)));
+        my $payload = enc_dict(
+            enc_str("gpid"), $self->_gpid($pidx),
+            enc_str("rid"),  enc_int($rid),
+            enc_str("ops"),  enc_list("l", $wop),
+            enc_str("auth"), enc_none(),
+            enc_str("partition_hash"), enc_uint($h));
+        return $self->_call($primary, "client_write", $payload,
+                            "client_write_reply", $rid);
+    });
+    return $pl->{err} if ($pl->{err} // -1) != 0;
+    return $pl->{results}[0];
+}
+
+sub del {
+    my ($self, $hk, $sk) = @_;
+    my $pl = $self->_with_retry($hk, $sk, sub {
+        my ($pidx, $h, $primary) = @_;
+        my $rid = $self->{rid}++;
+        my $wop = enc_list("t", enc_int(2),   # OP_REMOVE
+            enc_list("t", enc_bytes(_full_key($hk, $sk))));
+        my $payload = enc_dict(
+            enc_str("gpid"), $self->_gpid($pidx),
+            enc_str("rid"),  enc_int($rid),
+            enc_str("ops"),  enc_list("l", $wop),
+            enc_str("auth"), enc_none(),
+            enc_str("partition_hash"), enc_uint($h));
+        return $self->_call($primary, "client_write", $payload,
+                            "client_write_reply", $rid);
+    });
+    return $pl->{err} if ($pl->{err} // -1) != 0;
+    return $pl->{results}[0];
+}
+
+# returns (status, value); status 0 = OK, 1 = NOT_FOUND
+sub get {
+    my ($self, $hk, $sk) = @_;
+    my $pl = $self->_with_retry($hk, $sk, sub {
+        my ($pidx, $h, $primary) = @_;
+        my $rid = $self->{rid}++;
+        my $payload = enc_dict(
+            enc_str("gpid"), $self->_gpid($pidx),
+            enc_str("rid"),  enc_int($rid),
+            enc_str("op"),   enc_str("get"),
+            enc_str("args"), enc_bytes(_full_key($hk, $sk)),
+            enc_str("auth"), enc_none(),
+            enc_str("partition_hash"), enc_uint($h));
+        return $self->_call($primary, "client_read", $payload,
+                            "client_read_reply", $rid);
+    });
+    die "read err $pl->{err}" if ($pl->{err} // -1) != 0;
+    my ($status, $value) = @{ $pl->{result} };
+    return ($status, $value);
+}
+
+# returns (status, { sort_key => value }) for ALL sort keys of $hk
+sub multi_get {
+    my ($self, $hk) = @_;
+    my $pl = $self->_with_retry($hk, "", sub {
+        my ($pidx, $h, $primary) = @_;
+        my $rid = $self->{rid}++;
+        # MultiGetRequest in declaration order (server/types.py:160)
+        my $req = "D" . pack("V", length "MultiGetRequest")
+            . "MultiGetRequest" . pack("V", 12)
+            . enc_bytes($hk) . enc_list("l") . enc_int(-1) . enc_int(-1)
+            . "F" . enc_bytes("") . enc_bytes("") . "T" . "F"
+            . enc_int(0) . enc_bytes("") . "F";
+        my $payload = enc_dict(
+            enc_str("gpid"), $self->_gpid($pidx),
+            enc_str("rid"),  enc_int($rid),
+            enc_str("op"),   enc_str("multi_get"),
+            enc_str("args"), $req,
+            enc_str("auth"), enc_none(),
+            enc_str("partition_hash"), enc_uint($h));
+        return $self->_call($primary, "client_read", $payload,
+                            "client_read_reply", $rid);
+    });
+    die "read err $pl->{err}" if ($pl->{err} // -1) != 0;
+    my $resp = $pl->{result};
+    die "unexpected result" unless $resp->{__dataclass__} eq "MultiGetResponse";
+    my $status = $resp->{0};
+    my %kvs;
+    for my $kv (@{ $resp->{1} }) {
+        $kvs{ $kv->{0} } = $kv->{1};   # KeyValue: key (=sortkey), value
+    }
+    return ($status, \%kvs);
+}
+
+sub close_all {
+    my ($self) = @_;
+    close $_ for values %{ $self->{socks} };
+    $self->{socks} = {};
+}
+
+1;

@@ -1,0 +1,80 @@
+#!/usr/bin/perl
+# Demo / test driver for the pure-Perl wire client: reads the onebox
+# cluster config, performs set/get/del/multi_get against the live
+# cluster, and prints TAP-ish OK lines the test asserts on.
+#
+#   perl pegasus_demo.pl <cluster.json> <app_name>
+
+use strict;
+use warnings;
+use FindBin;
+use lib $FindBin::Bin;
+use PegasusTpu;
+
+my ($config_path, $app) = @ARGV;
+die "usage: $0 <cluster.json> <app>" unless $config_path && $app;
+
+# minimal JSON parse for the onebox config (flat, known shape; no
+# non-core JSON module needed)
+open my $fh, "<", $config_path or die "open $config_path: $!";
+my $json = do { local $/; <$fh> };
+close $fh;
+
+my (%book, @metas);
+while ($json =~ /"([a-z0-9]+)":\s*\{([^{}]*)\}/g) {
+    my ($name, $body) = ($1, $2);
+    next unless $body =~ /"host":\s*"([^"]+)"/;
+    my $host = $1;
+    next unless $body =~ /"port":\s*(\d+)/;
+    my $port = $1;
+    $book{$name} = [$host, $port];
+    push @metas, $name if $body =~ /"role":\s*"meta"/;
+}
+die "no meta in config" unless @metas;
+
+my $c = PegasusTpu->new(app => $app, book => \%book, metas => \@metas,
+                        name => "perl-demo");
+$c->refresh_config() or die "refresh_config failed";
+print "ok config partitions=$c->{partition_count}\n";
+
+for my $i (0 .. 19) {
+    my $st = $c->set("phk$i", "s", "perl-value-$i");
+    die "set $i: status $st" if $st != 0;
+}
+print "ok set 20\n";
+
+for my $i (0 .. 19) {
+    my ($st, $v) = $c->get("phk$i", "s");
+    die "get $i: status $st" if $st != 0;
+    die "get $i: got '$v'" if $v ne "perl-value-$i";
+}
+print "ok get 20\n";
+
+my ($st, $v) = $c->get("phk-missing", "s");
+die "missing: status $st" unless $st == 1;
+print "ok notfound\n";
+
+for my $i (0 .. 9) {
+    my $s = $c->set("pmulti", sprintf("s%02d", $i), "mv$i");
+    die "multi set $i: $s" if $s != 0;
+}
+my ($mst, $kvs) = $c->multi_get("pmulti");
+die "multi_get status $mst" if $mst != 0;
+my $n = scalar keys %$kvs;
+die "multi_get count $n" if $n != 10;
+die "multi_get s03" unless $kvs->{"s03"} eq "mv3";
+print "ok multi_get 10\n";
+
+$st = $c->del("phk0", "s");
+die "del: $st" if $st != 0;
+($st, $v) = $c->get("phk0", "s");
+die "del visible: $st" unless $st == 1;
+print "ok del\n";
+
+# leave one marker the python side reads back (cross-language interop)
+$st = $c->set("perl-wrote", "s", "hello-from-perl");
+die "marker: $st" if $st != 0;
+print "ok marker\n";
+
+$c->close_all();
+print "PERL CLIENT OK\n";

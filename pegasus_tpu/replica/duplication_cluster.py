@@ -18,6 +18,7 @@ timetag loses the `>` comparison the second time).
 from __future__ import annotations
 
 import itertools
+from collections import deque
 from typing import Callable, Dict, List, Optional, Tuple
 
 from pegasus_tpu.base.key_schema import generate_key, key_hash
@@ -75,8 +76,11 @@ class ClusterDuplicator:
         self._fail_decree: Optional[int] = None
         self._fail_count = 0
         self._fconfig: Optional[dict] = None  # follower app config
-        self._config_rid: Optional[int] = None
-        self._config_ticks = 0  # ticks since the in-flight config ask
+        # a FEW recent ask rids stay live: a re-ask must not discard a
+        # SLOW (not lost) reply to an earlier ask — the same
+        # retained-rid discipline the write path uses
+        self._config_rids: "deque[int]" = deque(maxlen=4)
+        self._config_ticks = 0  # ticks since the newest config ask
         # in-flight mutation: decree + outstanding write rids. rid →
         # follower pidx, so a LATE ack from a superseded ship attempt of
         # the same decree still completes that pidx (acks slower than the
@@ -98,21 +102,26 @@ class ClusterDuplicator:
 
     def _request_follower_config(self) -> None:
         rid = next(_RIDS)
-        self._config_rid = rid
+        self._config_rids.append(rid)
         self.stub.net.send(self.stub.name, self.follower_meta,
                            "query_config",
                            {"app_name": self.follower_app, "rid": rid})
 
     def on_follower_config(self, payload: dict) -> bool:
-        if payload.get("rid") != self._config_rid:
+        rid = payload.get("rid")
+        if rid not in self._config_rids:
             return False
-        self._config_rid = None
         if payload["err"] == 0:
+            self._config_rids.clear()
             self._fconfig = {
                 "app_id": payload["app_id"],
                 "partition_count": payload["partition_count"],
                 "configs": payload["configs"],
             }
+        else:
+            # an error reply settles only ITS ask: a newer in-flight
+            # ask's (possibly successful) reply must stay acceptable
+            self._config_rids.remove(rid)
         return True
 
     # ---- shipping ------------------------------------------------------
@@ -153,7 +162,7 @@ class ClusterDuplicator:
             # fresh rid after a few ticks, or a single dropped message
             # wedges the whole pipeline forever (seed-sweep finding —
             # the canonical schedule never dropped this message)
-            if self._config_rid is None:
+            if not self._config_rids:
                 self._request_follower_config()
                 self._config_ticks = 0
             else:

@@ -51,19 +51,22 @@ def test_act_assertion_failures_surface(tmp_path):
         runner.close()
 
 
+_FAULT600 = [c for c in CASES
+             if os.path.basename(c).startswith("case-6")]
+# an empty glob would silently skip the whole seed-diversity suite
+assert _FAULT600, "no case-6xx act files found"
+
+
 @pytest.mark.parametrize("seed", [1, 13, 42])
-def test_act_fault600_seed_diversity(seed, tmp_path):
+@pytest.mark.parametrize("case", _FAULT600,
+                         ids=[os.path.basename(c) for c in _FAULT600])
+def test_act_fault600_seed_diversity(seed, case, tmp_path):
     """The duplication/backup/recovery cases must hold under DIFFERENT
     simulator schedules, not just the canonical seed — a round-5 sweep
     found a real livelock (a dropped follower-config ask wedging
     duplication forever) that the canonical schedule never exercised."""
-    cases = [c for c in CASES
-             if os.path.basename(c).startswith("case-6")]
-    assert cases
-    for path in cases:
-        runner = ActRunner(str(tmp_path / f"s{seed}-{os.path.basename(path)}"),
-                           n_nodes=4, seed=seed)
-        try:
-            runner.run_file(path)
-        finally:
-            runner.close()
+    runner = ActRunner(str(tmp_path / "c"), n_nodes=4, seed=seed)
+    try:
+        runner.run_file(case)
+    finally:
+        runner.close()
