@@ -12,7 +12,9 @@ from pegasus_tpu.utils.metrics import MetricEntity
 CU_SIZE = 4096
 
 
-def _units(size: int) -> int:
+def units(size: int) -> int:
+    """CU for ONE request of `size` bytes (min 1 — the per-request
+    floor the reference bills, capacity_unit_calculator.h:50)."""
     return max(1, (size + CU_SIZE - 1) // CU_SIZE)
 
 
@@ -22,10 +24,16 @@ class CapacityUnitCalculator:
         self._write_cu = entity.counter("recent_write_cu")
 
     def add_read(self, size: int) -> None:
-        self._read_cu.increment(_units(size))
+        self._read_cu.increment(units(size))
+
+    def add_read_units(self, cu: int) -> None:
+        """Batch accounting: the caller pre-summed units(size) per
+        request (hot scan path — one counter touch per batch)."""
+        if cu:
+            self._read_cu.increment(cu)
 
     def add_write(self, size: int) -> None:
-        self._write_cu.increment(_units(size))
+        self._write_cu.increment(units(size))
 
     @property
     def read_cu(self) -> int:

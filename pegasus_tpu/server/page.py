@@ -18,12 +18,36 @@ unavailable (same output, slower).
 
 from __future__ import annotations
 
+import threading
 from typing import List, Optional, Tuple
 
 import numpy as np
 
 from pegasus_tpu import native
 from pegasus_tpu.server.types import ScanPage
+
+_scratch_tls = threading.local()
+
+
+def _scratch(name: str, size: int, dtype, alloc=np.empty):
+    """Grow-only per-thread scratch array + cached base pointer.
+
+    The assembly arenas are consumed within one serve_batch call (pages
+    cut out by copy), so reusing them across flushes avoids an
+    mmap/page-fault round per flush for the multi-MB value arena —
+    and caching `.ctypes.data` (a ~µs property that builds a fresh
+    ctypes view per access) with the buffer trims the per-call ctypes
+    overhead. Per-thread because onebox nodes serve from their own
+    dispatch threads. `alloc` fills the buffer at (re)allocation
+    (np.arange for the identity block table)."""
+    pool = getattr(_scratch_tls, "pool", None)
+    if pool is None:
+        pool = _scratch_tls.pool = {}
+    hit = pool.get(name)
+    if hit is None or hit[0].size < size:
+        arr = alloc(int(size * 3 // 2) + 64, dtype=dtype)
+        hit = pool[name] = (arr, arr.ctypes.data)
+    return hit
 
 
 def block_native_ptrs(blk):
@@ -61,17 +85,49 @@ def plan_geometry(plan):
     return total_rows, span, max_w
 
 
+def plan_nat(plan):
+    """Per-plan native entry table, cached WITH the plan
+    (partition_server.plan_scan_batch): the pointer rows (keys, width,
+    key_len, value_offs, heap, expire_ts) for every entry as one
+    uint64[6, n] plus int64 lo/hi bounds and the ckey tuple. Plans are
+    pure over the immutable run set, so these arrays are too —
+    serve_batch concatenates them instead of re-resolving per-entry
+    pointer rows through Python dicts on every flush."""
+    n = len(plan)
+    ptr6 = np.empty((6, n), dtype=np.uint64)
+    lo_arr = np.empty(n, dtype=np.int64)
+    hi_arr = np.empty(n, dtype=np.int64)
+    ckeys = []
+    for j, (ckey, blk, lo, hi) in enumerate(plan):
+        kp, lp, vp, hp, ep, w, _heap = block_native_ptrs(blk)
+        ptr6[0, j] = kp
+        ptr6[1, j] = w
+        ptr6[2, j] = lp
+        ptr6[3, j] = vp
+        ptr6[4, j] = hp
+        ptr6[5, j] = ep
+        lo_arr[j] = lo
+        hi_arr[j] = hi
+        ckeys.append(ckey)
+    return ptr6, lo_arr, hi_arr, tuple(ckeys), ptr6[1].astype(np.int64)
+
+
 def serve_batch(req_windows, unique, byte_cap: int, hdr: int):
     """Whole-BATCH base-path assembly in ONE native call.
 
     req_windows: per fast-path request (plan, want, no_value,
-    want_ets, live_masks, geom) where plan is [(ckey, Block, lo, hi)]
-    in key order, live_masks maps ckey -> bool[count] (that request's
-    static keep AND host TTL — PER WINDOW, because filter flavors
-    sharing a block carry different masks), and geom is
-    plan_geometry(plan) (may be omitted — recomputed then); unique:
-    OrderedDict ckey -> (run, bm, blk) covering every planned block
-    (may span partitions).
+    want_ets, live_masks, geom[, nat[, live_ptrs]]) where plan is
+    [(ckey, Block, lo, hi)] in key order, live_masks maps ckey ->
+    bool[count] (that request's static keep AND host TTL — PER WINDOW,
+    because filter flavors sharing a block carry different masks),
+    geom is plan_geometry(plan), nat is plan_nat(plan) and live_ptrs
+    maps ckey -> live-mask base pointer (resolved once per (block,
+    flavor, second) in prepare_serve). Trailing elements may be
+    omitted — recomputed then; the serving path passes 8-tuples, which
+    ride a fully vectorized bookkeeping path (no per-window numpy
+    scalar stores). `unique` is unused (kept for caller compatibility;
+    the entry table is per-entry now, so no flush-wide block dedup is
+    needed).
 
     Packs every request's surviving rows into shared arenas via
     packer.cpp pegasus_scan_serve_batch — the C++ twin of the
@@ -87,88 +143,113 @@ def serve_batch(req_windows, unique, byte_cap: int, hdr: int):
     if fn is None or not req_windows:
         return None
     want_ets = any(w[3] for w in req_windows)
-    n_blocks = len(unique)
-    ptrs = np.empty((6, n_blocks), dtype=np.uint64)
-    block_idx = {}
-    for b, (ckey, (_run, _bm, blk)) in enumerate(unique.items()):
-        kp, lp, vp, hp, ep, w, _heap = block_native_ptrs(blk)
-        ptrs[0, b] = kp
-        ptrs[1, b] = w
-        ptrs[2, b] = lp
-        ptrs[3, b] = vp
-        ptrs[4, b] = hp
-        ptrs[5, b] = ep
-        block_idx[ckey] = b
-    widths = ptrs[1].astype(np.int64)
-
     n_reqs = len(req_windows)
-    n_entries = sum(len(w[0]) for w in req_windows)
-    entry_start = np.zeros(n_reqs + 1, dtype=np.int64)
-    entry_block = np.empty(n_entries, dtype=np.int64)
-    entry_mask = np.empty(n_entries, dtype=np.uint64)
-    entry_lo = np.empty(n_entries, dtype=np.int64)
-    entry_hi = np.empty(n_entries, dtype=np.int64)
-    wants = np.empty(n_reqs, dtype=np.int64)
-    no_values = np.empty(n_reqs, dtype=np.uint8)
-    row_base = np.empty(n_reqs, dtype=np.int64)
-    mask_refs = []  # keep per-flavor mask arrays alive across the call
-    mask_ptr_cache = {}
-    e = 0
-    rows_total = 0
-    key_cap = 0
-    val_cap = 0
-    for r, window in enumerate(req_windows):
-        plan, want, no_value, _we, live_masks = window[:5]
-        geom = window[5] if len(window) > 5 else None
-        row_base[r] = rows_total + r  # +r: offsets windows are count+1
-        for ckey, blk, lo, hi in plan:
-            b = block_idx[ckey]
-            entry_block[e] = b
-            mkey = (id(live_masks), ckey)
-            mp = mask_ptr_cache.get(mkey)
-            if mp is None:
-                mask = live_masks[ckey]
-                mask_refs.append(mask)
-                mp = mask.ctypes.data
-                mask_ptr_cache[mkey] = mp
-            entry_mask[e] = mp
-            entry_lo[e] = lo
-            entry_hi[e] = hi
-            e += 1
-        total_rows, span, max_w = (geom if geom is not None
-                                   else plan_geometry(plan))
-        entry_start[r + 1] = e
-        cap_rows = min(want, total_rows)
-        wants[r] = cap_rows
-        no_values[r] = no_value
-        rows_total += cap_rows
-        key_cap += cap_rows * max_w
-        val_cap += 0 if no_value else min(byte_cap + (64 << 10), span)
+    mask_refs = []  # keep ad-hoc mask arrays alive across the call
+    if all(len(w) > 7 for w in req_windows):
+        # serving fast path: every per-window quantity comes cached
+        # (geom + nat with the plan, live_ptrs with the second's live
+        # masks), so the bookkeeping is pure array math over the flush
+        nats = [w[6] for w in req_windows]
+        geoms = np.array([w[5] for w in req_windows], dtype=np.int64)
+        wants_in = np.fromiter((w[1] for w in req_windows),
+                               dtype=np.int64, count=n_reqs)
+        no_vals = np.fromiter((bool(w[2]) for w in req_windows),
+                              dtype=np.bool_, count=n_reqs)
+        counts = np.fromiter((len(n[3]) for n in nats),
+                             dtype=np.int64, count=n_reqs)
+        entry_start = np.zeros(n_reqs + 1, dtype=np.int64)
+        np.cumsum(counts, out=entry_start[1:])
+        e = int(entry_start[-1])
+        entry_mask = np.fromiter(
+            (w[7][ck] for w in req_windows for ck in w[6][3]),
+            dtype=np.uint64, count=e)
+        wants = np.minimum(wants_in, geoms[:, 0])
+        rows_total = int(wants.sum())
+        row_base = np.zeros(n_reqs, dtype=np.int64)
+        np.cumsum(wants[:-1], out=row_base[1:])
+        row_base += np.arange(n_reqs)  # +r: offset windows are count+1
+        key_cap = int((wants * geoms[:, 2]).sum())
+        val_cap = int(np.where(
+            no_vals, 0,
+            np.minimum(byte_cap + (64 << 10), geoms[:, 1])).sum())
+        no_values = no_vals.astype(np.uint8)
+    else:
+        # ad-hoc callers (tests, fallbacks) may omit nat/live_ptrs
+        nats = []
+        mask_arrays = []
+        entry_start = np.zeros(n_reqs + 1, dtype=np.int64)
+        wants = np.empty(n_reqs, dtype=np.int64)
+        no_values = np.empty(n_reqs, dtype=np.uint8)
+        row_base = np.empty(n_reqs, dtype=np.int64)
+        e = 0
+        rows_total = 0
+        key_cap = 0
+        val_cap = 0
+        for r, window in enumerate(req_windows):
+            plan, want, no_value, _we, live_masks = window[:5]
+            geom = (window[5] if len(window) > 5
+                    and window[5] is not None else plan_geometry(plan))
+            nat = window[6] if len(window) > 6 else plan_nat(plan)
+            masks = [live_masks[ck] for ck in nat[3]]
+            mask_refs.extend(masks)
+            mask_arrays.append(np.fromiter(
+                (m.ctypes.data for m in masks),
+                dtype=np.uint64, count=len(masks)))
+            nats.append(nat)
+            e += len(nat[3])
+            entry_start[r + 1] = e
+            total_rows, span, max_w = geom
+            row_base[r] = rows_total + r
+            cap_rows = min(want, total_rows)
+            wants[r] = cap_rows
+            no_values[r] = no_value
+            rows_total += cap_rows
+            key_cap += cap_rows * max_w
+            val_cap += 0 if no_value else min(byte_cap + (64 << 10),
+                                              span)
+        entry_mask = (mask_arrays[0] if n_reqs == 1
+                      else np.concatenate(mask_arrays))
     if key_cap >= 1 << 32 or val_cap >= 1 << 32:
         # running arena offsets are uint32: a flush whose combined
         # spans pass 4 GiB must take the per-request Python path (which
         # enforces its own per-request caps) instead of wrapping
         return None
-    key_blob = np.empty(max(1, key_cap), dtype=np.uint8)
-    val_blob = np.empty(max(1, val_cap), dtype=np.uint8)
-    key_offs = np.zeros(rows_total + n_reqs + 1, dtype=np.uint32)
-    val_offs = np.zeros(rows_total + n_reqs + 1, dtype=np.uint32)
-    ets_arena = (np.empty(max(1, rows_total), dtype=np.uint32)
-                 if want_ets else None)
-    out_count = np.zeros(n_reqs, dtype=np.int64)
-    out_bytes = np.zeros(n_reqs, dtype=np.int64)
-    out_state = np.zeros(n_reqs, dtype=np.int32)
-    fn(ptrs[0].ctypes.data, widths.ctypes.data, ptrs[2].ctypes.data,
-       entry_mask.ctypes.data, ptrs[3].ctypes.data, ptrs[4].ctypes.data,
-       ptrs[5].ctypes.data, n_reqs, entry_start.ctypes.data,
-       entry_block.ctypes.data, entry_lo.ctypes.data,
+    if n_reqs == 1:
+        ptr6, entry_lo, entry_hi = nats[0][:3]
+        widths = nats[0][4]
+    else:
+        ptr6 = np.concatenate([n[0] for n in nats], axis=1)
+        entry_lo = np.concatenate([n[1] for n in nats])
+        entry_hi = np.concatenate([n[2] for n in nats])
+        widths = np.concatenate([n[4] for n in nats])
+    # grow-only arenas + outputs (the C call writes every cell the
+    # result loop reads — no zeroing needed); entry_block is a cached
+    # arange prefix (the per-entry block table is identity now)
+    _entry_block, eb_ptr = _scratch("entry_block", e, np.int64,
+                                    alloc=np.arange)
+    key_blob, kb_ptr = _scratch("key_blob", max(1, key_cap), np.uint8)
+    val_blob, vb_ptr = _scratch("val_blob", max(1, val_cap), np.uint8)
+    n_offs = rows_total + n_reqs + 1
+    key_offs, ko_ptr = _scratch("key_offs", n_offs, np.uint32)
+    val_offs, vo_ptr = _scratch("val_offs", n_offs, np.uint32)
+    if want_ets:
+        ets_arena, ets_ptr = _scratch("ets", max(1, rows_total),
+                                      np.uint32)
+    else:
+        ets_arena, ets_ptr = None, None
+    out_count, oc_ptr = _scratch("out_count", n_reqs, np.int64)
+    out_bytes, ob_ptr = _scratch("out_bytes", n_reqs, np.int64)
+    out_state, os_ptr = _scratch("out_state", n_reqs, np.int32)
+    fn(ptr6[0].ctypes.data, widths.ctypes.data, ptr6[2].ctypes.data,
+       entry_mask.ctypes.data, ptr6[3].ctypes.data, ptr6[4].ctypes.data,
+       ptr6[5].ctypes.data, n_reqs, entry_start.ctypes.data,
+       eb_ptr, entry_lo.ctypes.data,
        entry_hi.ctypes.data, wants.ctypes.data, no_values.ctypes.data,
-       byte_cap, hdr, key_blob.ctypes.data, key_cap,
-       val_blob.ctypes.data, val_cap, key_offs.ctypes.data,
-       val_offs.ctypes.data, row_base.ctypes.data,
-       ets_arena.ctypes.data if want_ets else None,
-       out_count.ctypes.data, out_bytes.ctypes.data,
-       out_state.ctypes.data)
+       byte_cap, hdr, kb_ptr, key_cap,
+       vb_ptr, val_cap, ko_ptr,
+       vo_ptr, row_base.ctypes.data,
+       ets_ptr,
+       oc_ptr, ob_ptr, os_ptr)
 
     results = []
     for r in range(n_reqs):

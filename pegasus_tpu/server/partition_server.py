@@ -31,6 +31,7 @@ from pegasus_tpu.base.key_schema import (
     restore_key,
 )
 from pegasus_tpu.base.value_schema import (
+    PEGASUS_EPOCH_BEGIN,
     check_if_ts_expired,
     epoch_now,
     extract_expire_ts,
@@ -48,7 +49,10 @@ from pegasus_tpu.ops.predicates import (
 )
 
 from pegasus_tpu.ops.record_block import build_record_block
-from pegasus_tpu.server.capacity_units import CapacityUnitCalculator
+from pegasus_tpu.server.capacity_units import (
+    CapacityUnitCalculator,
+    units as cu_units,
+)
 from pegasus_tpu.server.read_limiter import RangeReadLimiter
 from pegasus_tpu.server.scan_context import ScanContext, ScanContextCache
 from pegasus_tpu.server.types import (
@@ -174,6 +178,11 @@ class PartitionServer:
         # (ckey, static-mask-id) -> (second, alive, expired_count, live):
         # per-second TTL-applied serving masks (see prepare_serve)
         self._live_cache: dict = {}
+        # ((generation, second), {plan-id -> (plan, expired-count)}):
+        # flavor-independent per-request expired accounting, reset
+        # wholesale each second / store generation so it never pins
+        # compacted-away blocks (see finish_scan_batch)
+        self._plan_expired_cache: tuple = (None, {})
         self.metrics = METRICS.entity(
             "replica", f"{app_id}.{pidx}",
             {"table": str(app_id), "partition": str(pidx)})
@@ -185,6 +194,10 @@ class PartitionServer:
         from pegasus_tpu.utils.latency_tracer import SlowQueryLog
 
         self.slow_log = SlowQueryLog()
+        self._scan_log_key = f"scan_batch.{app_id}.{pidx}"
+        # env-driven remote manual compaction (one-shot trigger times)
+        self._mc_trigger_seen = 0
+        self._mc_running = False
         # on-demand hotkey detection (parity: hotkey_collector.h:93 —
         # started via on_detect_hotkey; the request stream feeds capture
         # while a detection runs, else a None-check costs nothing)
@@ -296,6 +309,15 @@ class PartitionServer:
                 elif key == "user_specified_compaction":
                     staged.append(("_compaction_rules",
                                    compile_rules(value) if value else None))
+                elif key == "manual_compact.once.trigger_time":
+                    # accepts unix seconds (the reference's `date +%s`
+                    # convention) or pegasus-epoch seconds; normalized
+                    # to pegasus epoch (unambiguous: pegasus-epoch
+                    # "now" stays far below PEGASUS_EPOCH_BEGIN)
+                    ts = int(value) if value else 0
+                    if ts > PEGASUS_EPOCH_BEGIN:
+                        ts -= PEGASUS_EPOCH_BEGIN
+                    staged.append(("_mc_once_trigger", ts))
             except Exception as exc:
                 raise ValueError(f"invalid app-env {key}={value!r}: {exc}") \
                     from exc
@@ -304,12 +326,49 @@ class PartitionServer:
                 self.slow_log.threshold_ms = parsed
             elif attr == "_usage_scenario":
                 self._apply_usage_scenario(parsed)
+            elif attr == "_mc_once_trigger":
+                self._maybe_start_manual_compact(parsed)
             else:
                 setattr(self, attr, parsed)
         if full_set:
             self.app_envs = dict(envs)
         else:
             self.app_envs.update(envs)
+
+    def _maybe_start_manual_compact(self, trigger_ts: int) -> None:
+        """Env-driven remote manual compaction (parity:
+        pegasus_manual_compact_service.cpp, the
+        `manual_compact.once.trigger_time` replica env): a trigger time
+        NEWER than the last one seen starts one asynchronous full
+        compaction; config-sync re-deliveries of the same env value are
+        idempotent, and a trigger arriving while one run is in flight
+        is absorbed (the running compaction already covers it — the
+        reference's queued/running distinction). A trigger older than
+        the store's recorded compaction finish time is already
+        satisfied — a restarted replica re-syncing a stale env must not
+        re-compact (check_once_compact's trigger-vs-finish compare)."""
+        if trigger_ts <= 0 or trigger_ts <= self._mc_trigger_seen:
+            return
+        if trigger_ts <= self.engine.lsm.compact_finish_time:
+            # persisted in the manifest independently of the run set, so
+            # an all-tombstone compaction still satisfies its trigger
+            # across restarts
+            self._mc_trigger_seen = trigger_ts
+            return
+        self._mc_trigger_seen = trigger_ts
+        if self._mc_running:
+            return
+        self._mc_running = True
+
+        def run() -> None:
+            try:
+                self.manual_compact()
+            finally:
+                self._mc_running = False
+
+        threading.Thread(
+            target=run, daemon=True,
+            name=f"manual-compact-{self.app_id}.{self.pidx}").start()
 
     def _apply_usage_scenario(self, scenario: str) -> None:
         """Parity: the usage-scenario dynamic tuning
@@ -1004,9 +1063,11 @@ class PartitionServer:
         return self.finish_scan_batch(state, keep_masks)
 
     def plan_scan_batch(self, reqs: List[GetScannerRequest],
-                        now: Optional[int] = None):
+                        now: Optional[int] = None, flavor=None):
         """Phase 1: qualify + block planning. None = caller must serve
-        per-request."""
+        per-request. `flavor` = the (validate, filter_key) the caller
+        already grouped by (scan_coordinator) — passing it skips the
+        per-request re-derivation."""
         t0 = time.perf_counter()
         gate = self._read_gate()
         if gate:
@@ -1030,10 +1091,14 @@ class PartitionServer:
         # the geo covering-cell / prefix-scan shape — rides the same
         # cached-mask machinery: the filter is simply part of the mask
         # key, so repeated popular filters hit like unfiltered scans.
-        validates = {bool(r.validate_partition_hash
-                          and self.validate_partition_hash)
-                     for r in reqs}
-        filters = {_normalize_filter_key(r) for r in reqs}
+        if flavor is not None:
+            validates = {flavor[0]}
+            filters = {flavor[1]}
+        else:
+            validates = {bool(r.validate_partition_hash
+                              and self.validate_partition_hash)
+                         for r in reqs}
+            filters = {_normalize_filter_key(r) for r in reqs}
         known = (FT_NO_FILTER, FT_MATCH_ANYWHERE, FT_MATCH_PREFIX,
                  FT_MATCH_POSTFIX)
         simple = (runs and overlay_count <= self.OVERLAY_MERGE_LIMIT
@@ -1076,7 +1141,7 @@ class PartitionServer:
             pkey = (start_key, stop_key, wb)
             hit = cache.get(pkey)
             if hit is not None:
-                plan, uniq_entries, geom = hit
+                plan, uniq_entries, geom, nat = hit
             else:
                 plan = []
                 uniq_entries = []
@@ -1101,19 +1166,21 @@ class PartitionServer:
                             break
                     if budget <= 0:
                         break
-                # plan geometry, computed once per cached plan —
-                # the native assembly's arena sizing (page.serve_batch)
-                # reads it instead of per-entry numpy scalar reads
-                from pegasus_tpu.server.page import plan_geometry
+                # plan geometry + native entry table, computed once per
+                # cached plan — the native assembly (page.serve_batch)
+                # concatenates these instead of re-resolving per-entry
+                # pointer rows and numpy scalar reads every flush
+                from pegasus_tpu.server.page import plan_geometry, plan_nat
 
                 geom = plan_geometry(plan)
+                nat = plan_nat(plan)
                 if len(cache) >= 8192:
                     cache.pop(next(iter(cache)))
-                cache[pkey] = (plan, uniq_entries, geom)
+                cache[pkey] = (plan, uniq_entries, geom, nat)
             for ckey, run, bm, blk in uniq_entries:
                 unique.setdefault(ckey, (run, bm, blk))
             req_plans.append((req, start_key, stop_key, want, plan,
-                              geom))
+                              geom, nat))
         return {"reqs": reqs, "req_plans": req_plans, "unique": unique,
                 "validate": validate, "now": now, "overlay": overlay,
                 "filter_key": filter_key, "t0": t0}
@@ -1283,6 +1350,7 @@ class PartitionServer:
         unique = state["unique"]
         now = state["now"]
         live_masks = {}
+        live_ptrs = {}
         alive_all = {}
         exp_full = {}
         cache = self._live_cache
@@ -1299,10 +1367,11 @@ class PartitionServer:
             # the entry pins the static array it was built from (id()
             # alone could be a recycled address after a mask evict)
             if hit is not None and hit[0] == now and hit[1] is static:
-                _now, _st, alive, exp, live = hit
+                _now, _st, alive, exp, live, lptr = hit
                 alive_all[ckey] = alive
                 exp_full[ckey] = exp
                 live_masks[ckey] = live
+                live_ptrs[ckey] = lptr
                 continue
             alive = blk.alive_mask(now)
             alive_all[ckey] = alive
@@ -1313,13 +1382,18 @@ class PartitionServer:
             exp_full[ckey] = exp
             live = static[:len(ets)] & alive
             live_masks[ckey] = live
+            # .ctypes.data costs ~a µs: resolve once per (block, flavor,
+            # second), not once per request window (page.serve_batch
+            # consumes these as the per-entry mask pointers)
+            lptr = live.ctypes.data
+            live_ptrs[ckey] = lptr
             if len(cache) >= 4096:
                 cache.pop(next(iter(cache)))
-            cache[lkey] = (now, static, alive, exp, live)
+            cache[lkey] = (now, static, alive, exp, live, lptr)
         overlay_keys, _overlay_map = state["overlay"]
         windows = []
         fast = []
-        for req, start_key, stop_key, want, plan, geom in \
+        for req, start_key, stop_key, want, plan, geom, nat in \
                 state["req_plans"]:
             capped = bool(plan) and geom[0] >= want * 2 + 64
             frontier = (_after(plan[-1][1].key_at(plan[-1][1].count - 1))
@@ -1336,7 +1410,8 @@ class PartitionServer:
             windows.append((capped, frontier, ov_lo, ov_hi))
             if ov_lo >= ov_hi:
                 fast.append((plan, want, req.no_value,
-                             req.return_expire_ts, live_masks, geom))
+                             req.return_expire_ts, live_masks, geom,
+                             nat, live_ptrs))
         state["live_masks"] = live_masks
         state["alive_all"] = alive_all
         state["exp_full"] = exp_full
@@ -1375,11 +1450,25 @@ class PartitionServer:
         overlay_keys, overlay_map = state["overlay"]
         hdr = header_length(self.data_version)
         if served is None and fast:
-            served = serve_batch(fast, unique, SCAN_BYTES_CAP, hdr)
+            served = serve_batch(fast, None, SCAN_BYTES_CAP, hdr)
         served_iter = iter(served) if served is not None else None
 
+        # per-(plan, second) expired-count cache: the count is flavor-
+        # independent (alive depends only on block + now) and plans are
+        # cached objects, so zipfian repeats of a popular scan within
+        # one second skip the per-entry accounting loop entirely. The
+        # plan object is pinned in the value so its id() cannot be
+        # recycled while the entry lives; the whole dict resets each
+        # second / generation, so nothing outlives the blocks it counts.
+        ptag = (self.engine.lsm.generation, now)
+        if self._plan_expired_cache[0] != ptag:
+            self._plan_expired_cache = (ptag, {})
+        pec = self._plan_expired_cache[1]
+        total_expired = 0
+        total_read_cu = 0
+
         out = []
-        for (req, start_key, stop_key, want, plan, _geom), \
+        for (req, start_key, stop_key, want, plan, _geom, _nat), \
                 (capped, frontier, ov_lo, ov_hi) in zip(req_plans,
                                                         windows):
             kvs: list = []
@@ -1387,7 +1476,6 @@ class PartitionServer:
             exhausted = True
             resume_key = None
             stop_early = False
-            req_expired = 0
             want_ets = req.return_expire_ts
             no_value = req.no_value
 
@@ -1398,14 +1486,20 @@ class PartitionServer:
                         idx = lo + int(i)
                         yield blk.key_at(idx), blk, idx
 
-            for ckey, blk_, lo, hi in plan:
-                # per-REQUEST expired accounting (the solo path counts
-                # per request served, not per block evaluated)
-                if lo == 0 and hi == blk_.count:
-                    req_expired += exp_full[ckey]
-                else:
-                    req_expired += int(np.count_nonzero(
-                        ~alive_all[ckey][lo:hi]))
+            hit = pec.get(id(plan))
+            if hit is not None:
+                req_expired = hit[1]
+            else:
+                req_expired = 0
+                for ckey, blk_, lo, hi in plan:
+                    # per-REQUEST expired accounting (the solo path
+                    # counts per request served, not per block evaluated)
+                    if lo == 0 and hi == blk_.count:
+                        req_expired += exp_full[ckey]
+                    else:
+                        req_expired += int(np.count_nonzero(
+                            ~alive_all[ckey][lo:hi]))
+                pec[id(plan)] = (plan, req_expired)
             ov_i = ov_lo
             if ov_lo >= ov_hi:
                 # fast path: no overlay rows shadow this window, so the
@@ -1523,11 +1617,12 @@ class PartitionServer:
             elif capped:
                 resume_key = frontier
                 exhausted = False
-            if req_expired:
-                self._abnormal_reads.increment(req_expired)
+            total_expired += req_expired
+            # per-request CU floor preserved: units() per request,
+            # summed, one counter touch per batch
+            total_read_cu += cu_units(size)
             resp = ScanResponse()
             resp.kvs = kvs
-            self.cu.add_read(size)
             resp.error = int(StorageStatus.OK)
             if exhausted or req.one_page:
                 resp.context_id = SCAN_CONTEXT_ID_COMPLETED
@@ -1536,8 +1631,13 @@ class PartitionServer:
                     request=req, resume_key=resume_key or start_key,
                     stop_key=stop_key))
             out.append(resp)
+        # batch-accumulated accounting: one metrics/capacity call per
+        # state, not per request (identical totals)
+        if total_expired:
+            self._abnormal_reads.increment(total_expired)
+        self.cu.add_read_units(total_read_cu)
         self.slow_log.observe_simple(
-            f"scan_batch.{self.app_id}.{self.pidx}",
+            self._scan_log_key,
             (time.perf_counter() - t0) * 1000.0,
             {"scans": len(reqs), "unique_blocks": len(unique)})
         return out

@@ -68,9 +68,9 @@ def scan_multi(servers_and_reqs: List[Tuple[object, list]],
                   _normalize_filter_key(r))
             groups.setdefault(fl, []).append(i)
         sub = []
-        for _fl, idxs in groups.items():
+        for fl, idxs in groups.items():
             state = server.plan_scan_batch([reqs[i] for i in idxs],
-                                           now=now)
+                                           now=now, flavor=fl)
             sub.append((idxs, state))
         states.append((server, reqs, sub))
 
@@ -116,7 +116,6 @@ def scan_multi(servers_and_reqs: List[Tuple[object, list]],
 
     fast_all: list = []
     fast_refs: list = []
-    uniq_all: "OrderedDict[tuple, tuple]" = OrderedDict()
     hdr_set = set()
     for server, reqs, sub in states:
         for _idxs, state in sub:
@@ -128,9 +127,8 @@ def scan_multi(servers_and_reqs: List[Tuple[object, list]],
             hdr_set.add(header_length(server.data_version))
             fast_refs.append((state, len(fast)))
             fast_all.extend(fast)
-            uniq_all.update(state["unique"])
     if fast_all and len(hdr_set) == 1:
-        served_all = serve_batch(fast_all, uniq_all,
+        served_all = serve_batch(fast_all, None,
                                  SCAN_BYTES_CAP, hdr_set.pop())
         if served_all is not None:
             off = 0

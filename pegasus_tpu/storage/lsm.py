@@ -66,6 +66,11 @@ class LSMStore:
         # compaction publish): callers key derived caches (scan plans)
         # on it so they invalidate exactly when the block set does
         self.generation = 0
+        # last manual-compaction finish time (pegasus-epoch seconds),
+        # persisted in the manifest INDEPENDENTLY of the run set so an
+        # all-tombstone compaction (zero surviving runs) still records
+        # completion — env-trigger staleness checks depend on it
+        self.compact_finish_time = 0
         self._load_existing()
 
     # ---- files --------------------------------------------------------
@@ -82,7 +87,8 @@ class LSMStore:
 
         fd, tmp = _tempfile.mkstemp(dir=self.data_dir)
         with os.fdopen(fd, "w") as f:
-            _json.dump({"seq": self._file_seq, "l1": l1_names}, f)
+            _json.dump({"seq": self._file_seq, "l1": l1_names,
+                        "mcft": self.compact_finish_time}, f)
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, self._manifest_path())
@@ -98,6 +104,7 @@ class LSMStore:
             # (an all-tombstone compaction): fresh flushes below the
             # horizon would be deleted as consumed inputs at next boot
             self._file_seq = max(self._file_seq, manifest["seq"])
+            self.compact_finish_time = manifest.get("mcft", 0)
         l0_files = []
         l1_files = []
         for name in os.listdir(self.data_dir):
@@ -275,6 +282,10 @@ class LSMStore:
         (jax dispatch is asynchronous — only materialization blocks).
         Tombstones always drop (bottommost).
         """
+        if meta and "manual_compact_finish_time" in meta:
+            # recorded before the manifest publish so it persists even
+            # when zero runs survive
+            self.compact_finish_time = meta["manual_compact_finish_time"]
         merged = self.iterate()
         new_runs: List[SSTable] = []
         writer: Optional[SSTableWriter] = None
@@ -420,6 +431,8 @@ class LSMStore:
 
         from pegasus_tpu.storage.sstable import SSTable, SSTableWriter
 
+        if meta and "manual_compact_finish_time" in meta:
+            self.compact_finish_time = meta["manual_compact_finish_time"]
         # finish() = flush + fsync + rename + dir-fsync — ~half the
         # wall time of a disk-bound compaction. Filled runs finish on a
         # helper thread (fsync releases the GIL) while the main thread

@@ -723,6 +723,16 @@ class _ClusterBox:
     def update_app_envs(self, name: str, envs) -> None:
         self.admin.call("update_app_envs", app_name=name, envs=envs)
 
+    def manual_compact_table(self, name: str) -> None:
+        """Remote manual compaction: set the one-shot trigger env; every
+        replica compacts when config-sync delivers it (parity: the shell
+        writing MANUAL_COMPACT_ONCE_TRIGGER_TIME_KEY,
+        pegasus_manual_compact_service.cpp)."""
+        import time as _time
+
+        self.update_app_envs(name, {
+            "manual_compact.once.trigger_time": str(int(_time.time()))})
+
     def remote_command(self, node: str, verb: str, cmd_args):
         """Invoke a registered control verb on one node (parity: shell
         remote_command over RPC_CLI_CLI_CALL)."""
@@ -1135,7 +1145,11 @@ def _dispatch(args, box, out) -> int:
         t = box.open_table(args.table)
         print(json.dumps(t.partitions[0].app_envs, indent=1), file=out)
     elif args.cmd == "manual_compact":
-        box.open_table(args.table).manual_compact_all()
+        mc = getattr(box, "manual_compact_table", None)
+        if mc is not None:  # wire mode: env-triggered remote compaction
+            mc(args.table)
+        else:
+            box.open_table(args.table).manual_compact_all()
         print("OK", file=out)
     elif args.cmd == "partition_split":
         new_count = box.split_table(args.table)

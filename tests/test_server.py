@@ -445,3 +445,49 @@ def test_batched_scan_overlay_merge_matches_individual(tmp_path):
     assert all_rows[generate_key(b"h02", b"s0002")] == b"NEWEST"
     assert generate_key(b"h01", b"s0011") not in all_rows
     srv.close()
+
+
+def test_env_triggered_manual_compact(server):
+    """Remote manual compaction rides the `manual_compact.once.
+    trigger_time` app env (parity: pegasus_manual_compact_service.cpp
+    MANUAL_COMPACT_ONCE_TRIGGER_TIME_KEY, written by the shell and
+    delivered to replicas via config-sync): a fresh trigger compacts
+    once (asynchronously), re-deliveries are idempotent, and a stale
+    trigger older than the store's recorded finish time never
+    re-compacts."""
+    import time
+
+    for i in range(50):
+        put(server, b"mc%02d" % i, b"s", b"v%d" % i)
+    lsm = server.engine.lsm
+    assert len(lsm.memtable) == 50 and not lsm.l1_runs
+
+    # unix-seconds trigger (the reference's `date +%s` convention)
+    server.update_app_envs(
+        {"manual_compact.once.trigger_time": str(int(time.time()))})
+    deadline = time.monotonic() + 30
+    while server._mc_running and time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert not server._mc_running
+    assert lsm.l1_runs and not len(lsm.memtable)
+    gen = lsm.generation
+    # the data survived, TTL semantics intact
+    assert server.on_get(generate_key(b"mc07", b"s")) == (OK, b"v7")
+
+    # config-sync re-delivery of the SAME env value: no second run
+    server.update_app_envs(
+        {"manual_compact.once.trigger_time":
+         str(server._mc_trigger_seen)})
+    time.sleep(0.1)
+    assert lsm.generation == gen
+
+    # restart-shaped staleness: a brand-new server over the same store
+    # re-syncing the old trigger must see it already satisfied (the
+    # finish time persists in the manifest, independent of the run set)
+    assert lsm.compact_finish_time > 0
+    server._mc_trigger_seen = 0
+    server.update_app_envs(
+        {"manual_compact.once.trigger_time":
+         str(lsm.compact_finish_time)})
+    time.sleep(0.1)
+    assert lsm.generation == gen
