@@ -88,3 +88,44 @@ def test_serve_batch_no_value_and_ets(table):
     assert all(page.value_at(i) == b"" for i in range(5))
     assert page.ets_at(0) == 0
     assert size == sum(len(page.key_at(i)) for i in range(5))
+
+
+def test_serve_batch_cached_path_matches_legacy(table):
+    """The serving path passes 8-tuple windows (cached plan_nat +
+    live_ptrs) through the fully vectorized bookkeeping; ad-hoc callers
+    pass 6-tuples through the per-window loop. Both must produce
+    byte-identical pages under mixed wants / no_value / ets flavors."""
+    if native.scan_serve_fn() is None:
+        pytest.skip("no native toolchain")
+    from pegasus_tpu.server.page import plan_nat
+
+    plan, masks, unique = _window(table)
+    # a second window with a PARTIAL mask and different want
+    masks2 = {k: v.copy() for k, v in masks.items()}
+    next(iter(masks2.values()))[::3] = False
+    nat = plan_nat(plan)
+    live_ptrs = {k: v.ctypes.data for k, v in masks.items()}
+    live_ptrs2 = {k: v.ctypes.data for k, v in masks2.items()}
+    geom = plan_geometry(plan)
+
+    legacy = serve_batch([
+        (plan, 7, False, False, masks, geom),
+        (plan, 50, False, True, masks2, geom),
+        (plan, 100, True, False, masks, geom),
+    ], unique, 1 << 20, 0)
+    cached = serve_batch([
+        (plan, 7, False, False, masks, geom, nat, live_ptrs),
+        (plan, 50, False, True, masks2, geom, nat, live_ptrs2),
+        (plan, 100, True, False, masks, geom, nat, live_ptrs),
+    ], unique, 1 << 20, 0)
+    assert legacy is not None and cached is not None
+    for lg, ca in zip(legacy, cached):
+        pl, sl, kl, tl = lg
+        pc, sc, kc, tc = ca
+        assert (sl, kl, tl) == (sc, kc, tc)
+        assert (pl.key_offs, pl.key_blob, pl.val_offs, pl.val_blob,
+                pl.ets) == (pc.key_offs, pc.key_blob, pc.val_offs,
+                            pc.val_blob, pc.ets)
+    assert len(legacy[0][0]) == 7
+    assert all(legacy[2][0].value_at(i) == b""
+               for i in range(len(legacy[2][0])))
