@@ -53,7 +53,9 @@ import os
 if os.environ.get("PEGBENCH_FORCE_CPU") == "1":
     # CPU-only run (CI / wedged-tunnel dry runs): never dial the axon
     # TPU tunnel — its plugin dials the pool even under
-    # JAX_PLATFORMS=cpu (see tests/conftest.py)
+    # JAX_PLATFORMS=cpu. Self-contained copy of
+    # pegasus_tpu/utils/cpu_isolation.force_cpu (this source string is
+    # exec'd in subprocess probes before the package is importable)
     os.environ["JAX_PLATFORMS"] = "cpu"
     import jax
     import jax._src.xla_bridge as _xb

@@ -74,13 +74,10 @@ def _isolate_cpu() -> None:
 
     if os.environ.get("PEGASUS_SHELL_DEVICE") == "accel":
         return
-    os.environ["JAX_PLATFORMS"] = "cpu"
     try:
-        import jax
-        import jax._src.xla_bridge as _xb
+        from pegasus_tpu.utils.cpu_isolation import force_cpu
 
-        jax.config.update("jax_platforms", "cpu")
-        _xb._backend_factories.pop("axon", None)
+        force_cpu()
     except Exception:  # noqa: BLE001 - jax-free verbs still work
         pass
 

@@ -1,0 +1,47 @@
+"""CPU isolation from the TPU-tunnel PJRT plugin.
+
+This image's sitecustomize installs the `axon` tunnel plugin, whose
+backend factory dials the SINGLE-TENANT TPU pool even when
+JAX_PLATFORMS=cpu — a wedged tunnel then hangs the dialing process for
+minutes (observed >600 s). Every code path that must stay CPU-only
+(tests, the driver's virtual multichip dryrun, bench's CPU fallback,
+server processes) needs the same three steps BEFORE first backend
+init: force the env/config to cpu, pop the axon backend factory (and
+ONLY axon — popping "tpu" would break importing pallas' TPU
+lowerings), and optionally prove the isolation held.
+
+Shared here so a jax private-API move breaks ONE site loudly instead
+of leaving a forgotten copy silently re-dialing the tunnel. Callers
+that must run before this package can import (tests/conftest.py, the
+exec'd prologue in bench.py) keep self-contained copies by necessity —
+they cite this module.
+"""
+
+from __future__ import annotations
+
+
+def force_cpu(verify: bool = False) -> None:
+    """Pin jax to the cpu backend and de-register the axon tunnel
+    plugin. Call before the first jax backend initialization.
+
+    verify=True proves the isolation actually held by initializing the
+    backend and checking every visible device is cpu — this FAILS
+    LOUDLY if the private factory registry moved, instead of silently
+    dialing the tunnel on first dispatch. (It also freezes the backend
+    config, so set XLA_FLAGS device-count overrides first.)
+    """
+    import os
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+    import jax
+    import jax._src.xla_bridge as _xb
+
+    jax.config.update("jax_platforms", "cpu")
+    _xb._backend_factories.pop("axon", None)
+    if verify:
+        devs = {d.platform for d in jax.devices()}
+        if devs != {"cpu"}:
+            raise RuntimeError(
+                f"CPU isolation failed — visible platforms {devs}; "
+                "the axon plugin registry has likely moved")

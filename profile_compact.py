@@ -8,14 +8,11 @@ import os
 import sys
 import time
 
-if os.environ.get("PEGPROF_DEVICE", "cpu") == "cpu":
-    os.environ["JAX_PLATFORMS"] = "cpu"
-    import jax
-    import jax._src.xla_bridge as _xb
-    jax.config.update("jax_platforms", "cpu")
-    _xb._backend_factories.pop("axon", None)
-
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+if os.environ.get("PEGPROF_DEVICE", "cpu") == "cpu":
+    from pegasus_tpu.utils.cpu_isolation import force_cpu
+    force_cpu()
 
 import bench as B  # noqa: E402
 

@@ -29,6 +29,8 @@ import jax._src.xla_bridge as _xb
 # config value and the plugin factory must go. This must FAIL LOUDLY if the
 # private API moves — silently keeping the axon factory would make the whole
 # test session dial the single-tenant TPU pool (observed: >120s hangs).
+# (Self-contained copy of pegasus_tpu/utils/cpu_isolation.force_cpu:
+# conftest must run before anything imports the package.)
 jax.config.update("jax_platforms", "cpu")
 # pop ONLY the axon tunnel plugin: popping "tpu" as well would remove it
 # from xb.known_platforms() and break importing pallas' TPU lowerings
