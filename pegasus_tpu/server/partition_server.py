@@ -346,7 +346,22 @@ class PartitionServer:
         reference's queued/running distinction). A trigger older than
         the store's recorded compaction finish time is already
         satisfied — a restarted replica re-syncing a stale env must not
-        re-compact (check_once_compact's trigger-vs-finish compare)."""
+        re-compact (check_once_compact's trigger-vs-finish compare).
+
+        Why a thread is safe against concurrent serving: writes are
+        excluded by manual_compact's _write_lock; point reads and
+        per-request scans snapshot the run list once and read
+        memtable-before-runs (the safe order against the publish
+        sequence); the batch planner brackets its reads with the store
+        generation and falls back to per-request serving on a torn
+        read (plan_scan_batch); superseded runs are unlinked but their
+        handles are released by GC so in-flight readers — including
+        encrypted CipherFile stores — finish on the files they hold
+        (lsm._publish_l1); mask/device caches clear under _mask_lock.
+        Running it synchronously instead would hold the node lock
+        (timers + dispatch share it) for the whole compaction —
+        stalling FD beacons long enough to get the node declared
+        dead."""
         if trigger_ts <= 0 or trigger_ts <= self._mc_trigger_seen:
             return
         if trigger_ts <= self.engine.lsm.compact_finish_time:
@@ -1078,6 +1093,17 @@ class PartitionServer:
                 out.append(resp)
             return {"precomputed": out, "t0": t0}
         lsm = self.engine.lsm
+        # generation is read BEFORE the run set and re-checked after the
+        # plans are built: an env-triggered compaction publishes off the
+        # node lock (l1_runs swap -> generation bump -> overlay clear),
+        # and a batch planned across that publish could pair the OLD
+        # runs with the NEW (empty) overlay — silently dropping the
+        # consumed overlay rows — or cache old-run plans under the new
+        # generation. Reading gen first puts any such plans under the
+        # OLD generation (correctly invalidated), and the final check
+        # sends a torn batch to the per-request path, which reads
+        # memtable-before-runs (the safe order against this publish).
+        gen = lsm.generation
         runs = lsm.l1_runs
         # a light write overlay (memtable + small L0s) must NOT evict the
         # whole partition from the device path: its rows merge host-side
@@ -1123,7 +1149,6 @@ class PartitionServer:
         # only means a further frontier, never a wrong page.
         req_plans = []
         unique: "OrderedDict[tuple, tuple]" = OrderedDict()
-        gen = lsm.generation
         pc = self._plan_cache
         if pc is None or pc[0] is not lsm or pc[1] != gen:
             pc = self._plan_cache = (lsm, gen, {})
@@ -1181,6 +1206,11 @@ class PartitionServer:
                 unique.setdefault(ckey, (run, bm, blk))
             req_plans.append((req, start_key, stop_key, want, plan,
                               geom, nat))
+        if lsm.generation != gen:
+            # a compaction published while this batch planned: the runs
+            # and overlay above may be from different sides of the swap
+            # — serve per-request instead (safe read order)
+            return None
         return {"reqs": reqs, "req_plans": req_plans, "unique": unique,
                 "validate": validate, "now": now, "overlay": overlay,
                 "filter_key": filter_key, "t0": t0}

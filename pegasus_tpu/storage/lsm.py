@@ -225,17 +225,21 @@ class LSMStore:
 
     def _run_for(self, key: bytes) -> Optional[SSTable]:
         """The (single) L1 run whose range may hold `key` — runs are
-        non-overlapping and key-ordered."""
-        lo, hi = 0, len(self.l1_runs)
+        non-overlapping and key-ordered. Operates on ONE snapshot of
+        the run list: a concurrent compaction publish swaps
+        `self.l1_runs` wholesale (env-triggered manual compaction runs
+        off the node lock), and re-reading the attribute mid-search
+        could index a shorter list."""
+        runs = self.l1_runs
+        lo, hi = 0, len(runs)
         while lo < hi:
             mid = (lo + hi) // 2
-            if (self.l1_runs[mid].last_key or b"") < key:
+            if (runs[mid].last_key or b"") < key:
                 lo = mid + 1
             else:
                 hi = mid
-        if lo < len(self.l1_runs) and (
-                (self.l1_runs[lo].first_key or b"") <= key):
-            return self.l1_runs[lo]
+        if lo < len(runs) and ((runs[lo].first_key or b"") <= key):
+            return runs[lo]
         return None
 
     def iterate(self, start: bytes = b"", stop: Optional[bytes] = None,
@@ -387,11 +391,16 @@ class LSMStore:
         if reset_overlay:
             old_l0, self.l0 = self.l0, []
             self.memtable = Memtable()
-        for t in old_l0:
-            t.close()
-            os.remove(t.path)
-        for t in old_runs:
-            t.close()
+        # Input files are unlinked now (crash-safe: the manifest no
+        # longer names them) but their HANDLES are released by GC, not
+        # closed here: a reader admitted before the swap may still be
+        # serving from these runs (the env-triggered compaction thread
+        # publishes concurrently with serving), and on encrypted stores
+        # a hard close() would yank the CipherFile out from under its
+        # next read_block. POSIX keeps unlinked-but-open files readable;
+        # the refcount drops to zero as soon as the last in-flight scan
+        # state / superseded plan cache lets go.
+        for t in old_l0 + old_runs:
             os.remove(t.path)
 
     # ---- bulk block-level compaction (the GB/s path) -------------------
