@@ -1166,7 +1166,7 @@ class PartitionServer:
             pkey = (start_key, stop_key, wb)
             hit = cache.get(pkey)
             if hit is not None:
-                plan, uniq_entries, geom, nat = hit
+                plan, uniq_entries, geom, nat, frontier = hit
             else:
                 plan = []
                 uniq_entries = []
@@ -1199,13 +1199,18 @@ class PartitionServer:
 
                 geom = plan_geometry(plan)
                 nat = plan_nat(plan)
+                # the resume frontier past a capped plan's last planned
+                # row — plan-pure, so computed once here instead of a
+                # per-request key_at on the serving path
+                frontier = (_after(plan[-1][1].key_at(
+                    plan[-1][1].count - 1)) if plan else None)
                 if len(cache) >= 8192:
                     cache.pop(next(iter(cache)))
-                cache[pkey] = (plan, uniq_entries, geom, nat)
+                cache[pkey] = (plan, uniq_entries, geom, nat, frontier)
             for ckey, run, bm, blk in uniq_entries:
                 unique.setdefault(ckey, (run, bm, blk))
             req_plans.append((req, start_key, stop_key, want, plan,
-                              geom, nat))
+                              geom, nat, frontier))
         if lsm.generation != gen:
             # a compaction published while this batch planned: the runs
             # and overlay above may be from different sides of the swap
@@ -1423,11 +1428,10 @@ class PartitionServer:
         overlay_keys, _overlay_map = state["overlay"]
         windows = []
         fast = []
-        for req, start_key, stop_key, want, plan, geom, nat in \
-                state["req_plans"]:
+        for req, start_key, stop_key, want, plan, geom, nat, pfrontier \
+                in state["req_plans"]:
             capped = bool(plan) and geom[0] >= want * 2 + 64
-            frontier = (_after(plan[-1][1].key_at(plan[-1][1].count - 1))
-                        if capped else None)
+            frontier = pfrontier if capped else None
             ov_lo = (_bisect.bisect_left(overlay_keys, start_key)
                      if start_key else 0)
             ov_hi = len(overlay_keys)
@@ -1498,7 +1502,7 @@ class PartitionServer:
         total_read_cu = 0
 
         out = []
-        for (req, start_key, stop_key, want, plan, _geom, _nat), \
+        for (req, start_key, stop_key, want, plan, _geom, _nat, _pf), \
                 (capped, frontier, ov_lo, ov_hi) in zip(req_plans,
                                                         windows):
             kvs: list = []
