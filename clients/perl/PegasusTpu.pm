@@ -90,6 +90,13 @@ sub enc_dict {
     return $out;
 }
 
+# registered dataclass from pre-encoded fields IN DECLARATION ORDER
+sub enc_dataclass {
+    my ($name, @fields) = @_;
+    return "D" . pack("V", length $name) . $name
+        . pack("V", scalar @fields) . join("", @fields);
+}
+
 # ---- tagged value grammar (decode) -----------------------------------
 # returns (perl-value, next-pos); dataclasses decode to
 # {__dataclass__ => name, 0 => f0, 1 => f1, ...}
@@ -244,6 +251,23 @@ sub _full_key {
     return pack("n", length $hk) . $hk . $sk;
 }
 
+sub _restore_key {
+    my ($full) = @_;
+    my $hl = unpack("n", $full);
+    return (substr($full, 2, $hl), substr($full, 2 + $hl));
+}
+
+# adjacent next key after every key with this prefix — drop trailing
+# 0xFF, increment the last byte (base/key_schema.py generate_next_bytes)
+sub _next_bytes {
+    my ($b) = @_;
+    my @c = unpack("C*", $b);
+    pop @c while @c && $c[-1] == 0xFF;
+    return "" unless @c;
+    $c[-1]++;
+    return pack("C*", @c);
+}
+
 sub _route {
     my ($self, $hk, $sk) = @_;
     unless (defined $self->{app_id}) {
@@ -374,11 +398,10 @@ sub multi_get {
         my ($pidx, $h, $primary) = @_;
         my $rid = $self->{rid}++;
         # MultiGetRequest in declaration order (server/types.py:160)
-        my $req = "D" . pack("V", length "MultiGetRequest")
-            . "MultiGetRequest" . pack("V", 12)
-            . enc_bytes($hk) . enc_list("l") . enc_int(-1) . enc_int(-1)
-            . "F" . enc_bytes("") . enc_bytes("") . "T" . "F"
-            . enc_int(0) . enc_bytes("") . "F";
+        my $req = enc_dataclass("MultiGetRequest",
+            enc_bytes($hk), enc_list("l"), enc_int(-1), enc_int(-1),
+            "F", enc_bytes(""), enc_bytes(""), "T", "F",
+            enc_int(0), enc_bytes(""), "F");
         my $payload = enc_dict(
             enc_str("gpid"), $self->_gpid($pidx),
             enc_str("rid"),  enc_int($rid),
@@ -398,6 +421,113 @@ sub multi_get {
         $kvs{ $kv->{0} } = $kv->{1};   # KeyValue: key (=sortkey), value
     }
     return ($status, \%kvs);
+}
+
+# Paged hash-key scanner (parity: client.h get_scanner/scan —
+# pegasus_scanner paging over RPC_RRDB_RRDB_SCAN; the sibling
+# implementations are cluster_client.ClusterScanner and
+# wire_client.cpp's scanner). Returns [[sort_key, value], ...] in key
+# order across however many server pages the range needs; the scan
+# context pages against the SAME primary (contexts are per-server).
+# opts: start/stop sort keys (stop exclusive), batch_size.
+sub scan_hashkey {
+    my ($self, $hk, %opt) = @_;
+    my $stop = (defined $opt{stop} && length $opt{stop})
+        ? _full_key($hk, $opt{stop})
+        : _next_bytes(_full_key($hk, ""));
+    my $start = _full_key($hk, $opt{start} // "");
+    my @rows;
+    # Restart discipline mirrors cluster_client.ClusterScanner._fetch:
+    # the first page goes through the refresh-on-error retry; any
+    # paging fault afterwards (failover, server scan-context eviction,
+    # transport error) reissues get_scanner from just past the last
+    # served key instead of dying — server contexts are per-primary
+    # and evictable, never a correctness anchor.
+    my $restarts = 0;
+    RESTART: while (1) {
+        die "scan: too many restarts" if $restarts++ > 32;
+        # GetScannerRequest in declaration order (server/types.py:273)
+        my $req = enc_dataclass("GetScannerRequest",
+            enc_bytes($start), enc_bytes($stop), "T", "F",
+            enc_int($opt{batch_size} // 1000), "F",
+            enc_int(0), enc_bytes(""), enc_int(0), enc_bytes(""),
+            "T", "F", "F", "F", "F");
+        my $primary_used;
+        my $pl = $self->_with_retry($hk, "", sub {
+            my ($pidx, $h, $primary) = @_;
+            $primary_used = $primary;
+            my $rid = $self->{rid}++;
+            my $payload = enc_dict(
+                enc_str("gpid"), $self->_gpid($pidx),
+                enc_str("rid"),  enc_int($rid),
+                enc_str("op"),   enc_str("get_scanner"),
+                enc_str("args"), $req,
+                enc_str("auth"), enc_none(),
+                enc_str("partition_hash"), enc_uint($h));
+            return $self->_call($primary, "client_read", $payload,
+                                "client_read_reply", $rid);
+        });
+        my $pidx = ($self->_route($hk, ""))[0];
+        while (1) {
+            die "scan err $pl->{err}" if ($pl->{err} // -1) != 0;
+            my $resp = $pl->{result};
+            if ($resp->{0} != 0) {
+                # 1 = NOT_FOUND: the server evicted this scan context
+                # (partition_server on_scan) — restart past the last
+                # key this scan already served; other errors are real
+                die "scan resp err $resp->{0}" if $resp->{0} != 1;
+                $start = @rows ? $rows[-1][0] . "\x00" : $start;
+                next RESTART;
+            }
+            push @rows, @{ _page_rows($resp->{1}) };
+            my $ctx = $resp->{2};
+            last RESTART if $ctx < 0;   # COMPLETED
+            my $rid = $self->{rid}++;
+            my $payload = enc_dict(
+                enc_str("gpid"), $self->_gpid($pidx),
+                enc_str("rid"),  enc_int($rid),
+                enc_str("op"),   enc_str("scan"),
+                enc_str("args"), enc_int($ctx),
+                enc_str("auth"), enc_none(),
+                enc_str("partition_hash"), enc_none());
+            $pl = eval {
+                $self->_call($primary_used, "client_read", $payload,
+                             "client_read_reply", $rid);
+            };
+            if ($@ or ($pl->{err} // -1) != 0) {
+                die "scan err $pl->{err}"
+                    if !$@ and !$RETRYABLE{$pl->{err} // -1};
+                # transport fault or retryable error mid-page: drop the
+                # (possibly desynced) socket and restart the range
+                my $s = delete $self->{socks}{$primary_used};
+                close $s if $s;
+                $self->{app_id} = undef;
+                $start = @rows ? $rows[-1][0] . "\x00" : $start;
+                next RESTART;
+            }
+        }
+    }
+    return [ map { my ($fhk, $sk) = _restore_key($_->[0]);
+                   [$sk, $_->[1]] } @rows ];
+}
+
+# a response page's [full_key, value] pairs: either a KeyValue list or
+# ONE columnar ScanPage (offset-sliced blobs — server/types.py:64)
+sub _page_rows {
+    my ($kvs) = @_;
+    my @out;
+    if (ref $kvs eq "ARRAY") {
+        push @out, [$_->{0}, $_->{1} // ""] for @$kvs;
+    } elsif (ref $kvs eq "HASH") {
+        my @ko = unpack("V*", $kvs->{0});
+        my @vo = unpack("V*", $kvs->{2});
+        for my $i (0 .. $#ko - 1) {
+            push @out, [
+                substr($kvs->{1}, $ko[$i], $ko[$i + 1] - $ko[$i]),
+                substr($kvs->{3}, $vo[$i], $vo[$i + 1] - $vo[$i])];
+        }
+    }
+    return \@out;
 }
 
 sub close_all {

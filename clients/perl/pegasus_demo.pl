@@ -71,6 +71,25 @@ die "del: $st" if $st != 0;
 die "del visible: $st" unless $st == 1;
 print "ok del\n";
 
+# paged scanner: 30 rows under one hash key, tiny pages force real
+# server-side context paging; a ranged scan narrows by sort key
+for my $i (0 .. 29) {
+    my $s = $c->set("pscan", sprintf("k%03d", $i), "sv$i");
+    die "scan set $i: $s" if $s != 0;
+}
+my $rows = $c->scan_hashkey("pscan", batch_size => 7);
+die "scan count " . scalar(@$rows) unless @$rows == 30;
+for my $i (0 .. 29) {
+    my ($sk, $v) = @{ $rows->[$i] };
+    die "scan row $i: $sk=$v"
+        unless $sk eq sprintf("k%03d", $i) && $v eq "sv$i";
+}
+print "ok scan 30 paged\n";
+$rows = $c->scan_hashkey("pscan", start => "k010", stop => "k020");
+die "ranged scan count " . scalar(@$rows) unless @$rows == 10;
+die "ranged first " . $rows->[0][0] unless $rows->[0][0] eq "k010";
+print "ok scan ranged 10\n";
+
 # leave one marker the python side reads back (cross-language interop)
 $st = $c->set("perl-wrote", "s", "hello-from-perl");
 die "marker: $st" if $st != 0;

@@ -70,7 +70,8 @@ def test_perl_client_against_onebox(tmp_path):
         assert out.returncode == 0, out.stderr + out.stdout
         assert "PERL CLIENT OK" in out.stdout, out.stdout
         for line in ("ok set 20", "ok get 20", "ok notfound",
-                     "ok multi_get 10", "ok del", "ok marker"):
+                     "ok multi_get 10", "ok scan 30 paged",
+                     "ok scan ranged 10", "ok del", "ok marker"):
             assert line in out.stdout, out.stdout
 
         # both-ways interop: python reads what perl wrote
