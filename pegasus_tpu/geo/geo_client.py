@@ -11,11 +11,16 @@ Parity: src/geo/lib/geo_client.h:96 — two tables:
 
 Values carry their coordinates; the codec extracts (lat, lng) from a
 '|'-separated value by field index (parity: latlng_codec with
-configurable latitude_index/longitude_index).
+configurable latitude_index/longitude_index). The RAW table stores the
+user's value untouched; INDEX rows prefix it with a versioned packed
+coordinate header (see _MAGIC/_COORD) so radius searches lift
+candidate coordinates vectorized; headerless index rows written by
+older builds still decode through the text codec.
 """
 
 from __future__ import annotations
 
+import struct
 from dataclasses import dataclass
 from typing import List, Optional, Tuple
 
@@ -24,6 +29,19 @@ from pegasus_tpu.ops.geo import radius_filter
 from pegasus_tpu.utils.errors import StorageStatus
 
 SORT_SEP = b"|"
+
+# Index-table value layout: 2-byte version magic, 16-byte packed
+# (lat, lng) doubles, then the raw value verbatim. The RAW table keeps
+# the user's value untouched (text codec, latlng_codec parity); the
+# INDEX table is internal to GeoClient, and the fixed binary header is
+# what lets a radius search lift every candidate's coordinates out of
+# a columnar scan page with ONE vectorized gather instead of a
+# per-record text parse. The magic distinguishes headered rows from
+# index rows written by builds that stored the raw value directly —
+# those fall back to the per-record text codec.
+_MAGIC = b"G\x01"
+_COORD = struct.Struct("<dd")
+_HDR = len(_MAGIC) + _COORD.size
 
 
 @dataclass
@@ -52,6 +70,74 @@ class GeoSearchResult:
     sort_key: bytes
     value: bytes
     distance_m: float
+
+
+def _page_coords(kvs, codec, value_of, n_rows):
+    """(coords float64[n, 2], row indices int64[n], packed bool[n]) of
+    the decodable rows of one response page.
+
+    Rows carrying the versioned packed header decode VECTORIZED on the
+    columnar ScanPage shape (one gather over the value blob); rows
+    without it — index entries written by a build predating the header
+    — fall back to the per-record text codec (`packed`=False marks
+    them so the caller keeps their value unstripped)."""
+    import numpy as np
+
+    m0, m1 = _MAGIC
+    if not hasattr(kvs, "val_offs"):  # KeyValue list / raw rows
+        rows, coords, packed = [], [], []
+        for i in range(n_rows):
+            v = value_of(i)
+            if len(v) >= _HDR and v[0] == m0 and v[1] == m1:
+                rows.append(i)
+                coords.append(_COORD.unpack_from(v, len(_MAGIC)))
+                packed.append(True)
+            else:
+                c = codec.decode(v)
+                if c is not None:
+                    rows.append(i)
+                    coords.append(c)
+                    packed.append(False)
+        if not rows:
+            return None, (), ()
+        return (np.asarray(coords, dtype=np.float64),
+                np.asarray(rows, dtype=np.int64),
+                np.asarray(packed, dtype=bool))
+    vo = np.frombuffer(kvs.val_offs, dtype="<u4").astype(np.int64)
+    if len(vo) <= 1:
+        return None, (), ()
+    starts = vo[:-1]
+    blob = np.frombuffer(kvs.val_blob, dtype=np.uint8)
+    fits = (vo[1:] - starts) >= _HDR
+    has_magic = fits.copy()
+    idx = np.flatnonzero(fits)
+    if len(idx):
+        has_magic[idx] &= (blob[starts[idx]] == m0) \
+            & (blob[starts[idx] + 1] == m1)
+    prows = np.flatnonzero(has_magic)
+    pcoords = np.zeros((0, 2))
+    if len(prows):
+        win = (starts[prows][:, None] + len(_MAGIC)
+               + np.arange(_COORD.size))
+        pcoords = blob[win].reshape(-1).view("<f8").reshape(-1, 2)
+    # legacy headerless rows: per-record text decode
+    lrows, lcoords = [], []
+    for i in np.flatnonzero(~has_magic):
+        c = codec.decode(value_of(int(i)))
+        if c is not None:
+            lrows.append(int(i))
+            lcoords.append(c)
+    if not len(prows) and not lrows:
+        return None, (), ()
+    coords = np.concatenate(
+        [pcoords, np.asarray(lcoords, dtype=np.float64).reshape(-1, 2)])
+    rows = np.concatenate(
+        [prows.astype(np.int64),
+         np.asarray(lrows, dtype=np.int64)])
+    packed = np.concatenate(
+        [np.ones(len(prows), dtype=bool),
+         np.zeros(len(lrows), dtype=bool)])
+    return coords, rows, packed
 
 
 class GeoClient:
@@ -101,7 +187,8 @@ class GeoClient:
         if err != int(StorageStatus.OK):
             return err
         ih, isk = self._index_keys(hash_key, sort_key, *coord)
-        return self.index.set(ih, isk, value, ttl_seconds)
+        return self.index.set(
+            ih, isk, _MAGIC + _COORD.pack(*coord) + value, ttl_seconds)
 
     def get(self, hash_key: bytes, sort_key: bytes) -> Tuple[int, bytes]:
         return self.raw.get(hash_key, sort_key)
@@ -148,24 +235,66 @@ class GeoClient:
                 if level <= self.index_level:
                     raise
                 level -= 1
-        cand_keys: List[Tuple[bytes, bytes, bytes]] = []
-        cand_lat: List[float] = []
-        cand_lng: List[float] = []
-        for _ih, isk, value in self._scan_cells(cells):
-            coord = self.codec.decode(value)
-            if coord is None:
+        import numpy as np
+
+        from pegasus_tpu.base.key_schema import restore_key
+
+        # Candidate coordinates are lifted PAGE-at-a-time: columnar
+        # scan pages give every packed (lat, lng) header in one numpy
+        # gather; keys/values materialize per record only for the
+        # SURVIVORS of the distance filter (typically a small fraction
+        # of the candidate set). A page is a columnar ScanPage, a
+        # KeyValue list, or the fallback scanner's raw
+        # ("raw", [(index_sortkey, value), ...]) batch.
+        pages: list = []  # (page, row_indices, packed_flags)
+        lat_parts: list = []
+        lng_parts: list = []
+        for page in self._scan_cell_pages(cells):
+            if isinstance(page, tuple):  # raw fallback batch
+                kvs = page[1]
+                value_of = lambda i, kvs=kvs: kvs[i][1]  # noqa: E731
+                n = len(kvs)
+            elif isinstance(page, list):
+                kvs = page
+                value_of = lambda i, kvs=kvs: kvs[i].value  # noqa: E731
+                n = len(kvs)
+            else:
+                kvs = page
+                value_of = kvs.value_at
+                n = len(kvs)
+            coords, rows, packed = _page_coords(kvs, self.codec,
+                                                value_of, n)
+            if coords is None or not len(rows):
                 continue
-            hk, sk = self._restore_raw_keys(isk)
-            cand_keys.append((hk, sk, value))
-            cand_lat.append(coord[0])
-            cand_lng.append(coord[1])
-        if not cand_keys:
+            pages.append((page, rows, packed))
+            lat_parts.append(coords[:, 0])
+            lng_parts.append(coords[:, 1])
+        if not pages:
             return []
+        cand_lat = np.concatenate(lat_parts)
+        cand_lng = np.concatenate(lng_parts)
         # exact-distance filtering: ONE device dispatch for the batch
         keep, dist = radius_filter(cand_lat, cand_lng, lat, lng, radius_m)
-        out = [GeoSearchResult(hk, sk, value, float(d))
-               for (hk, sk, value), k, d in zip(cand_keys, keep, dist)
-               if k]
+        out: List[GeoSearchResult] = []
+        base = 0
+        for page, rows, packed in pages:
+            n = len(rows)
+            for j in np.flatnonzero(keep[base:base + n]):
+                i = int(rows[int(j)])
+                if isinstance(page, tuple):
+                    isk, value = page[1][i]
+                elif isinstance(page, list):
+                    _ih, isk = restore_key(page[i].key)
+                    value = page[i].value
+                else:
+                    _ih, isk = restore_key(page.key_at(i))
+                    value = page.value_at(i)
+                hk, sk = self._restore_raw_keys(isk)
+                if packed[int(j)]:
+                    value = bytes(value[_HDR:])
+                out.append(GeoSearchResult(
+                    hk, sk, value, float(dist[base + int(j)])))
+            base += n
         if sort_by_distance:
             out.sort(key=lambda r: r.distance_m)
         if count >= 0:
@@ -179,8 +308,10 @@ class GeoClient:
         and the SORT_SEP continuation)."""
         return sub[:-1] + bytes([sub[-1] + 1]) if sub else b""
 
-    def _scan_cells(self, cells):
-        """All covering cells' index rows. A covering cell FINER than
+    def _scan_cell_pages(self, cells):
+        """All covering cells' index rows, yielded as whole response
+        PAGES (columnar ScanPage or KeyValue list) so the caller can
+        lift coordinates vectorized. A covering cell FINER than
         index_level becomes a sortkey-range scan inside its coarse
         hashkey cell (the cell digits continue into the sortkey). When
         the index client batches (scan_multi), every cell's FIRST page
@@ -194,13 +325,22 @@ class GeoClient:
                           cell[self.index_level:].encode()))
         scan_multi = getattr(self.index, "scan_multi", None)
         if scan_multi is None:
+            # streaming fallback for clients without batched scans:
+            # bounded ("raw", [(index_sortkey, value), ...]) batches —
+            # no key encode/restore round-trip, no whole-cell buffering
             for hk, sub in specs:
-                for row in self.index.get_scanner(
+                batch: list = []
+                for _rhk, rsk, value in self.index.get_scanner(
                         hk, start_sortkey=sub,
                         stop_sortkey=self._sub_stop(sub)):
-                    yield row
+                    batch.append((rsk, value))
+                    if len(batch) >= 1024:
+                        yield ("raw", batch)
+                        batch = []
+                if batch:
+                    yield ("raw", batch)
             return
-        from pegasus_tpu.base.key_schema import key_hash_parts, restore_key
+        from pegasus_tpu.base.key_schema import key_hash_parts
         from pegasus_tpu.client.client import make_hashkey_scan_request
 
         pcount = getattr(self.index, "partition_count", None)
@@ -223,9 +363,7 @@ class GeoClient:
                     # "no nearby points" — match the scanner path
                     raise RuntimeError(
                         f"geo cell scan failed: error {resp.error}")
-                for kv in resp.kvs:
-                    rhk, rsk = restore_key(kv.key)
-                    yield rhk, rsk, kv.value
+                yield resp.kvs
                 # overflowing cells RESUME the server-held context (no
                 # re-scan of served rows, no positional skipping, no
                 # leaked context)
@@ -235,9 +373,7 @@ class GeoClient:
                     if page.error != int(StorageStatus.OK):
                         raise RuntimeError(
                             f"geo cell scan failed: error {page.error}")
-                    for kv in page.kvs:
-                        rhk, rsk = restore_key(kv.key)
-                        yield rhk, rsk, kv.value
+                    yield page.kvs
                     cid = page.context_id
 
     def search_radial_by_key(self, hash_key: bytes, sort_key: bytes,

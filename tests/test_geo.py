@@ -196,3 +196,26 @@ def test_polar_search_coarsens_instead_of_crashing(tmp_path):
     finally:
         raw.close()
         idx.close()
+
+
+def test_legacy_headerless_index_rows_still_searchable(tmp_path):
+    """Index rows written by builds that stored the raw value directly
+    (no packed coordinate header) must keep appearing in radius
+    searches via the per-record text-codec fallback, alongside
+    headered rows — and their values must come back unstripped."""
+    geo, _raw, _idx = make_geo(tmp_path)
+    # a headered row through the normal path
+    assert geo.set(b"new", b"s", b"40.0001|-74.0001|new-point") == OK
+    # a LEGACY row: planted directly in the index table, raw value only
+    ih, isk = geo._index_keys(b"old", b"s", 40.0002, -74.0002)
+    legacy_value = b"40.0002|-74.0002|old-point"
+    assert geo.index.set(ih, isk, legacy_value) == OK
+    assert geo.raw.set(b"old", b"s", legacy_value) == OK
+
+    got = geo.search_radial(40.0, -74.0, 300)
+    by_hk = {g.hash_key: g for g in got}
+    assert set(by_hk) == {b"new", b"old"}
+    assert by_hk[b"new"].value == b"40.0001|-74.0001|new-point"
+    assert by_hk[b"old"].value == legacy_value
+    assert abs(by_hk[b"old"].distance_m
+               - haversine_m(40.0, -74.0, 40.0002, -74.0002)) < 1.0
