@@ -22,7 +22,7 @@ import socket
 import subprocess
 import sys
 import time
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 from pegasus_tpu.utils.errors import ErrorCode, PegasusError
 
@@ -46,18 +46,36 @@ def _cluster_paths(directory: str) -> Dict[str, str]:
 
 
 def start(directory: str = DEFAULT_DIR, n_replica: int = 3,
-          n_meta: int = 1, auth_secret: Optional[str] = None) -> dict:
+          n_meta: int = 1, auth_secret: Optional[str] = None,
+          name_prefix: str = "",
+          extra_peers: Optional[Dict[str, Tuple[str, int]]] = None) -> dict:
+    """`name_prefix` namespaces this cluster's node names (two oneboxes
+    on one host must not both own "meta"); `extra_peers` maps REMOTE
+    node names to (host, port) — written into the address book with
+    role "external" so this cluster's nodes can dial another cluster
+    (cross-cluster duplication), but never spawned or health-checked
+    here. Remote names must match the peer cluster's own node names:
+    the wire frame's dst field is how the receiving dispatcher finds
+    its handler."""
     paths = _cluster_paths(directory)
     os.makedirs(paths["logs"], exist_ok=True)
     if n_meta <= 1:
-        nodes = {"meta": {"host": "127.0.0.1", "port": _free_port(),
-                          "role": "meta"}}
+        nodes = {f"{name_prefix}meta": {
+            "host": "127.0.0.1", "port": _free_port(), "role": "meta"}}
     else:
-        nodes = {f"meta{i}": {"host": "127.0.0.1", "port": _free_port(),
-                              "role": "meta"} for i in range(n_meta)}
+        nodes = {f"{name_prefix}meta{i}": {
+            "host": "127.0.0.1", "port": _free_port(), "role": "meta"}
+            for i in range(n_meta)}
     for i in range(n_replica):
-        nodes[f"node{i}"] = {"host": "127.0.0.1", "port": _free_port(),
-                             "role": "replica"}
+        nodes[f"{name_prefix}node{i}"] = {
+            "host": "127.0.0.1", "port": _free_port(),
+            "role": "replica"}
+    for name, (host, port) in (extra_peers or {}).items():
+        if name in nodes:
+            raise ValueError(
+                f"extra peer {name!r} collides with a local node — "
+                "give one cluster a name_prefix")
+        nodes[name] = {"host": host, "port": port, "role": "external"}
     cfg = {"data_root": os.path.join(directory, "data"), "nodes": nodes}
     if auth_secret:
         # onebox-grade key distribution: the secret lives in the cluster
@@ -77,6 +95,8 @@ def start(directory: str = DEFAULT_DIR, n_replica: int = 3,
 
     pids = {}
     for name in nodes:
+        if nodes[name]["role"] == "external":
+            continue  # book-only remote peer (another cluster's node)
         log = open(os.path.join(paths["logs"], f"{name}.log"), "ab")
         p = subprocess.Popen(
             [sys.executable, "-m", "pegasus_tpu.server.node_main",
@@ -90,6 +110,8 @@ def start(directory: str = DEFAULT_DIR, n_replica: int = 3,
     # liveness: every node's port accepts within the deadline
     deadline = time.monotonic() + 30
     for name, n in nodes.items():
+        if n["role"] == "external":
+            continue
         while True:
             try:
                 socket.create_connection((n["host"], n["port"]),
