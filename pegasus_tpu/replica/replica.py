@@ -590,71 +590,80 @@ class Replica:
                 elif wo.op in (OP_MULTI_PUT, OP_MULTI_REMOVE):
                     hks.append(wo.request.hash_key)
             hc.capture(hks)
-        for wo in mu.ops:
-            if wo.op == OP_PUT:
-                key, user_data, expire_ts = wo.request
-                cu.add_write(len(key) + len(user_data))
-                its = ws.translate_put(key, user_data, expire_ts, ts)
-                responses.append(int(ErrorCode.ERR_OK))
-            elif wo.op == OP_REMOVE:
-                cu.add_write(len(wo.request[0]))
-                its = ws.translate_remove(wo.request[0])
-                responses.append(int(ErrorCode.ERR_OK))
-            elif wo.op == OP_MULTI_PUT:
-                cu.add_write(len(wo.request.hash_key) + sum(
-                    len(kv.key) + len(kv.value) for kv in wo.request.kvs))
-                err, its = ws.translate_multi_put(wo.request, ts, now)
-                responses.append(err)
-            elif wo.op == OP_MULTI_REMOVE:
-                cu.add_write(len(wo.request.hash_key) + sum(
-                    len(sk) for sk in wo.request.sort_keys))
-                err, count, its = ws.translate_multi_remove(wo.request)
-                responses.append((err, count))
-            elif wo.op == OP_INCR:
-                cu.add_write(len(wo.request.key))
-                resp, its = ws.translate_incr(wo.request, ts, now)
-                resp.decree = mu.decree
-                responses.append(resp)
-            elif wo.op == OP_CAS:
-                resp, its = ws.translate_check_and_set(wo.request, ts, now)
-                resp.decree = mu.decree
-                responses.append(resp)
-            elif wo.op == OP_CAM:
-                resp, its = ws.translate_check_and_mutate(wo.request, ts, now)
-                resp.decree = mu.decree
-                responses.append(resp)
-            elif wo.op == OP_DUP_PUT:
-                key, user_data, expire_ts, timetag = wo.request
-                applied, its = ws.translate_duplicate_put(
-                    key, user_data, expire_ts, timetag,
-                    dup_floors.get(key, 0))
-                if applied:
-                    dup_floors[key] = timetag
-                responses.append(int(applied))
-            elif wo.op == OP_DUP_REMOVE:
-                key, timetag = wo.request
-                applied, its = ws.translate_duplicate_remove(
-                    key, timetag, dup_floors.get(key, 0))
-                if applied:
-                    dup_floors[key] = timetag
-                responses.append(int(applied))
-            elif wo.op == OP_INGEST:
-                # bulk-load ingestion applies on EVERY member at the same
-                # decree (the mutation carries only the remote location;
-                # the staged SST is immutable, so the download is
-                # deterministic) — parity: replica_bulk_loader.h:49 +
-                # ingestion through 2PC. ingest_sst_file stamps the decree
-                # watermark itself; skip the empty apply_items below
-                # (OP_INGEST rides alone per ATOMIC_OPS)
-                responses.append(self._apply_ingest(wo.request, mu.decree))
-                callback = self._client_callbacks.pop(mu.decree, None)
-                if callback is not None:
-                    callback(responses)
-                return
-            else:
-                raise ValueError(f"unknown op {wo.op}")
-            items.extend(its)
-        ws.apply_items(items, mu.decree)
+        if len(mu.ops) == 1 and mu.ops[0].op == OP_INGEST:
+            # bulk-load ingestion rides alone (ATOMIC_OPS) and takes the
+            # write lock only around the engine mutation — its
+            # block-service download must not stall the partition
+            responses.append(
+                self._apply_ingest(mu.ops[0].request, mu.decree))
+            callback = self._client_callbacks.pop(mu.decree, None)
+            if callback is not None:
+                callback(responses)
+            return
+        # The engine-reading translations (timetags, incr/cas current
+        # values) AND the batch apply run under the server's
+        # single-writer lock: the env-triggered manual compaction
+        # thread takes the same lock (partition_server.manual_compact),
+        # and without this exclusion a compaction's overlay reset wipes
+        # any mutation applied after its merge snapshot began — acked
+        # writes silently lost (found by the combined-chaos drive:
+        # sustained load + env compaction on a live onebox).
+        with self.server._write_lock:
+            for wo in mu.ops:
+                if wo.op == OP_PUT:
+                    key, user_data, expire_ts = wo.request
+                    cu.add_write(len(key) + len(user_data))
+                    its = ws.translate_put(key, user_data, expire_ts, ts)
+                    responses.append(int(ErrorCode.ERR_OK))
+                elif wo.op == OP_REMOVE:
+                    cu.add_write(len(wo.request[0]))
+                    its = ws.translate_remove(wo.request[0])
+                    responses.append(int(ErrorCode.ERR_OK))
+                elif wo.op == OP_MULTI_PUT:
+                    cu.add_write(len(wo.request.hash_key) + sum(
+                        len(kv.key) + len(kv.value)
+                        for kv in wo.request.kvs))
+                    err, its = ws.translate_multi_put(wo.request, ts, now)
+                    responses.append(err)
+                elif wo.op == OP_MULTI_REMOVE:
+                    cu.add_write(len(wo.request.hash_key) + sum(
+                        len(sk) for sk in wo.request.sort_keys))
+                    err, count, its = ws.translate_multi_remove(wo.request)
+                    responses.append((err, count))
+                elif wo.op == OP_INCR:
+                    cu.add_write(len(wo.request.key))
+                    resp, its = ws.translate_incr(wo.request, ts, now)
+                    resp.decree = mu.decree
+                    responses.append(resp)
+                elif wo.op == OP_CAS:
+                    resp, its = ws.translate_check_and_set(
+                        wo.request, ts, now)
+                    resp.decree = mu.decree
+                    responses.append(resp)
+                elif wo.op == OP_CAM:
+                    resp, its = ws.translate_check_and_mutate(
+                        wo.request, ts, now)
+                    resp.decree = mu.decree
+                    responses.append(resp)
+                elif wo.op == OP_DUP_PUT:
+                    key, user_data, expire_ts, timetag = wo.request
+                    applied, its = ws.translate_duplicate_put(
+                        key, user_data, expire_ts, timetag,
+                        dup_floors.get(key, 0))
+                    if applied:
+                        dup_floors[key] = timetag
+                    responses.append(int(applied))
+                elif wo.op == OP_DUP_REMOVE:
+                    key, timetag = wo.request
+                    applied, its = ws.translate_duplicate_remove(
+                        key, timetag, dup_floors.get(key, 0))
+                    if applied:
+                        dup_floors[key] = timetag
+                    responses.append(int(applied))
+                else:
+                    raise ValueError(f"unknown op {wo.op}")
+                items.extend(its)
+            ws.apply_items(items, mu.decree)
         tracer = self._traces.pop(mu.decree, None)
         if tracer is not None:
             tracer.add_point("committed_applied")
@@ -761,13 +770,18 @@ class Replica:
             return int(StorageStatus.INVALID_ARGUMENT)
         remote = f"{src_app}/{self.server.pidx}/{BULK_LOAD_FILE}"
         if not bs.exists(remote):
-            self.server.write_service.apply_items([], decree)
+            with self.server._write_lock:
+                self.server.write_service.apply_items([], decree)
             return int(StorageStatus.OK)  # nothing staged for this pidx
         try:
             with tempfile.TemporaryDirectory(prefix="pegingest") as tmp:
                 local = os.path.join(tmp, "ingest.sst")
+                # the (possibly slow) block-service download runs
+                # UNLOCKED; only the engine mutation itself needs the
+                # single-writer exclusion (same split as bulk_load.py)
                 bs.download(remote, local)
-                self.server.engine.ingest_sst_file(local, decree)
+                with self.server._write_lock:
+                    self.server.engine.ingest_sst_file(local, decree)
             self._record_ingested(load_id)
         except (OSError, ValueError):
             # staged files must stay immutable+present for the whole load
@@ -775,7 +789,8 @@ class Replica:
             # STILL stamp the decree — a committed mutation must advance
             # the watermark identically on every member — and surface the
             # failure so meta aborts the load.
-            self.server.write_service.apply_items([], decree)
+            with self.server._write_lock:
+                self.server.write_service.apply_items([], decree)
             return int(StorageStatus.IO_ERROR)
         return int(StorageStatus.OK)
 
@@ -838,7 +853,7 @@ class Replica:
             ckpt_dir = os.path.join(self.server.engine.data_dir,
                                     f"learn.ckpt.{src}")
             shutil.rmtree(ckpt_dir, ignore_errors=True)
-            ckpt_decree = self.server.engine.checkpoint(ckpt_dir)
+            ckpt_decree = self.server.checkpoint(ckpt_dir)
             self._learn_ckpt_dirs[src] = ckpt_dir
             self.transport.send(self.name, src, "learn_response", {
                 "type": LT_APP,
@@ -942,7 +957,10 @@ class Replica:
             # a failed checkpoint must leave the WAL un-GC'd: nothing
             # durable moved, so recovery still replays everything
             return
-        self.server.engine.flush()
+        # PartitionServer.flush carries the single-writer exclusion: a
+        # flush swaps the memtable, which must not interleave with the
+        # async compaction thread's own overlay reset
+        self.server.flush()
         floor = self.server.engine.last_flushed_decree
         for dup in self.duplicators:
             floor = min(floor, dup.confirmed_decree)

@@ -692,7 +692,7 @@ class ReplicaStub:
 
         ckpt_dir = tempfile.mkdtemp(prefix="pegbk")
         try:
-            decree = r.server.engine.checkpoint(ckpt_dir)
+            decree = r.server.checkpoint(ckpt_dir)
         except Exception:
             shutil.rmtree(ckpt_dir, ignore_errors=True)
             self._backup_inflight.discard(key)
@@ -957,7 +957,7 @@ class ReplicaStub:
             child_dir = self._replica_dir(child_gpid)
             shutil.rmtree(child_dir, ignore_errors=True)
             os.makedirs(os.path.join(child_dir, "app"), exist_ok=True)
-            sess["ckpt_decree"] = r.server.engine.checkpoint(
+            sess["ckpt_decree"] = r.server.checkpoint(
                 os.path.join(child_dir, "app", "sst"))
             # phase 2 — fence writes (clients get ERR_SPLITTING, retry);
             # only the small log tail remains to move

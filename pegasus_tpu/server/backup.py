@@ -47,10 +47,15 @@ class BackupEngine:
         self.policy_name = policy_name
 
     def backup_partition(self, backup_id: int, app_id: int, pidx: int,
-                         engine: StorageEngine) -> int:
-        """Checkpoint one partition and upload it. Returns the decree."""
+                         engine: StorageEngine, server=None) -> int:
+        """Checkpoint one partition and upload it. Returns the decree.
+        `server`: the owning PartitionServer when available — its
+        checkpoint() carries the single-writer lock against the async
+        env-compaction thread; bare engines (offline tooling) snapshot
+        directly."""
         with tempfile.TemporaryDirectory(prefix="pegbk") as tmp:
-            decree = engine.checkpoint(tmp)
+            decree = (server.checkpoint(tmp) if server is not None
+                      else engine.checkpoint(tmp))
             self.upload_checkpoint(backup_id, app_id, pidx, tmp, decree)
             return decree
 

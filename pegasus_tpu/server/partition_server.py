@@ -1816,6 +1816,14 @@ class PartitionServer:
         with self._write_lock:
             return self.engine.flush()
 
+    def checkpoint(self, dest_dir: str) -> int:
+        """Frozen snapshot under the single-writer lock — checkpoint
+        starts with a memtable flush and walks the run set, which must
+        not interleave with the async env-compaction thread's publish
+        (backup / learning / split all snapshot through here)."""
+        with self._write_lock:
+            return self.engine.checkpoint(dest_dir)
+
     def update_partition_count(self, new_count: int) -> None:
         """Partition-count flip after a split (parity: the group
         partition-count update in replica_split_manager.h:76-123): routing

@@ -1165,7 +1165,7 @@ def _dispatch(args, box, out) -> int:
         be = BackupEngine(block_service_for(args.bucket), args.policy)
         for p_ in t.all_partitions():
             be.backup_partition(args.backup_id, t.app_id, p_.pidx,
-                                p_.engine)
+                                p_.engine, server=p_)
         be.finish_backup(args.backup_id, t.app_id, args.table,
                          t.partition_count)
         print(f"OK: backup {args.backup_id}", file=out)
