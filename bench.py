@@ -32,6 +32,8 @@ PEGBENCH_PARTITIONS (default 64), PEGBENCH_SEED, PEGBENCH_COMPACT=0 /
 PEGBENCH_GEO=0 (skip those phases),
 PEGBENCH_SCAN_BATCH (default 32: scans coalesced per device dispatch —
 the request-batching unit of SURVEY §2.6; 1 disables coalescing),
+PEGBENCH_GET_BATCH (default 32: point gets coalesced per read-
+coordinator flush in the point_get_batch phase),
 PEGBENCH_PROBE_TIMEOUT (s, default 120), PEGBENCH_PROBE_RETRIES (default 4),
 PEGBENCH_FORCE_CPU=1 (CPU-only dry run: never dials the TPU tunnel).
 """
@@ -290,22 +292,122 @@ def run_scans(bc, n_ops, n_partitions, n_hashkeys, seed, record_goal=100,
     return n_ops, records, elapsed
 
 
-def run_point_gets(bc, n_ops, n_hashkeys, seed):
-    """YCSB-C: 100% point gets, zipfian-ish key popularity (BASELINE
-    config #1), through the cluster read gate."""
+def _point_get_stream(n_ops, n_hashkeys, seed):
+    """The YCSB-C op stream (zipfian-ish key popularity, BASELINE
+    config #1) as (partition_hash, (hash_key, sort_key)) pairs — the
+    ONE derivation every point-get flavor (solo, client-batched,
+    server-side) measures against, so the cross-flavor ratios always
+    compare identical workloads."""
     import numpy as np
 
+    from pegasus_tpu.base.key_schema import key_hash_parts
+
     rng = np.random.default_rng(seed)
-    client = bc.client
     zipf_u = rng.random(n_ops) ** 2.0
     sk_draw = rng.integers(0, 10, size=n_ops)
+    return [(key_hash_parts(b"user%08d" % int(zipf_u[op] * n_hashkeys)),
+             (b"user%08d" % int(zipf_u[op] * n_hashkeys),
+              b"s%02d" % int(sk_draw[op])))
+            for op in range(n_ops)]
+
+
+def run_point_gets(bc, n_ops, n_hashkeys, seed):
+    """YCSB-C: 100% single-request point gets through the cluster read
+    gate (the round-5 baseline shape)."""
+    stream = _point_get_stream(n_ops, n_hashkeys, seed)
+    client = bc.client
     hits = 0
     t0 = time.perf_counter()
-    for op in range(n_ops):
-        hk = b"user%08d" % int(zipf_u[op] * n_hashkeys)
-        err, _v = client.get(hk, b"s%02d" % int(sk_draw[op]))
+    for _ph, (hk, sk) in stream:
+        err, _v = client.get(hk, sk)
         hits += err == 0
     return n_ops, hits, time.perf_counter() - t0
+
+
+def run_point_gets_batched(bc, n_ops, n_hashkeys, seed, batch=32):
+    """The same YCSB-C op stream coalesced through the cross-partition
+    read coordinator (`batch` gets per flush, client.point_read_multi)
+    — the request-batching dispatch model applied to point reads."""
+    from pegasus_tpu.base.key_schema import generate_key
+
+    stream = _point_get_stream(n_ops, n_hashkeys, seed)
+    client = bc.client
+    n_part = client.partition_count
+    hits = 0
+    pending: dict = {}
+    pending_n = 0
+
+    def flush():
+        nonlocal hits, pending_n
+        if not pending:
+            return
+        for _pidx, results in client.point_read_multi(
+                dict(pending)).items():
+            for err, _v in results:
+                hits += err == 0
+        pending.clear()
+        pending_n = 0
+
+    t0 = time.perf_counter()
+    for ph, (hk, sk) in stream:
+        pending.setdefault(ph % n_part, []).append(
+            ("get", generate_key(hk, sk), ph))
+        pending_n += 1
+        if pending_n >= batch:
+            flush()
+    flush()
+    return n_ops, hits, time.perf_counter() - t0
+
+
+def run_point_gets_server_side(bc, n_ops, n_hashkeys, seed, batch=0):
+    """Server-side only (no client/transport layer): batch=0 drives
+    on_get per op — the round-5 single-request hot loop — batch=N
+    drives coordinator flushes of N ops spread across partitions."""
+    from pegasus_tpu.base.key_schema import generate_key
+    from pegasus_tpu.server.read_coordinator import point_read_multi
+
+    stream = [(ph % len(bc.servers), generate_key(hk, sk), ph)
+              for ph, (hk, sk)
+              in _point_get_stream(n_ops, n_hashkeys, seed)]
+    servers = bc.servers
+    hits = 0
+    if batch <= 1:
+        t0 = time.perf_counter()
+        for pidx, key, ph in stream:
+            err, _v = servers[pidx].on_get(key, partition_hash=ph)
+            hits += err == 0
+        return n_ops, hits, time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for off in range(0, len(stream), batch):
+        groups: dict = {}
+        for pidx, key, ph in stream[off:off + batch]:
+            groups.setdefault(pidx, []).append(("get", key, ph))
+        for results in point_read_multi(
+                [(servers[pidx], ops) for pidx, ops in groups.items()]):
+            for err, _v in results:
+                hits += err == 0
+    return n_ops, hits, time.perf_counter() - t0
+
+
+def verify_point_batch_identity(bc, n_hashkeys, seed, n=512) -> bool:
+    """Acceptance gate: batched results must be BYTE-identical to the
+    single-request path over a sampled key set (hits, misses, and
+    expired records alike)."""
+    from pegasus_tpu.base.key_schema import generate_key
+
+    stream = _point_get_stream(n, n_hashkeys, seed)
+    client = bc.client
+    n_part = client.partition_count
+    groups: dict = {}
+    expect: dict = {}
+    for ph, (hk, sk) in stream:
+        pidx = ph % n_part
+        groups.setdefault(pidx, []).append(
+            ("get", generate_key(hk, sk), ph))
+        expect.setdefault(pidx, []).append(tuple(client.get(hk, sk)))
+    got = client.point_read_multi(groups)
+    return all(tuple(map(tuple, got[p])) == tuple(expect[p])
+               for p in groups)
 
 
 def measure_scan_phase(jax, device, bc, n_ops, n_partitions, n_hashkeys,
@@ -703,6 +805,56 @@ def main() -> None:
                     "hit_rate": round(hits_g / ops_g, 4),
                 }
                 save_details()
+
+                # batched point reads (the read-coordinator tentpole):
+                # the SAME op stream coalesced 32 per flush through
+                # point_read_multi, vs the single-request numbers above
+                # — plus the server-side pair (no client/transport) and
+                # the byte-identity acceptance gate
+                pg_batch = int(os.environ.get("PEGBENCH_GET_BATCH", 32))
+                identical = verify_point_batch_identity(
+                    bc, n_hashkeys, seed + 3)
+                with jax.default_device(accel):
+                    run_point_gets_batched(bc, g_ops, n_hashkeys,
+                                           seed + 3, batch=pg_batch)
+                    ops_b, hits_b, accel_b = run_point_gets_batched(
+                        bc, g_ops, n_hashkeys, seed + 3, batch=pg_batch)
+                with jax.default_device(cpu):
+                    run_point_gets_batched(bc, g_ops, n_hashkeys,
+                                           seed + 3, batch=pg_batch)
+                    _o, _h, cpu_b = run_point_gets_batched(
+                        bc, g_ops, n_hashkeys, seed + 3, batch=pg_batch)
+                # server-side: the r5 single-request hot loop vs the
+                # coordinator, same stream, both warm (pass 1 warms)
+                run_point_gets_server_side(bc, g_ops, n_hashkeys,
+                                           seed + 3, batch=0)
+                _o, _h, sv_solo = run_point_gets_server_side(
+                    bc, g_ops, n_hashkeys, seed + 3, batch=0)
+                run_point_gets_server_side(bc, g_ops, n_hashkeys,
+                                           seed + 3, batch=pg_batch)
+                _o, _h, sv_b = run_point_gets_server_side(
+                    bc, g_ops, n_hashkeys, seed + 3, batch=pg_batch)
+                details["phases"]["point_get_batch"] = {
+                    "batch": pg_batch,
+                    "accel_qps": round(ops_b / accel_b, 2),
+                    "cpu_qps": round(ops_b / cpu_b, 2),
+                    "hit_rate": round(hits_b / ops_b, 4),
+                    "vs_single_request": round(
+                        (ops_b / accel_b) / (ops_g / accel_g), 3),
+                    "server_side_solo_qps": round(g_ops / sv_solo, 2),
+                    f"server_side_batch{pg_batch}_qps": round(
+                        g_ops / sv_b, 2),
+                    "server_side_speedup": round(sv_solo / sv_b, 3),
+                    "identical_to_unbatched": identical,
+                }
+                save_details()
+                _log(f"point-get-batch({pg_batch}): "
+                     f"{ops_b / accel_b:.0f} q/s client-batched "
+                     f"({(ops_b / accel_b) / (ops_g / accel_g):.2f}x "
+                     f"single-request); server-side "
+                     f"{g_ops / sv_solo:.0f} -> {g_ops / sv_b:.0f} q/s "
+                     f"({sv_solo / sv_b:.2f}x); "
+                     f"identical={identical}")
 
                 # batching-margin sweep: the same scan workload with
                 # coalescing DISABLED (batch=1) on both backends — the

@@ -396,6 +396,20 @@ class PegasusClient:
         return {pidx: resps for (pidx, _reqs), resps
                 in zip(groups.items(), results)}
 
+    def point_read_multi(self, groups):
+        """Batched point reads for many partitions (in-process form):
+        one coordinator flush serves every partition's get / ttl /
+        multi_get(sort keys) / batch_get ops — same API shape as the
+        cluster client's. `groups`: {pidx: [(op, args,
+        partition_hash)]} -> {pidx: [result]}."""
+        from pegasus_tpu.server.read_coordinator import point_read_multi
+
+        pairs = [(self._table.partitions[pidx], ops)
+                 for pidx, ops in groups.items()]
+        results = point_read_multi(pairs)
+        return {pidx: res for (pidx, _ops), res
+                in zip(groups.items(), results)}
+
     # ---- scanners -----------------------------------------------------
 
     def get_scanner(self, hash_key: bytes, start_sortkey: bytes = b"",

@@ -63,6 +63,7 @@ _RETRYABLE = {
 }
 
 _OK = int(ErrorCode.ERR_OK)
+_MISROUTED = int(ErrorCode.ERR_PARENT_PARTITION_MISUSED)
 
 
 class ClusterClient:
@@ -457,6 +458,95 @@ class ClusterClient:
             raise PegasusError(ErrorCode.ERR_TIMEOUT,
                                f"scan_multi: partitions {sorted(missing)} "
                                f"unreachable")
+        return out
+
+    @staticmethod
+    def _point_result_err(result) -> int:
+        """The storage error inside a point-read result (tuple for
+        get/ttl, .error for multi_get/batch_get responses)."""
+        if isinstance(result, (tuple, list)):
+            return result[0]
+        return result.error
+
+    def point_read_multi(self, groups: Dict[int, list]):
+        """Batched point reads (get / ttl / multi_get with sort keys /
+        batch_get) for MANY partitions in as few node round-trips as
+        possible — the point-read twin of scan_multi: partitions group
+        by their primary node, each node serves its whole flush through
+        the cross-partition read coordinator. `groups`: {pidx: [(op,
+        args, partition_hash)]}. Returns {pidx: [result]} (the caller's
+        grouping, original op order) with results byte-identical to the
+        solo read ops.
+
+        Ops are re-routed PER ATTEMPT from their partition_hash (like
+        _read recomputes `ph % partition_count`), and a
+        misrouted-split result coming back in-band
+        (ERR_PARENT_PARTITION_MISUSED from the per-op gate) re-resolves
+        just that op — matching the solo path's transparent re-resolve
+        instead of surfacing the routing error to the application."""
+        self._ensure_config()
+        items = [(orig_pidx, i, op)
+                 for orig_pidx, ops in groups.items()
+                 for i, op in enumerate(ops)]
+        out: Dict[int, list] = {pidx: [None] * len(ops)
+                                for pidx, ops in groups.items()}
+        unresolved = set(range(len(items)))
+        for attempt in range(self._max_retries):
+            if not unresolved:
+                break
+            if attempt:
+                try:
+                    self.refresh_config()
+                except PegasusError:
+                    continue  # meta momentarily down; cached config may
+                    # still be right on the next pass
+            send: Dict[str, Dict[int, list]] = {}
+            for idx in sorted(unresolved):
+                orig_pidx, _i, op = items[idx]
+                ph = op[2] if len(op) > 2 else None
+                pidx = (ph % self.partition_count if ph is not None
+                        else orig_pidx)
+                primary = self._primary_of(pidx)
+                if primary:
+                    send.setdefault(primary, {}).setdefault(
+                        pidx, []).append((idx, op))
+            if not send:
+                continue  # mid-failover: refresh and retry, like _read
+            rids = []
+            for node, pmap in send.items():
+                node_groups = [((self.app_id, pidx),
+                                [op for _i, op in lst])
+                               for pidx, lst in pmap.items()]
+                rids.append((self._send_request(
+                    node, "client_read_batch",
+                    {"groups": node_groups, "auth": self.auth}), pmap))
+            for rid, pmap in rids:
+                reply = self._await(rid)
+                if reply is None or reply["err"] != _OK:
+                    continue  # retried next attempt
+                for pidx, err, results in reply["result"]:
+                    sent = pmap.get(pidx)
+                    if sent is None:
+                        continue
+                    if err == int(ErrorCode.ERR_ACL_DENY):
+                        raise PegasusError(ErrorCode.ERR_ACL_DENY,
+                                           "point_read_multi")
+                    if err in _RETRYABLE:
+                        continue  # stale primary; re-resolve
+                    if err != _OK:
+                        raise PegasusError(ErrorCode(err),
+                                           "point_read_multi")
+                    for (idx, _op), result in zip(sent, results):
+                        if self._point_result_err(result) == _MISROUTED:
+                            continue  # split raced: re-route this op
+                        orig_pidx, i, _o = items[idx]
+                        out[orig_pidx][i] = result
+                        unresolved.discard(idx)
+        if unresolved:
+            stuck = sorted({items[i][0] for i in unresolved})
+            raise PegasusError(
+                ErrorCode.ERR_TIMEOUT,
+                f"point_read_multi: partitions {stuck} unreachable")
         return out
 
     def scan_page(self, pidx: int, context_id: int):

@@ -44,6 +44,16 @@ _COORD = struct.Struct("<dd")
 _HDR = len(_MAGIC) + _COORD.size
 
 
+def _coord_in_range(lat: float, lng: float) -> bool:
+    """Sanity gate on header-sniffed coordinates: the 2-byte magic is
+    weak evidence, and a legacy headerless value that happens to start
+    with it would otherwise inject garbage coordinates into the radius
+    filter (and silently lose its first 18 bytes). Out-of-range or
+    non-finite doubles mean "not really a packed header" — the row
+    falls back to the text codec. NaN fails both comparisons."""
+    return -90.0 <= lat <= 90.0 and -180.0 <= lng <= 180.0
+
+
 @dataclass
 class LatLngCodec:
     """Extract/encode coordinates from a record value (parity:
@@ -88,16 +98,19 @@ def _page_coords(kvs, codec, value_of, n_rows):
         rows, coords, packed = [], [], []
         for i in range(n_rows):
             v = value_of(i)
+            c = None
             if len(v) >= _HDR and v[0] == m0 and v[1] == m1:
-                rows.append(i)
-                coords.append(_COORD.unpack_from(v, len(_MAGIC)))
-                packed.append(True)
-            else:
-                c = codec.decode(v)
-                if c is not None:
+                lat, lng = _COORD.unpack_from(v, len(_MAGIC))
+                if _coord_in_range(lat, lng):
                     rows.append(i)
-                    coords.append(c)
-                    packed.append(False)
+                    coords.append((lat, lng))
+                    packed.append(True)
+                    continue
+            c = codec.decode(v)
+            if c is not None:
+                rows.append(i)
+                coords.append(c)
+                packed.append(False)
         if not rows:
             return None, (), ()
         return (np.asarray(coords, dtype=np.float64),
@@ -120,6 +133,17 @@ def _page_coords(kvs, codec, value_of, n_rows):
         win = (starts[prows][:, None] + len(_MAGIC)
                + np.arange(_COORD.size))
         pcoords = blob[win].reshape(-1).view("<f8").reshape(-1, 2)
+        # range-validate the sniffed headers (vectorized): impossible
+        # lat/lng means a legacy value that merely starts with the
+        # magic — demote those rows to the text-codec path
+        with np.errstate(invalid="ignore"):
+            sane = (np.isfinite(pcoords).all(axis=1)
+                    & (np.abs(pcoords[:, 0]) <= 90.0)
+                    & (np.abs(pcoords[:, 1]) <= 180.0))
+        if not sane.all():
+            has_magic[prows[~sane]] = False
+            prows = prows[sane]
+            pcoords = pcoords[sane]
     # legacy headerless rows: per-record text decode
     lrows, lcoords = [], []
     for i in np.flatnonzero(~has_magic):

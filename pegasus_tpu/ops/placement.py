@@ -9,9 +9,11 @@ probe, because the tunnel's cost model (measured on this image:
 ~70 ms fixed per program round, ~0.5 GB/s host->device, ~37 MB/s
 device->host marginal) splits these programs into two classes:
 
-- "ttl" — compute-trivial per byte (a compare against `now`). The host
-  XLA backend streams these at memory speed with zero movement; the
-  accelerator can never win unless it is co-located (sub-ms RTT).
+- "ttl" / "probe" — compute-trivial per byte (a compare against `now`;
+  a crc/bisect over short key regions for the point-read batch gate).
+  The host XLA backend streams these at memory speed with zero
+  movement; the accelerator can never win unless it is co-located
+  (sub-ms RTT).
 - "rules" / "match" — compute-dense per byte (multi-pattern substring
   matching over wide key rows, K-flavor batches). Upload cost buys K
   patterns of compute, results return bit-packed; the accelerator wins
@@ -73,15 +75,16 @@ def choose_eval_device(workload: str = "rules"):
     """jax.Device to place a movement-bound program on, or None to keep
     the ambient default.
 
-    workload: "ttl" (compute-trivial per byte) or "rules"/"match"
-    (compute-dense). See the module docstring for the policy.
+    workload: "ttl"/"probe" (compute-trivial per byte) or
+    "rules"/"match" (compute-dense). See the module docstring for the
+    policy.
     """
     import jax
 
     rtt, _dev = _probe_rtt()
     if rtt is None:
         return None  # ambient default is already the host
-    if workload == "ttl":
+    if workload in ("ttl", "probe"):
         route_host = rtt > LINK_RTT_COLOCATED_S
     else:
         route_host = rtt > LINK_RTT_BROKEN_S

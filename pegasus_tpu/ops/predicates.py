@@ -275,6 +275,112 @@ def host_alive_mask(expire_ts: np.ndarray, now: int) -> np.ndarray:
     return ~((ets > 0) & (ets <= np.uint32(now)))
 
 
+def pad_probe_keys(probe_keys, width: int):
+    """(uint8[P, width] padded rows, int64[P] lengths) for a batch of
+    exact-match probe keys. Keys longer than `width` cannot exist in a
+    block of that key width; their rows are zeroed and flagged by
+    length so point_probe_rows reports them absent."""
+    p = len(probe_keys)
+    lens = np.fromiter((len(k) for k in probe_keys), dtype=np.int64,
+                       count=p)
+    buf = bytearray(p * width)
+    for i, k in enumerate(probe_keys):
+        if len(k) <= width:
+            off = i * width
+            buf[off:off + len(k)] = k
+    return (np.frombuffer(bytes(buf), dtype=np.uint8).reshape(p, width),
+            lens)
+
+
+def point_probe_rows(keys_matrix: np.ndarray, key_len: np.ndarray,
+                     probe_keys, block_void=None) -> np.ndarray:
+    """Vectorized exact-key probe into ONE sorted columnar block.
+
+    keys_matrix: uint8[N, W] zero-padded sorted rows (SST block order);
+    key_len: int[N]; probe_keys: list[bytes]; block_void: optional
+    precomputed memcmp-ordered void view of keys_matrix (cached per
+    block by page.probe_nat). Returns int64[P] row indices (-1 =
+    absent). One np.searchsorted over the void view locates every probe
+    at once — the batched replacement for per-key Python bisects on the
+    point-get hot path; no key materialization, so cold blocks probe as
+    fast as hot ones.
+
+    Zero padding makes two keys differing only in TRAILING zero bytes
+    pad to identical rows; such twins are adjacent and sorted by true
+    length, so the rare collision resolves with a short forward scan.
+    """
+    n, w = keys_matrix.shape
+    p = len(probe_keys)
+    if p == 0 or n == 0:
+        return np.full(p, -1, dtype=np.int64)
+    vt = np.dtype((np.void, w))
+    if block_void is None:
+        block_void = np.ascontiguousarray(keys_matrix).view(vt).ravel()
+    if p <= 4:
+        # scalar fast path: the common flush shape scatters 1-2 keys
+        # per block, where the batch verify's array setup costs more
+        # than the probes
+        rows = np.full(p, -1, dtype=np.int64)
+        for i, k in enumerate(probe_keys):
+            lk = len(k)
+            if lk > w:
+                continue
+            padded = k.ljust(w, b"\x00")
+            pos = int(np.searchsorted(
+                block_void, np.frombuffer(padded, dtype=vt))[0])
+            while pos < n and block_void[pos].tobytes() == padded:
+                if int(key_len[pos]) == lk:
+                    rows[i] = pos
+                    break
+                pos += 1  # trailing-zero twin: true match is ahead
+        return rows
+    pm, lens = pad_probe_keys(probe_keys, w)
+    probe_v = pm.view(vt).ravel()
+    pos = np.searchsorted(block_void, probe_v)
+    rows = np.full(p, -1, dtype=np.int64)
+    in_range = (pos < n) & (lens <= w)
+    cand = np.flatnonzero(in_range)
+    if cand.size:
+        cpos = pos[cand]
+        same = (keys_matrix[cpos] == pm[cand]).all(axis=1)
+        exact = same & (np.asarray(key_len)[cpos] == lens[cand])
+        rows[cand[exact]] = cpos[exact]
+        # padded-equal but length-mismatched: trailing-zero twins ahead
+        for i in cand[same & ~exact]:
+            j = int(pos[i]) + 1
+            want = int(lens[i])
+            while j < n and block_void[j] == probe_v[i]:
+                if int(key_len[j]) == want:
+                    rows[i] = j
+                    break
+                j += 1
+    return rows
+
+
+def host_key_hash_lo(hash_keys, sort_keys=None) -> np.ndarray:
+    """uint32[B] low lane of pegasus_key_hash for a key batch, evaluated
+    with ONE vectorized crc64 pass (base.crc.crc64_batch) instead of a
+    per-key scalar crc loop — the batched probe-eval form of
+    key_hash_parts used by the point-read coordinator's split-staleness
+    gate. Empty hash keys hash by their sort key (pegasus_key_schema
+    .h:150); placement note: this is compute-trivial per byte (the
+    "probe" workload class in ops/placement.py), so it always runs on
+    the host."""
+    from pegasus_tpu.base.crc import crc64_batch
+
+    regions = list(hash_keys)
+    if sort_keys is not None:
+        regions = [hk if hk else sk
+                   for hk, sk in zip(hash_keys, sort_keys)]
+    b = len(regions)
+    if b == 0:
+        return np.zeros(0, dtype=np.uint32)
+    width = max(1, max(len(r) for r in regions))
+    mat, lens = pad_probe_keys(regions, width)
+    return (crc64_batch(mat, lens, start=0)
+            & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+
+
 @functools.partial(jax.jit, static_argnames=("hash_filter_type",
                                              "sort_filter_type",
                                              "validate_hash",

@@ -924,8 +924,7 @@ class Replica:
         wal = os.path.join(app_dir, "wal.log")
         if os.path.exists(wal):
             os.remove(wal)
-        self.server.engine = StorageEngine(app_dir)
-        self.server.write_service.engine = self.server.engine
+        self.server.install_engine(StorageEngine(app_dir))
         if self.server.engine.last_committed_decree < checkpoint_decree:
             raise RuntimeError(
                 f"learned checkpoint reaches decree "

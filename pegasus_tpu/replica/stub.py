@@ -110,6 +110,13 @@ class ReplicaStub:
         self._fetch_sessions: Dict = {}
         self._last_beacon_ack = float("-inf")
         net.register(name, self.on_message)
+        batch_reg = getattr(net, "register_batch", None)
+        if batch_reg is not None:
+            # transport flush-window hook: a consecutive run of queued
+            # client reads delivers as ONE batch, and its point ops
+            # (get/ttl/multi_get(sort keys)/batch_get) serve through the
+            # cross-partition read coordinator in one flush
+            batch_reg(name, "client_read", self._on_client_read_batch)
         # load existing replica dirs across every data dir (parity:
         # replica_stub boot scan, replica_stub.cpp:594 load_replicas per
         # disk); each dir carries a .replica_info with its partition_count
@@ -459,6 +466,9 @@ class ReplicaStub:
         if msg_type == "client_scan_multi":
             self._on_client_scan_multi(src, payload)
             return
+        if msg_type == "client_read_batch":
+            self._on_client_read_batch_rpc(src, payload)
+            return
         if msg_type == "client_write":
             self._on_client_write(src, payload)
             return
@@ -545,36 +555,18 @@ class ReplicaStub:
         `err` (framework routing error space) and `result` (the storage
         handler's return value — storage status codes live inside it).
         """
-        from pegasus_tpu.replica.replica import PartitionStatus
         from pegasus_tpu.utils.errors import ErrorCode
 
-        gpid = tuple(payload["gpid"])
         rid = payload["rid"]
         op = payload.get("op", "get")
-        r = self.replicas.get(gpid)
-        if not self._client_allowed(r, payload, access="r", src=src):
+        err, r = self._client_read_gate(payload, src)
+        if err is not None:
             self.net.send(self.name, src, "client_read_reply", {
-                "rid": rid, "err": int(ErrorCode.ERR_ACL_DENY),
-                "result": None})
-            return
-        if (r is None or r.status != PartitionStatus.PRIMARY
-                or getattr(r, "restoring", False)
-                or not r.ready_to_serve()
-                or not self.lease_valid()):
-            self.net.send(self.name, src, "client_read_reply", {
-                "rid": rid, "err": int(ErrorCode.ERR_INVALID_STATE),
-                "result": None})
+                "rid": rid, "err": err, "result": None})
             return
         ph = payload.get("partition_hash")
         args = payload.get("args")
         srv = r.server
-        # split staleness gate for EVERY read op (scanner paging ops carry
-        # ph=None — their context was validated at get_scanner time)
-        gate = srv._hash_gate(ph)
-        if gate:
-            self.net.send(self.name, src, "client_read_reply", {
-                "rid": rid, "err": gate, "result": None})
-            return
         try:
             if op == "get":
                 result = srv.on_get(args, partition_hash=ph)
@@ -614,6 +606,137 @@ class ReplicaStub:
             return
         self.net.send(self.name, src, "client_read_reply", {
             "rid": rid, "err": int(ErrorCode.ERR_OK), "result": result})
+
+    def _client_read_gate(self, payload: dict, src: str):
+        """The read path's framework gates (ACL -> primary/lease ->
+        split staleness), factored so the solo handler and both batched
+        point-read paths apply them identically. Returns (err, replica);
+        err None means the request may reach the storage app."""
+        from pegasus_tpu.replica.replica import PartitionStatus
+        from pegasus_tpu.utils.errors import ErrorCode
+
+        gpid = tuple(payload["gpid"])
+        r = self.replicas.get(gpid)
+        if not self._client_allowed(r, payload, access="r", src=src):
+            return int(ErrorCode.ERR_ACL_DENY), None
+        if (r is None or r.status != PartitionStatus.PRIMARY
+                or getattr(r, "restoring", False)
+                or not r.ready_to_serve()
+                or not self.lease_valid()):
+            return int(ErrorCode.ERR_INVALID_STATE), None
+        # split staleness gate for EVERY read op (scanner paging ops
+        # carry ph=None — their context was validated at get_scanner)
+        gate = r.server._hash_gate(payload.get("partition_hash"))
+        if gate:
+            return gate, None
+        return None, r
+
+    def _on_client_read_batch(self, items) -> None:
+        """Transport flush-window delivery: a consecutive run of queued
+        client_read messages as [(src, payload)]. Point ops (get / ttl
+        / multi_get with sort keys / batch_get) from the whole window
+        serve through the cross-partition read coordinator in ONE
+        flush; everything else falls through to the solo handler in
+        arrival order."""
+        from pegasus_tpu.server.read_coordinator import (
+            is_point_read,
+            point_read_multi,
+        )
+        from pegasus_tpu.utils.errors import ErrorCode
+
+        flush: list = []  # (src, payload, server) past the gates
+        for src, payload in items:
+            op = payload.get("op", "get")
+            if not is_point_read(op, payload.get("args")):
+                self._on_client_read(src, payload)
+                continue
+            err, r = self._client_read_gate(payload, src)
+            if err is not None:
+                self.net.send(self.name, src, "client_read_reply", {
+                    "rid": payload.get("rid"), "err": err,
+                    "result": None})
+                continue
+            flush.append((src, payload, r.server))
+        if not flush:
+            return
+        groups: dict = {}
+        for i, (_src, _payload, server) in enumerate(flush):
+            groups.setdefault(id(server), (server, []))[1].append(i)
+        pairs = [(server, [(flush[i][1].get("op", "get"),
+                            flush[i][1].get("args"),
+                            flush[i][1].get("partition_hash"))
+                           for i in idxs])
+                 for server, idxs in groups.values()]
+        try:
+            results = point_read_multi(pairs)
+        except (ValueError, RuntimeError):
+            # malformed op in the flush: re-serve each solo so every
+            # request gets its own precise error instead of a shared one
+            for src, payload, _srv in flush:
+                self._on_client_read(src, payload)
+            return
+        for (_server, idxs), res in zip(groups.values(), results):
+            for i, result in zip(idxs, res):
+                src, payload, _srv = flush[i]
+                self.net.send(self.name, src, "client_read_reply", {
+                    "rid": payload.get("rid"),
+                    "err": int(ErrorCode.ERR_OK), "result": result})
+
+    def _on_client_read_batch_rpc(self, src: str, payload: dict) -> None:
+        """Explicitly batched point reads from the cluster client: one
+        message carries every point op for the partitions this node
+        hosts, served through the cross-partition read coordinator.
+        Reply: {rid, err, result: [(pidx, err, results)]} aligned with
+        the request's groups; per-partition gate failures surface in
+        their slot's err so the client re-resolves just those."""
+        from pegasus_tpu.server.read_coordinator import (
+            is_point_read,
+            point_read_multi,
+        )
+        from pegasus_tpu.utils.errors import ErrorCode
+
+        rid = payload.get("rid")
+        groups = payload.get("groups") or []
+        slots: list = []
+        ok: list = []  # (slot index, server, ops)
+        for gpid, ops in groups:
+            gpid = tuple(gpid)
+            # validate BEFORE planning: one malformed op must fail its
+            # own slot, never leave the whole node batch unreplied
+            if not all(len(o) == 3 and is_point_read(o[0], o[1])
+                       for o in ops):
+                slots.append((gpid[1],
+                              int(ErrorCode.ERR_INVALID_PARAMETERS),
+                              None))
+                continue
+            err, r = self._client_read_gate(
+                {"gpid": gpid, "auth": payload.get("auth")}, src)
+            if err is not None:
+                slots.append((gpid[1], err, None))
+                continue
+            slots.append((gpid[1], int(ErrorCode.ERR_OK), None))
+            ok.append((len(slots) - 1, r.server, ops))
+        if ok:
+            try:
+                results = point_read_multi(
+                    [(srv, [tuple(o) for o in ops])
+                     for _i, srv, ops in ok])
+            except (ValueError, TypeError, AttributeError):
+                # malformed args that slipped past the shape check:
+                # a definite reply, never an unreplied batch
+                for slot_i, _srv, _ops in ok:
+                    slots[slot_i] = (slots[slot_i][0], int(
+                        ErrorCode.ERR_INVALID_PARAMETERS), None)
+            except RuntimeError:
+                for slot_i, _srv, _ops in ok:
+                    slots[slot_i] = (slots[slot_i][0], int(
+                        ErrorCode.ERR_INVALID_STATE), None)
+            else:
+                for (slot_i, _srv, _ops), res in zip(ok, results):
+                    slots[slot_i] = (slots[slot_i][0],
+                                     int(ErrorCode.ERR_OK), res)
+        self.net.send(self.name, src, "client_read_reply", {
+            "rid": rid, "err": int(ErrorCode.ERR_OK), "result": slots})
 
     def _on_config_proposal(self, src: str, payload: dict) -> None:
         """Meta assigns a configuration (parity: on_config_proposal,
@@ -742,8 +865,7 @@ class ReplicaStub:
         r.server.engine.close()
         new_engine = engine.restore_partition(
             payload["backup_id"], payload["src_app_id"], gpid[1], app_dir)
-        r.server.engine = new_engine
-        r.server.write_service.engine = new_engine
+        r.server.install_engine(new_engine)
         r.prepare_list.reset(new_engine.last_committed_decree)
         r.restoring = False
         self.net.send(self.name, src, "restore_partition_done",

@@ -51,6 +51,12 @@ class TcpTransport:
         self.address_book = dict(address_book)
         self.lock = threading.RLock()  # node-wide handler serialization
         self._handlers: Dict[str, Callable[[str, str, Any], None]] = {}
+        # (dst, msg_type) -> handler([(src, payload)]): flush-window
+        # coalescing — the dispatcher drains CONSECUTIVE queued messages
+        # of the same type into one delivery (see _dispatch_loop). The
+        # replica stub registers its point-read batch here so a burst of
+        # independent client gets serves as one coordinator flush.
+        self._batch_handlers: Dict[tuple, Callable] = {}
         self._current_session: str = ""
         self._session_closed_cbs: list = []
         # name -> (socket, write-lock); outbound dials and learned inbound
@@ -101,6 +107,21 @@ class TcpTransport:
     def register(self, addr: str,
                  handler: Callable[[str, str, Any], None]) -> None:
         self._handlers[addr] = handler
+
+    # messages drained into one batch delivery; bounds the latency a
+    # deep queue can add to the first message of the window
+    BATCH_DRAIN_MAX = 64
+
+    def register_batch(self, addr: str, msg_type: str,
+                       handler: Callable[[list], None]) -> None:
+        """Register a flush-window batch handler: when the dispatcher
+        pops a (addr, msg_type) message, it drains every CONSECUTIVE
+        queued message with the same address and type (up to
+        BATCH_DRAIN_MAX) and delivers them as handler([(src, payload)])
+        in one call under the node lock. Only consecutive runs coalesce,
+        so cross-type ordering is preserved exactly; a lone message
+        costs one extra non-blocking queue poll."""
+        self._batch_handlers[(addr, msg_type)] = handler
 
     def send(self, src: str, dst: str, msg_type: str, payload: Any) -> None:
         if dst in self._handlers:
@@ -292,14 +313,42 @@ class TcpTransport:
         prof = METRICS.entity("rpc", "dispatch", {})
         lat: Dict[str, Any] = {}
         cnt: Dict[str, Any] = {}
+        carry: Optional[tuple] = None
         while True:
-            item = self._inbox.get()
+            if carry is not None:
+                item, carry = carry, None
+            else:
+                item = self._inbox.get()
             if item is None:
                 return
             t_enq, src, dst, msg_type, payload, sess = item
             handler = self._handlers.get(dst)
             if handler is None:
                 continue
+            batch = None
+            shutdown = False
+            bh = self._batch_handlers.get((dst, msg_type))
+            if bh is not None:
+                # flush-window coalescing: drain the CONSECUTIVE run of
+                # same-typed queued messages from the SAME connection
+                # into one delivery (the read coordinator's dispatch
+                # unit; session-scoped so negotiated identities keep
+                # binding to the right connection). Stopping at the
+                # first non-matching message preserves ordering exactly.
+                batch = [(src, payload)]
+                while len(batch) < self.BATCH_DRAIN_MAX:
+                    try:
+                        nxt = self._inbox.get_nowait()
+                    except queue.Empty:
+                        break
+                    if nxt is None:
+                        shutdown = True  # serve the batch, then exit
+                        break
+                    if (nxt[2] != dst or nxt[3] != msg_type
+                            or nxt[5] != sess):
+                        carry = nxt
+                        break
+                    batch.append((nxt[1], nxt[4]))
             t0 = time.perf_counter()
             try:
                 # the dispatcher is the node's single handler thread, so
@@ -307,7 +356,10 @@ class TcpTransport:
                 # in-flight message arrived on (see current_session())
                 self._current_session = sess
                 with self.lock:
-                    handler(src, msg_type, payload)
+                    if batch is not None:
+                        bh(batch)
+                    else:
+                        handler(src, msg_type, payload)
             except Exception:  # noqa: BLE001 - a bad message must not
                 import traceback  # kill the dispatcher
 
@@ -320,9 +372,12 @@ class TcpTransport:
                         f"{msg_type}_exec_ms")
                     cnt[msg_type] = prof.counter(f"{msg_type}_count")
                 p_lat.set((t1 - t0) * 1000.0)
-                cnt[msg_type].increment()
+                cnt[msg_type].increment(1 if batch is None
+                                        else len(batch))
                 if PROFILER.enabled:
                     # toollet join point: queue delay + exec latency
                     # per task code (profiler.cpp:90-198)
                     PROFILER.observe(msg_type, (t0 - t_enq) * 1000.0,
                                      (t1 - t0) * 1000.0)
+            if shutdown:
+                return

@@ -102,6 +102,10 @@ def _normalize_filter_key(r) -> tuple:
 # candidate records gathered per device predicate dispatch
 PREDICATE_BATCH = 2048
 
+# point-location-cache miss sentinel (None is a valid cached value:
+# "definitively absent from the L1 runs")
+_POINT_MISS = object()
+
 
 def _after(key: bytes) -> bytes:
     """Immediate lexicographic successor of an exact key."""
@@ -183,6 +187,14 @@ class PartitionServer:
         # wholesale each second / store generation so it never pins
         # compacted-away blocks (see finish_scan_batch)
         self._plan_expired_cache: tuple = (None, {})
+        # (store-instance, generation, {key -> None | ("l1", blk,
+        # row)}): the point-read location cache — zipfian point traffic
+        # re-probes the same hot keys constantly, and a key's (block,
+        # row) location is pure over the immutable run set, so cache
+        # hits skip the run/block/row bisects entirely. Same
+        # invalidation discipline as _plan_cache (replaced wholesale on
+        # generation change).
+        self._point_cache = None
         self.metrics = METRICS.entity(
             "replica", f"{app_id}.{pidx}",
             {"table": str(app_id), "partition": str(pidx)})
@@ -195,6 +207,7 @@ class PartitionServer:
 
         self.slow_log = SlowQueryLog()
         self._scan_log_key = f"scan_batch.{app_id}.{pidx}"
+        self._get_log_key = f"point_get_batch.{app_id}.{pidx}"
         # env-driven remote manual compaction (one-shot trigger times)
         self._mc_trigger_seen = 0
         self._mc_running = False
@@ -242,16 +255,52 @@ class PartitionServer:
         self._read_throttle = None
         self._default_ttl = 0
         self._compaction_rules = None   # compiled rules_filter
+        self.install_engine(self.engine)
+
+    def install_engine(self, engine: StorageEngine) -> None:
+        """(Re)wire a storage engine into this server: write service,
+        auto-compaction filter context, and the store publish hook that
+        keeps the serving caches from pinning dead runs. Used at
+        __init__ and by every path that swaps the engine wholesale
+        (restore from backup, learner checkpoint apply)."""
+        self.engine = engine
+        ws = getattr(self, "write_service", None)
+        if ws is not None:
+            ws.engine = engine
         # auto-compaction runs with THIS partition's filter context
         # (TTL + stale-split + user rules), like every rocksdb
         # compaction runs the filter in the reference
-        self.engine.auto_compact_ctx = lambda: {
+        engine.auto_compact_ctx = lambda: {
             "default_ttl": self._default_ttl,
             "pidx": self.pidx,
             "partition_version": self.partition_version,
             "validate_hash": self.validate_partition_hash,
             "rules_filter": self._compaction_rules,
         }
+        engine.lsm.on_publish = self._on_store_publish
+
+    def _on_store_publish(self, live_paths: set) -> None:
+        """Store publish hook (every compaction publish, including the
+        write path's auto-compaction): evict cache entries keyed by
+        runs that just left the manifest, so idle-scan partitions stop
+        pinning pre-compaction fds/mmaps/device blocks/disk until GC.
+        Warm FLAVORS survive — the prefresher re-evaluates the NEW
+        blocks' masks in the background before the next scan pays the
+        round-trip."""
+        with self._mask_lock:
+            for mkey in [k for k in self._mask_cache
+                         if k[0][0] not in live_paths]:
+                del self._mask_cache[mkey]
+            for ckey in [k for k in self._device_block_cache
+                         if k[0] not in live_paths]:
+                del self._device_block_cache[ckey]
+        # per-second / per-generation caches: rebind wholesale (cheap to
+        # rebuild, and rebinding is safe against concurrent readers on
+        # the serving thread)
+        self._live_cache = {}
+        self._plan_cache = None
+        self._point_cache = None
+        self._plan_expired_cache = (None, {})
 
     # env key -> (derived attr, reset-to-default parsed value); used when
     # a FULL env set arrives and a previously-set key is now absent
@@ -348,20 +397,23 @@ class PartitionServer:
         satisfied — a restarted replica re-syncing a stale env must not
         re-compact (check_once_compact's trigger-vs-finish compare).
 
-        Why a thread is safe against concurrent serving: writes are
-        excluded by manual_compact's _write_lock; point reads and
+        Why a thread is safe against concurrent serving: writes race
+        only the brief freeze-flush and publish cut-over (manual_compact
+        merges OFF the write lock from an immutable snapshot and
+        revalidates the run set at publish); point reads and
         per-request scans snapshot the run list once and read
         memtable-before-runs (the safe order against the publish
-        sequence); the batch planner brackets its reads with the store
-        generation and falls back to per-request serving on a torn
-        read (plan_scan_batch); superseded runs are unlinked but their
-        handles are released by GC so in-flight readers — including
-        encrypted CipherFile stores — finish on the files they hold
-        (lsm._publish_l1); mask/device caches clear under _mask_lock.
-        Running it synchronously instead would hold the node lock
-        (timers + dispatch share it) for the whole compaction —
-        stalling FD beacons long enough to get the node declared
-        dead."""
+        sequence); the batch planners bracket their reads with the
+        store generation and fall back to per-key/per-request serving
+        on a torn read (plan_scan_batch / plan_get_batch); superseded
+        runs are unlinked but their handles are released by GC so
+        in-flight readers — including encrypted CipherFile stores —
+        finish on the files they hold (lsm._publish_l1); dead-run cache
+        entries evict through the store publish hook
+        (_on_store_publish). Running it synchronously instead would
+        hold the node lock (timers + dispatch share it) for the whole
+        compaction — stalling FD beacons long enough to get the node
+        declared dead."""
         if trigger_ts <= 0 or trigger_ts <= self._mc_trigger_seen:
             return
         if trigger_ts <= self.engine.lsm.compact_finish_time:
@@ -657,6 +709,399 @@ class PartitionServer:
             size += len(key) + len(data)
         self.cu.add_read(size)
         return resp
+
+    # ---- batched point reads (the point-read twin of the batched scan
+    # path: a flush of concurrent get / ttl / multi_get(sort_keys) /
+    # batch_get requests resolves overlay hits host-side, locates base
+    # keys through the per-generation point cache with ONE vectorized
+    # probe per touched block, gathers every needed value with one
+    # native call per block, and batches expired/CU accounting — the
+    # plan/serve/finish split mirrors plan_scan_batch so the node-level
+    # read coordinator can stack the gathers across partitions) --------
+
+    POINT_CACHE_CAP = 65536
+    # keys in one OP before its blocks are routed through the native
+    # page gather (the co-located multi_get/batch_get shape); below it
+    # a direct per-row heap slice beats the per-chunk ctypes call
+    POINT_GATHER_MIN = 16
+
+    def on_point_read_batch(self, ops) -> list:
+        """Solo-node form of the batched point-read path. `ops`:
+        [(op, args, partition_hash)] with op in get / ttl / multi_get
+        (explicit sort keys) / batch_get; returns one result per op,
+        byte-identical to the corresponding single-request handler."""
+        return self.serve_get_batch(self.plan_get_batch(ops))
+
+    def serve_get_batch(self, state) -> list:
+        """Solo-form phases 2+3: gather this batch's co-located values
+        (one native call per block via page.build_page) and assemble
+        responses. The node-level read coordinator splits these phases
+        apart to stack the gathers ACROSS partitions into one page."""
+        from pegasus_tpu.server.page import build_page
+
+        chunks = self.point_chunks(state)
+        page = None
+        if chunks:
+            page, _size, _last = build_page(
+                chunks, header_length(self.data_version))
+        return self.finish_get_batch(state, page, 0)
+
+    def plan_get_batch(self, ops, now: Optional[int] = None) -> dict:
+        """Phase 1: gates + key decomposition + location.
+
+        Per-op gates replicate the solo handlers exactly (per-request
+        throttle consumption, per-key split-staleness for batch_get —
+        batched through ops.predicates.host_key_hash_lo). Unique keys
+        resolve once whatever the hot-key overlap: overlay first
+        (memtable-before-runs, the safe order against a concurrent
+        flush/compaction publish), then the per-generation point cache,
+        then batched run/block bisects + vectorized block probes for
+        the misses. A publish racing the plan (generation moved) makes
+        the batch re-resolve every key through the per-key safe order
+        instead of trusting the possibly-torn snapshot."""
+        from pegasus_tpu.storage.memtable import TOMBSTONE
+
+        t0 = time.perf_counter()
+        now = epoch_now() if now is None else now
+        lsm = self.engine.lsm
+        gen = lsm.generation  # read BEFORE the overlay/run snapshots
+        results: list = [None] * len(ops)
+        op_keys: list = [None] * len(ops)
+        probes: List[Tuple[bytes, bool]] = []
+        capture_hks: list = []
+        wide = False  # any op wide enough for the native gather path
+        hc = self.hotkey_collectors["read"]
+        hc_running = hc.state.value != "stopped"
+        for i, (op, args, ph) in enumerate(ops):
+            if op in ("get", "ttl"):
+                gate = self._read_gate() or self._hash_gate(ph)
+                if gate:
+                    results[i] = (gate, b"") if op == "get" else (gate, 0)
+                    continue
+                if op == "get" and hc_running:
+                    capture_hks.append(restore_key(args)[0])
+                op_keys[i] = (args,)
+                probes.append((args, op == "get"))
+            elif op == "multi_get":
+                capture_hks.append(args.hash_key)
+                # split-staleness gate per op, like the stub applies to
+                # every solo wire read — a stale-routed multi_get must
+                # tell the client to re-resolve, not silently miss
+                gate = self._read_gate() or self._hash_gate(ph)
+                if gate:
+                    resp = MultiGetResponse()
+                    resp.error = gate
+                    results[i] = resp
+                    continue
+                if not args.hash_key:
+                    resp = MultiGetResponse()
+                    resp.error = int(StorageStatus.INVALID_ARGUMENT)
+                    results[i] = resp
+                    continue
+                keys = tuple(generate_key(args.hash_key, sk)
+                             for sk in args.sort_keys)
+                op_keys[i] = keys
+                want = not args.no_value
+                if want and len(keys) >= self.POINT_GATHER_MIN:
+                    wide = True
+                probes.extend((k, want) for k in keys)
+            elif op == "batch_get":
+                gate = self._read_gate()
+                if gate:
+                    resp = BatchGetResponse()
+                    resp.error = gate
+                    results[i] = resp
+                    continue
+                if self.validate_partition_hash and args.keys:
+                    # per-key staleness gate, one vectorized crc pass
+                    # for the whole request (parity: on_batch_get)
+                    from pegasus_tpu.ops.predicates import host_key_hash_lo
+
+                    lo = host_key_hash_lo(
+                        [fk.hash_key for fk in args.keys],
+                        [fk.sort_key for fk in args.keys])
+                    pv = np.uint32(self.partition_version & 0xFFFFFFFF)
+                    if np.any((lo & pv) != np.uint32(self.pidx)):
+                        resp = BatchGetResponse()
+                        resp.error = int(
+                            ErrorCode.ERR_PARENT_PARTITION_MISUSED)
+                        results[i] = resp
+                        continue
+                keys = tuple(generate_key(fk.hash_key, fk.sort_key)
+                             for fk in args.keys)
+                op_keys[i] = keys
+                if len(keys) >= self.POINT_GATHER_MIN:
+                    wide = True
+                probes.extend((k, True) for k in keys)
+            else:
+                # a ValueError so the RPC handler can answer
+                # INVALID_PARAMETERS instead of dying unreplied
+                raise ValueError(f"unknown point-read op {op!r}")
+        if capture_hks:
+            hc.capture(capture_hks)
+
+        memget = lsm.memtable.get
+        l0 = lsm.l0
+        runs = lsm.l1_runs
+        pc = self._point_cache
+        if pc is None or pc[0] is not lsm or pc[1] != gen:
+            pc = self._point_cache = (lsm, gen, {})
+        loc_cache = pc[2]
+        uniq: dict = {}
+        pending: list = []
+        for key, _nv in probes:
+            if key in uniq:
+                continue
+            hit = memget(key)
+            if hit is not None:
+                uniq[key] = (None if hit[0] is TOMBSTONE
+                             else ("ov", hit[0], hit[1]))
+                continue
+            resolved = False
+            for table in l0:
+                h = table.get(key)
+                if h is not None:
+                    uniq[key] = (None if h[0] is None
+                                 else ("ov", h[0], h[1]))
+                    resolved = True
+                    break
+            if resolved:
+                continue
+            ent = loc_cache.get(key, _POINT_MISS)
+            if ent is not _POINT_MISS:
+                uniq[key] = ent
+            else:
+                uniq[key] = None  # placeholder; _locate_points overwrites
+                pending.append(key)
+        if pending:
+            self._locate_points(runs, pending, uniq)
+        if lsm.generation != gen:
+            # a compaction/flush published mid-plan: the overlay misses
+            # above may have raced the cut-over (key consumed from the
+            # overlay before the run snapshot saw its new home) —
+            # re-resolve every key through the per-key safe order and
+            # cache nothing
+            for key in list(uniq):
+                hit = lsm.get(key)
+                uniq[key] = (None if hit is None
+                             else ("ov", hit[0], hit[1]))
+        elif pending and self._point_cache is pc:
+            for key in pending:
+                loc_cache[key] = uniq[key]
+            while len(loc_cache) > self.POINT_CACHE_CAP:
+                loc_cache.pop(next(iter(loc_cache)))
+        return {"ops": ops, "results": results, "op_keys": op_keys,
+                "uniq": uniq, "now": now, "t0": t0, "wide": wide}
+
+    def _locate_points(self, runs, keys: list, out: dict) -> None:
+        """Batch-locate keys in the non-overlapping L1 runs: bisect each
+        key to its run and block, then probe every touched block's
+        sorted key matrix with ONE vectorized searchsorted
+        (page.probe_rows). out[key] = ("l1", blk, row) | None (absent
+        or tombstone — L1 is the last level)."""
+        import bisect as _b
+
+        from pegasus_tpu.server.page import probe_rows
+
+        if not runs:
+            for key in keys:
+                out[key] = None
+            return
+        run_last = [r.last_key or b"" for r in runs]
+        by_block: "OrderedDict[tuple, list]" = OrderedDict()
+        for key in keys:
+            ri = _b.bisect_left(run_last, key)
+            if ri >= len(runs) or (runs[ri].first_key or b"") > key:
+                out[key] = None
+                continue
+            bi = runs[ri]._block_for_key(key)
+            if bi is None:
+                out[key] = None
+                continue
+            by_block.setdefault((ri, bi), []).append(key)
+        for (ri, bi), ks in by_block.items():
+            blk = runs[ri].read_block(bi)
+            for key, row in zip(ks, probe_rows(blk, ks)):
+                row = int(row)
+                if row < 0 or blk.is_tombstone(row):
+                    out[key] = None
+                else:
+                    out[key] = ("l1", blk, row)
+
+    def point_chunks(self, state) -> list:
+        """Phase 2: this batch's L1 value-gather work as [(blk,
+        ascending rows)] chunks for one page.build_page call (one
+        native gather per block). Only alive rows some op wants the
+        VALUE of are gathered; TTL-only probes read expire_ts straight
+        from the block column. The node-level coordinator concatenates
+        these chunks ACROSS partitions into a single page; `base` at
+        finish maps this state's ordinals into it."""
+        if not state["wide"]:
+            # the common all-singleton flush: nothing can reach the
+            # gather threshold, so skip the grouping pass entirely
+            state["page_pos"] = {}
+            state["chunk_rows"] = 0
+            return []
+        now = state["now"]
+        uniq = state["uniq"]
+        gmin = self.POINT_GATHER_MIN
+        by_block: "OrderedDict[int, list]" = OrderedDict()
+        blocks: dict = {}
+        seen: set = set()
+        for i, (op, args, _ph) in enumerate(state["ops"]):
+            keys = state["op_keys"][i]
+            # only wide ops (the co-located multi_get/batch_get shape)
+            # reach the native gather: a flush of independent gets
+            # scatters 1-2 rows per block, where a direct heap slice
+            # beats the per-chunk ctypes call
+            if (state["results"][i] is not None or keys is None
+                    or len(keys) < gmin or op == "ttl"
+                    or (op == "multi_get" and args.no_value)):
+                continue
+            for key in keys:
+                if key in seen:
+                    continue
+                seen.add(key)
+                ent = uniq.get(key)
+                if not ent or ent[0] != "l1":
+                    continue
+                _tag, blk, row = ent
+                # wide ops touch many rows per block: one per-second
+                # vectorized alive mask (shared with the scan path's
+                # prepare_serve cache) beats per-row scalar checks
+                if not blk.alive_mask(now)[row]:
+                    continue  # expired rows are never gathered
+                bid = id(blk)
+                blocks[bid] = blk
+                by_block.setdefault(bid, []).append((row, key))
+        chunks = []
+        pos = 0
+        page_pos: dict = {}
+        for bid, entries in by_block.items():
+            entries.sort()
+            rows = np.fromiter((r for r, _k in entries), dtype=np.int64,
+                               count=len(entries))
+            for j, (_r, key) in enumerate(entries):
+                page_pos[key] = pos + j
+            chunks.append((blocks[bid], rows))
+            pos += len(entries)
+        state["page_pos"] = page_pos
+        state["chunk_rows"] = pos
+        return chunks
+
+    def finish_get_batch(self, state, page=None, base: int = 0) -> list:
+        """Phase 3: assemble per-op responses byte-identical to the
+        solo handlers, with batched expired/CU accounting (one counter
+        touch per flush). `page`/`base`: the (possibly cross-partition)
+        build_page result and this state's first row in it."""
+        ops = state["ops"]
+        results = state["results"]
+        op_keys = state["op_keys"]
+        uniq = state["uniq"]
+        now = state["now"]
+        page_pos = state.get("page_pos") or {}
+        dv = self.data_version
+        hdr = header_length(dv)
+        expired_total = 0
+        cu_total = 0
+
+        def lookup(key, want_value):
+            """(found, data, ets) with solo-handler TTL semantics."""
+            nonlocal expired_total
+            ent = uniq.get(key)
+            if ent is None:
+                return False, b"", 0
+            if ent[0] == "ov":
+                _t, value, ets = ent
+                if check_if_ts_expired(now, ets):
+                    expired_total += 1
+                    return False, b"", 0
+                return True, (extract_user_data(dv, value)
+                              if want_value else b""), ets
+            _t, blk, row = ent
+            # per-second TTL mask reuse: when the SCAN path already
+            # built this block's alive mask for the current second
+            # (Block.alive_mask caches one per second), a point probe
+            # reads one cell of it instead of re-deriving expiry
+            cmp = getattr(blk, "_cmp", None)  # unset slot on cold blocks
+            if cmp is not None and cmp[0] == now:
+                alive = bool(cmp[1][row])
+                ets = int(blk.expire_ts[row])
+            else:
+                ets = int(blk.expire_ts[row])
+                alive = not check_if_ts_expired(now, ets)
+            if not alive:
+                expired_total += 1
+                return False, b"", 0
+            if not want_value:
+                return True, b"", ets
+            pos = page_pos.get(key)
+            if pos is not None:
+                return True, page.value_at(base + pos), ets
+            # sparse block: direct header-stripped heap slice (same
+            # bytes as extract_user_data over Block.value_at)
+            vo = blk.value_offs
+            heap = blk.value_heap
+            v0 = int(vo[row]) + hdr
+            v1 = int(vo[row + 1])
+            data = (heap[v0:v1].tobytes()
+                    if isinstance(heap, np.ndarray) else heap[v0:v1])
+            return True, data, ets
+
+        out = []
+        for i, (op, args, _ph) in enumerate(ops):
+            if results[i] is not None:
+                out.append(results[i])
+                continue
+            if op == "get":
+                key = op_keys[i][0]
+                found, data, _ets = lookup(key, True)
+                if not found:
+                    out.append((int(StorageStatus.NOT_FOUND), b""))
+                else:
+                    cu_total += cu_units(len(key) + len(data))
+                    out.append((int(StorageStatus.OK), data))
+            elif op == "ttl":
+                found, _data, ets = lookup(op_keys[i][0], False)
+                if not found:
+                    out.append((int(StorageStatus.NOT_FOUND), 0))
+                else:
+                    out.append((int(StorageStatus.OK),
+                                (ets - now) if ets > 0 else -1))
+            elif op == "multi_get":
+                resp = MultiGetResponse()
+                want = not args.no_value
+                size = 0
+                for sk, key in zip(args.sort_keys, op_keys[i]):
+                    found, data, _ets = lookup(key, want)
+                    if not found:
+                        continue
+                    resp.kvs.append(KeyValue(sk, data))
+                    size += len(sk) + len(data)
+                cu_total += cu_units(size)
+                resp.error = int(StorageStatus.OK)
+                out.append(resp)
+            else:  # batch_get
+                resp = BatchGetResponse()
+                size = 0
+                for fk, key in zip(args.keys, op_keys[i]):
+                    found, data, _ets = lookup(key, True)
+                    if not found:
+                        continue
+                    resp.data.append(FullData(fk.hash_key, fk.sort_key,
+                                              data))
+                    size += len(key) + len(data)
+                cu_total += cu_units(size)
+                out.append(resp)
+        if expired_total:
+            self._abnormal_reads.increment(expired_total)
+        self.cu.add_read_units(cu_total)
+        elapsed_ms = (time.perf_counter() - state["t0"]) * 1000.0
+        if elapsed_ms >= self.slow_log.threshold_ms:
+            self.slow_log.observe_simple(
+                self._get_log_key, elapsed_ms,
+                {"ops": len(ops), "keys": len(uniq)})
+        return out
 
     # ---- ranged reads (the device-batched hot path) -------------------
 
@@ -1844,22 +2289,32 @@ class PartitionServer:
                        rules_filter=None) -> None:
         """Parity: pegasus_manual_compact_service (manual CompactRange).
         Defaults come from the table's app-envs (`default_ttl`,
-        `user_specified_compaction`) unless overridden."""
+        `user_specified_compaction`) unless overridden.
+
+        The writer critical section is NARROW: the overlay is frozen
+        with one flush under _write_lock, the multi-second merge runs
+        from that immutable snapshot with writes flowing, and
+        _write_lock is retaken only for the publish cut-over (with
+        lsm run-set revalidation inside _publish_l1) — so a write
+        arriving mid-compaction no longer wedges transport dispatch
+        and FD beacons for the whole merge. engine.compact_lock
+        serializes compactions; the write path's auto-compaction
+        skips its trigger while this runs (the manual run covers it).
+        Cache eviction for the superseded runs happens through the
+        store's publish hook (_on_store_publish)."""
         if default_ttl is None:
             default_ttl = self._default_ttl
         if rules_filter is None:
             rules_filter = self._compaction_rules
-        with self._write_lock:
+        with self.engine.compact_lock:
+            with self._write_lock:
+                # freeze the overlay: post-freeze writes land in the
+                # fresh memtable / newer L0s, which the publish leaves
+                # untouched (they keep shadowing the merged base)
+                self.engine.flush()
             self.engine.manual_compact(
                 default_ttl=default_ttl, pidx=self.pidx,
                 partition_version=self.partition_version,
                 validate_hash=self.validate_partition_hash,
-                rules_filter=rules_filter)
-            # the old L1 files are gone; their cached device blocks and
-            # static masks can never hit again — drop them instead of
-            # pinning dead HBM/host memory. Warm FLAVORS survive: the
-            # prefresher uses them to evaluate the new blocks' masks in
-            # the background before the next scan pays the round-trip.
-            with self._mask_lock:
-                self._device_block_cache.clear()
-                self._mask_cache.clear()
+                rules_filter=rules_filter,
+                publish_lock=self._write_lock)

@@ -58,11 +58,14 @@ class _Handler(BaseHTTPRequestHandler):
         self._reply(200, content_md5=hashlib.md5(data).hexdigest())
 
     def do_GET(self) -> None:
+        # a path-traversal attempt (LocalBlockService._abs raises
+        # ValueError) is a BAD REQUEST on every verb, never an
+        # uncaught traceback that kills the connection
         if self.path.startswith("/blob/"):
             p = self._path("/blob/")
-            if not self.store.exists(p):
-                return self._reply(404)
             try:
+                if not self.store.exists(p):
+                    return self._reply(404)
                 data, digest = self.store.read_file_with_md5(p)
             except ValueError:
                 return self._reply(400)
@@ -72,15 +75,21 @@ class _Handler(BaseHTTPRequestHandler):
                 return self._reply(500)
             return self._reply(200, data, content_md5=digest)
         if self.path.startswith("/list/"):
-            names = self.store.list_dir(self._path("/list/"))
+            try:
+                names = self.store.list_dir(self._path("/list/"))
+            except ValueError:
+                return self._reply(400)
             return self._reply(200, json.dumps(names).encode())
         self._reply(404)
 
     def do_HEAD(self) -> None:
         if not self.path.startswith("/blob/"):
             return self._reply(404)
-        self._reply(200 if self.store.exists(self._path("/blob/"))
-                    else 404)
+        try:
+            found = self.store.exists(self._path("/blob/"))
+        except ValueError:
+            return self._reply(400)
+        self._reply(200 if found else 404)
 
     def do_DELETE(self) -> None:
         if not self.path.startswith("/blob/"):
@@ -121,7 +130,10 @@ def main() -> None:
 
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("--root", required=True)
-    ap.add_argument("--host", default="0.0.0.0")
+    # loopback by default: the daemon is unauthenticated, so exposing
+    # backup/bulk-load data on all interfaces must be an explicit
+    # operator choice (--host 0.0.0.0)
+    ap.add_argument("--host", default="127.0.0.1")
     ap.add_argument("--port", type=int, default=8950)
     args = ap.parse_args()
     srv = BlobServer(args.root, args.host, args.port)

@@ -73,6 +73,15 @@ class StorageEngine:
         self.memtable_flush_trigger = 100_000  # records
         self.auto_compact = True
         self.auto_compact_ctx = None  # server installs its filter context
+        # serializes compactions: the env-triggered manual path holds it
+        # across its (unlocked) merge; the write path's auto-compaction
+        # try-acquires and SKIPS when a manual run is in flight (the
+        # running compaction covers the trigger) — blocking there would
+        # deadlock write-lock->compact-lock against the manual path's
+        # compact-lock->write-lock publish ordering
+        import threading as _threading
+
+        self.compact_lock = _threading.Lock()
 
         # flush/compaction event metrics (parity: pegasus_event_listener)
         from pegasus_tpu.utils.metrics import METRICS
@@ -132,8 +141,14 @@ class StorageEngine:
             return
         self.flush()
         if self.auto_compact and self.lsm.should_compact():
-            ctx = self.auto_compact_ctx() if self.auto_compact_ctx else {}
-            self.manual_compact(**ctx)
+            if not self.compact_lock.acquire(blocking=False):
+                return  # manual compaction in flight covers this trigger
+            try:
+                ctx = (self.auto_compact_ctx() if self.auto_compact_ctx
+                       else {})
+                self.manual_compact(**ctx)
+            finally:
+                self.compact_lock.release()
 
     def flush(self) -> bool:
         """Memtable -> durable L0 SST stamped with the decree watermark."""
@@ -248,7 +263,8 @@ class StorageEngine:
 
     def _manual_compact_bulk(self, now_s: int, default_ttl: int,
                              pidx: int, partition_version: int,
-                             do_validate: bool, operations) -> None:
+                             do_validate: bool, operations,
+                             publish_lock=None) -> None:
         """Block-level compaction over a pure-L1 store.
 
         Windowed: load a window of blocks, evaluate every window miss in
@@ -268,7 +284,12 @@ class StorageEngine:
         eval_device = choose_eval_device(workload=rules_workload(operations))
         entries = self.lsm.bulk_compact_entries()
         meta = {
-            "last_flushed_decree": self.last_committed_decree,
+            # snapshot mode: the output only covers decrees flushed at
+            # freeze time — claiming last_committed would make boot skip
+            # the WAL frames of writes that raced the merge
+            "last_flushed_decree": (
+                self.last_flushed_decree if publish_lock is not None
+                else self.last_committed_decree),
             "data_version": self.data_version,
             "manual_compact_finish_time": epoch_now(),
         }
@@ -307,19 +328,26 @@ class StorageEngine:
 
         self.lsm.bulk_compact_rewrite(
             results(), meta, ttl_may_change=ttl_may_change,
-            patch_headers=self.values_carry_expire_header)
+            patch_headers=self.values_carry_expire_header,
+            publish_lock=publish_lock)
 
     def manual_compact(self, default_ttl: int = 0, pidx: int = 0,
                        partition_version: int = -1,
                        validate_hash: bool = False,
                        rules_filter=None,
-                       now: Optional[int] = None) -> None:
+                       now: Optional[int] = None,
+                       publish_lock=None) -> None:
         """Full compaction with the device TTL/stale-split filter.
 
         `rules_filter(keys, expire_ts, now) -> (drop, new_ets)` is the
         optional user-specified compaction hook (compaction_rules.py),
         applied after the default-TTL rewrite, before expiry — matching the
         reference's Filter() ordering (key_ttl_compaction_filter.h:71-90).
+
+        `publish_lock` (narrow-critical-section mode): the caller froze
+        the memtable with a flush and holds engine.compact_lock; the
+        merge runs over the immutable file snapshot with writes flowing
+        and the lock is taken only for the publish cut-over.
         """
         now_s = epoch_now() if now is None else now
         # pv<0 / pidx>pv -> no stale-split dropping (keep), per
@@ -338,7 +366,8 @@ class StorageEngine:
             self._compact_with_epilogue(
                 lambda: self._manual_compact_bulk(
                     now_s, default_ttl, pidx, partition_version,
-                    do_validate, operations))
+                    do_validate, operations, publish_lock=publish_lock),
+                advance_watermark=publish_lock is None)
             return
 
         def record_filter(keys: List[bytes], ets: List[int]):
@@ -385,22 +414,37 @@ class StorageEngine:
             lambda: self.lsm.compact(
                 record_filter=record_filter,
                 patch_headers=self.values_carry_expire_header,
+                publish_lock=publish_lock,
                 meta={
-                    "last_flushed_decree": self.last_committed_decree,
+                    # see _manual_compact_bulk: snapshot mode covers
+                    # only the freeze-time watermark
+                    "last_flushed_decree": (
+                        self.last_flushed_decree
+                        if publish_lock is not None
+                        else self.last_committed_decree),
                     "data_version": self.data_version,
                     "manual_compact_finish_time": epoch_now(),
-                }))
+                }),
+            advance_watermark=publish_lock is None)
 
-    def _compact_with_epilogue(self, body) -> None:
+    def _compact_with_epilogue(self, body,
+                               advance_watermark: bool = True) -> None:
         """Shared post-compaction bookkeeping for both compaction paths:
-        advance the flushed watermark (everything committed is now in the
-        SSTs), truncate the WAL, and record metrics."""
+        advance the flushed watermark (everything committed is now in
+        the SSTs), truncate the WAL, and record metrics.
+
+        `advance_watermark=False` (snapshot-mode compaction): writes
+        flowed DURING the merge, so committed > covered — the freeze
+        flush already advanced the watermark and truncated the WAL for
+        everything the compaction merged, and the newer writes' WAL
+        frames must survive for crash recovery."""
         import time as _time
 
         t0 = _time.perf_counter()
         body()
-        self.last_flushed_decree = self.last_committed_decree
-        self.wal.truncate()
+        if advance_watermark:
+            self.last_flushed_decree = self.last_committed_decree
+            self.wal.truncate()
         self._ev_compact_count.increment()
         self._ev_compact_ms.set((_time.perf_counter() - t0) * 1000.0)
         self._ev_compact_bytes.increment(sum(

@@ -69,8 +69,16 @@ class LSMStore:
         # last manual-compaction finish time (pegasus-epoch seconds),
         # persisted in the manifest INDEPENDENTLY of the run set so an
         # all-tombstone compaction (zero surviving runs) still records
-        # completion — env-trigger staleness checks depend on it
+        # completion — env-trigger staleness checks depend on it.
+        # Recorded AT PUBLISH (with the manifest write), never at merge
+        # start: a failed mid-run compaction must not make a
+        # re-delivered env trigger look satisfied.
         self.compact_finish_time = 0
+        # publish hook: called with the live L1 path set after every
+        # compaction publish, so cache owners (PartitionServer) evict
+        # entries keyed by runs that just left the manifest instead of
+        # pinning dead fds/mmaps/HBM until GC
+        self.on_publish: Optional[Callable[[set], None]] = None
         self._load_existing()
 
     # ---- files --------------------------------------------------------
@@ -272,12 +280,22 @@ class LSMStore:
         record_filter: Optional[Callable[..., np.ndarray]] = None,
         meta: Optional[dict] = None,
         patch_headers: bool = False,
+        publish_lock=None,
     ) -> None:
         """Full compaction as a sequence of BOUNDED range steps.
 
-        One merged pass over memtable + L0 + L1 runs; output runs are
+        One merged pass over the overlay + L1 runs; output runs are
         size-capped (`l1_run_capacity`), so no monolithic rewrite and a
         predictable working set per step — the manual CompactRange shape.
+
+        `publish_lock=None` (legacy mode): the caller holds the writer
+        lock for the whole merge; memtable + live L0 + L1 merge and the
+        overlay resets at publish. `publish_lock` set (snapshot mode —
+        the narrow critical section): the caller froze the memtable
+        with a flush, the merge runs over the IMMUTABLE L0/L1 snapshot
+        with writes flowing, and the lock is taken only for the publish
+        cut-over — post-snapshot writes (fresh memtable, newer L0
+        flushes) survive untouched and keep shadowing the merged base.
 
         `record_filter(keys: List[bytes], expire_ts: List[int]) ->
         (drop_mask, new_expire)` is the device TTL/compaction-rule seam
@@ -286,11 +304,17 @@ class LSMStore:
         (jax dispatch is asynchronous — only materialization blocks).
         Tombstones always drop (bottommost).
         """
-        if meta and "manual_compact_finish_time" in meta:
-            # recorded before the manifest publish so it persists even
-            # when zero runs survive
-            self.compact_finish_time = meta["manual_compact_finish_time"]
-        merged = self.iterate()
+        runs_snap = list(self.l1_runs)
+        if publish_lock is not None:
+            l0_snap = list(self.l0)
+            sources: List[Iterator[Record]] = [
+                t.iterate() for t in l0_snap]
+            if runs_snap:
+                sources.append(_chain_runs(runs_snap, b"", None, False))
+            merged = _merge(sources)
+        else:
+            l0_snap = None
+            merged = self.iterate()
         new_runs: List[SSTable] = []
         writer: Optional[SSTableWriter] = None
         written_in_run = 0
@@ -373,35 +397,84 @@ class LSMStore:
             writer.finish()
             new_runs.append(SSTable(writer.path))
 
-        self._publish_l1(new_runs, reset_overlay=True)
+        self._publish_l1(new_runs, consumed_l0=l0_snap,
+                         old_runs=runs_snap, publish_lock=publish_lock,
+                         mcft=(meta or {}).get(
+                             "manual_compact_finish_time", 0))
 
     def _publish_l1(self, new_runs: List[SSTable],
-                    reset_overlay: bool) -> None:
-        """Swap in a freshly-compacted L1: manifest first (atomic), then
-        remove inputs — boot cleans up either crash window. Both
+                    consumed_l0: Optional[List[SSTable]] = None,
+                    old_runs: Optional[List[SSTable]] = None,
+                    publish_lock=None, mcft: int = 0) -> None:
+        """Swap in a freshly-compacted L1 under `publish_lock` (None =
+        the caller already excludes writers): manifest first (atomic),
+        then remove inputs — boot cleans up either crash window. Both
         compaction paths share this so the crash-safety ordering lives
-        in exactly one place. `reset_overlay` also clears memtable+L0
-        (merge compaction consumed them; the bulk path never touches
-        them)."""
-        self._write_manifest([os.path.basename(t.path) for t in new_runs])
-        old_runs = self.l1_runs
-        self.l1_runs = new_runs
-        self.generation += 1
+        in exactly one place.
+
+        consumed_l0=None: the merge consumed the LIVE overlay (caller
+        held the writer lock throughout) — memtable and L0 reset
+        wholesale. consumed_l0=[...]: snapshot mode — exactly those L0
+        tables leave; the memtable and any newer L0 flushes
+        (post-snapshot writes) survive and keep shadowing the new base.
+        old_runs: the L1 snapshot the merge consumed, revalidated
+        against the live list under the lock — compactions are
+        serialized (engine.compact_lock), so a mismatch means a torn
+        merge whose output must not publish.
+        mcft: manual-compaction finish time, recorded HERE (with the
+        manifest) so a failed mid-run compaction never satisfies a
+        re-delivered env trigger."""
+        import contextlib
+
+        lock = publish_lock if publish_lock is not None \
+            else contextlib.nullcontext()
         old_l0: List[SSTable] = []
-        if reset_overlay:
-            old_l0, self.l0 = self.l0, []
-            self.memtable = Memtable()
-        # Input files are unlinked now (crash-safe: the manifest no
-        # longer names them) but their HANDLES are released by GC, not
-        # closed here: a reader admitted before the swap may still be
-        # serving from these runs (the env-triggered compaction thread
-        # publishes concurrently with serving), and on encrypted stores
-        # a hard close() would yank the CipherFile out from under its
-        # next read_block. POSIX keeps unlinked-but-open files readable;
-        # the refcount drops to zero as soon as the last in-flight scan
-        # state / superseded plan cache lets go.
-        for t in old_l0 + old_runs:
-            os.remove(t.path)
+        with lock:
+            if old_runs is not None and \
+                    [id(t) for t in self.l1_runs] != \
+                    [id(t) for t in old_runs]:
+                for t in new_runs:
+                    try:
+                        t.close()
+                        os.remove(t.path)
+                    except OSError:
+                        pass
+                raise RuntimeError(
+                    "concurrent L1 publish detected; compaction output "
+                    "discarded")
+            if mcft:
+                self.compact_finish_time = mcft
+            self._write_manifest([os.path.basename(t.path)
+                                  for t in new_runs])
+            superseded = self.l1_runs
+            self.l1_runs = new_runs
+            self.generation += 1
+            if consumed_l0 is None:
+                old_l0, self.l0 = self.l0, []
+                self.memtable = Memtable()
+            elif consumed_l0:
+                consumed = {id(t) for t in consumed_l0}
+                self.l0 = [t for t in self.l0
+                           if id(t) not in consumed]
+                old_l0 = list(consumed_l0)
+            # Input files are unlinked now (crash-safe: the manifest no
+            # longer names them) but their HANDLES are released by GC,
+            # not closed here: a reader admitted before the swap may
+            # still be serving from these runs (the env-triggered
+            # compaction thread publishes concurrently with serving),
+            # and on encrypted stores a hard close() would yank the
+            # CipherFile out from under its next read_block. POSIX
+            # keeps unlinked-but-open files readable; the refcount
+            # drops to zero as soon as the last in-flight scan state /
+            # superseded plan cache lets go. Unlinking INSIDE the lock
+            # keeps checkpoint's file-copy walk (which takes the same
+            # lock) from racing the removals.
+            for t in old_l0 + superseded:
+                os.remove(t.path)
+        hook = self.on_publish
+        if hook is not None:
+            # cache owners evict entries keyed by the dead runs
+            hook({t.path for t in new_runs})
 
     # ---- bulk block-level compaction (the GB/s path) -------------------
 
@@ -425,7 +498,8 @@ class LSMStore:
 
     def bulk_compact_rewrite(self, per_block, meta,
                              ttl_may_change: bool,
-                             patch_headers: bool = False) -> None:
+                             patch_headers: bool = False,
+                             publish_lock=None) -> None:
         """Rewrite the L1 level from precomputed per-block filter results.
 
         `per_block`: [(run, idx, blk, drop, new_ets)] in key order (drop
@@ -435,13 +509,15 @@ class LSMStore:
         are rebuilt with numpy gathers — the value heap survivor bytes
         via one boolean-repeat mask, expire_ts headers patched with
         scatter stores — so no per-record Python runs at any drop
-        rate."""
+        rate. The rewrite never touches the memtable/L0 (eligibility
+        requires them empty at snapshot), so with `publish_lock` the
+        whole disk pass runs with writes flowing and the lock is taken
+        only for the publish cut-over."""
         import concurrent.futures as _cf
 
         from pegasus_tpu.storage.sstable import SSTable, SSTableWriter
 
-        if meta and "manual_compact_finish_time" in meta:
-            self.compact_finish_time = meta["manual_compact_finish_time"]
+        runs_snap = list(self.l1_runs)
         # finish() = flush + fsync + rename + dir-fsync — ~half the
         # wall time of a disk-bound compaction. Filled runs finish on a
         # helper thread (fsync releases the GIL) while the main thread
@@ -579,8 +655,12 @@ class LSMStore:
                     except Exception:  # noqa: BLE001 - best-effort
                         pass
         # memtable/L0 are untouched by construction
-        # (bulk_compact_eligible requires them empty)
-        self._publish_l1(new_runs, reset_overlay=False)
+        # (bulk_compact_eligible requires them empty at snapshot time;
+        # writes that arrived since stay in the live overlay)
+        self._publish_l1(new_runs, consumed_l0=[], old_runs=runs_snap,
+                         publish_lock=publish_lock,
+                         mcft=(meta or {}).get(
+                             "manual_compact_finish_time", 0))
 
 
 class _HeapEntry:

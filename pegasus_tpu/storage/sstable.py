@@ -67,12 +67,13 @@ class Block:
 
     __slots__ = ("keys", "key_len", "expire_ts", "hash_lo", "flags",
                  "value_offs", "value_heap", "_key_list", "_gets",
-                 "_nat", "_cmp")
+                 "_nat", "_cmp", "_probe")
 
     def __init__(self, keys, key_len, expire_ts, hash_lo, flags, value_offs,
                  value_heap):
         self._key_list = None
         self._gets = 0
+        self._probe = None  # point-probe entry table (page.probe_nat)
         self.keys = keys              # uint8[N, W]
         self.key_len = key_len        # int32[N]
         self.expire_ts = expire_ts    # uint32[N]
@@ -315,7 +316,12 @@ class SSTableWriter:
 class SSTable:
     """Reader with an in-memory index and a small block cache."""
 
-    def __init__(self, path: str, cache_blocks: int = 64) -> None:
+    def __init__(self, path: str, cache_blocks: int = 256) -> None:
+        # cache_blocks raised 64->256 for the point-read hot path: a
+        # decoded Block is zero-copy numpy views over the mmap (only
+        # encrypted stores pay real bytes), but an evicted block loses
+        # its lazily-built probe/key-list tables — zipfian point traffic
+        # over a ~256k-record run was thrashing exactly that
         import io as _io
         import mmap as _mmap
 

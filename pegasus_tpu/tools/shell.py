@@ -1372,10 +1372,9 @@ def _dispatch(args, box, out) -> int:
         t = box.create_table(new_name, meta["partition_count"])
         for p_ in t.all_partitions():
             p_.engine.close()
-            p_.engine = be.restore_partition(
+            p_.install_engine(be.restore_partition(
                 args.backup_id, meta["app_id"], p_.pidx,
-                p_.engine.data_dir)
-            p_.write_service.engine = p_.engine
+                p_.engine.data_dir))
         print(f"OK: restored into {new_name}", file=out)
     return 0
 

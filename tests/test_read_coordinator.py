@@ -1,0 +1,489 @@
+"""Batched point-read path tests: the read coordinator and its
+supporting pieces (vectorized block probes, per-generation location
+cache, native co-located gathers, transport flush-window dispatch) —
+plus the compaction-narrowing / cache-eviction satellites that keep the
+batched caches honest across publishes.
+
+The load-bearing regression: every batched result must be
+BYTE-IDENTICAL to the corresponding single-request handler.
+"""
+
+import threading
+import time
+
+import pytest
+
+from pegasus_tpu.base.key_schema import generate_key, key_hash_parts
+from pegasus_tpu.server import (
+    BatchGetRequest,
+    FullKey,
+    MultiGetRequest,
+    PartitionServer,
+)
+from pegasus_tpu.utils.errors import StorageStatus
+
+OK = int(StorageStatus.OK)
+NOT_FOUND = int(StorageStatus.NOT_FOUND)
+
+
+@pytest.fixture
+def server(tmp_path):
+    s = PartitionServer(str(tmp_path / "p0"))
+    yield s
+    s.close()
+
+
+def norm(result):
+    """Canonical comparable form of any point-read result."""
+    if isinstance(result, tuple):
+        return result
+    if hasattr(result, "kvs"):
+        return (result.error,
+                [(kv.key, kv.value, kv.expire_ts_seconds)
+                 for kv in result.kvs])
+    return (result.error,
+            [(d.hash_key, d.sort_key, d.value) for d in result.data])
+
+
+def solo(server, op, args, ph=None):
+    if op == "get":
+        return server.on_get(args, partition_hash=ph)
+    if op == "ttl":
+        return server.on_ttl(args, partition_hash=ph)
+    if op == "multi_get":
+        return server.on_multi_get(args)
+    return server.on_batch_get(args)
+
+
+def load_mixed(server, n_hk=40, ttl_every=7):
+    """n_hk hash keys x 5 sort keys; every ttl_every-th record carries a
+     1-second TTL (expired by the time tests read), plus overlay rows
+    and a tombstone on top of the compacted base."""
+    for h in range(n_hk):
+        hk = b"user%04d" % h
+        for sk in range(5):
+            ttl = 1 if (h * 5 + sk) % ttl_every == 0 else 0
+            server.on_put(generate_key(hk, b"s%02d" % sk),
+                          b"val-%d-%d" % (h, sk), ttl_seconds=ttl)
+    server.flush()
+    server.manual_compact()
+    server.on_put(generate_key(b"user0001", b"s00"), b"overlaid")
+    server.on_put(generate_key(b"user9999", b"s00"), b"overlay-only")
+    server.on_remove(generate_key(b"user0002", b"s01"))
+    time.sleep(1.1)  # the 1s TTLs expire
+
+
+def mixed_ops(server):
+    ops = []
+    for h in (0, 1, 2, 3, 7, 500):
+        hk = b"user%04d" % h
+        key = generate_key(hk, b"s00")
+        ops.append(("get", key, None))
+        ops.append(("ttl", key, None))
+        ops.append(("multi_get",
+                    MultiGetRequest(hk, sort_keys=[b"s00", b"s01",
+                                                   b"s04", b"szz"]),
+                    None))
+        ops.append(("multi_get",
+                    MultiGetRequest(hk, sort_keys=[b"s02"],
+                                    no_value=True), None))
+        ops.append(("batch_get",
+                    BatchGetRequest([FullKey(hk, b"s%02d" % i)
+                                     for i in range(5)]), None))
+    ops.append(("get", generate_key(b"user9999", b"s00"), None))
+    ops.append(("get", generate_key(b"user0002", b"s01"), None))
+    return ops
+
+
+def test_batched_byte_identical_mixed(server):
+    load_mixed(server)
+    ops = mixed_ops(server)
+    expect = [norm(solo(server, op, args, ph)) for op, args, ph in ops]
+    got = [norm(r) for r in server.on_point_read_batch(ops)]
+    assert got == expect
+
+
+def test_batched_expired_ttl_and_abnormal_counting(server):
+    server.on_put(generate_key(b"hk", b"dead"), b"x", ttl_seconds=1)
+    server.on_put(generate_key(b"hk", b"live"), b"y")
+    server.flush()
+    server.manual_compact()
+    time.sleep(1.1)
+    before = server._abnormal_reads.value()
+    res = server.on_point_read_batch([
+        ("get", generate_key(b"hk", b"dead"), None),
+        ("get", generate_key(b"hk", b"live"), None),
+        ("ttl", generate_key(b"hk", b"dead"), None),
+    ])
+    assert [r[0] for r in res] == [NOT_FOUND, OK, NOT_FOUND]
+    assert res[1][1] == b"y"
+    assert server._abnormal_reads.value() == before + 2
+
+
+def test_hot_key_overlap_resolves_once(server):
+    load_mixed(server)
+    key = generate_key(b"user0003", b"s00")
+    ops = [("get", key, None)] * 10 + [("ttl", key, None)] * 5
+    want_get = server.on_get(key)
+    want_ttl = server.on_ttl(key)
+    got = server.on_point_read_batch(ops)
+    assert all(g == want_get for g in got[:10])
+    assert all(g == want_ttl for g in got[10:])
+
+
+def test_point_cache_invalidates_on_generation_change(server):
+    key = generate_key(b"gen", b"s0")
+    server.on_put(key, b"v1")
+    server.flush()
+    server.manual_compact()
+    assert server.on_point_read_batch([("get", key, None)])[0] == (OK,
+                                                                   b"v1")
+    # overwrite + republish: the cached (block, row) location is dead
+    server.on_put(key, b"v2")
+    server.flush()
+    server.manual_compact()
+    assert server.on_point_read_batch([("get", key, None)])[0] == (OK,
+                                                                   b"v2")
+
+
+def test_wide_multi_get_rides_native_gather(server):
+    """>= POINT_GATHER_MIN co-located sort keys: the build_page path."""
+    n = PartitionServer.POINT_GATHER_MIN * 4
+    for j in range(n):
+        server.on_put(generate_key(b"wide", b"s%04d" % j),
+                      b"v%0100d" % j)
+    server.flush()
+    server.manual_compact()
+    req = MultiGetRequest(b"wide",
+                          sort_keys=[b"s%04d" % j for j in range(n)])
+    state = server.plan_get_batch([("multi_get", req, None)])
+    chunks = server.point_chunks(state)
+    assert chunks and sum(len(r) for _b, r in chunks) == n
+    got = norm(server.on_point_read_batch([("multi_get", req, None)])[0])
+    assert got == norm(server.on_multi_get(req))
+
+
+def test_probe_handles_trailing_zero_keys(server):
+    """Zero-padded key-matrix probes must not confuse keys differing
+    only in trailing NUL bytes."""
+    twins = [b"k", b"k\x00", b"k\x00\x00", b"k\x00a"]
+    for i, sk in enumerate(twins):
+        server.on_put(generate_key(b"z", sk), b"tw%d" % i)
+    server.flush()
+    server.manual_compact()
+    ops = [("get", generate_key(b"z", sk), None) for sk in twins]
+    ops.append(("get", generate_key(b"z", b"k\x00\x00\x00"), None))
+    got = server.on_point_read_batch(ops)
+    assert got[:4] == [(OK, b"tw%d" % i) for i in range(4)]
+    assert got[4] == (NOT_FOUND, b"")
+
+
+def test_batched_vs_solo_during_overlay_and_l0(server):
+    """Unflushed memtable + L0 overlay served identically (newest
+    wins, tombstones hide)."""
+    for h in range(10):
+        server.on_put(generate_key(b"ov%d" % h, b"s"), b"base%d" % h)
+    server.flush()
+    server.manual_compact()
+    server.on_put(generate_key(b"ov1", b"s"), b"l0-new")
+    server.flush()  # L0, no compact
+    server.on_put(generate_key(b"ov2", b"s"), b"mem-new")
+    server.on_remove(generate_key(b"ov3", b"s"))
+    ops = [("get", generate_key(b"ov%d" % h, b"s"), None)
+           for h in range(10)]
+    expect = [solo(server, *op) for op in ops]
+    assert server.on_point_read_batch(ops) == expect
+
+
+def test_in_process_client_point_read_multi(tmp_path):
+    from pegasus_tpu.client import PegasusClient, Table
+
+    table = Table(str(tmp_path / "t"), app_id=1, partition_count=8)
+    client = PegasusClient(table)
+    try:
+        for i in range(400):
+            client.set(b"hk%04d" % (i // 4), b"s%d" % (i % 4),
+                       b"v%05d" % i)
+        table.flush_all()
+        table.manual_compact_all()
+        groups, expect = {}, {}
+        for i in range(0, 100, 3):
+            hk, sk = b"hk%04d" % (i // 4), b"s%d" % (i % 4)
+            ph = key_hash_parts(hk, sk)
+            pidx = ph % 8
+            groups.setdefault(pidx, []).append(
+                ("get", generate_key(hk, sk), ph))
+            expect.setdefault(pidx, []).append(client.get(hk, sk))
+        res = client.point_read_multi(groups)
+        assert res == expect
+    finally:
+        table.close()
+
+
+def test_transport_flush_window_batches_point_reads():
+    """TcpTransport.register_batch: consecutive same-type messages from
+    one connection deliver as a single batch; other types keep solo
+    dispatch and ordering."""
+    from pegasus_tpu.rpc.transport import TcpTransport
+
+    srv = TcpTransport(("127.0.0.1", 0), {})
+    name = "batched-node"
+    got = []                 # interleaved delivery order
+    release = threading.Event()
+    done = threading.Event()
+
+    def batch_handler(items):
+        got.append([p["i"] for _s, p in items])
+
+    def solo_handler(src, msg_type, payload):
+        if msg_type == "block":
+            release.wait(10)  # hold the dispatcher: the burst queues up
+            return
+        got.append((msg_type, payload["i"]))
+        if msg_type == "finish":
+            done.set()
+
+    srv.register(name, solo_handler)
+    srv.register_batch(name, "pread", batch_handler)
+    book = {name: srv.listen_addr}
+    cli = TcpTransport(None, book)
+    try:
+        cli.send("c", name, "block", {"i": -1})
+        for i in range(6):
+            cli.send("c", name, "pread", {"i": i})
+        cli.send("c", name, "other", {"i": 100})
+        cli.send("c", name, "pread", {"i": 6})
+        cli.send("c", name, "finish", {"i": -1})
+        deadline = time.monotonic() + 10
+        while srv._inbox.qsize() < 9 and time.monotonic() < deadline:
+            time.sleep(0.01)  # everything queued behind the block
+        release.set()
+        assert done.wait(10)
+        # the consecutive pread run coalesced into ONE batch; the
+        # non-batch message cut the window, and ordering held exactly
+        assert got == [[0, 1, 2, 3, 4, 5], ("other", 100), [6],
+                       ("finish", -1)]
+    finally:
+        cli.close()
+        srv.close()
+
+
+def test_cluster_client_point_read_multi(tmp_path):
+    from pegasus_tpu.tools.cluster import SimCluster
+
+    c = SimCluster(str(tmp_path), n_nodes=1)
+    try:
+        c.create_table("t", partition_count=4, replica_count=1)
+        cl = c.client("t")
+        cl.refresh_config()
+        for i in range(200):
+            cl.set(b"hk%03d" % (i // 2), b"s%d" % (i % 2), b"v%04d" % i)
+        c.loop.run_until_idle()
+        groups, expect = {}, {}
+        for i in range(0, 60, 5):
+            hk, sk = b"hk%03d" % (i // 2), b"s%d" % (i % 2)
+            ph = key_hash_parts(hk, sk)
+            pidx = ph % cl.partition_count
+            key = generate_key(hk, sk)
+            groups.setdefault(pidx, []).append(("get", key, ph))
+            expect.setdefault(pidx, []).append(cl.get(hk, sk))
+            groups[pidx].append(("ttl", key, ph))
+            expect[pidx].append(cl.ttl(hk, sk))
+        res = cl.point_read_multi(groups)
+        assert {p: [norm(r) for r in rs] for p, rs in res.items()} == \
+            {p: [norm(r) for r in rs] for p, rs in expect.items()}
+    finally:
+        c.close()
+
+
+def test_batched_split_staleness_gates(tmp_path):
+    """Every batched op applies the split-staleness gate the solo wire
+    path applies: a stale partition_hash (or stale-grouped batch_get)
+    must surface ERR_PARENT_PARTITION_MISUSED, never silent misses."""
+    from pegasus_tpu.utils.errors import ErrorCode
+
+    s = PartitionServer(str(tmp_path / "p0"), pidx=0, partition_count=4)
+    s.on_put(generate_key(b"hk", b"s"), b"v")
+    wrong_ph = s.pidx + 1  # (ph & 3) != 0
+    key = generate_key(b"hk", b"s")
+    req = MultiGetRequest(b"hk", sort_keys=[b"s"])
+    bad = int(ErrorCode.ERR_PARENT_PARTITION_MISUSED)
+    got = s.on_point_read_batch([
+        ("get", key, wrong_ph),
+        ("ttl", key, wrong_ph),
+        ("multi_get", req, wrong_ph),
+        ("batch_get", BatchGetRequest([FullKey(b"hk", b"s")]), None),
+    ])
+    assert got[0] == (bad, b"") and got[1] == (bad, 0)
+    assert got[2].error == bad
+    # batch_get's per-key vectorized gate: 'hk' only belongs to pidx 0
+    # if its crc says so — compare against the solo handler
+    assert got[3].error == s.on_batch_get(
+        BatchGetRequest([FullKey(b"hk", b"s")])).error
+    s.close()
+
+
+def test_rpc_batch_malformed_op_gets_definite_reply(tmp_path):
+    """A malformed op in client_read_batch must fail its own slot with
+    INVALID_PARAMETERS, not leave the whole node batch unreplied."""
+    from pegasus_tpu.tools.cluster import SimCluster
+    from pegasus_tpu.utils.errors import PegasusError
+
+    c = SimCluster(str(tmp_path), n_nodes=1)
+    try:
+        c.create_table("t", partition_count=2, replica_count=1)
+        cl = c.client("t")
+        cl.refresh_config()
+        cl.set(b"hk", b"s", b"v")
+        c.loop.run_until_idle()
+        ph = key_hash_parts(b"hk", b"s")
+        good_pidx = ph % 2
+        # a bogus op name in one partition's group
+        with pytest.raises(PegasusError):
+            cl.point_read_multi({good_pidx: [("frobnicate", b"x", None)]})
+        # and a well-formed batch afterwards still works
+        res = cl.point_read_multi(
+            {good_pidx: [("get", generate_key(b"hk", b"s"), ph)]})
+        assert res[good_pidx][0] == (OK, b"v")
+    finally:
+        c.close()
+
+
+# ---- satellite regressions ------------------------------------------
+
+
+def test_compact_finish_time_set_at_publish_not_merge_start(tmp_path):
+    from pegasus_tpu.storage.lsm import LSMStore
+
+    store = LSMStore(str(tmp_path / "sst"))
+    for i in range(10):
+        store.put(b"k%02d" % i, b"v")
+    store.flush(meta={})
+
+    def exploding_filter(keys, ets):
+        raise RuntimeError("mid-merge failure")
+
+    with pytest.raises(RuntimeError):
+        store.compact(record_filter=exploding_filter,
+                      meta={"manual_compact_finish_time": 12345})
+    assert store.compact_finish_time == 0, \
+        "a failed compaction must not satisfy its env trigger"
+    store.compact(meta={"manual_compact_finish_time": 12345})
+    assert store.compact_finish_time == 12345
+    store.close()
+
+
+def test_publish_evicts_dead_run_cache_entries(server):
+    for i in range(2000):
+        server.on_put(generate_key(b"hk%04d" % i, b"s"), b"v%d" % i)
+    server.flush()
+    server.manual_compact()
+    # populate mask/device/plan caches through a scan batch
+    from pegasus_tpu.server.types import GetScannerRequest
+
+    req = GetScannerRequest(start_key=b"", stop_key=b"",
+                            batch_size=50, one_page=True)
+    server.on_get_scanner_batch([req])
+    server.on_point_read_batch(
+        [("get", generate_key(b"hk0001", b"s"), None)])
+    assert server._mask_cache or server._device_block_cache
+    old_paths = {k[0][0] for k in server._mask_cache}
+    old_paths |= {k[0] for k in server._device_block_cache}
+    # rewrite the store: the old runs' cache entries must all go
+    server.on_put(generate_key(b"hk0001", b"s"), b"new")
+    server.flush()
+    server.manual_compact()
+    live = {t.path for t in server.engine.lsm.l1_runs}
+    assert all(k[0][0] in live for k in server._mask_cache)
+    assert all(k[0] in live for k in server._device_block_cache)
+    assert server._point_cache is None
+    assert not (old_paths & live)
+
+
+def test_writes_survive_concurrent_manual_compact(tmp_path):
+    """The narrow-critical-section satellite: writes flowing DURING a
+    manual compaction are acked, survive the publish, and stay
+    readable — and the compaction itself completes."""
+    s = PartitionServer(str(tmp_path / "p0"))
+    try:
+        for i in range(20000):
+            s.on_put(generate_key(b"base%06d" % i, b"s"), b"v%d" % i)
+        s.flush()
+        acked = {}
+        stop = threading.Event()
+        errors = []
+
+        def writer():
+            i = 0
+            try:
+                while not stop.is_set():
+                    hk = b"during%05d" % i
+                    if s.on_put(generate_key(hk, b"s"),
+                                b"w%d" % i) == OK:
+                        acked[hk] = b"w%d" % i
+                    i += 1
+            except Exception as exc:  # noqa: BLE001 - surfaced below
+                errors.append(repr(exc))
+
+        t = threading.Thread(target=writer)
+        t.start()
+        try:
+            s.manual_compact()
+            s.manual_compact()
+        finally:
+            stop.set()
+            t.join(timeout=30)
+        assert not errors, errors
+        assert acked, "writer never got a write through"
+        lost = [hk for hk, v in acked.items()
+                if s.on_get(generate_key(hk, b"s")) != (OK, v)]
+        assert not lost, f"{len(lost)} acked writes lost"
+        # base data survived both compactions too
+        assert s.on_get(generate_key(b"base000123", b"s")) == (OK,
+                                                               b"v123")
+    finally:
+        s.close()
+
+
+def test_blob_server_traversal_returns_400(tmp_path):
+    import http.client
+
+    from pegasus_tpu.storage.blob_server import BlobServer
+
+    srv = BlobServer(str(tmp_path / "root"))
+    try:
+        conn = http.client.HTTPConnection(srv.host, srv.port, timeout=5)
+        for verb, path in (("GET", "/blob/../../etc/passwd"),
+                           ("HEAD", "/blob/../../etc/passwd"),
+                           ("GET", "/list/../..")):
+            conn.request(verb, path)
+            resp = conn.getresponse()
+            resp.read()
+            assert resp.status == 400, (verb, path, resp.status)
+        # the connection survived (no traceback kill) and normal ops work
+        conn.request("PUT", "/blob/a/b", body=b"data")
+        assert conn.getresponse().status == 200
+        conn.request("GET", "/blob/a/b")
+        r = conn.getresponse()
+        assert r.status == 200 and r.read() == b"data"
+    finally:
+        srv.close()
+
+
+def test_geo_rejects_magic_prefixed_legacy_value():
+    """A legacy (headerless) index value that happens to start with the
+    packed-header magic must not inject garbage coordinates."""
+    from pegasus_tpu.geo.geo_client import (
+        _MAGIC,
+        _page_coords,
+        LatLngCodec,
+    )
+
+    codec = LatLngCodec()
+    # 16 bytes of 0xFF decode as huge/inf doubles -> out of range
+    legacy = _MAGIC + b"\xff" * 16 + b"|40.1|-74.2|payload"
+    values = [legacy]
+    coords, rows, packed = _page_coords(
+        values, codec, lambda i: values[i], 1)
+    assert coords is None or not packed[0], \
+        "magic-prefixed legacy value misparsed as packed header"
