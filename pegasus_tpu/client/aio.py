@@ -20,7 +20,12 @@ from typing import Optional, Sequence, Tuple
 
 
 class AsyncPegasusClient:
-    """Wraps any sync client (PegasusClient or ClusterClient-backed)."""
+    """Wraps any sync client (PegasusClient or ClusterClient-backed).
+
+    Robustness rides the wrapped client: end-to-end deadlines and the
+    jittered retry backoff run on the worker thread, so awaiting tasks
+    see the same typed ERR_TIMEOUT/ERR_BUSY surface as the sync API and
+    the event loop never blocks on a backoff sleep."""
 
     _FORWARDED = (
         "set", "get", "delete", "exist", "ttl", "incr",
@@ -30,10 +35,24 @@ class AsyncPegasusClient:
         "point_read_multi",
     )
 
-    def __init__(self, client, max_workers: int = 1) -> None:
+    def __init__(self, client, max_workers: int = 1,
+                 op_timeout_ms: Optional[float] = None) -> None:
+        """`op_timeout_ms`: per-op end-to-end deadline override applied
+        to the wrapped client (ClusterClient.op_timeout_ms); None keeps
+        the client_op_timeout_ms flag default."""
         import threading
 
         self._c = client
+        if op_timeout_ms is not None:
+            if not hasattr(client, "op_timeout_ms"):
+                # only the cluster client enforces deadlines; silently
+                # setting a dead attribute would leave the caller
+                # believing a bound is active when none is
+                raise TypeError(
+                    f"{type(client).__name__} does not support "
+                    "op_timeout_ms (deadlines are a ClusterClient "
+                    "feature)")
+            self._c.op_timeout_ms = op_timeout_ms
         self._lock = threading.Lock()
         self._pool = ThreadPoolExecutor(
             max_workers=max_workers,

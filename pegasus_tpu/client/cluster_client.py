@@ -21,6 +21,7 @@ socket).
 from __future__ import annotations
 
 import itertools
+import time
 from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence, Tuple
 
 from pegasus_tpu.base.key_schema import generate_key, key_hash_parts, restore_key
@@ -52,6 +53,7 @@ from pegasus_tpu.server.types import (
     SCAN_CONTEXT_ID_NOT_EXIST,
 )
 from pegasus_tpu.utils.errors import ErrorCode, PegasusError, StorageStatus
+from pegasus_tpu.utils.flags import FLAGS, define_flag
 
 _RETRYABLE = {
     int(ErrorCode.ERR_INVALID_STATE),
@@ -60,10 +62,19 @@ _RETRYABLE = {
     int(ErrorCode.ERR_OBJECT_NOT_FOUND),
     int(ErrorCode.ERR_TIMEOUT),
     int(ErrorCode.ERR_SPLITTING),
+    # overload shedding (transport dispatcher): BUSY means "come back
+    # after a backoff", exactly what the retry loop now does
+    int(ErrorCode.ERR_BUSY),
 }
 
 _OK = int(ErrorCode.ERR_OK)
 _MISROUTED = int(ErrorCode.ERR_PARENT_PARTITION_MISUSED)
+
+define_flag("pegasus.client", "client_op_timeout_ms", 3_600_000,
+            "end-to-end deadline for one client op, spanning every "
+            "retry; requests carry the absolute deadline so servers "
+            "can drop work its client stopped waiting for",
+            mutable=True)
 
 
 class ClusterClient:
@@ -77,12 +88,29 @@ class ClusterClient:
     def __init__(self, net, name: str, meta_addr, app_name: str,
                  pump: Callable[[], None],
                  max_retries: int = 6, pump_rounds: int = 50,
-                 auth=None) -> None:
+                 auth=None, op_timeout_ms: Optional[float] = None,
+                 clock: Optional[Callable[[], float]] = None,
+                 sleep: Optional[Callable[[float], None]] = None,
+                 backoff_seed: Optional[int] = None) -> None:
         """`auth`: (user, token) credentials from
         security.make_credentials — required when the cluster enforces
-        authentication."""
+        authentication.
+
+        `op_timeout_ms` overrides the client_op_timeout_ms flag: every
+        op gets ONE absolute deadline covering all its retries, stamped
+        into each request so servers can fast-fail abandoned work.
+        `clock` must be the same timebase the serving stubs read (wall
+        time.time for the TCP path — the default; the sim cluster
+        passes its epoch-anchored virtual clock). `sleep` is the retry
+        backoff's wait (sim passes a virtual-time advance)."""
+        from pegasus_tpu.utils.backoff import Backoff
+
         self.net = net
         self.name = name
+        self.op_timeout_ms = op_timeout_ms
+        self._clock = clock or time.time
+        self.backoff = Backoff(seed=backoff_seed,
+                               sleep=sleep or time.sleep)
         # one address or the whole meta group (rotated on timeout —
         # parity: the client's meta group_address failover)
         self.meta_addrs = ([meta_addr] if isinstance(meta_addr, str)
@@ -113,18 +141,31 @@ class ClusterClient:
             if rid in self._pending:
                 self._replies[rid] = payload
 
-    def _send_request(self, dst: str, msg_type: str, payload: dict) -> int:
+    def _send_request(self, dst: str, msg_type: str, payload: dict,
+                      deadline: Optional[float] = None) -> int:
         rid = next(self._rids)
         payload["rid"] = rid
+        if deadline is not None:
+            # absolute, on the cluster's shared timebase: the transport
+            # dispatcher and replica gates fast-fail work past it
+            payload["deadline"] = deadline
         self._pending.add(rid)
         self.net.send(self.name, dst, msg_type, payload)
         return rid
 
-    def _await(self, rid: int) -> Optional[dict]:
+    def _deadline(self) -> float:
+        ms = self.op_timeout_ms if self.op_timeout_ms is not None else \
+            FLAGS.get("pegasus.client", "client_op_timeout_ms")
+        return self._clock() + float(ms) / 1000.0
+
+    def _await(self, rid: int,
+               deadline: Optional[float] = None) -> Optional[dict]:
         try:
             for _ in range(self._pump_rounds):
                 if rid in self._replies:
                     return self._replies.pop(rid)
+                if deadline is not None and self._clock() > deadline:
+                    break  # the op's deadline lapsed; stop pumping
                 self._pump()
             return self._replies.pop(rid, None)
         finally:
@@ -151,12 +192,24 @@ class ClusterClient:
     def meta_addr(self) -> str:
         return self.meta_addrs[self._meta_i % len(self.meta_addrs)]
 
-    def refresh_config(self) -> None:
+    def refresh_config(self, deadline: Optional[float] = None) -> None:
+        """`deadline`: the CALLING op's remaining end-to-end deadline —
+        a refresh inside a retry loop must not mint itself a fresh full
+        window (the op would overrun its declared bound by up to 2x)."""
         last = None
-        for _ in range(len(self.meta_addrs)):
+        if deadline is None:
+            deadline = self._deadline()
+        for rotation in range(len(self.meta_addrs)):
+            if rotation:
+                if self._clock() > deadline:
+                    break  # out of time: surface the last rotation error
+                # pace the meta-group rotation: hammering the next
+                # member the instant the last timed out is how a
+                # failover turns into a refresh_config storm
+                self.backoff.sleep(rotation)
             rid = self._send_request(self.meta_addr, "query_config", {
-                "app_name": self.app_name})
-            reply = self._await(rid)
+                "app_name": self.app_name}, deadline=deadline)
+            reply = self._await(rid, deadline)
             if reply is None:
                 # this meta is down/partitioned: rotate to the next group
                 # member (a follower forwards to the leader)
@@ -182,18 +235,38 @@ class ClusterClient:
     # ---- request dispatch with refresh-on-error retry ------------------
 
     def _read(self, op: str, args: Any, pidx: int,
-              partition_hash: Optional[int] = None) -> Any:
+              partition_hash: Optional[int] = None,
+              deadline: Optional[float] = None) -> Any:
+        """`deadline`: inherited when this read is one leg of a larger
+        op (batch_get) — the outer op's single end-to-end bound governs,
+        never a freshly minted per-leg window."""
         self._ensure_config()
         last_err = int(ErrorCode.ERR_TIMEOUT)
+        if deadline is None:
+            deadline = self._deadline()
         for attempt in range(self._max_retries):
             if attempt:
-                try:
-                    self.refresh_config()
-                except PegasusError as e:
-                    # an unreachable meta burns this retry, it doesn't
-                    # abort the op: the cached config may still be right
-                    # (and the meta may heal before the next attempt)
-                    last_err = int(e.code)
+                if self._clock() > deadline:
+                    raise PegasusError(ErrorCode.ERR_TIMEOUT,
+                                       f"{op} deadline exceeded")
+                # backoff BEFORE the refresh: mid-failover zero-sleep
+                # retries burn every attempt in microseconds and storm
+                # the meta with refresh_config
+                self.backoff.sleep(attempt)
+                if last_err == int(ErrorCode.ERR_BUSY):
+                    # shed by an overloaded replica, not misrouted: the
+                    # config is still right — re-resolving would only
+                    # convert the read storm into a meta query storm
+                    pass
+                else:
+                    try:
+                        self.refresh_config(deadline)
+                    except PegasusError as e:
+                        # an unreachable meta burns this retry, it
+                        # doesn't abort the op: the cached config may
+                        # still be right (and the meta may heal before
+                        # the next attempt)
+                        last_err = int(e.code)
             p = pidx if partition_hash is None else (
                 partition_hash % self.partition_count)
             primary = self._primary_of(p)
@@ -201,8 +274,9 @@ class ClusterClient:
                 continue  # partition momentarily unowned; refresh + retry
             rid = self._send_request(primary, "client_read", {
                 "gpid": (self.app_id, p), "op": op, "auth": self.auth,
-                "args": args, "partition_hash": partition_hash})
-            reply = self._await(rid)
+                "args": args, "partition_hash": partition_hash},
+                deadline=deadline)
+            reply = self._await(rid, deadline)
             if reply is None:
                 last_err = int(ErrorCode.ERR_TIMEOUT)
                 continue
@@ -221,12 +295,20 @@ class ClusterClient:
         self._ensure_config()
         retry_safe = all(op not in ATOMIC_OPS for op, _ in ops)
         last_err = int(ErrorCode.ERR_TIMEOUT)
+        deadline = self._deadline()
         for attempt in range(self._max_retries):
             if attempt:
-                try:
-                    self.refresh_config()
-                except PegasusError as e:
-                    last_err = int(e.code)
+                if self._clock() > deadline:
+                    raise PegasusError(ErrorCode.ERR_TIMEOUT,
+                                       "write deadline exceeded")
+                self.backoff.sleep(attempt)
+                if last_err != int(ErrorCode.ERR_BUSY):
+                    # (BUSY = overload shed, config still right — see
+                    # _read; back off without re-resolving)
+                    try:
+                        self.refresh_config(deadline)
+                    except PegasusError as e:
+                        last_err = int(e.code)
             pidx = partition_hash % self.partition_count
             primary = self._primary_of(pidx)
             if not primary:
@@ -234,8 +316,8 @@ class ClusterClient:
             rid = self._send_request(primary, "client_write", {
                 "gpid": (self.app_id, pidx), "ops": ops,
                 "auth": self.auth,
-                "partition_hash": partition_hash})
-            reply = self._await(rid)
+                "partition_hash": partition_hash}, deadline=deadline)
+            reply = self._await(rid, deadline)
             if reply is None:
                 # a LOST REPLY is ambiguous: the write may have committed.
                 # Retrying a put/remove is idempotent; retrying incr/cas/
@@ -344,9 +426,18 @@ class ClusterClient:
     def batch_get(self, keys: Sequence[Tuple[bytes, bytes]]
                   ) -> Tuple[int, List[Tuple[bytes, bytes, bytes]]]:
         self._ensure_config()
+        deadline = self._deadline()
         for attempt in range(self._max_retries):
             if attempt:
-                self.refresh_config()
+                if self._clock() > deadline:
+                    raise PegasusError(ErrorCode.ERR_TIMEOUT,
+                                       "batch_get deadline exceeded")
+                self.backoff.sleep(attempt)
+                try:
+                    self.refresh_config(deadline)
+                except PegasusError:
+                    pass  # meta momentarily down: cached config may
+                    # still be right, like _read/_write tolerate
             # regroup under the CURRENT partition count each attempt — a
             # split between attempts changes every key's pidx
             by_pidx: Dict[int, List[FullKey]] = {}
@@ -358,7 +449,7 @@ class ClusterClient:
             for pidx, fks in by_pidx.items():
                 try:
                     resp = self._read("batch_get", BatchGetRequest(fks),
-                                      pidx)
+                                      pidx, deadline=deadline)
                 except PegasusError as e:
                     if int(e.code) in _RETRYABLE:
                         stale = True
@@ -418,9 +509,17 @@ class ClusterClient:
         {pidx: [ScanResponse]}."""
         self._ensure_config()
         out: Dict[int, list] = {}
+        deadline = self._deadline()
         for attempt in range(self._max_retries):
             if attempt:
-                self.refresh_config()
+                if self._clock() > deadline:
+                    break  # surfaced below as the partitions-missing error
+                self.backoff.sleep(attempt)
+                try:
+                    self.refresh_config(deadline)
+                except PegasusError:
+                    pass  # meta momentarily down: cached config may
+                    # still be right, like _read/_write tolerate
             by_node: Dict[str, list] = {}
             for pidx, reqs in groups.items():
                 if pidx in out:
@@ -437,9 +536,10 @@ class ClusterClient:
             for node, node_groups in by_node.items():
                 rids.append(self._send_request(
                     node, "client_scan_multi",
-                    {"groups": node_groups, "auth": self.auth}))
+                    {"groups": node_groups, "auth": self.auth},
+                    deadline=deadline))
             for rid in rids:
-                reply = self._await(rid)
+                reply = self._await(rid, deadline)
                 if reply is None or reply["err"] != _OK:
                     continue  # retried next attempt for missing pidxs
                 for pidx, resps in reply["result"]:
@@ -491,12 +591,16 @@ class ClusterClient:
         out: Dict[int, list] = {pidx: [None] * len(ops)
                                 for pidx, ops in groups.items()}
         unresolved = set(range(len(items)))
+        deadline = self._deadline()
         for attempt in range(self._max_retries):
             if not unresolved:
                 break
             if attempt:
+                if self._clock() > deadline:
+                    break  # surfaced below as partitions-unreachable
+                self.backoff.sleep(attempt)
                 try:
-                    self.refresh_config()
+                    self.refresh_config(deadline)
                 except PegasusError:
                     continue  # meta momentarily down; cached config may
                     # still be right on the next pass
@@ -519,9 +623,10 @@ class ClusterClient:
                                for pidx, lst in pmap.items()]
                 rids.append((self._send_request(
                     node, "client_read_batch",
-                    {"groups": node_groups, "auth": self.auth}), pmap))
+                    {"groups": node_groups, "auth": self.auth},
+                    deadline=deadline), pmap))
             for rid, pmap in rids:
-                reply = self._await(rid)
+                reply = self._await(rid, deadline)
                 if reply is None or reply["err"] != _OK:
                     continue  # retried next attempt
                 for pidx, err, results in reply["result"]:

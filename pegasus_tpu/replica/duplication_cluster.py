@@ -223,6 +223,10 @@ class ClusterDuplicator:
                 )
 
                 auth = make_credentials(NODE_USER, self.stub.auth_secret)
+            # deliberately NO deadline on duplication-shipped writes:
+            # this is replication-class traffic (the log-GC floor waits
+            # on it), so it must never be fast-failed as abandoned —
+            # same exemption the dispatcher's overload shedding applies
             self.stub.net.send(self.stub.name, primary, "client_write", {
                 "gpid": (self._fconfig["app_id"], pidx), "rid": rid,
                 "ops": ops, "auth": auth})

@@ -489,6 +489,14 @@ class ReplicaStub:
 
         return worker_lease_valid(self._last_beacon_ack, self.sim_clock())
 
+    def _deadline_expired(self, payload: dict) -> bool:
+        """True when the request's end-to-end deadline already passed on
+        this node's clock (the client stamps the same timebase: wall
+        time over TCP, the epoch-anchored virtual clock in sim)."""
+        dl = payload.get("deadline")
+        return (dl is not None and self.clock is not None
+                and self.clock() > dl)
+
     def _on_client_write(self, src: str, payload: dict) -> None:
         from pegasus_tpu.replica.mutation import WriteOp
         from pegasus_tpu.replica.replica import PartitionStatus
@@ -496,6 +504,14 @@ class ReplicaStub:
 
         gpid = tuple(payload["gpid"])
         rid = payload["rid"]
+        if self._deadline_expired(payload):
+            # fast-fail BEFORE the 2PC starts: an expired write has not
+            # (and will not) run, so the explicit ERR_TIMEOUT reply is
+            # unambiguous — safe to retry even for atomic ops
+            self.net.send(self.name, src, "client_write_reply", {
+                "rid": rid, "err": int(ErrorCode.ERR_TIMEOUT),
+                "results": []})
+            return
         r = self.replicas.get(gpid)
         if not self._client_allowed(r, payload, access="w", src=src):
             self.net.send(self.name, src, "client_write_reply", {
@@ -615,6 +631,10 @@ class ReplicaStub:
         from pegasus_tpu.replica.replica import PartitionStatus
         from pegasus_tpu.utils.errors import ErrorCode
 
+        if self._deadline_expired(payload):
+            # abandoned work: the client's end-to-end deadline lapsed,
+            # so the cheapest correct answer is a typed fast-fail
+            return int(ErrorCode.ERR_TIMEOUT), None
         gpid = tuple(payload["gpid"])
         r = self.replicas.get(gpid)
         if not self._client_allowed(r, payload, access="r", src=src):
@@ -667,6 +687,12 @@ class ReplicaStub:
                             flush[i][1].get("partition_hash"))
                            for i in idxs])
                  for server, idxs in groups.values()]
+        # NO flush-wide deadline here: members carry INDEPENDENT
+        # deadlines (already gate-checked above, microseconds ago), and
+        # bounding the flush by the tightest one would let a single
+        # tight-deadline client abort 31 healthy neighbors into a retry
+        # round-trip. The explicit batch RPC passes its deadline down
+        # because there one deadline really does govern the whole batch.
         try:
             results = point_read_multi(pairs)
         except (ValueError, RuntimeError):
@@ -693,7 +719,7 @@ class ReplicaStub:
             is_point_read,
             point_read_multi,
         )
-        from pegasus_tpu.utils.errors import ErrorCode
+        from pegasus_tpu.utils.errors import ErrorCode, PegasusError
 
         rid = payload.get("rid")
         groups = payload.get("groups") or []
@@ -710,7 +736,8 @@ class ReplicaStub:
                               None))
                 continue
             err, r = self._client_read_gate(
-                {"gpid": gpid, "auth": payload.get("auth")}, src)
+                {"gpid": gpid, "auth": payload.get("auth"),
+                 "deadline": payload.get("deadline")}, src)
             if err is not None:
                 slots.append((gpid[1], err, None))
                 continue
@@ -720,7 +747,14 @@ class ReplicaStub:
             try:
                 results = point_read_multi(
                     [(srv, [tuple(o) for o in ops])
-                     for _i, srv, ops in ok])
+                     for _i, srv, ops in ok],
+                    deadline=payload.get("deadline"), clock=self.clock)
+            except PegasusError:
+                # the batch's deadline lapsed mid-flush: typed timeout
+                # for every slot this node accepted
+                for slot_i, _srv, _ops in ok:
+                    slots[slot_i] = (slots[slot_i][0],
+                                     int(ErrorCode.ERR_TIMEOUT), None)
             except (ValueError, TypeError, AttributeError):
                 # malformed args that slipped past the shape check:
                 # a definite reply, never an unreplied batch

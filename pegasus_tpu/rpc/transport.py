@@ -32,11 +32,37 @@ import time
 from typing import Any, Callable, Dict, Optional, Tuple
 
 from pegasus_tpu.rpc.message import decode_message, encode_message, read_frames
+from pegasus_tpu.utils.flags import FLAGS, define_flag
 
 Addr = Tuple[str, int]
 
 import itertools as _itertools
 _SESSION_IDS = _itertools.count(1)
+
+define_flag("pegasus.rpc", "connect_timeout_ms", 2000,
+            "outbound TCP dial timeout", mutable=True)
+define_flag("pegasus.rpc", "reconnect_backoff_base_ms", 50,
+            "first pause after a failed peer dial/write (doubles per "
+            "consecutive failure)", mutable=True)
+define_flag("pegasus.rpc", "reconnect_backoff_max_ms", 2000,
+            "cap on the reconnect pause", mutable=True)
+define_flag("pegasus.rpc", "read_shed_queue_depth", 2000,
+            "inbox depth beyond which NEW client reads are shed with "
+            "ERR_BUSY (writes/replication exempt)", mutable=True)
+define_flag("pegasus.rpc", "read_shed_queue_age_ms", 5000,
+            "queueing age beyond which a client read is shed with "
+            "ERR_BUSY", mutable=True)
+
+# client request types the dispatcher may fast-fail without consulting
+# the handler: reply envelope (type, result field, empty value). Writes
+# get deadline fast-fail only — shedding exempts them (and every
+# replication/meta message) so a read storm cannot reject mutations.
+_CLIENT_REQS: Dict[str, Tuple[str, str, Any]] = {
+    "client_read": ("client_read_reply", "result", None),
+    "client_read_batch": ("client_read_reply", "result", None),
+    "client_scan_multi": ("client_read_reply", "result", None),
+    "client_write": ("client_write_reply", "results", []),
+}
 
 
 class TcpTransport:
@@ -73,6 +99,9 @@ class TcpTransport:
         self._peer_outboxes: Dict[str, "queue.Queue[Optional[bytes]]"] = {}
         self._outboxes_lock = threading.Lock()
         self._closing = False
+        # chaos hook (rpc/fault.py): None = zero-overhead hot path; an
+        # installed plan only acts while FAIL_POINTS is enabled
+        self.fault_plan = None
         self._threads: list = []
         self._listener: Optional[socket.socket] = None
         self.listen_addr: Optional[Addr] = None
@@ -123,11 +152,28 @@ class TcpTransport:
         costs one extra non-blocking queue poll."""
         self._batch_handlers[(addr, msg_type)] = handler
 
+    def install_fault_plan(self, plan) -> None:
+        """Arm chaos injection (rpc/fault.py FaultPlan). Also enables the
+        fail-point registry — the plan's global gate — so a single
+        FAIL_POINTS.teardown() later disarms every transport at once."""
+        from pegasus_tpu.utils.fail_point import FAIL_POINTS
+
+        self.fault_plan = plan
+        if plan is not None:
+            FAIL_POINTS.setup()
+
     def send(self, src: str, dst: str, msg_type: str, payload: Any) -> None:
+        plan = self.fault_plan
+        verdict = (0.0, 1)
+        if plan is not None and plan.active:
+            verdict = plan.outbound(src, dst, msg_type)
+            if verdict is None:
+                return  # injected loss (same contract as real loss)
         if dst in self._handlers:
             # loopback: still through the inbox so delivery stays serial
-            self._inbox.put((time.perf_counter(), src, dst, msg_type,
-                             payload, "loopback"))
+            for _ in range(verdict[1]):
+                self._inbox.put((time.perf_counter(), src, dst, msg_type,
+                                 payload, "loopback"))
             return
         # encode HERE so an unencodable payload raises at the caller (a
         # programming error, not network loss); network IO happens on the
@@ -141,19 +187,47 @@ class TcpTransport:
                 box = queue.Queue()
                 self._peer_outboxes[dst] = box
                 self._spawn(self._send_loop, dst, box)
-        box.put(frame)
+        box.put((verdict[0], frame))
+        if verdict[1] > 1:
+            box.put((0.0, frame))  # injected duplicate
 
     def _send_loop(self, dst: str, box: "queue.Queue") -> None:
+        from pegasus_tpu.utils.backoff import Backoff
+
+        def nap(d: float) -> None:
+            # closing-aware sleep: a pause must not delay shutdown
+            t_end = time.monotonic() + d
+            while not self._closing and time.monotonic() < t_end:
+                time.sleep(min(0.05, max(0.0, t_end - time.monotonic())))
+
+        # capped exponential full-jitter pause between reconnect
+        # attempts — a dead peer must not be re-dialed at full speed
+        # once per queued frame (each dial burns connect_timeout and
+        # hammers the peer's accept queue as it restarts), and every
+        # sender backing off the same dead peer must NOT wake in
+        # lockstep (per-process jitter entropy from Backoff's default)
+        backoff = Backoff(
+            base_ms=FLAGS.get("pegasus.rpc", "reconnect_backoff_base_ms"),
+            max_ms=FLAGS.get("pegasus.rpc", "reconnect_backoff_max_ms"),
+            sleep=nap)
+        fail_streak = 0
         while True:
-            frame = box.get()
-            if frame is None:
+            item = box.get()
+            if item is None:
                 return
+            delay, frame = item
+            if delay > 0:
+                time.sleep(delay)  # injected link latency (fault plan)
+            if fail_streak:
+                backoff.sleep(fail_streak)
             try:
                 sock, wlock = self._route(dst)
                 with wlock:
                     sock.sendall(frame)
+                fail_streak = 0
             except OSError:
                 self._drop_route(dst)  # loss; protocols retry
+                fail_streak += 1
 
     def close(self) -> None:
         with self._outboxes_lock:
@@ -223,7 +297,9 @@ class TcpTransport:
         addr = self.address_book.get(dst)
         if addr is None:
             raise OSError(f"no route to peer {dst!r}")
-        sock = socket.create_connection(addr, timeout=2.0)
+        sock = socket.create_connection(
+            addr,
+            timeout=FLAGS.get("pegasus.rpc", "connect_timeout_ms") / 1000.0)
         sock.settimeout(None)
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         # our own reader on the outbound connection too: RPC replies come
@@ -303,6 +379,7 @@ class TcpTransport:
                 pass
 
     def _dispatch_loop(self) -> None:
+        from pegasus_tpu.utils.errors import ErrorCode
         from pegasus_tpu.utils.metrics import METRICS
 
         # profiler toollet (parity: runtime/profiler.cpp:90-198 —
@@ -311,6 +388,8 @@ class TcpTransport:
         from pegasus_tpu.utils.profiler import PROFILER
 
         prof = METRICS.entity("rpc", "dispatch", {})
+        expired_cnt = prof.counter("deadline_expired_count")
+        shed_cnt = prof.counter("read_shed_count")
         lat: Dict[str, Any] = {}
         cnt: Dict[str, Any] = {}
         carry: Optional[tuple] = None
@@ -325,6 +404,44 @@ class TcpTransport:
             handler = self._handlers.get(dst)
             if handler is None:
                 continue
+            plan = self.fault_plan
+            if plan is not None and plan.active and (
+                    plan.is_partitioned(src) or plan.is_partitioned(dst)):
+                continue  # inbound half of an injected partition
+            env = _CLIENT_REQS.get(msg_type) if isinstance(payload, dict) \
+                else None
+            if env is not None:
+                # (1) end-to-end deadline: work whose deadline lapsed in
+                # the queue (or on the wire) is abandoned — the client
+                # stopped waiting, so serving it only adds load exactly
+                # when the node is least able to afford it
+                dl = payload.get("deadline")
+                if dl is not None and time.time() > dl:
+                    expired_cnt.increment()
+                    self.send(dst, src, env[0], {
+                        "rid": payload.get("rid"),
+                        "err": int(ErrorCode.ERR_TIMEOUT), env[1]: env[2]})
+                    continue
+                # (2) overload shedding, reads only: the single
+                # dispatcher thread drains an unbounded inbox, so under
+                # a read storm queue depth (and thus latency) grows
+                # without bound; shed NEW reads with ERR_BUSY while the
+                # queue is deep or this message aged in it. Writes and
+                # replication traffic are exempt — availability of the
+                # mutation path degrades last.
+                if msg_type != "client_write":
+                    depth = self._inbox.qsize()
+                    age_ms = (time.perf_counter() - t_enq) * 1000.0
+                    if (depth > FLAGS.get("pegasus.rpc",
+                                          "read_shed_queue_depth")
+                            or age_ms > FLAGS.get(
+                                "pegasus.rpc", "read_shed_queue_age_ms")):
+                        shed_cnt.increment()
+                        self.send(dst, src, env[0], {
+                            "rid": payload.get("rid"),
+                            "err": int(ErrorCode.ERR_BUSY),
+                            env[1]: env[2]})
+                        continue
             batch = None
             shutdown = False
             bh = self._batch_handlers.get((dst, msg_type))

@@ -18,6 +18,7 @@ from typing import Any, Callable, Dict, Optional, Tuple
 
 import numpy as np
 
+from pegasus_tpu.rpc.fault import link_rule_lookup
 
 from pegasus_tpu.utils.profiler import PROFILER as _PROFILER
 
@@ -72,6 +73,7 @@ class SimNetwork:
         self._handlers: Dict[str, Callable[[str, str, Any], None]] = {}
         self._drop_prob: Dict[Optional[Tuple[str, str]], float] = {}
         self._extra_delay: Dict[Optional[Tuple[str, str]], float] = {}
+        self._dup_prob: Dict[Optional[Tuple[str, str]], float] = {}
         self._partitioned: set = set()
         # per-link FIFO: messages on one (src, dst) link never reorder
         # (parity: rDSN rides TCP; the 2PC protocol assumes ordered
@@ -105,6 +107,18 @@ class SimNetwork:
         else:
             self._extra_delay[key] = extra_s
 
+    def set_duplicate(self, prob: float, src: Optional[str] = None,
+                      dst: Optional[str] = None) -> None:
+        """Deliver a link's messages twice with probability `prob` —
+        the redelivery fault the real transport's FaultPlan injects
+        (protocols must tolerate duplicates; TCP alone never makes
+        them, so chaos has to)."""
+        key = None if src is None and dst is None else (src, dst)
+        if prob <= 0:
+            self._dup_prob.pop(key, None)
+        else:
+            self._dup_prob[key] = prob
+
     def partition(self, addr: str) -> None:
         """Cut a node off entirely (both directions)."""
         self._partitioned.add(addr)
@@ -116,31 +130,35 @@ class SimNetwork:
         if src in self._partitioned or dst in self._partitioned:
             self.dropped += 1
             return
-        prob = self._drop_prob.get((src, dst),
-                                   self._drop_prob.get(None, 0.0))
+        prob = link_rule_lookup(self._drop_prob, src, dst)
         if prob > 0 and self.loop.rng.random() < prob:
             self.dropped += 1
             return
-        delay = (self.base_delay + self.loop.rng.random() * self.jitter
-                 + self._extra_delay.get((src, dst),
-                                         self._extra_delay.get(None, 0.0)))
-        deliver_at = max(self.loop.now + delay,
-                         self._link_clock.get((src, dst), 0.0))
-        self._link_clock[(src, dst)] = deliver_at
-        delay = deliver_at - self.loop.now
+        # client_write exempt from duplication, like FaultPlan.outbound:
+        # a duplicated atomic write would double-apply (no rid dedup)
+        dup = link_rule_lookup(self._dup_prob, src, dst)
+        copies = 2 if (dup > 0 and msg_type != "client_write"
+                       and self.loop.rng.random() < dup) else 1
+        for _copy in range(copies):
+            delay = (self.base_delay + self.loop.rng.random() * self.jitter
+                     + link_rule_lookup(self._extra_delay, src, dst))
+            deliver_at = max(self.loop.now + delay,
+                             self._link_clock.get((src, dst), 0.0))
+            self._link_clock[(src, dst)] = deliver_at
+            delay = deliver_at - self.loop.now
 
-        def deliver() -> None:
-            handler = self._handlers.get(dst)
-            if handler is not None and dst not in self._partitioned:
-                self.delivered += 1
-                if _PROFILER.enabled:
-                    # toollet join point (profiler.cpp:90-198): queue
-                    # delay is the SIM link latency; exec is wall time
-                    t0 = _perf_counter()
-                    handler(src, msg_type, payload)
-                    _PROFILER.observe(msg_type, delay * 1000.0,
-                                      (_perf_counter() - t0) * 1000.0)
-                else:
-                    handler(src, msg_type, payload)
+            def deliver(delay=delay) -> None:
+                handler = self._handlers.get(dst)
+                if handler is not None and dst not in self._partitioned:
+                    self.delivered += 1
+                    if _PROFILER.enabled:
+                        # toollet join point (profiler.cpp:90-198): queue
+                        # delay is the SIM link latency; exec is wall time
+                        t0 = _perf_counter()
+                        handler(src, msg_type, payload)
+                        _PROFILER.observe(msg_type, delay * 1000.0,
+                                          (_perf_counter() - t0) * 1000.0)
+                    else:
+                        handler(src, msg_type, payload)
 
-        self.loop.schedule(delay, deliver)
+            self.loop.schedule(delay, deliver)

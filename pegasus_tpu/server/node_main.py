@@ -43,6 +43,16 @@ def run_node(cfg: dict, name: str) -> None:
     data_root = cfg["data_root"]
     book = address_book(cfg)
     transport = TcpTransport((node_cfg["host"], node_cfg["port"]), book)
+    if cfg.get("fault_plan"):
+        # config-driven chaos (rpc/fault.py): every node of a chaos
+        # onebox installs the same seeded schedule, so link faults are
+        # charged once at the sender and the run replays from its seed
+        from pegasus_tpu.rpc.fault import FaultPlan
+
+        transport.install_fault_plan(
+            FaultPlan.from_config(cfg["fault_plan"]))
+        print(f"[{name}] fault plan armed: {cfg['fault_plan']}",
+              flush=True)
     meta_names = [n for n, c in cfg["nodes"].items()
                   if c["role"] == "meta"]
 

@@ -51,22 +51,38 @@ def is_point_read(op: str, args) -> bool:
 
 
 def point_read_multi(servers_and_ops: List[Tuple[object, list]],
-                     now=None) -> List[list]:
+                     now=None, deadline=None, clock=None) -> List[list]:
     """[(PartitionServer, [(op, args, partition_hash)])] -> [[result]].
 
     Results are byte-identical to the solo handlers (on_get / on_ttl /
     on_multi_get with sort keys / on_batch_get). One build_page call
     assembles every partition's L1 value gathers per value-header
     width (one native gather per unique block across the whole flush).
+
+    `deadline`/`clock`: the flush's end-to-end deadline on the serving
+    node's clock. Checked between the per-partition planning passes and
+    again before the cross-partition gather — the two places a large
+    flush spends real time — raising ERR_TIMEOUT instead of finishing
+    work every requester already abandoned.
     """
     from pegasus_tpu.base.value_schema import epoch_now, header_length
     from pegasus_tpu.server.page import build_page
+
+    def _check_deadline() -> None:
+        if deadline is not None and clock is not None \
+                and clock() > deadline:
+            from pegasus_tpu.utils.errors import ErrorCode, PegasusError
+
+            raise PegasusError(ErrorCode.ERR_TIMEOUT,
+                               "point-read flush deadline exceeded")
 
     if now is None:
         now = epoch_now()
     states = []
     for server, ops in servers_and_ops:
+        _check_deadline()
         states.append((server, server.plan_get_batch(ops, now=now)))
+    _check_deadline()
 
     # cross-partition native assembly: group by value-header width (the
     # only per-partition parameter of the gather), concatenate chunks

@@ -154,9 +154,22 @@ class SimCluster:
             from pegasus_tpu.security.auth import make_credentials
 
             auth = make_credentials(user, self.auth_secret)
-        c = ClusterClient(self.net, name or f"client-{app_name}",
+        # deadline timebase = the stubs' wall-anchored clock; backoff
+        # "sleep" advances VIRTUAL time (delivering due messages), so
+        # retry pacing shapes the schedule without wall-clock cost
+        import zlib
+
+        # per-client FIXED backoff seed (name-derived, not hash() —
+        # that's salted per interpreter): sim schedules replay exactly,
+        # while two sim clients still draw distinct jitter streams
+        # (real clients default to per-process entropy instead)
+        cname = name or f"client-{app_name}"
+        c = ClusterClient(self.net, cname,
                           [m.name for m in self.metas],
-                          app_name, pump=self.pump, auth=auth)
+                          app_name, pump=self.pump, auth=auth,
+                          clock=lambda: self._epoch + self.loop.now,
+                          sleep=lambda s: self.loop.run_for(s),
+                          backoff_seed=zlib.crc32(cname.encode()))
         return c
 
     def primaries(self, app_id: int) -> List[str]:

@@ -91,11 +91,20 @@ class DataVerifier:
 
 
 class Killer:
-    """Random kill -9 / restart of replica processes."""
+    """Random chaos strikes against replica processes.
 
-    def __init__(self, directory: str, rng: random.Random) -> None:
+    mode='kill': kill -9 + cold restart (crash recovery).
+    mode='pause': SIGSTOP + later SIGCONT (the hung-node shape — GC
+    pause, disk stall — that must trip failure-detector lease expiry,
+    and whose victim wakes up believing it still serves)."""
+
+    def __init__(self, directory: str, rng: random.Random,
+                 mode: str = "kill") -> None:
+        if mode not in ("kill", "pause"):
+            raise ValueError(f"unknown chaos mode {mode!r}")
         self.directory = directory
         self.rng = rng
+        self.mode = mode
         with open(os.path.join(directory, "cluster.json")) as f:
             self.cfg = json.load(f)
         self.replica_nodes = [n for n, c in self.cfg["nodes"].items()
@@ -104,11 +113,14 @@ class Killer:
         self.kills = 0
 
     def kill_one(self) -> str:
-        from pegasus_tpu.tools.onebox_cluster import kill_node
+        from pegasus_tpu.tools.onebox_cluster import kill_node, pause_node
 
         victim = self.rng.choice([n for n in self.replica_nodes
                                   if n != self.down])
-        kill_node(victim, self.directory)
+        if self.mode == "pause":
+            pause_node(victim, self.directory)
+        else:
+            kill_node(victim, self.directory)
         self.down = victim
         self.kills += 1
         return victim
@@ -116,6 +128,13 @@ class Killer:
     def restart_down(self) -> Optional[str]:
         if self.down is None:
             return None
+        if self.mode == "pause":
+            from pegasus_tpu.tools.onebox_cluster import resume_node
+
+            name = self.down
+            resume_node(name, self.directory)
+            self.down = None
+            return name
         name = self.down
         env = dict(os.environ)
         env["PYTHONPATH"] = _REPO_ROOT + os.pathsep + env.get(
@@ -143,7 +162,11 @@ class Killer:
 
 def run_kill_test(directory: str, duration_s: float = 60.0,
                   kill_every_s: float = 12.0, seed: int = 0,
-                  table: str = "killtest") -> dict:
+                  table: str = "killtest", mode: str = "kill",
+                  op_timeout_ms: Optional[float] = None) -> dict:
+    """`op_timeout_ms`: verifier-client end-to-end op deadline — under
+    chaos every op must either succeed or raise a typed PegasusError
+    within it (no hangs); None keeps the flag default."""
     from pegasus_tpu.tools import onebox_cluster as ob
 
     rng = random.Random(seed)
@@ -169,9 +192,9 @@ def run_kill_test(directory: str, duration_s: float = 60.0,
             if time.monotonic() > create_deadline:
                 raise
             time.sleep(1)
-    client = ob.connect(table, directory)
+    client = ob.connect(table, directory, op_timeout_ms=op_timeout_ms)
     verifier = DataVerifier(client, rng)
-    killer = Killer(directory, rng)
+    killer = Killer(directory, rng, mode=mode)
 
     t_end = time.monotonic() + duration_s
     next_kill = time.monotonic() + kill_every_s
@@ -190,6 +213,7 @@ def run_kill_test(directory: str, duration_s: float = 60.0,
     killer.restart_down()
     verifier.final_check()
     report = {
+        "mode": mode,
         "kills": killer.kills,
         "writes_acked": verifier.write_ok,
         "writes_rejected": verifier.write_rejected,
@@ -207,9 +231,12 @@ def main() -> None:
     ap.add_argument("--duration", type=float, default=60.0)
     ap.add_argument("--kill-every", type=float, default=12.0)
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--mode", choices=["kill", "pause"], default="kill",
+                    help="kill: kill -9 + restart (crash recovery); "
+                         "pause: SIGSTOP/SIGCONT (hung-node detection)")
     args = ap.parse_args()
     report = run_kill_test(args.dir, args.duration, args.kill_every,
-                           args.seed)
+                           args.seed, mode=args.mode)
     print(json.dumps(report, indent=1))
     sys.exit(1 if report["violations"] else 0)
 

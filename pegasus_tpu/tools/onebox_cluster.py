@@ -48,7 +48,8 @@ def _cluster_paths(directory: str) -> Dict[str, str]:
 def start(directory: str = DEFAULT_DIR, n_replica: int = 3,
           n_meta: int = 1, auth_secret: Optional[str] = None,
           name_prefix: str = "",
-          extra_peers: Optional[Dict[str, Tuple[str, int]]] = None) -> dict:
+          extra_peers: Optional[Dict[str, Tuple[str, int]]] = None,
+          fault_plan: Optional[dict] = None) -> dict:
     """`name_prefix` namespaces this cluster's node names (two oneboxes
     on one host must not both own "meta"); `extra_peers` maps REMOTE
     node names to (host, port) — written into the address book with
@@ -77,6 +78,12 @@ def start(directory: str = DEFAULT_DIR, n_replica: int = 3,
                 "give one cluster a name_prefix")
         nodes[name] = {"host": host, "port": port, "role": "external"}
     cfg = {"data_root": os.path.join(directory, "data"), "nodes": nodes}
+    if fault_plan:
+        # chaos wiring for REAL processes: every node installs this
+        # rpc/fault.FaultPlan schedule on its transport at boot (see
+        # node_main), so kill_test/integration runs inject network
+        # faults without any in-process hook
+        cfg["fault_plan"] = fault_plan
     if auth_secret:
         # onebox-grade key distribution: the secret lives in the cluster
         # config file (the keytab-file analogue)
@@ -165,6 +172,25 @@ def kill_node(name: str, directory: str = DEFAULT_DIR) -> None:
     os.kill(pids[name], signal.SIGKILL)
 
 
+def pause_node(name: str, directory: str = DEFAULT_DIR) -> None:
+    """SIGSTOP one node: the process is alive but serves nothing and
+    beacons nothing — the hung-node shape (GC pause, disk stall) that
+    exercises FD lease expiry instead of crash recovery."""
+    paths = _cluster_paths(directory)
+    with open(paths["pids"]) as f:
+        pids = json.load(f)
+    os.kill(pids[name], signal.SIGSTOP)
+
+
+def resume_node(name: str, directory: str = DEFAULT_DIR) -> None:
+    """SIGCONT a paused node. It wakes believing it is still serving;
+    the worker-side lease check must fence it until meta re-admits."""
+    paths = _cluster_paths(directory)
+    with open(paths["pids"]) as f:
+        pids = json.load(f)
+    os.kill(pids[name], signal.SIGCONT)
+
+
 class OneboxAdmin:
     """Wire admin client: DDL against the onebox meta."""
 
@@ -181,6 +207,9 @@ class OneboxAdmin:
         self.name = name
         self._rids = itertools.count(1)
         self._replies: Dict[int, dict] = {}
+        from pegasus_tpu.utils.backoff import Backoff
+
+        self._backoff = Backoff()
         self.net.register(name, self._on_message)
 
     def _on_message(self, src: str, msg_type: str, payload) -> None:
@@ -195,6 +224,10 @@ class OneboxAdmin:
         overall = time.monotonic() + timeout
         last = None
         for i, meta in enumerate(metas):
+            if i:
+                # jittered pause before the next group member — the
+                # same anti-storm pacing the data clients apply
+                self._backoff.sleep(i)
             remaining = overall - time.monotonic()
             if remaining <= 0:
                 break
@@ -226,8 +259,11 @@ class OneboxAdmin:
 
 
 def connect(app_name: str, directory: str = DEFAULT_DIR,
-            client_name: Optional[str] = None, user: str = "admin"):
-    """Wire data client for a onebox table."""
+            client_name: Optional[str] = None, user: str = "admin",
+            op_timeout_ms: Optional[float] = None):
+    """Wire data client for a onebox table. `op_timeout_ms` bounds each
+    op end-to-end (all retries included); None keeps the
+    client_op_timeout_ms flag default."""
     from pegasus_tpu.client.cluster_client import ClusterClient
     from pegasus_tpu.rpc.transport import TcpTransport
 
@@ -245,7 +281,7 @@ def connect(app_name: str, directory: str = DEFAULT_DIR,
     return ClusterClient(
         net, client_name or f"client-{os.getpid()}", metas, app_name,
         pump=lambda: time.sleep(0.01), max_retries=8, pump_rounds=400,
-        auth=auth)
+        auth=auth, op_timeout_ms=op_timeout_ms)
 
 
 def main() -> None:

@@ -8,11 +8,17 @@ server code (e.g. src/replica/replication_app_base.cpp:289).
 
 from __future__ import annotations
 
+import random
+import re
 import threading
 import time
 from typing import Any, Callable, Dict, Optional
 
 _SENTINEL = object()
+
+# '<N>%action(arg)' — the reference's probabilistic frequency prefix
+# (fail_point.h parses "25%return(ok)"; N may be fractional)
+_FREQ_RE = re.compile(r"^(\d+(?:\.\d+)?)%(.+)$")
 
 
 class _FailPointRegistry:
@@ -20,40 +26,69 @@ class _FailPointRegistry:
         self._actions: Dict[str, Callable[[str], Any]] = {}
         self._enabled = False
         self._lock = threading.Lock()
+        # seedable RNG for the probabilistic '<N>%...' actions: chaos
+        # runs replay from their seed (parity: the reference threads one
+        # seeded env through the simulator's fault decisions)
+        self._rng = random.Random(0)
 
     def setup(self) -> None:
         self._enabled = True
+
+    @property
+    def enabled(self) -> bool:
+        return self._enabled
 
     def teardown(self) -> None:
         with self._lock:
             self._actions.clear()
         self._enabled = False
+        self._rng = random.Random(0)
+
+    def seed(self, seed: int) -> None:
+        """Re-seed the probabilistic-action RNG (reproducible chaos)."""
+        with self._lock:
+            self._rng = random.Random(seed)
 
     def cfg(self, name: str, action: str) -> None:
         """Configure an action string, mirroring the reference's mini-language:
-        'off', 'return(<value>)', 'delay(<ms>)', 'raise(<msg>)',
-        '<N>%return(<value>)' is not supported (keep deterministic for tests).
+        'off', 'return(<value>)', 'delay(<ms>)', 'raise(<msg>)', each
+        optionally prefixed '<N>%' to fire with probability N/100 per
+        inject (fail_point.h's frequency syntax), e.g. '25%raise(io)'.
         """
         with self._lock:
             if action == "off":
                 self._actions.pop(name, None)
                 return
+            prob = 1.0
+            m = _FREQ_RE.match(action)
+            if m:
+                prob = float(m.group(1)) / 100.0
+                action = m.group(2)
             if action.startswith("return(") and action.endswith(")"):
                 value = action[len("return("):-1]
-                self._actions[name] = lambda _n, v=value: v
+                base = lambda _n, v=value: v  # noqa: E731
             elif action.startswith("delay(") and action.endswith(")"):
                 ms = float(action[len("delay("):-1])
-                def _delay(_n, ms=ms):
+                def base(_n, ms=ms):
                     time.sleep(ms / 1000.0)
                     return _SENTINEL
-                self._actions[name] = _delay
             elif action.startswith("raise(") and action.endswith(")"):
                 msg = action[len("raise("):-1]
-                def _raise(_n, msg=msg):
+                def base(_n, msg=msg):
                     raise RuntimeError(f"fail_point({_n}): {msg}")
-                self._actions[name] = _raise
             else:
                 raise ValueError(f"unknown fail_point action: {action!r}")
+            if prob >= 1.0:
+                self._actions[name] = base
+            else:
+                def probabilistic(n, base=base, prob=prob):
+                    # RNG draw under the registry lock: concurrent
+                    # injects from many dispatcher threads must not
+                    # corrupt (or de-determinize) the shared stream
+                    with self._lock:
+                        hit = self._rng.random() < prob
+                    return base(n) if hit else _SENTINEL
+                self._actions[name] = probabilistic
 
     def cfg_callable(self, name: str, fn: Callable[[str], Any]) -> None:
         with self._lock:

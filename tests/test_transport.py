@@ -96,6 +96,145 @@ def test_tcp_transport_request_reply():
     server.close()
 
 
+def _pair():
+    """A listening server transport + client transport dialing it."""
+    from pegasus_tpu.rpc.transport import TcpTransport
+
+    server = TcpTransport(("127.0.0.1", 0), {})
+    host, port = server.listen_addr
+    client = TcpTransport(None, {"srv": (host, port)})
+    return server, client
+
+
+def _wait_for(pred, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while not pred() and time.monotonic() < deadline:
+        time.sleep(0.01)
+    return pred()
+
+
+def test_dispatcher_fast_fails_expired_deadline():
+    """A client request whose end-to-end deadline lapsed in flight is
+    never served: the dispatcher answers typed ERR_TIMEOUT without
+    touching the handler (abandoned work sheds itself)."""
+    from pegasus_tpu.utils.errors import ErrorCode
+
+    server, client = _pair()
+    served, replies = [], []
+    try:
+        server.register("srv", lambda s, mt, p: served.append(p))
+        client.register("cli", lambda s, mt, p: replies.append((mt, p)))
+        client.send("cli", "srv", "client_read", {
+            "rid": 7, "gpid": (1, 0), "op": "get", "args": b"k",
+            "deadline": time.time() - 1.0})
+        assert _wait_for(lambda: replies)
+        mt, p = replies[0]
+        assert mt == "client_read_reply"
+        assert p == {"rid": 7, "err": int(ErrorCode.ERR_TIMEOUT),
+                     "result": None}
+        assert served == []
+        # an unexpired deadline passes straight through to the handler
+        client.send("cli", "srv", "client_read", {
+            "rid": 8, "gpid": (1, 0), "op": "get", "args": b"k",
+            "deadline": time.time() + 30.0})
+        assert _wait_for(lambda: served)
+    finally:
+        client.close()
+        server.close()
+
+
+def test_read_shedding_err_busy():
+    """Aged/deep-queued client reads shed with typed ERR_BUSY; writes
+    are exempt (the mutation path degrades last)."""
+    from pegasus_tpu.utils.errors import ErrorCode
+    from pegasus_tpu.utils.flags import FLAGS
+
+    server, client = _pair()
+    served, replies = [], []
+    FLAGS.set("pegasus.rpc", "read_shed_queue_age_ms", 50)
+    try:
+        server.register("srv", lambda s, mt, p: served.append((mt, p)))
+        client.register("cli", lambda s, mt, p: replies.append((mt, p)))
+        # hold the node lock so queued messages AGE in the inbox (the
+        # dispatcher pops the first one pre-aging and blocks on the
+        # lock; everything behind it crosses the age threshold)
+        with server.lock:
+            for i in range(6):
+                client.send("cli", "srv", "client_read",
+                            {"rid": i, "op": "get", "args": b"k"})
+            client.send("cli", "srv", "client_write",
+                        {"rid": 100, "gpid": (1, 0), "ops": []})
+            # wait for arrival, then let them age past the threshold
+            time.sleep(0.4)
+        assert _wait_for(lambda: len(replies) >= 4)
+        assert all(mt == "client_read_reply"
+                   and p["err"] == int(ErrorCode.ERR_BUSY)
+                   for mt, p in replies), replies
+        # the equally-aged write was NOT shed: it reached the handler
+        assert _wait_for(lambda: ("client_write", {
+            "rid": 100, "gpid": (1, 0), "ops": []}) in served)
+        # fresh reads after the storm drains serve normally
+        client.send("cli", "srv", "client_read",
+                    {"rid": 200, "op": "get", "args": b"k"})
+        assert _wait_for(lambda: any(mt == "client_read"
+                                     and p.get("rid") == 200
+                                     for mt, p in served))
+    finally:
+        FLAGS.set("pegasus.rpc", "read_shed_queue_age_ms", 5000)
+        client.close()
+        server.close()
+
+
+def test_fault_plan_drop_delay_duplicate_partition():
+    """rpc/fault.FaultPlan gives the REAL transport SimNetwork's chaos
+    surface, gated by the fail-point registry."""
+    from pegasus_tpu.rpc.fault import FaultPlan
+    from pegasus_tpu.utils.fail_point import FAIL_POINTS
+
+    server, client = _pair()
+    got = []
+    try:
+        server.register("srv", lambda s, mt, p: got.append(p))
+        plan = FaultPlan(seed=3)
+        client.install_fault_plan(plan)  # arms FAIL_POINTS too
+        # drop: total loss on the link
+        plan.set_drop(1.0, "cli", "srv")
+        client.send("cli", "srv", "ping", 1)
+        time.sleep(0.3)
+        assert got == [] and plan.dropped == 1
+        # delay: held by the sender for the extra latency
+        plan.set_drop(0.0, "cli", "srv")
+        plan.set_delay(0.25, "cli", "srv")
+        t0 = time.monotonic()
+        client.send("cli", "srv", "ping", 2)
+        assert _wait_for(lambda: 2 in got)
+        assert time.monotonic() - t0 >= 0.25
+        # duplicate: redelivery TCP alone can never produce
+        plan.set_delay(0.0, "cli", "srv")
+        plan.set_duplicate(1.0, "cli", "srv")
+        client.send("cli", "srv", "ping", 3)
+        assert _wait_for(lambda: got.count(3) == 2)
+        # partition: both directions dark, then heal
+        plan.set_duplicate(0.0, "cli", "srv")
+        plan.partition("srv")
+        client.send("cli", "srv", "ping", 4)
+        time.sleep(0.2)
+        assert 4 not in got
+        plan.heal("srv")
+        client.send("cli", "srv", "ping", 5)
+        assert _wait_for(lambda: 5 in got)
+        # the fail-point registry is the global kill-switch: teardown
+        # disarms the installed plan without un-wiring it
+        FAIL_POINTS.teardown()
+        plan.set_drop(1.0, "cli", "srv")
+        client.send("cli", "srv", "ping", 6)
+        assert _wait_for(lambda: 6 in got)
+    finally:
+        FAIL_POINTS.teardown()
+        client.close()
+        server.close()
+
+
 def test_multiprocess_onebox(tmp_path):
     """The function-test tier: real processes, real TCP, kill -9 cure.
 

@@ -74,6 +74,64 @@ def test_fail_point_lifecycle():
     assert fail_point("boom") is None
 
 
+def test_fail_point_probabilistic_actions():
+    """The reference's '<N>%action(...)' frequency syntax, backed by the
+    registry's seeded RNG (fail_point.h's probabilistic fail points)."""
+    FAIL_POINTS.setup()
+    try:
+        FAIL_POINTS.seed(42)
+        FAIL_POINTS.cfg("p::ret", "30%return(shed)")
+        hits = sum(1 for _ in range(2000)
+                   if fail_point("p::ret") is not None)
+        assert 480 < hits < 720  # ~30% of 2000, generous bounds
+        # reproducible: the same seed replays the same decision stream
+        FAIL_POINTS.seed(42)
+        first = [fail_point("p::ret") for _ in range(50)]
+        FAIL_POINTS.seed(42)
+        assert [fail_point("p::ret") for _ in range(50)] == first
+        # probabilistic raise: fires sometimes, not always
+        FAIL_POINTS.cfg("p::raise", "50%raise(boom)")
+        raised = 0
+        for _ in range(200):
+            try:
+                fail_point("p::raise")
+            except RuntimeError:
+                raised += 1
+        assert 50 < raised < 150
+        # 100%-equivalent prefix behaves like the plain action
+        FAIL_POINTS.cfg("p::always", "100%return(x)")
+        assert all(fail_point("p::always") == "x" for _ in range(10))
+        # probabilistic delay: a miss is a no-op, a hit sleeps; either
+        # way the injected value stays None (delay never returns one)
+        FAIL_POINTS.cfg("p::delay", "50%delay(1)")
+        assert all(fail_point("p::delay") is None for _ in range(20))
+    finally:
+        FAIL_POINTS.teardown()
+
+
+def test_backoff_jitter_bounds_and_determinism():
+    from pegasus_tpu.utils.backoff import Backoff
+
+    slept = []
+    b = Backoff(base_ms=20, max_ms=1000, seed=7,
+                sleep=lambda s: slept.append(s))
+    for attempt in range(1, 12):
+        d = b.sleep(attempt)
+        ceiling = min(1.0, 0.020 * 2 ** (attempt - 1))
+        # full-jitter window: [ceiling/2, ceiling] — never zero (a zero
+        # sleep is the busy-spin this exists to kill), never past cap
+        assert ceiling / 2 <= d <= ceiling, (attempt, d)
+    assert slept == b.slept and len(slept) == 11
+    # deterministic from the seed
+    b2 = Backoff(base_ms=20, max_ms=1000, seed=7, sleep=lambda s: None)
+    assert [b2.delay(a) for a in range(1, 12)] != \
+        [b2.delay(a) for a in range(1, 12)]  # jitter varies per draw
+    b3 = Backoff(base_ms=20, max_ms=1000, seed=7, sleep=lambda s: None)
+    b4 = Backoff(base_ms=20, max_ms=1000, seed=7, sleep=lambda s: None)
+    assert [b3.delay(a) for a in range(1, 12)] == \
+        [b4.delay(a) for a in range(1, 12)]
+
+
 def test_token_bucket():
     tb = TokenBucket(rate=1000, burst=10)
     assert all(tb.try_consume() for _ in range(10))
