@@ -34,6 +34,8 @@ PEGBENCH_SCAN_BATCH (default 32: scans coalesced per device dispatch —
 the request-batching unit of SURVEY §2.6; 1 disables coalescing),
 PEGBENCH_GET_BATCH (default 32: point gets coalesced per read-
 coordinator flush in the point_get_batch phase),
+PEGBENCH_WRITE_BATCH (default 32: puts coalesced per write_multi flush
+in the write_put_batch phase),
 PEGBENCH_PROBE_TIMEOUT (s, default 120), PEGBENCH_PROBE_RETRIES (default 4),
 PEGBENCH_FORCE_CPU=1 (CPU-only dry run: never dials the TPU tunnel).
 """
@@ -387,6 +389,151 @@ def run_point_gets_server_side(bc, n_ops, n_hashkeys, seed, batch=0):
             for err, _v in results:
                 hits += err == 0
     return n_ops, hits, time.perf_counter() - t0
+
+
+def _write_put_stream(n_ops, seed, tag=b"wb"):
+    """Deterministic put stream over a dedicated keyspace (never
+    collides with the loaded scan/get dataset): (partition_hash,
+    (hash_key, sort_key), value) triples — the ONE derivation every
+    write flavor (solo, client-batched, server-side) measures against."""
+    import numpy as np
+
+    from pegasus_tpu.base.key_schema import key_hash_parts
+
+    rng = np.random.default_rng(seed)
+    hk_draw = rng.integers(0, max(1, n_ops // 4), size=n_ops)
+    out = []
+    for op in range(n_ops):
+        hk = tag + b"%08d" % int(hk_draw[op])
+        sk = b"s%04d" % op
+        out.append((key_hash_parts(hk, sk), (hk, sk),
+                    b"wval-%06d" % op))
+    return out
+
+
+def run_puts(bc, n_ops, seed, tag=b"wb"):
+    """Single-request puts through the full client write path (one
+    client_write RPC + one 2PC round per op) — the write-side twin of
+    run_point_gets."""
+    stream = _write_put_stream(n_ops, seed, tag)
+    client = bc.client
+    errs = 0
+    t0 = time.perf_counter()
+    for _ph, (hk, sk), v in stream:
+        errs += client.set(hk, sk, v) != 0
+    return n_ops, errs, time.perf_counter() - t0
+
+
+def run_puts_batched(bc, n_ops, seed, batch=32, tag=b"wb"):
+    """The same put stream coalesced through write_multi (`batch` ops
+    per flush): one client_write_batch RPC per node per flush, one
+    mutation per touched partition, one group-commit window."""
+    from pegasus_tpu.base.key_schema import generate_key
+    from pegasus_tpu.rpc.codec import OP_PUT
+
+    stream = _write_put_stream(n_ops, seed, tag)
+    client = bc.client
+    n_part = client.partition_count
+    errs = 0
+    pending: dict = {}
+    pending_n = 0
+
+    def flush():
+        nonlocal errs, pending_n
+        if not pending:
+            return
+        for _pidx, results in client.write_multi(dict(pending)).items():
+            for err in results:
+                errs += err != 0
+        pending.clear()
+        pending_n = 0
+
+    t0 = time.perf_counter()
+    for ph, (hk, sk), v in stream:
+        pending.setdefault(ph % n_part, []).append(
+            (OP_PUT, (generate_key(hk, sk), v, 0), ph))
+        pending_n += 1
+        if pending_n >= batch:
+            flush()
+    flush()
+    return n_ops, errs, time.perf_counter() - t0
+
+
+def run_puts_server_side(bc, n_ops, seed, batch=0, tag=b"wbs"):
+    """Server-side only (no client/transport): batch=0 drives one
+    replica.client_write (one mutation) per op; batch=N groups each
+    window's ops per partition into ONE client_write — the mutation
+    coalescing + vectorized-apply path in isolation."""
+    from pegasus_tpu.base.key_schema import generate_key
+    from pegasus_tpu.replica.mutation import WriteOp
+    from pegasus_tpu.rpc.codec import OP_PUT
+
+    stream = [(ph % len(bc.replicas), generate_key(hk, sk), v)
+              for ph, (hk, sk), v in _write_put_stream(n_ops, seed, tag)]
+    replicas = bc.replicas
+    pump = bc.cluster.loop.run_until_idle
+    window = next(iter(bc.cluster.stubs.values())).write_window
+    if batch <= 1:
+        t0 = time.perf_counter()
+        for pidx, key, v in stream:
+            replicas[pidx].client_write([WriteOp(OP_PUT, (key, v, 0))])
+            pump()
+        return n_ops, time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for off in range(0, len(stream), batch):
+        groups: dict = {}
+        for pidx, key, v in stream[off:off + batch]:
+            groups.setdefault(pidx, []).append(
+                WriteOp(OP_PUT, (key, v, 0)))
+        # one group-commit window per flush — exactly what a
+        # client_write_batch dispatch opens on a serving node
+        with window:
+            for pidx, ops in groups.items():
+                replicas[pidx].client_write(ops)
+        pump()
+    return n_ops, time.perf_counter() - t0
+
+
+def verify_write_batch_identity(bc, seed, n=256) -> bool:
+    """Acceptance gate: the batched write path must produce the same
+    per-op results as the solo handler AND leave identical user-visible
+    state — asserted over twin keyspaces carrying the same payloads
+    (hits, overwrites, and deletes alike)."""
+    from pegasus_tpu.base.key_schema import generate_key, key_hash_parts
+    from pegasus_tpu.rpc.codec import OP_PUT, OP_REMOVE
+
+    client = bc.client
+    n_part = client.partition_count
+    stream = _write_put_stream(n, seed, tag=b"id")
+    solo_res = []
+    for i, (_ph, (hk, sk), v) in enumerate(stream):
+        solo_res.append(client.set(b"solo-" + hk, sk, v))
+        if i % 5 == 0:  # overwrite mix
+            solo_res.append(client.set(b"solo-" + hk, sk, v + b"!"))
+        if i % 9 == 0:
+            solo_res.append(client.delete(b"solo-" + hk, sk))
+    groups: dict = {}
+    order = []
+    for i, (_ph, (hk, sk), v) in enumerate(stream):
+        def add(op, hk=hk, sk=sk):
+            ph = key_hash_parts(b"batch-" + hk, sk)
+            pidx = ph % n_part
+            lst = groups.setdefault(pidx, [])
+            order.append((pidx, len(lst)))
+            lst.append((op[0], op[1], ph))
+        add((OP_PUT, (generate_key(b"batch-" + hk, sk), v, 0)))
+        if i % 5 == 0:
+            add((OP_PUT, (generate_key(b"batch-" + hk, sk), v + b"!", 0)))
+        if i % 9 == 0:
+            add((OP_REMOVE, (generate_key(b"batch-" + hk, sk),)))
+    got = client.write_multi(groups)
+    batch_res = [got[p][i] for p, i in order]
+    if batch_res != solo_res:
+        return False
+    for _ph, (hk, sk), _v in stream:
+        if client.get(b"solo-" + hk, sk) != client.get(b"batch-" + hk, sk):
+            return False
+    return True
 
 
 def verify_point_batch_identity(bc, n_hashkeys, seed, n=512) -> bool:
@@ -890,6 +1037,69 @@ def main() -> None:
                      f"batch={base_batch} ratio {ratio_bn:.3f}")
                 _log(f"point-get: accel {ops_g / accel_g:.0f} q/s, "
                      f"cpu {ops_g / cpu_g:.0f} q/s, hits {hits_g}/{ops_g}")
+
+                # batched write hot path (the round-7 write-side
+                # tentpole): the same put workload single-request vs
+                # coalesced `wb` per flush through write_multi (one
+                # client_write_batch RPC per node per flush, one
+                # mutation per touched partition, one group-commit
+                # window), plus the server-side pair and the
+                # results/state identity acceptance gate
+                w_ops = max(2000, n_ops // 4)
+                wb = int(os.environ.get("PEGBENCH_WRITE_BATCH", 32))
+                w_identical = verify_write_batch_identity(bc, seed + 11)
+                assert w_identical, \
+                    "batched write results/state diverged from solo"
+                run_puts(bc, 500, seed + 12, tag=b"wwarm")  # warm path
+                ops_ws, errs_ws, solo_w = run_puts(bc, w_ops, seed + 13)
+                ops_wb, errs_wb, batch_w = run_puts_batched(
+                    bc, w_ops, seed + 14, batch=wb)
+                sv_n, sv_solo_s = run_puts_server_side(
+                    bc, w_ops, seed + 15, batch=0)
+                _svn, sv_b_s = run_puts_server_side(
+                    bc, w_ops, seed + 16, batch=wb)
+                # short fsync-mode segment: the group-commit window's
+                # shared fsync measured against op count, then the
+                # default sync mode restored
+                from pegasus_tpu.utils.flags import FLAGS as _FLAGS
+                from pegasus_tpu.utils.metrics import METRICS as _MET
+
+                fs_counter = _MET.entity("write", "node0").counter(
+                    "plog_fsync_count")
+                _FLAGS.set("pegasus.replica", "plog_sync_mode", "fsync")
+                try:
+                    fs0 = fs_counter.value()
+                    run_puts_batched(bc, 1024, seed + 17, batch=wb,
+                                     tag=b"wfs")
+                    w_fsyncs = fs_counter.value() - fs0
+                finally:
+                    _FLAGS.set("pegasus.replica", "plog_sync_mode",
+                               "flush")
+                w_ratio = (ops_wb / batch_w) / (ops_ws / solo_w)
+                details["phases"]["write_put_batch"] = {
+                    "batch": wb,
+                    "solo_qps": round(ops_ws / solo_w, 2),
+                    "batched_qps": round(ops_wb / batch_w, 2),
+                    "vs_single_request": round(w_ratio, 3),
+                    "server_side_solo_qps": round(sv_n / sv_solo_s, 2),
+                    f"server_side_batch{wb}_qps": round(
+                        sv_n / sv_b_s, 2),
+                    "server_side_speedup": round(sv_solo_s / sv_b_s, 3),
+                    "errors": errs_ws + errs_wb,
+                    "identical_to_solo": w_identical,
+                    "meets_1_8x": w_ratio >= 1.8,
+                    "fsync_mode_segment": {
+                        "ops": 1024, "plog_fsyncs": w_fsyncs,
+                        "fsyncs_per_op": round(w_fsyncs / 1024, 4)},
+                }
+                save_details()
+                _log(f"write-put-batch({wb}): "
+                     f"{ops_ws / solo_w:.0f} -> {ops_wb / batch_w:.0f} "
+                     f"w/s client path ({w_ratio:.2f}x); server-side "
+                     f"{sv_n / sv_solo_s:.0f} -> {sv_n / sv_b_s:.0f} w/s "
+                     f"({sv_solo_s / sv_b_s:.2f}x); "
+                     f"identical={w_identical}; fsync-mode segment: "
+                     f"{w_fsyncs} fsyncs / 1024 ops")
 
                 if do_compact:
                     gb = float(os.environ.get("PEGBENCH_COMPACT_GB", "1.0"))

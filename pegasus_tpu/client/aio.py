@@ -32,7 +32,7 @@ class AsyncPegasusClient:
         "multi_set", "multi_get", "multi_get_sortkeys", "multi_del",
         "batch_get", "sortkey_count", "check_and_set",
         "check_and_mutate", "scan_multi", "scan_page", "scan_abort",
-        "point_read_multi",
+        "point_read_multi", "write_multi",
     )
 
     def __init__(self, client, max_workers: int = 1,

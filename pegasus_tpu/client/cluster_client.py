@@ -654,6 +654,117 @@ class ClusterClient:
                 f"point_read_multi: partitions {stuck} unreachable")
         return out
 
+    def write_multi(self, groups: Dict[int, list]):
+        """Batched writes (set / del / multi_set / multi_del — plus
+        atomic ops, which ride alone server-side) for MANY partitions
+        in as few node round-trips as possible — the write-side twin of
+        point_read_multi: partitions group by their primary node, each
+        node replicates its whole flush through per-partition 2PC
+        inside one group-commit window. `groups`: {pidx: [(op_code,
+        request, partition_hash)]} (op_code/request exactly as the solo
+        `_write` sends them). Returns {pidx: [result]} (the caller's
+        grouping, original op order) with per-op results identical to
+        the solo write handlers.
+
+        Retry machinery mirrors point_read_multi: ops re-route per
+        attempt from partition_hash, per-op retryable errors (ERR_BUSY
+        overload, per-op deadline fast-fail, split misroute) retry just
+        that op. A LOST reply is ambiguous for atomic ops in flight on
+        that node (they may have committed) — surfaced as ERR_TIMEOUT
+        instead of retried, like the solo path."""
+        from pegasus_tpu.replica.mutation import ATOMIC_OPS
+
+        self._ensure_config()
+        items = [(orig_pidx, i, op)
+                 for orig_pidx, ops in groups.items()
+                 for i, op in enumerate(ops)]
+        out: Dict[int, list] = {pidx: [None] * len(ops)
+                                for pidx, ops in groups.items()}
+        unresolved = set(range(len(items)))
+        deadline = self._deadline()
+        for attempt in range(self._max_retries):
+            if not unresolved:
+                break
+            if attempt:
+                if self._clock() > deadline:
+                    break  # surfaced below as partitions-unreachable
+                self.backoff.sleep(attempt)
+                try:
+                    self.refresh_config(deadline)
+                except PegasusError:
+                    continue  # meta momentarily down; cached config may
+                    # still be right on the next pass
+            send: Dict[str, Dict[int, list]] = {}
+            for idx in sorted(unresolved):
+                orig_pidx, _i, op = items[idx]
+                ph = op[2] if len(op) > 2 else None
+                pidx = (ph % self.partition_count if ph is not None
+                        else orig_pidx)
+                primary = self._primary_of(pidx)
+                if primary:
+                    send.setdefault(primary, {}).setdefault(
+                        pidx, []).append((idx, op))
+            if not send:
+                continue  # mid-failover: refresh and retry, like _write
+            rids = []
+            for node, pmap in send.items():
+                node_groups = [
+                    ((self.app_id, pidx),
+                     [([(op[0], op[1])],
+                       op[2] if len(op) > 2 else None, deadline)
+                      for _i, op in lst])
+                    for pidx, lst in pmap.items()]
+                rids.append((self._send_request(
+                    node, "client_write_batch",
+                    {"groups": node_groups, "auth": self.auth},
+                    deadline=deadline), pmap))
+            for rid, pmap in rids:
+                reply = self._await(rid, deadline)
+                if reply is None:
+                    # ambiguous: the node may have committed some of
+                    # the batch. Idempotent ops retry; an atomic op in
+                    # flight here must surface the timeout instead
+                    for lst in pmap.values():
+                        for idx, op in lst:
+                            if (idx in unresolved
+                                    and op[0] in ATOMIC_OPS):
+                                raise PegasusError(
+                                    ErrorCode.ERR_TIMEOUT,
+                                    "atomic write reply lost")
+                    continue
+                if reply["err"] != _OK:
+                    continue  # retried next attempt
+                for pidx, err, item_res in reply["result"]:
+                    sent = pmap.get(pidx)
+                    if sent is None:
+                        continue
+                    if err == int(ErrorCode.ERR_ACL_DENY):
+                        raise PegasusError(ErrorCode.ERR_ACL_DENY,
+                                           "write_multi")
+                    if err in _RETRYABLE:
+                        continue  # stale primary/splitting; re-resolve
+                    if err != _OK:
+                        raise PegasusError(ErrorCode(err), "write_multi")
+                    for (idx, _op), (op_err, op_results) in zip(
+                            sent, item_res):
+                        if op_err in _RETRYABLE:
+                            # per-op deadline fast-fail / ERR_BUSY shed
+                            # / split misroute: nothing ran — safe to
+                            # retry even atomic ops
+                            continue
+                        if op_err != _OK:
+                            raise PegasusError(ErrorCode(op_err),
+                                               "write_multi")
+                        orig_pidx, i, _o = items[idx]
+                        out[orig_pidx][i] = op_results[0]
+                        unresolved.discard(idx)
+        if unresolved:
+            stuck = sorted({items[i][0] for i in unresolved})
+            raise PegasusError(
+                ErrorCode.ERR_TIMEOUT,
+                f"write_multi: partitions {stuck} unreachable")
+        return out
+
     def scan_page(self, pidx: int, context_id: int):
         """Continue a server-held scan context (batched-path paging)."""
         return self._read("scan", context_id, pidx)

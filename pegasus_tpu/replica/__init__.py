@@ -3,8 +3,10 @@
 from pegasus_tpu.replica.mutation import Mutation, WriteOp
 from pegasus_tpu.replica.prepare_list import PrepareList
 from pegasus_tpu.replica.mutation_log import MutationLog
+from pegasus_tpu.replica.group_commit import WriteFlushWindow
 from pegasus_tpu.replica.replica import (
     PartitionStatus,
     Replica,
+    ReplicaBusyError,
     ReplicaConfig,
 )

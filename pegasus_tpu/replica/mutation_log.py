@@ -6,8 +6,18 @@ replays on boot to rebuild the prepare list, learning reads ranges back
 out (mutation_log.h:231), and GC drops everything at or below the durable
 (flushed-to-storage) decree (mutation_log.h:213).
 
-Frame format: [u32 len][u32 crc32][encoded mutation], same torn-tail
-recovery contract as the storage WAL.
+Frame format: the shared framed-log codec (storage/framed_log.py —
+[u32 len][u32 crc32][encoded mutation]), same torn-tail recovery
+contract as the storage WAL.
+
+Group commit: `append(mu, flush=False)` stages a frame in the append
+buffer without making it OS-visible; the node-level plog batcher
+(replica/group_commit.py) later calls `commit_window()` ONCE per
+transport flush window — one flush (and at most one fsync) covers every
+mutation staged across all partitions in the window, and acks are
+released only after it returns, so the appended-before-acked contract
+is unchanged. Readers (learning, duplication tailing, GC) call through
+`_ensure_flushed` so a buffered tail is never invisible to them.
 """
 
 from __future__ import annotations
@@ -16,12 +26,10 @@ import os
 
 from pegasus_tpu.storage.efile import open_data_file, repair_truncate
 import struct
-from typing import Iterator, List, Optional, Tuple
+from typing import Iterable, Iterator, List, Optional, Tuple
 
-from pegasus_tpu.base.crc import crc32
 from pegasus_tpu.replica.mutation import Mutation
-
-_FRAME = struct.Struct("<II")
+from pegasus_tpu.storage.framed_log import iter_frames, pack_frame
 
 
 class MutationLog:
@@ -34,6 +42,9 @@ class MutationLog:
         if valid_end is not None:
             repair_truncate(path, valid_end)
         self._f = open_data_file(path, "ab")
+        # frames written but not yet flushed to the OS (group commit);
+        # readers flush before reopening the file
+        self._buffered = False
         # bumped whenever the file is rewritten (gc): readers holding byte
         # offsets must restart from 0 when the generation changes
         self.generation = 0
@@ -45,26 +56,58 @@ class MutationLog:
             return None, 0
         with open_data_file(path, "rb") as f:
             data = f.read()
-        pos = 0
         max_decree = 0
-        while pos + _FRAME.size <= len(data):
-            length, want = _FRAME.unpack_from(data, pos)
-            end = pos + _FRAME.size + length
-            if end > len(data) or crc32(data[pos + _FRAME.size:end]) != want:
-                return pos, max_decree
-            (decree,) = struct.unpack_from("<Q", data, pos + _FRAME.size + 8)
+        pos = 0
+        for payload, end in iter_frames(data):
+            (decree,) = struct.unpack_from("<Q", payload, 8)
             max_decree = max(max_decree, decree)
             pos = end
         return (pos if pos < len(data) else None), max_decree
 
-    def append(self, mu: Mutation, sync: bool = False) -> None:
-        blob = mu.encode()
-        self._f.write(_FRAME.pack(len(blob), crc32(blob)))
-        self._f.write(blob)
+    def append(self, mu: Mutation, sync: bool = False,
+               flush: bool = True) -> None:
+        """Append one mutation. `flush=False` stages the frame in the
+        append buffer for a later `commit_window()` (group commit) —
+        the caller owns NOT acking until that commit happens."""
+        self._f.write(pack_frame(mu.encode()))
+        if flush:
+            self._f.flush()
+            if sync:
+                os.fsync(self._f.fileno())
+        else:
+            self._buffered = True
+        self.max_decree = max(self.max_decree, mu.decree)
+
+    def append_batch(self, mus: Iterable[Mutation],
+                     sync: bool = False) -> None:
+        """Append many mutations as one buffered write + one flush (and
+        at most one fsync) — the storage WAL's append_batch shape."""
+        frames = []
+        for mu in mus:
+            frames.append(pack_frame(mu.encode()))
+            self.max_decree = max(self.max_decree, mu.decree)
+        if not frames:
+            return
+        self._f.write(b"".join(frames))
         self._f.flush()
+        self._buffered = False
         if sync:
             os.fsync(self._f.fileno())
-        self.max_decree = max(self.max_decree, mu.decree)
+
+    def commit_window(self, sync: bool = False) -> None:
+        """Make every buffered append durable: one flush, one optional
+        fsync, shared by all frames staged since the last commit."""
+        self._f.flush()
+        self._buffered = False
+        if sync:
+            os.fsync(self._f.fileno())
+
+    def _ensure_flushed(self) -> None:
+        """Readers reopen the file by path; a buffered tail must reach
+        the OS first or they would serve a stale prefix."""
+        if self._buffered:
+            self._f.flush()
+            self._buffered = False
 
     @staticmethod
     def replay(path: str) -> Iterator[Mutation]:
@@ -72,17 +115,8 @@ class MutationLog:
             return
         with open_data_file(path, "rb") as f:
             data = f.read()
-        pos = 0
-        while pos + _FRAME.size <= len(data):
-            length, want = _FRAME.unpack_from(data, pos)
-            end = pos + _FRAME.size + length
-            if end > len(data):
-                return
-            blob = data[pos + _FRAME.size:end]
-            if crc32(blob) != want:
-                return
-            yield Mutation.decode(blob)
-            pos = end
+        for payload, _end in iter_frames(data):
+            yield Mutation.decode(payload)
 
     def read_range(self, start_decree: int,
                    end_decree: Optional[int] = None) -> List[Mutation]:
@@ -90,6 +124,7 @@ class MutationLog:
         LT_LOG ships these, replica_learn.cpp:483-508). The log may hold
         multiple entries per decree (ballot changes); the highest-ballot
         one wins, matching replay semantics."""
+        self._ensure_flushed()
         best: dict[int, Mutation] = {}
         for mu in self.replay(self.path):
             if mu.decree < start_decree:
@@ -108,22 +143,12 @@ class MutationLog:
         stop mid-batch WITHOUT skipping unprocessed frames — it resumes
         from the last frame it actually consumed. Callers re-tail from 0
         when `generation` changes."""
+        self._ensure_flushed()
         with open_data_file(self.path, "rb") as f:
             f.seek(offset)
             data = f.read()
-        out: List[Tuple[Mutation, int]] = []
-        pos = 0
-        while pos + _FRAME.size <= len(data):
-            length, want = _FRAME.unpack_from(data, pos)
-            end = pos + _FRAME.size + length
-            if end > len(data):
-                break
-            blob = data[pos + _FRAME.size:end]
-            if crc32(blob) != want:
-                break
-            out.append((Mutation.decode(blob), offset + end))
-            pos = end
-        return out
+        return [(Mutation.decode(payload), offset + end)
+                for payload, end in iter_frames(data)]
 
     def gc(self, durable_decree: int) -> None:
         """Drop everything <= durable_decree.
@@ -135,14 +160,13 @@ class MutationLog:
         window and the mutations duplication has not yet shipped (the gc
         floor is held back precisely to preserve those).
         """
+        self._ensure_flushed()
         keep = [mu for mu in self.replay(self.path)
                 if mu.decree > durable_decree]
         tmp = self.path + ".gc.tmp"
         with open_data_file(tmp, "wb") as f:
             for mu in keep:
-                blob = mu.encode()
-                f.write(_FRAME.pack(len(blob), crc32(blob)))
-                f.write(blob)
+                f.write(pack_frame(mu.encode()))
             f.flush()
             os.fsync(f.fileno())
         # replace first, swap the append handle after: if the replace
@@ -161,4 +185,5 @@ class MutationLog:
             self.generation += 1
 
     def close(self) -> None:
+        self._ensure_flushed()
         self._f.close()

@@ -83,6 +83,14 @@ def _serial(fn):
 PREPARE_LIST_CAPACITY = 1024
 
 
+class ReplicaBusyError(RuntimeError):
+    """Write-queue overload: the mutation queue is full, or a
+    non-batchable op is stuck behind an in-flight round. RETRYABLE —
+    the stub maps it to ERR_BUSY so the client's backoff machinery
+    handles write overload exactly like read shedding (never
+    ERR_INVALID_STATE, which would burn a config refresh per retry)."""
+
+
 class PartitionStatus(enum.IntEnum):
     INACTIVE = 0
     ERROR = 1
@@ -126,6 +134,8 @@ class Replica:
         self.config = ReplicaConfig(ballot=0, primary="", secondaries=[])
         self._access = SerialAccessChecker(
             f"replica {app_id}.{pidx}@{name}")
+        # fail-point site names are hot-path lookups: built once
+        self._fp_primary_plog = f"{name}::primary_plog_append"
         self.prepare_list = PrepareList(
             self.server.engine.last_committed_decree, PREPARE_LIST_CAPACITY,
             self._apply_mutation)
@@ -171,6 +181,16 @@ class Replica:
         # reads and writes alike
         self._traces: Dict[int, Any] = {}
         self.slow_log = self.server.slow_log
+        # node-level write flush window (group_commit.WriteFlushWindow),
+        # set by the hosting stub: plog appends stage under its shared
+        # flush/fsync and prepare/ack sends aggregate per peer. None =
+        # immediate legacy behavior (directly-driven replicas).
+        self.plog_sink = None
+        # node-level "write" metric entity (stub-provided; None in
+        # directly-driven replicas); the queue-depth percentile caches
+        # lazily — it sits on the per-write hot path
+        self.write_metrics = None
+        self._queue_depth_metric = None
         # whether learn checkpoint paths are reachable via the local
         # filesystem (single host / shared fs). Multi-host deployments set
         # False on the stub and checkpoints travel via the file-transfer
@@ -277,15 +297,42 @@ class Replica:
             remu = replace(mu, ballot=self.config.ballot,
                            last_committed=self.last_committed_decree)
             self.prepare_list.prepare(remu)
-            self.log.append(remu)
+            self._log_append(remu)
             targets = self._prepare_targets(remu.decree)
             if targets:
                 self._pending_acks[remu.decree] = set(targets)
-            self._send_prepares(remu)
-            if not targets:
-                # never leave an empty entry (it would count toward the
-                # pipelining depth forever and wedge the write queue)
-                self._on_decree_ready(remu.decree)
+
+            def _ship(remu=remu, targets=targets) -> None:
+                self._send_prepares(remu)
+                if not targets:
+                    # never leave an empty entry (it would count toward
+                    # the pipelining depth forever and wedge the queue)
+                    self._on_decree_ready(remu.decree)
+
+            self._after_durable(_ship)
+
+    # ---- group-commit plumbing ----------------------------------------
+
+    def _log_append(self, mu: Mutation) -> None:
+        """Plog append through the node's group-commit window when one
+        is open (one shared flush/fsync per window); immediate append
+        otherwise."""
+        sink = self.plog_sink
+        if sink is not None:
+            sink.append(self.log, mu)
+        else:
+            self.log.append(mu)
+
+    def _after_durable(self, fn: Callable[[], None]) -> None:
+        """Run `fn` only once every mutation staged in the current
+        flush window is durable — the ack-after-durable contract under
+        group commit. Immediate when no window is open (the append
+        already flushed)."""
+        sink = self.plog_sink
+        if sink is not None:
+            sink.after_durable(fn)
+        else:
+            fn()
 
     # ---- client write path (primary) ----------------------------------
 
@@ -309,6 +356,11 @@ class Replica:
             raise RuntimeError(f"{self.name}: not primary")
         if any(wo.op in ATOMIC_OPS for wo in ops) and len(ops) > 1:
             raise ValueError("atomic ops cannot batch with other writes")
+        if self.write_metrics is not None:
+            if self._queue_depth_metric is None:
+                self._queue_depth_metric = self.write_metrics.percentile(
+                    "pipeline_queue_depth")
+            self._queue_depth_metric.set(len(self._queued_ops))
         if (self._write_queue
                 or len(self._pending_acks) >= self.PIPELINE_DEPTH):
             # the window is at its pipelining depth (or earlier writes
@@ -323,7 +375,7 @@ class Replica:
                 self._write_queue.append((len(ops), callback))
                 self._queued_ops.extend(ops)
                 return -1
-            raise RuntimeError(
+            raise ReplicaBusyError(
                 f"{self.name}: write queue busy (retry)")
         decree = self.last_prepared_decree() + 1
         ts = max(int(self.clock() * 1_000_000), self._last_timestamp_us + 1)
@@ -344,7 +396,7 @@ class Replica:
             # an open window could hold a conflicting earlier write, so
             # busy-reject and let the client retry after it drains.
             if self.last_committed_decree != self.last_prepared_decree():
-                raise RuntimeError(
+                raise ReplicaBusyError(
                     f"{self.name}: atomic write on a duplicated table "
                     f"must wait for the in-flight window")
             ops, idem_responses = self._make_idempotent(ops, ts)
@@ -371,27 +423,34 @@ class Replica:
         # not ack, and must not send prepares it hasn't durably staged)
         from pegasus_tpu.utils.fail_point import fail_point
 
-        if fail_point(f"{self.name}::primary_plog_append") is not None:
+        if fail_point(self._fp_primary_plog) is not None:
             self._traces.pop(decree, None)
             self._idempotent_responses.pop(decree, None)
             raise RuntimeError(
                 f"{self.name}: primary plog append failed (fault)")
         self.prepare_list.prepare(mu)
         tracer.add_point("prepare_local")
-        self.log.append(mu)
+        self._log_append(mu)
         tracer.add_point("append_plog")
         if callback is not None:
             self._client_callbacks[decree] = callback
         targets = self._prepare_targets(decree)
         if targets:
             self._pending_acks[decree] = set(targets)
-        self._send_prepares(mu)
-        tracer.add_point("prepares_sent")
-        if not targets:
-            # no members to wait on: ready now. (Never leave an EMPTY
-            # entry in _pending_acks — it would count toward the
-            # pipelining depth forever and wedge the write queue.)
-            self._on_decree_ready(decree)
+
+        def _ship() -> None:
+            # runs after the group-commit window hardened the plog (a
+            # primary must not send prepares — or ack a zero-member
+            # round — before its own log write is durable)
+            self._send_prepares(mu)
+            tracer.add_point("prepares_sent")
+            if not targets:
+                # no members to wait on: ready now. (Never leave an
+                # EMPTY entry in _pending_acks — it would count toward
+                # the pipelining depth forever and wedge the queue.)
+                self._on_decree_ready(decree)
+
+        self._after_durable(_ship)
         return decree
 
     def _prepare_targets(self, decree: int) -> List[str]:
@@ -401,8 +460,11 @@ class Replica:
         return targets
 
     def _send_prepares(self, mu: Mutation) -> None:
+        targets = self._prepare_targets(mu.decree)
+        if not targets:
+            return  # single-replica: skip the dead encode entirely
         blob = mu.encode()
-        for dst in self._prepare_targets(mu.decree):
+        for dst in targets:
             self.transport.send(self.name, dst, "prepare", blob)
 
     # ---- 2PC message handlers -----------------------------------------
@@ -468,15 +530,20 @@ class Replica:
                 "decree": mu.decree, "ballot": self.config.ballot,
                 "err": int(ErrorCode.ERR_FILE_OPERATION_FAILED)})
             return
-        self.log.append(mu)
+        self._log_append(mu)
         # advance commit point from the piggy-backed primary commit
         mode = (COMMIT_TO_DECREE_HARD
                 if self.status == PartitionStatus.SECONDARY
                 else COMMIT_TO_DECREE_SOFT)
         self.prepare_list.commit(min(mu.last_committed, mu.decree - 1), mode)
-        self.transport.send(self.name, src, "prepare_ack", {
-            "decree": mu.decree, "ballot": mu.ballot,
-            "err": int(ErrorCode.ERR_OK)})
+        # the OK ack waits for the group-commit window's shared
+        # flush/fsync: "appended before it can be acked" must mean
+        # DURABLY appended, or a crash mid-window could lose a
+        # mutation the primary already counted as replicated here
+        self._after_durable(lambda: self.transport.send(
+            self.name, src, "prepare_ack", {
+                "decree": mu.decree, "ballot": mu.ballot,
+                "err": int(ErrorCode.ERR_OK)}))
 
     @_serial
     def _on_prepare_ack(self, src: str, ack: dict) -> None:
@@ -608,30 +675,56 @@ class Replica:
         # any mutation applied after its merge snapshot began — acked
         # writes silently lost (found by the combined-chaos drive:
         # sustained load + env compaction on a live onebox).
+        from pegasus_tpu.server.capacity_units import units as _cu_units
+
         with self.server._write_lock:
-            for wo in mu.ops:
+            # vectorized translate: homogeneous PUT/REMOVE runs go
+            # through one run-translate pass (single timetag sweep —
+            # byte-identical output) and CU accounting batches into ONE
+            # counter touch per mutation instead of one per op (the
+            # LUDA observation: per-record write-path work collapses
+            # once the records travel in batches, arXiv:2004.03054)
+            ok = int(ErrorCode.ERR_OK)
+            ops = mu.ops
+            n_ops = len(ops)
+            cu_total = 0
+            i = 0
+            while i < n_ops:
+                wo = ops[i]
                 if wo.op == OP_PUT:
-                    key, user_data, expire_ts = wo.request
-                    cu.add_write(len(key) + len(user_data))
-                    its = ws.translate_put(key, user_data, expire_ts, ts)
-                    responses.append(int(ErrorCode.ERR_OK))
-                elif wo.op == OP_REMOVE:
-                    cu.add_write(len(wo.request[0]))
-                    its = ws.translate_remove(wo.request[0])
-                    responses.append(int(ErrorCode.ERR_OK))
-                elif wo.op == OP_MULTI_PUT:
-                    cu.add_write(len(wo.request.hash_key) + sum(
+                    j = i + 1
+                    while j < n_ops and ops[j].op == OP_PUT:
+                        j += 1
+                    reqs = [w.request for w in ops[i:j]]
+                    cu_total += sum(_cu_units(len(k) + len(ud))
+                                    for k, ud, _ets in reqs)
+                    items.extend(ws.translate_put_run(reqs, ts))
+                    responses.extend([ok] * (j - i))
+                    i = j
+                    continue
+                if wo.op == OP_REMOVE:
+                    j = i + 1
+                    while j < n_ops and ops[j].op == OP_REMOVE:
+                        j += 1
+                    keys = [w.request[0] for w in ops[i:j]]
+                    cu_total += sum(_cu_units(len(k)) for k in keys)
+                    items.extend(ws.translate_remove_run(keys))
+                    responses.extend([ok] * (j - i))
+                    i = j
+                    continue
+                if wo.op == OP_MULTI_PUT:
+                    cu_total += _cu_units(len(wo.request.hash_key) + sum(
                         len(kv.key) + len(kv.value)
                         for kv in wo.request.kvs))
                     err, its = ws.translate_multi_put(wo.request, ts, now)
                     responses.append(err)
                 elif wo.op == OP_MULTI_REMOVE:
-                    cu.add_write(len(wo.request.hash_key) + sum(
+                    cu_total += _cu_units(len(wo.request.hash_key) + sum(
                         len(sk) for sk in wo.request.sort_keys))
                     err, count, its = ws.translate_multi_remove(wo.request)
                     responses.append((err, count))
                 elif wo.op == OP_INCR:
-                    cu.add_write(len(wo.request.key))
+                    cu_total += _cu_units(len(wo.request.key))
                     resp, its = ws.translate_incr(wo.request, ts, now)
                     resp.decree = mu.decree
                     responses.append(resp)
@@ -663,7 +756,18 @@ class Replica:
                 else:
                     raise ValueError(f"unknown op {wo.op}")
                 items.extend(its)
-            ws.apply_items(items, mu.decree)
+                i += 1
+            cu.add_write_units(cu_total)
+            sink = self.plog_sink
+            if sink is not None and sink.wal_flush_deferred():
+                # the engine-WAL frame rides the IO buffer: the ack's
+                # durability lives in the private log (hardened before
+                # this callback ran), and every decree this WAL could
+                # recover replays from the plog anyway — see
+                # WriteFlushWindow.wal_flush_deferred
+                ws.apply_items(items, mu.decree, wal_flush=False)
+            else:
+                ws.apply_items(items, mu.decree)
         tracer = self._traces.pop(mu.decree, None)
         if tracer is not None:
             tracer.add_point("committed_applied")
@@ -902,10 +1006,13 @@ class Replica:
             if mu.decree <= self.last_committed_decree:
                 continue
             self.prepare_list.prepare(mu)
-            self.log.append(mu)
+            self._log_append(mu)
         self.prepare_list.commit(payload["last_committed"],
                                  COMMIT_TO_DECREE_HARD)
-        self.transport.send(self.name, src, "learn_completion", {})
+        # completion claims the learner HOLDS the tail — wait for the
+        # window's shared flush like any other post-append ack
+        self._after_durable(lambda: self.transport.send(
+            self.name, src, "learn_completion", {}))
 
     def _apply_learned_checkpoint(self, checkpoint_dir: str,
                                   checkpoint_decree: int) -> None:

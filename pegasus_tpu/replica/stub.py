@@ -15,20 +15,35 @@ from __future__ import annotations
 import os
 from typing import Callable, Dict, Optional, Tuple
 
-from pegasus_tpu.replica.replica import PartitionStatus, Replica, ReplicaConfig
+from pegasus_tpu.replica.replica import (
+    PartitionStatus,
+    Replica,
+    ReplicaBusyError,
+    ReplicaConfig,
+)
 
 Gpid = Tuple[int, int]  # (app_id, partition_index)
 
 
 class _GpidTransport:
-    """Binds a replica's sends to its node + gpid envelope."""
+    """Binds a replica's sends to its node + gpid envelope. Prepares
+    and prepare acks divert into the node's write flush window while
+    one is open, so a window's worth of per-partition 2PC traffic to
+    one peer collapses into a single prepare_batch/prepare_batch_ack
+    message (group_commit.WriteFlushWindow)."""
 
-    def __init__(self, net, node_name: str, gpid: Gpid) -> None:
+    def __init__(self, net, node_name: str, gpid: Gpid,
+                 window=None) -> None:
         self._net = net
         self._node = node_name
         self._gpid = gpid
+        self._window = window
 
     def send(self, _src: str, dst: str, msg_type: str, payload) -> None:
+        if (self._window is not None
+                and self._window.queue_replica_msg(
+                    dst, msg_type, self._gpid, payload)):
+            return
         self._net.send(self._node, dst, "replica", {
             "gpid": self._gpid, "type": msg_type, "payload": payload})
 
@@ -109,6 +124,15 @@ class ReplicaStub:
         self.transfer = TransferServer(net, name, self.fs.data_dirs)
         self._fetch_sessions: Dict = {}
         self._last_beacon_ack = float("-inf")
+        # node-level write flush window: plog group commit (one shared
+        # flush/fsync per dispatch window across every partition) +
+        # prepare fan-out aggregation; metrics live on the node's
+        # "write" entity next to the transport's read-shed counters
+        from pegasus_tpu.replica.group_commit import WriteFlushWindow
+        from pegasus_tpu.utils.metrics import METRICS
+
+        self.write_metrics = METRICS.entity("write", name)
+        self.write_window = WriteFlushWindow(net, name, self.write_metrics)
         net.register(name, self.on_message)
         batch_reg = getattr(net, "register_batch", None)
         if batch_reg is not None:
@@ -117,6 +141,10 @@ class ReplicaStub:
             # (get/ttl/multi_get(sort keys)/batch_get) serve through the
             # cross-partition read coordinator in one flush
             batch_reg(name, "client_read", self._on_client_read_batch)
+            # and a consecutive run of queued client writes shares ONE
+            # group-commit window (solo writes over TCP coalesce their
+            # plog hardening + prepare fan-out without client changes)
+            batch_reg(name, "client_write", self._on_client_write_window)
         # load existing replica dirs across every data dir (parity:
         # replica_stub boot scan, replica_stub.cpp:594 load_replicas per
         # disk); each dir carries a .replica_info with its partition_count
@@ -325,9 +353,12 @@ class ReplicaStub:
                     json.dump({"app_id": gpid[0], "pidx": gpid[1],
                                "partition_count": partition_count}, f)
             r = Replica(self.name, rdir,
-                        _GpidTransport(self.net, self.name, gpid),
+                        _GpidTransport(self.net, self.name, gpid,
+                                       self.write_window),
                         app_id=gpid[0], pidx=gpid[1],
                         partition_count=partition_count, clock=self.clock)
+            r.plog_sink = self.write_window
+            r.write_metrics = self.write_metrics
             r.on_learn_completed = (
                 lambda learner, g=gpid: self._notify_learn_completed(g, learner))
             r.on_replication_error = (
@@ -346,6 +377,23 @@ class ReplicaStub:
     # ---- message routing ----------------------------------------------
 
     def on_message(self, src: str, msg_type: str, payload) -> None:
+        # every dispatch runs inside the node's write flush window:
+        # plog appends it causes stage under one shared flush/fsync and
+        # its prepare/ack fan-out aggregates per peer, all released
+        # when the (outermost) window closes
+        with self.write_window:
+            self._dispatch_message(src, msg_type, payload)
+
+    def _on_client_write_window(self, items) -> None:
+        """Transport flush-window delivery for writes: a consecutive
+        run of queued client_write messages shares ONE group-commit
+        window — one plog flush/fsync and one prepare_batch per peer
+        for the whole run."""
+        with self.write_window:
+            for src, payload in items:
+                self._on_client_write(src, payload)
+
+    def _dispatch_message(self, src: str, msg_type: str, payload) -> None:
         if msg_type == "replica":
             gpid = tuple(payload["gpid"])
             r = self.replicas.get(gpid)
@@ -356,6 +404,18 @@ class ReplicaStub:
                     gpid, payload["payload"].get("partition_count", 1))
             if r is not None:
                 r.on_message(src, payload["type"], payload["payload"])
+            return
+        if msg_type in ("prepare_batch", "prepare_batch_ack"):
+            # aggregated 2PC fan-out (group_commit): one message carries
+            # (gpid, payload) items for many partitions; items route in
+            # order to each partition's solo handler, and our own acks
+            # re-aggregate under the already-open flush window
+            kind = ("prepare" if msg_type == "prepare_batch"
+                    else "prepare_ack")
+            for gpid, item in payload["items"]:
+                r = self.replicas.get(tuple(gpid))
+                if r is not None:
+                    r.on_message(src, kind, item)
             return
         if msg_type == "negotiate":
             # SASL-style connection auth handshake (negotiation.h:37).
@@ -469,6 +529,9 @@ class ReplicaStub:
         if msg_type == "client_read_batch":
             self._on_client_read_batch_rpc(src, payload)
             return
+        if msg_type == "client_write_batch":
+            self._on_client_write_batch(src, payload)
+            return
         if msg_type == "client_write":
             self._on_client_write(src, payload)
             return
@@ -556,10 +619,141 @@ class ReplicaStub:
 
         try:
             r.client_write(ops, reply)
+        except ReplicaBusyError:
+            # typed retryable overload: the client backs off WITHOUT a
+            # config refresh (the routing is right, the queue is full)
+            self.net.send(self.name, src, "client_write_reply", {
+                "rid": rid, "err": int(ErrorCode.ERR_BUSY),
+                "results": []})
         except (RuntimeError, ValueError):
             self.net.send(self.name, src, "client_write_reply", {
                 "rid": rid, "err": int(ErrorCode.ERR_INVALID_STATE),
                 "results": []})
+
+    def _on_client_write_batch(self, src: str, payload: dict) -> None:
+        """Explicitly batched writes from the cluster client: one
+        message carries every write op for the partitions this node
+        hosts; each partition's run of batchable ops replicates as ONE
+        mutation through the existing 2PC pipeline (which keeps
+        coalescing via MAX_BATCH_OPS/PIPELINE_DEPTH), all inside one
+        group-commit window — one plog flush/fsync and one
+        prepare_batch per peer for the whole message.
+
+        payload: {rid, auth, deadline?, groups: [(gpid, items)]} with
+        items = [(ops, partition_hash, deadline), ...] and ops =
+        [(op_code, request), ...] (one item = one client write, the
+        shape solo client_write carries). Reply: {rid, err, result:
+        [(pidx, err, [(op_err, results)])]} aligned with the request's
+        groups; per-partition gate failures surface in their slot's
+        err, per-op failures (deadline, hash gate, busy) in that op's
+        own err, so the client retries exactly what failed. The reply
+        is sent only after every op's 2PC callback resolved (acks are
+        durability-gated by the group-commit window)."""
+        from pegasus_tpu.replica.mutation import ATOMIC_OPS, WriteOp
+        from pegasus_tpu.utils.errors import ErrorCode
+
+        ok = int(ErrorCode.ERR_OK)
+        rid = payload.get("rid")
+        if self._deadline_expired(payload):
+            # whole-batch deadline lapsed before any 2PC started: an
+            # unambiguous typed fast-fail (nothing ran — safe to retry)
+            self.net.send(self.name, src, "client_write_reply", {
+                "rid": rid, "err": int(ErrorCode.ERR_TIMEOUT),
+                "result": None})
+            return
+        groups = payload.get("groups") or []
+        slots: list = []
+        state = {"outstanding": 0, "armed": False, "replied": False}
+
+        def maybe_reply() -> None:
+            if (state["armed"] and not state["replied"]
+                    and state["outstanding"] == 0):
+                state["replied"] = True
+                self.net.send(self.name, src, "client_write_reply", {
+                    "rid": rid, "err": ok, "result": slots})
+
+        for gpid, items in groups:
+            gpid = tuple(gpid)
+            r = self.replicas.get(gpid)
+            if not self._client_allowed(r, payload, access="w", src=src):
+                slots.append((gpid[1], int(ErrorCode.ERR_ACL_DENY),
+                              None))
+                continue
+            if r is not None and getattr(r, "splitting", False):
+                slots.append((gpid[1], int(ErrorCode.ERR_SPLITTING),
+                              None))
+                continue
+            if (r is None or r.status != PartitionStatus.PRIMARY
+                    or getattr(r, "restoring", False)
+                    or not self.lease_valid()):
+                slots.append((gpid[1],
+                              int(ErrorCode.ERR_INVALID_STATE), None))
+                continue
+            item_res: list = [None] * len(items)
+            slots.append((gpid[1], ok, item_res))
+
+            def submit(spans, ops_list, replica=r, results=item_res):
+                """One client_write for a combined run; its response
+                list splits back per original item via the spans."""
+                if not ops_list:
+                    return
+
+                def cb(res, spans=spans, results=results) -> None:
+                    off = 0
+                    for i, n in spans:
+                        results[i] = (ok, res[off:off + n])
+                        off += n
+                    state["outstanding"] -= 1
+                    maybe_reply()
+
+                state["outstanding"] += 1
+                try:
+                    replica.client_write(ops_list, cb)
+                except ReplicaBusyError:
+                    state["outstanding"] -= 1
+                    for i, _n in spans:
+                        results[i] = (int(ErrorCode.ERR_BUSY), [])
+                except (RuntimeError, ValueError):
+                    state["outstanding"] -= 1
+                    for i, _n in spans:
+                        results[i] = (int(ErrorCode.ERR_INVALID_STATE),
+                                      [])
+
+            # runs of batchable ops combine into one client_write (one
+            # mutation); atomic ops ride alone, submission order kept
+            run_spans: list = []
+            run_ops: list = []
+            for i, (raw_ops, ph, dl) in enumerate(items):
+                if self._deadline_expired(
+                        {"deadline": dl if dl is not None
+                         else payload.get("deadline")}):
+                    # per-op deadline: THIS op fast-fails before its
+                    # 2PC starts; its window neighbors proceed
+                    item_res[i] = (int(ErrorCode.ERR_TIMEOUT), [])
+                    continue
+                gate = r.server._hash_gate(ph)
+                if gate:
+                    item_res[i] = (gate, [])
+                    continue
+                sgate = r.server._write_gate()
+                if sgate:
+                    # deny/throttle are STORAGE statuses per op, same
+                    # as the solo handler's [sgate] * len(ops) reply
+                    item_res[i] = (ok, [sgate] * len(raw_ops))
+                    continue
+                wos = [WriteOp(op, req) for op, req in raw_ops]
+                atomic = any(wo.op in ATOMIC_OPS for wo in wos)
+                if atomic or len(run_ops) + len(wos) > r.MAX_BATCH_OPS:
+                    submit(run_spans, run_ops)
+                    run_spans, run_ops = [], []
+                if atomic:
+                    submit([(i, len(wos))], wos)
+                else:
+                    run_spans.append((i, len(wos)))
+                    run_ops.extend(wos)
+            submit(run_spans, run_ops)
+        state["armed"] = True
+        maybe_reply()
 
     def _on_client_read(self, src: str, payload: dict) -> None:
         """Dispatch a read op to the partition's storage app through the

@@ -155,8 +155,10 @@ class FaultPlan:
                 self.dropped += 1
                 return None
             copies = 1
+            from pegasus_tpu.rpc.transport import WRITE_REQS
+
             dup = link_rule_lookup(self._dup, src, dst)
-            if dup > 0 and msg_type != "client_write" \
+            if dup > 0 and msg_type not in WRITE_REQS \
                     and self._rng.random() < dup:
                 copies = 2
                 self.duplicated += 1

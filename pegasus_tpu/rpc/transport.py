@@ -62,7 +62,13 @@ _CLIENT_REQS: Dict[str, Tuple[str, str, Any]] = {
     "client_read_batch": ("client_read_reply", "result", None),
     "client_scan_multi": ("client_read_reply", "result", None),
     "client_write": ("client_write_reply", "results", []),
+    "client_write_batch": ("client_write_reply", "result", None),
 }
+
+# mutation-path requests: exempt from overload shedding (availability
+# of writes degrades last) and from chaos duplication (no rid dedup —
+# a duplicated atomic write would double-apply)
+WRITE_REQS = ("client_write", "client_write_batch")
 
 
 class TcpTransport:
@@ -429,7 +435,7 @@ class TcpTransport:
                 # queue is deep or this message aged in it. Writes and
                 # replication traffic are exempt — availability of the
                 # mutation path degrades last.
-                if msg_type != "client_write":
+                if msg_type not in WRITE_REQS:
                     depth = self._inbox.qsize()
                     age_ms = (time.perf_counter() - t_enq) * 1000.0
                     if (depth > FLAGS.get("pegasus.rpc",

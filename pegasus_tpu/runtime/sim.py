@@ -19,6 +19,7 @@ from typing import Any, Callable, Dict, Optional, Tuple
 import numpy as np
 
 from pegasus_tpu.rpc.fault import link_rule_lookup
+from pegasus_tpu.rpc.transport import WRITE_REQS
 
 from pegasus_tpu.utils.profiler import PROFILER as _PROFILER
 
@@ -134,10 +135,10 @@ class SimNetwork:
         if prob > 0 and self.loop.rng.random() < prob:
             self.dropped += 1
             return
-        # client_write exempt from duplication, like FaultPlan.outbound:
+        # write requests exempt from duplication, like FaultPlan.outbound:
         # a duplicated atomic write would double-apply (no rid dedup)
         dup = link_rule_lookup(self._dup_prob, src, dst)
-        copies = 2 if (dup > 0 and msg_type != "client_write"
+        copies = 2 if (dup > 0 and msg_type not in WRITE_REQS
                        and self.loop.rng.random() < dup) else 1
         for _copy in range(copies):
             delay = (self.base_delay + self.loop.rng.random() * self.jitter

@@ -35,6 +35,12 @@ class CapacityUnitCalculator:
     def add_write(self, size: int) -> None:
         self._write_cu.increment(units(size))
 
+    def add_write_units(self, cu: int) -> None:
+        """Batch accounting: the caller pre-summed units(size) per
+        request (mutation apply — one counter touch per mutation)."""
+        if cu:
+            self._write_cu.increment(cu)
+
     @property
     def read_cu(self) -> int:
         return self._read_cu.value()

@@ -167,6 +167,28 @@ class WriteService:
     def translate_remove(self, key: bytes) -> List[WriteBatchItem]:
         return [WriteBatchItem(OP_DEL, key)]
 
+    def translate_put_run(self, reqs: List[Tuple[bytes, bytes, int]],
+                          timestamp_us: Optional[int] = None
+                          ) -> List[WriteBatchItem]:
+        """A homogeneous run of puts [(key, user_data, expire_ts)] in
+        ONE pass: the timetag is computed once for the whole run (every
+        op in a mutation shares the primary-assigned timestamp, so the
+        per-op sweep produced identical tags anyway) — byte-identical
+        to translate_put called per op."""
+        timetag = 0
+        if self.data_version >= 1:
+            ts = (timestamp_us if timestamp_us is not None
+                  else int(time.time() * 1_000_000))
+            timetag = generate_timetag(ts, self.cluster_id, False)
+        ver = self.data_version
+        return [WriteBatchItem(OP_PUT, key,
+                               generate_value(ver, ud, ets, timetag), ets)
+                for key, ud, ets in reqs]
+
+    def translate_remove_run(self, keys: List[bytes]
+                             ) -> List[WriteBatchItem]:
+        return [WriteBatchItem(OP_DEL, key) for key in keys]
+
     def translate_multi_put(self, req: MultiPutRequest,
                             timestamp_us: Optional[int] = None,
                             now: Optional[int] = None
@@ -353,11 +375,14 @@ class WriteService:
 
     # -- apply phase ----------------------------------------------------
 
-    def apply_items(self, items: List[WriteBatchItem], decree: int) -> None:
+    def apply_items(self, items: List[WriteBatchItem], decree: int,
+                    wal_flush: bool = True) -> None:
         """One engine batch per decree; empty item lists still advance the
         decree (reference empty_put, pegasus_write_service.cpp:210 — a
-        no-op write that carries the decree watermark)."""
-        self.engine.write_batch(items, decree)
+        no-op write that carries the decree watermark). `wal_flush=False`
+        defers the engine-WAL flush into the caller's group-commit
+        window."""
+        self.engine.write_batch(items, decree, wal_flush=wal_flush)
 
     # -- fused convenience (standalone mode) ----------------------------
 

@@ -6,16 +6,13 @@ frame carrying its decree, and replayed on boot from the last durable
 decree. The replication layer will layer its own mutation log on top; this
 WAL guards the memtable.
 
-Frame format (little-endian):
-    [u32 payload_len][u32 crc32(payload)][payload]
+Frame format (little-endian): the shared framed-log codec
+(storage/framed_log.py — [u32 payload_len][u32 crc32(payload)][payload]
+with torn-tail recovery) around:
 payload:
     [u64 decree][u32 record_count] record*
 record:
     [u8 op][u32 key_len][key][u32 value_len][value][u32 expire_ts]
-
-A torn tail (partial frame or crc mismatch) terminates replay — identical
-recovery contract to the reference's log_file replay
-(src/replica/mutation_log_replay.cpp).
 """
 
 from __future__ import annotations
@@ -25,13 +22,16 @@ import struct
 from dataclasses import dataclass
 from typing import Iterator, List, Optional, Tuple
 
-from pegasus_tpu.base.crc import crc32
 from pegasus_tpu.storage.efile import open_data_file, repair_truncate
+from pegasus_tpu.storage.framed_log import (
+    iter_frames,
+    pack_frame,
+    scan_valid_end,
+)
 
 OP_PUT = 0
 OP_DEL = 1
 
-_FRAME_HDR = struct.Struct("<II")
 _PAYLOAD_HDR = struct.Struct("<QI")
 _REC_HDR = struct.Struct("<BI")
 
@@ -65,19 +65,16 @@ class WriteAheadLog:
             return None
         with open_data_file(path, "rb") as f:
             data = f.read()
-        pos = 0
-        while pos + _FRAME_HDR.size <= len(data):
-            length, want_crc = _FRAME_HDR.unpack_from(data, pos)
-            frame_end = pos + _FRAME_HDR.size + length
-            if frame_end > len(data):
-                return pos
-            if crc32(data[pos + _FRAME_HDR.size:frame_end]) != want_crc:
-                return pos
-            pos = frame_end
-        return pos if pos < len(data) else None
+        return scan_valid_end(data)
 
     def append_batch(self, decree: int, records: List[WalRecord],
-                     sync: bool = False) -> None:
+                     sync: bool = False, flush: bool = True) -> None:
+        """`flush=False` leaves the frame in the IO buffer (the replica
+        apply path under a group-commit window: the ack's durability
+        rides the private log, which hardened first, and every decree
+        this WAL could recover also replays from the plog — the frame
+        reaches the OS when the buffer fills or truncate()/close()
+        flush it; a torn tail is recovered like any other)."""
         parts = [_PAYLOAD_HDR.pack(decree, len(records))]
         for r in records:
             parts.append(_REC_HDR.pack(r.op, len(r.key)))
@@ -85,9 +82,9 @@ class WriteAheadLog:
             parts.append(struct.pack("<I", len(r.value)))
             parts.append(r.value)
             parts.append(struct.pack("<I", r.expire_ts))
-        payload = b"".join(parts)
-        self._f.write(_FRAME_HDR.pack(len(payload), crc32(payload)))
-        self._f.write(payload)
+        self._f.write(pack_frame(b"".join(parts)))
+        if not flush:
+            return
         self._f.flush()
         if sync:
             os.fsync(self._f.fileno())
@@ -109,15 +106,7 @@ class WriteAheadLog:
             return
         with open_data_file(path, "rb") as f:
             data = f.read()
-        pos = 0
-        while pos + _FRAME_HDR.size <= len(data):
-            length, want_crc = _FRAME_HDR.unpack_from(data, pos)
-            frame_end = pos + _FRAME_HDR.size + length
-            if frame_end > len(data):
-                return  # torn tail
-            payload = data[pos + _FRAME_HDR.size:frame_end]
-            if crc32(payload) != want_crc:
-                return  # corrupt tail
+        for payload, _end in iter_frames(data):
             decree, count = _PAYLOAD_HDR.unpack_from(payload, 0)
             off = _PAYLOAD_HDR.size
             records = []
@@ -137,4 +126,3 @@ class WriteAheadLog:
             except struct.error:
                 return  # malformed payload despite crc — treat as torn
             yield decree, records
-            pos = frame_end
