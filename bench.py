@@ -14,6 +14,9 @@ workload in the same process (the CPU baseline the reference's scalar C++
 loop competes with — see BASELINE.md "measure CPU baseline").
 
 Secondary phases — YCSB-C point gets (BASELINE config #1; always on),
+round-8 filtered reads (point_get_miss / point_get_hot: bloom pruning +
+the node row cache vs the unfiltered baseline, byte-identity gated,
+persisted to BENCH_r08.json),
 manual-compaction GB/s (configs #3/#4), geo radius search (config #5)
 — all ON by default (PEGBENCH_COMPACT=0 / PEGBENCH_GEO=0 to skip) — land
 in BENCH_DETAILS.json
@@ -389,6 +392,129 @@ def run_point_gets_server_side(bc, n_ops, n_hashkeys, seed, batch=0):
             for err, _v in results:
                 hits += err == 0
     return n_ops, hits, time.perf_counter() - t0
+
+
+def _point_miss_stream(n_ops, n_hashkeys, seed):
+    """Uniform LOADED hashkeys with half the sort-key space absent
+    (s00-s09 loaded, s10-s19 never written) — the round-8 miss
+    workload. Misses on existing hashkeys fall INSIDE every table's
+    key fence (the realistic "existing user, missing field" shape), so
+    only a membership structure can skip the block probes; uniform
+    draws defeat the location cache (each key is effectively seen
+    once)."""
+    import numpy as np
+
+    from pegasus_tpu.base.key_schema import key_hash_parts
+
+    rng = np.random.default_rng(seed)
+    hk_draw = rng.integers(0, n_hashkeys, size=n_ops)
+    sk_draw = rng.integers(0, 20, size=n_ops)
+    return [(key_hash_parts(b"user%08d" % int(hk_draw[op])),
+             (b"user%08d" % int(hk_draw[op]),
+              b"s%02d" % int(sk_draw[op])))
+            for op in range(n_ops)]
+
+
+def _point_hot_stream(n_ops, n_hashkeys, seed, hot_set=256, hot_frac=0.9):
+    """Hotspot stream (YCSB-D-ish): `hot_frac` of ops over `hot_set`
+    (hash, sort) pairs, the rest uniform — the shape the node row cache
+    serves without entering the LSM."""
+    import numpy as np
+
+    from pegasus_tpu.base.key_schema import key_hash_parts
+
+    rng = np.random.default_rng(seed)
+    hot_hks = rng.integers(0, n_hashkeys, size=hot_set)
+    hot_sks = rng.integers(0, 10, size=hot_set)
+    pick = rng.integers(0, hot_set, size=n_ops)
+    uni_hk = rng.integers(0, n_hashkeys, size=n_ops)
+    uni_sk = rng.integers(0, 10, size=n_ops)
+    hot_draw = rng.random(n_ops)
+    out = []
+    for op in range(n_ops):
+        if hot_draw[op] < hot_frac:
+            hk = b"user%08d" % int(hot_hks[pick[op]])
+            sk = b"s%02d" % int(hot_sks[pick[op]])
+        else:
+            hk = b"user%08d" % int(uni_hk[op])
+            sk = b"s%02d" % int(uni_sk[op])
+        out.append((key_hash_parts(hk), (hk, sk)))
+    return out
+
+
+def deepen_l0(bc, n_hashkeys, seed, n_l0=4, rows_per_flush=500):
+    """Round-8 store state: `n_l0` overlay flushes whose rows interleave
+    across the loaded hashkey space (distinct sort keys, so the base
+    dataset stays fully visible and identity gates are unaffected).
+    Every L0 table's key fence then spans the whole probed range — each
+    point get must consider every L0 table, exactly the deep-L0 shape
+    the bloom layer answers with a bit probe instead of a block decode."""
+    from pegasus_tpu.base.key_schema import generate_key, key_hash_parts
+    from pegasus_tpu.replica.mutation import WriteOp
+    from pegasus_tpu.rpc.codec import OP_PUT
+
+    step = max(1, n_hashkeys // rows_per_flush)
+    for g in range(n_l0):
+        per_pidx: dict = {}
+        for h in range(g, n_hashkeys, step):
+            hk = b"user%08d" % h
+            per_pidx.setdefault(
+                key_hash_parts(hk) % len(bc.servers), []).append(
+                WriteOp(OP_PUT,
+                        (generate_key(hk, b"zz%02d" % g),
+                         b"l0-%d" % g, 0)))
+        for pidx, ops in per_pidx.items():
+            bc.replicas[pidx].client_write(ops)
+        bc.cluster.loop.run_until_idle()
+        for s in bc.servers:
+            s.flush()
+
+
+def run_point_stream_server_side(bc, stream, batch=32):
+    """Server-side batched point gets over a prebuilt (ph, (hk, sk))
+    stream — the round-8 measurement loop, shared by the baseline and
+    filtered passes so only the flag state differs."""
+    from pegasus_tpu.base.key_schema import generate_key
+    from pegasus_tpu.server.read_coordinator import point_read_multi
+
+    resolved = [(ph % len(bc.servers), generate_key(hk, sk), ph)
+                for ph, (hk, sk) in stream]
+    servers = bc.servers
+    hits = 0
+    t0 = time.perf_counter()
+    for off in range(0, len(resolved), batch):
+        groups: dict = {}
+        for pidx, key, ph in resolved[off:off + batch]:
+            groups.setdefault(pidx, []).append(("get", key, ph))
+        for results in point_read_multi(
+                [(servers[pidx], ops) for pidx, ops in groups.items()]):
+            for err, _v in results:
+                hits += err == 0
+    return len(resolved), hits, time.perf_counter() - t0
+
+
+def collect_point_results(bc, stream, batch=32):
+    """Per-op (err, value) tuples in stream order — the round-8
+    byte-identity gate runs this once per flag mode and compares."""
+    from pegasus_tpu.base.key_schema import generate_key
+    from pegasus_tpu.server.read_coordinator import point_read_multi
+
+    resolved = [(ph % len(bc.servers), generate_key(hk, sk), ph)
+                for ph, (hk, sk) in stream]
+    out = []
+    for off in range(0, len(resolved), batch):
+        groups: dict = {}
+        order = []
+        for pidx, key, ph in resolved[off:off + batch]:
+            lst = groups.setdefault(pidx, [])
+            order.append((pidx, len(lst)))
+            lst.append(("get", key, ph))
+        pidxs = list(groups)
+        res = point_read_multi(
+            [(bc.servers[p], groups[p]) for p in pidxs])
+        by_pidx = dict(zip(pidxs, res))
+        out.extend(tuple(by_pidx[p][i]) for p, i in order)
+    return out
 
 
 def _write_put_stream(n_ops, seed, tag=b"wb"):
@@ -1100,6 +1226,110 @@ def main() -> None:
                      f"({sv_solo_s / sv_b_s:.2f}x); "
                      f"identical={w_identical}; fsync-mode segment: "
                      f"{w_fsyncs} fsyncs / 1024 ops")
+
+                # round-8 filtered reads: bloom probe pruning + the
+                # node row cache, measured against the UNfiltered
+                # baseline IN THE SAME RUN over a deep-L0 store, with
+                # byte-identity gates on both workloads (the filters'
+                # whole contract is "faster, bit-for-bit the same")
+                from pegasus_tpu.utils.flags import FLAGS as _F8
+
+                f_ops = max(3000, n_ops // 2)
+                fb = int(os.environ.get("PEGBENCH_FILTER_BATCH", 128))
+                # deep-L0 state: 16 overlay tables — the bulk-load /
+                # ingest-heavy shape (`rocksdb.usage_scenario =
+                # bulk_load` turns auto-compaction OFF, so the overlay
+                # grows unboundedly until the load finishes), with rows
+                # interleaved across the probed keyspace
+                deepen_l0(bc, n_hashkeys, seed + 21, n_l0=16,
+                          rows_per_flush=min(2 * n_hashkeys, 50_000))
+                miss_stream = _point_miss_stream(f_ops, n_hashkeys,
+                                                 seed + 22)
+                hot_stream = _point_hot_stream(f_ops, n_hashkeys,
+                                               seed + 23)
+                id_miss, id_hot = miss_stream[:512], hot_stream[:512]
+
+                def _mode(bloom: bool, rc_bytes: int) -> None:
+                    _F8.set("pegasus.server", "bloom_probe", bloom)
+                    _F8.set("pegasus.server", "row_cache_bytes",
+                            rc_bytes)
+                    for s in bc.servers:
+                        s._point_cache = None  # re-plan under this mode
+
+                def _measure(stream, reps=3, fresh_loc=False):
+                    """Median-of-reps elapsed (the onebox shares the
+                    host with the jax runtime; single runs jitter).
+                    `fresh_loc` resets the per-generation location
+                    cache before each rep: a uniform miss stream never
+                    repeats a key in production, so letting rep 1's
+                    locations serve reps 2-3 would measure PR 1's
+                    cache, not the probe path — block caches and key
+                    lists (state that IS warm in production) keep."""
+                    import statistics as _stats
+
+                    run_point_stream_server_side(bc, stream, fb)  # warm
+                    out = []
+                    for _ in range(reps):
+                        if fresh_loc:
+                            for s in bc.servers:
+                                s._point_cache = None
+                        _o, hits, el = run_point_stream_server_side(
+                            bc, stream, fb)
+                        out.append((el, hits))
+                    return (_stats.median(e for e, _h in out),
+                            out[0][1])
+
+                _mode(False, 0)  # unfiltered, uncached baseline
+                base_miss_id = collect_point_results(bc, id_miss, fb)
+                base_hot_id = collect_point_results(bc, id_hot, fb)
+                base_miss_s, m_hits = _measure(miss_stream,
+                                               fresh_loc=True)
+                base_hot_s, h_hits = _measure(hot_stream)
+                _mode(True, 0)   # the filter layer alone (miss gate)
+                miss_ident = collect_point_results(
+                    bc, id_miss, fb) == base_miss_id
+                flt_miss_s, m_hits_f = _measure(miss_stream,
+                                                fresh_loc=True)
+                _mode(True, 33_554_432)  # production: filters + row cache
+                hot_ident = collect_point_results(
+                    bc, id_hot, fb) == base_hot_id
+                flt_hot_s, h_hits_f = _measure(hot_stream)
+                miss_x = base_miss_s / flt_miss_s
+                hot_x = base_hot_s / flt_hot_s
+                details["phases"]["point_get_miss"] = {
+                    "ops": f_ops, "batch": fb,
+                    "hit_rate": round(m_hits_f / f_ops, 4),
+                    "unfiltered_qps": round(f_ops / base_miss_s, 2),
+                    "filtered_qps": round(f_ops / flt_miss_s, 2),
+                    "speedup": round(miss_x, 3),
+                    "meets_2x": miss_x >= 2.0,
+                    "identical_to_unfiltered": bool(
+                        miss_ident and m_hits == m_hits_f),
+                }
+                details["phases"]["point_get_hot"] = {
+                    "ops": f_ops, "batch": fb,
+                    "hit_rate": round(h_hits_f / f_ops, 4),
+                    "unfiltered_qps": round(f_ops / base_hot_s, 2),
+                    "row_cache_qps": round(f_ops / flt_hot_s, 2),
+                    "speedup": round(hot_x, 3),
+                    "meets_1_5x": hot_x >= 1.5,
+                    "identical_to_uncached": bool(
+                        hot_ident and h_hits == h_hits_f),
+                }
+                save_details()
+                with open(os.path.join(here, "BENCH_r08.json"), "w") as f:
+                    json.dump({"phases": {
+                        "point_get_miss":
+                            details["phases"]["point_get_miss"],
+                        "point_get_hot":
+                            details["phases"]["point_get_hot"],
+                    }, "accel_platform": accel.platform}, f, indent=1)
+                _log(f"point-get-miss: {f_ops / base_miss_s:.0f} -> "
+                     f"{f_ops / flt_miss_s:.0f} q/s ({miss_x:.2f}x, "
+                     f"identical={miss_ident}); point-get-hot: "
+                     f"{f_ops / base_hot_s:.0f} -> "
+                     f"{f_ops / flt_hot_s:.0f} q/s ({hot_x:.2f}x, "
+                     f"identical={hot_ident})")
 
                 if do_compact:
                     gb = float(os.environ.get("PEGBENCH_COMPACT_GB", "1.0"))

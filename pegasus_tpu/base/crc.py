@@ -93,6 +93,38 @@ def crc64(data: bytes, init_crc: int = 0) -> int:
     return _crc64_py(data, init_crc)
 
 
+_crc64_rows_native = None
+_crc64_rows_tried = False
+
+
+def crc64_rows(data: np.ndarray, lengths: np.ndarray) -> np.ndarray:
+    """crc64 of each zero-padded byte row — uint64[B].
+
+    The batched-probe form of `crc64`: one call hashes every probe key
+    of a point-read flush for the bloom-filter pass. The native C loop
+    (one ctypes round per BATCH) takes over when built; the numpy
+    fallback is `crc64_batch`, whose per-byte-position dispatch cost
+    only amortizes on large batches — both are bit-identical to the
+    scalar spec (golden vectors in tests/test_crc.py).
+    """
+    global _crc64_rows_native, _crc64_rows_tried
+    if not _crc64_rows_tried:
+        _crc64_rows_tried = True
+        try:
+            from pegasus_tpu.native import crc64_rows_fn
+
+            _crc64_rows_native = crc64_rows_fn()
+        except Exception:  # noqa: BLE001 - fall back to the numpy loop
+            _crc64_rows_native = None
+    if _crc64_rows_native is not None:
+        rows = np.ascontiguousarray(data, dtype=np.uint8)
+        lens = np.ascontiguousarray(lengths, dtype=np.int64)
+        out = np.empty(rows.shape[0], dtype=np.uint64)
+        _crc64_rows_native(rows, lens, out)
+        return out
+    return crc64_batch(data, lengths)
+
+
 def _crc32_py(data: bytes, init_crc: int = 0) -> int:
     """Pure-Python CRC-32C (the spec twin the native path is pinned to)."""
     crc = ~init_crc & _M32

@@ -57,6 +57,15 @@ def _load() -> Optional[ctypes.CDLL]:
             lib.pegasus_crc32.restype = ctypes.c_uint32
             lib.pegasus_crc32.argtypes = [ctypes.c_char_p, ctypes.c_int64,
                                           ctypes.c_uint32]
+            lib.pegasus_crc64_rows.restype = None
+            lib.pegasus_crc64_rows.argtypes = [
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+                ctypes.c_int64, ctypes.c_void_p]
+            lib.pegasus_bloom_probe_multi.restype = None
+            lib.pegasus_bloom_probe_multi.argtypes = [
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+                ctypes.c_int64, ctypes.c_void_p, ctypes.c_int64,
+                ctypes.c_void_p]
             lib.pegasus_pack_records.restype = ctypes.c_int32
             lib.pegasus_pack_records.argtypes = [
                 ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
@@ -109,6 +118,41 @@ def crc64_native(data: bytes) -> int:
     if lib is None:
         raise RuntimeError("native library unavailable")
     return int(lib.pegasus_crc64(data, len(data)))
+
+
+def crc64_rows_fn():
+    """The batched crc64-over-padded-rows function, or None when the
+    native library is unavailable (base.crc.crc64_rows falls back to
+    the vectorized numpy loop)."""
+    lib = _load()
+    if lib is None:
+        return None
+
+    def crc64_rows_native(rows, lens, out) -> None:
+        # rows: C-contiguous uint8[n, width]; lens: int64[n];
+        # out: uint64[n] — filled in place
+        lib.pegasus_crc64_rows(
+            rows.ctypes.data, lens.ctypes.data, rows.shape[0],
+            rows.shape[1], out.ctypes.data)
+
+    return crc64_rows_native
+
+
+def bloom_probe_multi_fn():
+    """The multi-filter bloom probe, or None when the native library is
+    unavailable (storage.bloom.MultiProbe falls back to scalar walks)."""
+    lib = _load()
+    if lib is None:
+        return None
+
+    def probe(addrs, masks, ks, n_filters, hashes, n_keys, out) -> None:
+        # addrs/masks uint64[n_filters], ks int32[n_filters],
+        # hashes uint64[n_keys], out uint8[n_keys * n_filters]
+        lib.pegasus_bloom_probe_multi(
+            addrs.ctypes.data, masks.ctypes.data, ks.ctypes.data,
+            n_filters, hashes.ctypes.data, n_keys, out.ctypes.data)
+
+    return probe
 
 
 def crc32_fn():

@@ -94,6 +94,51 @@ uint32_t pegasus_crc32(const uint8_t* data, int64_t len, uint32_t init) {
   return crc32c(data, len, init);
 }
 
+// Batched crc64 over n zero-padded byte rows (uint8[n, width], row i
+// holding lens[i] valid bytes) — one ctypes call hashes a whole
+// point-read flush's probe keys for the bloom-filter pass, where the
+// numpy per-byte loop pays ~10us of dispatch per byte POSITION and a
+// scalar call pays ~1us of ctypes overhead per KEY.
+void pegasus_crc64_rows(const uint8_t* rows, const int64_t* lens, int64_t n,
+                        int64_t width, uint64_t* out) {
+  for (int64_t i = 0; i < n; ++i)
+    out[i] = crc64(rows + i * width, lens[i], 0);
+}
+
+// Multi-filter bloom probe: out[i * n_filters + t] = 1 iff hash i may
+// be present in filter t. Filters are the power-of-two double-hashed
+// blooms of storage/bloom.py (g_j = (h + j*delta) & mask, delta =
+// ((h>>17)|1) & mask). One call answers a whole point-read flush
+// against EVERY L0 table and L1 run of a partition — the per-key
+// python probe walk costs ~1.4us per (key, filter) pair, which at
+// deep-L0 rivals the block probes the filter exists to skip.
+// bits_addrs: n_filters raw pointers to each filter's bit bytes.
+void pegasus_bloom_probe_multi(const uint64_t* bits_addrs,
+                               const uint64_t* masks, const int32_t* ks,
+                               int64_t n_filters, const uint64_t* hashes,
+                               int64_t n_keys, uint8_t* out) {
+  for (int64_t i = 0; i < n_keys; ++i) {
+    const uint64_t h = hashes[i];
+    uint8_t* row = out + i * n_filters;
+    for (int64_t t = 0; t < n_filters; ++t) {
+      const uint8_t* bits =
+          reinterpret_cast<const uint8_t*>(static_cast<uintptr_t>(bits_addrs[t]));
+      const uint64_t mask = masks[t];
+      uint64_t idx = h & mask;
+      const uint64_t delta = ((h >> 17) | 1) & mask;
+      uint8_t ok = 1;
+      for (int32_t j = 0; j < ks[t]; ++j) {
+        if (!((bits[idx >> 3] >> (idx & 7)) & 1)) {
+          ok = 0;
+          break;
+        }
+        idx = (idx + delta) & mask;
+      }
+      row[t] = ok;
+    }
+  }
+}
+
 // Pack n encoded keys (concatenated in `heap`, row i spanning
 // [offsets[i], offsets[i+1])) into:
 //   keys_out     uint8[n, key_width]   zero-padded rows

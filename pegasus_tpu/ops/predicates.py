@@ -357,6 +357,45 @@ def point_probe_rows(keys_matrix: np.ndarray, key_len: np.ndarray,
     return rows
 
 
+def bloom_key_hashes(keys) -> np.ndarray:
+    """uint64[B] full-key crc64 for a batch of probe keys — the bloom
+    filter's hash input, evaluated once per read flush and shared by
+    every table/run the flush's candidates touch.
+
+    Placement: compute-trivial per byte (the "probe" workload class in
+    ops/placement.py — a table lookup per byte), so this always runs on
+    the host: small batches take the scalar C crc64 (one call per key
+    beats the batch call's array setup), larger flushes take ONE
+    `crc64_rows` pass over the padded key matrix.
+    """
+    n = len(keys)
+    if n == 0:
+        return np.zeros(0, dtype=np.uint64)
+    from pegasus_tpu.base.crc import crc64, crc64_rows
+
+    if n < 16:
+        return np.fromiter((crc64(k) for k in keys), dtype=np.uint64,
+                           count=n)
+    width = max(1, max(len(k) for k in keys))
+    mat, lens = pad_probe_keys(keys, width)
+    return crc64_rows(mat, lens)
+
+
+def bloom_probe_rows(bloom, hashes: np.ndarray) -> np.ndarray:
+    """bool[B]: may each hashed probe key be present in `bloom`
+    (storage.bloom.BloomFilter)? False is definitive — the caller skips
+    that run/table without decoding a block. One vectorized pass
+    answers the whole flush; a filterless table answers all-True.
+
+    This is the batch-evaluation form the coalesced read flush feeds
+    (LSM-OPD's direct-on-format idea: membership for N keys is k
+    vectorized gathers over the bit array, not N scalar walks).
+    """
+    if bloom is None:
+        return np.ones(len(hashes), dtype=bool)
+    return bloom.may_contain_hashes(hashes)
+
+
 def host_key_hash_lo(hash_keys, sort_keys=None) -> np.ndarray:
     """uint32[B] low lane of pegasus_key_hash for a key batch, evaluated
     with ONE vectorized crc64 pass (base.crc.crc64_batch) instead of a

@@ -52,6 +52,13 @@ class HotkeyCollector:
     def stop(self) -> None:
         self.state = HotkeyState.STOPPED
 
+    def hot_hash_key(self) -> Optional[bytes]:
+        """The detected-hot hashkey once a detection FINISHES, else
+        None — the node row cache's fast-admit signal: a hashkey the
+        two-phase detector already flagged earns caching on first
+        touch instead of waiting out the repeat-hit gate."""
+        return self.result if self.state is HotkeyState.FINISHED else None
+
     def capture(self, hash_keys: Sequence[bytes]) -> None:
         """Feed a batch of request hashkeys (called from read/write
         dispatch paths while a detection is running)."""

@@ -54,6 +54,7 @@ from pegasus_tpu.server.capacity_units import (
     units as cu_units,
 )
 from pegasus_tpu.server.read_limiter import RangeReadLimiter
+from pegasus_tpu.server.row_cache import ROW_CACHE
 from pegasus_tpu.server.scan_context import ScanContext, ScanContextCache
 from pegasus_tpu.server.types import (
     BatchGetRequest,
@@ -77,6 +78,7 @@ from pegasus_tpu.server.types import (
 )
 from pegasus_tpu.server.write_service import WriteService
 
+from pegasus_tpu.storage.bloom import bloom_probe_enabled
 from pegasus_tpu.storage.engine import StorageEngine
 from pegasus_tpu.utils.errors import ErrorCode, StorageStatus
 from pegasus_tpu.utils.metrics import METRICS
@@ -101,6 +103,13 @@ def _normalize_filter_key(r) -> tuple:
 
 # candidate records gathered per device predicate dispatch
 PREDICATE_BATCH = 2048
+
+# node-wide twin of the per-replica bloom counter (same RelaxedCounter
+# object the sstable solo path ticks — the registry dedupes by name)
+_STORAGE_BLOOM_USEFUL = METRICS.entity(
+    "storage", "node").relaxed_counter("bloom_useful_count")
+
+
 
 # point-location-cache miss sentinel (None is a valid cached value:
 # "definitively absent from the L1 runs")
@@ -195,11 +204,21 @@ class PartitionServer:
         # invalidation discipline as _plan_cache (replaced wholesale on
         # generation change).
         self._point_cache = None
+        # (store, generation, MultiProbe, {id(table) -> filter col}):
+        # the run set's filters prepared for the one-call batched
+        # probe; pure over the immutable run set
+        self._bloom_probe_cache = None
         self.metrics = METRICS.entity(
             "replica", f"{app_id}.{pidx}",
             {"table": str(app_id), "partition": str(pidx)})
         self.cu = CapacityUnitCalculator(self.metrics)
         self._abnormal_reads = self.metrics.counter("abnormal_read_count")
+        # filter/row-cache observability, per partition (the node-wide
+        # twins live on the "storage" entity): incremented BATCHED, once
+        # per read flush
+        self._bloom_useful = self.metrics.counter("bloom_useful_count")
+        self._row_cache_hits = self.metrics.counter("row_cache_hit")
+        self._row_cache_misses = self.metrics.counter("row_cache_miss")
         # slow-read dumps (parity: slow-query threshold app-env +
         # latency_tracer dumps); threshold configurable per table via
         # replica.slow_query_threshold_ms
@@ -278,6 +297,16 @@ class PartitionServer:
             "rules_filter": self._compaction_rules,
         }
         engine.lsm.on_publish = self._on_store_publish
+        # write-through row-cache invalidation: every applied mutation
+        # batch drops its keys from the node cache BEFORE the write is
+        # acked, and an engine swap orphans every entry of the old store
+        engine.on_write_keys = self._invalidate_rows
+        ROW_CACHE.invalidate_gid((self.app_id, self.pidx))
+
+    def _invalidate_rows(self, keys) -> None:
+        lsm = self.engine.lsm
+        ROW_CACHE.invalidate((self.app_id, self.pidx), lsm.store_uid,
+                             lsm.generation, keys)
 
     def _on_store_publish(self, live_paths: set) -> None:
         """Store publish hook (every compaction publish, including the
@@ -301,6 +330,7 @@ class PartitionServer:
         self._plan_cache = None
         self._point_cache = None
         self._plan_expired_cache = (None, {})
+        ROW_CACHE.invalidate_gid((self.app_id, self.pidx))
 
     # env key -> (derived attr, reset-to-default parsed value); used when
     # a FULL env set arrives and a previously-set key is now absent
@@ -847,58 +877,211 @@ class PartitionServer:
         if pc is None or pc[0] is not lsm or pc[1] != gen:
             pc = self._point_cache = (lsm, gen, {})
         loc_cache = pc[2]
+        gid = (self.app_id, self.pidx)
+        suid = lsm.store_uid
+        rc = ROW_CACHE
+        rc_on = rc.enabled
+        # invalidation epoch observed BEFORE any LSM read: admission
+        # below hands it back, and the cache refuses the entry if a
+        # write/publish invalidated this gid in between (the populate
+        # race a plain write-through LRU would lose)
+        rc_epoch = rc.epoch(gid) if rc_on else 0
+        rc_hits = rc_misses = 0
+        rc_cached = None
+        if rc_on and probes:
+            # ONE lock round against the node-shared cache serves the
+            # whole flush (get_many); per-key acquisition would make
+            # every partition's read flush contend on one lock
+            ukeys = list(dict.fromkeys(k for k, _nv in probes))
+            rc_cached = rc.get_many(gid, suid, gen, ukeys)
+            rc_hits = len(rc_cached)
+            rc_misses = len(ukeys) - rc_hits
         uniq: dict = {}
-        pending: list = []
+        base_pending: list = []  # missed the row cache AND the overlay
         for key, _nv in probes:
             if key in uniq:
                 continue
+            if rc_cached is not None:
+                ent = rc_cached.get(key)
+                if ent is not None:
+                    # cached rows carry the FULL encoded value + ets, so
+                    # the serve path below is byte-identical to the
+                    # overlay form; hot hashkeys never enter the LSM
+                    uniq[key] = ("ov", ent[0], ent[1])
+                    continue
             hit = memget(key)
             if hit is not None:
                 uniq[key] = (None if hit[0] is TOMBSTONE
                              else ("ov", hit[0], hit[1]))
                 continue
-            resolved = False
-            for table in l0:
-                h = table.get(key)
-                if h is not None:
-                    uniq[key] = (None if h[0] is None
-                                 else ("ov", h[0], h[1]))
-                    resolved = True
-                    break
-            if resolved:
-                continue
-            ent = loc_cache.get(key, _POINT_MISS)
-            if ent is not _POINT_MISS:
-                uniq[key] = ent
-            else:
-                uniq[key] = None  # placeholder; _locate_points overwrites
-                pending.append(key)
+            uniq[key] = None  # placeholder until base resolution
+            base_pending.append(key)
+
+        # disk-bound residue: ONE vectorized full-key hash pass + ONE
+        # native multi-filter probe answer every (key x L0-table /
+        # L1-run) candidacy of the flush before any block is decoded —
+        # definitive "absent" cells skip the decode + bisect entirely,
+        # which is where miss-heavy and deep-L0 traffic spends its time
+        probe = None  # (matrix bytes, {id(table)->col}, {key->row base})
+        bloom_useful = 0
+        if base_pending and bloom_probe_enabled():
+            mp, cols = self._filter_probe(lsm, gen)
+            if mp is not None:
+                from pegasus_tpu.ops.predicates import bloom_key_hashes
+
+                mat = mp.probe(bloom_key_hashes(base_pending))
+                nfil = mp.n
+                probe = (mat, cols,
+                         {k: i * nfil
+                          for i, k in enumerate(base_pending)})
+        pending = base_pending
+        if pending and l0:
+            pending, bloom_useful = self._probe_l0(
+                l0, pending, probe, uniq)
         if pending:
-            self._locate_points(runs, pending, uniq)
+            still = []
+            for key in pending:
+                ent = loc_cache.get(key, _POINT_MISS)
+                if ent is not _POINT_MISS:
+                    uniq[key] = ent
+                else:
+                    still.append(key)
+            pending = still
+        if pending:
+            bloom_useful += self._locate_points(runs, pending, uniq,
+                                                probe)
         if lsm.generation != gen:
             # a compaction/flush published mid-plan: the overlay misses
             # above may have raced the cut-over (key consumed from the
             # overlay before the run snapshot saw its new home) —
             # re-resolve every key through the per-key safe order and
-            # cache nothing
+            # cache nothing (neither locations nor rows)
             for key in list(uniq):
                 hit = lsm.get(key)
                 uniq[key] = (None if hit is None
                              else ("ov", hit[0], hit[1]))
-        elif pending and self._point_cache is pc:
-            for key in pending:
-                loc_cache[key] = uniq[key]
-            while len(loc_cache) > self.POINT_CACHE_CAP:
-                loc_cache.pop(next(iter(loc_cache)))
+        else:
+            if pending and self._point_cache is pc:
+                for key in pending:
+                    loc_cache[key] = uniq[key]
+                while len(loc_cache) > self.POINT_CACHE_CAP:
+                    loc_cache.pop(next(iter(loc_cache)))
+            if rc_on and base_pending:
+                self._maybe_admit_rows(rc, gid, suid, gen, rc_epoch,
+                                       base_pending, uniq, hc)
+        if bloom_useful:
+            self._bloom_useful.increment(bloom_useful)
+            _STORAGE_BLOOM_USEFUL.increment(bloom_useful)
+        if rc_hits:
+            self._row_cache_hits.increment(rc_hits)
+        if rc_misses:
+            self._row_cache_misses.increment(rc_misses)
         return {"ops": ops, "results": results, "op_keys": op_keys,
                 "uniq": uniq, "now": now, "t0": t0, "wide": wide}
 
-    def _locate_points(self, runs, keys: list, out: dict) -> None:
+    def _filter_probe(self, lsm, gen: int):
+        """(MultiProbe over every filtered table of the current run
+        set, {id(table) -> filter column}); (None, {}) when no table
+        carries a filter. Pure over the immutable run set — rebuilt
+        once per store generation, so the plan hot path pays one
+        identity compare."""
+        c = self._bloom_probe_cache
+        if c is not None and c[0] is lsm and c[1] == gen:
+            return c[2], c[3]
+        from pegasus_tpu.storage.bloom import MultiProbe
+
+        filters = []
+        cols: dict = {}
+        for t in list(lsm.l0) + list(lsm.l1_runs):
+            if t.bloom is not None:
+                cols[id(t)] = len(filters)
+                filters.append(t.bloom)
+        mp = MultiProbe(filters) if filters else None
+        self._bloom_probe_cache = (lsm, gen, mp, cols)
+        return mp, cols
+
+    def _probe_l0(self, l0, keys: list, probe, uniq: dict
+                  ) -> Tuple[list, int]:
+        """Resolve `keys` through the L0 overlay newest-first (first
+        table hit wins, the solo-get order). `probe` is the flush's
+        precomputed bloom answer (matrix bytes, {id(table) -> column},
+        {key -> row base}): a 0 cell is a definitive absent — no block
+        is touched. Filterless tables (pre-filter files) gate on their
+        first/last-key fences instead, a compare per key. Returns
+        (unresolved keys, bloom-pruned probe count)."""
+        useful = 0
+        if probe is not None:
+            mat, cols, key_row = probe
+            # (table, filter column | None) resolved once per flush —
+            # id()+dict per (key, table) pair was measurable at depth 16
+            pairs = [(t, cols.get(id(t))) for t in l0]
+        else:
+            mat = key_row = None
+            pairs = [(t, None) for t in l0]
+        out_keys = []
+        for k in keys:
+            row = key_row[k] if key_row is not None else 0
+            resolved = False
+            for table, col in pairs:
+                if col is not None:
+                    if not mat[row + col]:
+                        useful += 1
+                        continue
+                else:
+                    fk = table.first_key
+                    if fk is None or k < fk or k > table.last_key:
+                        continue
+                h = table.get(k)
+                if h is not None:
+                    uniq[k] = (None if h[0] is None
+                               else ("ov", h[0], h[1]))
+                    resolved = True
+                    break
+            if not resolved:
+                out_keys.append(k)
+        return out_keys, useful
+
+    def _maybe_admit_rows(self, rc, gid, suid: int, gen: int, epoch: int,
+                          keys: list, uniq: dict, hc) -> None:
+        """Offer this flush's base-resolved rows (L0/L1 hits — overlay
+        hits are already memory-speed) to the node row cache. Admission
+        is repeat-gated inside the cache; a FINISHED hotkey detection
+        fast-admits its hashkey; `epoch` voids the admission if any
+        write invalidated this gid since planning began. One lock
+        round for the touch gate, one for the inserts — never per
+        key."""
+        cands = [k for k in keys if uniq.get(k)]
+        if not cands:
+            return  # absent / tombstone rows are never cached
+        hot = hc.hot_hash_key()
+        fast = ()
+        if hot is not None:
+            fast = {k for k in cands if restore_key(k)[0] == hot}
+        granted = rc.note_and_check_many(gid, cands, fast)
+        if not granted:
+            return
+        items = []
+        for key in granted:
+            ent = uniq[key]
+            if ent[0] == "ov":
+                value, ets = ent[1], int(ent[2])
+            else:
+                _t, blk, row = ent
+                value = blk.value_at(row)
+                ets = int(blk.expire_ts[row])
+            items.append((key, value, ets))
+        rc.admit_many(gid, suid, gen, items, epoch=epoch)
+
+    def _locate_points(self, runs, keys: list, out: dict,
+                       probe=None) -> int:
         """Batch-locate keys in the non-overlapping L1 runs: bisect each
-        key to its run and block, then probe every touched block's
-        sorted key matrix with ONE vectorized searchsorted
+        key to its run, answer each candidacy from the flush's
+        precomputed bloom matrix (`probe` — a 0 cell is definitively
+        absent, no block is decoded), then probe every surviving
+        block's sorted key matrix with ONE vectorized searchsorted
         (page.probe_rows). out[key] = ("l1", blk, row) | None (absent
-        or tombstone — L1 is the last level)."""
+        or tombstone — L1 is the last level). Returns the bloom-pruned
+        probe count."""
         import bisect as _b
 
         from pegasus_tpu.server.page import probe_rows
@@ -906,19 +1089,39 @@ class PartitionServer:
         if not runs:
             for key in keys:
                 out[key] = None
-            return
+            return 0
+        if probe is not None:
+            mat, cols, key_row = probe
+        else:
+            mat = cols = key_row = None
         run_last = [r.last_key or b"" for r in runs]
-        by_block: "OrderedDict[tuple, list]" = OrderedDict()
+        by_run: "OrderedDict[int, list]" = OrderedDict()
         for key in keys:
             ri = _b.bisect_left(run_last, key)
             if ri >= len(runs) or (runs[ri].first_key or b"") > key:
                 out[key] = None
                 continue
-            bi = runs[ri]._block_for_key(key)
-            if bi is None:
-                out[key] = None
-                continue
-            by_block.setdefault((ri, bi), []).append(key)
+            by_run.setdefault(ri, []).append(key)
+        useful = 0
+        by_block: "OrderedDict[tuple, list]" = OrderedDict()
+        for ri, ks in by_run.items():
+            run = runs[ri]
+            col = cols.get(id(run)) if cols is not None else None
+            if col is not None:
+                kept = []
+                for k in ks:
+                    if mat[key_row[k] + col]:
+                        kept.append(k)
+                    else:
+                        useful += 1
+                        out[k] = None
+                ks = kept
+            for key in ks:
+                bi = run._block_for_key(key)
+                if bi is None:
+                    out[key] = None
+                    continue
+                by_block.setdefault((ri, bi), []).append(key)
         for (ri, bi), ks in by_block.items():
             blk = runs[ri].read_block(bi)
             for key, row in zip(ks, probe_rows(blk, ks)):
@@ -927,6 +1130,7 @@ class PartitionServer:
                     out[key] = None
                 else:
                     out[key] = ("l1", blk, row)
+        return useful
 
     def point_chunks(self, state) -> list:
         """Phase 2: this batch's L1 value-gather work as [(blk,

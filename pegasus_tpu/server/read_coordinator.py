@@ -23,7 +23,16 @@ tunneled accelerator; what batching buys instead is
   the flush with one searchsorted over its sorted key matrix;
 - one native gather per block for co-located keys (hot hash keys cluster
   in the same SST block) with per-second TTL masks read straight off the
-  host-resident expire_ts column.
+  host-resident expire_ts column;
+- batched bloom pruning: each partition's plan hashes its disk-bound
+  residue ONCE (ops.predicates.bloom_key_hashes) and answers every
+  (key x L0-table / L1-run) candidacy from the per-SSTable filters
+  (storage/bloom.py) before any block is decoded — misses and deep-L0
+  states stop paying a decode + bisect per table;
+- the node row cache (server/row_cache.py): hot rows admitted by repeat
+  traffic (or a hotkey-detection fast-admit) serve before the engine is
+  touched at all, write-through-invalidated on the mutation apply path
+  and wholesale on store publishes/generation bumps.
 
 Used by the replica stub's client_read_batch handler (the rpc/transport
 batch-dispatch hook delivers consecutive queued point reads as one

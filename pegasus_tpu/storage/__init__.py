@@ -11,6 +11,7 @@ Components:
   memtable  — sorted in-memory overlay with tombstones
   wal       — framed, crc-protected write-ahead log (the "private log"
               analogue at the storage layer)
+  bloom     — per-SSTable bloom filters (the point-read filter layer)
   sstable   — columnar SST read/write
   lsm       — LSMStore: memtable + L0 runs + L1, flush/compaction, iterators
   engine    — StorageEngine: write batches with decree watermark discipline
@@ -19,6 +20,7 @@ Components:
 
 from pegasus_tpu.storage.memtable import Memtable, TOMBSTONE
 from pegasus_tpu.storage.wal import WriteAheadLog, WalRecord, OP_PUT, OP_DEL
+from pegasus_tpu.storage.bloom import BloomFilter
 from pegasus_tpu.storage.sstable import SSTable, SSTableWriter, BLOCK_CAPACITY
 from pegasus_tpu.storage.lsm import LSMStore
 from pegasus_tpu.storage.engine import StorageEngine, WriteBatchItem

@@ -73,6 +73,11 @@ class StorageEngine:
         self.memtable_flush_trigger = 100_000  # records
         self.auto_compact = True
         self.auto_compact_ctx = None  # server installs its filter context
+        # write-through invalidation hook: called with the key list of
+        # every applied batch BEFORE the write returns, so row-cache
+        # owners (PartitionServer) can never serve a value this batch
+        # replaced
+        self.on_write_keys = None
         # serializes compactions: the env-triggered manual path holds it
         # across its (unlocked) merge; the write path's auto-compaction
         # try-acquires and SKIPS when a manual run is in flight (the
@@ -133,6 +138,9 @@ class StorageEngine:
             else:
                 self.lsm.put(i.key, i.value, i.expire_ts)
         self.last_committed_decree = decree
+        hook = self.on_write_keys
+        if hook is not None and items:
+            hook([i.key for i in items])
         self._maybe_maintain()
 
     def _maybe_maintain(self) -> None:

@@ -28,11 +28,14 @@ Scan merge order: memtable > newest L0 > ... > oldest L0 > L1 runs.
 from __future__ import annotations
 
 import heapq
+import itertools
 import os
 from typing import Callable, Iterator, List, Optional, Tuple
 
 import numpy as np
 
+from pegasus_tpu.base.crc import crc64
+from pegasus_tpu.storage.bloom import bloom_probe_enabled
 from pegasus_tpu.storage.memtable import Memtable, TOMBSTONE
 from pegasus_tpu.storage.sstable import (
     BLOCK_CAPACITY,
@@ -47,6 +50,11 @@ Record = Tuple[bytes, Optional[bytes], int]
 # records per L1 output run before the compactor starts a new one:
 # bounds every future range-compaction step (and its device batches)
 L1_RUN_CAPACITY = 262_144
+
+# process-unique store ids: cache owners (the node row cache) key
+# entries by store identity + generation, and an int token can never
+# alias a recycled object id after an engine swap
+_STORE_UIDS = itertools.count(1)
 
 
 class LSMStore:
@@ -66,6 +74,7 @@ class LSMStore:
         # compaction publish): callers key derived caches (scan plans)
         # on it so they invalidate exactly when the block set does
         self.generation = 0
+        self.store_uid = next(_STORE_UIDS)
         # last manual-compaction finish time (pegasus-epoch seconds),
         # persisted in the manifest INDEPENDENTLY of the run set so an
         # all-tombstone compaction (zero surviving runs) still records
@@ -213,18 +222,41 @@ class LSMStore:
 
     def get(self, key: bytes) -> Optional[Tuple[bytes, int]]:
         """Visible (value, expire_ts) or None. TTL filtering is the caller's
-        job (reference checks expiry in the handlers, not the engine)."""
+        job (reference checks expiry in the handlers, not the engine).
+
+        L0 tables short-circuit on their first/last-key fences (an
+        out-of-range table costs two compares, not a block lookup) and
+        then on their bloom filters — the key is hashed ONCE when any
+        overlapping L0 table exists, and the same hash feeds the
+        candidate L1 run's filter, so a deep-L0 miss costs one crc plus
+        a bit probe per table instead of a decode + bisect per table.
+        Steady-state stores (empty L0) skip the hash entirely: a
+        present-key L1 get pays nothing new."""
         hit = self.memtable.get(key)
         if hit is not None:
             value, ets = hit
             return None if value is TOMBSTONE else (value, ets)
+        key_hash = ...  # unhashed; None = probing off for this get
         for table in self.l0:
+            fk = table.first_key
+            if fk is None or key < fk or key > table.last_key:
+                continue
+            if table.bloom is not None:
+                if key_hash is ...:
+                    key_hash = (crc64(key) if bloom_probe_enabled()
+                                else None)
+                if key_hash is not None \
+                        and not table.may_contain(key, key_hash):
+                    continue
             hit = table.get(key)
             if hit is not None:
                 value, ets = hit
                 return None if value is None else (value, ets)
         run = self._run_for(key)
         if run is not None:
+            if key_hash is not ... and key_hash is not None \
+                    and not run.may_contain(key, key_hash):
+                return None
             hit = run.get(key)
             if hit is not None:
                 value, ets = hit

@@ -39,8 +39,24 @@ import numpy as np
 
 from pegasus_tpu.storage.efile import open_data_file
 
-from pegasus_tpu.base.crc import crc32, crc64_batch
+from pegasus_tpu.base.crc import crc32, crc64_batch, crc64_rows
 from pegasus_tpu.ops.record_block import next_bucket
+from pegasus_tpu.storage.bloom import (
+    BloomFilter,
+    bloom_build_bits,
+    bloom_probe_enabled,
+)
+from pegasus_tpu.utils.metrics import METRICS
+
+# node-wide storage observability (parity: the rocksdb block-cache /
+# filter tickers the reference exports per server): relaxed counters —
+# these tick once per block read / filter probe, the hottest loops in
+# the process, so they trade perfect cross-thread accuracy for zero
+# lock traffic
+_STORAGE_METRICS = METRICS.entity("storage", "node")
+_BLOCK_CACHE_HIT = _STORAGE_METRICS.relaxed_counter("block_cache_hit")
+_BLOCK_CACHE_MISS = _STORAGE_METRICS.relaxed_counter("block_cache_miss")
+_BLOOM_USEFUL = _STORAGE_METRICS.relaxed_counter("bloom_useful_count")
 
 MAGIC = b"PGT2"
 MAGIC_V1 = b"PGT1"  # pre-hash_lo format, still readable
@@ -148,6 +164,11 @@ class SSTableWriter:
         self._io_q = None
         self._io_thread = None
         self._io_err: List[BaseException] = []
+        # full-key crc64 per block, accumulated for the bloom filter
+        # built at finish(); bits-per-key is latched HERE so a mutable
+        # flag flip mid-write cannot tear one table's filter
+        self._bloom_bits_per_key = bloom_build_bits()
+        self._key_hashes: List[np.ndarray] = []
         if async_io:
             import queue
             import threading
@@ -227,6 +248,10 @@ class SSTableWriter:
         region_len = np.where(hkl > 0, hkl, key_len.astype(np.int64) - 2)
         hash_lo = (crc64_batch(keys, region_len, start=2)
                    & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+        if self._bloom_bits_per_key > 0:
+            # full-key hash column for the table bloom filter: one
+            # vectorized pass per block, folded into the filter at finish
+            self._key_hashes.append(crc64_rows(keys, key_len))
 
         offset = self._offset
         # ONE buffer per block: a single kernel copy + syscall instead of
@@ -255,6 +280,8 @@ class SSTableWriter:
         if self._last_key is not None and first_key <= self._last_key:
             raise ValueError("blocks must be added in key order")
         width = int(keys.shape[1])
+        if self._bloom_bits_per_key > 0:
+            self._key_hashes.append(crc64_rows(keys, key_len))
         offset = self._offset
         self._write(b"".join((
             _BLOCK_HDR.pack(n, width, len(heap)),
@@ -284,6 +311,17 @@ class SSTableWriter:
             "meta": self._meta,
             "total_count": self._count,
         }
+        if self._key_hashes:
+            # bloom section sits between the data blocks and the index;
+            # the index names its offset/geometry, so pre-filter readers
+            # (and pre-filter FILES under new readers) stay compatible
+            bf = BloomFilter.build(np.concatenate(self._key_hashes),
+                                   self._bloom_bits_per_key)
+            bloom_off = self._f.tell()
+            blob = bf.to_bytes()
+            self._f.write(blob)
+            index["bloom"] = {"off": bloom_off, "size": len(blob),
+                              "m": bf.m, "k": bf.k}
         blob = json.dumps(index).encode()
         index_offset = self._f.tell()
         self._f.write(blob)
@@ -363,25 +401,64 @@ class SSTable:
         ]
         self.meta: dict = index.get("meta", {})
         self.total_count: int = index.get("total_count", 0)
-        self._cache: dict[int, Block] = {}
+        # pre-filter files simply miss the "bloom" entry and degrade to
+        # the unfiltered path (may_contain == always True)
+        self.bloom: Optional[BloomFilter] = None
+        bl = index.get("bloom")
+        if bl:
+            if self._mv is not None:
+                raw = self._mv[bl["off"]:bl["off"] + bl["size"]]
+            else:
+                self._f.seek(bl["off"])
+                raw = self._f.read(bl["size"])
+            self.bloom = BloomFilter.from_bytes(raw, bl["m"], bl["k"])
+        from collections import OrderedDict as _OD
+
+        self._cache: "_OD[int, Block]" = _OD()
         self._cache_cap = cache_blocks
         self._last_keys: Optional[List[bytes]] = None  # iter_blocks bisect
+        # fence columns as plain attributes: the block list is immutable
+        # for the file's lifetime, and the point-read planner compares
+        # fences for every (key, table) candidate — property dispatch
+        # was measurable there
+        self.first_key: Optional[bytes] = (
+            self.blocks[0].first_key if self.blocks else None)
+        self.last_key: Optional[bytes] = (
+            self.blocks[-1].last_key if self.blocks else None)
 
     def close(self) -> None:
         self._f.close()
 
-    @property
-    def first_key(self) -> Optional[bytes]:
-        return self.blocks[0].first_key if self.blocks else None
-
-    @property
-    def last_key(self) -> Optional[bytes]:
-        return self.blocks[-1].last_key if self.blocks else None
+    def may_contain(self, key: bytes, key_hash: Optional[int] = None
+                    ) -> bool:
+        """False means definitively absent (bloom-filtered); tables
+        without a filter (or with probing switched off) answer True.
+        `key_hash` lets callers that already hashed the key (the
+        batched probe path, or a multi-table solo get) skip the crc."""
+        bf = self.bloom
+        if bf is None or not bloom_probe_enabled():
+            return True
+        hit = (bf.may_contain_hash(key_hash) if key_hash is not None
+               else bf.may_contain(key))
+        if not hit:
+            _BLOOM_USEFUL.increment()
+        return hit
 
     def read_block(self, idx: int) -> Block:
         blk = self._cache.get(idx)
         if blk is not None:
+            # true LRU: a hit refreshes recency (the old FIFO eviction
+            # popped insertion order, so resident-forever hot blocks
+            # were evicted by any cold streak)
+            try:
+                self._cache.move_to_end(idx)
+            except KeyError:
+                pass  # raced a concurrent eviction (serving vs
+                # compaction threads share run caches); the decoded
+                # block in hand stays valid
+            _BLOCK_CACHE_HIT.increment()
             return blk
+        _BLOCK_CACHE_MISS.increment()
         bm = self.blocks[idx]
         if self._mv is not None:
             raw = self._mv[bm.offset:bm.offset + bm.size]
@@ -410,7 +487,7 @@ class SSTable:
                              offset=pos)
         blk = Block(keys, key_len, ets, hash_lo, flags, offs, heap)
         if len(self._cache) >= self._cache_cap:
-            self._cache.pop(next(iter(self._cache)))
+            self._cache.popitem(last=False)  # evict true-LRU head
         self._cache[idx] = blk
         return blk
 

@@ -24,7 +24,7 @@ verbs, parity: the linenoise REPL + `use`). Command families:
   cluster    : cluster_info, nodes, server_info, server_stat, app_stat,
                app_disk, ddd_diagnose, propose, rebalance, offline_node,
                get/set_meta_level, detect_hotkey, remote_command,
-               slow_queries, metrics
+               slow_queries, metrics, storage_stats
   offline    : sst_dump, mlog_dump, local_get, rdb_key_str2hex,
                rdb_key_hex2str, rdb_value_hex2str
 
@@ -290,6 +290,9 @@ def main(argv=None) -> int:
                    help="one node, or all when omitted")
     p = sub.add_parser("server_stat")
     p.add_argument("node", nargs="?", default=None)
+    p = sub.add_parser("storage_stats")
+    p.add_argument("table",
+                   help="dump cache/bloom counters per partition")
     p = sub.add_parser("app_stat")
     p.add_argument("table")
     p = sub.add_parser("app_disk")
@@ -1158,6 +1161,45 @@ def _dispatch(args, box, out) -> int:
         from pegasus_tpu.utils.metrics import METRICS
         print(json.dumps(METRICS.snapshot(args.entity_type), indent=1),
               file=out)
+    elif args.cmd == "storage_stats":
+        # per-partition filter / cache observability (round-8): block
+        # cache + bloom + row cache counters, plus each partition's
+        # filter coverage (how many runs actually carry blooms — a
+        # mixed old/new-format store shows it here)
+        from pegasus_tpu.server.row_cache import ROW_CACHE
+        from pegasus_tpu.utils.metrics import METRICS
+
+        t = box.open_table(args.table)
+        rows = []
+        for p_ in t.all_partitions():
+            lsm = p_.engine.lsm
+            tables = list(lsm.l0) + list(lsm.l1_runs)
+            snap = p_.metrics.snapshot()["metrics"]
+            rows.append({
+                "gpid": [p_.app_id, p_.pidx],
+                "generation": lsm.generation,
+                "l0_tables": len(lsm.l0),
+                "l1_runs": len(lsm.l1_runs),
+                "runs_with_bloom": sum(
+                    1 for x in tables if x.bloom is not None),
+                "bloom_bits": sum(
+                    x.bloom.m for x in tables if x.bloom is not None),
+                "cached_blocks": sum(len(x._cache) for x in tables),
+                "bloom_useful_count": snap.get(
+                    "bloom_useful_count", {}).get("value", 0),
+                "row_cache_hit": snap.get(
+                    "row_cache_hit", {}).get("value", 0),
+                "row_cache_miss": snap.get(
+                    "row_cache_miss", {}).get("value", 0),
+            })
+        node_wide = [s["metrics"]
+                     for s in METRICS.snapshot("storage")] or [{}]
+        print(json.dumps({
+            "partitions": rows,
+            "storage": {n: m.get("value", 0)
+                        for n, m in node_wide[0].items()},
+            "row_cache": ROW_CACHE.stats(),
+        }, indent=1), file=out)
     elif args.cmd == "backup":
         from pegasus_tpu.server.backup import BackupEngine
         from pegasus_tpu.storage.block_service import block_service_for

@@ -37,6 +37,26 @@ class Counter:
         return {"type": "counter", "value": self._value}
 
 
+class RelaxedCounter(Counter):
+    """Lock-free counter for per-block hot paths (block-cache hits run
+    once per SST block read). `+=` on a Python int is not atomic across
+    threads, so concurrent increments may occasionally be lost — the
+    relaxed-memory-order trade every stats counter makes in the
+    reference; values are for observability, never for accounting."""
+
+    __slots__ = ()
+
+    def __init__(self) -> None:
+        self._value = 0
+        self._lock = None
+
+    def increment(self, by: int = 1) -> None:
+        self._value += by
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {"type": "counter", "value": self._value}
+
+
 class VolatileCounter(Counter):
     """Counter reset on read (reference: metrics.h volatile counter)."""
 
@@ -124,6 +144,9 @@ class MetricEntity:
 
     def counter(self, name: str) -> Counter:
         return self._get_or_create(name, Counter)
+
+    def relaxed_counter(self, name: str) -> RelaxedCounter:
+        return self._get_or_create(name, RelaxedCounter)
 
     def volatile_counter(self, name: str) -> VolatileCounter:
         return self._get_or_create(name, VolatileCounter)
