@@ -20,7 +20,10 @@ use Socket qw(IPPROTO_TCP TCP_NODELAY);
 
 # Mid-failover errors worth a config refresh + retry — mirrors
 # client/cluster_client.py _RETRYABLE (utils/errors.py values).
-my %RETRYABLE = map { $_ => 1 } (5, 6, 13, 14, 53, 56);
+# 58/63 = ERR_DISK_IO_ERROR / ERR_CHECKSUM_FAILED: the replica
+# quarantined over storage corruption; the refresh lands on the
+# healed primary once the guardian's re-learn cure completes.
+my %RETRYABLE = map { $_ => 1 } (5, 6, 13, 14, 53, 56, 58, 63);
 
 # ---- crc64 (reflected; ~init/~final) --------------------------------
 

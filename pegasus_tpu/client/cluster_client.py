@@ -65,6 +65,11 @@ _RETRYABLE = {
     # overload shedding (transport dispatcher): BUSY means "come back
     # after a backoff", exactly what the retry loop now does
     int(ErrorCode.ERR_BUSY),
+    # storage-integrity failures: the replica quarantined itself and
+    # the guardian is repairing via re-learn — the retry's config
+    # refresh lands the op on the healed (or newly promoted) primary
+    int(ErrorCode.ERR_CHECKSUM_FAILED),
+    int(ErrorCode.ERR_DISK_IO_ERROR),
 }
 
 _OK = int(ErrorCode.ERR_OK)

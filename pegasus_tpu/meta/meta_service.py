@@ -133,7 +133,8 @@ class MetaService:
     # ---- messages -----------------------------------------------------
 
     _LEADER_ONLY = frozenset({
-        "beacon", "learn_completed", "replication_error", "config_sync",
+        "beacon", "learn_completed", "replication_error",
+        "replica_corrupted", "config_sync",
         "admin", "backup_partition_done", "restore_partition_done",
         "ingest_done", "duplication_sync", "register_child",
         "query_config", "admin_reply",
@@ -175,6 +176,10 @@ class MetaService:
         if msg_type == "replication_error":
             self._on_replication_error(tuple(payload["gpid"]),
                                        payload["member"])
+            return
+        if msg_type == "replica_corrupted":
+            self._on_replica_corrupted(tuple(payload["gpid"]),
+                                       payload["node"])
             return
         if msg_type == "config_sync":
             self._on_config_sync(src, payload)
@@ -904,6 +909,48 @@ class MetaService:
         self._propose(gpid[0], gpid[1], new_pc)
         # the removed node must deactivate too
         self._send_proposal(member, app, gpid[1], new_pc)
+
+    def _on_replica_corrupted(self, gpid: Gpid, node: str) -> None:
+        """A replica self-quarantined over storage corruption (block
+        crc / index failure / disk IO error). The cure is removal +
+        re-learn: a corrupt SECONDARY leaves the membership (ballot+1)
+        and the guardian pass tops the partition back up with a fresh
+        learner built from a healthy peer; a corrupt PRIMARY demotes —
+        an alive secondary is promoted in the same config change (the
+        client's retry + config refresh lands on it) and the sick node
+        drops out. The quarantined node already trashed its store, so
+        when the guardian picks it as the learn target it rebuilds from
+        clean bytes, never from the corrupt ones."""
+        app = self.state.apps.get(gpid[0])
+        if app is None or app.status != AS_AVAILABLE:
+            return
+        pc = self.state.get_partition(*gpid)
+        # a pending learn targeting the quarantined node is dead; clear
+        # it BEFORE the membership check — a corrupt LEARNER is not in
+        # members() (it was never upgraded), and leaving the entry
+        # would stall the repair learn for the full learn timeout
+        pending = self._pending_learns.get(gpid)
+        if pending is not None and pending[0] == node:
+            self._pending_learns.pop(gpid, None)
+            self._pending_moves.pop(gpid, None)
+        if node not in pc.members():
+            return  # corrupt learner / duplicate report: nothing to cure
+        if node == pc.primary:
+            alive = [s for s in pc.secondaries if self.fd.is_alive(s)]
+            if not alive:
+                # no healthy member to promote: leave the config for
+                # ddd_diagnose / an operator `propose` — promoting
+                # nothing beats promoting nothing-with-data-loss
+                return
+            new_pc = PartitionConfig(ballot=pc.ballot + 1,
+                                     primary=alive[0],
+                                     secondaries=alive[1:])
+        else:
+            new_pc = PartitionConfig(
+                ballot=pc.ballot + 1, primary=pc.primary,
+                secondaries=[s for s in pc.secondaries if s != node])
+        self.state.update_partition(gpid[0], gpid[1], new_pc)
+        self._propose(gpid[0], gpid[1], new_pc)
 
     def _guardian_pass(self) -> None:
         """Re-replicate under-replicated partitions onto spare nodes."""

@@ -522,7 +522,7 @@ struct Client {
       }
       // retryable state errors: re-resolve; anything else surfaces
       if (err == 13 || err == 14 || err == 53 || err == 56 || err == 5 ||
-          err == 6)
+          err == 6 || err == 58 || err == 63)
         continue;
       return (int)err;
     }
@@ -558,7 +558,7 @@ struct Client {
       int64_t err = reply.get("err")->as_int();
       if (err != 0) {
         if (err == 13 || err == 14 || err == 53 || err == 56 || err == 5 ||
-            err == 6)
+            err == 6 || err == 58 || err == 63)
           continue;
         return (int)err;
       }
@@ -576,8 +576,10 @@ struct Client {
   // discipline as write_op/read_get) ----------------------------------
 
   static bool retryable(int64_t err) {
+    // 58/63: replica quarantined over storage corruption — the
+    // refresh-and-retry lands on the healed primary post-cure
     return err == 13 || err == 14 || err == 53 || err == 56 || err == 5 ||
-           err == 6;
+           err == 6 || err == 58 || err == 63;
   }
 
   // op result into *result; returns 0 ok, >0 server error, -1 transport

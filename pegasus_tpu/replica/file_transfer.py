@@ -23,7 +23,7 @@ from __future__ import annotations
 import itertools
 import os
 
-from pegasus_tpu.storage.efile import logical_size, open_data_file
+from pegasus_tpu.storage.vfs import logical_size, open_data_file
 from typing import Callable, List, Optional, Tuple
 
 CHUNK_SIZE = 1 << 20

@@ -1,13 +1,23 @@
-"""FsManager: multi-data-dir layout, capacity tracking, trash cleanup.
+"""FsManager: multi-data-dir layout, capacity tracking, trash cleanup,
+and per-dir health.
 
 Parity: src/common/fs_manager.h:115 (dir_node capacity tracking +
-per-disk replica placement), src/replica/disk_cleaner.* (removed
+per-disk replica placement + disk_status NORMAL/SPACE_INSUFFICIENT/
+IO_ERROR — fs_manager.h:52), src/replica/disk_cleaner.* (removed
 replicas rename to trash and age out instead of vanishing instantly),
 and src/replica/replica_disk_migrator.h (move a replica between disks).
+
+Health: the stub reports storage OSErrors here (`note_io_error`); a dir
+that produced EIO-class failures goes IO_ERROR, ENOSPC goes
+SPACE_INSUFFICIENT, and `replica_dir` stops placing NEW replicas on
+sick dirs (existing replicas stay until the quarantine/cure machinery
+moves them — the reference likewise only excludes sick dir_nodes from
+placement, fs_manager.cpp:select_target_dir_node).
 """
 
 from __future__ import annotations
 
+import errno as _errno
 import os
 import shutil
 import time
@@ -17,6 +27,11 @@ Gpid = Tuple[int, int]
 
 TRASH_SUFFIX = ".gar"
 
+# per-dir health states (parity: disk_status::type, fs_manager.h:52)
+DIR_NORMAL = "NORMAL"
+DIR_SPACE_INSUFFICIENT = "SPACE_INSUFFICIENT"
+DIR_IO_ERROR = "IO_ERROR"
+
 
 class FsManager:
     def __init__(self, data_dirs: List[str]) -> None:
@@ -25,6 +40,9 @@ class FsManager:
         self.data_dirs = [os.path.abspath(d) for d in data_dirs]
         for d in self.data_dirs:
             os.makedirs(d, exist_ok=True)
+        self._dir_status: Dict[str, str] = {
+            d: DIR_NORMAL for d in self.data_dirs}
+        self._dir_errors: Dict[str, int] = {d: 0 for d in self.data_dirs}
 
     # ---- layout --------------------------------------------------------
 
@@ -57,18 +75,75 @@ class FsManager:
         return None
 
     def replica_dir(self, gpid: Gpid) -> str:
-        """Existing home, or a placement on the least-loaded disk
-        (parity: fs_manager picks the dir with most headroom; replica
-        COUNT is the capacity proxy here — byte usage shifts with
-        compaction and would make placement flappy)."""
+        """Existing home, or a placement on the least-loaded HEALTHY
+        disk (parity: fs_manager picks the dir with most headroom and
+        skips non-NORMAL dir_nodes; replica COUNT is the capacity proxy
+        here — byte usage shifts with compaction and would make
+        placement flappy). When every dir is sick the least-loaded one
+        is still returned — refusing placement entirely would wedge
+        cures, and the reference degrades the same way."""
         existing = self.dir_of(gpid)
         if existing is not None:
             return existing
+        candidates = self.healthy_dirs() or self.data_dirs
         counts = {d: 0 for d in self.data_dirs}
         for _g, path in self.scan_replicas().items():
             counts[os.path.dirname(path)] += 1
-        best = min(self.data_dirs, key=lambda d: (counts[d], d))
+        best = min(candidates, key=lambda d: (counts[d], d))
         return os.path.join(best, self._entry_name(gpid))
+
+    # ---- health (parity: fs_manager dir_node status) -------------------
+
+    def healthy_dirs(self) -> List[str]:
+        return [d for d in self.data_dirs
+                if self._dir_status[d] == DIR_NORMAL]
+
+    def dir_status(self, data_dir: str) -> str:
+        return self._dir_status[os.path.abspath(data_dir)]
+
+    def dir_of_path(self, path: str) -> Optional[str]:
+        """The managed data dir containing `path` (any depth), or None."""
+        p = os.path.abspath(path)
+        for d in self.data_dirs:
+            if p == d or p.startswith(d + os.sep):
+                return d
+        return None
+
+    def note_io_error(self, path: str, exc: OSError) -> Optional[str]:
+        """Record a storage OSError against the owning dir: ENOSPC
+        marks SPACE_INSUFFICIENT, everything else IO_ERROR. Returns the
+        dir marked (None when the path is outside every managed dir).
+        An IO_ERROR verdict is sticky over SPACE_INSUFFICIENT — a disk
+        that both filled and errored is treated as broken."""
+        d = self.dir_of_path(path)
+        if d is None:
+            return None
+        self._dir_errors[d] += 1
+        status = (DIR_SPACE_INSUFFICIENT
+                  if getattr(exc, "errno", None) == _errno.ENOSPC
+                  else DIR_IO_ERROR)
+        if not (self._dir_status[d] == DIR_IO_ERROR
+                and status == DIR_SPACE_INSUFFICIENT):
+            self._dir_status[d] = status
+        return d
+
+    def mark_dir_normal(self, data_dir: str) -> None:
+        """Operator reset (disk replaced / space freed)."""
+        self._dir_status[os.path.abspath(data_dir)] = DIR_NORMAL
+
+    def health(self) -> List[dict]:
+        """Per-dir state + error counts (shell `disk_health`)."""
+        out = []
+        for d in self.data_dirs:
+            try:
+                disk = shutil.disk_usage(d)
+                avail = disk.free
+            except OSError:
+                avail = -1
+            out.append({"dir": d, "status": self._dir_status[d],
+                        "io_errors": self._dir_errors[d],
+                        "disk_available": avail})
+        return out
 
     # ---- capacity ------------------------------------------------------
 

@@ -24,7 +24,12 @@ from __future__ import annotations
 
 import os
 
-from pegasus_tpu.storage.efile import open_data_file, repair_truncate
+from pegasus_tpu.storage.vfs import (
+    fsync_dir,
+    fsync_file,
+    open_data_file,
+    repair_truncate,
+)
 import struct
 from typing import Iterable, Iterator, List, Optional, Tuple
 
@@ -73,7 +78,7 @@ class MutationLog:
         if flush:
             self._f.flush()
             if sync:
-                os.fsync(self._f.fileno())
+                fsync_file(self._f)
         else:
             self._buffered = True
         self.max_decree = max(self.max_decree, mu.decree)
@@ -92,7 +97,7 @@ class MutationLog:
         self._f.flush()
         self._buffered = False
         if sync:
-            os.fsync(self._f.fileno())
+            fsync_file(self._f)
 
     def commit_window(self, sync: bool = False) -> None:
         """Make every buffered append durable: one flush, one optional
@@ -100,7 +105,7 @@ class MutationLog:
         self._f.flush()
         self._buffered = False
         if sync:
-            os.fsync(self._f.fileno())
+            fsync_file(self._f)
 
     def _ensure_flushed(self) -> None:
         """Readers reopen the file by path; a buffered tail must reach
@@ -168,17 +173,13 @@ class MutationLog:
             for mu in keep:
                 f.write(pack_frame(mu.encode()))
             f.flush()
-            os.fsync(f.fileno())
+            fsync_file(f)
         # replace first, swap the append handle after: if the replace
         # raises, self._f still appends to the live (un-gc'd) log instead
         # of being left closed and wedging every later append
         os.replace(tmp, self.path)
         try:
-            dir_fd = os.open(os.path.dirname(self.path) or ".", os.O_RDONLY)
-            try:
-                os.fsync(dir_fd)
-            finally:
-                os.close(dir_fd)
+            fsync_dir(os.path.dirname(self.path))
         finally:
             self._f.close()
             self._f = open_data_file(self.path, "ab")

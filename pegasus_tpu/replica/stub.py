@@ -21,6 +21,7 @@ from pegasus_tpu.replica.replica import (
     ReplicaBusyError,
     ReplicaConfig,
 )
+from pegasus_tpu.utils.errors import StorageCorruptionError
 
 Gpid = Tuple[int, int]  # (app_id, partition_index)
 
@@ -133,6 +134,19 @@ class ReplicaStub:
 
         self.write_metrics = METRICS.entity("write", name)
         self.write_window = WriteFlushWindow(net, name, self.write_metrics)
+        # storage-integrity observability + the background scrubber
+        # (parity: the disk-error/scrub counters the reference keeps on
+        # its server entity; the scrub itself is this repo's analogue
+        # of rocksdb background verification)
+        from pegasus_tpu.storage.scrub import ReplicaScrubber
+
+        storage_ent = METRICS.entity("storage", "node")
+        self._quarantine_count = storage_ent.counter(
+            "replica_quarantine_count")
+        self._disk_io_errors = storage_ent.counter("disk_io_error_count")
+        self.scrubber = ReplicaScrubber(
+            lambda: self.replicas, self._on_scrub_corruption,
+            clock=self.sim_clock)
         net.register(name, self.on_message)
         batch_reg = getattr(net, "register_batch", None)
         if batch_reg is not None:
@@ -155,7 +169,22 @@ class ReplicaStub:
                 import json
                 with open(info_path) as f:
                     partition_count = json.load(f)["partition_count"]
-            self._open_replica(gpid, partition_count)
+            try:
+                self._open_replica(gpid, partition_count)
+            except (StorageCorruptionError, OSError) as e:
+                # a replica whose store fails its integrity checks at
+                # boot must not take the whole node down: retire it to
+                # trash and let the guardian re-learn it onto us (the
+                # node will report it missing at the next config_sync)
+                self._quarantine_count.increment()
+                if isinstance(e, OSError):
+                    self._disk_io_errors.increment()
+                    self.fs.note_io_error(rdir, e)
+                self.replicas.pop(gpid, None)
+                try:
+                    self.fs.trash_replica(gpid)
+                except OSError:
+                    pass
 
     def _register_default_commands(self) -> None:
         """The node's built-in control verbs (parity: the verbs replicas
@@ -327,6 +356,36 @@ class ReplicaStub:
         self.commands.register("replica.disk", replica_disk,
                                "per-replica sst+plog bytes")
 
+        def fs_health(_args):
+            """Per-dir health state + error counts (parity: the
+            fs_manager disk_status surface shell query_disk_info
+            reads)."""
+            return self.fs.health()
+
+        def replica_scrub(args):
+            """replica.scrub [app_id|status [app_id]] — no args / an
+            app_id triggers a full synchronous scrub of the hosted
+            replicas (of that table) and returns per-partition results;
+            'status' reports the paced background scrubber's progress
+            + last results without triggering anything."""
+            if args and args[0] == "status":
+                app_id = int(args[1]) if len(args) > 1 else None
+                return self.scrubber.status(app_id)
+            app_id = int(args[0]) if args else None
+            for gpid, r in sorted(list(self.replicas.items())):
+                if app_id is not None and gpid[0] != app_id:
+                    continue
+                if self.replicas.get(gpid) is r:  # not quarantined yet
+                    self.scrubber.scrub_now(gpid, r)
+            return self.scrubber.status(app_id)
+
+        self.commands.register("fs.health", fs_health,
+                               "per-data-dir health + io error counts")
+        self.commands.register(
+            "replica.scrub", replica_scrub,
+            "replica.scrub [app_id | status [app_id]] — trigger a full "
+            "scrub / report scrub progress+results")
+
     def close(self) -> None:
         for r in self.replicas.values():
             r.close()
@@ -374,6 +433,91 @@ class ReplicaStub:
     def get_replica(self, gpid: Gpid) -> Optional[Replica]:
         return self.replicas.get(gpid)
 
+    # ---- storage integrity: detect -> quarantine -> repair via re-learn
+    # (parity: the reference's disk-error handling —
+    # replica::handle_local_failure marks the replica PS_ERROR, the
+    # stub's disk monitor flags the dir, and the partition guardian
+    # re-replicates; the repair channel is the learner flow) -----------
+
+    def scrub_tick(self) -> None:
+        """Timer: one paced scrub advance (storage/scrub.py). Corrupt
+        blocks found here quarantine their replica exactly like a
+        corrupt client read would."""
+        self.scrubber.tick()
+
+    def _on_scrub_corruption(self, gpid: Gpid, exc: Exception) -> None:
+        self._on_storage_error(gpid, exc)
+
+    def _replica_for_path(self, path: str) -> Optional[Gpid]:
+        """Map a corrupt file path to the replica whose store owns it
+        (batched reads span partitions; the exception names the file)."""
+        p = os.path.abspath(path)
+        for gpid, r in self.replicas.items():
+            d = os.path.abspath(r.data_dir)
+            if p == d or p.startswith(d + os.sep):
+                return gpid
+        return None
+
+    def _on_storage_error(self, gpid: Optional[Gpid], exc: Exception) -> int:
+        """One storage failure -> typed error code + disk-health note +
+        replica quarantine. Returns the ErrorCode int the RPC reply
+        should carry."""
+        from pegasus_tpu.utils.errors import ErrorCode
+
+        if isinstance(exc, StorageCorruptionError):
+            code = int(ErrorCode.ERR_CHECKSUM_FAILED)
+            if gpid is None:
+                gpid = self._replica_for_path(exc.path)
+        else:  # OSError: the disk itself is failing, mark its dir sick
+            code = int(ErrorCode.ERR_DISK_IO_ERROR)
+            self._disk_io_errors.increment()
+            path = getattr(exc, "filename", None)
+            if path is None and gpid is not None:
+                r = self.replicas.get(gpid)
+                if r is not None:
+                    path = r.data_dir
+            if path is not None:
+                self.fs.note_io_error(path, exc)
+        if gpid is not None:
+            self._quarantine_replica(gpid, repr(exc))
+        return code
+
+    def _quarantine_replica(self, gpid: Gpid, reason: str) -> None:
+        """Self-quarantine: stop serving, retire the sick store to
+        trash (the boot scan ignores trash, so these bytes can never be
+        reopened), drop the node caches that could still hold pre-
+        corruption rows, and report to the partition guardian — which
+        removes us from the membership and tops the partition back up
+        by re-learning a fresh replica from a healthy peer (possibly
+        onto this same node, on a healthy dir)."""
+        r = self.replicas.pop(gpid, None)
+        if r is None:
+            return  # already quarantined (scrub + read raced)
+        self._quarantine_count.increment()
+        # no stale pre-repair bytes may serve: the node row cache drops
+        # this partition NOW (install_engine/_on_store_publish re-cover
+        # this when the re-learned engine installs, but the window
+        # between quarantine and repair must be closed too)
+        from pegasus_tpu.server.row_cache import ROW_CACHE
+
+        ROW_CACHE.invalidate_gid(gpid)
+        r.status = PartitionStatus.ERROR
+        try:
+            r.close()
+        except (OSError, RuntimeError, ValueError):
+            pass  # the store is already known-bad; closing is best-effort
+        try:
+            self.fs.trash_replica(gpid)
+        except OSError:
+            pass
+        # an in-flight checkpoint fetch must die with the replica
+        sess = self._fetch_sessions.pop(gpid, None)
+        if sess is not None:
+            sess._finished = True
+        for meta in self._meta_targets():
+            self.net.send(self.name, meta, "replica_corrupted", {
+                "gpid": gpid, "node": self.name, "reason": reason})
+
     # ---- message routing ----------------------------------------------
 
     def on_message(self, src: str, msg_type: str, payload) -> None:
@@ -403,7 +547,15 @@ class ReplicaStub:
                 r = self._open_replica(
                     gpid, payload["payload"].get("partition_count", 1))
             if r is not None:
-                r.on_message(src, payload["type"], payload["payload"])
+                try:
+                    r.on_message(src, payload["type"], payload["payload"])
+                except (StorageCorruptionError, OSError) as e:
+                    # a SECONDARY can trip corruption too (apply-path
+                    # compaction re-reads blocks, learning copies
+                    # files): quarantine instead of killing the
+                    # dispatcher — the primary sees the missing ack and
+                    # the guardian repairs via re-learn
+                    self._on_storage_error(gpid, e)
             return
         if msg_type in ("prepare_batch", "prepare_batch_ack"):
             # aggregated 2PC fan-out (group_commit): one message carries
@@ -415,7 +567,10 @@ class ReplicaStub:
             for gpid, item in payload["items"]:
                 r = self.replicas.get(tuple(gpid))
                 if r is not None:
-                    r.on_message(src, kind, item)
+                    try:
+                        r.on_message(src, kind, item)
+                    except (StorageCorruptionError, OSError) as e:
+                        self._on_storage_error(tuple(gpid), e)
             return
         if msg_type == "negotiate":
             # SASL-style connection auth handshake (negotiation.h:37).
@@ -625,6 +780,14 @@ class ReplicaStub:
             self.net.send(self.name, src, "client_write_reply", {
                 "rid": rid, "err": int(ErrorCode.ERR_BUSY),
                 "results": []})
+        except (StorageCorruptionError, OSError) as e:
+            # the store under this write is corrupt or its disk is
+            # dying: typed reply (retryable — the client's refresh
+            # lands on the healed primary after the guardian's cure),
+            # then detect -> quarantine -> re-learn
+            self.net.send(self.name, src, "client_write_reply", {
+                "rid": rid, "err": self._on_storage_error(gpid, e),
+                "results": []})
         except (RuntimeError, ValueError):
             self.net.send(self.name, src, "client_write_reply", {
                 "rid": rid, "err": int(ErrorCode.ERR_INVALID_STATE),
@@ -713,6 +876,12 @@ class ReplicaStub:
                     state["outstanding"] -= 1
                     for i, _n in spans:
                         results[i] = (int(ErrorCode.ERR_BUSY), [])
+                except (StorageCorruptionError, OSError) as e:
+                    state["outstanding"] -= 1
+                    code = self._on_storage_error(
+                        (replica.server.app_id, replica.server.pidx), e)
+                    for i, _n in spans:
+                        results[i] = (code, [])
                 except (RuntimeError, ValueError):
                     state["outstanding"] -= 1
                     for i, _n in spans:
@@ -809,6 +978,16 @@ class ReplicaStub:
                 "rid": rid, "err": int(ErrorCode.ERR_INVALID_PARAMETERS),
                 "result": None})
             return
+        except (StorageCorruptionError, OSError) as e:
+            # a block failed its crc (or the disk failed the read):
+            # typed retryable reply — the client's backoff + config
+            # refresh lands it on the healed primary — then the replica
+            # quarantines and the guardian repairs via re-learn
+            self.net.send(self.name, src, "client_read_reply", {
+                "rid": rid,
+                "err": self._on_storage_error(tuple(payload["gpid"]), e),
+                "result": None})
+            return
         except RuntimeError:
             self.net.send(self.name, src, "client_read_reply", {
                 "rid": rid, "err": int(ErrorCode.ERR_INVALID_STATE),
@@ -889,9 +1068,12 @@ class ReplicaStub:
         # because there one deadline really does govern the whole batch.
         try:
             results = point_read_multi(pairs)
-        except (ValueError, RuntimeError):
-            # malformed op in the flush: re-serve each solo so every
-            # request gets its own precise error instead of a shared one
+        except (ValueError, RuntimeError, OSError):
+            # malformed op in the flush — or a corrupt block / failing
+            # disk under ONE member: re-serve each solo so every
+            # request gets its own precise error instead of a shared
+            # one (the solo path carries the typed corruption handling
+            # and quarantines exactly the sick replica)
             for src, payload, _srv in flush:
                 self._on_client_read(src, payload)
             return
@@ -955,6 +1137,21 @@ class ReplicaStub:
                 for slot_i, _srv, _ops in ok:
                     slots[slot_i] = (slots[slot_i][0], int(
                         ErrorCode.ERR_INVALID_PARAMETERS), None)
+            except (StorageCorruptionError, OSError) as e:
+                # one member's store is corrupt: its slot gets the
+                # typed code (and the replica quarantines); healthy
+                # neighbors get retryable INVALID_STATE — their work
+                # was lost with the shared flush, not their data
+                bad = (self._replica_for_path(e.path)
+                       if isinstance(e, StorageCorruptionError) else None)
+                code = self._on_storage_error(bad, e)
+                for slot_i, srv, _ops in ok:
+                    hit = bad is not None and \
+                        (srv.app_id, srv.pidx) == bad
+                    slots[slot_i] = (
+                        slots[slot_i][0],
+                        code if (hit or bad is None)
+                        else int(ErrorCode.ERR_INVALID_STATE), None)
             except RuntimeError:
                 for slot_i, _srv, _ops in ok:
                     slots[slot_i] = (slots[slot_i][0], int(

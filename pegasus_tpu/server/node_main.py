@@ -53,6 +53,15 @@ def run_node(cfg: dict, name: str) -> None:
             FaultPlan.from_config(cfg["fault_plan"]))
         print(f"[{name}] fault plan armed: {cfg['fault_plan']}",
               flush=True)
+    if cfg.get("disk_fault_plan"):
+        # the disk twin of fault_plan (storage/vfs.py): bit-flip /
+        # torn-write / EIO / ENOSPC injection on the data-file layer,
+        # seeded so a chaos run replays exactly
+        from pegasus_tpu.storage.vfs import install_disk_faults
+
+        install_disk_faults(cfg["disk_fault_plan"])
+        print(f"[{name}] disk fault plan armed: "
+              f"{cfg['disk_fault_plan']}", flush=True)
     meta_names = [n for n, c in cfg["nodes"].items()
                   if c["role"] == "meta"]
 
@@ -99,6 +108,10 @@ def run_node(cfg: dict, name: str) -> None:
         transport.run_timer(1.0, stub.dup_tick)
         transport.run_timer(1.0, stub.split_tick)
         transport.run_timer(2.0, stub.transfer_tick)
+        # paced background scrub: verify at-rest block CRCs so latent
+        # corruption on a non-serving replica is found and repaired
+        # (quarantine + re-learn) before a promotion serves it
+        transport.run_timer(1.0, stub.scrub_tick)
         # keep device predicate masks warm across TTL-seconds so scans
         # never block on an accelerator round-trip (scan_coordinator)
         from pegasus_tpu.server.scan_coordinator import MaskPrefresher

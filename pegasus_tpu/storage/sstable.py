@@ -37,7 +37,7 @@ from typing import Iterator, List, Optional, Tuple
 
 import numpy as np
 
-from pegasus_tpu.storage.efile import open_data_file
+from pegasus_tpu.storage.vfs import fsync_dir, fsync_file, open_data_file
 
 from pegasus_tpu.base.crc import crc32, crc64_batch, crc64_rows
 from pegasus_tpu.ops.record_block import next_bucket
@@ -46,7 +46,29 @@ from pegasus_tpu.storage.bloom import (
     bloom_build_bits,
     bloom_probe_enabled,
 )
+from pegasus_tpu.utils.errors import StorageCorruptionError
+from pegasus_tpu.utils.flags import FLAGS, define_flag
 from pegasus_tpu.utils.metrics import METRICS
+
+define_flag("pegasus.storage", "block_crc", True,
+            "write a crc32 per data block into new SST files and "
+            "verify it on every block decode (cache misses only — "
+            "cached hits already paid); files written without block "
+            "CRCs keep serving unverified", mutable=True)
+
+
+def block_crc_enabled() -> bool:
+    return bool(FLAGS.get("pegasus.storage", "block_crc"))
+
+
+# Block checksums use zlib's slice-by-8 CRC-32 (~1 GB/s) rather than
+# the repo's table-loop CRC-32C (~235 MB/s): the block CRC is a private
+# file-format field with no wire-parity constraint — unlike the routing
+# crc64 / framing crc32, which stay bit-compatible with the reference —
+# and it sits on every cold block decode, where a 4x cheaper check is
+# the difference between "noise" and a measurable read regression
+# (rocksdb likewise offers kxxHash behind the same per-block slot).
+from zlib import crc32 as _block_crc32  # noqa: E402
 
 # node-wide storage observability (parity: the rocksdb block-cache /
 # filter tickers the reference exports per server): relaxed counters —
@@ -76,6 +98,11 @@ class BlockMeta:
     key_width: int
     first_key: bytes
     last_key: bytes
+    # crc32 of the block's on-disk bytes (header + columns + heap);
+    # None for files written before the block-checksum layer — those
+    # keep serving unverified (parity: rocksdb's per-block checksum,
+    # which the reference trusts for every data block read)
+    crc: Optional[int] = None
 
 
 class Block:
@@ -168,6 +195,9 @@ class SSTableWriter:
         # built at finish(); bits-per-key is latched HERE so a mutable
         # flag flip mid-write cannot tear one table's filter
         self._bloom_bits_per_key = bloom_build_bits()
+        # block-checksum latch, same reasoning: one table is either
+        # fully checksummed or fully legacy, never mixed
+        self._block_crc = block_crc_enabled()
         self._key_hashes: List[np.ndarray] = []
         if async_io:
             import queue
@@ -255,14 +285,18 @@ class SSTableWriter:
 
         offset = self._offset
         # ONE buffer per block: a single kernel copy + syscall instead of
-        # eight, and a single unit for the async-IO queue
-        self._write(b"".join((
+        # eight, and a single unit for the async-IO queue — and the one
+        # pass the end-to-end block checksum rides (crc32 over exactly
+        # the bytes that hit the disk)
+        buf = b"".join((
             _BLOCK_HDR.pack(n, width, len(heap)), keys.tobytes(),
             key_len.tobytes(), ets.tobytes(), hash_lo.tobytes(),
-            flags.tobytes(), offs.tobytes(), heap)))
+            flags.tobytes(), offs.tobytes(), heap))
+        self._write(buf)
         self._blocks.append(BlockMeta(
             offset=offset, size=self._offset - offset, count=n,
-            key_width=width, first_key=recs[0][0], last_key=recs[-1][0]))
+            key_width=width, first_key=recs[0][0], last_key=recs[-1][0],
+            crc=_block_crc32(buf) if self._block_crc else None))
 
     def add_block_columnar(self, keys: np.ndarray, key_len: np.ndarray,
                            ets: np.ndarray, hash_lo: np.ndarray,
@@ -283,7 +317,7 @@ class SSTableWriter:
         if self._bloom_bits_per_key > 0:
             self._key_hashes.append(crc64_rows(keys, key_len))
         offset = self._offset
-        self._write(b"".join((
+        buf = b"".join((
             _BLOCK_HDR.pack(n, width, len(heap)),
             np.ascontiguousarray(keys, dtype=np.uint8).tobytes(),
             np.ascontiguousarray(key_len, dtype=np.int32).tobytes(),
@@ -291,10 +325,12 @@ class SSTableWriter:
             np.ascontiguousarray(hash_lo, dtype=np.uint32).tobytes(),
             np.ascontiguousarray(flags, dtype=np.uint8).tobytes(),
             np.ascontiguousarray(value_offs, dtype=np.uint32).tobytes(),
-            heap)))
+            heap))
+        self._write(buf)
         self._blocks.append(BlockMeta(
             offset=offset, size=self._offset - offset, count=n,
-            key_width=width, first_key=first_key, last_key=last_key))
+            key_width=width, first_key=first_key, last_key=last_key,
+            crc=_block_crc32(buf) if self._block_crc else None))
         self._count += n
         self._last_key = last_key
 
@@ -305,7 +341,8 @@ class SSTableWriter:
             "blocks": [
                 {"off": b.offset, "size": b.size, "count": b.count,
                  "kw": b.key_width, "first": b.first_key.hex(),
-                 "last": b.last_key.hex()}
+                 "last": b.last_key.hex(),
+                 **({"crc": b.crc} if b.crc is not None else {})}
                 for b in self._blocks
             ],
             "meta": self._meta,
@@ -327,17 +364,13 @@ class SSTableWriter:
         self._f.write(blob)
         self._f.write(FOOTER.pack(index_offset, len(blob), crc32(blob), MAGIC))
         self._f.flush()
-        os.fsync(self._f.fileno())
+        fsync_file(self._f)
         self._f.close()
         os.replace(self.path + ".tmp", self.path)
         # the rename itself must be durable BEFORE the caller truncates the
         # WAL, or a power failure can lose the SST while the WAL is already
         # empty — fsync the containing directory
-        dir_fd = os.open(os.path.dirname(self.path) or ".", os.O_RDONLY)
-        try:
-            os.fsync(dir_fd)
-        finally:
-            os.close(dir_fd)
+        fsync_dir(os.path.dirname(self.path))
 
     def abandon(self) -> None:
         try:
@@ -381,22 +414,28 @@ class SSTable:
         self._f.seek(0, os.SEEK_END)
         file_size = self._f.tell()
         if file_size < len(MAGIC) + FOOTER.size:
-            raise ValueError(f"{path}: not an sstable (too small)")
+            raise StorageCorruptionError(path, "not an sstable (too small)")
         self._f.seek(file_size - FOOTER.size)
         index_offset, index_size, index_crc, magic = FOOTER.unpack(
             self._f.read(FOOTER.size))
         if magic not in (MAGIC, MAGIC_V1):
-            raise ValueError(f"{path}: bad footer magic")
+            raise StorageCorruptionError(path, "bad footer magic")
         self._has_hash_lo = magic == MAGIC
         self._f.seek(index_offset)
         blob = self._f.read(index_size)
         if crc32(blob) != index_crc:
-            raise ValueError(f"{path}: index crc mismatch")
-        index = json.loads(blob)
+            raise StorageCorruptionError(path, "index crc mismatch")
+        try:
+            index = json.loads(blob)
+        except ValueError as e:
+            # crc passed but the JSON doesn't parse: a write bug, not a
+            # disk flip — still corruption at the serving surface
+            raise StorageCorruptionError(path, f"index unparsable: {e}")
         self.blocks: List[BlockMeta] = [
             BlockMeta(offset=e["off"], size=e["size"], count=e["count"],
                       key_width=e["kw"], first_key=bytes.fromhex(e["first"]),
-                      last_key=bytes.fromhex(e["last"]))
+                      last_key=bytes.fromhex(e["last"]),
+                      crc=e.get("crc"))
             for e in index["blocks"]
         ]
         self.meta: dict = index.get("meta", {})
@@ -465,6 +504,14 @@ class SSTable:
         else:
             self._f.seek(bm.offset)
             raw = self._f.read(bm.size)
+        # verify-on-read BEHIND the block cache: a decoded block is
+        # checked exactly once per residency, so cached hits (the hot
+        # path) pay nothing. Legacy blocks (crc None) serve unverified.
+        if bm.crc is not None and _block_crc32(raw) != bm.crc:
+            raise StorageCorruptionError(
+                self.path,
+                f"block {idx} crc mismatch (offset {bm.offset}, "
+                f"{bm.size} bytes)")
         n, width, heap_size = _BLOCK_HDR.unpack_from(raw, 0)
         pos = _BLOCK_HDR.size
         keys = np.frombuffer(raw, dtype=np.uint8, count=n * width,
@@ -490,6 +537,50 @@ class SSTable:
             self._cache.popitem(last=False)  # evict true-LRU head
         self._cache[idx] = blk
         return blk
+
+    def verify_block(self, idx: int) -> bool:
+        """Scrub entry point: re-read block `idx`'s raw bytes and check
+        them against the index CRC — no decode, no block-cache
+        pollution (a scrub walking a cold table must not evict the
+        serving working set). Returns False for legacy blocks (nothing
+        to verify); raises StorageCorruptionError on a mismatch."""
+        bm = self.blocks[idx]
+        if bm.crc is None:
+            return False
+        if self._mv is not None:
+            raw = self._mv[bm.offset:bm.offset + bm.size]
+        else:
+            self._f.seek(bm.offset)
+            raw = self._f.read(bm.size)
+        if len(raw) != bm.size or _block_crc32(raw) != bm.crc:
+            raise StorageCorruptionError(
+                self.path,
+                f"scrub: block {idx} crc mismatch (offset {bm.offset}, "
+                f"{bm.size} bytes)")
+        return True
+
+    def verify_index_consistency(self) -> None:
+        """Scrub's structural pass: block fences must be internally
+        ordered and monotonic across the file, and (when a filter
+        exists) every block's first key must answer 'maybe' from the
+        bloom filter — a filter that denies a present key would turn
+        into silent NotFound under probe pruning, which is data loss
+        without a single flipped data byte."""
+        prev_last: Optional[bytes] = None
+        for i, bm in enumerate(self.blocks):
+            if bm.first_key > bm.last_key:
+                raise StorageCorruptionError(
+                    self.path, f"scrub: block {i} fence inverted")
+            if prev_last is not None and bm.first_key <= prev_last:
+                raise StorageCorruptionError(
+                    self.path, f"scrub: block {i} overlaps block {i - 1}")
+            prev_last = bm.last_key
+            if self.bloom is not None and \
+                    not self.bloom.may_contain(bm.first_key):
+                raise StorageCorruptionError(
+                    self.path,
+                    f"scrub: bloom filter denies resident key "
+                    f"(block {i} first key)")
 
     def get(self, key: bytes) -> Optional[Tuple[Optional[bytes], int]]:
         """Returns (value|None-for-tombstone, expire_ts), or None if absent."""

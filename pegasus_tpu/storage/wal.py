@@ -22,7 +22,11 @@ import struct
 from dataclasses import dataclass
 from typing import Iterator, List, Optional, Tuple
 
-from pegasus_tpu.storage.efile import open_data_file, repair_truncate
+from pegasus_tpu.storage.vfs import (
+    fsync_file,
+    open_data_file,
+    repair_truncate,
+)
 from pegasus_tpu.storage.framed_log import (
     iter_frames,
     pack_frame,
@@ -87,7 +91,7 @@ class WriteAheadLog:
             return
         self._f.flush()
         if sync:
-            os.fsync(self._f.fileno())
+            fsync_file(self._f)
 
     def close(self) -> None:
         self._f.close()

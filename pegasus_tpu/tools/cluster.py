@@ -105,6 +105,9 @@ class SimCluster:
                     stub.dup_tick()
                     stub.split_tick()
                     stub.transfer_tick()
+                    # background scrub timer: latent at-rest corruption
+                    # on non-serving replicas is detected here
+                    stub.scrub_tick()
             self.loop.run_for(self.beacon_interval)
             for m in self.metas:
                 if m.name not in self._dead:

@@ -49,7 +49,8 @@ def start(directory: str = DEFAULT_DIR, n_replica: int = 3,
           n_meta: int = 1, auth_secret: Optional[str] = None,
           name_prefix: str = "",
           extra_peers: Optional[Dict[str, Tuple[str, int]]] = None,
-          fault_plan: Optional[dict] = None) -> dict:
+          fault_plan: Optional[dict] = None,
+          disk_fault_plan: Optional[dict] = None) -> dict:
     """`name_prefix` namespaces this cluster's node names (two oneboxes
     on one host must not both own "meta"); `extra_peers` maps REMOTE
     node names to (host, port) — written into the address book with
@@ -84,6 +85,11 @@ def start(directory: str = DEFAULT_DIR, n_replica: int = 3,
         # node_main), so kill_test/integration runs inject network
         # faults without any in-process hook
         cfg["fault_plan"] = fault_plan
+    if disk_fault_plan:
+        # the disk twin: storage/vfs.py fail-point actions (bit_flip /
+        # torn_write / eio / enospc), armed in every node process at
+        # boot from one seed so the run replays
+        cfg["disk_fault_plan"] = disk_fault_plan
     if auth_secret:
         # onebox-grade key distribution: the secret lives in the cluster
         # config file (the keytab-file analogue)
@@ -213,8 +219,28 @@ class OneboxAdmin:
         self.net.register(name, self._on_message)
 
     def _on_message(self, src: str, msg_type: str, payload) -> None:
-        if msg_type == "admin_reply":
+        if msg_type in ("admin_reply", "remote_command_reply"):
             self._replies[payload["rid"]] = payload
+
+    def remote_command(self, node: str, verb: str, args=None,
+                       timeout: float = 10.0):
+        """Invoke a registered control verb on one node (the chaos
+        harness uses this to force flushes and read the integrity
+        counters; the shell's wire mode has its own copy)."""
+        rid = next(self._rids)
+        self.net.send(self.name, node, "remote_command",
+                      {"rid": rid, "cmd": verb, "args": args or []})
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if rid in self._replies:
+                reply = self._replies.pop(rid)
+                if reply["err"] != 0:
+                    raise PegasusError(ErrorCode.ERR_HANDLER_NOT_FOUND,
+                                       str(reply["result"]))
+                return reply["result"]
+            time.sleep(0.01)
+        raise PegasusError(ErrorCode.ERR_TIMEOUT,
+                           f"remote_command {verb} to {node}")
 
     def call(self, cmd: str, timeout: float = 15.0, **args):
         """One OVERALL deadline shared across the meta-group rotation —

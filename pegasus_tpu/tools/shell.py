@@ -24,7 +24,7 @@ verbs, parity: the linenoise REPL + `use`). Command families:
   cluster    : cluster_info, nodes, server_info, server_stat, app_stat,
                app_disk, ddd_diagnose, propose, rebalance, offline_node,
                get/set_meta_level, detect_hotkey, remote_command,
-               slow_queries, metrics, storage_stats
+               slow_queries, metrics, storage_stats, disk_health, scrub
   offline    : sst_dump, mlog_dump, local_get, rdb_key_str2hex,
                rdb_key_hex2str, rdb_value_hex2str
 
@@ -293,6 +293,14 @@ def main(argv=None) -> int:
     p = sub.add_parser("storage_stats")
     p.add_argument("table",
                    help="dump cache/bloom counters per partition")
+    p = sub.add_parser("disk_health")
+    p.add_argument("node", nargs="?", default=None,
+                   help="one node, or all replica nodes when omitted")
+    p = sub.add_parser("scrub")
+    p.add_argument("table")
+    p.add_argument("--status", action="store_true",
+                   help="report background-scrub progress/last-result "
+                        "only (no trigger)")
     p = sub.add_parser("app_stat")
     p.add_argument("table")
     p = sub.add_parser("app_disk")
@@ -394,7 +402,10 @@ def main(argv=None) -> int:
         from pegasus_tpu.tools.onebox import Onebox
 
         box = Onebox(args.root)
-    from pegasus_tpu.utils.errors import PegasusError
+    from pegasus_tpu.utils.errors import (
+        PegasusError,
+        StorageCorruptionError,
+    )
 
     out = sys.stdout
     try:
@@ -412,7 +423,10 @@ def main(argv=None) -> int:
               f"--cluster)", file=sys.stderr)
         return 1
     except (KeyError, ValueError, NotImplementedError,
-            PegasusError) as exc:
+            PegasusError, StorageCorruptionError) as exc:
+        # StorageCorruptionError: the offline dump tools exist to poke
+        # at exactly the corrupt files that raise it — report, don't
+        # traceback
         print(f"error: {exc}", file=sys.stderr)
         return 1
     finally:
@@ -428,7 +442,8 @@ _TABLE_VERBS = frozenset({
     "multi_get_sortkeys", "hash_scan", "full_scan", "count_data",
     "clear_data", "hash", "set_app_envs", "get_app_envs",
     "manual_compact", "partition_split", "flush", "app_stat",
-    "app_disk", "get_replica_count", "enable_atomic_idempotent",
+    "app_disk", "scrub", "get_replica_count",
+    "enable_atomic_idempotent",
     "disable_atomic_idempotent", "get_atomic_idempotent",
 })
 
@@ -440,7 +455,10 @@ def _repl(parser, box, out) -> int:
     session."""
     import shlex
 
-    from pegasus_tpu.utils.errors import PegasusError
+    from pegasus_tpu.utils.errors import (
+        PegasusError,
+        StorageCorruptionError,
+    )
 
     import pegasus_tpu
 
@@ -540,7 +558,7 @@ def _repl(parser, box, out) -> int:
             print(f"error: {exc} (this command may need wire mode: "
                   f"--cluster)", file=out)
         except (KeyError, ValueError, NotImplementedError,
-                PegasusError) as exc:
+                PegasusError, StorageCorruptionError) as exc:
             print(f"error: {exc}", file=out)
 
 
@@ -735,30 +753,15 @@ class _ClusterBox:
 
     def remote_command(self, node: str, verb: str, cmd_args):
         """Invoke a registered control verb on one node (parity: shell
-        remote_command over RPC_CLI_CLI_CALL)."""
-        import itertools as _it
-        import time as _time
+        remote_command over RPC_CLI_CLI_CALL) — the poll protocol lives
+        on OneboxAdmin (the chaos harness shares it); this surfaces its
+        failures in the shell's ValueError error space."""
+        from pegasus_tpu.utils.errors import PegasusError
 
-        rid = next(self.admin._rids)
-        replies = self.admin._replies
-        self.admin.net.register(self.admin.name, self.admin._on_message)
-
-        def on_msg(src, msg_type, payload):
-            if msg_type in ("admin_reply", "remote_command_reply"):
-                replies[payload["rid"]] = payload
-
-        self.admin.net.register(self.admin.name, on_msg)
-        self.admin.net.send(self.admin.name, node, "remote_command",
-                            {"rid": rid, "cmd": verb, "args": cmd_args})
-        deadline = _time.monotonic() + 10
-        while _time.monotonic() < deadline:
-            if rid in replies:
-                reply = replies.pop(rid)
-                if reply["err"] != 0:
-                    raise ValueError(str(reply["result"]))
-                return reply["result"]
-            _time.sleep(0.01)
-        raise ValueError(f"remote_command to {node} timed out")
+        try:
+            return self.admin.remote_command(node, verb, cmd_args)
+        except PegasusError as e:
+            raise ValueError(str(e))
 
     def open_table(self, name: str):
         raise NotImplementedError(
@@ -1277,6 +1280,29 @@ def _dispatch(args, box, out) -> int:
         for n in nodes:
             print(json.dumps({n: box.remote_command(n, verb, [])},
                              indent=1), file=out)
+    elif args.cmd == "disk_health":
+        # per-dir health state + io error counts across the fleet
+        # (parity: shell query_disk_info over the fs_manager states)
+        nodes = ([args.node] if args.node
+                 else box.admin.call("list_nodes"))
+        for n in nodes:
+            print(json.dumps(
+                {n: box.remote_command(n, "fs.health", [])},
+                indent=1), file=out)
+    elif args.cmd == "scrub":
+        # trigger (or query) the storage scrub for one table: every
+        # node scrubs its hosted partitions and reports per-partition
+        # progress + last result
+        app_ids = {row["app_id"] for row in box.list_tables()
+                   if row["name"] == args.table}
+        if not app_ids:
+            raise ValueError(f"no such table {args.table!r}")
+        app_id = str(sorted(app_ids)[0])
+        verb_args = (["status", app_id] if args.status else [app_id])
+        for n in box.admin.call("list_nodes"):
+            rows = box.remote_command(n, "replica.scrub", verb_args)
+            for row in rows:
+                print(json.dumps(dict(row, node=n)), file=out)
     elif args.cmd == "app_stat":
         rows = []
         for n in box.admin.call("list_nodes"):

@@ -79,6 +79,7 @@ class ErrorCode(enum.IntEnum):
     ERR_RANGER_PARSE_ACL = 60
     ERR_ACL_DENY = 61
     ERR_DUP_EXIST = 62
+    ERR_CHECKSUM_FAILED = 63
 
 
 class StorageStatus(enum.IntEnum):
@@ -108,3 +109,19 @@ class PegasusError(Exception):
     def __init__(self, code: ErrorCode, message: str = ""):
         self.code = code
         super().__init__(f"{code.name}: {message}" if message else code.name)
+
+
+class StorageCorruptionError(RuntimeError):
+    """On-disk bytes failed an integrity check (block crc32, index crc,
+    bad magic): carries the file path so the node can map the failure to
+    the owning replica and quarantine it. Subclasses RuntimeError so
+    paths that have no corruption policy still degrade to their generic
+    ERR_INVALID_STATE handling; the stub's client gates catch THIS type
+    first and surface typed ERR_CHECKSUM_FAILED (parity: rocksdb
+    Status::Corruption surfacing through the replica's disk-error
+    handler, replica/replica_disk_monitor + pegasus_event_listener)."""
+
+    def __init__(self, path: str, detail: str = ""):
+        self.path = path
+        self.detail = detail
+        super().__init__(f"{path}: {detail}" if detail else path)

@@ -49,6 +49,16 @@ class _FailPointRegistry:
         with self._lock:
             self._rng = random.Random(seed)
 
+    def rand(self) -> float:
+        """One draw from the seeded chaos stream (under the registry
+        lock — concurrent consumers must not tear or de-determinize
+        it). Fault actions that need PARAMETERS beyond fire/don't-fire
+        — which bit a vfs bit-flip corrupts, how much of a torn write
+        survives — draw here so a whole chaos run replays from
+        FAIL_POINTS.seed alone."""
+        with self._lock:
+            return self._rng.random()
+
     def cfg(self, name: str, action: str) -> None:
         """Configure an action string, mirroring the reference's mini-language:
         'off', 'return(<value>)', 'delay(<ms>)', 'raise(<msg>)', each
@@ -93,6 +103,13 @@ class _FailPointRegistry:
     def cfg_callable(self, name: str, fn: Callable[[str], Any]) -> None:
         with self._lock:
             self._actions[name] = fn
+
+    def configured(self, name: str) -> bool:
+        """Whether an action is configured for `name` — lets layers
+        that wrap whole objects per fault domain (storage/vfs.py) skip
+        the wrap when THEIR sites are idle even while the registry is
+        enabled for someone else's (the network FaultPlan's)."""
+        return name in self._actions
 
     def inject(self, name: str) -> Optional[Any]:
         """Returns None when the point is inactive; otherwise the configured
