@@ -432,7 +432,16 @@ class ClusterClient:
                   ) -> Tuple[int, List[Tuple[bytes, bytes, bytes]]]:
         self._ensure_config()
         deadline = self._deadline()
+        out: List[Tuple[bytes, bytes, bytes]] = []
+        # keys not yet definitively answered; a split racing an attempt
+        # bounces only the stale-routed GROUPS (per-key misroute gate on
+        # the server), and only those re-resolve under the refreshed
+        # count — answered groups keep their results instead of the
+        # whole flush replaying
+        pending: List[Tuple[bytes, bytes]] = list(keys)
         for attempt in range(self._max_retries):
+            if not pending:
+                break
             if attempt:
                 if self._clock() > deadline:
                     raise PegasusError(ErrorCode.ERR_TIMEOUT,
@@ -444,34 +453,35 @@ class ClusterClient:
                     pass  # meta momentarily down: cached config may
                     # still be right, like _read/_write tolerate
             # regroup under the CURRENT partition count each attempt — a
-            # split between attempts changes every key's pidx
-            by_pidx: Dict[int, List[FullKey]] = {}
-            for hk, sk in keys:
+            # split between attempts changes the stale keys' pidx
+            by_pidx: Dict[int, List[Tuple[bytes, bytes]]] = {}
+            for hk, sk in pending:
                 pidx = key_hash_parts(hk, sk) % self.partition_count
-                by_pidx.setdefault(pidx, []).append(FullKey(hk, sk))
-            out: List[Tuple[bytes, bytes, bytes]] = []
-            stale = False
-            for pidx, fks in by_pidx.items():
+                by_pidx.setdefault(pidx, []).append((hk, sk))
+            still: List[Tuple[bytes, bytes]] = []
+            for pidx, group in by_pidx.items():
+                fks = [FullKey(hk, sk) for hk, sk in group]
                 try:
                     resp = self._read("batch_get", BatchGetRequest(fks),
                                       pidx, deadline=deadline)
                 except PegasusError as e:
                     if int(e.code) in _RETRYABLE:
-                        stale = True
-                        break
+                        still.extend(group)
+                        continue
                     raise
                 if resp.error == int(
                         ErrorCode.ERR_PARENT_PARTITION_MISUSED):
-                    stale = True
-                    break
+                    still.extend(group)
+                    continue
                 if resp.error != int(StorageStatus.OK):
                     return resp.error, []
                 out.extend((d.hash_key, d.sort_key, d.value)
                            for d in resp.data)
-            if not stale:
-                return int(StorageStatus.OK), out
-        raise PegasusError(ErrorCode.ERR_TIMEOUT,
-                           "batch_get exhausted retries")
+            pending = still
+        if pending:
+            raise PegasusError(ErrorCode.ERR_TIMEOUT,
+                               "batch_get exhausted retries")
+        return int(StorageStatus.OK), out
 
     def check_and_set(self, hash_key: bytes, check_sort_key: bytes,
                       check_type: int, check_operand: bytes,

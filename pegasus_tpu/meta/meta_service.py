@@ -79,12 +79,16 @@ class MetaService:
             MetaDuplicationService,
         )
 
+        from pegasus_tpu.meta.elasticity import ElasticityController
         from pegasus_tpu.meta.split_service import MetaSplitService
 
         self.backup = MetaBackupService(self)
         self.bulk_load = MetaBulkLoadService(self)
         self.duplication = MetaDuplicationService(self)
         self.split = MetaSplitService(self)
+        # the detect→decide→act elasticity closed loop (signals flow in
+        # through config_sync whatever the level; it ACTS only in lively)
+        self.elasticity = ElasticityController(self)
         # cluster function level (parity: meta_function_level / shell
         # get_meta_level|set_meta_level): "freezed" = no guardian cures
         # or proposals; "steady" = cures but manual balance only
@@ -261,6 +265,10 @@ class MetaService:
         self.bulk_load.tick()
         self.duplication.tick()
         self.split.tick()
+        if self.function_level != "freezed":
+            # steady: signals + metrics only; lively: the controller may
+            # also split/move (its own pacing + pressure backoff inside)
+            self.elasticity.tick(act=(self.function_level == "lively"))
         if self.function_level == "lively":
             now = self.clock()
             if now - self._lively_last_balance >= self._lively_interval:
@@ -376,6 +384,9 @@ class MetaService:
                     args["app_name"])
             elif cmd == "split_status":
                 result = self.split.split_status(args["app_name"])
+            elif cmd == "hot_partitions":
+                result = self.elasticity.status(
+                    args.get("app_name", ""))
             elif cmd == "del_app_envs":
                 result = self.del_app_envs(args["app_name"], args["keys"])
             elif cmd == "clear_app_envs":
@@ -479,6 +490,9 @@ class MetaService:
         partition's member list may be an in-flight learner."""
         node = payload["node"]
         self._stored_reports[node] = list(payload.get("stored", []))
+        # elasticity detect phase: the same report carries per-partition
+        # capacity units + hotkey results and the node's pressure counts
+        self.elasticity.on_report(node, payload)
         # recovery adoption: a replica holding a HIGHER ballot than our
         # state knows (e.g. updates lost across a leader change) is the
         # truth — adopt its view before answering
@@ -924,6 +938,12 @@ class MetaService:
         app = self.state.apps.get(gpid[0])
         if app is None or app.status != AS_AVAILABLE:
             return
+        # PR 5 quarantine firing mid-split: a corrupt REGISTERED child
+        # must be unregistered (its single replica just trashed its
+        # store) so the split re-spawns it from the parent — the normal
+        # demote/remove cure below cannot repair a one-replica child
+        if self.split.on_replica_corrupted(gpid, src_node=node):
+            return
         pc = self.state.get_partition(*gpid)
         # a pending learn targeting the quarantined node is dead; clear
         # it BEFORE the membership check — a corrupt LEARNER is not in
@@ -1073,10 +1093,18 @@ class MetaService:
         nodes = self.fd.alive_workers()
         configs = {}
         for app in self.list_apps():
+            if app.app_id in self.split._splits:
+                # an in-flight split owns this app's configuration: a
+                # balancer move racing the child registration / count
+                # flip could relocate a fenced parent or start a learn
+                # the flip invalidates — skip until the split lands
+                # (start_partition_split refuses the mirror race)
+                continue
             for pidx in range(app.partition_count):
                 configs[(app.app_id, pidx)] = self.state.get_partition(
                     app.app_id, pidx)
         proposals = propose_app_balanced_moves(configs, nodes)
+        self.elasticity._proposal_count.increment(len(proposals))
         for prop in proposals:
             app = self.state.apps[prop.gpid[0]]
             pc = self.state.get_partition(*prop.gpid)

@@ -47,6 +47,33 @@ class MetaSplitService:
             raise PegasusError(
                 ErrorCode.ERR_INVALID_PARAMETERS,
                 "split requires a power-of-two partition count")
+        # serialize against the balancer: a copy-secondary move in
+        # flight on this app rides the learner flow, and the count flip
+        # would land it on a pre-split config (the mirror guard of
+        # MetaService.rebalance skipping splitting apps)
+        pending = sorted(g for g in set(self.meta._pending_moves)
+                         | set(self.meta._pending_learns)
+                         if g[0] == app.app_id)
+        if pending:
+            raise PegasusError(
+                ErrorCode.ERR_INVALID_STATE,
+                f"balancer/learner moves pending on {app_name}: "
+                f"{pending} — retry once they land")
+        # only split a HEALTHY table: every parent needs an alive
+        # primary to checkpoint from (a quarantined/dead partition is
+        # mid-repair — splitting would copy from nothing or race the
+        # re-learn), and a restoring partition has no data yet
+        for pidx in range(app.partition_count):
+            gpid = (app.app_id, pidx)
+            if gpid in self.meta.pending_restores:
+                raise PegasusError(ErrorCode.ERR_INVALID_STATE,
+                                   f"partition {pidx} is restoring")
+            pc = self.meta.state.get_partition(app.app_id, pidx)
+            if not pc.primary or not self.meta.fd.is_alive(pc.primary):
+                raise PegasusError(
+                    ErrorCode.ERR_INVALID_STATE,
+                    f"partition {pidx} has no alive primary "
+                    "(unhealthy/quarantined) — split refused")
         self._splits[app.app_id] = {
             "old_count": app.partition_count,
             "new_count": app.partition_count * 2,
@@ -129,6 +156,43 @@ class MetaSplitService:
         if len(info["registered"]) == info["old_count"]:
             self._finish(app_id, info)
 
+    def _unregister_child(self, app_id: int, info: dict,
+                          child_pidx: int) -> None:
+        """Forget a registered child (its only replica died or
+        quarantined pre-flip): clear its config, unfence + re-propose
+        the parent so a fresh spawn re-registers it. The parent still
+        holds the full pre-split key range until the post-flip
+        compaction GC, so nothing is lost."""
+        info["registered"].remove(child_pidx)
+        self.meta.state.set_partition_raw(app_id, child_pidx,
+                                          PartitionConfig())
+        parent_pidx = child_pidx - info["old_count"]
+        pc = self.meta.state.get_partition(app_id, parent_pidx)
+        new_pc = PartitionConfig(ballot=pc.ballot + 1,
+                                 primary=pc.primary,
+                                 secondaries=list(pc.secondaries))
+        self.meta.state.update_partition(app_id, parent_pidx, new_pc)
+        self.meta._propose(app_id, parent_pidx, new_pc)
+
+    def on_replica_corrupted(self, gpid, src_node: str) -> bool:
+        """PR 5 quarantine firing mid-split: when the corrupt replica is
+        a REGISTERED (pre-flip, single-replica) child, the usual
+        remove-and-relearn cure cannot apply — there is no healthy peer
+        of the child to learn from. Unregister it and re-drive the
+        parent, which re-spawns the child from its own (healthy) state.
+        Returns True when the report was consumed here."""
+        app_id, pidx = gpid
+        info = self._splits.get(app_id)
+        if info is None or pidx not in info["registered"]:
+            return False
+        pc = self.meta.state.get_partition(app_id, pidx)
+        if pc.primary != src_node:
+            return False  # stale/duplicate report for a re-spawned child
+        self._unregister_child(app_id, info, pidx)
+        self._save()
+        self._drive(app_id)
+        return True
+
     def _finish(self, app_id: int, info: dict) -> None:
         # a registered child whose (single-replica) primary died before
         # the flip would be an empty partition after it — unregister and
@@ -139,18 +203,7 @@ class MetaSplitService:
                     self.meta.state.get_partition(app_id, cp).primary)]
         if dead:
             for cp in dead:
-                info["registered"].remove(cp)
-                self.meta.state.set_partition_raw(app_id, cp,
-                                                  PartitionConfig())
-                # unfence + re-drive the parent
-                parent_pidx = cp - info["old_count"]
-                pc = self.meta.state.get_partition(app_id, parent_pidx)
-                new_pc = PartitionConfig(ballot=pc.ballot + 1,
-                                         primary=pc.primary,
-                                         secondaries=list(pc.secondaries))
-                self.meta.state.update_partition(app_id, parent_pidx,
-                                                 new_pc)
-                self.meta._propose(app_id, parent_pidx, new_pc)
+                self._unregister_child(app_id, info, cp)
             self._save()
             self._drive(app_id)
             return

@@ -144,6 +144,11 @@ class ReplicaStub:
         self._quarantine_count = storage_ent.counter(
             "replica_quarantine_count")
         self._disk_io_errors = storage_ent.counter("disk_io_error_count")
+        # split-fence observability: writes rejected ERR_SPLITTING while
+        # a parent drains its tail (the hash-gate's misroute twin lives
+        # on the same entity, incremented in PartitionServer._hash_gate)
+        self._split_fence_rejects = storage_ent.counter(
+            "split_fence_reject_count")
         self.scrubber = ReplicaScrubber(
             lambda: self.replicas, self._on_scrub_corruption,
             clock=self.sim_clock)
@@ -494,6 +499,41 @@ class ReplicaStub:
         if r is None:
             return  # already quarantined (scrub + read raced)
         self._quarantine_count.increment()
+        # quarantine firing mid-split: a session touching this replica
+        # cannot outlive its store
+        import shutil as _shutil
+
+        sess = self._split_sessions.pop(gpid, None)
+        if sess is not None:
+            # the PARENT quarantined: abandon the session and reap the
+            # half-built child (meta demotes us and re-drives the split
+            # at the promoted primary, which re-spawns the child)
+            child = self.replicas.pop(sess["child_gpid"], None)
+            if child is not None:
+                child.close()
+            _shutil.rmtree(self._replica_dir(sess["child_gpid"]),
+                           ignore_errors=True)
+            # the child may already be REGISTERED at meta (session in
+            # the register phase) with its config pointing at this
+            # node: report it corrupted too, so meta unregisters it and
+            # the re-driven split re-spawns it — otherwise the count
+            # would flip onto a phantom child whose replica was just
+            # reaped here (unregistered children make this a no-op)
+            for meta in self._meta_targets():
+                self.net.send(self.name, meta, "replica_corrupted", {
+                    "gpid": sess["child_gpid"], "node": self.name,
+                    "reason": reason})
+        for parent_gpid, psess in self._split_sessions.items():
+            if psess["child_gpid"] == gpid:
+                # the half-built CHILD quarantined (its store is
+                # trashed): restart the session from a fresh checkpoint
+                # — resuming drain/register would replay the tail into
+                # (or register) a child whose base bytes are gone
+                psess["phase"] = "ckpt"
+                parent = self.replicas.get(parent_gpid)
+                if parent is not None:
+                    parent.splitting = False  # re-fenced at drain
+                break
         # no stale pre-repair bytes may serve: the node row cache drops
         # this partition NOW (install_engine/_on_store_publish re-cover
         # this when the re-learned engine installs, but the window
@@ -628,6 +668,20 @@ class ReplicaStub:
         if msg_type == "start_split":
             self._on_start_split(src, payload)
             return
+        if msg_type == "detect_hotkey":
+            # the elasticity controller's detect command (parity:
+            # on_detect_hotkey): start both collectors on the flagged
+            # partition; results flow back on the config_sync report
+            gpid = tuple(payload["gpid"])
+            r = self.replicas.get(gpid)
+            # primaries only: client reads/writes flow through the
+            # primary, so a collector started on a just-demoted node
+            # would sample nothing and never finish
+            if r is not None and r.status == PartitionStatus.PRIMARY:
+                for hc in r.server.hotkey_collectors.values():
+                    if hc.state.value in ("stopped", "finished"):
+                        hc.start()
+            return
         if msg_type == "dup_add":
             self._on_dup_add(src, payload)
             return
@@ -739,6 +793,7 @@ class ReplicaStub:
         if r is not None and getattr(r, "splitting", False):
             # write fence during the split's final catch-up (parity: the
             # reference fences the parent before the count flip)
+            self._split_fence_rejects.increment()
             self.net.send(self.name, src, "client_write_reply", {
                 "rid": rid, "err": int(ErrorCode.ERR_SPLITTING),
                 "results": []})
@@ -843,6 +898,7 @@ class ReplicaStub:
                               None))
                 continue
             if r is not None and getattr(r, "splitting", False):
+                self._split_fence_rejects.increment()
                 slots.append((gpid[1], int(ErrorCode.ERR_SPLITTING),
                               None))
                 continue
@@ -1500,7 +1556,13 @@ class ReplicaStub:
         child_gpid = sess["child_gpid"]
         if sess["phase"] == "ckpt":
             # phase 1 — checkpoint copy WITHOUT a write fence (bulk of the
-            # data moves while writes continue)
+            # data moves while writes continue). A child replica already
+            # open here is a leftover from a crashed/aborted earlier
+            # attempt (boot scan resurrects half-built dirs): close and
+            # rebuild from a fresh checkpoint, never resume unknown bytes
+            stale = self.replicas.pop(child_gpid, None)
+            if stale is not None:
+                stale.close()
             child_dir = self._replica_dir(child_gpid)
             shutil.rmtree(child_dir, ignore_errors=True)
             os.makedirs(os.path.join(child_dir, "app"), exist_ok=True)
@@ -1651,14 +1713,45 @@ class ReplicaStub:
         leader change lost recent updates, the new leader adopts any
         reported config with a higher ballot (replicas are the recovery
         source of truth — parity: `recover` from replica list)."""
-        stored = [{"gpid": gpid, "ballot": r.config.ballot,
-                   "primary": r.config.primary,
-                   "secondaries": list(r.config.secondaries),
-                   "partition_count": r.server.partition_count}
-                  for gpid, r in self.replicas.items()]
+        from pegasus_tpu.utils.metrics import METRICS
+
+        now = self.sim_clock()
+        stored = []
+        for gpid, r in self.replicas.items():
+            entry = {"gpid": gpid, "ballot": r.config.ballot,
+                     "primary": r.config.primary,
+                     "secondaries": list(r.config.secondaries),
+                     "partition_count": r.server.partition_count}
+            if r.status == PartitionStatus.PRIMARY:
+                # elasticity detect signals ride the existing report:
+                # cumulative capacity units + the hotkey detector's
+                # published result, sampled on the node's clock so the
+                # meta-side controller can turn them into rates
+                srv = r.server
+                hot = (srv.hotkey_collectors["read"].hot_hash_key()
+                       or srv.hotkey_collectors["write"].hot_hash_key())
+                entry["load"] = {
+                    "read_cu": srv.cu.read_cu,
+                    "write_cu": srv.cu.write_cu,
+                    "hot_key": hot,
+                    "hot_state": {
+                        k: hc.state.value
+                        for k, hc in srv.hotkey_collectors.items()},
+                    "at": now,
+                }
+            stored.append(entry)
+        # foreground-pressure counters (PR 2 shed/deadline machinery):
+        # the controller backs its move pacing off when these grow
+        rpc_ent = METRICS.entity("rpc", "dispatch", {})
+        pressure = {
+            "deadline_expired": rpc_ent.counter(
+                "deadline_expired_count").value(),
+            "read_shed": rpc_ent.counter("read_shed_count").value(),
+        }
         for meta in self._meta_targets():
             self.net.send(self.name, meta, "config_sync", {
-                "node": self.name, "stored": stored})
+                "node": self.name, "stored": stored,
+                "pressure": pressure})
 
     def _on_config_sync_reply(self, src: str, payload: dict) -> None:
         import shutil

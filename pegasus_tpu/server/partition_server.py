@@ -109,6 +109,12 @@ PREDICATE_BATCH = 2048
 _STORAGE_BLOOM_USEFUL = METRICS.entity(
     "storage", "node").relaxed_counter("bloom_useful_count")
 
+# requests bounced for routing under a stale partition count (the
+# ERR_PARENT_PARTITION_MISUSED hash-gate) — the node-level split-fence
+# observability the stub's ERR_SPLITTING rejects share
+_SPLIT_FENCE_REJECTS = METRICS.entity(
+    "storage", "node").counter("split_fence_reject_count")
+
 
 
 # point-location-cache miss sentinel (None is a valid cached value:
@@ -533,6 +539,7 @@ class PartitionServer:
         if partition_hash is None or not self.validate_partition_hash:
             return 0
         if (partition_hash & self.partition_version) != self.pidx:
+            _SPLIT_FENCE_REJECTS.increment()
             return int(ErrorCode.ERR_PARENT_PARTITION_MISUSED)
         return 0
 
@@ -2488,6 +2495,17 @@ class PartitionServer:
         # cached masks were computed under the old partition_version; the
         # predicate takes pv dynamically so caches stay valid, but fused
         # prepared tensors embed nothing version-dependent either — keep.
+        # The ROW/plan/point/live caches, by contrast, hold ROWS resolved
+        # under the pre-flip routing: the hash gate keeps misrouted
+        # requests off them, but half this partition's key range just
+        # moved to the child — drop parent entries eagerly so no code
+        # path (present or future) can observe a stale parent row, and
+        # so dead-half rows stop occupying the node-shared byte cap.
+        self._live_cache = {}
+        self._plan_cache = None
+        self._point_cache = None
+        self._plan_expired_cache = (None, {})
+        ROW_CACHE.invalidate_gid((self.app_id, self.pidx))
 
     def manual_compact(self, default_ttl: Optional[int] = None,
                        rules_filter=None) -> None:

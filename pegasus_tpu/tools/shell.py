@@ -24,7 +24,8 @@ verbs, parity: the linenoise REPL + `use`). Command families:
   cluster    : cluster_info, nodes, server_info, server_stat, app_stat,
                app_disk, ddd_diagnose, propose, rebalance, offline_node,
                get/set_meta_level, detect_hotkey, remote_command,
-               slow_queries, metrics, storage_stats, disk_health, scrub
+               slow_queries, metrics, storage_stats, disk_health,
+               scrub, hot_partitions
   offline    : sst_dump, mlog_dump, local_get, rdb_key_str2hex,
                rdb_key_hex2str, rdb_value_hex2str
 
@@ -266,6 +267,9 @@ def main(argv=None) -> int:
     p = sub.add_parser("query_split")
     p.add_argument("table")
     p = sub.add_parser("nodes")
+    p = sub.add_parser("hot_partitions")
+    p.add_argument("table", nargs="?", default="",
+                   help="one table, or the whole cluster when omitted")
     p = sub.add_parser("rebalance", aliases=["balance"])
     p = sub.add_parser("offline_node")
     p.add_argument("node", help="drain all primaries off this node")
@@ -1422,6 +1426,13 @@ def _dispatch(args, box, out) -> int:
     elif args.cmd == "nodes":
         for n in box.admin.call("list_nodes"):
             print(n, file=out)
+    elif args.cmd == "hot_partitions":
+        # the elasticity controller's view: per-partition CU rates +
+        # hotkey signals, node load, in-flight splits, pressure backoff
+        status = box.admin.call("hot_partitions", app_name=args.table)
+        for row in status.pop("partitions", []):
+            print(json.dumps(row), file=out)
+        print(json.dumps(status, indent=1), file=out)
     elif args.cmd == "rebalance":
         n = box.admin.call("rebalance")
         print(f"OK: {n} proposals", file=out)

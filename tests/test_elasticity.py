@@ -1,0 +1,865 @@
+"""Elasticity closed loop: detect→decide→act under chaos.
+
+The PR 6 surface: per-partition load signals (capacity units + hotkey
+results) flow node→meta on the config-sync report channel, the meta
+elasticity controller decides split-vs-rebalance with guards (pressure
+backoff, split/balancer mutual exclusion, health checks), the split
+path survives mid-flight chaos (parent primary kill, quarantine), and
+the batched client paths retry exactly the misrouted subset of a flush
+that spans the count flip.
+"""
+
+import random
+
+import pytest
+
+import pegasus_tpu.meta.elasticity  # noqa: F401 - registers the flags
+
+from pegasus_tpu.base.key_schema import generate_key, key_hash_parts
+from pegasus_tpu.rpc.codec import OP_PUT
+from pegasus_tpu.tools.cluster import SimCluster
+from pegasus_tpu.tools.kill_test import DataVerifier
+from pegasus_tpu.utils.errors import ErrorCode, PegasusError, StorageStatus
+from pegasus_tpu.utils.flags import FLAGS
+
+OK = int(StorageStatus.OK)
+
+
+@pytest.fixture
+def fast_flags():
+    """Aggressive controller thresholds so sim twins converge in a few
+    beacon rounds."""
+    saved = [(s, n, FLAGS.get(s, n)) for s, n in (
+        ("pegasus.meta", "elasticity_act_interval_s"),
+        ("pegasus.meta", "elasticity_split_cu_rate"),
+        ("pegasus.meta", "elasticity_detect_grace_s"))]
+    FLAGS.set("pegasus.meta", "elasticity_act_interval_s", 1.0)
+    FLAGS.set("pegasus.meta", "elasticity_split_cu_rate", 3.0)
+    FLAGS.set("pegasus.meta", "elasticity_detect_grace_s", 4.0)
+    yield
+    for s, n, v in saved:
+        FLAGS.set(s, n, v)
+
+
+# ---- the tier-1 closed-loop twin (<5s): detect an overloaded table
+# from real config-sync signals, split it online under live writes ----
+
+
+def test_closed_loop_detects_and_splits_oversized_table(tmp_path,
+                                                        fast_flags):
+    cluster = SimCluster(str(tmp_path / "c"), n_nodes=3, seed=17)
+    try:
+        app_id = cluster.create_table("elastic", partition_count=2)
+        cluster.meta.set_meta_level("lively")
+        c = cluster.client("elastic")
+        acked = {}
+        split_seen = False
+        for round_ in range(24):
+            for i in range(30):
+                hk = b"u%04d" % (round_ * 30 + i)
+                if c.set(hk, b"s", b"v%d" % i) == OK:
+                    acked[hk] = b"v%d" % i
+            cluster.step()
+            if cluster.meta.state.apps[app_id].partition_count == 4:
+                split_seen = True
+                break
+        assert split_seen, "controller never split the overloaded table"
+        # freeze further elasticity actions; drive to completion + settle
+        cluster.meta.set_meta_level("steady")
+        for _ in range(6):
+            cluster.step()
+        st = cluster.meta.split.split_status("elastic")
+        assert not st["splitting"]
+        ctl = cluster.meta.elasticity
+        assert ctl.last_action and ctl.last_action["kind"] == "split"
+        # the invariant: every acked write byte-identical via new routing
+        c.refresh_config()
+        assert c.partition_count == 4
+        for hk, want in acked.items():
+            assert c.get(hk, b"s") == (OK, want), hk
+    finally:
+        cluster.close()
+
+
+def test_closed_loop_split_survives_primary_kill_and_chaos(tmp_path,
+                                                           fast_flags):
+    """The acceptance scenario: live writes, controller splits, a parent
+    primary is killed MID-SPLIT (register channel cut first so the
+    session is provably in flight), and the DataVerifier invariant
+    holds end-to-end — zero acked-write loss, byte-identical reads."""
+    cluster = SimCluster(str(tmp_path / "c"), n_nodes=4, seed=23)
+    try:
+        app_id = cluster.create_table("fire", partition_count=2)
+        c = cluster.client("fire")
+        c.op_timeout_ms = 600_000
+        verifier = DataVerifier(c, random.Random(23))
+        for _ in range(12):
+            verifier.step()
+        # cut the parent primary's meta uplink: its split session will
+        # wedge at the register phase — a provable mid-split window
+        victim = cluster.primaries(app_id)[0]
+        cluster.net.set_drop(1.0, src=victim, dst="meta")
+        assert cluster.meta.split.start_partition_split("fire") == 4
+        cluster.step()
+        assert (app_id, 0) in cluster.stubs[victim]._split_sessions
+        # kill -9 the parent primary mid-split
+        cluster.kill(victim)
+        for _ in range(8):
+            verifier.step()
+        # FD grace + cure + meta re-drives the split at the new primary
+        for _ in range(30):
+            cluster.step()
+            if not cluster.meta.split.split_status("fire")["splitting"]:
+                break
+        assert not cluster.meta.split.split_status("fire")["splitting"]
+        assert cluster.meta.state.apps[app_id].partition_count == 4
+        for _ in range(6):
+            verifier.step()
+        cluster.step(rounds=2)
+        assert verifier.violations == [], verifier.violations
+        assert verifier.write_ok > 15
+        # zero acked-write loss, byte-identical from the children
+        for hk, want in verifier.acked.items():
+            assert c.get(hk, b"s") == (OK, want), hk
+    finally:
+        cluster.close()
+
+
+# ---- decide paths: dominant hotkey → move, pressure → backoff -------
+
+
+def _feed(cluster, samples, at, pressure=None):
+    """Push synthetic load reports into the controller as if config_sync
+    delivered them: samples = {gpid: (node, cu_total, hot_key)}."""
+    by_node = {}
+    for gpid, (node, cu, hot) in samples.items():
+        by_node.setdefault(node, []).append({
+            "gpid": gpid,
+            "load": {"read_cu": cu, "write_cu": 0, "hot_key": hot,
+                     "at": at}})
+    for node, stored in by_node.items():
+        payload = {"stored": stored}
+        if pressure is not None:
+            payload["pressure"] = pressure.get(node, {})
+        cluster.meta.elasticity.on_report(node, payload)
+
+
+def test_dominant_hotkey_moves_primary_instead_of_splitting(tmp_path,
+                                                            fast_flags):
+    cluster = SimCluster(str(tmp_path / "c"), n_nodes=4, seed=3)
+    try:
+        app_id = cluster.create_table("whale", partition_count=16,
+                                      replica_count=3)
+        cluster.loop.run_until_idle()
+        meta = cluster.meta
+        ctl = meta.elasticity
+        hot_pidx = 5
+        hot_pc = meta.state.get_partition(app_id, hot_pidx)
+
+        def samples(scale):
+            # the hot node carries CO-LOCATED load beyond the whale
+            # partition, so moving the whale to an idle secondary is a
+            # real win (the ping-pong guard refuses pointless moves)
+            out = {}
+            for p in range(16):
+                pc = meta.state.get_partition(app_id, p)
+                if p == hot_pidx:
+                    cu = 10_000 * scale
+                elif pc.primary == hot_pc.primary:
+                    cu = 2_000 * scale
+                else:
+                    cu = 10 * scale
+                hot = b"whale" if p == hot_pidx else None
+                out[(app_id, p)] = (pc.primary, cu, hot)
+            return out
+
+        _feed(cluster, samples(1), at=0.0)
+        ctl.tick()  # first sample: no rates yet, no action
+        assert ctl.last_action is None
+        _feed(cluster, samples(2), at=10.0)
+        ctl.tick()
+        assert ctl.last_action and ctl.last_action["kind"] == "move", \
+            ctl.last_action
+        assert ctl.last_action["gpid"] == (app_id, hot_pidx)
+        # zero-copy move: leadership went to the coolest alive secondary
+        new_pc = meta.state.get_partition(app_id, hot_pidx)
+        assert new_pc.primary != hot_pc.primary
+        assert new_pc.primary in hot_pc.secondaries
+        assert new_pc.ballot == hot_pc.ballot + 1
+        # and no split was started for a single-key hotspot
+        assert app_id not in meta.split._splits
+        # the consumed verdict re-arms detection: a stale FINISHED
+        # result must not pin this partition to "move" forever
+        assert (app_id, hot_pidx) in ctl._detect_started
+    finally:
+        cluster.close()
+
+
+def test_move_refused_when_it_would_only_ping_pong(tmp_path,
+                                                   fast_flags):
+    """A whale partition dominating an otherwise idle node gains
+    nothing from a primary move (the whale saturates whichever node
+    hosts it) — the controller must refuse instead of oscillating
+    leadership every act interval."""
+    cluster = SimCluster(str(tmp_path / "c"), n_nodes=4, seed=4)
+    try:
+        app_id = cluster.create_table("pong", partition_count=16,
+                                      replica_count=3)
+        cluster.loop.run_until_idle()
+        meta = cluster.meta
+        ctl = meta.elasticity
+        hot_pidx = 5
+        hot_pc = meta.state.get_partition(app_id, hot_pidx)
+
+        def samples(scale):
+            return {(app_id, p): (
+                meta.state.get_partition(app_id, p).primary,
+                (10_000 if p == hot_pidx else 10) * scale,
+                b"whale" if p == hot_pidx else None)
+                for p in range(16)}
+
+        _feed(cluster, samples(1), at=0.0)
+        ctl.tick()
+        _feed(cluster, samples(2), at=10.0)
+        ctl.tick()
+        assert ctl.last_action and ctl.last_action["kind"] == "move"
+        assert ctl.last_action["moved_to"] is None  # refused: no win
+        assert meta.state.get_partition(app_id, hot_pidx).primary \
+            == hot_pc.primary
+    finally:
+        cluster.close()
+
+
+def test_cooled_partition_clears_detection_window(tmp_path, fast_flags):
+    """A detection window belongs to one flag episode: if the partition
+    cools before the grace elapses, a re-flag much later must run a
+    FRESH detection instead of instantly concluding diffuse heat from
+    the stale stamp (and splitting unprovoked)."""
+    cluster = SimCluster(str(tmp_path / "c"), n_nodes=3, seed=19)
+    try:
+        app_id = cluster.create_table("cool", partition_count=16,
+                                      replica_count=2)
+        cluster.loop.run_until_idle()
+        meta = cluster.meta
+        ctl = meta.elasticity
+        hot_pidx = 3
+
+        def samples(scale, hot_cu):
+            return {(app_id, p): (
+                meta.state.get_partition(app_id, p).primary,
+                (hot_cu if p == hot_pidx else 5) * scale, None)
+                for p in range(16)}
+
+        _feed(cluster, samples(1, 9_000), at=0.0)
+        ctl.tick()
+        _feed(cluster, samples(2, 9_000), at=5.0)
+        ctl.tick()
+        assert (app_id, hot_pidx) in ctl._detect_started
+        # the heat subsides before the grace window elapses
+        _feed(cluster, samples(3, 6), at=10.0)
+        ctl.tick()
+        assert (app_id, hot_pidx) not in ctl._detect_started
+        # much later the partition re-heats: detection restarts — no
+        # instant split from the stale episode's stamp
+        cluster.loop.run_for(100.0)
+        _feed(cluster, samples(40, 9_000), at=110.0)
+        ctl.tick()
+        cluster.loop.run_for(2.0)
+        _feed(cluster, samples(80, 9_000), at=115.0)
+        ctl.tick()
+        assert app_id not in meta.split._splits
+        assert (app_id, hot_pidx) in ctl._detect_started
+    finally:
+        cluster.close()
+
+
+def test_diffuse_hotspot_starts_detection_then_splits(tmp_path,
+                                                      fast_flags):
+    cluster = SimCluster(str(tmp_path / "c"), n_nodes=3, seed=7)
+    try:
+        app_id = cluster.create_table("diffuse", partition_count=16,
+                                      replica_count=2)
+        cluster.loop.run_until_idle()
+        meta = cluster.meta
+        ctl = meta.elasticity
+        hot_pidx = 11
+        primary = meta.state.get_partition(app_id, hot_pidx).primary
+
+        def samples(scale):
+            return {(app_id, p): (
+                meta.state.get_partition(app_id, p).primary,
+                (9_000 if p == hot_pidx else 5) * scale, None)
+                for p in range(16)}
+
+        _feed(cluster, samples(1), at=0.0)
+        ctl.tick()
+        _feed(cluster, samples(2), at=5.0)
+        ctl.tick()  # hot but no dominant key: detection commanded
+        assert (app_id, hot_pidx) in ctl._detect_started
+        cluster.loop.run_until_idle()  # deliver detect_hotkey
+        stub = cluster.stubs[primary]
+        hc = stub.replicas[(app_id, hot_pidx)].server.hotkey_collectors
+        assert hc["read"].state.value == "coarse"  # detection running
+        # detection window passes with NO dominant key -> diffuse -> split
+        cluster.loop.run_for(10.0)  # past detect_grace_s
+        _feed(cluster, samples(3), at=15.0)
+        ctl.tick()
+        assert ctl.last_action and ctl.last_action["kind"] == "split", \
+            ctl.last_action
+        assert app_id in meta.split._splits
+    finally:
+        cluster.close()
+
+
+def test_foreground_pressure_backs_off_actions(tmp_path, fast_flags):
+    cluster = SimCluster(str(tmp_path / "c"), n_nodes=3, seed=9)
+    try:
+        app_id = cluster.create_table("busy", partition_count=2)
+        cluster.loop.run_until_idle()
+        meta = cluster.meta
+        ctl = meta.elasticity
+        before = ctl._backoff_count.value()
+
+        def samples(scale):
+            return {(app_id, p): (
+                meta.state.get_partition(app_id, p).primary,
+                50_000 * scale, None) for p in range(2)}
+
+        # oversized on rate alone — but the shed/deadline counters grew,
+        # so the controller must defer instead of splitting
+        _feed(cluster, samples(1), at=0.0)
+        ctl.tick()
+        _feed(cluster, samples(2), at=5.0,
+              pressure={n: {"read_shed": 10, "deadline_expired": 3}
+                        for n in cluster.stubs})
+        ctl.tick()
+        assert app_id not in meta.split._splits
+        assert ctl._backoff > 1
+        assert ctl._backoff_count.value() == before + 1
+        # pressure stable (no growth) long enough: the deferred split
+        # eventually runs once the backoff window expires
+        for i in range(3, 40):
+            _feed(cluster, samples(i), at=5.0 * i,
+                  pressure={n: {"read_shed": 10, "deadline_expired": 3}
+                            for n in cluster.stubs})
+            cluster.loop.run_for(60.0)
+            ctl.tick()
+            if app_id in meta.split._splits:
+                break
+        assert app_id in meta.split._splits
+    finally:
+        cluster.close()
+
+
+def test_detection_requires_evidence_before_diffuse_split(tmp_path,
+                                                          fast_flags):
+    """Grace expiry alone must not conclude diffuse heat: when the
+    primary's report shows the collectors never sampled (the one-shot
+    detect command was lost, or the primary died and its successor
+    reports fresh stopped collectors), the controller restarts the
+    window instead of splitting on zero evidence."""
+    cluster = SimCluster(str(tmp_path / "c"), n_nodes=3, seed=29)
+    try:
+        app_id = cluster.create_table("ev", partition_count=16,
+                                      replica_count=2)
+        cluster.loop.run_until_idle()
+        meta = cluster.meta
+        ctl = meta.elasticity
+        hot_pidx = 7
+
+        def feed(scale, at, hot_state):
+            for p in range(16):
+                pc = meta.state.get_partition(app_id, p)
+                cu = (9_000 if p == hot_pidx else 5) * scale
+                ctl.on_report(pc.primary, {"stored": [{
+                    "gpid": (app_id, p),
+                    "load": {"read_cu": cu, "write_cu": 0,
+                             "hot_key": None, "hot_state": hot_state,
+                             "at": at}}]})
+
+        stopped = {"read": "stopped", "write": "stopped"}
+        feed(1, 0.0, stopped)
+        ctl.tick()
+        feed(2, 5.0, stopped)
+        ctl.tick()
+        assert (app_id, hot_pidx) in ctl._detect_started
+        first_window = ctl._detect_started[(app_id, hot_pidx)]
+        # grace passes, but the report says no collector ever sampled:
+        # the window restarts — no split on zero evidence
+        cluster.loop.run_for(10.0)
+        feed(3, 15.0, stopped)
+        ctl.tick()
+        assert app_id not in meta.split._splits
+        assert ctl._detect_started[(app_id, hot_pidx)] > first_window
+        # once the report proves a detector ran the window with no
+        # dominant key, diffuse heat is a sound conclusion
+        cluster.loop.run_for(10.0)
+        feed(4, 25.0, {"read": "coarse", "write": "coarse"})
+        ctl.tick()
+        assert app_id in meta.split._splits
+    finally:
+        cluster.close()
+
+
+def test_rate_rebases_when_leadership_moves(tmp_path):
+    """A failover hands the partition to a node whose cumulative CU
+    counter is unrelated to the old primary's — diffing across the
+    handoff would clamp a real rate to zero or, on the way back,
+    manufacture an enormous phantom rate that could split a near-idle
+    table."""
+    cluster = SimCluster(str(tmp_path / "c"), n_nodes=2, seed=31)
+    try:
+        app_id = cluster.create_table("rb", partition_count=2)
+        cluster.loop.run_until_idle()
+        ctl = cluster.meta.elasticity
+        gpid = (app_id, 0)
+
+        def feed(node, cu, at):
+            ctl.on_report(node, {"stored": [{
+                "gpid": gpid,
+                "load": {"read_cu": cu, "write_cu": 0, "hot_key": None,
+                         "at": at}}]})
+
+        feed("node0", 1_000_000, 0.0)
+        ctl.tick()
+        feed("node0", 1_000_050, 5.0)
+        ctl.tick()
+        assert ctl.rates[gpid] == pytest.approx(10.0)
+        # failover: node1's counter starts near zero — re-base, the
+        # smoothed rate survives untouched
+        feed("node1", 10, 10.0)
+        ctl.tick()
+        assert ctl.rates[gpid] == pytest.approx(10.0)
+        feed("node1", 20, 15.0)
+        ctl.tick()
+        assert ctl.rates[gpid] == pytest.approx(6.0)  # 0.5*10 + 0.5*2
+        # leadership returns to node0: again a re-base, not a
+        # (1_000_100 - 20)/dt phantom spike
+        feed("node0", 1_000_100, 20.0)
+        ctl.tick()
+        assert ctl.rates[gpid] == pytest.approx(6.0)
+    finally:
+        cluster.close()
+
+
+def test_signals_for_dead_gpids_are_pruned(tmp_path):
+    """Rates for gpids that no longer exist (dropped table, admin
+    split flip) must not haunt node_load() forever."""
+    cluster = SimCluster(str(tmp_path / "c"), n_nodes=2, seed=37)
+    try:
+        app_id = cluster.create_table("pr", partition_count=2)
+        cluster.loop.run_until_idle()
+        ctl = cluster.meta.elasticity
+        for at, cu in ((0.0, 1_000), (5.0, 2_000)):
+            ctl.on_report("node0", {"stored": [
+                {"gpid": (999, 0),
+                 "load": {"read_cu": cu, "write_cu": 0,
+                          "hot_key": None, "at": at}},
+                {"gpid": (app_id, 0),
+                 "load": {"read_cu": cu, "write_cu": 0,
+                          "hot_key": None, "at": at}}]})
+            ctl.tick()
+        assert (app_id, 0) in ctl.rates
+        assert (999, 0) not in ctl.rates
+        assert (999, 0) not in ctl._reports
+    finally:
+        cluster.close()
+
+
+def test_refused_app_does_not_starve_other_apps(tmp_path, fast_flags):
+    """A split refusal is not an action: the tick must keep scanning so
+    one perpetually-guarded app cannot starve every other app's
+    elasticity forever."""
+    cluster = SimCluster(str(tmp_path / "c"), n_nodes=3, seed=41)
+    try:
+        a_id = cluster.create_table("starver", partition_count=2)
+        b_id = cluster.create_table("starved", partition_count=2)
+        cluster.loop.run_until_idle()
+        meta = cluster.meta
+        ctl = meta.elasticity
+        # app A: oversized but permanently refused (pending balancer
+        # copy-secondary move holds the split guard)
+        meta._pending_moves[(a_id, 0)] = ("node2", "node0")
+        meta._pending_learns[(a_id, 0)] = ("node2", 0.0)
+
+        def feed(scale, at):
+            stored = []
+            for app in (a_id, b_id):
+                for p in range(2):
+                    pc = meta.state.get_partition(app, p)
+                    stored.append({
+                        "gpid": (app, p),
+                        "load": {"read_cu": 50_000 * scale,
+                                 "write_cu": 0, "hot_key": None,
+                                 "at": at}})
+                    ctl.on_report(pc.primary, {"stored": stored})
+
+        feed(1, 0.0)
+        ctl.tick()
+        feed(2, 5.0)
+        ctl.tick()
+        # A (first in list order) was refused; B still got its split
+        assert a_id not in meta.split._splits
+        assert b_id in meta.split._splits
+        assert ctl.last_action["app"] == "starved"
+    finally:
+        cluster.close()
+
+
+# ---- guards: split/balancer mutual exclusion + health ----------------
+
+
+def test_rebalance_skips_apps_with_inflight_split(tmp_path):
+    from pegasus_tpu.meta.server_state import PartitionConfig
+
+    cluster = SimCluster(str(tmp_path / "c"), n_nodes=3, seed=1)
+    try:
+        app_id = cluster.create_table("sk", partition_count=6)
+        cluster.loop.run_until_idle()
+        meta = cluster.meta
+        # force every primary onto node0 so a rebalance WOULD propose
+        for pidx in range(6):
+            pc = meta.state.get_partition(app_id, pidx)
+            forced = PartitionConfig(pc.ballot + 1, "node0",
+                                     [n for n in pc.members()
+                                      if n != "node0"])
+            meta.state.update_partition(app_id, pidx, forced)
+            meta._propose(app_id, pidx, forced)
+        cluster.loop.run_until_idle()
+        # with a split in flight the balancer must not touch the app
+        meta.split._splits[app_id] = {"old_count": 6, "new_count": 12,
+                                      "registered": []}
+        assert meta.rebalance() == []
+        del meta.split._splits[app_id]
+        assert meta.rebalance()  # now it proposes
+    finally:
+        cluster.close()
+
+
+def test_split_refuses_pending_moves_and_unhealthy_partitions(tmp_path):
+    cluster = SimCluster(str(tmp_path / "c"), n_nodes=4, seed=2)
+    try:
+        app_id = cluster.create_table("gd", partition_count=2,
+                                      replica_count=2)
+        cluster.loop.run_until_idle()
+        meta = cluster.meta
+        # pending balancer copy-secondary move on the app: refused
+        meta._pending_moves[(app_id, 0)] = ("node3", "node1")
+        meta._pending_learns[(app_id, 0)] = ("node3", 0.0)
+        with pytest.raises(PegasusError) as ei:
+            meta.split.start_partition_split("gd")
+        assert ei.value.code == ErrorCode.ERR_INVALID_STATE
+        del meta._pending_moves[(app_id, 0)]
+        del meta._pending_learns[(app_id, 0)]
+        # unhealthy partition (both members dead, primary un-curable):
+        # refused until repaired
+        pc = meta.state.get_partition(app_id, 0)
+        for node in pc.members():
+            cluster.kill(node)
+        cluster.step(rounds=4)  # FD declares them dead; no cure possible
+        with pytest.raises(PegasusError) as ei:
+            meta.split.start_partition_split("gd")
+        assert ei.value.code == ErrorCode.ERR_INVALID_STATE
+        # repair: revive the members; once the table is healthy again
+        # (primary back, no guardian learns in flight) the split runs
+        for node in pc.members():
+            cluster.revive(node)
+        for _ in range(25):
+            cluster.step()
+            healthy = not meta._pending_learns and all(
+                meta.fd.is_alive(
+                    meta.state.get_partition(app_id, p).primary)
+                for p in range(2))
+            if healthy:
+                break
+        assert meta.split.start_partition_split("gd") == 4
+    finally:
+        cluster.close()
+
+
+# ---- quarantine firing mid-split (PR 5 x split) ----------------------
+
+
+def test_child_quarantine_mid_split_rebuilds_from_checkpoint(tmp_path):
+    cluster = SimCluster(str(tmp_path / "c"), n_nodes=2, seed=5)
+    try:
+        app_id = cluster.create_table("qc", partition_count=1,
+                                      replica_count=1)
+        c = cluster.client("qc")
+        for i in range(30):
+            assert c.set(b"q%03d" % i, b"s", b"v%d" % i) == OK
+        pc = cluster.meta.state.get_partition(app_id, 0)
+        stub = cluster.stubs[pc.primary]
+        # wedge the register phase so the session is provably mid-split
+        stub.meta_addr = None
+        cluster.meta.split.start_partition_split("qc")
+        cluster.loop.run_until_idle()
+        sess = stub._split_sessions[(app_id, 0)]
+        assert sess["phase"] == "register"
+        # PR 5 quarantine hits the HALF-BUILT CHILD: its store is
+        # trashed; the session must restart from a fresh checkpoint
+        stub._quarantine_replica((app_id, 1), "planted corruption")
+        assert stub._split_sessions[(app_id, 0)]["phase"] == "ckpt"
+        assert (app_id, 1) not in stub.replicas
+        stub.meta_addr = cluster.metas[0].name
+        for _ in range(12):
+            cluster.step()
+            if not cluster.meta.split.split_status("qc")["splitting"]:
+                break
+        assert cluster.meta.state.apps[app_id].partition_count == 2
+        c.refresh_config()
+        for i in range(30):
+            assert c.get(b"q%03d" % i, b"s") == (OK, b"v%d" % i), i
+    finally:
+        cluster.close()
+
+
+def test_parent_quarantine_mid_split_aborts_session(tmp_path):
+    cluster = SimCluster(str(tmp_path / "c"), n_nodes=3, seed=6)
+    try:
+        app_id = cluster.create_table("qp", partition_count=1,
+                                      replica_count=2)
+        c = cluster.client("qp")
+        for i in range(20):
+            assert c.set(b"p%03d" % i, b"s", b"v%d" % i) == OK
+        pc = cluster.meta.state.get_partition(app_id, 0)
+        stub = cluster.stubs[pc.primary]
+        stub.meta_addr = None  # wedge at register
+        cluster.meta.split.start_partition_split("qp")
+        cluster.loop.run_until_idle()
+        assert (app_id, 0) in stub._split_sessions
+        stub.meta_addr = cluster.metas[0].name
+        # PR 5 quarantine hits the PARENT mid-split: session + half-built
+        # child die with it; meta demotes and re-drives at the promoted
+        # secondary, which re-spawns the child from its own state
+        stub._quarantine_replica((app_id, 0), "planted corruption")
+        assert (app_id, 0) not in stub._split_sessions
+        assert (app_id, 1) not in stub.replicas
+        for _ in range(20):
+            cluster.step()
+            if not cluster.meta.split.split_status("qp")["splitting"]:
+                break
+        assert cluster.meta.state.apps[app_id].partition_count == 2
+        c.refresh_config()
+        for i in range(20):
+            assert c.get(b"p%03d" % i, b"s") == (OK, b"v%d" % i), i
+    finally:
+        cluster.close()
+
+
+def test_meta_unregisters_corrupt_registered_child(tmp_path):
+    """A REGISTERED (pre-flip, single-replica) child that reports
+    corruption cannot be repaired by remove-and-relearn — meta must
+    unregister it and re-drive the parent."""
+    cluster = SimCluster(str(tmp_path / "c"), n_nodes=2, seed=8)
+    try:
+        app_id = cluster.create_table("uc", partition_count=2,
+                                      replica_count=1)
+        cluster.loop.run_until_idle()
+        meta = cluster.meta
+        from pegasus_tpu.meta.server_state import PartitionConfig
+
+        node = meta.state.get_partition(app_id, 0).primary
+        meta.split._splits[app_id] = {"old_count": 2, "new_count": 4,
+                                      "registered": [2]}
+        meta.state.set_partition_raw(app_id, 2,
+                                     PartitionConfig(1, node, []))
+        parent_ballot = meta.state.get_partition(app_id, 0).ballot
+        meta._on_replica_corrupted((app_id, 2), node)
+        info = meta.split._splits[app_id]
+        assert 2 not in info["registered"]
+        assert meta.state.get_partition(app_id, 2).primary == ""
+        # parent re-proposed (unfence + re-drive)
+        assert meta.state.get_partition(app_id, 0).ballot \
+            == parent_ballot + 1
+    finally:
+        cluster.close()
+
+
+# ---- batched-path misroute: retry ONLY the stale-routed subset -------
+
+
+def _count_batch_ops(cluster, msg_type, log):
+    orig = cluster.net.send
+
+    def send(src, dst, mt, payload):
+        if mt == msg_type:
+            log.append(sum(len(ops) for _g, ops in payload["groups"]))
+        return orig(src, dst, mt, payload)
+
+    cluster.net.send = send
+
+
+def _split_to_four(cluster, app_id, table):
+    cluster.meta.split.start_partition_split(table)
+    for _ in range(15):
+        cluster.step()
+        if not cluster.meta.split.split_status(table)["splitting"]:
+            break
+    assert cluster.meta.state.apps[app_id].partition_count == 4
+
+
+def test_point_read_batch_retries_only_misrouted_subset(tmp_path):
+    cluster = SimCluster(str(tmp_path / "c"), n_nodes=3, seed=11)
+    try:
+        app_id = cluster.create_table("mr", partition_count=2)
+        c = cluster.client("mr")
+        keys = [b"k%03d" % i for i in range(24)]
+        for hk in keys:
+            assert c.set(hk, b"s", b"val-" + hk) == OK
+        stale = cluster.client("mr", name="stale-reader")
+        stale._ensure_config()
+        assert stale.partition_count == 2
+        _split_to_four(cluster, app_id, "mr")
+        # the stale client flushes a batch grouped under count=2; the
+        # keys whose new pidx moved to a child bounce per-op with
+        # ERR_PARENT_PARTITION_MISUSED and ONLY they are retried
+        misrouted = sum(1 for hk in keys
+                        if key_hash_parts(hk, b"s") % 4 >= 2)
+        assert 0 < misrouted < len(keys)
+        sent = []
+        _count_batch_ops(cluster, "client_read_batch", sent)
+        groups = {}
+        for hk in keys:
+            ph = key_hash_parts(hk, b"s")
+            groups.setdefault(ph % 2, []).append(
+                ("get", generate_key(hk, b"s"), ph))
+        out = stale.point_read_multi(groups)
+        flat = [r for results in out.values() for r in results]
+        assert len(flat) == len(keys)
+        assert {r[1] for r in flat} == {b"val-" + hk for hk in keys}
+        assert sum(sent) == len(keys) + misrouted, sent
+    finally:
+        cluster.close()
+
+
+def test_write_batch_retries_only_misrouted_subset(tmp_path):
+    cluster = SimCluster(str(tmp_path / "c"), n_nodes=3, seed=12)
+    try:
+        app_id = cluster.create_table("mw", partition_count=2)
+        c = cluster.client("mw")
+        assert c.set(b"seed", b"s", b"v") == OK
+        stale = cluster.client("mw", name="stale-writer")
+        stale._ensure_config()
+        assert stale.partition_count == 2
+        _split_to_four(cluster, app_id, "mw")
+        keys = [b"w%03d" % i for i in range(24)]
+        misrouted = sum(1 for hk in keys
+                        if key_hash_parts(hk, b"s") % 4 >= 2)
+        assert 0 < misrouted < len(keys)
+        sent = []
+        _count_batch_ops(cluster, "client_write_batch", sent)
+        groups = {}
+        for hk in keys:
+            ph = key_hash_parts(hk, b"s")
+            groups.setdefault(ph % 2, []).append(
+                (OP_PUT, (generate_key(hk, b"s"), b"wv-" + hk, 0), ph))
+        out = stale.write_multi(groups)
+        assert all(r == OK for results in out.values() for r in results)
+        assert sum(sent) == len(keys) + misrouted, sent
+        # every write landed exactly once, readable through new routing
+        for hk in keys:
+            assert c.get(hk, b"s") == (OK, b"wv-" + hk), hk
+    finally:
+        cluster.close()
+
+
+def test_batch_get_keeps_answered_groups_across_split_retry(tmp_path):
+    cluster = SimCluster(str(tmp_path / "c"), n_nodes=3, seed=13)
+    try:
+        app_id = cluster.create_table("bg", partition_count=2)
+        c = cluster.client("bg")
+        # craft: group pidx0 (stale) mixes clean+moved keys -> bounces;
+        # group pidx1 only holds keys that stay put -> answered once
+        pool = [b"g%04d" % i for i in range(400)]
+        moved = [hk for hk in pool if key_hash_parts(hk, b"s") % 4 == 2]
+        steady0 = [hk for hk in pool
+                   if key_hash_parts(hk, b"s") % 4 == 0]
+        steady1 = [hk for hk in pool
+                   if key_hash_parts(hk, b"s") % 4 == 1]
+        keys = moved[:4] + steady0[:4] + steady1[:4]
+        assert len(keys) == 12
+        for hk in keys:
+            assert c.set(hk, b"s", b"bv-" + hk) == OK
+        stale = cluster.client("bg", name="stale-bg")
+        stale._ensure_config()
+        _split_to_four(cluster, app_id, "bg")
+        sent = []  # (pidx, n_keys) per batch_get request
+        orig = cluster.net.send
+
+        def send(src, dst, mt, payload):
+            if (mt == "client_read" and isinstance(payload, dict)
+                    and payload.get("op") == "batch_get"):
+                sent.append((payload["gpid"][1],
+                             len(payload["args"].keys)))
+            return orig(src, dst, mt, payload)
+
+        cluster.net.send = send
+        err, rows = stale.batch_get([(hk, b"s") for hk in keys])
+        assert err == OK
+        assert {(hk, v) for hk, _sk, v in rows} \
+            == {(hk, b"bv-" + hk) for hk in keys}
+        # attempt 1: pidx0 carries 8 keys (bounces), pidx1 carries 4
+        # (answered). Attempt 2 re-sends ONLY pidx0's 8 keys, now split
+        # across their true owners — the answered group never replays.
+        total = sum(n for _p, n in sent)
+        assert total == 12 + 8, sent
+    finally:
+        cluster.close()
+
+
+# ---- observability: hot_partitions verb + metrics --------------------
+
+
+def test_hot_partitions_verb_reports_signals(tmp_path):
+    cluster = SimCluster(str(tmp_path / "c"), n_nodes=3, seed=14)
+    try:
+        cluster.create_table("hp", partition_count=4)
+        c = cluster.client("hp")
+        for i in range(80):
+            assert c.set(b"h%03d" % i, b"s", b"v") == OK
+        cluster.step(rounds=3)  # config_sync reports + controller rates
+        replies = []
+        cluster.net.register("hpcx",
+                             lambda src, mt, p: replies.append(p))
+        cluster.net.send("hpcx", cluster.metas[0].name, "admin", {
+            "rid": 1, "cmd": "hot_partitions", "args": {}})
+        cluster.loop.run_until_idle()
+        assert replies and replies[0]["err"] == 0
+        status = replies[0]["result"]
+        rows = status["partitions"]
+        assert len(rows) == 4
+        assert sorted(r["gpid"][1] for r in rows) == [0, 1, 2, 3]
+        assert all("cu_rate" in r and "hot_key" in r for r in rows)
+        assert sum(r["read_cu"] + r["write_cu"] for r in rows) > 0
+        assert status["splits_inflight"] == []
+        assert "node_load" in status and "pressure" in status
+    finally:
+        cluster.close()
+
+
+def test_split_fence_reject_metric_counts_fenced_writes(tmp_path):
+    from pegasus_tpu.utils.metrics import METRICS
+
+    cluster = SimCluster(str(tmp_path / "c"), n_nodes=2, seed=15)
+    try:
+        app_id = cluster.create_table("fm", partition_count=1,
+                                      replica_count=1)
+        c = cluster.client("fm")
+        assert c.set(b"a", b"s", b"v") == OK
+        pc = cluster.meta.state.get_partition(app_id, 0)
+        stub = cluster.stubs[pc.primary]
+        counter = METRICS.entity("storage", "node").counter(
+            "split_fence_reject_count")
+        before = counter.value()
+        # fence the replica directly and fire one write at it
+        stub.replicas[(app_id, 0)].splitting = True
+        rid = c._send_request(pc.primary, "client_write", {
+            "gpid": (app_id, 0), "ops": [], "auth": None,
+            "partition_hash": None})
+        reply = c._await(rid)
+        assert reply["err"] == int(ErrorCode.ERR_SPLITTING)
+        assert counter.value() == before + 1
+        stub.replicas[(app_id, 0)].splitting = False
+    finally:
+        cluster.close()

@@ -273,6 +273,8 @@ def test_admin_verbs_over_wire(tmp_path, capsys):
         assert "total:" in out
         code, out = run(capsys, "--cluster", d, "ddd_diagnose")
         assert code == 0
+        code, out = run(capsys, "--cluster", d, "hot_partitions", "wt")
+        assert code == 0 and '"cu_rate"' in out and "node_load" in out
         code, out = run(capsys, "--cluster", d, "rename", "wt", "wt2")
         assert "OK" in out
         code, out = run(capsys, "--cluster", d, "ls")

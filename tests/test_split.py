@@ -131,6 +131,57 @@ def test_writes_after_split_land_in_new_partitions(loaded):
     assert server.on_get(generate_key(b"newbie_42", b"s")) == (0, b"fresh")
 
 
+def test_flip_drops_row_and_plan_caches_no_stale_parent_row(tmp_path):
+    """Epoch-guard across the count flip (PR 6): rows admitted into the
+    node row cache and the per-generation plan/point caches under the
+    PARENT's pre-split routing must never serve after the flip — an
+    acked pre-split write read through a child (or a post-split
+    overwrite) must always see the latest bytes."""
+    from pegasus_tpu.server.row_cache import ROW_CACHE
+
+    t = Table(str(tmp_path / "t"), partition_count=2)
+    try:
+        c = PegasusClient(t)
+        keys = [b"rc%03d" % i for i in range(40)]
+        for hk in keys:
+            c.set(hk, b"s", b"v1-" + hk)
+        t.flush_all()  # rows must be base-resolved to enter the cache
+        # two batched flushes: the repeat gate admits on the 2nd touch
+        for parent in t.all_partitions():
+            ops = [("get", generate_key(hk, b"s"), None) for hk in keys
+                   if partition_index(hk, 2) == parent.pidx]
+            for _ in range(2):
+                results = parent.on_point_read_batch(ops)
+                assert all(r[0] == 0 for r in results)
+        app_id = t.app_id
+        stats = ROW_CACHE.stats()["per_gid"]
+        parent_gids = {str((app_id, p)) for p in range(2)}
+        assert parent_gids & set(stats), stats  # parent rows resident
+        assert any(p._point_cache is not None
+                   for p in t.all_partitions())
+        t.split()
+        # the flip dropped every parent row and plan/point cache
+        stats = ROW_CACHE.stats()["per_gid"]
+        assert not (parent_gids & set(stats)), stats
+        for p in t.all_partitions():
+            assert p._point_cache is None
+            assert p._plan_cache is None
+            assert p._live_cache == {}
+        # overwrite post-split (owned by whichever partition routes it
+        # now), then read through the batched path: never the v1 bytes
+        for hk in keys:
+            c.set(hk, b"s", b"v2-" + hk)
+        for hk in keys:
+            pidx = partition_index(hk, 4)
+            server = t.partitions[pidx]
+            res = server.on_point_read_batch(
+                [("get", generate_key(hk, b"s"), None)] * 2)
+            assert res == [(0, b"v2-" + hk)] * 2, hk
+            assert c.get(hk, b"s") == (0, b"v2-" + hk), hk
+    finally:
+        t.close()
+
+
 def test_split_concurrent_writes_not_lost(tmp_path):
     """ADVICE r1 (medium): a write acked by a parent after its child's
     checkpoint but before the count flip must not vanish. split() fences
