@@ -772,7 +772,8 @@ def _compact_rules_filter():
 
 
 def build_compact_store(data_dir: str, n_records: int,
-                        expired_frac: float, n_parts: int, seed: int):
+                        expired_frac: float, n_parts: int, seed: int,
+                        value_kind: str = "random"):
     """Build `n_parts` partition stores totalling n_records directly as
     columnar L1 runs — the bulk-load ingest shape (externally-built
     SSTs adopted whole, parity: bulk load OP_INGEST) — with
@@ -823,8 +824,26 @@ def build_compact_store(data_dir: str, n_records: int,
                            np.uint32(0)).astype(np.uint32)
             flags = np.zeros(n, dtype=np.uint8)
             offs = np.arange(n + 1, dtype=np.uint32) * VALUE
-            heap = rng.integers(32, 126, size=n * VALUE,
-                                dtype=np.uint8).tobytes()
+            if value_kind == "templated":
+                # realistic structured payloads (field names + bounded
+                # enumerations + a short random tail) — the workload
+                # class where value compression actually pays, vs the
+                # incompressible uniform-random default
+                tails = rng.integers(97, 123, size=(n, 24),
+                                     dtype=np.uint8)
+
+                def _tv(j, i):
+                    head = (b"ts=1700000000|city=%03d|tier=%d|"
+                            b"status=active|score=%02d|"
+                            % (i % 997, i % 5, i % 100)) \
+                        + tails[j].tobytes()
+                    return head + b"." * (VALUE - len(head))
+
+                heap = b"".join(_tv(j, int(i))
+                                for j, i in enumerate(idx))
+            else:
+                heap = rng.integers(32, 126, size=n * VALUE,
+                                    dtype=np.uint8).tobytes()
             hash_lo = (crc64_batch(keys, np.full(n, 12, dtype=np.int64),
                                    start=2)
                        & np.uint64(0xFFFFFFFF)).astype(np.uint32)
@@ -922,6 +941,203 @@ def measure_compaction_scaled(jax, device, tmpdir, mode: str,
         size_after
 
 
+def _compact_sample_digest(engines, seed, per_part=3000):
+    """Deterministic record-level digest of post-compaction contents:
+    a bounded iterate() prefix plus scattered point gets per partition
+    — the identity gate between the compressed and uncompressed
+    same-run stores."""
+    import hashlib
+    import itertools
+
+    import numpy as np
+
+    from pegasus_tpu.base.key_schema import generate_key
+
+    h = hashlib.sha256()
+    rng = np.random.default_rng(seed)
+    for eng in engines:
+        for key, value, ets in itertools.islice(eng.iterate(),
+                                                per_part):
+            h.update(key)
+            h.update(value)
+            h.update(b"%d" % ets)
+        for _ in range(64):
+            k = generate_key(b"user%08d" % int(rng.integers(0, 1 << 24)),
+                             b"s%02d" % int(rng.integers(0, 10)))
+            h.update(repr(eng.get(k)).encode())
+    return h.hexdigest()
+
+
+def measure_compressed_compact(jax, device, tmpdir, gb: float,
+                               expired_frac: float, seed: int,
+                               n_parts: int = 8):
+    """compact_compressed phase (round-11): the SAME logical dataset is
+    built twice — block_codec=none and =dcz — and one full bulk
+    compaction of every partition is timed on each. Reported per codec:
+    wall seconds, on-disk input/output bytes, disk GB/s, and EFFECTIVE
+    input GB/s (logical uncompressed bytes / seconds — the number that
+    can pass the raw-disk ceiling when compressed output shrinks the
+    write side). Identity-gated record-for-record between the two
+    stores."""
+    import shutil
+    from concurrent.futures import ThreadPoolExecutor
+
+    from pegasus_tpu.utils.flags import FLAGS
+
+    n_records = int(gb * 1e9 / 145)  # ~145 B/record in the raw format
+    per_part = n_records // n_parts
+    out = {}
+    logical_in = None
+    old_codec = FLAGS.get("pegasus.storage", "block_codec")
+    try:
+        for codec in ("none", "dcz"):
+            FLAGS.set("pegasus.storage", "block_codec", codec)
+            data_dir = os.path.join(tmpdir, f"ccompact-{codec}")
+            if os.path.exists(data_dir):
+                shutil.rmtree(data_dir)
+            t0 = time.perf_counter()
+            engines = build_compact_store(
+                data_dir, per_part * (n_parts + 1), expired_frac,
+                n_parts + 1, seed, value_kind="templated")
+            _log(f"compact_compressed[{codec}] fixture: "
+                 f"{per_part * n_parts} records in "
+                 f"{time.perf_counter() - t0:.1f}s")
+            warm = engines[0]
+            engines = engines[1:]
+            with jax.default_device(device):
+                warm.manual_compact()
+            warm.close()
+            os.sync()
+            size_before = _store_bytes(engines)
+            if codec == "none":
+                logical_in = size_before
+            with jax.default_device(device):
+                t0 = time.perf_counter()
+
+                def one(eng):
+                    with jax.default_device(device):
+                        eng.manual_compact()
+
+                # pool sized to the machine, not the partition count:
+                # dcz compaction is CPU-dense (GIL-released native
+                # deflate), and 8 workers on a 2-core box thrash both
+                # codecs while taxing dcz hardest (measured 0.83x vs
+                # 1.04x at workers=cpu_count on the same fixture)
+                with ThreadPoolExecutor(
+                        max_workers=min(os.cpu_count() or 4,
+                                        n_parts)) as ex:
+                    for f in [ex.submit(one, e) for e in engines]:
+                        f.result()
+                secs = time.perf_counter() - t0
+            size_after = _store_bytes(engines)
+            ratios = [t.codec_stats for e in engines
+                      for t in e.lsm.l1_runs if t.codec_stats]
+            raw_b = sum(r["raw_bytes"] for r in ratios)
+            stored_b = sum(r["stored_bytes"] for r in ratios)
+            digest = _compact_sample_digest(engines, seed + 1)
+            for eng in engines:
+                eng.close()
+            shutil.rmtree(data_dir, ignore_errors=True)
+            out[codec] = {
+                "seconds": round(secs, 3),
+                "in_bytes": size_before,
+                "out_bytes": size_after,
+                "disk_gb_per_s": round(size_before / secs / 1e9, 4),
+                "effective_input_gb_per_s": round(
+                    (logical_in or size_before) / secs / 1e9, 4),
+                "output_compression_ratio": (
+                    round(stored_b / raw_b, 4) if raw_b else None),
+                "sample_digest": digest,
+            }
+            _log(f"compact_compressed[{codec}]: {secs:.1f}s, "
+                 f"disk {out[codec]['disk_gb_per_s']:.3f} GB/s, "
+                 f"effective {out[codec]['effective_input_gb_per_s']:.3f}"
+                 f" GB/s")
+    finally:
+        FLAGS.set("pegasus.storage", "block_codec", old_codec)
+    out["identity_ok"] = (out["none"]["sample_digest"]
+                          == out["dcz"]["sample_digest"])
+    out["effective_speedup"] = round(
+        out["dcz"]["effective_input_gb_per_s"]
+        / max(out["none"]["effective_input_gb_per_s"], 1e-9), 3)
+    return out
+
+
+def _scan_identity_digest(bc, n_partitions, n_hashkeys, seed, n=96):
+    """sha256 over a deterministic scan sample's key/value bytes."""
+    import hashlib
+
+    import numpy as np
+
+    from pegasus_tpu.base.key_schema import generate_key
+    from pegasus_tpu.server.types import GetScannerRequest
+
+    rng = np.random.default_rng(seed)
+    h = hashlib.sha256()
+    for _ in range(n):
+        pidx = int(rng.integers(0, n_partitions))
+        start = b"user%08d" % int(rng.integers(0, n_hashkeys))
+        res = bc.client.scan_multi({pidx: [GetScannerRequest(
+            start_key=generate_key(start, b""), batch_size=40,
+            validate_partition_hash=True, one_page=True)]})
+        for resp in res[pidx]:
+            for kv in resp.kvs:
+                h.update(kv.key)
+                h.update(b"\x00")
+                h.update(kv.value)
+                h.update(b"\x01")
+    return h.hexdigest()
+
+
+def measure_compressed_scan(jax, device, tmpdir, n_records: int,
+                            n_partitions: int, n_ops: int, seed: int):
+    """scan_compressed phase (round-11): the warm YCSB-E scan measured
+    over a compressed store vs an uncompressed same-run twin. Direct
+    compute means the steady state decodes nothing (masks from the
+    encoded probe, blocks resident in the byte-capped cache), so the
+    compressed number must sit within noise of the raw one — that IS
+    the acceptance gate, alongside a byte-identity scan sample."""
+    from pegasus_tpu.utils.flags import FLAGS
+
+    n_hashkeys = max(1, n_records // 10)
+    out = {}
+    old_codec = FLAGS.get("pegasus.storage", "block_codec")
+    try:
+        for codec in ("none", "dcz"):
+            FLAGS.set("pegasus.storage", "block_codec", codec)
+            bdir = os.path.join(tmpdir, f"cscan-{codec}")
+            bc = build_cluster(bdir, n_records, n_partitions, seed)
+            try:
+                ops, recs, secs = _measure_scan_phase(
+                    jax, device, bc, n_ops, n_partitions, n_hashkeys,
+                    seed)
+                digest = _scan_identity_digest(bc, n_partitions,
+                                               n_hashkeys, seed + 7)
+                out[codec] = {
+                    "ops_per_s": round(ops / secs, 1),
+                    "records_per_s": round(recs / secs, 1),
+                    "seconds": round(secs, 3),
+                    "disk_bytes": data_bytes(bc),
+                    "sample_digest": digest,
+                }
+                _log(f"scan_compressed[{codec}]: "
+                     f"{out[codec]['ops_per_s']:.0f} ops/s, "
+                     f"{out[codec]['records_per_s']:.0f} records/s")
+            finally:
+                bc.close()
+    finally:
+        FLAGS.set("pegasus.storage", "block_codec", old_codec)
+    out["identity_ok"] = (out["none"]["sample_digest"]
+                          == out["dcz"]["sample_digest"])
+    out["ops_ratio_dcz_vs_none"] = round(
+        out["dcz"]["ops_per_s"] / max(out["none"]["ops_per_s"], 1e-9),
+        4)
+    out["disk_ratio"] = round(
+        out["dcz"]["disk_bytes"] / max(out["none"]["disk_bytes"], 1),
+        4)
+    return out
+
+
 def measure_geo(jax, device, n_points=20_000, n_searches=150, seed=11):
     """Geo radius-search ops/sec (BASELINE config #5): cell-cover prefix
     scans + one batched device distance predicate per search."""
@@ -977,6 +1193,7 @@ def main() -> None:
     # all BASELINE.md phases run by default so the recorded details
     # cover every target row; =0 disables one for quick iteration
     do_compact = os.environ.get("PEGBENCH_COMPACT", "1") != "0"
+    do_compressed = os.environ.get("PEGBENCH_COMPRESSED", "1") != "0"
     do_geo = os.environ.get("PEGBENCH_GEO", "1") != "0"
 
     details = {"phases": {}}
@@ -1357,6 +1574,40 @@ def main() -> None:
                             "cpu_seconds": round(c_s, 2),
                         }
                         save_details()
+
+                if do_compressed:
+                    # round-11: compressed SST output + direct compute.
+                    # Single-backend phases (the codec work is host-side
+                    # by design — deflate/inflate and the encoded probes
+                    # never touch the device), so each runs once on the
+                    # serving backend and compares codec none vs dcz
+                    # same-run.
+                    gb = float(os.environ.get(
+                        "PEGBENCH_COMPRESSED_GB", "1.0"))
+                    exp_frac = float(os.environ.get("PEGBENCH_EXPIRED",
+                                                    "0.5"))
+                    cc = measure_compressed_compact(
+                        jax, accel, tmpdir, gb, exp_frac, seed)
+                    details["phases"]["compact_compressed"] = cc
+                    save_details()
+                    _log(f"compact_compressed: effective "
+                         f"{cc['dcz']['effective_input_gb_per_s']:.3f} "
+                         f"GB/s vs {cc['none']['effective_input_gb_per_s']:.3f}"
+                         f" uncompressed ({cc['effective_speedup']:.2f}x,"
+                         f" ratio {cc['dcz']['output_compression_ratio']}"
+                         f", identical={cc['identity_ok']})")
+                    cs = measure_compressed_scan(
+                        jax, accel, tmpdir,
+                        min(n_records, 200_000), n_partitions,
+                        n_ops, seed)
+                    details["phases"]["scan_compressed"] = cs
+                    save_details()
+                    _log(f"scan_compressed: dcz "
+                         f"{cs['dcz']['ops_per_s']:.0f} vs none "
+                         f"{cs['none']['ops_per_s']:.0f} ops/s "
+                         f"({cs['ops_ratio_dcz_vs_none']:.3f}x, disk "
+                         f"{cs['disk_ratio']:.3f}, "
+                         f"identical={cs['identity_ok']})")
 
                 if do_geo:
                     g_accel, g_hits = measure_geo(jax, accel)

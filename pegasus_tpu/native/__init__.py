@@ -30,7 +30,7 @@ def _build() -> bool:
         # builders must not interleave writes into one tmp file
         result = subprocess.run(
             ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", _SRC,
-             "-o", tmp],
+             "-o", tmp, "-ldl"],
             capture_output=True, timeout=120)
         if result.returncode != 0:
             return False
@@ -71,6 +71,22 @@ def _load() -> Optional[ctypes.CDLL]:
                 ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
                 ctypes.c_int64, ctypes.c_void_p, ctypes.c_void_p,
                 ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p]
+            lib.pegasus_cblock_decode_keys.restype = None
+            lib.pegasus_cblock_decode_keys.argtypes = [
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+                ctypes.c_int64, ctypes.c_int64, ctypes.c_void_p]
+            lib.pegasus_region_filter.restype = None
+            lib.pegasus_region_filter.argtypes = [
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+                ctypes.c_void_p, ctypes.c_int64, ctypes.c_int32,
+                ctypes.c_void_p]
+            lib.pegasus_cblock_subset.restype = ctypes.c_int64
+            lib.pegasus_cblock_subset.argtypes = [
+                ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,
+                ctypes.c_void_p, ctypes.c_int32, ctypes.c_void_p,
+                ctypes.c_int64, ctypes.c_void_p, ctypes.c_void_p,
+                ctypes.c_void_p]
             lib.pegasus_gather_page.restype = None
             lib.pegasus_gather_page.argtypes = [
                 ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,
@@ -199,6 +215,87 @@ def pack_records(keys, key_width: int):
     if rc != 0:
         return None
     return keys_out, key_len, hkl, hash_lo, valid.astype(bool)
+
+
+def cblock_decode_keys_fn():
+    """Key-matrix rebuild for dcz-encoded blocks, or None when the
+    native library is unavailable (block_codec falls back to numpy
+    ragged scatters)."""
+    lib = _load()
+    if lib is None:
+        return None
+
+    def decode_keys(dict_heap, dict_offs, hk_idx, sk_heap, sk_offs,
+                    key_len, n, width, out) -> None:
+        lib.pegasus_cblock_decode_keys(
+            dict_heap.ctypes.data if dict_heap.size else None,
+            dict_offs.ctypes.data, hk_idx.ctypes.data,
+            sk_heap.ctypes.data if sk_heap.size else None,
+            sk_offs.ctypes.data, key_len.ctypes.data, n, width,
+            out.ctypes.data)
+
+    return decode_keys
+
+
+def cblock_subset_fn():
+    """Encoded-domain block subsetting for the compaction drop path
+    (see packer.cpp pegasus_cblock_subset), or None when the native
+    library is unavailable (bulk compaction falls back to the Python
+    decode -> gather -> re-encode path)."""
+    import numpy as np
+
+    lib = _load()
+    if lib is None:
+        return None
+
+    def subset(raw, raw_heap_len: int, key_width: int, keep, new_ets,
+               patch_value_headers: bool, want_hashes: bool):
+        """(encoded bytes, crc64 hashes|None, kept n, subset raw heap
+        len, first_key, last_key), or None when the kernel cannot take
+        this block (compressed heap with no zlib/zstd resolvable)."""
+        a = raw if isinstance(raw, np.ndarray) \
+            else np.frombuffer(raw, dtype=np.uint8)
+        a = np.ascontiguousarray(a)
+        keep_u8 = np.ascontiguousarray(keep, dtype=np.uint8)
+        if new_ets is not None:
+            new_ets = np.ascontiguousarray(new_ets, dtype=np.uint32)
+        out = np.empty(a.size + raw_heap_len + 4096, dtype=np.uint8)
+        hashes = (np.empty(keep_u8.size, dtype=np.uint64)
+                  if want_hashes else None)
+        out_keys = np.zeros(2 * key_width, dtype=np.uint8)
+        out_meta = np.zeros(4, dtype=np.int64)
+        rc = lib.pegasus_cblock_subset(
+            a.ctypes.data, a.size, keep_u8.ctypes.data,
+            new_ets.ctypes.data if new_ets is not None else None,
+            1 if patch_value_headers else 0, out.ctypes.data, out.size,
+            hashes.ctypes.data if hashes is not None else None,
+            out_keys.ctypes.data, out_meta.ctypes.data)
+        if rc < 0:
+            return None
+        m, vsub, fkl, lkl = (int(x) for x in out_meta)
+        return (out[:rc].tobytes(),
+                hashes[:m].copy() if hashes is not None else None,
+                m, vsub, out_keys[:fkl].tobytes(),
+                out_keys[key_width:key_width + lkl].tobytes())
+
+    return subset
+
+
+def region_filter_fn():
+    """Ragged-region pattern filter (the encoded-probe primitive), or
+    None when the native library is unavailable (predicates falls back
+    to the scalar host_match_filter loop)."""
+    lib = _load()
+    if lib is None:
+        return None
+
+    def region_filter(heap, offs, n, pattern: bytes, ftype: int,
+                      out) -> None:
+        lib.pegasus_region_filter(
+            heap.ctypes.data if heap.size else None, offs.ctypes.data,
+            n, pattern, len(pattern), ftype, out.ctypes.data)
+
+    return region_filter
 
 
 def gather_page_fn():

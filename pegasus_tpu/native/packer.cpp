@@ -15,7 +15,10 @@
 // ABI: plain C, consumed via ctypes.
 
 #include <cstdint>
+#include <cstdlib>
 #include <cstring>
+
+#include <dlfcn.h>
 
 namespace {
 
@@ -182,6 +185,514 @@ int32_t pegasus_pack_records(const uint8_t* heap, const int64_t* offsets,
     valid_out[i] = valid ? 1 : 0;
   }
   return 0;
+}
+
+// Rebuild the zero-padded key matrix of a dcz-encoded block (see
+// storage/block_codec.py): per row, the 2-byte big-endian hashkey
+// header + the dictionary entry + the sortkey heap slice, memcpy'd
+// into a pre-zeroed uint8[n, width] matrix. Rows whose hk_idx is the
+// 0xFFFFFFFF sentinel are malformed originals stored raw in the
+// sortkey heap and copy back verbatim (no header synthesis).
+void pegasus_cblock_decode_keys(const uint8_t* dict_heap,
+                                const uint32_t* dict_offs,
+                                const uint32_t* hk_idx,
+                                const uint8_t* sk_heap,
+                                const int64_t* sk_offs,
+                                const int32_t* key_len, int64_t n,
+                                int64_t width, uint8_t* keys_out) {
+  for (int64_t i = 0; i < n; ++i) {
+    uint8_t* row = keys_out + i * width;
+    const int64_t s0 = sk_offs[i];
+    const int64_t sl = sk_offs[i + 1] - s0;
+    const uint32_t d = hk_idx[i];
+    if (d == 0xFFFFFFFFu) {
+      std::memcpy(row, sk_heap + s0, sl);
+      continue;
+    }
+    const uint32_t h0 = dict_offs[d];
+    const uint32_t hl = dict_offs[d + 1] - h0;
+    row[0] = static_cast<uint8_t>(hl >> 8);
+    row[1] = static_cast<uint8_t>(hl & 0xFF);
+    std::memcpy(row + 2, dict_heap + h0, hl);
+    std::memcpy(row + 2 + hl, sk_heap + s0, sl);
+    (void)key_len;
+  }
+}
+
+// Pattern-filter a column of ragged byte regions (the direct-compute
+// probe over a dcz block's sortkey heap, or its hashkey dictionary):
+// out[i] = 1 iff region i matches. Semantics mirror the device
+// match_filter kernel (ops/predicates.py): an empty pattern matches
+// everything; a region shorter than the pattern never matches; types
+// are 1=anywhere, 2=prefix, 3=postfix (0=no-filter handled by the
+// caller).
+void pegasus_region_filter(const uint8_t* heap, const int64_t* offs,
+                           int64_t n, const uint8_t* pat, int64_t plen,
+                           int32_t ftype, uint8_t* out) {
+  if (plen == 0) {
+    std::memset(out, 1, static_cast<size_t>(n));
+    return;
+  }
+  for (int64_t i = 0; i < n; ++i) {
+    const uint8_t* r = heap + offs[i];
+    const int64_t rl = offs[i + 1] - offs[i];
+    uint8_t ok = 0;
+    if (rl >= plen) {
+      if (ftype == 2) {  // prefix
+        ok = std::memcmp(r, pat, plen) == 0;
+      } else if (ftype == 3) {  // postfix
+        ok = std::memcmp(r + rl - plen, pat, plen) == 0;
+      } else {  // anywhere
+        for (int64_t t = 0; t + plen <= rl; ++t) {
+          if (r[t] == pat[0] && std::memcmp(r + t, pat, plen) == 0) {
+            ok = 1;
+            break;
+          }
+        }
+      }
+    }
+    out[i] = ok;
+  }
+}
+
+// ---- encoded-domain block subsetting (compaction drop path) ---------
+//
+// zlib/zstd via dlopen: the value heap of a dcz block may be
+// compressed, and the subset must inflate -> gather -> re-compress.
+// Linking -lz/-lzstd at build time would make the WHOLE library's
+// availability depend on a dev symlink; resolving the .so at first
+// use keeps every other kernel alive when a compressor is absent (the
+// caller falls back to the Python gather path on rc=-2).
+typedef int (*z_uncompress_t)(uint8_t*, unsigned long*, const uint8_t*,
+                              unsigned long);
+typedef int (*z_compress2_t)(uint8_t*, unsigned long*, const uint8_t*,
+                             unsigned long, int);
+typedef unsigned long (*z_bound_t)(unsigned long);
+
+namespace {
+
+struct ZlibFns {
+  z_uncompress_t uncompress_ = nullptr;
+  z_compress2_t compress2_ = nullptr;
+  z_bound_t bound_ = nullptr;
+  ZlibFns() {
+    void* h = dlopen("libz.so.1", RTLD_NOW | RTLD_LOCAL);
+    if (h == nullptr) h = dlopen("libz.so", RTLD_NOW | RTLD_LOCAL);
+    if (h != nullptr) {
+      uncompress_ = reinterpret_cast<z_uncompress_t>(
+          dlsym(h, "uncompress"));
+      compress2_ = reinterpret_cast<z_compress2_t>(
+          dlsym(h, "compress2"));
+      bound_ = reinterpret_cast<z_bound_t>(dlsym(h, "compressBound"));
+    }
+  }
+  bool ok() const {
+    return uncompress_ != nullptr && compress2_ != nullptr &&
+           bound_ != nullptr;
+  }
+};
+
+const ZlibFns& zlib() {
+  static ZlibFns z;  // thread-safe magic static
+  return z;
+}
+
+// zstd via the same dlopen pattern: level-1 zstd runs ~6x faster than
+// zlib-1 at a similar ratio, and compaction's inflate -> gather ->
+// re-compress is exactly the path where that factor decides whether
+// compressed output beats the disk. Decode handles BOTH heap modes
+// (zlib-heap blocks written before the switch keep serving); encode
+// prefers zstd and falls back to zlib when libzstd is absent.
+typedef size_t (*zstd_compress_t)(void*, size_t, const void*, size_t,
+                                  int);
+typedef size_t (*zstd_decompress_t)(void*, size_t, const void*, size_t);
+typedef size_t (*zstd_bound_t)(size_t);
+typedef unsigned (*zstd_iserr_t)(size_t);
+
+struct ZstdFns {
+  zstd_compress_t compress_ = nullptr;
+  zstd_decompress_t decompress_ = nullptr;
+  zstd_bound_t bound_ = nullptr;
+  zstd_iserr_t iserr_ = nullptr;
+  ZstdFns() {
+    void* h = dlopen("libzstd.so.1", RTLD_NOW | RTLD_LOCAL);
+    if (h == nullptr) h = dlopen("libzstd.so", RTLD_NOW | RTLD_LOCAL);
+    if (h != nullptr) {
+      compress_ = reinterpret_cast<zstd_compress_t>(
+          dlsym(h, "ZSTD_compress"));
+      decompress_ = reinterpret_cast<zstd_decompress_t>(
+          dlsym(h, "ZSTD_decompress"));
+      bound_ = reinterpret_cast<zstd_bound_t>(
+          dlsym(h, "ZSTD_compressBound"));
+      iserr_ = reinterpret_cast<zstd_iserr_t>(dlsym(h, "ZSTD_isError"));
+    }
+  }
+  bool ok() const {
+    return compress_ != nullptr && decompress_ != nullptr &&
+           bound_ != nullptr && iserr_ != nullptr;
+  }
+};
+
+const ZstdFns& zstd() {
+  static ZstdFns z;
+  return z;
+}
+
+// mirror of block_codec._CBLK_HDR ("<IIQQQIIBBBBBBxx", 48 bytes)
+#pragma pack(push, 1)
+struct CBlkHdr {
+  uint32_t n, key_width;
+  uint64_t raw_heap, comp_heap, sk_bytes;
+  uint32_t dict_n, dict_bytes;
+  uint8_t klen_w, vlen_w, idx_w, flags_mode, ets_mode, heap_mode;
+  uint8_t pad[2];
+};
+#pragma pack(pop)
+static_assert(sizeof(CBlkHdr) == 48, "header layout drift");
+
+inline int64_t narrow_at(const uint8_t* col, int w, int64_t i) {
+  if (w == 1) return col[i];
+  if (w == 2) {
+    uint16_t v;
+    std::memcpy(&v, col + 2 * i, 2);
+    return v;
+  }
+  uint32_t v;
+  std::memcpy(&v, col + 4 * i, 4);
+  return v;
+}
+
+inline void narrow_put(uint8_t* col, int w, int64_t i, int64_t v) {
+  if (w == 1) {
+    col[i] = static_cast<uint8_t>(v);
+  } else if (w == 2) {
+    uint16_t x = static_cast<uint16_t>(v);
+    std::memcpy(col + 2 * i, &x, 2);
+  } else {
+    uint32_t x = static_cast<uint32_t>(v);
+    std::memcpy(col + 4 * i, &x, 4);
+  }
+}
+
+constexpr int kHeapRaw = 0;
+constexpr int kHeapZlib = 1;
+constexpr int kHeapZstd = 2;
+constexpr int kZlibLevel = 1;
+constexpr int kZstdLevel = 1;
+
+}  // namespace
+
+// Subset a dcz-encoded block ENTIRELY in the encoded domain: keep[i]
+// selects rows; the dictionary is re-built from the surviving rows'
+// slots (order of first appearance — sorted keys keep equal hashkeys
+// adjacent, so the remap is monotone), key/value length columns and
+// the sortkey heap gather ragged, and the value heap subsets RAW or
+// inflate->gather->re-compress for ZLIB/ZSTD heaps (the compression
+// DECISION is inherited from the original block: a heap the encoder
+// stored raw stays raw — no probing). `new_ets` (nullable, original indexing)
+// replaces the TTL column; with `patch_value_headers` the 4-byte
+// big-endian expire_ts header at the start of every kept value is
+// rewritten to match (value_schema.h layout). This is the compaction
+// drop path: one GIL-free pass replaces Python's decode -> gather ->
+// re-encode round trip, whose many small numpy ops serialized the
+// whole thread pool on the GIL.
+//
+// The kernel also emits everything the SST writer needs to append the
+// result without re-parsing it on the GIL: per-kept-row crc64 full-key
+// hashes for the bloom build (`out_hashes`, nullable — computed
+// incrementally over header+dict+sortkey segments, no padded matrix),
+// the first/last kept keys (`out_keys`, 2*key_width bytes), and
+// `out_meta` = [kept_count, subset_raw_heap_len, first_key_len,
+// last_key_len].
+//
+// Returns bytes written into `out`, or -1 (malformed input /
+// out_cap too small), -2 (zlib unavailable for a deflated heap; the
+// caller must fall back), -3 (heap inflate/deflate failed).
+int64_t pegasus_cblock_subset(const uint8_t* raw, int64_t raw_len,
+                              const uint8_t* keep,
+                              const uint32_t* new_ets,
+                              int32_t patch_value_headers, uint8_t* out,
+                              int64_t out_cap, uint64_t* out_hashes,
+                              uint8_t* out_keys, int64_t* out_meta) {
+  if (raw_len < static_cast<int64_t>(sizeof(CBlkHdr))) return -1;
+  CBlkHdr h;
+  std::memcpy(&h, raw, sizeof(h));
+  const int64_t n = h.n;
+  // input section pointers
+  const uint8_t* p = raw + sizeof(CBlkHdr);
+  const uint32_t* in_ets = nullptr;
+  if (h.ets_mode != 0) {
+    in_ets = reinterpret_cast<const uint32_t*>(p);
+    p += 4 * n;
+  }
+  const uint32_t* in_hash = reinterpret_cast<const uint32_t*>(p);
+  p += 4 * n;
+  const uint32_t* in_doffs = reinterpret_cast<const uint32_t*>(p);
+  p += 4 * (static_cast<int64_t>(h.dict_n) + 1);
+  const uint8_t* in_klen = p;
+  p += h.klen_w * n;
+  const uint8_t* in_vlen = p;
+  p += h.vlen_w * n;
+  const uint8_t* in_idx = p;
+  p += h.idx_w * n;
+  const uint8_t* in_flags = nullptr;
+  if (h.flags_mode != 0) {
+    in_flags = p;
+    p += n;
+  }
+  const uint8_t* in_dict = p;
+  p += h.dict_bytes;
+  const uint8_t* in_sk = p;
+  p += h.sk_bytes;
+  const uint8_t* in_heap = p;
+  if (p + h.comp_heap > raw + raw_len) return -1;
+  const int64_t sentinel = (1LL << (8 * h.idx_w)) - 1;
+
+  // pass 1: survivor geometry + monotone dictionary remap
+  int64_t* remap = static_cast<int64_t*>(
+      malloc(sizeof(int64_t) * (h.dict_n + 1)));
+  if (remap == nullptr) return -1;
+  for (int64_t d = 0; d <= h.dict_n; ++d) remap[d] = -1;
+  int64_t m = 0, new_dict_n = 0, new_dict_bytes = 0, new_sk = 0,
+          vsub = 0;
+  bool any_ets = false, any_flags = false;
+  {
+    int64_t sk_off = 0;
+    for (int64_t i = 0; i < n; ++i) {
+      const int64_t kl = narrow_at(in_klen, h.klen_w, i);
+      const int64_t d = narrow_at(in_idx, h.idx_w, i);
+      const int64_t hk =
+          (d == sentinel) ? 0 : in_doffs[d + 1] - in_doffs[d];
+      const int64_t sl = (d == sentinel) ? kl : kl - 2 - hk;
+      if (keep[i] != 0) {
+        ++m;
+        if (d != sentinel && remap[d] < 0) {
+          remap[d] = new_dict_n++;
+          new_dict_bytes += hk;
+        }
+        new_sk += sl;
+        vsub += narrow_at(in_vlen, h.vlen_w, i);
+        const uint32_t e =
+            (new_ets != nullptr) ? new_ets[i]
+                                 : (in_ets != nullptr ? in_ets[i] : 0);
+        any_ets = any_ets || (e != 0);
+        any_flags = any_flags || (in_flags != nullptr && in_flags[i]);
+      }
+      sk_off += sl;
+    }
+    if (sk_off != static_cast<int64_t>(h.sk_bytes)) {
+      free(remap);
+      return -1;
+    }
+  }
+
+  // inflate the value heap if compressed (subsetting needs raw bytes)
+  const uint8_t* heap_raw = in_heap;
+  uint8_t* inflated = nullptr;
+  if (h.heap_mode == kHeapZlib || h.heap_mode == kHeapZstd) {
+    const bool is_zstd = (h.heap_mode == kHeapZstd);
+    if (is_zstd ? !zstd().ok() : !zlib().ok()) {
+      free(remap);
+      return -2;
+    }
+    inflated = static_cast<uint8_t*>(malloc(h.raw_heap ? h.raw_heap : 1));
+    if (inflated == nullptr) {
+      free(remap);
+      return -3;
+    }
+    bool bad;
+    if (is_zstd) {
+      const size_t got = zstd().decompress_(inflated, h.raw_heap,
+                                            in_heap, h.comp_heap);
+      bad = zstd().iserr_(got) != 0 || got != h.raw_heap;
+    } else {
+      unsigned long dst = h.raw_heap;
+      bad = zlib().uncompress_(inflated, &dst, in_heap, h.comp_heap) !=
+                0 ||
+            dst != h.raw_heap;
+    }
+    if (bad) {
+      free(inflated);
+      free(remap);
+      return -3;
+    }
+    heap_raw = inflated;
+  }
+
+  // output header + section layout
+  CBlkHdr oh = h;
+  oh.n = static_cast<uint32_t>(m);
+  oh.ets_mode = any_ets ? 4 : 0;
+  oh.flags_mode = any_flags ? 1 : 0;
+  oh.dict_n = static_cast<uint32_t>(new_dict_n);
+  oh.dict_bytes = static_cast<uint32_t>(new_dict_bytes);
+  oh.sk_bytes = static_cast<uint64_t>(new_sk);
+  oh.raw_heap = static_cast<uint64_t>(vsub);
+  const int64_t fixed = sizeof(CBlkHdr) + (any_ets ? 4 * m : 0) +
+                        4 * m + 4 * (new_dict_n + 1) + h.klen_w * m +
+                        h.vlen_w * m + h.idx_w * m + (any_flags ? m : 0) +
+                        new_dict_bytes + new_sk;
+  if (fixed + vsub > out_cap) {
+    free(inflated);
+    free(remap);
+    return -1;
+  }
+  uint8_t* q = out + sizeof(CBlkHdr);
+  uint32_t* out_ets =
+      any_ets ? reinterpret_cast<uint32_t*>(q) : nullptr;
+  if (any_ets) q += 4 * m;
+  uint32_t* out_hash = reinterpret_cast<uint32_t*>(q);
+  q += 4 * m;
+  uint32_t* out_doffs = reinterpret_cast<uint32_t*>(q);
+  q += 4 * (new_dict_n + 1);
+  uint8_t* out_klen = q;
+  q += h.klen_w * m;
+  uint8_t* out_vlen = q;
+  q += h.vlen_w * m;
+  uint8_t* out_idx = q;
+  q += h.idx_w * m;
+  uint8_t* out_flags = nullptr;
+  if (any_flags) {
+    out_flags = q;
+    q += m;
+  }
+  uint8_t* out_dict = q;
+  q += new_dict_bytes;
+  uint8_t* out_sk = q;
+  q += new_sk;
+  uint8_t* out_heap = q;  // raw subset lands here (ZLIB re-packs below)
+
+  // dictionary: entries in new-slot order
+  out_doffs[0] = 0;
+  for (int64_t d = 0; d < h.dict_n; ++d) {
+    const int64_t nd = remap[d];
+    if (nd < 0) continue;
+    const uint32_t len = in_doffs[d + 1] - in_doffs[d];
+    std::memcpy(out_dict + out_doffs[nd], in_dict + in_doffs[d], len);
+    out_doffs[nd + 1] = out_doffs[nd] + len;
+  }
+
+  // pass 2: gather survivors (+ bloom hashes and first/last keys)
+  {
+    int64_t j = 0, sk_off = 0, v_off = 0, osk = 0, ov = 0;
+    for (int64_t i = 0; i < n; ++i) {
+      const int64_t kl = narrow_at(in_klen, h.klen_w, i);
+      const int64_t d = narrow_at(in_idx, h.idx_w, i);
+      const int64_t hk =
+          (d == sentinel) ? 0 : in_doffs[d + 1] - in_doffs[d];
+      const int64_t sl = (d == sentinel) ? kl : kl - 2 - hk;
+      const int64_t vl = narrow_at(in_vlen, h.vlen_w, i);
+      if (keep[i] != 0) {
+        const uint32_t e =
+            (new_ets != nullptr) ? new_ets[i]
+                                 : (in_ets != nullptr ? in_ets[i] : 0);
+        if (out_ets != nullptr) out_ets[j] = e;
+        out_hash[j] = in_hash[i];
+        narrow_put(out_klen, h.klen_w, j, kl);
+        narrow_put(out_vlen, h.vlen_w, j, vl);
+        narrow_put(out_idx, h.idx_w, j,
+                   (d == sentinel) ? sentinel : remap[d]);
+        if (out_flags != nullptr)
+          out_flags[j] = (in_flags != nullptr) ? in_flags[i] : 0;
+        std::memcpy(out_sk + osk, in_sk + sk_off, sl);
+        std::memcpy(out_heap + ov, heap_raw + v_off, vl);
+        if (patch_value_headers != 0 && new_ets != nullptr && vl >= 4) {
+          out_heap[ov] = static_cast<uint8_t>(e >> 24);
+          out_heap[ov + 1] = static_cast<uint8_t>(e >> 16);
+          out_heap[ov + 2] = static_cast<uint8_t>(e >> 8);
+          out_heap[ov + 3] = static_cast<uint8_t>(e);
+        }
+        if (out_hashes != nullptr) {
+          // crc64 over the row's real key bytes, segment-chained
+          // (crc64(x, prev) continues prev thanks to the ~init/~final
+          // construction) — identical to crc64_rows over the padded
+          // matrix rows the writer would otherwise rebuild
+          uint64_t c;
+          if (d != sentinel) {
+            const uint8_t hdr2[2] = {static_cast<uint8_t>(hk >> 8),
+                                     static_cast<uint8_t>(hk & 0xFF)};
+            c = crc64(hdr2, 2, 0);
+            c = crc64(in_dict + in_doffs[d], hk, c);
+            c = crc64(in_sk + sk_off, sl, c);
+          } else {
+            c = crc64(in_sk + sk_off, sl, 0);
+          }
+          out_hashes[j] = c;
+        }
+        if (out_keys != nullptr && out_meta != nullptr) {
+          // overwrite the last-key slot on every kept row (the final
+          // survivor wins); the first row ALSO fills the first-key
+          // slot — a single-survivor subset must land in both
+          uint8_t* dst = out_keys + h.key_width;
+          if (d != sentinel) {
+            dst[0] = static_cast<uint8_t>(hk >> 8);
+            dst[1] = static_cast<uint8_t>(hk & 0xFF);
+            std::memcpy(dst + 2, in_dict + in_doffs[d], hk);
+            std::memcpy(dst + 2 + hk, in_sk + sk_off, sl);
+          } else {
+            std::memcpy(dst, in_sk + sk_off, sl);
+          }
+          if (j == 0) {
+            std::memcpy(out_keys, dst, kl);
+            out_meta[2] = kl;
+          }
+          out_meta[3] = kl;
+        }
+        osk += sl;
+        ov += vl;
+        ++j;
+      }
+      sk_off += sl;
+      v_off += vl;
+    }
+  }
+  if (out_meta != nullptr) {
+    out_meta[0] = m;
+    out_meta[1] = vsub;
+  }
+  free(inflated);
+  free(remap);
+
+  int64_t stored = vsub;
+  oh.heap_mode = kHeapRaw;
+  if (h.heap_mode != kHeapRaw && vsub > 0) {
+    // the original encoder proved this heap compressible; re-compress
+    // the subset and keep it when it still clears the 5% bar. zstd
+    // when resolvable (even if the input heap was zlib — compaction
+    // migrates old heaps forward), zlib otherwise.
+    if (zstd().ok()) {
+      const size_t bound = zstd().bound_(vsub);
+      uint8_t* comp = static_cast<uint8_t*>(malloc(bound));
+      if (comp != nullptr) {
+        const size_t clen =
+            zstd().compress_(comp, bound, out_heap, vsub, kZstdLevel);
+        if (zstd().iserr_(clen) == 0 &&
+            static_cast<int64_t>(clen) < (vsub * 95) / 100) {
+          std::memcpy(out_heap, comp, clen);
+          stored = static_cast<int64_t>(clen);
+          oh.heap_mode = kHeapZstd;
+        }
+        free(comp);
+      }
+    } else if (zlib().ok()) {
+      unsigned long bound = zlib().bound_(vsub);
+      uint8_t* comp = static_cast<uint8_t*>(malloc(bound));
+      if (comp != nullptr) {
+        unsigned long clen = bound;
+        if (zlib().compress2_(comp, &clen, out_heap, vsub,
+                              kZlibLevel) == 0 &&
+            static_cast<int64_t>(clen) < (vsub * 95) / 100) {
+          std::memcpy(out_heap, comp, clen);
+          stored = static_cast<int64_t>(clen);
+          oh.heap_mode = kHeapZlib;
+        }
+        free(comp);
+      }
+    }
+  }
+  oh.comp_heap = static_cast<uint64_t>(stored);
+  std::memcpy(out, &oh, sizeof(oh));
+  return fixed + stored;
 }
 
 // Gather `m` selected rows of a columnar block into a packed response

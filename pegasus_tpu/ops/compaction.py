@@ -148,6 +148,33 @@ def make_compaction_eval(operations=None):
     return eval_block
 
 
+def encoded_drop_mask(enc, now: int, default_ttl: int, pidx: int,
+                      partition_version: int, validate_hash: bool,
+                      want_ets: bool = True):
+    """(drop bool[n], new_ets|None) for one ENCODED block — the
+    direct-compute twin of the jitted eval_block for rulesets that
+    touch no key bytes (no user rules): the TTL + default-TTL rewrite
+    reads the raw `expire_ts` column and the stale-split check reads
+    the raw `hash_lo` column, so a compressed block's drop mask costs
+    zero key decode, zero value-heap inflate, and zero device
+    dispatch. Semantics match eval_block exactly (valid is all-True
+    for SST-origin blocks, as compaction_eval_submit stamps it)."""
+    ets = np.asarray(enc.expire_ts)
+    if default_ttl:
+        new_ets = np.where(ets == 0,
+                           np.uint32((now + default_ttl) & 0xFFFFFFFF),
+                           ets)
+    else:
+        new_ets = ets
+    now32 = np.uint32(now & 0xFFFFFFFF)
+    drop = (new_ets > 0) & (new_ets <= now32)
+    if validate_hash:
+        pv = np.uint32(max(partition_version, 0) & 0xFFFFFFFF)
+        drop = drop | ((np.asarray(enc.hash_lo) & pv)
+                       != np.uint32(pidx & 0xFFFFFFFF))
+    return drop, (new_ets if want_ets else None)
+
+
 COMPACT_CHUNK_ROWS = 1 << 18  # 256k records per stacked program
 
 

@@ -275,6 +275,85 @@ def host_alive_mask(expire_ts: np.ndarray, now: int) -> np.ndarray:
     return ~((ets > 0) & (ets <= np.uint32(now)))
 
 
+# direct compute on compressed blocks: probes answered from the encoded
+# representation, with zero key-matrix rebuild and zero device dispatch
+from pegasus_tpu.utils.metrics import METRICS as _METRICS  # noqa: E402
+
+_ENCODED_PROBE = _METRICS.entity("storage", "node").relaxed_counter(
+    "encoded_probe_count")
+
+
+def _region_filter_host(heap: np.ndarray, offs: np.ndarray,
+                        filter_type: int, pattern: bytes) -> np.ndarray:
+    """bool[n] pattern match over ragged byte regions
+    heap[offs[i]:offs[i+1]] — native kernel when available, scalar
+    host_match_filter loop otherwise. Device-kernel semantics: empty
+    pattern matches everything; region shorter than pattern never
+    matches."""
+    from pegasus_tpu import native
+
+    n = len(offs) - 1
+    if filter_type == FT_NO_FILTER or not pattern:
+        return np.ones(n, dtype=bool)
+    fn = native.region_filter_fn()
+    if fn is not None:
+        out = np.empty(n, dtype=np.uint8)
+        fn(np.ascontiguousarray(heap),
+           np.ascontiguousarray(offs, dtype=np.int64), n, pattern,
+           filter_type, out)
+        return out.astype(bool)
+    hv = np.asarray(heap)
+    return np.fromiter(
+        (host_match_filter(hv[offs[i]:offs[i + 1]].tobytes(),
+                           filter_type, pattern) for i in range(n)),
+        dtype=bool, count=n)
+
+
+def encoded_static_keep(enc, validate_hash: bool, pidx: int,
+                        partition_version: int,
+                        filter_key) -> Optional[np.ndarray]:
+    """bool[n] static keep mask of an EncodedBlock
+    (storage/block_codec.py), bit-identical to
+    `static_block_predicate` over the decoded block — evaluated
+    entirely on the HOST against the encoded representation:
+
+    - partition-hash validation reads the raw `hash_lo` column;
+    - the hashkey filter evaluates once per DICTIONARY entry (D unique
+      hashkeys, not n rows) and gathers per-row through the index
+      column;
+    - the sortkey filter runs over the packed sortkey heap (no padded
+      key matrix, no zero-byte scanning).
+
+    Returns None when the block cannot take this path (malformed rows
+    present — the device kernel's hashkey_len semantics differ there).
+    TTL stays the caller's per-second host mask, exactly as on the
+    device path (static masks are `now`-independent).
+    """
+    if enc.has_malformed:
+        return None
+    n = enc.n
+    hft, hfp, sft, sfp = filter_key
+    if validate_hash and (partition_version < 0
+                          or pidx > partition_version):
+        # split-safety reject-all gate, mirroring static_block_predicate
+        _ENCODED_PROBE.increment()
+        return np.zeros(n, dtype=bool)
+    keep = np.asarray(enc.key_len) >= 2
+    if validate_hash:
+        pv = np.uint32(partition_version & 0xFFFFFFFF)
+        keep = keep & ((np.asarray(enc.hash_lo) & pv)
+                       == np.uint32(pidx))
+    if hft != FT_NO_FILTER and hfp:
+        do = np.asarray(enc.dict_offs, dtype=np.int64)
+        per_dict = _region_filter_host(enc.dict_heap, do, hft, hfp)
+        keep = keep & per_dict[enc.hk_idx]
+    if sft != FT_NO_FILTER and sfp:
+        keep = keep & _region_filter_host(enc.sk_heap, enc.sk_offs,
+                                          sft, sfp)
+    _ENCODED_PROBE.increment()
+    return keep
+
+
 def pad_probe_keys(probe_keys, width: int):
     """(uint8[P, width] padded rows, int64[P] lengths) for a batch of
     exact-match probe keys. Keys longer than `width` cannot exist in a

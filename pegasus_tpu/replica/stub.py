@@ -1448,6 +1448,25 @@ class ReplicaStub:
             try:
                 results = scan_multi(
                     [(srv, reqs) for _i, srv, reqs in ok_servers], now)
+            except (StorageCorruptionError, OSError) as e:
+                # one member's store is corrupt (a scan-path block or
+                # encoded-probe crc failed): its slot gets the typed
+                # code (and the replica quarantines); healthy neighbors
+                # get retryable INVALID_STATE — their work was lost
+                # with the shared evaluation, not their data
+                bad = (self._replica_for_path(e.path)
+                       if isinstance(e, StorageCorruptionError) else None)
+                code = self._on_storage_error(bad, e)
+                for slot_i, srv, reqs in ok_servers:
+                    hit = bad is not None and \
+                        (srv.app_id, srv.pidx) == bad
+                    errs = []
+                    for _req in reqs:
+                        resp = ScanResponse()
+                        resp.error = (code if (hit or bad is None)
+                                      else int(ErrorCode.ERR_INVALID_STATE))
+                        errs.append(resp)
+                    slots[slot_i] = (slots[slot_i][0], errs)
             except ValueError as e:
                 # malformed request: a DEFINITE reply, not a dropped one
                 # (retrying a deterministic failure helps no one)

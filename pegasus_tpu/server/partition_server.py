@@ -80,7 +80,11 @@ from pegasus_tpu.server.write_service import WriteService
 
 from pegasus_tpu.storage.bloom import bloom_probe_enabled
 from pegasus_tpu.storage.engine import StorageEngine
-from pegasus_tpu.utils.errors import ErrorCode, StorageStatus
+from pegasus_tpu.utils.errors import (
+    ErrorCode,
+    StorageCorruptionError,
+    StorageStatus,
+)
 from pegasus_tpu.utils.metrics import METRICS
 
 # the no-filter flavor's mask key component (and the normal form of any
@@ -1900,10 +1904,52 @@ class PartitionServer:
                     keep_masks[ckey] = cached
                     continue
                 misses[ckey] = (run, bm, blk)
-        for ckey, (run, bm, blk) in misses.items():
+        pv = self.partition_version
+        encoded_resolved = []
+        for ckey, (run, bm, blk) in list(misses.items()):
+            # direct compute on compressed blocks: the static keep
+            # (hash validation + hashkey/sortkey filters) evaluates
+            # host-side against the ENCODED representation — the
+            # hashkey filter once per dictionary entry, the sortkey
+            # filter over the packed heap — so a compressed block's
+            # first-touch mask costs no device round-trip at all
+            keep = self._encoded_static_mask(run, bm, validate,
+                                             filter_key, pv)
+            if keep is not None:
+                keep_masks[ckey] = keep
+                encoded_resolved.append((ckey, keep))
+                del misses[ckey]
+                continue
             misses[ckey] = self._device_cached_block(ckey, blk)
+        for ckey, keep in encoded_resolved:
+            self.store_mask_for(ckey, validate, filter_key, keep,
+                                computed_pv=pv)
         state["cached_keep"] = keep_masks
         return misses
+
+    def _encoded_static_mask(self, run, bm, validate: bool, filter_key,
+                             pv: int):
+        """bool[n] static keep of one planned block via the encoded
+        probe (ops/predicates.encoded_static_keep), or None when the
+        run is uncompressed / the block can't take the path."""
+        if getattr(run, "codec", None) is None:
+            return None
+        from pegasus_tpu.ops.predicates import encoded_static_keep
+
+        try:
+            enc = run.read_block_encoded(run.block_index(bm))
+        except (StorageCorruptionError, OSError):
+            # the probe's raw re-read DETECTED on-disk corruption:
+            # escalate into the PR 5 quarantine/re-learn loop — falling
+            # back to a stale cached decode would serve while hiding a
+            # known-corrupt file until the next scrub pass
+            raise
+        except Exception:  # noqa: BLE001 - run replaced mid-plan: the
+            return None    # device path serves from the decoded block
+        if enc is None:
+            return None
+        return encoded_static_keep(enc, validate, self.pidx, pv,
+                                   filter_key)
 
     def _register_flavor(self, validate: bool, filter_key,
                          wall: float) -> None:

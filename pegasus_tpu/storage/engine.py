@@ -288,6 +288,7 @@ class StorageEngine:
             choose_eval_device,
             compaction_eval_drain,
             compaction_eval_submit,
+            encoded_drop_mask,
             rules_workload,
         )
 
@@ -310,13 +311,30 @@ class StorageEngine:
 
         def submit(off):
             window = entries[off:off + WINDOW]
-            blocks = [((run, i), run.read_block(i), pidx)
-                      for run, i, _bm in window]
+            blocks = []
+            host_done = {}
+            for run, i, _bm in window:
+                # direct compute on compressed blocks: a ruleset that
+                # touches no key bytes (TTL + default-TTL rewrite +
+                # stale-split) evaluates straight off the encoded
+                # block's raw expire_ts/hash_lo columns — no key-matrix
+                # rebuild, no value-heap inflate, no device program;
+                # unchanged blocks then copy verbatim in the rewrite
+                if operations is None \
+                        and getattr(run, "codec", None) is not None:
+                    enc = run.read_block_encoded(i)
+                    host_done[(run, i)] = (enc, encoded_drop_mask(
+                        enc, now_s, default_ttl, pidx,
+                        partition_version, do_validate,
+                        want_ets=ttl_may_change))
+                    continue
+                blocks.append(((run, i), run.read_block(i), pidx))
             pend = compaction_eval_submit(
                 blocks, now_s, default_ttl, partition_version,
                 do_validate, operations=operations,
-                eval_device=eval_device, want_ets=ttl_may_change)
-            return window, blocks, pend
+                eval_device=eval_device,
+                want_ets=ttl_may_change) if blocks else []
+            return window, blocks, pend, host_done
 
         def results():
             # one-window lookahead: while window w's masks drain and its
@@ -326,7 +344,7 @@ class StorageEngine:
             ahead = submit(0) if entries else None
             off = WINDOW
             while ahead is not None:
-                window, blocks, pend = ahead
+                window, blocks, pend, host_done = ahead
                 ahead = submit(off) if off < len(entries) else None
                 off += WINDOW
                 got = {}
@@ -335,6 +353,11 @@ class StorageEngine:
                     got[tag] = (drop, new_ets)
                 by_tag = {tag: blk for tag, blk, _p in blocks}
                 for run, i, _bm in window:
+                    hd = host_done.get((run, i))
+                    if hd is not None:
+                        enc, (drop, new_ets) = hd
+                        yield run, i, enc, drop, new_ets
+                        continue
                     drop, new_ets = got[(run, i)]
                     yield run, i, by_tag[(run, i)], drop, new_ets
 

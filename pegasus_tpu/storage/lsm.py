@@ -35,6 +35,7 @@ from typing import Callable, Iterator, List, Optional, Tuple
 import numpy as np
 
 from pegasus_tpu.base.crc import crc64
+from pegasus_tpu.storage.block_codec import CODEC_NONE, EncodedBlock
 from pegasus_tpu.storage.bloom import bloom_probe_enabled
 from pegasus_tpu.storage.memtable import Memtable, TOMBSTONE
 from pegasus_tpu.storage.sstable import (
@@ -568,6 +569,9 @@ class LSMStore:
             finishing_writers.append(w)
             finishing.append(finish_pool.submit(_finish_one, w))
 
+        from pegasus_tpu import native
+
+        cblock_subset = native.cblock_subset_fn()
         writer: Optional[SSTableWriter] = None
         written_in_run = 0
         ok = False
@@ -595,7 +599,18 @@ class LSMStore:
         try:
             for run, idx, blk, drop, new_ets in per_block:
                 dropped = bool(drop.any())
+                encoded = isinstance(blk, EncodedBlock)
                 if not dropped and not ttl_may_change:
+                    if encoded:
+                        w = roll_writer()
+                        if w.codec != CODEC_NONE:
+                            # untouched compressed block: the on-disk
+                            # bytes copy VERBATIM — no heap inflate, no
+                            # re-encode, no re-deflate
+                            w.add_block_encoded(blk)
+                            written_in_run += blk.count
+                            continue
+                        blk = blk.decode()  # codec turned off mid-store
                     copy_block(blk)
                     continue
                 n = blk.count
@@ -603,8 +618,47 @@ class LSMStore:
                                and not np.array_equal(new_ets,
                                                       blk.expire_ts))
                 if not dropped and not ets_changed:
+                    if encoded:
+                        w = roll_writer()
+                        if w.codec != CODEC_NONE:
+                            w.add_block_encoded(blk)
+                            written_in_run += blk.count
+                            continue
+                        blk = blk.decode()
                     copy_block(blk)
                     continue
+                if encoded:
+                    # survivor check BEFORE roll_writer: instantiating
+                    # a writer for a fully-dropped block would publish
+                    # an empty L1 run when every block drops every row
+                    keep = ~drop
+                    keep &= np.asarray(blk.flags) == 0
+                    if not keep.any():
+                        continue
+                    w = roll_writer()
+                    if w.codec != CODEC_NONE and cblock_subset is not None:
+                        # rows drop (or TTLs rewrite): subset the block
+                        # in the ENCODED domain — one GIL-free native
+                        # pass (dict remap + ragged gathers + heap
+                        # inflate/re-deflate) instead of the Python
+                        # decode -> gather -> re-encode round trip that
+                        # serialized the compaction thread pool
+                        res = cblock_subset(
+                            blk.raw, blk.raw_heap_len, blk.key_width,
+                            keep, new_ets if ets_changed else None,
+                            ets_changed and patch_headers,
+                            want_hashes=w.bloom_enabled)
+                        if res is not None:
+                            buf, hashes, m, vsub, fk, lk = res
+                            w.add_block_encoded_raw(
+                                buf, m, blk.key_width, vsub, fk, lk,
+                                hashes)
+                            written_in_run += m
+                            continue
+                    # native kernel unavailable (or codec flipped off
+                    # mid-store): materialize once and take the
+                    # vectorized gather path below
+                    blk = blk.decode()
                 keep = ~drop
                 if blk.flags is not None:
                     keep &= blk.flags == 0  # tombstones never stay

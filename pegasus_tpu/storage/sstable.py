@@ -41,6 +41,13 @@ from pegasus_tpu.storage.vfs import fsync_dir, fsync_file, open_data_file
 
 from pegasus_tpu.base.crc import crc32, crc64_batch, crc64_rows
 from pegasus_tpu.ops.record_block import next_bucket
+from pegasus_tpu.storage.block_codec import (
+    CODEC_NONE,
+    KNOWN_CODECS,
+    EncodedBlock,
+    encode_block,
+    raw_block_size,
+)
 from pegasus_tpu.storage.bloom import (
     BloomFilter,
     bloom_build_bits,
@@ -56,9 +63,37 @@ define_flag("pegasus.storage", "block_crc", True,
             "cached hits already paid); files written without block "
             "CRCs keep serving unverified", mutable=True)
 
+define_flag("pegasus.storage", "block_codec", "dcz",
+            "per-block compression codec stamped into new SST files "
+            "at every writer finish site (flush / merge-compact / "
+            "bulk-compact / ingest): 'dcz' = dictionary-coded hashkey "
+            "column + packed sortkeys + compressed value heap (zstd-1, "
+            "zlib-1 fallback) with direct "
+            "compute on the encoded form; 'none' = the legacy raw "
+            "columnar layout, bit-for-bit. Files written before this "
+            "flag existed (or with an unknown codec) keep serving / "
+            "are refused at open respectively", mutable=True)
+
+define_flag("pegasus.storage", "block_cache_bytes", 33_554_432,
+            "per-table decoded-block cache budget in bytes (LRU). "
+            "Replaces the old fixed 256-block count cap: compressed "
+            "blocks decode into real allocations of wildly varying "
+            "size, so only a byte budget bounds memory", mutable=True)
+
 
 def block_crc_enabled() -> bool:
     return bool(FLAGS.get("pegasus.storage", "block_crc"))
+
+
+def block_codec() -> str:
+    codec = str(FLAGS.get("pegasus.storage", "block_codec"))
+    if codec != CODEC_NONE and codec not in KNOWN_CODECS:
+        raise ValueError(f"unknown block_codec {codec!r}")
+    return codec
+
+
+def block_cache_budget() -> int:
+    return int(FLAGS.get("pegasus.storage", "block_cache_bytes"))
 
 
 # Block checksums use zlib's slice-by-8 CRC-32 (~1 GB/s) rather than
@@ -79,6 +114,12 @@ _STORAGE_METRICS = METRICS.entity("storage", "node")
 _BLOCK_CACHE_HIT = _STORAGE_METRICS.relaxed_counter("block_cache_hit")
 _BLOCK_CACHE_MISS = _STORAGE_METRICS.relaxed_counter("block_cache_miss")
 _BLOOM_USEFUL = _STORAGE_METRICS.relaxed_counter("bloom_useful_count")
+# codec observability: how often the read path pays a full decode of a
+# compressed block, and how many bytes the byte-capped cache evicts
+_COMPRESSED_DECODE = _STORAGE_METRICS.relaxed_counter(
+    "compressed_block_decode_count")
+_BLOCK_EVICT_BYTES = _STORAGE_METRICS.relaxed_counter(
+    "block_cache_evict_bytes")
 
 MAGIC = b"PGT2"
 MAGIC_V1 = b"PGT1"  # pre-hash_lo format, still readable
@@ -106,10 +147,16 @@ class BlockMeta:
 
 
 class Block:
-    """A decoded columnar block; arrays are views over the file bytes\n    (plus, for blocks that prove hot, one lazily materialized Python\n    key list — see key_list())."""
+    """A decoded columnar block; arrays are views over the file bytes\n    (plus, for blocks that prove hot, one lazily materialized Python\n    key list — see key_list()).
+
+    Blocks decoded from COMPRESSED files may carry their value heap as
+    a zero-arg thunk: the heap decompression runs on first value access, so
+    key-only work (point probes, bloom builds, fence walks, no-value
+    scans) over a compressed block never pays the heap decode —
+    materialization is deferred to the rows that actually serve."""
 
     __slots__ = ("keys", "key_len", "expire_ts", "hash_lo", "flags",
-                 "value_offs", "value_heap", "_key_list", "_gets",
+                 "value_offs", "_vh", "_key_list", "_gets",
                  "_nat", "_cmp", "_probe")
 
     def __init__(self, keys, key_len, expire_ts, hash_lo, flags, value_offs,
@@ -123,7 +170,14 @@ class Block:
         self.hash_lo = hash_lo        # uint32[N]
         self.flags = flags            # uint8[N]
         self.value_offs = value_offs  # uint32[N+1]
-        self.value_heap = value_heap  # uint8[heap] (zero-copy file view)
+        self._vh = value_heap         # uint8[heap] view, or lazy thunk
+
+    @property
+    def value_heap(self):
+        vh = self._vh
+        if callable(vh):
+            vh = self._vh = vh()
+        return vh
 
     @property
     def count(self) -> int:
@@ -195,9 +249,15 @@ class SSTableWriter:
         # built at finish(); bits-per-key is latched HERE so a mutable
         # flag flip mid-write cannot tear one table's filter
         self._bloom_bits_per_key = bloom_build_bits()
+        self.bloom_enabled = self._bloom_bits_per_key > 0
         # block-checksum latch, same reasoning: one table is either
         # fully checksummed or fully legacy, never mixed
         self._block_crc = block_crc_enabled()
+        # codec latch: one file is wholly one codec (the index names it
+        # once); a mutable flag flip mid-write cannot tear a table
+        self.codec = block_codec()
+        self._codec_raw_bytes = 0     # logical (raw-format) bytes
+        self._codec_stored_bytes = 0  # bytes actually written
         self._key_hashes: List[np.ndarray] = []
         if async_io:
             import queue
@@ -288,10 +348,16 @@ class SSTableWriter:
         # eight, and a single unit for the async-IO queue — and the one
         # pass the end-to-end block checksum rides (crc32 over exactly
         # the bytes that hit the disk)
-        buf = b"".join((
-            _BLOCK_HDR.pack(n, width, len(heap)), keys.tobytes(),
-            key_len.tobytes(), ets.tobytes(), hash_lo.tobytes(),
-            flags.tobytes(), offs.tobytes(), heap))
+        if self.codec == CODEC_NONE:
+            buf = b"".join((
+                _BLOCK_HDR.pack(n, width, len(heap)), keys.tobytes(),
+                key_len.tobytes(), ets.tobytes(), hash_lo.tobytes(),
+                flags.tobytes(), offs.tobytes(), heap))
+        else:
+            buf = encode_block(keys, key_len, ets, hash_lo, flags,
+                               offs, heap)
+            self._codec_raw_bytes += raw_block_size(n, width, len(heap))
+            self._codec_stored_bytes += len(buf)
         self._write(buf)
         self._blocks.append(BlockMeta(
             offset=offset, size=self._offset - offset, count=n,
@@ -317,20 +383,85 @@ class SSTableWriter:
         if self._bloom_bits_per_key > 0:
             self._key_hashes.append(crc64_rows(keys, key_len))
         offset = self._offset
-        buf = b"".join((
-            _BLOCK_HDR.pack(n, width, len(heap)),
-            np.ascontiguousarray(keys, dtype=np.uint8).tobytes(),
-            np.ascontiguousarray(key_len, dtype=np.int32).tobytes(),
-            np.ascontiguousarray(ets, dtype=np.uint32).tobytes(),
-            np.ascontiguousarray(hash_lo, dtype=np.uint32).tobytes(),
-            np.ascontiguousarray(flags, dtype=np.uint8).tobytes(),
-            np.ascontiguousarray(value_offs, dtype=np.uint32).tobytes(),
-            heap))
+        if self.codec == CODEC_NONE:
+            buf = b"".join((
+                _BLOCK_HDR.pack(n, width, len(heap)),
+                np.ascontiguousarray(keys, dtype=np.uint8).tobytes(),
+                np.ascontiguousarray(key_len, dtype=np.int32).tobytes(),
+                np.ascontiguousarray(ets, dtype=np.uint32).tobytes(),
+                np.ascontiguousarray(hash_lo, dtype=np.uint32).tobytes(),
+                np.ascontiguousarray(flags, dtype=np.uint8).tobytes(),
+                np.ascontiguousarray(value_offs,
+                                     dtype=np.uint32).tobytes(),
+                heap))
+        else:
+            buf = encode_block(keys, key_len, ets, hash_lo, flags,
+                               value_offs, heap)
+            self._codec_raw_bytes += raw_block_size(n, width, len(heap))
+            self._codec_stored_bytes += len(buf)
         self._write(buf)
         self._blocks.append(BlockMeta(
             offset=offset, size=self._offset - offset, count=n,
             key_width=width, first_key=first_key, last_key=last_key,
             crc=_block_crc32(buf) if self._block_crc else None))
+        self._count += n
+        self._last_key = last_key
+
+    def add_block_encoded(self, enc: EncodedBlock) -> None:
+        """Append an ALREADY-ENCODED block verbatim — bulk compaction's
+        untouched-block fast path on compressed stores: the on-disk
+        bytes stream straight to the output with no value-heap inflate,
+        no re-encode, and no re-deflate; only the bloom filter's
+        full-key hashes re-derive (from the cheap key-matrix rebuild,
+        which never touches the heap)."""
+        if self.codec == CODEC_NONE:
+            raise ValueError("writer codec is 'none'; encoded blocks "
+                             "must decode first")
+        n = enc.n
+        if n == 0:
+            return
+        self._flush_block()
+        first_key = enc.key_at(0)
+        last_key = enc.key_at(n - 1)
+        if self._last_key is not None and first_key <= self._last_key:
+            raise ValueError("blocks must be added in key order")
+        buf = enc.raw if isinstance(enc.raw, bytes) else bytes(enc.raw)
+        hashes = (crc64_rows(enc.key_matrix(), enc.key_len)
+                  if self._bloom_bits_per_key > 0 else None)
+        self.add_block_encoded_raw(buf, n, enc.key_width,
+                                   enc.raw_heap_len, first_key,
+                                   last_key, hashes)
+
+    def add_block_encoded_raw(self, buf: bytes, n: int, key_width: int,
+                              raw_heap_len: int, first_key: bytes,
+                              last_key: bytes, key_hashes) -> None:
+        """Append pre-encoded block bytes with the metadata the index
+        needs already in hand — the native subset kernel's exit
+        (pegasus_cblock_subset emits the bloom hashes and fence keys
+        in its gather pass, so nothing here re-parses the block on the
+        GIL)."""
+        if self.codec == CODEC_NONE:
+            raise ValueError("writer codec is 'none'; encoded blocks "
+                             "must decode first")
+        if n == 0:
+            return
+        self._flush_block()
+        if self._last_key is not None and first_key <= self._last_key:
+            raise ValueError("blocks must be added in key order")
+        if self._bloom_bits_per_key > 0:
+            if key_hashes is None:
+                raise ValueError("bloom build needs key hashes")
+            self._key_hashes.append(key_hashes)
+        offset = self._offset
+        self._write(buf)
+        self._blocks.append(BlockMeta(
+            offset=offset, size=len(buf), count=n,
+            key_width=key_width, first_key=first_key,
+            last_key=last_key,
+            crc=_block_crc32(buf) if self._block_crc else None))
+        self._codec_raw_bytes += raw_block_size(n, key_width,
+                                                raw_heap_len)
+        self._codec_stored_bytes += len(buf)
         self._count += n
         self._last_key = last_key
 
@@ -348,6 +479,16 @@ class SSTableWriter:
             "meta": self._meta,
             "total_count": self._count,
         }
+        if self.codec != CODEC_NONE:
+            # format versioning exactly like the PR 5 block CRC: the
+            # codec is named once per file; readers without the codec
+            # refuse at open (never misparse), and codec=none files
+            # stay bit-for-bit the legacy layout (no key at all)
+            index["codec"] = self.codec
+            index["codec_stats"] = {
+                "raw_bytes": self._codec_raw_bytes,
+                "stored_bytes": self._codec_stored_bytes,
+            }
         if self._key_hashes:
             # bloom section sits between the data blocks and the index;
             # the index names its offset/geometry, so pre-filter readers
@@ -385,14 +526,16 @@ class SSTableWriter:
 
 
 class SSTable:
-    """Reader with an in-memory index and a small block cache."""
+    """Reader with an in-memory index and a byte-capped block cache."""
 
-    def __init__(self, path: str, cache_blocks: int = 256) -> None:
-        # cache_blocks raised 64->256 for the point-read hot path: a
-        # decoded Block is zero-copy numpy views over the mmap (only
-        # encrypted stores pay real bytes), but an evicted block loses
-        # its lazily-built probe/key-list tables — zipfian point traffic
-        # over a ~256k-record run was thrashing exactly that
+    def __init__(self, path: str,
+                 cache_bytes: Optional[int] = None) -> None:
+        # the decoded-block cache is BYTE-capped (LRU, like the node
+        # row cache): a raw-file Block is zero-copy numpy views over
+        # the mmap and charges only bookkeeping, but a block decoded
+        # from a COMPRESSED file is a real allocation whose size the
+        # old fixed 256-block count cap could not see. `cache_bytes`
+        # None -> the mutable [pegasus.storage] block_cache_bytes flag.
         import io as _io
         import mmap as _mmap
 
@@ -440,6 +583,16 @@ class SSTable:
         ]
         self.meta: dict = index.get("meta", {})
         self.total_count: int = index.get("total_count", 0)
+        # per-file codec negotiation: legacy files carry no key and
+        # serve the raw layout unmodified; a codec this build does not
+        # know is REFUSED at open (a misparse would serve garbage)
+        codec = index.get("codec")
+        if codec is not None and codec not in KNOWN_CODECS:
+            raise StorageCorruptionError(
+                path, f"unsupported block codec {codec!r} "
+                      f"(known: {', '.join(KNOWN_CODECS)})")
+        self.codec: Optional[str] = codec
+        self.codec_stats: Optional[dict] = index.get("codec_stats")
         # pre-filter files simply miss the "bloom" entry and degrade to
         # the unfiltered path (may_contain == always True)
         self.bloom: Optional[BloomFilter] = None
@@ -453,8 +606,18 @@ class SSTable:
             self.bloom = BloomFilter.from_bytes(raw, bl["m"], bl["k"])
         from collections import OrderedDict as _OD
 
-        self._cache: "_OD[int, Block]" = _OD()
-        self._cache_cap = cache_blocks
+        import threading
+
+        # idx -> (Block, charged_bytes); bytes tracked alongside so
+        # eviction never recomputes sizes. Insert/evict accounting runs
+        # under a lock: serving and compaction threads share run caches,
+        # and an interleaved += / -= on _cache_bytes would drift the
+        # budget for the file's whole lifetime (hits stay lock-free)
+        self._cache: "_OD[int, Tuple[Block, int]]" = _OD()
+        self._cache_bytes = 0
+        self._cache_lock = threading.Lock()
+        self._cache_budget = cache_bytes  # None -> flag at use
+        self._off2idx: Optional[dict] = None  # block_index lookup
         self._last_keys: Optional[List[bytes]] = None  # iter_blocks bisect
         # fence columns as plain attributes: the block list is immutable
         # for the file's lifetime, and the point-read planner compares
@@ -467,6 +630,13 @@ class SSTable:
 
     def close(self) -> None:
         self._f.close()
+
+    def clear_block_cache(self) -> None:
+        """Drop every decoded block (and its byte accounting) — tests
+        and cache-pressure tooling; the serving path never needs it."""
+        with self._cache_lock:
+            self._cache.clear()
+            self._cache_bytes = 0
 
     def may_contain(self, key: bytes, key_hash: Optional[int] = None
                     ) -> bool:
@@ -483,21 +653,9 @@ class SSTable:
             _BLOOM_USEFUL.increment()
         return hit
 
-    def read_block(self, idx: int) -> Block:
-        blk = self._cache.get(idx)
-        if blk is not None:
-            # true LRU: a hit refreshes recency (the old FIFO eviction
-            # popped insertion order, so resident-forever hot blocks
-            # were evicted by any cold streak)
-            try:
-                self._cache.move_to_end(idx)
-            except KeyError:
-                pass  # raced a concurrent eviction (serving vs
-                # compaction threads share run caches); the decoded
-                # block in hand stays valid
-            _BLOCK_CACHE_HIT.increment()
-            return blk
-        _BLOCK_CACHE_MISS.increment()
+    def _read_raw_block(self, idx: int):
+        """(raw bytes of block `idx`, its BlockMeta), crc-verified —
+        the shared cold-read step of decode / encoded-probe paths."""
         bm = self.blocks[idx]
         if self._mv is not None:
             raw = self._mv[bm.offset:bm.offset + bm.size]
@@ -512,30 +670,108 @@ class SSTable:
                 self.path,
                 f"block {idx} crc mismatch (offset {bm.offset}, "
                 f"{bm.size} bytes)")
-        n, width, heap_size = _BLOCK_HDR.unpack_from(raw, 0)
-        pos = _BLOCK_HDR.size
-        keys = np.frombuffer(raw, dtype=np.uint8, count=n * width,
-                             offset=pos).reshape(n, width)
-        pos += n * width
-        key_len = np.frombuffer(raw, dtype=np.int32, count=n, offset=pos)
-        pos += 4 * n
-        ets = np.frombuffer(raw, dtype=np.uint32, count=n, offset=pos)
-        pos += 4 * n
-        if self._has_hash_lo:
-            hash_lo = np.frombuffer(raw, dtype=np.uint32, count=n, offset=pos)
-            pos += 4 * n
+        return raw, bm
+
+    def read_block_encoded(self, idx: int) -> Optional[EncodedBlock]:
+        """The ENCODED form of block `idx` (predicate columns parsed,
+        key matrix and value heap untouched) — the direct-compute entry
+        point for compaction drop masks and scan probes. None for
+        uncompressed files. No cache: callers stream sequentially or
+        probe once per (block, flavor) miss, and parsing is a handful
+        of section views."""
+        if self.codec is None:
+            return None
+        raw, _bm = self._read_raw_block(idx)
+        return EncodedBlock.parse(raw)
+
+    def block_index(self, bm: BlockMeta) -> int:
+        """BlockMeta -> its position (offset-keyed; block offsets are
+        unique and immutable for the file's lifetime)."""
+        o2i = self._off2idx
+        if o2i is None:
+            o2i = self._off2idx = {
+                b.offset: i for i, b in enumerate(self.blocks)}
+        return o2i[bm.offset]
+
+    def read_block(self, idx: int) -> Block:
+        hit = self._cache.get(idx)
+        if hit is not None:
+            # true LRU: a hit refreshes recency (the old FIFO eviction
+            # popped insertion order, so resident-forever hot blocks
+            # were evicted by any cold streak)
+            try:
+                self._cache.move_to_end(idx)
+            except KeyError:
+                pass  # raced a concurrent eviction (serving vs
+                # compaction threads share run caches); the decoded
+                # block in hand stays valid
+            _BLOCK_CACHE_HIT.increment()
+            return hit[0]
+        _BLOCK_CACHE_MISS.increment()
+        raw, bm = self._read_raw_block(idx)
+        if self.codec is not None:
+            enc = EncodedBlock.parse(raw)
+            blk = enc.decode()
+            _COMPRESSED_DECODE.increment()
+            # a decoded compressed block is real allocation (the raw
+            # path below is mmap views): charge its materialized size
+            nbytes = enc.mem_bytes()
         else:
-            hash_lo = None  # v1 file: predicate path computes on device
-        flags = np.frombuffer(raw, dtype=np.uint8, count=n, offset=pos)
-        pos += n
-        offs = np.frombuffer(raw, dtype=np.uint32, count=n + 1, offset=pos)
-        pos += 4 * (n + 1)
-        heap = np.frombuffer(raw, dtype=np.uint8, count=heap_size,
-                             offset=pos)
-        blk = Block(keys, key_len, ets, hash_lo, flags, offs, heap)
-        if len(self._cache) >= self._cache_cap:
-            self._cache.popitem(last=False)  # evict true-LRU head
-        self._cache[idx] = blk
+            n, width, heap_size = _BLOCK_HDR.unpack_from(raw, 0)
+            pos = _BLOCK_HDR.size
+            keys = np.frombuffer(raw, dtype=np.uint8, count=n * width,
+                                 offset=pos).reshape(n, width)
+            pos += n * width
+            key_len = np.frombuffer(raw, dtype=np.int32, count=n,
+                                    offset=pos)
+            pos += 4 * n
+            ets = np.frombuffer(raw, dtype=np.uint32, count=n, offset=pos)
+            pos += 4 * n
+            if self._has_hash_lo:
+                hash_lo = np.frombuffer(raw, dtype=np.uint32, count=n,
+                                        offset=pos)
+                pos += 4 * n
+            else:
+                hash_lo = None  # v1 file: predicate path computes on device
+            flags = np.frombuffer(raw, dtype=np.uint8, count=n, offset=pos)
+            pos += n
+            offs = np.frombuffer(raw, dtype=np.uint32, count=n + 1,
+                                 offset=pos)
+            pos += 4 * (n + 1)
+            heap = np.frombuffer(raw, dtype=np.uint8, count=heap_size,
+                                 offset=pos)
+            blk = Block(keys, key_len, ets, hash_lo, flags, offs, heap)
+            # raw blocks start as zero-copy views over the page cache
+            # (or a real read() copy on encrypted stores), but a
+            # resident block lazily materializes real memory the views
+            # don't show — key_list() (~a bytes object per row) and the
+            # point-probe table — so the charge models that worst-case
+            # resident footprint, not the view bookkeeping. Charging
+            # only ~2KB would let the 32MiB default admit ~16k blocks
+            # (the old count cap held 256) whose hidden side tables
+            # could grow unchecked.
+            lazy = n * (width + 64)
+            nbytes = (512 + lazy if self._mv is not None
+                      else bm.size + 512 + lazy)
+        budget = (self._cache_budget if self._cache_budget is not None
+                  else block_cache_budget())
+        evicted = 0
+        with self._cache_lock:
+            prev = self._cache.get(idx)
+            if prev is not None:
+                # two threads raced the same cold block (serving +
+                # compaction share run caches): the overwrite must
+                # release the first insert's charge or the budget
+                # drifts up by one block per race, forever
+                self._cache_bytes -= prev[1]
+            self._cache[idx] = (blk, nbytes)
+            self._cache_bytes += nbytes
+            while self._cache_bytes > budget and len(self._cache) > 1:
+                _k, (_b, nb) = self._cache.popitem(last=False)
+                self._cache_bytes -= nb
+                evicted += nb
+        if evicted:
+            _BLOCK_EVICT_BYTES.increment(evicted)
         return blk
 
     def verify_block(self, idx: int) -> bool:

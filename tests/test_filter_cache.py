@@ -214,11 +214,21 @@ def test_l0_fence_short_circuit(tmp_path, no_row_cache):
 def test_block_cache_true_lru(tmp_path):
     """A hit refreshes recency: the old FIFO popped insertion order, so
     a hot block died to any cold streak."""
-    w = SSTableWriter(str(tmp_path / "t.sst"), block_capacity=4)
-    for i in range(16):  # 4 blocks of 4
-        w.add(b"k%04d" % i, b"v", 0)
-    w.finish()
-    t = SSTable(str(tmp_path / "t.sst"), cache_blocks=2)
+    old_codec = FLAGS.get("pegasus.storage", "block_codec")
+    FLAGS.set("pegasus.storage", "block_codec", "none")
+    try:
+        w = SSTableWriter(str(tmp_path / "t.sst"), block_capacity=4)
+        for i in range(16):  # 4 blocks of 4
+            w.add(b"k%04d" % i, b"v", 0)
+        w.finish()
+    finally:
+        FLAGS.set("pegasus.storage", "block_codec", old_codec)
+    # learn one block's cache charge, then budget exactly two blocks
+    t = SSTable(str(tmp_path / "t.sst"))
+    t.read_block(0)
+    one = t._cache[0][1]
+    t.close()
+    t = SSTable(str(tmp_path / "t.sst"), cache_bytes=2 * one + 16)
     t.read_block(0)
     t.read_block(1)
     t.read_block(0)   # refresh block 0
