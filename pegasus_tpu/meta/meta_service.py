@@ -89,6 +89,13 @@ class MetaService:
         # the detect→decide→act elasticity closed loop (signals flow in
         # through config_sync whatever the level; it ACTS only in lively)
         self.elasticity = ElasticityController(self)
+        # cluster-level compaction stagger: heavy-compaction demand
+        # reports ride config_sync, leased grants ride the reply
+        from pegasus_tpu.meta.compaction_scheduler import (
+            CompactionCoordinator,
+        )
+
+        self.compaction = CompactionCoordinator(self)
         # cluster function level (parity: meta_function_level / shell
         # get_meta_level|set_meta_level): "freezed" = no guardian cures
         # or proposals; "steady" = cures but manual balance only
@@ -387,6 +394,8 @@ class MetaService:
             elif cmd == "hot_partitions":
                 result = self.elasticity.status(
                     args.get("app_name", ""))
+            elif cmd == "compact_sched":
+                result = self.compaction.status()
             elif cmd == "del_app_envs":
                 result = self.del_app_envs(args["app_name"], args["keys"])
             elif cmd == "clear_app_envs":
@@ -493,6 +502,9 @@ class MetaService:
         # elasticity detect phase: the same report carries per-partition
         # capacity units + hotkey results and the node's pressure counts
         self.elasticity.on_report(node, payload)
+        # compaction stagger: demand in, leased grant out (None = the
+        # node reported no compaction block — say nothing)
+        compact_grant = self.compaction.on_report(node, payload)
         # recovery adoption: a replica holding a HIGHER ballot than our
         # state knows (e.g. updates lost across a leader change) is the
         # truth — adopt its view before answering
@@ -529,8 +541,10 @@ class MetaService:
                 # replicas of apps unknown to meta entirely are garbage
                 if app_id not in self.state.apps:
                     gc.append(tuple(entry["gpid"]))
-        self.net.send(self.name, src, "config_sync_reply", {
-            "configs": configs, "gc": gc})
+        reply = {"configs": configs, "gc": gc}
+        if compact_grant is not None:
+            reply["compact_grant"] = compact_grant
+        self.net.send(self.name, src, "config_sync_reply", reply)
 
     # ---- DDL surface (parity: meta_service.cpp:480-571) ---------------
 

@@ -259,7 +259,11 @@ def cblock_subset_fn():
         keep_u8 = np.ascontiguousarray(keep, dtype=np.uint8)
         if new_ets is not None:
             new_ets = np.ascontiguousarray(new_ets, dtype=np.uint32)
-        out = np.empty(a.size + raw_heap_len + 4096, dtype=np.uint8)
+        # margin covers v2 column growth: a subset can widen a
+        # FOR-encoded expire_ts section back to raw u32 (new_ets
+        # spreading past u16) — up to +4 bytes/row over the input
+        out = np.empty(a.size + raw_heap_len + 4 * keep_u8.size + 4096,
+                       dtype=np.uint8)
         hashes = (np.empty(keep_u8.size, dtype=np.uint64)
                   if want_hashes else None)
         out_keys = np.zeros(2 * key_width, dtype=np.uint8)

@@ -338,17 +338,34 @@ const ZstdFns& zstd() {
   return z;
 }
 
-// mirror of block_codec._CBLK_HDR ("<IIQQQIIBBBBBBxx", 48 bytes)
+// mirror of block_codec._CBLK_HDR ("<IIQQQIIBBBBBBBx", 48 bytes).
+// fmt: 0 = v1 (dcz), 2 = v2 (dcz2: FOR expire_ts + dict-indexed
+// hash_lo) — was a zeroed pad byte before dcz2, so old blocks read v1.
 #pragma pack(push, 1)
 struct CBlkHdr {
   uint32_t n, key_width;
   uint64_t raw_heap, comp_heap, sk_bytes;
   uint32_t dict_n, dict_bytes;
   uint8_t klen_w, vlen_w, idx_w, flags_mode, ets_mode, heap_mode;
-  uint8_t pad[2];
+  uint8_t fmt, pad;
 };
 #pragma pack(pop)
 static_assert(sizeof(CBlkHdr) == 48, "header layout drift");
+
+// v2 (dcz2) section layouts do NOT keep uint32 sections 4-byte
+// aligned (the FOR ets section is 4 + w*n bytes and the narrowed
+// klen/vlen/idx columns precede the hash sections), so every u32
+// section access goes through memcpy — a single mov on x86, defined
+// behavior everywhere else
+inline uint32_t ld_u32(const uint8_t* p, int64_t i) {
+  uint32_t v;
+  std::memcpy(&v, p + 4 * i, 4);
+  return v;
+}
+
+inline void st_u32(uint8_t* p, int64_t i, uint32_t v) {
+  std::memcpy(p + 4 * i, &v, 4);
+}
 
 inline int64_t narrow_at(const uint8_t* col, int w, int64_t i) {
   if (w == 1) return col[i];
@@ -418,16 +435,33 @@ int64_t pegasus_cblock_subset(const uint8_t* raw, int64_t raw_len,
   CBlkHdr h;
   std::memcpy(&h, raw, sizeof(h));
   const int64_t n = h.n;
-  // input section pointers
+  const bool v2 = (h.fmt == 2);
+  const int64_t sentinel = (1LL << (8 * h.idx_w)) - 1;
+  // input section pointers (v1: ets? | hash | doffs | klen | vlen |
+  // idx | flags? | dict | sk | heap; v2 moves the hash section after
+  // flags — slot hashes + row-ordered overflow — and the ets section
+  // may be FOR-encoded: u32 base + narrowed delta_plus1 per row)
   const uint8_t* p = raw + sizeof(CBlkHdr);
-  const uint32_t* in_ets = nullptr;
-  if (h.ets_mode != 0) {
-    in_ets = reinterpret_cast<const uint32_t*>(p);
+  const uint8_t* in_ets = nullptr;     // raw u32[n] (v1 mode!=0, v2 mode 4)
+  const uint8_t* in_ets_d = nullptr;   // v2 FOR deltas
+  uint32_t ets_base = 0;
+  int ets_w = 0;
+  if (v2 && (h.ets_mode == 1 || h.ets_mode == 2)) {
+    std::memcpy(&ets_base, p, 4);
+    p += 4;
+    in_ets_d = p;
+    ets_w = h.ets_mode;
+    p += ets_w * n;
+  } else if (h.ets_mode != 0) {
+    in_ets = p;
     p += 4 * n;
   }
-  const uint32_t* in_hash = reinterpret_cast<const uint32_t*>(p);
-  p += 4 * n;
-  const uint32_t* in_doffs = reinterpret_cast<const uint32_t*>(p);
+  const uint8_t* in_hash = nullptr;   // v1 per-row hash column
+  if (!v2) {
+    in_hash = p;
+    p += 4 * n;
+  }
+  const uint8_t* in_doffs = p;
   p += 4 * (static_cast<int64_t>(h.dict_n) + 1);
   const uint8_t* in_klen = p;
   p += h.klen_w * n;
@@ -440,13 +474,35 @@ int64_t pegasus_cblock_subset(const uint8_t* raw, int64_t raw_len,
     in_flags = p;
     p += n;
   }
+  const uint8_t* in_slot_hash = nullptr;  // v2 per-dict-slot hash
+  const uint8_t* in_over_hash = nullptr;  // v2 row-ordered overflow
+  if (v2) {
+    int64_t n_over = 0;
+    for (int64_t i = 0; i < n; ++i) {
+      const int64_t d = narrow_at(in_idx, h.idx_w, i);
+      if (d == sentinel || ld_u32(in_doffs, d + 1) == ld_u32(in_doffs, d))
+        ++n_over;
+    }
+    in_slot_hash = p;
+    p += 4 * static_cast<int64_t>(h.dict_n);
+    in_over_hash = p;
+    p += 4 * n_over;
+  }
   const uint8_t* in_dict = p;
   p += h.dict_bytes;
   const uint8_t* in_sk = p;
   p += h.sk_bytes;
   const uint8_t* in_heap = p;
   if (p + h.comp_heap > raw + raw_len) return -1;
-  const int64_t sentinel = (1LL << (8 * h.idx_w)) - 1;
+  // per-row expire_ts independent of the stored encoding
+  const auto ets_at = [&](int64_t i) -> uint32_t {
+    if (in_ets != nullptr) return ld_u32(in_ets, i);
+    if (in_ets_d != nullptr) {
+      const int64_t d = narrow_at(in_ets_d, ets_w, i);
+      return d == 0 ? 0 : ets_base + static_cast<uint32_t>(d) - 1;
+    }
+    return 0;
+  };
 
   // pass 1: survivor geometry + monotone dictionary remap
   int64_t* remap = static_cast<int64_t*>(
@@ -454,15 +510,19 @@ int64_t pegasus_cblock_subset(const uint8_t* raw, int64_t raw_len,
   if (remap == nullptr) return -1;
   for (int64_t d = 0; d <= h.dict_n; ++d) remap[d] = -1;
   int64_t m = 0, new_dict_n = 0, new_dict_bytes = 0, new_sk = 0,
-          vsub = 0;
+          vsub = 0, out_over = 0;
   bool any_ets = false, any_flags = false;
+  uint32_t e_min = 0xFFFFFFFFu, e_max = 0;
   {
     int64_t sk_off = 0;
     for (int64_t i = 0; i < n; ++i) {
       const int64_t kl = narrow_at(in_klen, h.klen_w, i);
       const int64_t d = narrow_at(in_idx, h.idx_w, i);
       const int64_t hk =
-          (d == sentinel) ? 0 : in_doffs[d + 1] - in_doffs[d];
+          (d == sentinel)
+              ? 0
+              : static_cast<int64_t>(ld_u32(in_doffs, d + 1)) -
+                    ld_u32(in_doffs, d);
       const int64_t sl = (d == sentinel) ? kl : kl - 2 - hk;
       if (keep[i] != 0) {
         ++m;
@@ -470,12 +530,16 @@ int64_t pegasus_cblock_subset(const uint8_t* raw, int64_t raw_len,
           remap[d] = new_dict_n++;
           new_dict_bytes += hk;
         }
+        if (d == sentinel || hk == 0) ++out_over;
         new_sk += sl;
         vsub += narrow_at(in_vlen, h.vlen_w, i);
         const uint32_t e =
-            (new_ets != nullptr) ? new_ets[i]
-                                 : (in_ets != nullptr ? in_ets[i] : 0);
-        any_ets = any_ets || (e != 0);
+            (new_ets != nullptr) ? new_ets[i] : ets_at(i);
+        if (e != 0) {
+          any_ets = true;
+          if (e < e_min) e_min = e;
+          if (e > e_max) e_max = e;
+        }
         any_flags = any_flags || (in_flags != nullptr && in_flags[i]);
       }
       sk_off += sl;
@@ -519,17 +583,34 @@ int64_t pegasus_cblock_subset(const uint8_t* raw, int64_t raw_len,
     heap_raw = inflated;
   }
 
-  // output header + section layout
+  // output header + section layout (output keeps the input's format
+  // version: v1 in -> v1 out, v2 in -> v2 out with the FOR width
+  // re-derived over the SURVIVOR values)
   CBlkHdr oh = h;
   oh.n = static_cast<uint32_t>(m);
-  oh.ets_mode = any_ets ? 4 : 0;
+  uint8_t out_ets_mode = 0;
+  int64_t ets_sec = 0;
+  if (any_ets) {
+    if (v2) {
+      const uint64_t spread =
+          static_cast<uint64_t>(e_max) - e_min + 1;
+      out_ets_mode = spread <= 0xFF ? 1 : (spread <= 0xFFFF ? 2 : 4);
+      ets_sec = out_ets_mode == 4 ? 4 * m : 4 + out_ets_mode * m;
+    } else {
+      out_ets_mode = 4;
+      ets_sec = 4 * m;
+    }
+  }
+  oh.ets_mode = out_ets_mode;
   oh.flags_mode = any_flags ? 1 : 0;
   oh.dict_n = static_cast<uint32_t>(new_dict_n);
   oh.dict_bytes = static_cast<uint32_t>(new_dict_bytes);
   oh.sk_bytes = static_cast<uint64_t>(new_sk);
   oh.raw_heap = static_cast<uint64_t>(vsub);
-  const int64_t fixed = sizeof(CBlkHdr) + (any_ets ? 4 * m : 0) +
-                        4 * m + 4 * (new_dict_n + 1) + h.klen_w * m +
+  const int64_t hash_sec =
+      v2 ? 4 * (new_dict_n + out_over) : 4 * m;
+  const int64_t fixed = sizeof(CBlkHdr) + ets_sec + hash_sec +
+                        4 * (new_dict_n + 1) + h.klen_w * m +
                         h.vlen_w * m + h.idx_w * m + (any_flags ? m : 0) +
                         new_dict_bytes + new_sk;
   if (fixed + vsub > out_cap) {
@@ -538,12 +619,23 @@ int64_t pegasus_cblock_subset(const uint8_t* raw, int64_t raw_len,
     return -1;
   }
   uint8_t* q = out + sizeof(CBlkHdr);
-  uint32_t* out_ets =
-      any_ets ? reinterpret_cast<uint32_t*>(q) : nullptr;
-  if (any_ets) q += 4 * m;
-  uint32_t* out_hash = reinterpret_cast<uint32_t*>(q);
-  q += 4 * m;
-  uint32_t* out_doffs = reinterpret_cast<uint32_t*>(q);
+  uint8_t* out_ets = nullptr;   // raw-u32 ets (v1, or v2 mode 4)
+  uint8_t* out_ets_d = nullptr;  // v2 FOR deltas
+  if (out_ets_mode == 4) {
+    out_ets = q;
+    q += 4 * m;
+  } else if (out_ets_mode != 0) {
+    std::memcpy(q, &e_min, 4);  // FOR base = min nonzero survivor
+    q += 4;
+    out_ets_d = q;
+    q += out_ets_mode * m;
+  }
+  uint8_t* out_hash = nullptr;        // v1 per-row
+  if (!v2) {
+    out_hash = q;
+    q += 4 * m;
+  }
+  uint8_t* out_doffs = q;
   q += 4 * (new_dict_n + 1);
   uint8_t* out_klen = q;
   q += h.klen_w * m;
@@ -556,38 +648,68 @@ int64_t pegasus_cblock_subset(const uint8_t* raw, int64_t raw_len,
     out_flags = q;
     q += m;
   }
+  uint8_t* out_slot_hash = nullptr;   // v2 dict-slot hashes
+  uint8_t* out_over_hash = nullptr;   // v2 overflow hashes
+  if (v2) {
+    out_slot_hash = q;
+    q += 4 * new_dict_n;
+    out_over_hash = q;
+    q += 4 * out_over;
+  }
   uint8_t* out_dict = q;
   q += new_dict_bytes;
   uint8_t* out_sk = q;
   q += new_sk;
   uint8_t* out_heap = q;  // raw subset lands here (ZLIB re-packs below)
 
-  // dictionary: entries in new-slot order
-  out_doffs[0] = 0;
-  for (int64_t d = 0; d < h.dict_n; ++d) {
-    const int64_t nd = remap[d];
-    if (nd < 0) continue;
-    const uint32_t len = in_doffs[d + 1] - in_doffs[d];
-    std::memcpy(out_dict + out_doffs[nd], in_dict + in_doffs[d], len);
-    out_doffs[nd + 1] = out_doffs[nd] + len;
+  // dictionary: entries in new-slot order (+ v2 slot hashes riding
+  // the same remap)
+  st_u32(out_doffs, 0, 0);
+  {
+    uint32_t cur = 0;
+    for (int64_t d = 0; d < h.dict_n; ++d) {
+      const int64_t nd = remap[d];
+      if (nd < 0) continue;
+      const uint32_t len = ld_u32(in_doffs, d + 1) - ld_u32(in_doffs, d);
+      std::memcpy(out_dict + cur, in_dict + ld_u32(in_doffs, d), len);
+      cur += len;
+      st_u32(out_doffs, nd + 1, cur);
+      if (v2) st_u32(out_slot_hash, nd, ld_u32(in_slot_hash, d));
+    }
   }
 
   // pass 2: gather survivors (+ bloom hashes and first/last keys)
   {
     int64_t j = 0, sk_off = 0, v_off = 0, osk = 0, ov = 0;
+    int64_t in_over_seq = 0, out_over_seq = 0;
     for (int64_t i = 0; i < n; ++i) {
       const int64_t kl = narrow_at(in_klen, h.klen_w, i);
       const int64_t d = narrow_at(in_idx, h.idx_w, i);
       const int64_t hk =
-          (d == sentinel) ? 0 : in_doffs[d + 1] - in_doffs[d];
+          (d == sentinel)
+              ? 0
+              : static_cast<int64_t>(ld_u32(in_doffs, d + 1)) -
+                    ld_u32(in_doffs, d);
       const int64_t sl = (d == sentinel) ? kl : kl - 2 - hk;
       const int64_t vl = narrow_at(in_vlen, h.vlen_w, i);
+      const bool slot_derivable = (d != sentinel) && hk > 0;
+      uint32_t hrow = 0;
+      if (v2) {
+        hrow = slot_derivable ? ld_u32(in_slot_hash, d)
+                              : ld_u32(in_over_hash, in_over_seq++);
+      } else {
+        hrow = ld_u32(in_hash, i);
+      }
       if (keep[i] != 0) {
         const uint32_t e =
-            (new_ets != nullptr) ? new_ets[i]
-                                 : (in_ets != nullptr ? in_ets[i] : 0);
-        if (out_ets != nullptr) out_ets[j] = e;
-        out_hash[j] = in_hash[i];
+            (new_ets != nullptr) ? new_ets[i] : ets_at(i);
+        if (out_ets != nullptr) st_u32(out_ets, j, e);
+        if (out_ets_d != nullptr)
+          narrow_put(out_ets_d, out_ets_mode, j,
+                     e == 0 ? 0 : static_cast<int64_t>(e) - e_min + 1);
+        if (out_hash != nullptr) st_u32(out_hash, j, hrow);
+        if (v2 && !slot_derivable)
+          st_u32(out_over_hash, out_over_seq++, hrow);
         narrow_put(out_klen, h.klen_w, j, kl);
         narrow_put(out_vlen, h.vlen_w, j, vl);
         narrow_put(out_idx, h.idx_w, j,
@@ -612,7 +734,7 @@ int64_t pegasus_cblock_subset(const uint8_t* raw, int64_t raw_len,
             const uint8_t hdr2[2] = {static_cast<uint8_t>(hk >> 8),
                                      static_cast<uint8_t>(hk & 0xFF)};
             c = crc64(hdr2, 2, 0);
-            c = crc64(in_dict + in_doffs[d], hk, c);
+            c = crc64(in_dict + ld_u32(in_doffs, d), hk, c);
             c = crc64(in_sk + sk_off, sl, c);
           } else {
             c = crc64(in_sk + sk_off, sl, 0);
@@ -627,7 +749,7 @@ int64_t pegasus_cblock_subset(const uint8_t* raw, int64_t raw_len,
           if (d != sentinel) {
             dst[0] = static_cast<uint8_t>(hk >> 8);
             dst[1] = static_cast<uint8_t>(hk & 0xFF);
-            std::memcpy(dst + 2, in_dict + in_doffs[d], hk);
+            std::memcpy(dst + 2, in_dict + ld_u32(in_doffs, d), hk);
             std::memcpy(dst + 2 + hk, in_sk + sk_off, sl);
           } else {
             std::memcpy(dst, in_sk + sk_off, sl);

@@ -102,3 +102,43 @@ def reset_probe() -> None:
     global _PROBE_RTT, _PROBE_DEFAULT
     _PROBE_RTT = ...
     _PROBE_DEFAULT = None
+
+
+# modeled link constants (measured once on this image, see module
+# docstring): used only for the offload BREAKDOWN — the routing
+# decision itself stays the probed-RTT thresholds above, which hold
+# across link models
+H2D_GBPS_EST = 0.5      # host->device marginal bandwidth
+ROUND_FIXED_S_EST = 0.070  # fixed cost per program round over the tunnel
+HOST_FILTER_GBPS_EST = 2.0  # host-side TTL/hash compare streams near
+#                             memory speed (no movement at all)
+
+
+def offload_breakdown(workload: str, batch_bytes: int) -> dict:
+    """Quantified pays/doesn't-pay verdict for one movement-bound
+    filter batch — the compaction pipeline's filter stage logs this,
+    and the bench publishes it (PERF round-12's offload table). The
+    verdict mirrors choose_eval_device exactly; the cost estimates are
+    the modeled link constants scaled by the probed RTT."""
+    rtt, dev = _probe_rtt()
+    routed_host = choose_eval_device(workload) is not None
+    out = {
+        "workload": workload,
+        "batch_bytes": int(batch_bytes),
+        "accelerator_present": rtt is not None,
+        "link_rtt_s": round(rtt, 6) if rtt is not None else None,
+        "offload_pays": rtt is not None and not routed_host,
+        "routed": ("host" if (rtt is None or routed_host)
+                   else str(dev)),
+    }
+    if rtt is not None:
+        # scale the fixed-round estimate by how the probed RTT compares
+        # to the co-located threshold (a colocated link has ~no fixed
+        # round cost; the wedged tunnel's is ~70ms)
+        fixed = (ROUND_FIXED_S_EST if rtt > LINK_RTT_COLOCATED_S
+                 else rtt)
+        out["accel_batch_s_est"] = round(
+            fixed + batch_bytes / (H2D_GBPS_EST * 1e9), 6)
+        out["host_batch_s_est"] = round(
+            batch_bytes / (HOST_FILTER_GBPS_EST * 1e9), 6)
+    return out

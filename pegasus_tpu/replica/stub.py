@@ -1767,14 +1767,26 @@ class ReplicaStub:
                 "deadline_expired_count").value(),
             "read_shed": rpc_ent.counter("read_shed_count").value(),
         }
+        # compaction demand for the meta-side stagger coordinator (the
+        # reply's compact_grant answers it); the same tick drives the
+        # governor's pressure feedback on nodes with no compaction
+        # currently paying acquire()
+        from pegasus_tpu.storage.compact_governor import GOVERNOR
+
+        GOVERNOR.poke()
+        compaction = GOVERNOR.report()
         for meta in self._meta_targets():
             self.net.send(self.name, meta, "config_sync", {
                 "node": self.name, "stored": stored,
-                "pressure": pressure})
+                "pressure": pressure, "compaction": compaction})
 
     def _on_config_sync_reply(self, src: str, payload: dict) -> None:
         import shutil
 
+        if "compact_grant" in payload:
+            from pegasus_tpu.storage.compact_governor import GOVERNOR
+
+            GOVERNOR.set_cluster_grant(bool(payload["compact_grant"]))
         for entry in payload["configs"]:
             gpid = tuple(entry["gpid"])
             r = self._open_replica(gpid, entry["partition_count"])

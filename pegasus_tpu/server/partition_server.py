@@ -454,6 +454,14 @@ class PartitionServer:
         hold the node lock (timers + dispatch share it) for the whole
         compaction — stalling FD beacons long enough to get the node
         declared dead."""
+        from pegasus_tpu.storage.compact_governor import GOVERNOR
+
+        # <=: a re-delivered trigger that already STARTED a run is
+        # absorbed even when the trigger is future-dated relative to
+        # the recorded finish time (an operator stamping a skewed-ahead
+        # timestamp must not re-compact every sync round). A DEFERRED
+        # trigger never advances trigger_seen, so its re-delivery
+        # passes this guard and re-attempts under a fresh grant.
         if trigger_ts <= 0 or trigger_ts <= self._mc_trigger_seen:
             return
         if trigger_ts <= self.engine.lsm.compact_finish_time:
@@ -462,16 +470,28 @@ class PartitionServer:
             # across restarts
             self._mc_trigger_seen = trigger_ts
             return
-        self._mc_trigger_seen = trigger_ts
         if self._mc_running:
+            self._mc_trigger_seen = trigger_ts
             return
+        if not GOVERNOR.heavy_allowed():
+            # cluster stagger: another node holds the heavy-compaction
+            # slot. DEFER, don't block — the trigger env is
+            # re-delivered by every config-sync round, and trigger_seen
+            # is deliberately NOT advanced, so the next delivery
+            # re-attempts under a (possibly fresh) grant. The governor
+            # records the demand so this node's report asks for a slot.
+            GOVERNOR.note_deferred()
+            return
+        self._mc_trigger_seen = trigger_ts
         self._mc_running = True
+        GOVERNOR.begin_heavy()
 
         def run() -> None:
             try:
                 self.manual_compact()
             finally:
                 self._mc_running = False
+                GOVERNOR.end_heavy()
 
         threading.Thread(
             target=run, daemon=True,

@@ -45,6 +45,27 @@ kernels, native page assembly, point probes — sees exactly the block
 it would have seen from an uncompressed file. The per-block CRC is
 computed over the ON-DISK (encoded) bytes, which keeps the PR 5
 scrubber's raw re-read path working unchanged.
+
+Codec ``dcz2`` (the PR 8 follow-on): same family, two column upgrades
+on the until-now-raw uint32 predicate columns, stamped per BLOCK via
+the header's format byte so one dcz2 FILE may verbatim-carry legacy v1
+blocks (compaction copies untouched blocks without transcoding):
+
+    expire_ts   FOR/delta: u32 base (min nonzero) + {u8,u16} per-row
+                delta_plus1 (0 keeps meaning "no TTL"); falls back to
+                raw u32 when the spread overflows u16, omitted when
+                all-zero — exactly the old ets_mode=0 case
+    hash_lo     dictionary-indexed: rows sharing a hashkey share its
+                crc64 lane, so the column stores one u32 PER DICT SLOT
+                plus a row-ordered overflow array for rows whose hash
+                is not slot-derivable (malformed keys, empty hashkeys
+                — an empty hashkey hashes the per-row SORTKEY region)
+
+Format versioning follows the PR 7 rule: new files stamp codec "dcz2"
+in the index (builds without it refuse at open, never misparse);
+legacy "dcz" files keep serving; "none" stays bit-for-bit; and a "dcz"
+WRITER never emits a v2 block (down-transcoding instead), so a file's
+named codec always bounds what is inside it.
 """
 
 from __future__ import annotations
@@ -57,11 +78,33 @@ import numpy as np
 
 CODEC_NONE = "none"
 CODEC_DCZ = "dcz"
-KNOWN_CODECS = (CODEC_DCZ,)
+CODEC_DCZ2 = "dcz2"
+KNOWN_CODECS = (CODEC_DCZ, CODEC_DCZ2)
+
+# block format versions the dcz-family codecs may contain: a file's
+# index-named codec BOUNDS the block versions inside it, so an old
+# build that knows only "dcz" can never meet a v2 block it would
+# misparse (it refuses "dcz2" files at open)
+_CODEC_VERSIONS = {CODEC_DCZ: (1,), CODEC_DCZ2: (1, 2)}
+
+
+def codec_accepts(codec: str, version: int) -> bool:
+    """May a file stamped `codec` contain a block of `version`? The
+    verbatim-copy / encoded-subset fast paths gate on this: an
+    incompatible block transcodes through decode->re-encode instead."""
+    return version in _CODEC_VERSIONS.get(codec, ())
+
+
+def block_version(buf) -> int:
+    """Format version of one encoded block's bytes (header fmt byte;
+    pre-dcz2 writers zeroed it, so 0 reads as version 1)."""
+    return 2 if buf[46] == 2 else 1
+
 
 # n, key_width, raw_heap, comp_heap, sk_bytes, dict_n, dict_bytes,
-# klen_w, vlen_w, idx_w, flags_mode, ets_mode, heap_mode, pad
-_CBLK_HDR = struct.Struct("<IIQQQIIBBBBBBxx")
+# klen_w, vlen_w, idx_w, flags_mode, ets_mode, heap_mode, fmt, pad
+# (fmt was a zeroed pad byte before dcz2 — 0 therefore means v1)
+_CBLK_HDR = struct.Struct("<IIQQQIIBBBBBBBx")
 
 _HEAP_RAW = 0
 _HEAP_ZLIB = 1
@@ -229,11 +272,49 @@ def _ragged_scatter(dst: np.ndarray, dst_starts: np.ndarray,
         src[np.repeat(src_starts, lens) + intra]
 
 
+def _ets_for_encode(ets: np.ndarray):
+    """(ets_mode, [section bytes]) for the v2 FOR/delta expire_ts
+    column: mode 0 = all-zero (omitted), 1/2 = u32 base + per-row
+    delta_plus1 narrowed to u8/u16 (0 stays 0 — "no TTL"), 4 = raw
+    u32 fallback when the nonzero spread overflows u16."""
+    if not ets.any():
+        return 0, []
+    nz = ets[ets != 0]
+    base = int(nz.min())
+    spread = int(nz.max()) - base + 1
+    if spread <= 0xFF:
+        w = 1
+    elif spread <= 0xFFFF:
+        w = 2
+    else:
+        return 4, [ets.tobytes()]
+    d = np.where(ets == 0, 0,
+                 ets.astype(np.int64) - base + 1).astype(_NARROW[w])
+    return w, [struct.pack("<I", base), d.tobytes()]
+
+
+def _ets_for_decode(mode: int, raw, pos: int, n: int):
+    """Inverse of _ets_for_encode: (expire_ts uint32[n], bytes read)."""
+    if mode == 0:
+        return np.zeros(n, dtype=np.uint32), 0
+    if mode == 4:
+        return np.frombuffer(raw, dtype=np.uint32, count=n,
+                             offset=pos), 4 * n
+    (base,) = struct.unpack_from("<I", raw, pos)
+    d = np.frombuffer(raw, dtype=_NARROW[mode], count=n,
+                      offset=pos + 4).astype(np.int64)
+    ets = np.where(d == 0, 0, base + d - 1).astype(np.uint32)
+    return ets, 4 + mode * n
+
+
 def encode_block(keys: np.ndarray, key_len: np.ndarray, ets: np.ndarray,
                  hash_lo: np.ndarray, flags: np.ndarray,
-                 value_offs: np.ndarray, heap) -> bytes:
+                 value_offs: np.ndarray, heap,
+                 version: int = 1) -> bytes:
     """Raw columnar block -> dcz bytes. `keys` is the zero-padded
-    uint8[n, W] matrix exactly as the raw format would store it."""
+    uint8[n, W] matrix exactly as the raw format would store it.
+    `version` 1 writes the original dcz layout bit-for-bit; 2 writes
+    the dcz2 layout (FOR expire_ts + dictionary-indexed hash_lo)."""
     keys = np.ascontiguousarray(keys, dtype=np.uint8)
     n, width = keys.shape
     key_len = np.asarray(key_len, dtype=np.int32)
@@ -302,14 +383,42 @@ def encode_block(keys: np.ndarray, key_len: np.ndarray, ets: np.ndarray,
     klen_w = _width_for(int(kl64.max()) if n else 0)
     vlen_w = _width_for(int(vlens.max()) if n else 0)
     flags_mode = 1 if flags.any() else 0
-    ets_mode = 4 if ets.any() else 0
 
     heap_mode, heap_out = _maybe_deflate(heap_bytes)
 
+    if version == 2:
+        ets_mode, ets_parts = _ets_for_encode(ets)
+        # hash_lo is crc64 of the HASHKEY region, constant across a
+        # dictionary group — store one u32 per slot. Rows whose hash
+        # is not slot-derivable (malformed keys, and empty hashkeys,
+        # whose hash covers the per-row SORTKEY region) append to a
+        # row-ordered overflow array the decoder consumes in turn.
+        slot_ok = normal & (hkl > 0)
+        slot_hash = hash_lo[dict_rows]
+        overflow = hash_lo[~slot_ok]
+        parts = [_CBLK_HDR.pack(
+            n, width, len(heap_bytes), len(heap_out),
+            int(sk_len.sum()), dict_n, int(dict_offs[-1]), klen_w,
+            vlen_w, idx_w, flags_mode, ets_mode, heap_mode, 2)]
+        parts.extend(ets_parts)
+        parts.append(dict_offs.tobytes())
+        parts.append(key_len.astype(_NARROW[klen_w]).tobytes())
+        parts.append(vlens.astype(_NARROW[vlen_w]).tobytes())
+        parts.append(hk_idx.astype(_NARROW[idx_w]).tobytes())
+        if flags_mode:
+            parts.append(flags.tobytes())
+        parts.append(slot_hash.tobytes())
+        parts.append(overflow.tobytes())
+        parts.append(dict_heap.tobytes())
+        parts.append(sk_heap.tobytes())
+        parts.append(heap_out)
+        return b"".join(parts)
+
+    ets_mode = 4 if ets.any() else 0
     parts: List[bytes] = [_CBLK_HDR.pack(
         n, width, len(heap_bytes), len(heap_out), int(sk_len.sum()),
         dict_n, int(dict_offs[-1]), klen_w, vlen_w, idx_w, flags_mode,
-        ets_mode, heap_mode)]
+        ets_mode, heap_mode, 0)]
     if ets_mode:
         parts.append(ets.tobytes())
     parts.append(hash_lo.tobytes())
@@ -342,7 +451,7 @@ class EncodedBlock:
                  "hash_lo", "flags", "hk_idx", "dict_offs", "dict_heap",
                  "sk_heap", "sk_offs", "hk_len", "value_offs",
                  "_heap_comp", "heap_mode", "raw_heap_len",
-                 "has_malformed", "_sentinel")
+                 "has_malformed", "_sentinel", "version")
 
     @property
     def count(self) -> int:
@@ -354,21 +463,27 @@ class EncodedBlock:
         self.raw = raw
         buf = np.frombuffer(raw, dtype=np.uint8)
         (n, width, raw_heap, comp_heap, sk_bytes, dict_n, dict_bytes,
-         klen_w, vlen_w, idx_w, flags_mode, ets_mode,
-         heap_mode) = _CBLK_HDR.unpack_from(raw, 0)
+         klen_w, vlen_w, idx_w, flags_mode, ets_mode, heap_mode,
+         fmt) = _CBLK_HDR.unpack_from(raw, 0)
+        self.version = 2 if fmt == 2 else 1
         self.n, self.key_width = n, width
         self.raw_heap_len = raw_heap
         self.heap_mode = heap_mode
+        self._sentinel = (1 << (8 * idx_w)) - 1
         pos = _CBLK_HDR.size
-        if ets_mode:
+        if self.version == 2:
+            self.expire_ts, adv = _ets_for_decode(ets_mode, raw, pos, n)
+            pos += adv
+        elif ets_mode:
             self.expire_ts = np.frombuffer(raw, dtype=np.uint32,
                                            count=n, offset=pos)
             pos += 4 * n
         else:
             self.expire_ts = np.zeros(n, dtype=np.uint32)
-        self.hash_lo = np.frombuffer(raw, dtype=np.uint32, count=n,
-                                     offset=pos)
-        pos += 4 * n
+        if self.version == 1:
+            self.hash_lo = np.frombuffer(raw, dtype=np.uint32, count=n,
+                                         offset=pos)
+            pos += 4 * n
         self.dict_offs = np.frombuffer(raw, dtype=np.uint32,
                                        count=dict_n + 1, offset=pos)
         pos += 4 * (dict_n + 1)
@@ -386,20 +501,12 @@ class EncodedBlock:
         self.hk_idx = np.frombuffer(raw, dtype=_NARROW[idx_w], count=n,
                                     offset=pos).astype(np.int64)
         pos += idx_w * n
-        self._sentinel = (1 << (8 * idx_w)) - 1
         if flags_mode:
             self.flags = np.frombuffer(raw, dtype=np.uint8, count=n,
                                        offset=pos)
             pos += n
         else:
             self.flags = np.zeros(n, dtype=np.uint8)
-        self.dict_heap = np.frombuffer(raw, dtype=np.uint8,
-                                       count=dict_bytes, offset=pos)
-        pos += dict_bytes
-        self.sk_heap = np.frombuffer(raw, dtype=np.uint8,
-                                     count=sk_bytes, offset=pos)
-        pos += sk_bytes
-        self._heap_comp = buf[pos:pos + comp_heap]
 
         normal = self.hk_idx != self._sentinel
         self.has_malformed = bool((~normal).any())
@@ -408,6 +515,33 @@ class EncodedBlock:
         ni = self.hk_idx[normal]
         hk_len[normal] = do64[ni + 1] - do64[ni]
         self.hk_len = hk_len
+
+        if self.version == 2:
+            # dictionary-indexed hash column: one u32 per slot, plus a
+            # row-ordered overflow for rows whose hash is not
+            # slot-derivable (sentinel / empty hashkey — the hash then
+            # covers the per-row sortkey region, unique per row)
+            slot_ok = normal & (hk_len > 0)
+            n_over = n - int(slot_ok.sum())
+            slot_hash = np.frombuffer(raw, dtype=np.uint32,
+                                      count=dict_n, offset=pos)
+            pos += 4 * dict_n
+            overflow = np.frombuffer(raw, dtype=np.uint32,
+                                     count=n_over, offset=pos)
+            pos += 4 * n_over
+            hash_lo = np.empty(n, dtype=np.uint32)
+            hash_lo[slot_ok] = slot_hash[self.hk_idx[slot_ok]]
+            hash_lo[~slot_ok] = overflow
+            self.hash_lo = hash_lo
+
+        self.dict_heap = np.frombuffer(raw, dtype=np.uint8,
+                                       count=dict_bytes, offset=pos)
+        pos += dict_bytes
+        self.sk_heap = np.frombuffer(raw, dtype=np.uint8,
+                                     count=sk_bytes, offset=pos)
+        pos += sk_bytes
+        self._heap_comp = buf[pos:pos + comp_heap]
+
         kl64 = self.key_len.astype(np.int64)
         sk_len = np.where(normal, kl64 - 2 - hk_len, kl64)
         so = np.zeros(n + 1, dtype=np.int64)

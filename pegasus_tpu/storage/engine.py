@@ -29,6 +29,10 @@ import numpy as np
 from pegasus_tpu.base.value_schema import epoch_now
 from pegasus_tpu.ops.compaction import compaction_filter_block
 from pegasus_tpu.ops.record_block import build_record_block
+# imported for their flag definitions (compact_pipeline /
+# compact_max_mbps etc. must exist before any config file applies)
+from pegasus_tpu.storage import compact_governor  # noqa: F401
+from pegasus_tpu.storage import compact_pipeline  # noqa: F401
 from pegasus_tpu.storage.lsm import LSMStore
 from pegasus_tpu.storage.wal import OP_DEL, OP_PUT, WalRecord, WriteAheadLog
 
@@ -279,17 +283,30 @@ class StorageEngine:
                              publish_lock=None) -> None:
         """Block-level compaction over a pure-L1 store.
 
-        Windowed: load a window of blocks, evaluate every window miss in
-        a handful of stacked programs (ops/compaction.py — placed on the
-        accelerator or the host XLA backend by the link cost model),
-        rewrite survivors with vectorized gathers, release, repeat —
-        memory stays bounded by the window regardless of table size."""
+        Pipelined (default): the block-read, filter-eval, and
+        compressed-write stages run on dedicated threads connected by
+        bounded queues (storage/compact_pipeline.py) — disk reads,
+        device/XLA filter programs, the native subset kernel, and the
+        output writers all overlap, and the read stage pays the
+        CompactionGovernor's token bucket so background bandwidth
+        answers foreground pressure. Serial (flag off): the original
+        windowed loop with one-window device lookahead. Both produce
+        the identical (block, mask) stream, so output bytes match."""
         from pegasus_tpu.ops.compaction import (
             choose_eval_device,
             compaction_eval_drain,
             compaction_eval_submit,
             encoded_drop_mask,
             rules_workload,
+        )
+        from pegasus_tpu.storage.compact_governor import GOVERNOR
+        from pegasus_tpu.storage.compact_pipeline import (
+            CompactPipeline,
+            pipeline_depth,
+            pipeline_enabled,
+            pipeline_window,
+            stage_threads_enabled,
+            transform_workers,
         )
 
         ttl_may_change = bool(default_ttl) or bool(
@@ -307,64 +324,102 @@ class StorageEngine:
             "manual_compact_finish_time": epoch_now(),
         }
 
-        WINDOW = 512  # blocks per load->eval->rewrite window
+        # direct compute on compressed blocks: a ruleset that touches
+        # no key bytes (TTL + default-TTL rewrite + stale-split)
+        # evaluates straight off the encoded block's raw predicate
+        # columns — no key-matrix rebuild, no value-heap inflate, no
+        # device program; unchanged blocks then copy verbatim
+        def direct(run) -> bool:
+            return (operations is None
+                    and getattr(run, "codec", None) is not None)
 
-        def submit(off):
-            window = entries[off:off + WINDOW]
-            blocks = []
+        def load(entry):
+            """READ stage: one block off disk, paced by the governor
+            (this is the only place background compaction touches the
+            disk for input)."""
+            run, i, bm = entry
+            GOVERNOR.acquire(bm.size)
+            if direct(run):
+                return (run, i, run.read_block_encoded(i), True)
+            return (run, i, run.read_block(i), False)
+
+        def submit_window(items):
+            """FILTER stage phase 1: dispatch without waiting."""
+            blocks = [((run, i), blk, pidx)
+                      for run, i, blk, is_direct in items
+                      if not is_direct]
             host_done = {}
-            for run, i, _bm in window:
-                # direct compute on compressed blocks: a ruleset that
-                # touches no key bytes (TTL + default-TTL rewrite +
-                # stale-split) evaluates straight off the encoded
-                # block's raw expire_ts/hash_lo columns — no key-matrix
-                # rebuild, no value-heap inflate, no device program;
-                # unchanged blocks then copy verbatim in the rewrite
-                if operations is None \
-                        and getattr(run, "codec", None) is not None:
-                    enc = run.read_block_encoded(i)
-                    host_done[(run, i)] = (enc, encoded_drop_mask(
-                        enc, now_s, default_ttl, pidx,
+            for run, i, blk, is_direct in items:
+                if is_direct:
+                    host_done[(run, i)] = encoded_drop_mask(
+                        blk, now_s, default_ttl, pidx,
                         partition_version, do_validate,
-                        want_ets=ttl_may_change))
-                    continue
-                blocks.append(((run, i), run.read_block(i), pidx))
+                        want_ets=ttl_may_change)
             pend = compaction_eval_submit(
                 blocks, now_s, default_ttl, partition_version,
                 do_validate, operations=operations,
                 eval_device=eval_device,
                 want_ets=ttl_may_change) if blocks else []
-            return window, blocks, pend, host_done
+            return items, pend, host_done
 
-        def results():
-            # one-window lookahead: while window w's masks drain and its
-            # survivors rewrite to disk, window w+1's blocks are already
-            # loaded, uploaded, and evaluating — device (or host XLA)
-            # filter time hides behind the disk time and vice versa
-            ahead = submit(0) if entries else None
-            off = WINDOW
-            while ahead is not None:
-                window, blocks, pend, host_done = ahead
-                ahead = submit(off) if off < len(entries) else None
-                off += WINDOW
-                got = {}
-                for tag, drop, new_ets in compaction_eval_drain(
-                        pend, want_ets=ttl_may_change):
-                    got[tag] = (drop, new_ets)
-                by_tag = {tag: blk for tag, blk, _p in blocks}
-                for run, i, _bm in window:
-                    hd = host_done.get((run, i))
-                    if hd is not None:
-                        enc, (drop, new_ets) = hd
-                        yield run, i, enc, drop, new_ets
-                        continue
+        def drain_window(token):
+            """FILTER stage phase 2: materialize one window's masks."""
+            items, pend, host_done = token
+            got = {}
+            for tag, drop, new_ets in compaction_eval_drain(
+                    pend, want_ets=ttl_may_change):
+                got[tag] = (drop, new_ets)
+            out = []
+            for run, i, blk, is_direct in items:
+                if is_direct:
+                    drop, new_ets = host_done[(run, i)]
+                else:
                     drop, new_ets = got[(run, i)]
-                    yield run, i, by_tag[(run, i)], drop, new_ets
+                out.append((run, i, blk, drop, new_ets))
+            return out
+
+        if pipeline_enabled() and stage_threads_enabled():
+            pipe = CompactPipeline(
+                entries, load, submit_window, drain_window,
+                window=pipeline_window(), depth=pipeline_depth(),
+                # a window whose masks all computed host-direct at
+                # submit has no in-flight device program to hide:
+                # forward it immediately instead of holding the
+                # one-window lookahead
+                eager=lambda token: not token[1])
+            results = pipe.results()
+        else:
+            def serial_results():
+                # one-window lookahead ONLY for windows with an
+                # in-flight device program: while window w's masks
+                # drain and its survivors rewrite, window w+1 is
+                # already uploaded and evaluating. Host-direct windows
+                # (every mask computed at submit) yield immediately —
+                # holding them back starves the write stage for a full
+                # window of reads with nothing async to hide.
+                W = pipeline_window()
+                pending = None
+                for off in range(0, len(entries), W):
+                    token = submit_window(
+                        [load(e) for e in entries[off:off + W]])
+                    if pending is not None:
+                        yield from drain_window(pending)
+                        pending = None
+                    if not token[1]:
+                        yield from drain_window(token)
+                    else:
+                        pending = token
+                if pending is not None:
+                    yield from drain_window(pending)
+
+            results = serial_results()
 
         self.lsm.bulk_compact_rewrite(
-            results(), meta, ttl_may_change=ttl_may_change,
+            results, meta, ttl_may_change=ttl_may_change,
             patch_headers=self.values_carry_expire_header,
-            publish_lock=publish_lock)
+            publish_lock=publish_lock,
+            transform_workers=(transform_workers()
+                               if pipeline_enabled() else 0))
 
     def manual_compact(self, default_ttl: int = 0, pidx: int = 0,
                        partition_version: int = -1,

@@ -35,7 +35,11 @@ from typing import Callable, Iterator, List, Optional, Tuple
 import numpy as np
 
 from pegasus_tpu.base.crc import crc64
-from pegasus_tpu.storage.block_codec import CODEC_NONE, EncodedBlock
+from pegasus_tpu.storage.block_codec import (
+    CODEC_NONE,
+    EncodedBlock,
+    codec_accepts,
+)
 from pegasus_tpu.storage.bloom import bloom_probe_enabled
 from pegasus_tpu.storage.memtable import Memtable, TOMBSTONE
 from pegasus_tpu.storage.sstable import (
@@ -351,11 +355,16 @@ class LSMStore:
         new_runs: List[SSTable] = []
         writer: Optional[SSTableWriter] = None
         written_in_run = 0
+        # write-stage overlap, same shape as the bulk path: block
+        # writes stream on the writer's async-IO thread while the
+        # merge/filter keeps producing, and filled runs finish on the
+        # shared _FinishPool (joined before publish)
+        finish_pool = _FinishPool()
 
         def open_writer() -> SSTableWriter:
             return SSTableWriter(self._next_path("l1"),
                                  block_capacity=self._block_capacity,
-                                 meta=meta)
+                                 meta=meta, async_io=True)
 
         def write_records(keys, vals, ets_orig, drop, new_ets) -> None:
             nonlocal writer, written_in_run
@@ -376,8 +385,7 @@ class LSMStore:
                 writer.add(k, v, ne)
                 written_in_run += 1
                 if written_in_run >= self._l1_run_capacity:
-                    writer.finish()
-                    new_runs.append(SSTable(writer.path))
+                    finish_pool.submit(writer)
                     writer = None
                     written_in_run = 0
 
@@ -400,35 +408,52 @@ class LSMStore:
                 new_ets = np.asarray(new_ets)
             write_records(keys, vals, ets_orig, drop, new_ets)
 
+        from pegasus_tpu.storage.compact_governor import GOVERNOR
+
         batch_keys: List[bytes] = []
         batch_vals: List[bytes] = []
         batch_ets: List[int] = []
+        batch_bytes = 0
         # the FILTER batch is much larger than the write-block size: a
         # high-RTT device pays per dispatch, so the compactor amortizes
         # 16 blocks of records into each filter evaluation
         filter_batch = self._block_capacity * 16
-        for key, value, ets in merged:
-            if value is None:  # tombstone: bottommost level -> drop
-                continue
-            batch_keys.append(key)
-            batch_vals.append(value)
-            batch_ets.append(ets)
-            if len(batch_keys) >= filter_batch:
+        ok = False
+        try:
+            for key, value, ets in merged:
+                if value is None:  # tombstone: bottommost level -> drop
+                    continue
+                batch_keys.append(key)
+                batch_vals.append(value)
+                batch_ets.append(ets)
+                batch_bytes += len(key) + len(value)
+                if len(batch_keys) >= filter_batch:
+                    # the merge path's input pacing: one governor
+                    # charge per filter batch (the bulk path pays per
+                    # block) — background bandwidth answers foreground
+                    # pressure on BOTH compaction shapes
+                    GOVERNOR.acquire(batch_bytes)
+                    entry = submit(batch_keys, batch_vals, batch_ets)
+                    if pending is not None:
+                        drain(pending)
+                    pending = entry
+                    batch_keys, batch_vals, batch_ets = [], [], []
+                    batch_bytes = 0
+            if batch_keys:
+                GOVERNOR.acquire(batch_bytes)
                 entry = submit(batch_keys, batch_vals, batch_ets)
                 if pending is not None:
                     drain(pending)
                 pending = entry
-                batch_keys, batch_vals, batch_ets = [], [], []
-        if batch_keys:
-            entry = submit(batch_keys, batch_vals, batch_ets)
             if pending is not None:
                 drain(pending)
-            pending = entry
-        if pending is not None:
-            drain(pending)
-        if writer is not None:
-            writer.finish()
-            new_runs.append(SSTable(writer.path))
+            if writer is not None:
+                finish_pool.submit(writer)
+                writer = None
+            new_runs = finish_pool.results()
+            ok = True
+        finally:
+            finish_pool.shutdown(ok, open_writer=writer)
 
         self._publish_l1(new_runs, consumed_l0=l0_snap,
                          old_runs=runs_snap, publish_lock=publish_lock,
@@ -532,7 +557,8 @@ class LSMStore:
     def bulk_compact_rewrite(self, per_block, meta,
                              ttl_may_change: bool,
                              patch_headers: bool = False,
-                             publish_lock=None) -> None:
+                             publish_lock=None,
+                             transform_workers: int = 0) -> None:
         """Rewrite the L1 level from precomputed per-block filter results.
 
         `per_block`: [(run, idx, blk, drop, new_ets)] in key order (drop
@@ -545,29 +571,29 @@ class LSMStore:
         rate. The rewrite never touches the memtable/L0 (eligibility
         requires them empty at snapshot), so with `publish_lock` the
         whole disk pass runs with writes flowing and the lock is taken
-        only for the publish cut-over."""
+        only for the publish cut-over.
+
+        `transform_workers` > 0 (the pipelined compactor's write
+        stage): the per-block transform — subset kernel, heap
+        inflate/re-deflate, numpy gathers — runs on an ordered worker
+        pool while this thread only appends results, so the GIL-free
+        kernel work of block N+1..N+k overlaps block N's writer append.
+        The transform is ONE function executed identically inline or
+        pooled, so output bytes cannot depend on the mode."""
         import concurrent.futures as _cf
 
-        from pegasus_tpu.storage.sstable import SSTable, SSTableWriter
+        from pegasus_tpu.storage.bloom import bloom_build_bits
+        from pegasus_tpu.storage.sstable import (
+            SSTable,
+            SSTableWriter,
+            block_codec,
+        )
 
         runs_snap = list(self.l1_runs)
-        # finish() = flush + fsync + rename + dir-fsync — ~half the
-        # wall time of a disk-bound compaction. Filled runs finish on a
-        # helper thread (fsync releases the GIL) while the main thread
-        # keeps gathering/writing the next run; every future joins
-        # BEFORE the manifest publish, so the durability ordering
-        # (all runs durable, then manifest) is unchanged.
-        finish_pool = _cf.ThreadPoolExecutor(max_workers=2)
-        finishing: List[_cf.Future] = []
-        finishing_writers: List[SSTableWriter] = []
-
-        def _finish_one(w: SSTableWriter) -> SSTable:
-            w.finish()
-            return SSTable(w.path)
-
-        def _submit_finish(w: SSTableWriter) -> None:
-            finishing_writers.append(w)
-            finishing.append(finish_pool.submit(_finish_one, w))
+        # filled runs finish on the shared _FinishPool (fsync releases
+        # the GIL) while this thread keeps appending; joined before
+        # the manifest publish
+        finish_pool = _FinishPool()
 
         from pegasus_tpu import native
 
@@ -579,7 +605,7 @@ class LSMStore:
         def roll_writer() -> SSTableWriter:
             nonlocal writer, written_in_run
             if writer is not None and written_in_run >= self._l1_run_capacity:
-                _submit_finish(writer)
+                finish_pool.submit(writer)
                 writer = None
                 written_in_run = 0
             if writer is None:
@@ -596,150 +622,181 @@ class LSMStore:
                                  blk.value_heap)
             written_in_run += blk.count
 
-        try:
-            for run, idx, blk, drop, new_ets in per_block:
-                dropped = bool(drop.any())
-                encoded = isinstance(blk, EncodedBlock)
-                if not dropped and not ttl_may_change:
-                    if encoded:
-                        w = roll_writer()
-                        if w.codec != CODEC_NONE:
-                            # untouched compressed block: the on-disk
-                            # bytes copy VERBATIM — no heap inflate, no
-                            # re-encode, no re-deflate
-                            w.add_block_encoded(blk)
-                            written_in_run += blk.count
-                            continue
-                        blk = blk.decode()  # codec turned off mid-store
-                    copy_block(blk)
-                    continue
-                n = blk.count
-                ets_changed = (ttl_may_change
-                               and not np.array_equal(new_ets,
-                                                      blk.expire_ts))
-                if not dropped and not ets_changed:
-                    if encoded:
-                        w = roll_writer()
-                        if w.codec != CODEC_NONE:
-                            w.add_block_encoded(blk)
-                            written_in_run += blk.count
-                            continue
-                        blk = blk.decode()
-                    copy_block(blk)
-                    continue
+        # writer-independent state the TRANSFORM latches once, so the
+        # same decisions compute on any thread: every writer this
+        # rewrite rolls latches the identical flag values at creation
+        codec_now = block_codec()
+        bloom_now = bloom_build_bits() > 0
+
+        def transform(item):
+            """Stateless per-block transform -> (kind, payload). The
+            expensive work lives here — subset kernel (GIL-free), heap
+            inflate, numpy gathers — and runs identically inline
+            (serial) or on the ordered worker pool (pipelined)."""
+            _run, _idx, blk, drop, new_ets = item
+            dropped = bool(drop.any())
+            encoded = isinstance(blk, EncodedBlock)
+            ets_changed = ttl_may_change and \
+                not np.array_equal(new_ets, blk.expire_ts)
+            if not dropped and not ets_changed:
                 if encoded:
-                    # survivor check BEFORE roll_writer: instantiating
-                    # a writer for a fully-dropped block would publish
-                    # an empty L1 run when every block drops every row
-                    keep = ~drop
-                    keep &= np.asarray(blk.flags) == 0
-                    if not keep.any():
-                        continue
-                    w = roll_writer()
-                    if w.codec != CODEC_NONE and cblock_subset is not None:
-                        # rows drop (or TTLs rewrite): subset the block
-                        # in the ENCODED domain — one GIL-free native
-                        # pass (dict remap + ragged gathers + heap
-                        # inflate/re-deflate) instead of the Python
-                        # decode -> gather -> re-encode round trip that
-                        # serialized the compaction thread pool
-                        res = cblock_subset(
-                            blk.raw, blk.raw_heap_len, blk.key_width,
-                            keep, new_ets if ets_changed else None,
-                            ets_changed and patch_headers,
-                            want_hashes=w.bloom_enabled)
-                        if res is not None:
-                            buf, hashes, m, vsub, fk, lk = res
-                            w.add_block_encoded_raw(
-                                buf, m, blk.key_width, vsub, fk, lk,
-                                hashes)
-                            written_in_run += m
-                            continue
-                    # native kernel unavailable (or codec flipped off
-                    # mid-store): materialize once and take the
-                    # vectorized gather path below
-                    blk = blk.decode()
+                    if codec_now != CODEC_NONE:
+                        # untouched compressed block: the on-disk
+                        # bytes copy VERBATIM — no heap inflate, no
+                        # re-encode, no re-deflate
+                        return "verbatim", blk
+                    blk = blk.decode()  # codec turned off mid-store
+                return "copy", blk
+            n = blk.count
+            if encoded:
+                # survivor check first: a fully-dropped block must
+                # never roll a writer (an empty L1 run would publish
+                # when every block drops every row)
                 keep = ~drop
-                if blk.flags is not None:
-                    keep &= blk.flags == 0  # tombstones never stay
-                kept = np.flatnonzero(keep)
-                if kept.size == 0:
-                    continue
-                vo = blk.value_offs.astype(np.int64)
-                lens = vo[1:] - vo[:-1]
-                heap_arr = blk.value_heap
-                if not isinstance(heap_arr, np.ndarray):
-                    heap_arr = np.frombuffer(heap_arr, dtype=np.uint8)
-                ets_col = new_ets if ets_changed else blk.expire_ts
-                if ets_changed and patch_headers:
-                    # patch the big-endian u32 expire_ts value header in
-                    # place (vectorized scatter, value_schema.h: header
-                    # starts every encoded value)
-                    heap_arr = heap_arr.copy()
-                    chg = np.flatnonzero((new_ets != blk.expire_ts)
-                                         & keep)
-                    if chg.size:
-                        pos = vo[chg]
-                        vals = new_ets[chg].astype(np.uint32)
-                        heap_arr[pos] = (vals >> 24).astype(np.uint8)
-                        heap_arr[pos + 1] = \
-                            ((vals >> 16) & 0xFF).astype(np.uint8)
-                        heap_arr[pos + 2] = \
-                            ((vals >> 8) & 0xFF).astype(np.uint8)
-                        heap_arr[pos + 3] = (vals & 0xFF).astype(np.uint8)
-                if kept.size == n:
-                    new_heap = heap_arr
-                    new_offs = blk.value_offs
-                    keys2d, klen = blk.keys, blk.key_len
-                    hlo, flg = blk.hash_lo, blk.flags
-                    ets_out = ets_col
-                else:
-                    keep_bytes = np.repeat(keep, lens)
-                    new_heap = heap_arr[keep_bytes]
-                    kept_lens = lens[kept]
-                    new_offs = np.zeros(kept.size + 1, dtype=np.uint32)
-                    new_offs[1:] = np.cumsum(kept_lens)
-                    keys2d = blk.keys[kept]
-                    klen = blk.key_len[kept]
-                    ets_out = np.asarray(ets_col)[kept]
-                    hlo = blk.hash_lo[kept]
-                    flg = blk.flags[kept]
+                keep &= np.asarray(blk.flags) == 0
+                if not keep.any():
+                    return "skip", None
+                if codec_now != CODEC_NONE and cblock_subset is not None \
+                        and codec_accepts(codec_now, blk.version):
+                    # rows drop (or TTLs rewrite): subset the block
+                    # in the ENCODED domain — one GIL-free native
+                    # pass (dict remap + ragged gathers + heap
+                    # inflate/re-deflate) instead of the Python
+                    # decode -> gather -> re-encode round trip that
+                    # serialized the compaction thread pool
+                    res = cblock_subset(
+                        blk.raw, blk.raw_heap_len, blk.key_width,
+                        keep, new_ets if ets_changed else None,
+                        ets_changed and patch_headers,
+                        want_hashes=bloom_now)
+                    if res is not None:
+                        return "raw", (res, blk.key_width)
+                # native kernel unavailable (or codec flipped off
+                # mid-store): materialize once and take the
+                # vectorized gather path below
+                blk = blk.decode()
+            keep = ~drop
+            if blk.flags is not None:
+                keep &= blk.flags == 0  # tombstones never stay
+            kept = np.flatnonzero(keep)
+            if kept.size == 0:
+                return "skip", None
+            vo = blk.value_offs.astype(np.int64)
+            lens = vo[1:] - vo[:-1]
+            heap_arr = blk.value_heap
+            if not isinstance(heap_arr, np.ndarray):
+                heap_arr = np.frombuffer(heap_arr, dtype=np.uint8)
+            ets_col = new_ets if ets_changed else blk.expire_ts
+            if ets_changed and patch_headers:
+                # patch the big-endian u32 expire_ts value header in
+                # place (vectorized scatter, value_schema.h: header
+                # starts every encoded value)
+                heap_arr = heap_arr.copy()
+                chg = np.flatnonzero((new_ets != blk.expire_ts)
+                                     & keep)
+                if chg.size:
+                    pos = vo[chg]
+                    vals = new_ets[chg].astype(np.uint32)
+                    heap_arr[pos] = (vals >> 24).astype(np.uint8)
+                    heap_arr[pos + 1] = \
+                        ((vals >> 16) & 0xFF).astype(np.uint8)
+                    heap_arr[pos + 2] = \
+                        ((vals >> 8) & 0xFF).astype(np.uint8)
+                    heap_arr[pos + 3] = (vals & 0xFF).astype(np.uint8)
+            if kept.size == n:
+                new_heap = heap_arr
+                new_offs = blk.value_offs
+                keys2d, klen = blk.keys, blk.key_len
+                hlo, flg = blk.hash_lo, blk.flags
+                ets_out = ets_col
+            else:
+                keep_bytes = np.repeat(keep, lens)
+                new_heap = heap_arr[keep_bytes]
+                kept_lens = lens[kept]
+                new_offs = np.zeros(kept.size + 1, dtype=np.uint32)
+                new_offs[1:] = np.cumsum(kept_lens)
+                keys2d = blk.keys[kept]
+                klen = blk.key_len[kept]
+                ets_out = np.asarray(ets_col)[kept]
+                hlo = blk.hash_lo[kept]
+                flg = blk.flags[kept]
+            return "columnar", (keys2d, klen, ets_out, hlo, flg,
+                                new_offs, new_heap, int(kept.size))
+
+        def consume(kind, payload) -> None:
+            """Writer appends, strictly in block order on THIS thread
+            (the writers are single-threaded; ordering is the format
+            contract)."""
+            nonlocal written_in_run
+            if kind == "skip":
+                return
+            if kind == "verbatim":
                 w = roll_writer()
-                w.add_block_columnar(keys2d, klen, ets_out, hlo, flg,
-                                     new_offs, new_heap)
-                written_in_run += kept.size
+                # add_block_encoded transcodes a version the writer's
+                # codec cannot contain (flag moved mid-store)
+                w.add_block_encoded(payload)
+                written_in_run += payload.count
+            elif kind == "copy":
+                copy_block(payload)
+            elif kind == "raw":
+                (buf, hashes, m, vsub, fk, lk), kw = payload
+                w = roll_writer()
+                w.add_block_encoded_raw(buf, m, kw, vsub, fk, lk,
+                                        hashes)
+                written_in_run += m
+            else:
+                w = roll_writer()
+                w.add_block_columnar(*payload[:7])
+                written_in_run += payload[7]
+
+        try:
+            if transform_workers > 0:
+                # ordered lookahead: transforms run CHUNKED on the
+                # pool (one future per ~16 blocks — a future round
+                # trip costs a condition-variable wait, which at one
+                # per block ate the whole overlap win) while results
+                # append in order — the write stage's own intra-stage
+                # parallelism
+                from collections import deque
+
+                CHUNK = 16
+                depth = 2 * transform_workers + 2
+
+                def transform_chunk(chunk):
+                    return [transform(x) for x in chunk]
+
+                tpool = _cf.ThreadPoolExecutor(
+                    max_workers=transform_workers)
+                try:
+                    pend: deque = deque()
+                    chunk: list = []
+                    for item in per_block:
+                        chunk.append(item)
+                        if len(chunk) >= CHUNK:
+                            pend.append(tpool.submit(transform_chunk,
+                                                     chunk))
+                            chunk = []
+                            if len(pend) >= depth:
+                                for r in pend.popleft().result():
+                                    consume(*r)
+                    if chunk:
+                        pend.append(tpool.submit(transform_chunk,
+                                                 chunk))
+                    while pend:
+                        for r in pend.popleft().result():
+                            consume(*r)
+                finally:
+                    tpool.shutdown(wait=True)
+            else:
+                for item in per_block:
+                    consume(*transform(item))
             if writer is not None:
-                _submit_finish(writer)
+                finish_pool.submit(writer)
                 writer = None
-            new_runs = [f.result() for f in finishing]
+            new_runs = finish_pool.results()
             ok = True
         finally:
-            # an exception mid-rewrite must not leak the pool, in-flight
-            # finish futures, a half-written SSTable handle, or —
-            # critically — already-renamed partial l1-*.sst outputs (a
-            # legacy pre-manifest boot would adopt the highest-seq
-            # orphan as the whole L1)
-            finish_pool.shutdown(wait=True)
-            if not ok:
-                for f, w in zip(finishing, finishing_writers):
-                    try:
-                        t = f.result()
-                    except Exception:  # noqa: BLE001 - finish() died
-                        try:
-                            w.abandon()
-                        except Exception:  # noqa: BLE001 - best-effort
-                            pass
-                        continue
-                    try:
-                        t.close()
-                        os.remove(t.path)
-                    except OSError:
-                        pass
-                if writer is not None:
-                    try:
-                        writer.abandon()
-                    except Exception:  # noqa: BLE001 - best-effort
-                        pass
+            finish_pool.shutdown(ok, open_writer=writer)
         # memtable/L0 are untouched by construction
         # (bulk_compact_eligible requires them empty at snapshot time;
         # writes that arrived since stay in the live overlay)
@@ -747,6 +804,62 @@ class LSMStore:
                          publish_lock=publish_lock,
                          mcft=(meta or {}).get(
                              "manual_compact_finish_time", 0))
+
+
+class _FinishPool:
+    """Shared write-stage finisher for both compaction paths: filled
+    runs finish() (flush + fsync + rename + dir-fsync — ~half the wall
+    of a disk-bound compaction) on helper threads while the producer
+    keeps writing the next run; `results()` joins every future BEFORE
+    the manifest publish, so the durability ordering (all runs
+    durable, then manifest) is unchanged. `shutdown(ok=False,
+    open_writer=...)` is the crash cleanup: nothing may leak the pool,
+    in-flight finishes, a half-written handle, or — critically —
+    already-renamed partial l1-*.sst outputs (a legacy pre-manifest
+    boot would adopt the highest-seq orphan as the whole L1)."""
+
+    def __init__(self) -> None:
+        import concurrent.futures as _cf
+
+        self._pool = _cf.ThreadPoolExecutor(max_workers=2)
+        self._futures: list = []
+        self._writers: list = []
+
+    @staticmethod
+    def _finish_one(w) -> "SSTable":
+        w.finish()
+        return SSTable(w.path)
+
+    def submit(self, w) -> None:
+        self._writers.append(w)
+        self._futures.append(self._pool.submit(self._finish_one, w))
+
+    def results(self) -> List["SSTable"]:
+        return [f.result() for f in self._futures]
+
+    def shutdown(self, ok: bool, open_writer=None) -> None:
+        self._pool.shutdown(wait=True)
+        if ok:
+            return
+        for f, w in zip(self._futures, self._writers):
+            try:
+                t = f.result()
+            except Exception:  # noqa: BLE001 - finish() died
+                try:
+                    w.abandon()
+                except Exception:  # noqa: BLE001 - best-effort
+                    pass
+                continue
+            try:
+                t.close()
+                os.remove(t.path)
+            except OSError:
+                pass
+        if open_writer is not None:
+            try:
+                open_writer.abandon()
+            except Exception:  # noqa: BLE001 - best-effort
+                pass
 
 
 class _HeapEntry:

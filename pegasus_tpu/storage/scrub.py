@@ -37,6 +37,14 @@ Gpid = Tuple[int, int]
 
 _SCRUB_CORRUPT = METRICS.entity("storage", "node").counter(
     "scrub_corrupt_blocks")
+# one tick per mid-pass restart caused by a publish (flush / compaction
+# / ingest changed the run set under the cursor): under PIPELINED
+# compaction a single logical compaction bumps the generation more than
+# once (freeze-flush, then the publish cut-over), and the restart logic
+# must collapse that into ONE restart per publish observation — this
+# counter is how the test proves it does
+_SCRUB_RESTART = METRICS.entity("storage", "node").counter(
+    "scrub_restart_count")
 
 
 class ReplicaScrubber:
@@ -132,10 +140,17 @@ class ReplicaScrubber:
                     and self._clock() - last["finished"]
                     < self.pass_interval):
                 return 0  # pass-interval pacing: recently walked
-        if (cur is None or cur["store"] != lsm.store_uid
-                or cur["gen"] != lsm.generation):
-            # fresh pass (or the run set changed mid-pass: restart —
-            # the old cursor points into unlinked files)
+        if cur is not None and (cur["store"] != lsm.store_uid
+                                or cur["gen"] != lsm.generation):
+            # the run set changed mid-pass: restart — the old cursor
+            # points into unlinked files. ONE restart per observed
+            # publish, however many generation bumps the publish's
+            # pipeline stages produced while the scrubber was parked
+            # on the compact_lock skip (freeze-flush + cut-over is
+            # still one logical publish)
+            _SCRUB_RESTART.increment()
+            cur = None
+        if cur is None:
             cur = {"store": lsm.store_uid, "gen": lsm.generation,
                    "table_i": 0, "block_i": 0, "scanned": 0,
                    "started": self._clock(), "structural_done": False}
@@ -144,9 +159,18 @@ class ReplicaScrubber:
         done = 0
         try:
             while done < budget:
+                if engine.compact_lock.locked():
+                    # a compaction started under us: PAUSE, keep the
+                    # cursor — if its publish changes the generation
+                    # the entry check above restarts exactly once;
+                    # if it aborts, the pass resumes where it stopped
+                    return done
                 if lsm.generation != cur["gen"]:
-                    # a publish landed between blocks: restart next tick
-                    del self._cursor[gpid]
+                    # a publish landed between blocks: stop here with
+                    # the stale cursor in place — the next tick's
+                    # entry check restarts (and counts) it exactly
+                    # once, the same path as a publish observed
+                    # between ticks
                     return done
                 if cur["table_i"] >= len(tables):
                     # pass complete

@@ -42,9 +42,12 @@ from pegasus_tpu.storage.vfs import fsync_dir, fsync_file, open_data_file
 from pegasus_tpu.base.crc import crc32, crc64_batch, crc64_rows
 from pegasus_tpu.ops.record_block import next_bucket
 from pegasus_tpu.storage.block_codec import (
+    CODEC_DCZ2,
     CODEC_NONE,
     KNOWN_CODECS,
     EncodedBlock,
+    block_version,
+    codec_accepts,
     encode_block,
     raw_block_size,
 )
@@ -63,16 +66,18 @@ define_flag("pegasus.storage", "block_crc", True,
             "cached hits already paid); files written without block "
             "CRCs keep serving unverified", mutable=True)
 
-define_flag("pegasus.storage", "block_codec", "dcz",
+define_flag("pegasus.storage", "block_codec", "dcz2",
             "per-block compression codec stamped into new SST files "
             "at every writer finish site (flush / merge-compact / "
-            "bulk-compact / ingest): 'dcz' = dictionary-coded hashkey "
+            "bulk-compact / ingest): 'dcz2' = dictionary-coded hashkey "
             "column + packed sortkeys + compressed value heap (zstd-1, "
-            "zlib-1 fallback) with direct "
-            "compute on the encoded form; 'none' = the legacy raw "
-            "columnar layout, bit-for-bit. Files written before this "
-            "flag existed (or with an unknown codec) keep serving / "
-            "are refused at open respectively", mutable=True)
+            "zlib-1 fallback) + FOR/delta expire_ts + dict-indexed "
+            "hash_lo, with direct compute on the encoded form; 'dcz' = "
+            "the PR 7 layout (raw uint32 predicate columns); 'none' = "
+            "the legacy raw columnar layout, bit-for-bit. Files "
+            "written before this flag existed (or with an unknown "
+            "codec) keep serving / are refused at open respectively",
+            mutable=True)
 
 define_flag("pegasus.storage", "block_cache_bytes", 33_554_432,
             "per-table decoded-block cache budget in bytes (LRU). "
@@ -256,6 +261,9 @@ class SSTableWriter:
         # codec latch: one file is wholly one codec (the index names it
         # once); a mutable flag flip mid-write cannot tear a table
         self.codec = block_codec()
+        # block format version this writer EMITS; the file may still
+        # verbatim-carry older versions its codec accepts
+        self.codec_version = 2 if self.codec == CODEC_DCZ2 else 1
         self._codec_raw_bytes = 0     # logical (raw-format) bytes
         self._codec_stored_bytes = 0  # bytes actually written
         self._key_hashes: List[np.ndarray] = []
@@ -355,7 +363,7 @@ class SSTableWriter:
                 flags.tobytes(), offs.tobytes(), heap))
         else:
             buf = encode_block(keys, key_len, ets, hash_lo, flags,
-                               offs, heap)
+                               offs, heap, version=self.codec_version)
             self._codec_raw_bytes += raw_block_size(n, width, len(heap))
             self._codec_stored_bytes += len(buf)
         self._write(buf)
@@ -396,7 +404,8 @@ class SSTableWriter:
                 heap))
         else:
             buf = encode_block(keys, key_len, ets, hash_lo, flags,
-                               value_offs, heap)
+                               value_offs, heap,
+                               version=self.codec_version)
             self._codec_raw_bytes += raw_block_size(n, width, len(heap))
             self._codec_stored_bytes += len(buf)
         self._write(buf)
@@ -419,6 +428,17 @@ class SSTableWriter:
                              "must decode first")
         n = enc.n
         if n == 0:
+            return
+        if not codec_accepts(self.codec, enc.version):
+            # a 'dcz' writer may not embed a v2 block (an old build
+            # reading the file would misparse it): transcode down
+            # through the columnar path — decode never inflates the
+            # value heap until the encoder's compress probe reads it
+            blk = enc.decode()
+            self.add_block_columnar(blk.keys, blk.key_len,
+                                    blk.expire_ts, blk.hash_lo,
+                                    blk.flags, blk.value_offs,
+                                    blk.value_heap)
             return
         self._flush_block()
         first_key = enc.key_at(0)
@@ -445,6 +465,14 @@ class SSTableWriter:
                              "must decode first")
         if n == 0:
             return
+        if not codec_accepts(self.codec, block_version(buf)):
+            # callers (lsm's subset fast path) pre-check compatibility;
+            # reaching here means a version this file's named codec
+            # cannot legally contain — refuse rather than write a file
+            # that other builds would misparse
+            raise ValueError(
+                f"block format v{block_version(buf)} cannot be stored "
+                f"in a {self.codec!r} file")
         self._flush_block()
         if self._last_key is not None and first_key <= self._last_key:
             raise ValueError("blocks must be added in key order")

@@ -25,7 +25,7 @@ verbs, parity: the linenoise REPL + `use`). Command families:
                app_disk, ddd_diagnose, propose, rebalance, offline_node,
                get/set_meta_level, detect_hotkey, remote_command,
                slow_queries, metrics, storage_stats, disk_health,
-               scrub, hot_partitions
+               scrub, hot_partitions, compact_sched
   offline    : sst_dump, mlog_dump, local_get, rdb_key_str2hex,
                rdb_key_hex2str, rdb_value_hex2str
 
@@ -270,6 +270,10 @@ def main(argv=None) -> int:
     p = sub.add_parser("hot_partitions")
     p.add_argument("table", nargs="?", default="",
                    help="one table, or the whole cluster when omitted")
+    sub.add_parser("compact_sched",
+                   help="the meta compaction coordinator's stagger "
+                        "state: granted/waiting nodes + per-node "
+                        "demand reports")
     p = sub.add_parser("rebalance", aliases=["balance"])
     p = sub.add_parser("offline_node")
     p.add_argument("node", help="drain all primaries off this node")
@@ -1221,11 +1225,18 @@ def _dispatch(args, box, out) -> int:
             })
         node_wide = [s["metrics"]
                      for s in METRICS.snapshot("storage")] or [{}]
+        # round-12: the compaction pipeline's stage counters
+        # (compact_{read,filter,write}_stall_ms, queue depths,
+        # compaction_bytes_per_s) land in the node-wide `storage`
+        # block above; `compaction` is the governor's live throttle /
+        # grant state
+        from pegasus_tpu.storage.compact_governor import GOVERNOR
         print(json.dumps({
             "partitions": rows,
             "storage": {n: m.get("value", 0)
                         for n, m in node_wide[0].items()},
             "row_cache": ROW_CACHE.stats(),
+            "compaction": GOVERNOR.status(),
         }, indent=1), file=out)
     elif args.cmd == "backup":
         from pegasus_tpu.server.backup import BackupEngine
@@ -1453,6 +1464,12 @@ def _dispatch(args, box, out) -> int:
         for row in status.pop("partitions", []):
             print(json.dumps(row), file=out)
         print(json.dumps(status, indent=1), file=out)
+    elif args.cmd == "compact_sched":
+        # the cluster background-IO scheduler's meta half: who holds
+        # the heavy-compaction grant, who waits, what each node
+        # reported (running / waiting / paced bytes_per_s)
+        print(json.dumps(box.admin.call("compact_sched"), indent=1),
+              file=out)
     elif args.cmd == "rebalance":
         n = box.admin.call("rebalance")
         print(f"OK: {n} proposals", file=out)

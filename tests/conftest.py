@@ -43,3 +43,25 @@ if _devs != {"cpu"}:
         f"conftest failed to isolate tests from the TPU tunnel: {_devs}")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _reset_compaction_governor():
+    """The compaction governor is a process singleton (one per node in
+    real deployments); in-process sim clusters share it, so a cluster
+    stagger grant issued in one test must not gate env-triggered
+    compactions in the next."""
+    yield
+    try:
+        from pegasus_tpu.storage.compact_governor import GOVERNOR
+    except Exception:  # noqa: BLE001 - package not imported by this test
+        return
+    GOVERNOR._grant = None
+    GOVERNOR._heavy_waiting = False
+    GOVERNOR.heavy_running = 0
+    GOVERNOR._throttle_mbps = 0.0
+    GOVERNOR._engaged_at_mbps = 0.0
+    GOVERNOR._pressure_last = None
