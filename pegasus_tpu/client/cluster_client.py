@@ -52,6 +52,7 @@ from pegasus_tpu.server.types import (
     SCAN_CONTEXT_ID_COMPLETED,
     SCAN_CONTEXT_ID_NOT_EXIST,
 )
+from pegasus_tpu.utils import tracing
 from pegasus_tpu.utils.errors import ErrorCode, PegasusError, StorageStatus
 from pegasus_tpu.utils.flags import FLAGS, define_flag
 
@@ -132,11 +133,20 @@ class ClusterClient:
         self.partition_count = 0
         self._configs: List[dict] = []
         self.auth = tuple(auth) if auth else None
+        # distributed tracing: the op-level root span (one per client
+        # API call; nested helpers — batch_get's per-group _read legs —
+        # ride the outer op's trace instead of minting their own)
+        self._cur_span = None
         net.register(name, self._on_message)
 
     # ---- transport plumbing -------------------------------------------
 
     def _on_message(self, src: str, msg_type: str, payload) -> None:
+        if isinstance(payload, dict):
+            # tail-keep propagation: a reply stamped KEEP by a hop that
+            # crossed the slow threshold pins this trace here too —
+            # slow traces stay whole at every upstream hop
+            tracing.on_inbound_ctx(self.name, payload.get("trace"))
         if msg_type in ("client_read_reply", "client_write_reply",
                         "query_config_reply", "negotiate_reply"):
             rid = payload.get("rid")
@@ -146,6 +156,19 @@ class ClusterClient:
             if rid in self._pending:
                 self._replies[rid] = payload
 
+    def _traced(self, name: str, fn, *args):
+        """Run one client op under a sampled root span (or plain when
+        sampling says no / an outer op's span already governs)."""
+        if self._cur_span is not None or not tracing.maybe_sample():
+            return fn(*args)
+        span = tracing.ring_for(self.name).start(name)
+        self._cur_span = span
+        try:
+            return fn(*args)
+        finally:
+            self._cur_span = None
+            span.finish()
+
     def _send_request(self, dst: str, msg_type: str, payload: dict,
                       deadline: Optional[float] = None) -> int:
         rid = next(self._rids)
@@ -154,6 +177,11 @@ class ClusterClient:
             # absolute, on the cluster's shared timebase: the transport
             # dispatcher and replica gates fast-fail work past it
             payload["deadline"] = deadline
+        if self._cur_span is not None:
+            # the op's trace context rides every request it issues
+            # (explicit — the client never leaves a span ambient, so
+            # unrelated timer traffic pumped while we wait stays clean)
+            payload["trace"] = self._cur_span.ctx()
         self._pending.add(rid)
         self.net.send(self.name, dst, msg_type, payload)
         return rid
@@ -242,6 +270,12 @@ class ClusterClient:
     def _read(self, op: str, args: Any, pidx: int,
               partition_hash: Optional[int] = None,
               deadline: Optional[float] = None) -> Any:
+        return self._traced(f"client.{op}", self._read_impl, op, args,
+                            pidx, partition_hash, deadline)
+
+    def _read_impl(self, op: str, args: Any, pidx: int,
+                   partition_hash: Optional[int] = None,
+                   deadline: Optional[float] = None) -> Any:
         """`deadline`: inherited when this read is one leg of a larger
         op (batch_get) — the outer op's single end-to-end bound governs,
         never a freshly minted per-leg window."""
@@ -295,6 +329,11 @@ class ClusterClient:
 
     def _write(self, ops: List[Tuple[int, Any]],
                partition_hash: int) -> List[Any]:
+        return self._traced("client.write", self._write_impl, ops,
+                            partition_hash)
+
+    def _write_impl(self, ops: List[Tuple[int, Any]],
+                    partition_hash: int) -> List[Any]:
         from pegasus_tpu.replica.mutation import ATOMIC_OPS
 
         self._ensure_config()
@@ -430,6 +469,11 @@ class ClusterClient:
 
     def batch_get(self, keys: Sequence[Tuple[bytes, bytes]]
                   ) -> Tuple[int, List[Tuple[bytes, bytes, bytes]]]:
+        return self._traced("client.batch_get", self._batch_get_impl,
+                            keys)
+
+    def _batch_get_impl(self, keys: Sequence[Tuple[bytes, bytes]]
+                        ) -> Tuple[int, List[Tuple[bytes, bytes, bytes]]]:
         self._ensure_config()
         deadline = self._deadline()
         out: List[Tuple[bytes, bytes, bytes]] = []
@@ -522,6 +566,10 @@ class ClusterClient:
         stacks its partitions' blocks into one device evaluation
         (SURVEY §2.6's partitions-as-batch-dimension model). Returns
         {pidx: [ScanResponse]}."""
+        return self._traced("client.scan_multi", self._scan_multi_impl,
+                            groups)
+
+    def _scan_multi_impl(self, groups: Dict[int, list]):
         self._ensure_config()
         out: Dict[int, list] = {}
         deadline = self._deadline()
@@ -599,6 +647,10 @@ class ClusterClient:
         (ERR_PARENT_PARTITION_MISUSED from the per-op gate) re-resolves
         just that op — matching the solo path's transparent re-resolve
         instead of surfacing the routing error to the application."""
+        return self._traced("client.point_read_multi",
+                            self._point_read_multi_impl, groups)
+
+    def _point_read_multi_impl(self, groups: Dict[int, list]):
         self._ensure_config()
         items = [(orig_pidx, i, op)
                  for orig_pidx, ops in groups.items()
@@ -687,6 +739,10 @@ class ClusterClient:
         that op. A LOST reply is ambiguous for atomic ops in flight on
         that node (they may have committed) — surfaced as ERR_TIMEOUT
         instead of retried, like the solo path."""
+        return self._traced("client.write_multi",
+                            self._write_multi_impl, groups)
+
+    def _write_multi_impl(self, groups: Dict[int, list]):
         from pegasus_tpu.replica.mutation import ATOMIC_OPS
 
         self._ensure_config()

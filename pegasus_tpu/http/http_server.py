@@ -32,6 +32,15 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
+    def _reply_text(self, code: int, text: str,
+                    content_type: str) -> None:
+        body = text.encode()
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
     def do_GET(self):
         url = urlparse(self.path)
         query = parse_qs(url.query)
@@ -117,7 +126,17 @@ class _Handler(BaseHTTPRequestHandler):
                                     query.get("entity_type", [None]))[0]
             names = query.get("with_metrics", query.get("metrics", [None]))[0]
             metric_names = names.split(",") if names else None
-            self._reply(200, METRICS.snapshot(entity_type, metric_names))
+            snap = METRICS.snapshot(entity_type, metric_names)
+            if query.get("format", [None])[0] == "prom":
+                # Prometheus text exposition (standard scrapers; the
+                # collector->Prometheus sink path). JSON stays default.
+                from pegasus_tpu.utils.metrics import to_prometheus
+
+                self._reply_text(
+                    200, to_prometheus(snap),
+                    "text/plain; version=0.0.4; charset=utf-8")
+            else:
+                self._reply(200, snap)
         else:
             self._reply(404, {"error": f"unknown path {url.path}"})
 

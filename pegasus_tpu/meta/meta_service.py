@@ -59,6 +59,10 @@ class MetaService:
         # replicas are the recovery source of truth (parity: shell
         # `recover` from replica list, commands.h:209)
         self._stored_reports: Dict[str, list] = {}
+        # latest tail-kept slow-trace summary per node (rides the same
+        # config_sync report): `shell traces --slow` reads the whole
+        # cluster's kept roots with ONE meta admin call
+        self._trace_reports: Dict[str, dict] = {}
         # in-flight learner adds: gpid -> (learner, started_at); prevents
         # every guardian tick from restarting a slow learn from scratch
         self._pending_learns: Dict[Gpid, Tuple[str, float]] = {}
@@ -396,6 +400,12 @@ class MetaService:
                     args.get("app_name", ""))
             elif cmd == "compact_sched":
                 result = self.compaction.status()
+            elif cmd == "slow_traces":
+                # per-node tail-kept trace roots, newest last (the
+                # `shell traces --slow` surface; full spans fan out on
+                # demand via the trace-dump remote command)
+                result = {n: dict(t) for n, t in
+                          sorted(self._trace_reports.items())}
             elif cmd == "del_app_envs":
                 result = self.del_app_envs(args["app_name"], args["keys"])
             elif cmd == "clear_app_envs":
@@ -499,6 +509,8 @@ class MetaService:
         partition's member list may be an in-flight learner."""
         node = payload["node"]
         self._stored_reports[node] = list(payload.get("stored", []))
+        if "trace_report" in payload:
+            self._trace_reports[node] = payload["trace_report"]
         # elasticity detect phase: the same report carries per-partition
         # capacity units + hotkey results and the node's pressure counts
         self.elasticity.on_report(node, payload)

@@ -132,10 +132,16 @@ class WriteFlushWindow:
     def queue_replica_msg(self, dst: str, msg_type: str, gpid,
                           payload) -> bool:
         """Divert an aggregatable replica message into the window's
-        per-peer batch; False = caller sends solo."""
+        per-peer batch; False = caller sends solo. Each item captures
+        its own trace context at queue time — a prepare_batch carries
+        many partitions' 2PC legs, each on its OWN trace, so the
+        context must travel per item, not per carrier message."""
         if not self.active or msg_type not in _AGGREGATED:
             return False
-        self._agg.setdefault((dst, msg_type), []).append((gpid, payload))
+        from pegasus_tpu.utils.tracing import current_ctx
+
+        self._agg.setdefault((dst, msg_type), []).append(
+            (gpid, payload, current_ctx()))
         return True
 
     # ---- flush ---------------------------------------------------------
@@ -180,9 +186,13 @@ class WriteFlushWindow:
             for (dst, kind), items in agg.items():
                 self._prepare_batch_size.set(len(items))
                 if len(items) == 1:
-                    gpid, payload = items[0]
+                    gpid, payload, ctx = items[0]
                     self.net.send(self.node, dst, "replica", {
-                        "gpid": gpid, "type": kind, "payload": payload})
+                        "gpid": gpid, "type": kind, "payload": payload,
+                        "trace": ctx})
                 else:
+                    # trace: None suppresses ambient stamping — the
+                    # carrier spans MANY traces (one per item ctx); a
+                    # single carrier-level context would be a lie
                     self.net.send(self.node, dst, _AGGREGATED[kind],
-                                  {"items": items})
+                                  {"items": items, "trace": None})

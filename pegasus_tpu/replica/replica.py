@@ -180,6 +180,11 @@ class Replica:
         # ONE app-env threshold (replica.slow_query_threshold_ms) governs
         # reads and writes alike
         self._traces: Dict[int, Any] = {}
+        # distributed tracing: per-peer prepare hop spans, keyed
+        # (decree, peer) — opened at prepare send, closed at ack (the
+        # hop whose self-time exposes a lagging secondary)
+        self._prepare_spans: Dict[Tuple[int, str], Any] = {}
+        self._write_latency = None  # lazy per-table percentile
         self.slow_log = self.server.slow_log
         # node-level write flush window (group_commit.WriteFlushWindow),
         # set by the hosting stub: plog appends stage under its shared
@@ -274,6 +279,9 @@ class Replica:
         self._pending_acks.clear()
         self._client_callbacks.clear()
         self._traces.clear()
+        for psp in self._prepare_spans.values():
+            psp.finish()  # hops die with the primaryship; record them
+        self._prepare_spans.clear()
         # queued writes die unacked with the primaryship (clients retry)
         self._write_queue.clear()
         self._queued_ops.clear()
@@ -407,10 +415,19 @@ class Replica:
         # reserve one microsecond PER OP: duplication stamps op i with
         # ts + i, and the next mutation must not overlap those timetags
         self._last_timestamp_us = ts + max(len(ops), 1) - 1
+        from pegasus_tpu.utils import tracing
         from pegasus_tpu.utils.latency_tracer import LatencyTracer
 
+        # the write's own span (child of the carrier RPC's dispatch
+        # span): it outlives this call — acks arrive in later dispatches
+        # — and closes when the client reply goes out, so the reply send
+        # carries this trace's context (and its tail-keep bit) upstream
+        wspan = tracing.child_of(
+            tracing.current_span(),
+            f"2pc.{self.server.app_id}.{self.server.pidx}.d{decree}")
         tracer = LatencyTracer(f"write.{self.server.app_id}."
-                               f"{self.server.pidx}.d{decree}")
+                               f"{self.server.pidx}.d{decree}",
+                               span=wspan)
         self._traces[decree] = tracer
         if idem_responses is not None:
             self._idempotent_responses[decree] = idem_responses
@@ -442,6 +459,7 @@ class Replica:
             # runs after the group-commit window hardened the plog (a
             # primary must not send prepares — or ack a zero-member
             # round — before its own log write is durable)
+            tracer.add_point("plog_durable")
             self._send_prepares(mu)
             tracer.add_point("prepares_sent")
             if not targets:
@@ -460,12 +478,28 @@ class Replica:
         return targets
 
     def _send_prepares(self, mu: Mutation) -> None:
+        from pegasus_tpu.utils import tracing
+
         targets = self._prepare_targets(mu.decree)
         if not targets:
             return  # single-replica: skip the dead encode entirely
         blob = mu.encode()
+        tracer = self._traces.get(mu.decree)
+        wspan = tracer.span if tracer is not None else None
         for dst in targets:
-            self.transport.send(self.name, dst, "prepare", blob)
+            psp = None
+            if wspan is not None:
+                key = (mu.decree, dst)
+                psp = self._prepare_spans.get(key)
+                if psp is None:
+                    # per-peer prepare hop: send -> ack received. Its
+                    # SELF time is the wire+peer latency — the span a
+                    # lagging secondary shows up in. Re-sends (group
+                    # check recovery) extend the same span.
+                    psp = tracing.child_of(wspan, f"prepare.{dst}")
+                    self._prepare_spans[key] = psp
+            with tracing.activate(psp):
+                self.transport.send(self.name, dst, "prepare", blob)
 
     # ---- 2PC message handlers -----------------------------------------
 
@@ -564,6 +598,9 @@ class Replica:
         tracer = self._traces.get(decree)
         if tracer is not None:
             tracer.add_point(f"ack.{src}")
+        psp = self._prepare_spans.pop((decree, src), None)
+        if psp is not None:
+            psp.finish()
         if not pending:
             del self._pending_acks[decree]
             self._on_decree_ready(decree)
@@ -768,16 +805,35 @@ class Replica:
                 ws.apply_items(items, mu.decree, wal_flush=False)
             else:
                 ws.apply_items(items, mu.decree)
+        from pegasus_tpu.utils import tracing
+
         tracer = self._traces.pop(mu.decree, None)
+        wspan = tracer.span if tracer is not None else None
+        if wspan is not None:
+            # members that never acked (removed mid-round): close their
+            # hop spans at apply so the trace is whole
+            for key in [k for k in self._prepare_spans
+                        if k[0] == mu.decree]:
+                self._prepare_spans.pop(key).finish()
         if tracer is not None:
             tracer.add_point("committed_applied")
         callback = self._client_callbacks.pop(mu.decree, None)
         override = self._idempotent_responses.pop(mu.decree, None)
         if callback is not None:
-            callback(override if override is not None else responses)
+            # the client reply goes out under the write's span so it
+            # carries this trace's context — and, when any hop crossed
+            # the slow threshold, the tail-keep bit — back upstream
+            with tracing.activate(wspan):
+                callback(override if override is not None else responses)
         if tracer is not None:
             tracer.add_point("replied")
             self.slow_log.observe(tracer)
+            if self._write_latency is None:
+                self._write_latency = self.server.metrics.percentile(
+                    "write_latency_ms")
+            self._write_latency.set(tracer.total_ms())
+        if wspan is not None:
+            wspan.finish()
 
     def has_ingested(self, load_id: int) -> bool:
         """Group-visible ingest dedup: the marker is written by EVERY

@@ -251,6 +251,27 @@ class ReplicaStub:
             "per-task-code profiler toollet: enable|disable|clear|dump "
             "(queue/exec latency + qps per message type)")
 
+        def trace_dump(args):
+            # the cross-node stitch's fan-out target: this node's span
+            # ring (+ tail-kept traces), optionally one trace only
+            from pegasus_tpu.utils import tracing
+
+            return tracing.ring_for(self.name).dump(
+                args[0] if args else None)
+
+        def trace_list(args):
+            from pegasus_tpu.utils import tracing
+
+            limit = int(args[0]) if args else 16
+            return tracing.ring_for(self.name).slow_roots(limit)
+
+        self.commands.register(
+            "trace-dump", trace_dump,
+            "dump this node's spans (arg: one trace id) for stitching")
+        self.commands.register(
+            "trace-list", trace_list,
+            "list this node's tail-kept slow trace roots [limit]")
+
         def fs_stats(_args):
             return self.fs.stats()
 
@@ -572,10 +593,21 @@ class ReplicaStub:
         """Transport flush-window delivery for writes: a consecutive
         run of queued client_write messages shares ONE group-commit
         window — one plog flush/fsync and one prepare_batch per peer
-        for the whole run."""
+        for the whole run. Each message keeps its own dispatch span
+        parented to its own carried context (the transport's batch
+        drain skips the generic per-message join point)."""
+        from pegasus_tpu.utils import tracing
+
         with self.write_window:
             for src, payload in items:
-                self._on_client_write(src, payload)
+                span = tracing.start_server_span(
+                    self.name, "client_write", payload.get("trace"))
+                try:
+                    with tracing.activate(span):
+                        self._on_client_write(src, payload)
+                finally:
+                    if span is not None:
+                        span.finish()
 
     def _dispatch_message(self, src: str, msg_type: str, payload) -> None:
         if msg_type == "replica":
@@ -599,18 +631,37 @@ class ReplicaStub:
             return
         if msg_type in ("prepare_batch", "prepare_batch_ack"):
             # aggregated 2PC fan-out (group_commit): one message carries
-            # (gpid, payload) items for many partitions; items route in
-            # order to each partition's solo handler, and our own acks
-            # re-aggregate under the already-open flush window
+            # (gpid, payload, trace-ctx) items for many partitions;
+            # items route in order to each partition's solo handler, and
+            # our own acks re-aggregate under the already-open flush
+            # window. Tracing: every batched item keeps its OWN span
+            # parented to its own hop context — N legs in one carrier
+            # yield N spans, never N carriers
+            from pegasus_tpu.utils import tracing
+
             kind = ("prepare" if msg_type == "prepare_batch"
                     else "prepare_ack")
-            for gpid, item in payload["items"]:
+            for entry in payload["items"]:
+                gpid, item = entry[0], entry[1]
+                ctx = entry[2] if len(entry) > 2 else None
                 r = self.replicas.get(tuple(gpid))
-                if r is not None:
-                    try:
+                if r is None:
+                    continue
+                span = None
+                if ctx is not None:
+                    if kind == "prepare_ack":
+                        tracing.on_inbound_ctx(self.name, ctx)
+                    else:
+                        span = tracing.start_server_span(
+                            self.name, f"replica.{kind}", ctx)
+                try:
+                    with tracing.activate(span):
                         r.on_message(src, kind, item)
-                    except (StorageCorruptionError, OSError) as e:
-                        self._on_storage_error(tuple(gpid), e)
+                except (StorageCorruptionError, OSError) as e:
+                    self._on_storage_error(tuple(gpid), e)
+                finally:
+                    if span is not None:
+                        span.finish()
             return
         if msg_type == "negotiate":
             # SASL-style connection auth handshake (negotiation.h:37).
@@ -879,8 +930,14 @@ class ReplicaStub:
                 "rid": rid, "err": int(ErrorCode.ERR_TIMEOUT),
                 "result": None})
             return
+        from pegasus_tpu.utils import tracing
+
         groups = payload.get("groups") or []
         slots: list = []
+        # batching-seam fan-out (write side): every batched item keeps
+        # its own span under the carrier's dispatch span; the shared
+        # 2PC rounds (combined runs) hang off the carrier too
+        carrier = tracing.current_span()
         state = {"outstanding": 0, "armed": False, "replied": False}
 
         def maybe_reply() -> None:
@@ -948,23 +1005,41 @@ class ReplicaStub:
             # mutation); atomic ops ride alone, submission order kept
             run_spans: list = []
             run_ops: list = []
+            item_spans: list = []
             for i, (raw_ops, ph, dl) in enumerate(items):
+                ispan = None
+                if carrier is not None:
+                    # per-item span opened around THIS item's handling
+                    # (gates + its submission leg), so a gated item is
+                    # visibly near-zero and items keep distinct windows
+                    ispan = tracing.child_of(carrier,
+                                             f"op.write.{gpid[1]}")
+                    item_spans.append(ispan)
                 if self._deadline_expired(
                         {"deadline": dl if dl is not None
                          else payload.get("deadline")}):
                     # per-op deadline: THIS op fast-fails before its
                     # 2PC starts; its window neighbors proceed
                     item_res[i] = (int(ErrorCode.ERR_TIMEOUT), [])
+                    if ispan is not None:
+                        ispan.tags["gated"] = "deadline"
+                        ispan.finish()
                     continue
                 gate = r.server._hash_gate(ph)
                 if gate:
                     item_res[i] = (gate, [])
+                    if ispan is not None:
+                        ispan.tags["gated"] = "hash"
+                        ispan.finish()
                     continue
                 sgate = r.server._write_gate()
                 if sgate:
                     # deny/throttle are STORAGE statuses per op, same
                     # as the solo handler's [sgate] * len(ops) reply
                     item_res[i] = (ok, [sgate] * len(raw_ops))
+                    if ispan is not None:
+                        ispan.tags["gated"] = "throttle"
+                        ispan.finish()
                     continue
                 wos = [WriteOp(op, req) for op, req in raw_ops]
                 atomic = any(wo.op in ATOMIC_OPS for wo in wos)
@@ -973,10 +1048,14 @@ class ReplicaStub:
                     run_spans, run_ops = [], []
                 if atomic:
                     submit([(i, len(wos))], wos)
+                    if ispan is not None:
+                        ispan.finish()  # its leg submitted inline
                 else:
                     run_spans.append((i, len(wos)))
                     run_ops.extend(wos)
             submit(run_spans, run_ops)
+            for sp in item_spans:
+                sp.finish()  # idempotent: gated/atomic already closed
         state["armed"] = True
         maybe_reply()
 
@@ -1091,13 +1170,24 @@ class ReplicaStub:
             is_point_read,
             point_read_multi,
         )
+        from pegasus_tpu.utils import tracing
         from pegasus_tpu.utils.errors import ErrorCode
 
-        flush: list = []  # (src, payload, server) past the gates
+        flush: list = []  # (src, payload, server, span) past the gates
         for src, payload in items:
             op = payload.get("op", "get")
+            ctx = payload.get("trace")
             if not is_point_read(op, payload.get("args")):
-                self._on_client_read(src, payload)
+                # solo fallback still gets its dispatch span (the
+                # transport's batch drain skipped the generic one)
+                span = tracing.start_server_span(
+                    self.name, "client_read", ctx)
+                try:
+                    with tracing.activate(span):
+                        self._on_client_read(src, payload)
+                finally:
+                    if span is not None:
+                        span.finish()
                 continue
             err, r = self._client_read_gate(payload, src)
             if err is not None:
@@ -1105,11 +1195,16 @@ class ReplicaStub:
                     "rid": payload.get("rid"), "err": err,
                     "result": None})
                 continue
-            flush.append((src, payload, r.server))
+            # per-message span parented to its OWN context: a flush
+            # coalesces reads from many independent traces — each op
+            # keeps its span, the flush never becomes one carrier
+            flush.append((src, payload, r.server,
+                          tracing.start_server_span(
+                              self.name, "client_read", ctx)))
         if not flush:
             return
         groups: dict = {}
-        for i, (_src, _payload, server) in enumerate(flush):
+        for i, (_src, _payload, server, _sp) in enumerate(flush):
             groups.setdefault(id(server), (server, []))[1].append(i)
         pairs = [(server, [(flush[i][1].get("op", "get"),
                             flush[i][1].get("args"),
@@ -1123,22 +1218,33 @@ class ReplicaStub:
         # round-trip. The explicit batch RPC passes its deadline down
         # because there one deadline really does govern the whole batch.
         try:
-            results = point_read_multi(pairs)
-        except (ValueError, RuntimeError, OSError):
-            # malformed op in the flush — or a corrupt block / failing
-            # disk under ONE member: re-serve each solo so every
-            # request gets its own precise error instead of a shared
-            # one (the solo path carries the typed corruption handling
-            # and quarantines exactly the sick replica)
-            for src, payload, _srv in flush:
-                self._on_client_read(src, payload)
-            return
-        for (_server, idxs), res in zip(groups.values(), results):
-            for i, result in zip(idxs, res):
-                src, payload, _srv = flush[i]
-                self.net.send(self.name, src, "client_read_reply", {
-                    "rid": payload.get("rid"),
-                    "err": int(ErrorCode.ERR_OK), "result": result})
+            try:
+                results = point_read_multi(pairs)
+            except (ValueError, RuntimeError, OSError):
+                # malformed op in the flush — or a corrupt block /
+                # failing disk under ONE member: re-serve each solo so
+                # every request gets its own precise error instead of a
+                # shared one (the solo path carries the typed corruption
+                # handling and quarantines exactly the sick replica)
+                for src, payload, _srv, span in flush:
+                    with tracing.activate(span):
+                        self._on_client_read(src, payload)
+                return
+            for (_server, idxs), res in zip(groups.values(), results):
+                for i, result in zip(idxs, res):
+                    src, payload, _srv, span = flush[i]
+                    # the reply rides this op's span context (tail-keep
+                    # bit included) back to its client
+                    with tracing.activate(span):
+                        self.net.send(
+                            self.name, src, "client_read_reply", {
+                                "rid": payload.get("rid"),
+                                "err": int(ErrorCode.ERR_OK),
+                                "result": result})
+        finally:
+            for _src, _payload, _srv, span in flush:
+                if span is not None:
+                    span.finish()
 
     def _on_client_read_batch_rpc(self, src: str, payload: dict) -> None:
         """Explicitly batched point reads from the cluster client: one
@@ -1175,6 +1281,19 @@ class ReplicaStub:
                 continue
             slots.append((gpid[1], int(ErrorCode.ERR_OK), None))
             ok.append((len(slots) - 1, r.server, ops))
+        # batching-seam fan-out: each op in the carrier gets its own
+        # span parented to the CARRIER's dispatch span — N ops in one
+        # carrier yield N child spans, never N carriers
+        from pegasus_tpu.utils import tracing
+
+        carrier = tracing.current_span()
+        op_spans: list = []
+        if carrier is not None:
+            for _slot_i, srv, ops in ok:
+                op_spans.extend(
+                    tracing.child_of(carrier,
+                                     f"op.{o[0]}.{srv.pidx}")
+                    for o in ops)
         if ok:
             try:
                 results = point_read_multi(
@@ -1216,6 +1335,9 @@ class ReplicaStub:
                 for (slot_i, _srv, _ops), res in zip(ok, results):
                     slots[slot_i] = (slots[slot_i][0],
                                      int(ErrorCode.ERR_OK), res)
+            finally:
+                for sp in op_spans:
+                    sp.finish()
         self.net.send(self.name, src, "client_read_reply", {
             "rid": rid, "err": int(ErrorCode.ERR_OK), "result": slots})
 
@@ -1775,10 +1897,24 @@ class ReplicaStub:
 
         GOVERNOR.poke()
         compaction = GOVERNOR.report()
+        # tail-kept slow-trace summaries ride the EXISTING config-sync
+        # channel so `shell traces --slow` is ONE meta call instead of a
+        # cluster-wide fan-out (the full spans still fan out on demand
+        # via the trace-dump verb)
+        from pegasus_tpu.utils import tracing
+
+        ring = tracing.ring_for(self.name)
+        trace_report = {
+            "kept": ring.kept_count.value(),
+            "roots": ring.slow_roots(limit=16),
+        }
         for meta in self._meta_targets():
             self.net.send(self.name, meta, "config_sync", {
                 "node": self.name, "stored": stored,
-                "pressure": pressure, "compaction": compaction})
+                "pressure": pressure, "compaction": compaction,
+                # NB: key must not be "trace" — that's the wire slot
+                # for the distributed-tracing context
+                "trace_report": trace_report})
 
     def _on_config_sync_reply(self, src: str, payload: dict) -> None:
         import shutil

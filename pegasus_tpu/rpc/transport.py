@@ -25,6 +25,7 @@ per-connection FIFO.
 
 from __future__ import annotations
 
+import logging
 import queue
 import socket
 import threading
@@ -32,9 +33,40 @@ import time
 from typing import Any, Callable, Dict, Optional, Tuple
 
 from pegasus_tpu.rpc.message import decode_message, encode_message, read_frames
+from pegasus_tpu.utils import tracing
 from pegasus_tpu.utils.flags import FLAGS, define_flag
 
 Addr = Tuple[str, int]
+
+_LOG = logging.getLogger("pegasus.rpc")
+
+
+class _RateLimitedLog:
+    """Structured transport-failure logging with per-site rate limiting:
+    a dead peer's reconnect loop must produce one countable line per
+    interval, not a stdout traceback per queued frame."""
+
+    def __init__(self, interval_s: float = 1.0) -> None:
+        self._interval = interval_s
+        self._last: Dict[str, float] = {}
+        self._suppressed: Dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    def log(self, site: str, exc: BaseException) -> None:
+        with self._lock:
+            now = time.monotonic()
+            self._suppressed[site] = self._suppressed.get(site, 0) + 1
+            if now - self._last.get(site, float("-inf")) < self._interval:
+                return
+            n = self._suppressed.pop(site, 1)
+            self._last[site] = now
+        _LOG.error("transport site=%s err=%s.%s msg=%r count=%d",
+                   site, type(exc).__module__, type(exc).__name__,
+                   str(exc), n,
+                   exc_info=not isinstance(exc, OSError))
+
+
+_RL_LOG = _RateLimitedLog()
 
 import itertools as _itertools
 _SESSION_IDS = _itertools.count(1)
@@ -109,6 +141,13 @@ class TcpTransport:
         # installed plan only acts while FAIL_POINTS is enabled
         self.fault_plan = None
         self._threads: list = []
+        # transport failure observability (node rpc entity): failures
+        # are countable instead of stdout traceback noise
+        from pegasus_tpu.utils.metrics import METRICS
+
+        _rpc_ent = METRICS.entity("rpc", "dispatch", {})
+        self._dispatch_errors = _rpc_ent.counter("dispatch_error_count")
+        self._sender_errors = _rpc_ent.counter("sender_error_count")
         self._listener: Optional[socket.socket] = None
         self.listen_addr: Optional[Addr] = None
         if listen is not None:
@@ -175,6 +214,15 @@ class TcpTransport:
             verdict = plan.outbound(src, dst, msg_type)
             if verdict is None:
                 return  # injected loss (same contract as real loss)
+        if isinstance(payload, dict) and "trace" not in payload:
+            # distributed-tracing context rides the payload envelope:
+            # a send issued under an active span is causally part of it
+            # (replies inherit the serving span, whose ctx() carries the
+            # tail-keep bit upstream). One thread-local read when
+            # untraced; an explicit payload["trace"] wins.
+            ctx = tracing.current_ctx()
+            if ctx is not None:
+                payload["trace"] = ctx
         if dst in self._handlers:
             # loopback: still through the inbox so delivery stays serial
             for _ in range(verdict[1]):
@@ -231,9 +279,11 @@ class TcpTransport:
                 with wlock:
                     sock.sendall(frame)
                 fail_streak = 0
-            except OSError:
+            except OSError as e:
                 self._drop_route(dst)  # loss; protocols retry
                 fail_streak += 1
+                self._sender_errors.increment()
+                _RL_LOG.log(f"sender.{dst}", e)
 
     def close(self) -> None:
         with self._outboxes_lock:
@@ -266,10 +316,10 @@ class TcpTransport:
         def run() -> None:
             try:
                 fn()
-            except Exception:  # noqa: BLE001 - background op must not
-                import traceback  # kill silently with no trace
-
-                traceback.print_exc()
+            except Exception as e:  # noqa: BLE001 - background op must
+                # not kill silently (countable, rate-limited)
+                self._dispatch_errors.increment()
+                _RL_LOG.log("offload", e)
 
         self._spawn(run)
 
@@ -286,10 +336,9 @@ class TcpTransport:
                 try:
                     with self.lock:
                         fn()
-                except Exception:  # noqa: BLE001 - timers must survive
-                    import traceback
-
-                    traceback.print_exc()
+                except Exception as e:  # noqa: BLE001 - timers survive
+                    self._dispatch_errors.increment()
+                    _RL_LOG.log("timer", e)
 
         self._spawn(loop)
 
@@ -364,8 +413,12 @@ class TcpTransport:
             buf.extend(chunk)
             try:
                 bodies = read_frames(buf)
-            except ValueError:
-                break  # corrupt stream: drop the connection
+            except ValueError as e:
+                # corrupt stream: drop the connection — countable, not
+                # silent (a flapping peer shows up in the counter)
+                self._dispatch_errors.increment()
+                _RL_LOG.log("reader", e)
+                break
             for body in bodies:
                 try:
                     src, dst, msg_type, payload = decode_message(body)
@@ -472,22 +525,43 @@ class TcpTransport:
                         carry = nxt
                         break
                     batch.append((nxt[1], nxt[4]))
+            # distributed-tracing join point: an inbound request
+            # carrying a sampled context opens a dispatch span (replies
+            # and acks only pin tail-keep). Batch deliveries (bh) open
+            # per-item spans at the stub seam instead — one item per
+            # trace, never one carrier per item.
+            span = None
+            if isinstance(payload, dict):
+                t_ctx = payload.get("trace")
+                if t_ctx is not None and batch is None:
+                    name = msg_type
+                    if msg_type == "replica":
+                        name = f"replica.{payload.get('type')}"
+                    if tracing.is_reply_type(name):
+                        tracing.on_inbound_ctx(dst, t_ctx)
+                    else:
+                        span = tracing.start_server_span(dst, name, t_ctx)
+                        if span is not None:
+                            span.tags["queue_ms"] = round(
+                                (time.perf_counter() - t_enq) * 1000.0, 3)
             t0 = time.perf_counter()
             try:
                 # the dispatcher is the node's single handler thread, so
                 # a plain attribute safely exposes the CONNECTION the
                 # in-flight message arrived on (see current_session())
                 self._current_session = sess
-                with self.lock:
+                with self.lock, tracing.activate(span):
                     if batch is not None:
                         bh(batch)
                     else:
                         handler(src, msg_type, payload)
-            except Exception:  # noqa: BLE001 - a bad message must not
-                import traceback  # kill the dispatcher
-
-                traceback.print_exc()
+            except Exception as e:  # noqa: BLE001 - a bad message must
+                # not kill the dispatcher (countable, rate-limited)
+                self._dispatch_errors.increment()
+                _RL_LOG.log("dispatch", e)
             finally:
+                if span is not None:
+                    span.finish()
                 t1 = time.perf_counter()
                 p_lat = lat.get(msg_type)
                 if p_lat is None:

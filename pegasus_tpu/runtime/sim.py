@@ -21,6 +21,7 @@ import numpy as np
 from pegasus_tpu.rpc.fault import link_rule_lookup
 from pegasus_tpu.rpc.transport import WRITE_REQS
 
+from pegasus_tpu.utils import tracing as _tracing
 from pegasus_tpu.utils.profiler import PROFILER as _PROFILER
 
 class SimLoop:
@@ -128,6 +129,13 @@ class SimNetwork:
         self._partitioned.discard(addr)
 
     def send(self, src: str, dst: str, msg_type: str, payload: Any) -> None:
+        if isinstance(payload, dict) and "trace" not in payload:
+            # trace context rides the payload envelope — the exact
+            # stamping rule the TCP transport applies, so a sim schedule
+            # exercises the same propagation the real wire does
+            ctx = _tracing.current_ctx()
+            if ctx is not None:
+                payload["trace"] = ctx
         if src in self._partitioned or dst in self._partitioned:
             self.dropped += 1
             return
@@ -152,14 +160,39 @@ class SimNetwork:
                 handler = self._handlers.get(dst)
                 if handler is not None and dst not in self._partitioned:
                     self.delivered += 1
-                    if _PROFILER.enabled:
-                        # toollet join point (profiler.cpp:90-198): queue
-                        # delay is the SIM link latency; exec is wall time
-                        t0 = _perf_counter()
-                        handler(src, msg_type, payload)
-                        _PROFILER.observe(msg_type, delay * 1000.0,
-                                          (_perf_counter() - t0) * 1000.0)
-                    else:
-                        handler(src, msg_type, payload)
+                    # tracing join point (same rule as the TCP
+                    # dispatcher): a sampled request context opens a
+                    # dispatch span; replies/acks only pin tail-keep
+                    span = None
+                    if isinstance(payload, dict):
+                        t_ctx = payload.get("trace")
+                        if t_ctx is not None:
+                            name = msg_type
+                            if msg_type == "replica":
+                                name = f"replica.{payload.get('type')}"
+                            if _tracing.is_reply_type(name):
+                                _tracing.on_inbound_ctx(dst, t_ctx)
+                            else:
+                                span = _tracing.start_server_span(
+                                    dst, name, t_ctx)
+                                if span is not None:
+                                    span.tags["queue_ms"] = round(
+                                        delay * 1000.0, 3)
+                    try:
+                        with _tracing.activate(span):
+                            if _PROFILER.enabled:
+                                # toollet join point (profiler.cpp:
+                                # 90-198): queue delay is the SIM link
+                                # latency; exec is wall time
+                                t0 = _perf_counter()
+                                handler(src, msg_type, payload)
+                                _PROFILER.observe(
+                                    msg_type, delay * 1000.0,
+                                    (_perf_counter() - t0) * 1000.0)
+                            else:
+                                handler(src, msg_type, payload)
+                    finally:
+                        if span is not None:
+                            span.finish()
 
             self.loop.schedule(delay, deliver)

@@ -237,6 +237,9 @@ class PartitionServer:
         self.slow_log = SlowQueryLog()
         self._scan_log_key = f"scan_batch.{app_id}.{pidx}"
         self._get_log_key = f"point_get_batch.{app_id}.{pidx}"
+        # per-table read-latency percentile (the collector aggregates
+        # p50/p99 per table from these each round)
+        self._read_latency = self.metrics.percentile("read_latency_ms")
         # env-driven remote manual compaction (one-shot trigger times)
         self._mc_trigger_seen = 0
         self._mc_running = False
@@ -821,8 +824,14 @@ class PartitionServer:
         the batch re-resolve every key through the per-key safe order
         instead of trusting the possibly-torn snapshot."""
         from pegasus_tpu.storage.memtable import TOMBSTONE
+        from pegasus_tpu.utils.latency_tracer import LatencyTracer
 
         t0 = time.perf_counter()
+        # real stage chain for the batched point-read window (parity
+        # with the write path's per-mutation tracer): slow_queries shows
+        # WHERE a read stalled, and the stages double as annotations on
+        # the active distributed-tracing span
+        tracer = LatencyTracer(self._get_log_key)
         now = epoch_now() if now is None else now
         lsm = self.engine.lsm
         gen = lsm.generation  # read BEFORE the overlay/run snapshots
@@ -900,6 +909,7 @@ class PartitionServer:
                 raise ValueError(f"unknown point-read op {op!r}")
         if capture_hks:
             hc.capture(capture_hks)
+        tracer.add_point("plan")
 
         memget = lsm.memtable.get
         l0 = lsm.l0
@@ -965,6 +975,7 @@ class PartitionServer:
                 probe = (mat, cols,
                          {k: i * nfil
                           for i, k in enumerate(base_pending)})
+        tracer.add_point("bloom")
         pending = base_pending
         if pending and l0:
             pending, bloom_useful = self._probe_l0(
@@ -1007,8 +1018,10 @@ class PartitionServer:
             self._row_cache_hits.increment(rc_hits)
         if rc_misses:
             self._row_cache_misses.increment(rc_misses)
+        tracer.add_point("block_probe")
         return {"ops": ops, "results": results, "op_keys": op_keys,
-                "uniq": uniq, "now": now, "t0": t0, "wide": wide}
+                "uniq": uniq, "now": now, "t0": t0, "wide": wide,
+                "tracer": tracer}
 
     def _filter_probe(self, lsm, gen: int):
         """(MultiProbe over every filtered table of the current run
@@ -1234,6 +1247,11 @@ class PartitionServer:
         op_keys = state["op_keys"]
         uniq = state["uniq"]
         now = state["now"]
+        tracer = state.get("tracer")
+        if tracer is not None:
+            # the (possibly cross-partition) value gather ran between
+            # the phases — the time since block_probe is decode/gather
+            tracer.add_point("decode")
         page_pos = state.get("page_pos") or {}
         dv = self.data_version
         hdr = header_length(dv)
@@ -1332,7 +1350,15 @@ class PartitionServer:
             self._abnormal_reads.increment(expired_total)
         self.cu.add_read_units(cu_total)
         elapsed_ms = (time.perf_counter() - state["t0"]) * 1000.0
-        if elapsed_ms >= self.slow_log.threshold_ms:
+        self._read_latency.set(elapsed_ms)
+        if tracer is not None:
+            tracer.add_point("finish")
+            # the full stage chain (plan/bloom/block_probe/decode/
+            # finish) lands in the slow ring — WHERE the read stalled,
+            # not just that it did
+            self.slow_log.observe(tracer,
+                                  {"ops": len(ops), "keys": len(uniq)})
+        elif elapsed_ms >= self.slow_log.threshold_ms:
             self.slow_log.observe_simple(
                 self._get_log_key, elapsed_ms,
                 {"ops": len(ops), "keys": len(uniq)})
@@ -1548,9 +1574,10 @@ class PartitionServer:
         try:
             return self._on_multi_get(req)
         finally:
+            elapsed_ms = (time.perf_counter() - t0) * 1000.0
+            self._read_latency.set(elapsed_ms)
             self.slow_log.observe_simple(
-                f"multi_get.{self.app_id}.{self.pidx}",
-                (time.perf_counter() - t0) * 1000.0,
+                f"multi_get.{self.app_id}.{self.pidx}", elapsed_ms,
                 {"hash_key": req.hash_key.decode(errors="replace")})
 
     def _on_multi_get(self, req: MultiGetRequest) -> MultiGetResponse:
@@ -1680,17 +1707,25 @@ class PartitionServer:
 
     def _serve_scan_batch(self, req: GetScannerRequest, start_key: bytes,
                           stop_key: bytes) -> ScanResponse:
+        from pegasus_tpu.utils.latency_tracer import LatencyTracer
+
         t0 = time.perf_counter()
+        # stage chain for scan pages (plan -> block scan/decode ->
+        # assemble): a slow page shows WHERE it stalled, and the stages
+        # annotate the active distributed-tracing span
+        tracer = LatencyTracer(f"scan.{self.app_id}.{self.pidx}")
         try:
-            return self._serve_scan_batch_inner(req, start_key, stop_key)
+            return self._serve_scan_batch_inner(req, start_key, stop_key,
+                                                tracer)
         finally:
-            self.slow_log.observe_simple(
-                f"scan.{self.app_id}.{self.pidx}",
-                (time.perf_counter() - t0) * 1000.0)
+            elapsed_ms = (time.perf_counter() - t0) * 1000.0
+            self._read_latency.set(elapsed_ms)
+            self.slow_log.observe(tracer)
 
     def _serve_scan_batch_inner(self, req: GetScannerRequest,
                                 start_key: bytes,
-                                stop_key: bytes) -> ScanResponse:
+                                stop_key: bytes,
+                                tracer=None) -> ScanResponse:
         now = epoch_now()
         resp = ScanResponse()
         limiter = RangeReadLimiter()
@@ -1698,17 +1733,22 @@ class PartitionServer:
                          SCAN_BATCH_CAP)
         if req.only_return_count:
             batch_size = -1  # count the whole (limiter-bounded) range
+        hash_filter = FilterSpec.make(req.hash_key_filter_type,
+                                      req.hash_key_filter_pattern)
+        sort_filter = FilterSpec.make(req.sort_key_filter_type,
+                                      req.sort_key_filter_pattern)
+        if tracer is not None:
+            tracer.add_point("plan")
         records, exhausted, resume_key = self._batched_scan(
             start_key, stop_key or None, now,
-            FilterSpec.make(req.hash_key_filter_type,
-                            req.hash_key_filter_pattern),
-            FilterSpec.make(req.sort_key_filter_type,
-                            req.sort_key_filter_pattern),
+            hash_filter, sort_filter,
             validate_hash=(req.validate_partition_hash
                            and self.validate_partition_hash),
             limiter=limiter, max_records=batch_size,
             max_bytes=-1 if req.only_return_count else SCAN_BYTES_CAP,
             with_values=not req.no_value and not req.only_return_count)
+        if tracer is not None:
+            tracer.add_point("block_scan")
         if req.only_return_count:
             resp.kv_count = len(records)
         else:
@@ -1720,6 +1760,8 @@ class PartitionServer:
                 resp.kvs.append(kv)
                 size += len(key) + len(data)
             self.cu.add_read(size)
+        if tracer is not None:
+            tracer.add_point("assemble")
         resp.error = int(StorageStatus.OK)
         if exhausted or req.one_page:
             # one_page: the client promised not to page further — no
@@ -1763,7 +1805,10 @@ class PartitionServer:
         per-request. `flavor` = the (validate, filter_key) the caller
         already grouped by (scan_coordinator) — passing it skips the
         per-request re-derivation."""
+        from pegasus_tpu.utils.latency_tracer import LatencyTracer
+
         t0 = time.perf_counter()
+        tracer = LatencyTracer(self._scan_log_key)
         gate = self._read_gate()
         if gate:
             out = []
@@ -1896,9 +1941,10 @@ class PartitionServer:
             # and overlay above may be from different sides of the swap
             # — serve per-request instead (safe read order)
             return None
+        tracer.add_point("plan")
         return {"reqs": reqs, "req_plans": req_plans, "unique": unique,
                 "validate": validate, "now": now, "overlay": overlay,
-                "filter_key": filter_key, "t0": t0}
+                "filter_key": filter_key, "t0": t0, "tracer": tracer}
 
     def planned_misses(self, state) -> "OrderedDict[tuple, object]":
         """Unique planned blocks whose STATIC masks are NOT cached (the
@@ -2089,6 +2135,9 @@ class PartitionServer:
                 misses, state["filter_key"], state["validate"]):
             keep_masks[ckey] = keep
             self.store_mask(state, ckey, keep)
+        tracer = state.get("tracer")
+        if tracer is not None:
+            tracer.add_point("block_probe")
         return keep_masks
 
     def prepare_serve(self, state, keep_masks) -> list:
@@ -2173,6 +2222,9 @@ class PartitionServer:
         state["exp_full"] = exp_full
         state["windows"] = windows
         state["fast"] = fast
+        tracer = state.get("tracer")
+        if tracer is not None:
+            tracer.add_point("decode")
         return fast
 
     def finish_scan_batch(self, state, keep_masks, served=None
@@ -2392,10 +2444,18 @@ class PartitionServer:
         if total_expired:
             self._abnormal_reads.increment(total_expired)
         self.cu.add_read_units(total_read_cu)
-        self.slow_log.observe_simple(
-            self._scan_log_key,
-            (time.perf_counter() - t0) * 1000.0,
-            {"scans": len(reqs), "unique_blocks": len(unique)})
+        elapsed_ms = (time.perf_counter() - t0) * 1000.0
+        self._read_latency.set(elapsed_ms)
+        tracer = state.get("tracer")
+        if tracer is not None:
+            tracer.add_point("finish")
+            self.slow_log.observe(
+                tracer,
+                {"scans": len(reqs), "unique_blocks": len(unique)})
+        else:
+            self.slow_log.observe_simple(
+                self._scan_log_key, elapsed_ms,
+                {"scans": len(reqs), "unique_blocks": len(unique)})
         return out
 
     # overlay rows tolerated on the batched device path before falling

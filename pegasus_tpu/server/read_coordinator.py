@@ -85,6 +85,8 @@ def point_read_multi(servers_and_ops: List[Tuple[object, list]],
             raise PegasusError(ErrorCode.ERR_TIMEOUT,
                                "point-read flush deadline exceeded")
 
+    from pegasus_tpu.utils.tracing import annotate
+
     if now is None:
         now = epoch_now()
     states = []
@@ -92,6 +94,7 @@ def point_read_multi(servers_and_ops: List[Tuple[object, list]],
         _check_deadline()
         states.append((server, server.plan_get_batch(ops, now=now)))
     _check_deadline()
+    annotate("coord_plan")  # read-coordinator join point (active span)
 
     # cross-partition native assembly: group by value-header width (the
     # only per-partition parameter of the gather), concatenate chunks
@@ -114,8 +117,11 @@ def point_read_multi(servers_and_ops: List[Tuple[object, list]],
         for state, _chunks in grp:
             state["_page"] = (page, state.pop("_page_base"))
 
+    annotate("coord_gather")
+
     out = []
     for server, state in states:
         page, base = state.pop("_page", (None, 0))
         out.append(server.finish_get_batch(state, page, base))
+    annotate("coord_finish")
     return out

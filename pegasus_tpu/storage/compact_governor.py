@@ -142,6 +142,12 @@ class CompactionGovernor:
                     self._tokens = 0.0
         if sleep_s > 0:
             self._c_stall_ms.increment(int(sleep_s * 1000))
+            # a traced request stalled behind the governor (e.g. an
+            # ingest riding the compaction pipeline) records WHERE the
+            # time went; one attr check when untraced
+            from pegasus_tpu.utils.tracing import annotate
+
+            annotate("governor_stall")
             self._sleep(sleep_s)
 
     def _feedback_locked(self, now: float) -> None:

@@ -126,6 +126,8 @@ _COMPRESSED_DECODE = _STORAGE_METRICS.relaxed_counter(
 _BLOCK_EVICT_BYTES = _STORAGE_METRICS.relaxed_counter(
     "block_cache_evict_bytes")
 
+from pegasus_tpu.utils.tracing import annotate as _trace_annotate  # noqa: E402
+
 MAGIC = b"PGT2"
 MAGIC_V1 = b"PGT1"  # pre-hash_lo format, still readable
 FOOTER = struct.Struct("<QII4s")  # index_offset, index_size, index_crc, magic
@@ -741,6 +743,9 @@ class SSTable:
             enc = EncodedBlock.parse(raw)
             blk = enc.decode()
             _COMPRESSED_DECODE.increment()
+            # storage join point: a traced request that paid a cold
+            # compressed-block decode records it on its span
+            _trace_annotate("block_decode")
             # a decoded compressed block is real allocation (the raw
             # path below is mmap views): charge its materialized size
             nbytes = enc.mem_bytes()

@@ -51,6 +51,17 @@ class SimCluster:
         # wall-anchored clock so value timetags / TTL math are realistic
         # while FD timing stays on deterministic sim time
         self._epoch = 1_700_000_000
+        # distributed-tracing rings live on the SIM clock: span
+        # timelines (and the slow-trace threshold) must see injected
+        # virtual delays, not the microseconds of wall time a sim
+        # schedule actually burns
+        from pegasus_tpu.utils import tracing
+
+        self._trace_clock = lambda: self._epoch + self.loop.now
+        self._trace_rings: List[str] = []
+        for m in self.metas:
+            tracing.ring_for(m.name, clock=self._trace_clock)
+            self._trace_rings.append(m.name)
         for i in range(n_nodes):
             self.add_node(f"node{i}")
         # settle: everyone beacons, FD learns the membership
@@ -59,6 +70,10 @@ class SimCluster:
     # ---- membership ----------------------------------------------------
 
     def add_node(self, name: str) -> ReplicaStub:
+        from pegasus_tpu.utils import tracing
+
+        tracing.ring_for(name, clock=self._trace_clock)
+        self._trace_rings.append(name)
         stub = ReplicaStub(
             name, os.path.join(self.data_dir, name), self.net,
             clock=lambda: self._epoch + self.loop.now,
@@ -167,6 +182,10 @@ class SimCluster:
         # while two sim clients still draw distinct jitter streams
         # (real clients default to per-process entropy instead)
         cname = name or f"client-{app_name}"
+        from pegasus_tpu.utils import tracing
+
+        tracing.ring_for(cname, clock=self._trace_clock)
+        self._trace_rings.append(cname)
         c = ClusterClient(self.net, cname,
                           [m.name for m in self.metas],
                           app_name, pump=self.pump, auth=auth,
@@ -181,5 +200,12 @@ class SimCluster:
                 for p in range(app.partition_count)]
 
     def close(self) -> None:
+        from pegasus_tpu.utils import tracing
+
         for stub in self.stubs.values():
             stub.close()
+        # drop the rings this cluster registered: their clock closures
+        # pin the whole dead cluster, and stale spans must not leak
+        # into a later cluster reusing the same node names
+        for name in self._trace_rings:
+            tracing.drop_ring(name)

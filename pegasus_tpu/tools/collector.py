@@ -66,8 +66,11 @@ class InfoCollector:
     # ---- stat aggregation (parity: info_collector.h:206-212) -----------
 
     def collect_round(self) -> Dict[str, dict]:
-        """Scrape every node's replica metrics, aggregate per table, and
-        write one row per table into the stat table."""
+        """Scrape every node's replica metrics, aggregate per table
+        (CU counters + read/write latency p50/p99 off the node
+        percentile snapshots), and write one row per table into the
+        stat table; node tail-kept slow-trace counts land in a
+        `_traces` row so soak/scale runs can assert on them."""
         per_table: Dict[str, dict] = {}
         for node in self.nodes:
             snapshot = self._command(node, "metrics", ["replica"])
@@ -79,7 +82,9 @@ class InfoCollector:
                     continue
                 agg = per_table.setdefault(table, {
                     "partitions": 0, "read_cu": 0, "write_cu": 0,
-                    "abnormal_reads": 0})
+                    "abnormal_reads": 0,
+                    "read_p50_ms": 0.0, "read_p99_ms": 0.0,
+                    "write_p50_ms": 0.0, "write_p99_ms": 0.0})
                 agg["partitions"] += 1
                 metrics = entity.get("metrics", {})
                 agg["read_cu"] += int(
@@ -89,6 +94,20 @@ class InfoCollector:
                 agg["abnormal_reads"] += int(
                     metrics.get("abnormal_read_count", {})
                     .get("value", 0))
+                # per-table latency: the WORST partition's percentile
+                # (percentiles over partitions cannot merge exactly;
+                # max is the honest aggregate for an SLO check)
+                for key, metric in (("read_p50_ms", "read_latency_ms"),
+                                    ("write_p50_ms",
+                                     "write_latency_ms")):
+                    snap = metrics.get(metric)
+                    if not snap:
+                        continue
+                    agg[key] = max(agg[key], snap.get("p50", 0.0))
+                    p99_key = key.replace("p50", "p99")
+                    agg[p99_key] = max(agg[p99_key],
+                                       snap.get("p99", 0.0))
+        node_traces = self.collect_traces()
         if per_table:
             if self._stat_client is None:
                 self._stat_client = self.client_factory(STAT_TABLE)
@@ -96,7 +115,25 @@ class InfoCollector:
             for table, agg in per_table.items():
                 self._stat_client.set(
                     table.encode(), ts, json.dumps(agg).encode())
+            if node_traces:
+                self._stat_client.set(b"_traces", ts,
+                                      json.dumps(node_traces).encode())
         return per_table
+
+    def collect_traces(self) -> Dict[str, int]:
+        """Tail-kept slow-trace count per node (the tracing entity's
+        kept_trace_count) — how many slow requests each node pinned."""
+        out: Dict[str, int] = {}
+        for node in self.nodes:
+            snapshot = self._command(node, "metrics", ["tracing"])
+            if not snapshot:
+                continue
+            for entity in snapshot:
+                if entity.get("id") != node:
+                    continue
+                out[node] = int(entity.get("metrics", {}).get(
+                    "kept_trace_count", {}).get("value", 0))
+        return out
 
     def table_history(self, app_id_str: str) -> List[dict]:
         if self._stat_client is None:

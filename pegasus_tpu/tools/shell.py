@@ -26,6 +26,8 @@ verbs, parity: the linenoise REPL + `use`). Command families:
                get/set_meta_level, detect_hotkey, remote_command,
                slow_queries, metrics, storage_stats, disk_health,
                scrub, hot_partitions, compact_sched
+  tracing    : trace <id> (fan out + stitch one cross-node span tree),
+               traces --slow (tail-kept slow trace roots, one meta call)
   offline    : sst_dump, mlog_dump, local_get, rdb_key_str2hex,
                rdb_key_hex2str, rdb_value_hex2str
 
@@ -291,6 +293,22 @@ def main(argv=None) -> int:
     p.add_argument("cmd_args", nargs="*")
     p = sub.add_parser("slow_queries")
     p.add_argument("node")
+    # distributed tracing: one-command cross-node stitching
+    p = sub.add_parser("trace",
+                       help="fan trace-dump out to every node, stitch "
+                            "the spans into one tree, render the "
+                            "timeline with per-hop skew bounds")
+    p.add_argument("trace_id")
+    p.add_argument("--json", action="store_true",
+                   help="print the stitched tree as JSON instead of "
+                        "the rendered timeline")
+    p = sub.add_parser("traces",
+                       help="list recent tail-kept slow trace roots "
+                            "(one meta call; nodes report them on "
+                            "config-sync)")
+    p.add_argument("--slow", action="store_true",
+                   help="kept slow traces only (the default view)")
+    p.add_argument("--limit", type=int, default=16)
     # cluster/node admin breadth (parity: shell admin commands)
     sub.add_parser("cluster_info")
     p = sub.add_parser("server_info")
@@ -1454,6 +1472,37 @@ def _dispatch(args, box, out) -> int:
     elif args.cmd == "slow_queries":
         for rep in box.remote_command(args.node, "slow-query-dump", []):
             print(json.dumps(rep), file=out)
+    elif args.cmd == "trace":
+        from pegasus_tpu.utils import tracing
+
+        # local rings first (this process's client spans), then fan the
+        # trace-dump verb out to every node; stitch dedupes overlaps
+        spans = list(tracing.dump_all(args.trace_id))
+        if isinstance(box, _ClusterBox):
+            for n in box.admin.call("list_nodes"):
+                res = box.remote_command(n, "trace-dump",
+                                         [args.trace_id])
+                if res:
+                    spans.extend(res)
+        tree = tracing.stitch(spans)
+        if tree is None:
+            print(f"no spans for trace {args.trace_id}", file=out)
+        elif args.json:
+            print(json.dumps(tree, indent=1, default=str), file=out)
+        else:
+            print(tracing.render(tree), file=out)
+    elif args.cmd == "traces":
+        from pegasus_tpu.utils import tracing
+
+        if isinstance(box, _ClusterBox):
+            reports = box.admin.call("slow_traces")
+            for rep in reports.values():  # newest last per node
+                if isinstance(rep.get("roots"), list):
+                    rep["roots"] = rep["roots"][-args.limit:]
+            print(json.dumps(reports, indent=1), file=out)
+        else:
+            print(json.dumps(tracing.slow_roots_all(args.limit),
+                             indent=1), file=out)
     elif args.cmd == "nodes":
         for n in box.admin.call("list_nodes"):
             print(n, file=out)

@@ -12,19 +12,31 @@ import time
 from collections import deque
 from typing import Any, Deque, Dict, List, Optional, Tuple
 
+from pegasus_tpu.utils.tracing import current_span as _current_span
+
 
 class LatencyTracer:
-    """One request's stage chain. Cheap: a list of (stage, t) tuples."""
+    """One request's stage chain. Cheap: a list of (stage, t) tuples.
 
-    __slots__ = ("name", "points", "_clock")
+    When a distributed-tracing span is active at creation (or passed
+    explicitly), every stage point ALSO lands on that span as an
+    annotation — the per-process stage chain and the cross-process span
+    tree share one instrumentation layer (utils/tracing.py)."""
 
-    def __init__(self, name: str, clock=time.perf_counter) -> None:
+    __slots__ = ("name", "points", "_clock", "span")
+
+    def __init__(self, name: str, clock=time.perf_counter,
+                 span=None) -> None:
         self.name = name
         self._clock = clock
+        self.span = span if span is not None else _current_span()
         self.points: List[Tuple[str, float]] = [("start", clock())]
 
     def add_point(self, stage: str) -> None:
         self.points.append((stage, self._clock()))
+        sp = self.span
+        if sp is not None:
+            sp.annotate(stage)
 
     def total_ms(self) -> float:
         return (self.points[-1][1] - self.points[0][1]) * 1000.0

@@ -13,6 +13,7 @@ from __future__ import annotations
 import bisect
 import threading
 import time
+from collections import OrderedDict
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -203,6 +204,78 @@ class MetricRegistry:
 
 
 METRICS = MetricRegistry()
+
+
+# ---- Prometheus text exposition -----------------------------------------
+
+_PROM_NAME_BAD = None  # lazy-compiled regex
+
+
+def _prom_name(name: str) -> str:
+    global _PROM_NAME_BAD
+    if _PROM_NAME_BAD is None:
+        import re
+
+        _PROM_NAME_BAD = re.compile(r"[^a-zA-Z0-9_:]")
+    out = _PROM_NAME_BAD.sub("_", name)
+    if out and out[0].isdigit():
+        out = "_" + out
+    return out
+
+
+def _prom_label_value(v: Any) -> str:
+    return str(v).replace("\\", "\\\\").replace("\n", "\\n") \
+        .replace('"', '\\"')
+
+
+def to_prometheus(snapshot: List[Dict[str, Any]],
+                  prefix: str = "pegasus_") -> str:
+    """Render a MetricRegistry snapshot in the Prometheus text format
+    (version 0.0.4): counters/gauges as-is, percentile windows as
+    summaries with quantile labels; entity type/id and entity
+    attributes become labels. The SURVEY collector->Prometheus sink
+    path works against this with any standard scraper."""
+    # group series by metric name: the exposition format requires all
+    # samples of one metric to be contiguous under one TYPE header
+    series: "OrderedDict[str, Tuple[str, List[str]]]" = OrderedDict()
+
+    def add(name: str, prom_type: str, labels: Dict[str, Any],
+            value: Any, extra_label: Optional[Tuple[str, str]] = None
+            ) -> None:
+        mname = prefix + _prom_name(name)
+        pairs = [(_prom_name(k), _prom_label_value(v))
+                 for k, v in labels.items()]
+        if extra_label is not None:
+            pairs.append(extra_label)
+        lbl = ",".join(f'{k}="{v}"' for k, v in pairs)
+        line = f"{mname}{{{lbl}}} {value}" if lbl else f"{mname} {value}"
+        ent = series.get(mname)
+        if ent is None:
+            series[mname] = (prom_type, [line])
+        else:
+            ent[1].append(line)
+
+    for ent_snap in snapshot:
+        labels = {"entity": ent_snap["type"], "id": ent_snap["id"]}
+        labels.update(ent_snap.get("attributes") or {})
+        for name, m in (ent_snap.get("metrics") or {}).items():
+            t = m.get("type")
+            if t in ("counter", "volatile_counter"):
+                add(name, "counter", labels, m["value"])
+            elif t == "gauge":
+                add(name, "gauge", labels, m["value"])
+            elif t == "percentile":
+                for k, v in m.items():
+                    if k == "type" or not k.startswith("p"):
+                        continue
+                    q = float(k[1:]) / 100.0
+                    add(name, "summary", labels, v,
+                        ("quantile", f"{q:g}"))
+    lines: List[str] = []
+    for mname, (prom_type, samples) in series.items():
+        lines.append(f"# TYPE {mname} {prom_type}")
+        lines.extend(samples)
+    return "\n".join(lines) + ("\n" if lines else "")
 
 
 class LatencyTimer:
