@@ -1277,6 +1277,174 @@ def measure_trace_overhead(tmpdir, seed: int):
         shutil.rmtree(cdir, ignore_errors=True)
 
 
+def measure_dup_catchup(tmpdir, seed: int):
+    """Geo-replication catch-up phase (round 14): batched+compressed
+    dup_apply_batch envelope shipping vs the legacy solo-mutation
+    client_write shipping, catching a follower cluster up over a
+    DELAYED inter-cluster link — same-run, identity-gated on the
+    follower table digest. Each mode runs a FRESH two-SimCluster
+    topology from the same seed (identical schedules); with every
+    inter-cluster hop paying the link delay, catch-up sim-time is
+    round-trip-dominated, i.e. it measures shipping efficiency, not
+    host speed. A third pass re-runs batched mode under synthetic
+    follower pressure (every envelope delivery grows the follower's
+    shed counter): the governor's AIMD backoff must ENGAGE
+    (backoff_count grows, throttle floors) while catch-up still
+    completes — the forward-progress floor."""
+    import hashlib
+    import shutil
+
+    from pegasus_tpu.runtime.sim import SimLoop, SimNetwork
+    from pegasus_tpu.tools.cluster import SimCluster
+    from pegasus_tpu.utils.flags import FLAGS
+    from pegasus_tpu.utils.metrics import METRICS
+
+    n_records = int(os.environ.get("PEGBENCH_DUP_RECORDS", 400))
+    delay_s = 0.03
+    flag_keys = ["ship_batch_mutations", "ship_batch_bytes",
+                 "ship_governor"]
+    import pegasus_tpu.replica.dup_governor  # noqa: F401 - flags
+    import pegasus_tpu.replica.duplication_cluster  # noqa: F401
+
+    saved = {k: FLAGS.get("pegasus.dup", k) for k in flag_keys}
+
+    def dup_counters():
+        shipped = raw = backoff = 0
+        for ent in METRICS.snapshot("duplication"):
+            m = ent.get("metrics", {})
+            shipped += m.get("dup_shipped_bytes", {}).get("value", 0)
+            raw += m.get("dup_shipped_raw_bytes", {}).get("value", 0)
+            backoff += m.get("dup_backoff_count", {}).get("value", 0)
+        return shipped, raw, backoff
+
+    def one_mode(name, batch, pressure):
+        mode_dir = os.path.join(tmpdir, f"dupcatch_{name}")
+        loop = SimLoop(seed=seed)
+        net = SimNetwork(loop)
+        a = SimCluster(os.path.join(mode_dir, "A"), n_nodes=2,
+                       name_prefix="a-", loop=loop, net=net,
+                       cluster_id=1)
+        b = SimCluster(os.path.join(mode_dir, "B"), n_nodes=2,
+                       name_prefix="b-", loop=loop, net=net,
+                       cluster_id=2)
+        try:
+            FLAGS.set("pegasus.dup", "ship_batch_mutations", batch)
+
+            def step_both(r=1):
+                for _ in range(r):
+                    a.step()
+                    b.step(advance=False)
+
+            def pump(sim_seconds):
+                """Advance shared sim time in 1s slices with timers
+                interleaved: a LONG shipping chain spans many sim
+                seconds of link delay, and beacons must keep flowing
+                through it or the follower's FD lease lapses mid-
+                catch-up (a step-quantized-beacon artifact — real
+                nodes beacon on wall-clock timers)."""
+                for _ in range(int(sim_seconds)):
+                    for cl in (a, b):
+                        for stub in cl.stubs.values():
+                            stub.send_beacon()
+                            stub.config_sync()
+                            stub.dup_tick()
+                    loop.run_for(1.0)
+                    for cl in (a, b):
+                        for m in cl.metas:
+                            m.tick()
+
+            step_both(2)
+            a.create_table("t", partition_count=2, replica_count=2)
+            b.create_table("t", partition_count=2, replica_count=2)
+            ca = a.client("t")
+            for i in range(n_records):
+                assert ca.set(b"ck%06d" % i, b"s",
+                              b"geo-payload-%06d|" % i * 4) == 0
+            for s in list(a.stubs) + [m.name for m in a.metas]:
+                for d in list(b.stubs) + [m.name for m in b.metas]:
+                    net.set_delay(delay_s, src=s, dst=d)
+                    net.set_delay(delay_s, src=d, dst=s)
+            if pressure:
+                # synthetic follower pressure: every envelope delivery
+                # grows the shed counter the ack carries back
+                shed = METRICS.entity("rpc", "dispatch", {}).counter(
+                    "read_shed_count")
+                for bn in list(b.stubs):
+                    orig = net._handlers[bn]
+
+                    def wrapped(src, mt, pl, orig=orig):
+                        if mt == "dup_apply_batch":
+                            shed.increment(5)
+                        orig(src, mt, pl)
+
+                    net._handlers[bn] = wrapped
+            s0, r0, b0 = dup_counters()
+            t0_sim, t0 = loop.now, time.perf_counter()
+            a.meta.duplication.add_duplication("t", "b-meta", "t")
+            drained = False
+            for _ in range(600):
+                pump(1)
+                sessions = [sess for stub in a.stubs.values()
+                            for sess in stub._dup_sessions.values()]
+                if sessions and all(
+                        sess.confirmed_decree > 0
+                        and sess._inflight_decree is None
+                        and sess.stats()["lag_decrees"] == 0
+                        for sess in sessions):
+                    drained = True
+                    break
+            sim_s = loop.now - t0_sim
+            wall_s = time.perf_counter() - t0
+            s1, r1, b1 = dup_counters()
+            cb = b.client("t")
+            digest = hashlib.sha256()
+            for i in range(n_records):
+                st, val = cb.get(b"ck%06d" % i, b"s")
+                digest.update(b"%d" % st)
+                digest.update(val or b"")
+            return {
+                "drained": drained,
+                "catchup_sim_s": round(sim_s, 2),
+                "catchup_wall_s": round(wall_s, 2),
+                "shipped_wire_bytes": s1 - s0,
+                "shipped_raw_bytes": r1 - r0,
+                "compression_ratio": round((s1 - s0) / (r1 - r0), 4)
+                if r1 > r0 else None,
+                "governor_backoffs": b1 - b0,
+                "digest": digest.hexdigest(),
+            }
+        finally:
+            a.close()
+            b.close()
+            shutil.rmtree(mode_dir, ignore_errors=True)
+
+    try:
+        out = {"records": n_records, "link_delay_s": delay_s}
+        out["solo"] = one_mode("solo", 1, False)
+        out["batched"] = one_mode("batched", 32, False)
+        out["governed"] = one_mode("governed", 32, True)
+        out["speedup_sim"] = round(
+            out["solo"]["catchup_sim_s"]
+            / out["batched"]["catchup_sim_s"], 2) \
+            if out["batched"]["catchup_sim_s"] else None
+        out["identity_ok"] = (
+            out["solo"]["digest"] == out["batched"]["digest"]
+            == out["governed"]["digest"])
+        # the gate: batched+compressed beats solo on the delayed link,
+        # byte-identical content, and the governor both ENGAGES under
+        # follower pressure and never stalls catch-up (forward floor)
+        out["gate_ok"] = bool(
+            out["identity_ok"]
+            and out["solo"]["drained"] and out["batched"]["drained"]
+            and out["governed"]["drained"]
+            and (out["speedup_sim"] or 0) > 1.0
+            and out["governed"]["governor_backoffs"] > 0)
+        return out
+    finally:
+        for k, v in saved.items():
+            FLAGS.set("pegasus.dup", k, v)
+
+
 def measure_mixed_load(jax, device, tmpdir, seed: int,
                        n_parts: int = 4, fg_seconds: float = 20.0):
     """Mixed-load phase (round-12): foreground point reads against one
@@ -1537,6 +1705,7 @@ def main() -> None:
     do_mixed = os.environ.get("PEGBENCH_MIXED", "1") != "0"
     do_geo = os.environ.get("PEGBENCH_GEO", "1") != "0"
     do_trace = os.environ.get("PEGBENCH_TRACE", "1") != "0"
+    do_dup = os.environ.get("PEGBENCH_DUP", "1") != "0"
 
     details = {"phases": {}}
     here = os.path.dirname(os.path.abspath(__file__))
@@ -1988,6 +2157,20 @@ def main() -> None:
                          f"no-tracing baseline (gate<=2%: "
                          f"{to['gate_ok']}, "
                          f"identical={to['identity_ok']})")
+
+                if do_dup:
+                    dc = measure_dup_catchup(tmpdir, seed)
+                    details["phases"]["dup_catchup"] = dc
+                    save_details()
+                    _log(f"dup_catchup: batched+compressed "
+                         f"{dc['batched']['catchup_sim_s']}s vs solo "
+                         f"{dc['solo']['catchup_sim_s']}s sim "
+                         f"({dc['speedup_sim']}x, wire ratio "
+                         f"{dc['batched']['compression_ratio']}, "
+                         f"governed backoffs "
+                         f"{dc['governed']['governor_backoffs']}, "
+                         f"identical={dc['identity_ok']}, "
+                         f"gate={dc['gate_ok']})")
 
                 if do_geo:
                     g_accel, g_hits = measure_geo(jax, accel)

@@ -23,7 +23,9 @@ use Socket qw(IPPROTO_TCP TCP_NODELAY);
 # 58/63 = ERR_DISK_IO_ERROR / ERR_CHECKSUM_FAILED: the replica
 # quarantined over storage corruption; the refresh lands on the
 # healed primary once the guardian's re-learn cure completes.
-my %RETRYABLE = map { $_ => 1 } (5, 6, 13, 14, 53, 56, 58, 63);
+# 64 = ERR_DUP_FENCED: table draining its duplication for a failover
+# drill; transient until the flip.
+my %RETRYABLE = map { $_ => 1 } (5, 6, 13, 14, 53, 56, 58, 63, 64);
 
 # ---- crc64 (reflected; ~init/~final) --------------------------------
 

@@ -71,6 +71,9 @@ _RETRYABLE = {
     # refresh lands the op on the healed (or newly promoted) primary
     int(ErrorCode.ERR_CHECKSUM_FAILED),
     int(ErrorCode.ERR_DISK_IO_ERROR),
+    # duplication failover drill: fenced-for-drain is transient — the
+    # backoff (plus its config refresh) carries the op across the flip
+    int(ErrorCode.ERR_DUP_FENCED),
 }
 
 _OK = int(ErrorCode.ERR_OK)

@@ -219,8 +219,10 @@ class MetaService:
             return
         if msg_type == "admin_reply":
             # replies to admin verbs THIS meta issued (dup bootstrap
-            # asking the follower cluster's meta to restore_app)
+            # asking the follower cluster's meta to restore_app; the
+            # failover drill's follower-side flip)
             self.duplication.on_admin_reply(payload)
+            self.duplication.on_flip_reply(payload)
             return
         if msg_type == "remote_command":
             rid = payload.get("rid")
@@ -435,6 +437,15 @@ class MetaService:
                 result = self.recover_from_reports()
             elif cmd == "list_dups":
                 result = self.duplication.list_all()
+            elif cmd == "dup_stats":
+                result = self.duplication.dup_stats(
+                    args.get("app_name", ""))
+            elif cmd == "dup_failover":
+                result = self.duplication.start_failover(
+                    args["app_name"])
+            elif cmd == "dup_failover_status":
+                result = self.duplication.failover_status(
+                    args["app_name"])
             elif cmd == "query_restore_status":
                 result = self.query_restore_status(
                     args.get("app_name", ""))
@@ -514,6 +525,9 @@ class MetaService:
         # elasticity detect phase: the same report carries per-partition
         # capacity units + hotkey results and the node's pressure counts
         self.elasticity.on_report(node, payload)
+        # duplication health: per-dup lag/shipping entries feeding the
+        # dup_stats surface and the failover drill's drain evidence
+        self.duplication.on_report(node, payload)
         # compaction stagger: demand in, leased grant out (None = the
         # node reported no compaction block — say nothing)
         compact_grant = self.compaction.on_report(node, payload)

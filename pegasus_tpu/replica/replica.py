@@ -120,14 +120,15 @@ class Replica:
 
     def __init__(self, name: str, data_dir: str, transport,
                  app_id: int = 1, pidx: int = 0, partition_count: int = 1,
-                 clock: Optional[Callable[[], float]] = None) -> None:
+                 clock: Optional[Callable[[], float]] = None,
+                 cluster_id: int = 1) -> None:
         self.name = name
         self.data_dir = data_dir
         self.transport = transport
         self.clock = clock or time.time
         self.server = PartitionServer(
             os.path.join(data_dir, "app"), app_id=app_id, pidx=pidx,
-            partition_count=partition_count)
+            partition_count=partition_count, cluster_id=cluster_id)
         self.log = MutationLog(os.path.join(data_dir, "plog", "mlog.bin"))
 
         self.status = PartitionStatus.INACTIVE
@@ -157,6 +158,13 @@ class Replica:
         self._last_timestamp_us = getattr(self, "_boot_timestamp_floor", 0)
         # duplicators attach here; log GC must not outrun their progress
         self.duplicators: List = []
+        # decree -> the write's 2PC span ctx (sampled writes only):
+        # duplication parents its dup.ship spans here so a traced write
+        # renders as ONE stitched tree across clusters. Bounded — only
+        # as large as tracing is actually sampling.
+        from collections import OrderedDict
+
+        self.dup_trace_ctxs: "OrderedDict[int, tuple]" = OrderedDict()
         # primary-side state (parity: primary_context, replica_context.h)
         self._pending_acks: Dict[int, Set[str]] = {}
         self._client_callbacks: Dict[int, Callable[[List[Any]], None]] = {}
@@ -425,6 +433,10 @@ class Replica:
         wspan = tracing.child_of(
             tracing.current_span(),
             f"2pc.{self.server.app_id}.{self.server.pidx}.d{decree}")
+        if wspan is not None:
+            self.dup_trace_ctxs[decree] = wspan.ctx()
+            while len(self.dup_trace_ctxs) > 1024:
+                self.dup_trace_ctxs.popitem(last=False)
         tracer = LatencyTracer(f"write.{self.server.app_id}."
                                f"{self.server.pidx}.d{decree}",
                                span=wspan)

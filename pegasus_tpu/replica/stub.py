@@ -52,7 +52,8 @@ class _GpidTransport:
 class ReplicaStub:
     def __init__(self, name: str, data_dir, net,
                  clock: Optional[Callable[[], float]] = None,
-                 sim_clock: Optional[Callable[[], float]] = None) -> None:
+                 sim_clock: Optional[Callable[[], float]] = None,
+                 cluster_id: int = 1) -> None:
         """`data_dir`: one path or a list of paths (multi-disk layout —
         parity: fs_manager dir_nodes; replicas place on the least-loaded
         disk)."""
@@ -100,6 +101,15 @@ class ReplicaStub:
         self.meta_addr: Optional[str] = None
         # (gpid, dupid) -> ClusterDuplicator on this node's primaries
         self._dup_sessions: Dict = {}
+        # this node's cluster identity (timetag cluster bits + the
+        # duplication origin-echo filter); distinct per geo-replicated
+        # cluster so master-master topologies don't ping-pong writes
+        self.cluster_id = cluster_id
+        # AIMD backpressure for dup catch-up shipping (all sessions on
+        # this node share the WAN egress budget)
+        from pegasus_tpu.replica.dup_governor import DupGovernor
+
+        self.dup_governor = DupGovernor(name, clock=self.sim_clock)
         # long-op dedup: a meta tick re-sends commands until done arrives;
         # a second copy of an in-flight backup/ingest must be ignored
         self._backup_inflight: set = set()
@@ -149,6 +159,10 @@ class ReplicaStub:
         # on the same entity, incremented in PartitionServer._hash_gate)
         self._split_fence_rejects = storage_ent.counter(
             "split_fence_reject_count")
+        # failover-drill fence observability: client writes rejected
+        # typed ERR_DUP_FENCED while a table drains its duplication
+        self._dup_fence_rejects = storage_ent.counter(
+            "dup_fence_reject_count")
         self.scrubber = ReplicaScrubber(
             lambda: self.replicas, self._on_scrub_corruption,
             clock=self.sim_clock)
@@ -412,6 +426,50 @@ class ReplicaStub:
             "replica.scrub [app_id | status [app_id]] — trigger a full "
             "scrub / report scrub progress+results")
 
+        def dup_stats(_args):
+            """Per-duplication shipping stats on this node (scraped by
+            tools/collector.py and the shell's dup_stats verb): lag,
+            inflight decree, fail_mode, shipped bytes, last error —
+            plus the node governor's throttle state."""
+            return {
+                "node": self.name,
+                "sessions": [s.stats()
+                             for s in self._dup_sessions.values()],
+                "governor": self.dup_governor.status(),
+            }
+
+        self.commands.register("dup.stats", dup_stats,
+                               "per-duplication lag/shipping stats + "
+                               "governor state")
+
+        def fault_set(args):
+            """fault.set <drop|delay> <value> [src] [dst] — live-adjust
+            this node's chaos plan (installs one if absent). The WAN
+            scale harness uses it to black out / heal the inter-cluster
+            link mid-run without restarting nodes."""
+            kind, value = args[0], float(args[1])
+            src = args[2] if len(args) > 2 and args[2] else None
+            dst = args[3] if len(args) > 3 and args[3] else None
+            plan = getattr(self.net, "fault_plan", None)
+            if plan is None:
+                install = getattr(self.net, "install_fault_plan", None)
+                if install is not None:
+                    from pegasus_tpu.rpc.fault import FaultPlan
+
+                    plan = FaultPlan()
+                    install(plan)
+            target = plan if plan is not None else self.net
+            fn = getattr(target, f"set_{kind}", None)
+            if fn is None:
+                raise ValueError(f"no fault surface for {kind!r}")
+            fn(value, src, dst)
+            return "ok"
+
+        self.commands.register(
+            "fault.set", fault_set,
+            "fault.set <drop|delay|duplicate> <value> [src] [dst] — "
+            "live chaos-plan adjustment")
+
     def close(self) -> None:
         for r in self.replicas.values():
             r.close()
@@ -441,7 +499,8 @@ class ReplicaStub:
                         _GpidTransport(self.net, self.name, gpid,
                                        self.write_window),
                         app_id=gpid[0], pidx=gpid[1],
-                        partition_count=partition_count, clock=self.clock)
+                        partition_count=partition_count, clock=self.clock,
+                        cluster_id=self.cluster_id)
             r.plog_sink = self.write_window
             r.write_metrics = self.write_metrics
             r.on_learn_completed = (
@@ -745,6 +804,16 @@ class ReplicaStub:
                     # unhook or the log-GC floor stays pinned forever
                     r.duplicators.remove(dup)
             return
+        if msg_type == "dup_apply_batch":
+            self._on_dup_apply_batch(src, payload)
+            return
+        if msg_type == "dup_apply_batch_ack":
+            # acks to duplication envelopes this node shipped
+            for dup in self._dup_sessions.values():
+                if dup.on_write_reply(payload):
+                    dup.tick()
+                    return
+            return
         if msg_type == "query_config_reply":
             for dup in self._dup_sessions.values():
                 if dup.on_follower_config(payload):
@@ -847,6 +916,16 @@ class ReplicaStub:
             self._split_fence_rejects.increment()
             self.net.send(self.name, src, "client_write_reply", {
                 "rid": rid, "err": int(ErrorCode.ERR_SPLITTING),
+                "results": []})
+            return
+        if self._dup_fenced(r, payload.get("ops")):
+            # failover-drill fence: the table is draining its
+            # duplication before the flip — typed and RETRYABLE, so an
+            # in-flight client rides its backoff onto the flipped
+            # follower instead of acking a write the drill would strand
+            self._dup_fence_rejects.increment()
+            self.net.send(self.name, src, "client_write_reply", {
+                "rid": rid, "err": int(ErrorCode.ERR_DUP_FENCED),
                 "results": []})
             return
         if (r is None or r.status != PartitionStatus.PRIMARY
@@ -957,6 +1036,11 @@ class ReplicaStub:
             if r is not None and getattr(r, "splitting", False):
                 self._split_fence_rejects.increment()
                 slots.append((gpid[1], int(ErrorCode.ERR_SPLITTING),
+                              None))
+                continue
+            if self._dup_fenced(r):
+                self._dup_fence_rejects.increment()
+                slots.append((gpid[1], int(ErrorCode.ERR_DUP_FENCED),
                               None))
                 continue
             if (r is None or r.status != PartitionStatus.PRIMARY
@@ -1776,6 +1860,111 @@ class ReplicaStub:
     # ---- duplication (parity: duplication_sync_timer driving the
     # replica-side pipeline; meta owns WHICH partitions duplicate) -------
 
+    @staticmethod
+    def _dup_fenced(r, ops=None) -> bool:
+        """True when the replica's table is fenced for client writes by
+        a duplication failover drill (`dup.fence` app env, propagated
+        through config-sync like every env). Inbound DUPLICATION writes
+        are exempt — they are replication-class traffic and a fenced
+        master-master peer must still drain."""
+        if r is None or not r.server.app_envs.get("dup.fence"):
+            return False
+        if ops:
+            from pegasus_tpu.rpc.codec import OP_DUP_PUT, OP_DUP_REMOVE
+
+            if all(op in (OP_DUP_PUT, OP_DUP_REMOVE)
+                   for op, _req in ops):
+                return False
+        return True
+
+    def _on_dup_apply_batch(self, src: str, payload: dict) -> None:
+        """Follower side of WAN-shaped shipping: decompress one
+        envelope, apply its ops IN DECREE ORDER as one 2PC mutation, ack
+        at the batch's max decree. The ack carries this node's
+        foreground-pressure counters so the source's dup governor backs
+        catch-up off before this node starts shedding its own clients.
+        No deadline and no dup fence apply — replication-class traffic
+        (the source's log-GC floor waits on it)."""
+        from pegasus_tpu.replica.mutation import WriteOp
+        from pegasus_tpu.replica.replica import PartitionStatus
+        from pegasus_tpu.rpc.codec import decode_write
+        from pegasus_tpu.storage.block_codec import inflate_payload
+        from pegasus_tpu.utils.errors import ErrorCode
+        from pegasus_tpu.utils.fail_point import fail_point
+        from pegasus_tpu.utils.metrics import METRICS
+
+        gpid = tuple(payload["gpid"])
+        rid = payload["rid"]
+
+        def reply(err) -> None:
+            rpc_ent = METRICS.entity("rpc", "dispatch", {})
+            self.net.send(self.name, src, "dup_apply_batch_ack", {
+                "rid": rid, "err": int(err), "node": self.name,
+                "max_decree": payload.get("max_decree"),
+                "pressure": {
+                    "deadline_expired": rpc_ent.counter(
+                        "deadline_expired_count").value(),
+                    "read_shed": rpc_ent.counter(
+                        "read_shed_count").value(),
+                }})
+
+        fp = fail_point("dup::apply_batch")
+        if fp is not None:
+            # chaos/test hook: reject the envelope with a typed error
+            reply(int(fp) if str(fp).isdigit()
+                  else int(ErrorCode.ERR_INVALID_STATE))
+            return
+        r = self.replicas.get(gpid)
+        if not self._client_allowed(r, payload, access="w", src=src):
+            reply(ErrorCode.ERR_ACL_DENY)
+            return
+        if r is not None and getattr(r, "splitting", False):
+            self._split_fence_rejects.increment()
+            reply(ErrorCode.ERR_SPLITTING)
+            return
+        if (r is None or r.status != PartitionStatus.PRIMARY
+                or getattr(r, "restoring", False)
+                or not self.lease_valid()):
+            reply(ErrorCode.ERR_INVALID_STATE)
+            return
+        import struct as _struct
+
+        try:
+            raw = inflate_payload(payload["blob_mode"],
+                                  payload["ops_blob"],
+                                  payload["raw_len"])
+            ops = []
+            pos = 0
+            for _ in range(payload["n_ops"]):
+                (length,) = _struct.unpack_from("<I", raw, pos)
+                pos += 4
+                op, req, end = decode_write(raw, pos)
+                if end != pos + length:
+                    raise ValueError("dup envelope op length mismatch")
+                ops.append(WriteOp(op, req))
+                pos = end
+        except (ValueError, KeyError, RuntimeError,
+                _struct.error) as e:
+            from pegasus_tpu.rpc.transport import _RateLimitedLog
+
+            if not hasattr(self, "_dup_decode_log"):
+                self._dup_decode_log = _RateLimitedLog()
+            self._dup_decode_log.log(f"dup.decode.{gpid}", e)
+            reply(ErrorCode.ERR_INVALID_PARAMETERS)
+            return
+
+        def done(_results) -> None:
+            reply(ErrorCode.ERR_OK)
+
+        try:
+            r.client_write(ops, done)
+        except ReplicaBusyError:
+            reply(ErrorCode.ERR_BUSY)
+        except (StorageCorruptionError, OSError) as e:
+            reply(self._on_storage_error(gpid, e))
+        except (RuntimeError, ValueError):
+            reply(ErrorCode.ERR_INVALID_STATE)
+
     def _on_dup_add(self, src: str, payload: dict) -> None:
         from pegasus_tpu.replica.duplication_cluster import (
             ClusterDuplicator,
@@ -1804,7 +1993,8 @@ class ReplicaStub:
             self, gpid, dupid, payload["follower_meta"],
             payload["follower_app"],
             confirmed_decree=payload.get("confirmed", 0),
-            source_cluster_id=payload.get("source_cluster_id", 1),
+            source_cluster_id=payload.get("source_cluster_id")
+            or self.cluster_id,
             on_progress=progress,
             fail_mode=payload.get("fail_mode", "slow"))
 
@@ -1908,10 +2098,21 @@ class ReplicaStub:
             "kept": ring.kept_count.value(),
             "roots": ring.slow_roots(limit=16),
         }
+        # duplication health rides the same report: per-dup lag (decrees
+        # + ms), shipped bytes, error counts, last error — meta's
+        # duplication_service aggregates these into cluster-wide dup
+        # health (`dup_stats`) and the failover drill's drain check
+        dup_report = []
+        for (dgpid, _dupid), sess in list(self._dup_sessions.items()):
+            dr = self.replicas.get(dgpid)
+            if dr is None or dr.status != PartitionStatus.PRIMARY:
+                continue
+            dup_report.append(sess.stats())
         for meta in self._meta_targets():
             self.net.send(self.name, meta, "config_sync", {
                 "node": self.name, "stored": stored,
                 "pressure": pressure, "compaction": compaction,
+                "dup": dup_report,
                 # NB: key must not be "trace" — that's the wire slot
                 # for the distributed-tracing context
                 "trace_report": trace_report})

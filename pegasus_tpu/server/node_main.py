@@ -92,7 +92,8 @@ def run_node(cfg: dict, name: str) -> None:
 
         dirs = node_cfg.get("data_dirs") or [os.path.join(data_root, name)]
         stub = ReplicaStub(name, dirs, transport,
-                           clock=time.time, sim_clock=time.monotonic)
+                           clock=time.time, sim_clock=time.monotonic,
+                           cluster_id=int(cfg.get("cluster_id", 1)))
         stub.auth_secret = cfg.get("auth_secret")
         stub.meta_addrs = meta_names
         stub.meta_addr = meta_names[0]

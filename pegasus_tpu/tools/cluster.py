@@ -27,17 +27,31 @@ from pegasus_tpu.runtime.sim import SimLoop, SimNetwork
 class SimCluster:
     def __init__(self, data_dir: str, n_nodes: int = 3, seed: int = 0,
                  beacon_interval: float = 3.0, n_meta: int = 1,
-                 auth_secret: Optional[str] = None) -> None:
+                 auth_secret: Optional[str] = None,
+                 name_prefix: str = "", loop: Optional[SimLoop] = None,
+                 net: Optional[SimNetwork] = None,
+                 cluster_id: int = 1) -> None:
+        """`name_prefix`/`loop`/`net`/`cluster_id`: the two-cluster
+        geo-replication shape — build BOTH clusters over ONE shared
+        loop+network (prefixes keep their node names apart, distinct
+        cluster ids keep their timetags and the duplication
+        origin-echo filter honest), then fault the inter-cluster links
+        like a WAN. Step the second cluster with `advance=False` so a
+        pair of steps advances shared time once, not twice."""
         self.data_dir = data_dir
-        self.loop = SimLoop(seed=seed)
-        self.net = SimNetwork(self.loop)
+        self.name_prefix = name_prefix
+        self.cluster_id = cluster_id
+        self.loop = loop if loop is not None else SimLoop(seed=seed)
+        self.net = net if net is not None else SimNetwork(self.loop)
         self.beacon_interval = beacon_interval
         clock = lambda: self.loop.now  # noqa: E731
         if n_meta <= 1:
             self.metas = [MetaService(
-                "meta", os.path.join(data_dir, "meta"), self.net, clock)]
+                f"{name_prefix}meta",
+                os.path.join(data_dir, f"{name_prefix}meta"),
+                self.net, clock)]
         else:
-            group = [f"meta{i}" for i in range(n_meta)]
+            group = [f"{name_prefix}meta{i}" for i in range(n_meta)]
             self.metas = [MetaService(
                 name, os.path.join(data_dir, name), self.net, clock,
                 peers=group) for name in group]
@@ -63,7 +77,7 @@ class SimCluster:
             tracing.ring_for(m.name, clock=self._trace_clock)
             self._trace_rings.append(m.name)
         for i in range(n_nodes):
-            self.add_node(f"node{i}")
+            self.add_node(f"{name_prefix}node{i}")
         # settle: everyone beacons, FD learns the membership
         self.step(rounds=2)
 
@@ -77,7 +91,8 @@ class SimCluster:
         stub = ReplicaStub(
             name, os.path.join(self.data_dir, name), self.net,
             clock=lambda: self._epoch + self.loop.now,
-            sim_clock=lambda: self.loop.now)
+            sim_clock=lambda: self.loop.now,
+            cluster_id=self.cluster_id)
         stub.meta_addrs = [m.name for m in self.metas]
         stub.meta_addr = self.metas[0].name
         stub.auth_secret = self.auth_secret
@@ -96,9 +111,13 @@ class SimCluster:
 
     # ---- time ----------------------------------------------------------
 
-    def step(self, rounds: int = 1) -> None:
+    def step(self, rounds: int = 1, advance: bool = True) -> None:
         """One beacon interval per round: beacons from alive nodes, message
-        delivery, meta FD + guardian tick."""
+        delivery, meta FD + guardian tick. `advance=False` fires this
+        cluster's timers and drains delivery WITHOUT advancing the
+        shared loop a beacon interval — the second cluster of a
+        two-cluster topology steps this way so paired steps move shared
+        time once."""
         from pegasus_tpu.replica.replica import PartitionStatus
 
         for _ in range(rounds):
@@ -123,7 +142,10 @@ class SimCluster:
                     # background scrub timer: latent at-rest corruption
                     # on non-serving replicas is detected here
                     stub.scrub_tick()
-            self.loop.run_for(self.beacon_interval)
+            if advance:
+                self.loop.run_for(self.beacon_interval)
+            else:
+                self.loop.run_until_idle()
             for m in self.metas:
                 if m.name not in self._dead:
                     m.tick()
@@ -181,7 +203,7 @@ class SimCluster:
         # that's salted per interpreter): sim schedules replay exactly,
         # while two sim clients still draw distinct jitter streams
         # (real clients default to per-process entropy instead)
-        cname = name or f"client-{app_name}"
+        cname = name or f"{self.name_prefix}client-{app_name}"
         from pegasus_tpu.utils import tracing
 
         tracing.ring_for(cname, clock=self._trace_clock)

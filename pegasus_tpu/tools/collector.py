@@ -108,6 +108,7 @@ class InfoCollector:
                     agg[p99_key] = max(agg[p99_key],
                                        snap.get("p99", 0.0))
         node_traces = self.collect_traces()
+        dup_rows = self.collect_dups()
         if per_table:
             if self._stat_client is None:
                 self._stat_client = self.client_factory(STAT_TABLE)
@@ -118,7 +119,36 @@ class InfoCollector:
             if node_traces:
                 self._stat_client.set(b"_traces", ts,
                                       json.dumps(node_traces).encode())
+            if dup_rows:
+                self._stat_client.set(b"_dups", ts,
+                                      json.dumps(dup_rows).encode())
         return per_table
+
+    def collect_dups(self) -> Dict[str, dict]:
+        """Per-table duplication lag rows off every node's `dup.stats`
+        verb: worst lag (decrees + ms) across the table's sessions,
+        shipped/error/skip totals — the geo-replication health a soak
+        or an operator SLO check reads in one row per app."""
+        out: Dict[str, dict] = {}
+        for node in self.nodes:
+            stats = self._command(node, "dup.stats")
+            if not stats:
+                continue
+            for sess in stats.get("sessions", ()):
+                app_id = str(sess.get("gpid", [0, 0])[0])
+                agg = out.setdefault(app_id, {
+                    "sessions": 0, "max_lag_decrees": 0,
+                    "max_lag_ms": 0.0, "shipped_bytes": 0,
+                    "error_count": 0, "skip_count": 0})
+                agg["sessions"] += 1
+                agg["max_lag_decrees"] = max(
+                    agg["max_lag_decrees"], sess.get("lag_decrees", 0))
+                agg["max_lag_ms"] = max(agg["max_lag_ms"],
+                                        sess.get("lag_ms", 0.0))
+                agg["shipped_bytes"] += sess.get("shipped_bytes", 0)
+                agg["error_count"] += sess.get("error_count", 0)
+                agg["skip_count"] += sess.get("skip_count", 0)
+        return out
 
     def collect_traces(self) -> Dict[str, int]:
         """Tail-kept slow-trace count per node (the tracing entity's

@@ -50,7 +50,8 @@ def start(directory: str = DEFAULT_DIR, n_replica: int = 3,
           name_prefix: str = "",
           extra_peers: Optional[Dict[str, Tuple[str, int]]] = None,
           fault_plan: Optional[dict] = None,
-          disk_fault_plan: Optional[dict] = None) -> dict:
+          disk_fault_plan: Optional[dict] = None,
+          cluster_id: int = 1) -> dict:
     """`name_prefix` namespaces this cluster's node names (two oneboxes
     on one host must not both own "meta"); `extra_peers` maps REMOTE
     node names to (host, port) — written into the address book with
@@ -78,7 +79,10 @@ def start(directory: str = DEFAULT_DIR, n_replica: int = 3,
                 f"extra peer {name!r} collides with a local node — "
                 "give one cluster a name_prefix")
         nodes[name] = {"host": host, "port": port, "role": "external"}
-    cfg = {"data_root": os.path.join(directory, "data"), "nodes": nodes}
+    cfg = {"data_root": os.path.join(directory, "data"), "nodes": nodes,
+           # this cluster's identity in value timetags + the dup
+           # origin-echo filter (geo-replicated clusters must differ)
+           "cluster_id": cluster_id}
     if fault_plan:
         # chaos wiring for REAL processes: every node installs this
         # rpc/fault.FaultPlan schedule on its transport at boot (see

@@ -14,14 +14,24 @@ Report: per-tenant write/read counts, verifier violations (must be
 empty), split + rebalance completion, and the elasticity/fence/
 quarantine counters that show each machinery actually engaged.
 
+`--topology wan` swaps in the geo-replication soak instead: TWO
+oneboxes (cluster A duplicating every tenant table to cluster B across
+a delayed+lossy FaultPlan link with a mid-run full blackout), kill
+chaos alternating across both clusters, ending in the controlled
+failover drill — fence A (typed retryable ERR_DUP_FENCED), drain
+confirmed decrees, flip B writable — after which the DataVerifier
+ledger replays every write A ever acked against B.
+
 CLI:
     python -m pegasus_tpu.tools.scale_test --dir D --tenants 4 \
-        --partitions 32 --duration 60 [--chaos kill] [--disk-faults]
+        --partitions 32 --duration 60 [--chaos kill] [--disk-faults] \
+        [--topology wan]
 """
 
 from __future__ import annotations
 
 import json
+import os
 import random
 import sys
 import time
@@ -119,14 +129,7 @@ def run_scale_test(directory: str, n_tenants: int = 4,
     report: dict = {"tenants": {}, "violations": []}
     try:
         # ---- topology: tenant tables, premium first ------------------
-        boot_deadline = time.monotonic() + 120
-        while time.monotonic() < boot_deadline:
-            try:
-                if len(admin.call("list_nodes", timeout=6)) == n_replica:
-                    break
-            except PegasusError:
-                pass
-            time.sleep(0.5)
+        _wait_cluster(admin, n_replica)
         tenants: List[TenantWorkload] = []
         for t in range(n_tenants):
             table = f"tenant{t}"
@@ -137,19 +140,8 @@ def run_scale_test(directory: str, n_tenants: int = 4,
                 # premium half's capacity (reject mode -> TryAgain,
                 # surfaced in write_rejected, never a violation)
                 envs = {"replica.write_throttling": "200*reject*10"}
-            create_deadline = time.monotonic() + 90
-            while True:
-                try:
-                    admin.create_table(table, partition_count=partitions,
-                                       replica_count=min(3, n_replica),
-                                       envs=envs)
-                    break
-                except PegasusError as e:
-                    if "APP_EXIST" in str(e):
-                        break
-                    if time.monotonic() > create_deadline:
-                        raise
-                    time.sleep(1)
+            _create_table_retry(admin, table, partitions,
+                                min(3, n_replica), envs=envs)
             client = ob.connect(table, directory,
                                 op_timeout_ms=op_timeout_ms)
             tenants.append(TenantWorkload(
@@ -248,11 +240,238 @@ def run_scale_test(directory: str, n_tenants: int = 4,
     return report
 
 
+def _wait_cluster(admin, n_replica: float, deadline_s: float = 120.0
+                  ) -> None:
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        try:
+            if len(admin.call("list_nodes", timeout=6)) == n_replica:
+                return
+        except PegasusError:
+            pass
+        time.sleep(0.5)
+    raise RuntimeError("cluster never came up")
+
+
+def _create_table_retry(admin, table: str, partitions: int,
+                        replica_count: int, deadline_s: float = 90.0,
+                        envs=None) -> None:
+    deadline = time.monotonic() + deadline_s
+    while True:
+        try:
+            admin.create_table(table, partition_count=partitions,
+                               replica_count=replica_count, envs=envs)
+            return
+        except PegasusError as e:
+            if "APP_EXIST" in str(e):
+                return
+            if time.monotonic() > deadline:
+                raise
+            time.sleep(1)
+
+
+def run_wan_test(directory: str, n_tenants: int = 2,
+                 partitions: int = 4, duration_s: float = 60.0,
+                 n_replica: int = 2, seed: int = 0,
+                 kill_every_s: float = 15.0,
+                 wan_delay_s: float = 0.05, wan_drop: float = 0.1,
+                 blackout_s: float = 5.0,
+                 op_timeout_ms: float = 8_000) -> dict:
+    """Two-cluster geo-replication soak ending in the failover drill.
+
+    Cluster A (`a-` prefix, cluster id 1) duplicates every tenant table
+    to cluster B (`b-` prefix, cluster id 2). The inter-cluster link is
+    WAN-shaped by a seeded FaultPlan on A's nodes (delay + drop on every
+    A→B pair) plus a mid-run FULL link blackout toggled live through the
+    `fault.set` remote verb; Killer kill chaos fires on BOTH clusters
+    the whole run. At ~70% the controlled failover drill runs per table:
+    fence A (writes get retryable ERR_DUP_FENCED), drain every
+    partition to confirmed == last_committed, flip B writable — then
+    the DataVerifier ledger replays EVERY write A ever acked against B.
+    Zero violations is the acceptance invariant."""
+    from pegasus_tpu.tools import onebox_cluster as ob
+
+    da = os.path.join(directory, "A")
+    db = os.path.join(directory, "B")
+    rng = random.Random(seed)
+    report: dict = {"topology": "wan", "tenants": {}, "violations": []}
+    ob.start(db, n_replica=n_replica, name_prefix="b-", cluster_id=2)
+    try:
+        admin_b = ob.OneboxAdmin(db)
+        _wait_cluster(admin_b, n_replica)
+        with open(os.path.join(db, "cluster.json")) as f:
+            bnodes = {n: (c["host"], c["port"])
+                      for n, c in json.load(f)["nodes"].items()}
+        # WAN shape: every A→B link pays delay + seeded loss (replies
+        # ride the inbound TCP sessions back, so the fault charge is
+        # sender-side once per link — the FaultPlan contract)
+        a_fault = {
+            "seed": seed + 11,
+            "delay": [{"extra_s": wan_delay_s, "dst": bn}
+                      for bn in bnodes],
+            "drop": [{"prob": wan_drop, "dst": bn} for bn in bnodes],
+        }
+        ob.start(da, n_replica=n_replica, name_prefix="a-",
+                 extra_peers=bnodes, fault_plan=a_fault, cluster_id=1)
+        try:
+            admin_a = ob.OneboxAdmin(da)
+            _wait_cluster(admin_a, n_replica)
+            rc = min(3, n_replica)
+            tenants: List[TenantWorkload] = []
+            tables = [f"tenant{t}" for t in range(n_tenants)]
+            for t, table in enumerate(tables):
+                _create_table_retry(admin_b, table, partitions, rc)
+                _create_table_retry(admin_a, table, partitions, rc)
+                client = ob.connect(table, da,
+                                    op_timeout_ms=op_timeout_ms)
+                tenants.append(TenantWorkload(
+                    table, client, random.Random(seed * 1000 + t),
+                    n_keys=500))
+                admin_a.call("add_dup", app_name=table,
+                             follower_meta="b-meta", follower_app=table,
+                             timeout=30)
+            a_replicas = [n for n, c in admin_a.cfg["nodes"].items()
+                          if c["role"] == "replica"]
+            killer_a = Killer(da, rng, mode="kill", admin=admin_a)
+            killer_b = Killer(db, random.Random(seed + 1), mode="kill",
+                              admin=admin_b)
+
+            def set_link_drop(prob: float) -> None:
+                for n in a_replicas:
+                    for bn in bnodes:
+                        try:
+                            admin_a.remote_command(
+                                n, "fault.set",
+                                ["drop", str(prob), "", bn])
+                        except PegasusError:
+                            pass  # node mid-kill; the link heals with it
+
+            t_end = time.monotonic() + duration_s
+            blackout_at = time.monotonic() + duration_s * 0.4
+            drill_at = time.monotonic() + duration_s * 0.7
+            next_kill = time.monotonic() + kill_every_s
+            restarts = {}  # killer -> restart deadline
+            blackout_done = False
+            side = 0
+            while time.monotonic() < min(t_end, drill_at):
+                for tw in tenants:
+                    tw.step()
+                now = time.monotonic()
+                for killer, at in list(restarts.items()):
+                    if now >= at:
+                        killer.restart_down()
+                        restarts.pop(killer)
+                if now >= next_kill:
+                    # alternate chaos between the two clusters
+                    killer = (killer_a, killer_b)[side % 2]
+                    side += 1
+                    if killer.down is None:
+                        killer.kill_one()
+                        restarts[killer] = now + kill_every_s / 2
+                    next_kill = now + kill_every_s
+                if not blackout_done and now >= blackout_at:
+                    # full inter-cluster partition: shipping must stall
+                    # (re-drives only), then converge after the heal
+                    set_link_drop(1.0)
+                    time.sleep(blackout_s)
+                    set_link_drop(0.0)  # heal to delay-only
+                    blackout_done = True
+            for killer in (killer_a, killer_b):
+                killer.restart_down()
+            report["blackout_done"] = blackout_done
+            report["kills_a"] = killer_a.kills
+            report["kills_b"] = killer_b.kills
+
+            # ---- the drill: fence → drain → flip, per table ----------
+            drill_status: dict = {}
+            for table in tables:
+                deadline = time.monotonic() + 60
+                while True:
+                    try:
+                        admin_a.call("dup_failover", app_name=table,
+                                     timeout=15)
+                        break
+                    except PegasusError as e:
+                        if time.monotonic() > deadline:
+                            raise
+                        drill_status.setdefault(
+                            "start_retries", []).append(str(e))
+                        time.sleep(1)
+            # writes during the drain must surface the typed fence,
+            # never an ack that could be stranded on A
+            fence_seen = 0
+            deadline = time.monotonic() + 180
+            while time.monotonic() < deadline:
+                done = 0
+                for table in tables:
+                    try:
+                        st = admin_a.call("dup_failover_status",
+                                          app_name=table, timeout=10)
+                        drill_status[table] = st
+                        done += st["phase"] == "done"
+                    except PegasusError:
+                        pass
+                if done == len(tables):
+                    break
+                time.sleep(1)
+            report["drill"] = drill_status
+            report["drill_done"] = all(
+                drill_status.get(t, {}).get("phase") == "done"
+                for t in tables)
+            try:
+                report["dup_stats"] = admin_a.call("dup_stats",
+                                                   timeout=10)
+            except PegasusError:
+                report["dup_stats"] = None
+            for n in a_replicas:
+                try:
+                    for ent in admin_a.remote_command(n, "metrics",
+                                                      ["storage"]):
+                        fence_seen += ent.get("metrics", {}).get(
+                            "dup_fence_reject_count", {}).get("value", 0)
+                except PegasusError:
+                    pass
+            report["fence_rejects"] = fence_seen
+
+            # ---- the invariant: every write A acked reads back on B --
+            for tw in tenants:
+                b_client = ob.connect(tw.name, db,
+                                      op_timeout_ms=op_timeout_ms)
+                tw.verifier.client = b_client
+                tw.verifier.final_check(deadline_s=180.0)
+                report["tenants"][tw.name] = {
+                    "writes_acked": tw.verifier.write_ok,
+                    "writes_rejected": tw.verifier.write_rejected,
+                    "reads_ok": tw.reads_ok,
+                    "read_errors": tw.read_errors,
+                }
+                report["violations"].extend(
+                    f"{tw.name}: {v}" for v in tw.verifier.violations)
+        finally:
+            try:
+                admin_a.close()
+            except Exception:  # noqa: BLE001 - teardown best-effort
+                pass
+            ob.stop(da)
+    finally:
+        try:
+            admin_b.close()
+        except Exception:  # noqa: BLE001 - teardown best-effort
+            pass
+        ob.stop(db)
+    return report
+
+
 def main() -> None:
     import argparse
 
     ap = argparse.ArgumentParser()
     ap.add_argument("--dir", required=True)
+    ap.add_argument("--topology", choices=["single", "wan"],
+                    default="single",
+                    help="wan: two clusters, A geo-replicating to B "
+                         "across a faulted link, ending in the "
+                         "controlled failover drill")
     ap.add_argument("--tenants", type=int, default=4)
     ap.add_argument("--partitions", type=int, default=32)
     ap.add_argument("--nodes", type=int, default=3)
@@ -262,11 +481,17 @@ def main() -> None:
                     default="kill")
     ap.add_argument("--disk-faults", action="store_true")
     args = ap.parse_args()
-    report = run_scale_test(
-        args.dir, n_tenants=args.tenants, partitions=args.partitions,
-        duration_s=args.duration, n_replica=args.nodes, seed=args.seed,
-        chaos_mode=None if args.chaos == "none" else args.chaos,
-        disk_faults=args.disk_faults)
+    if args.topology == "wan":
+        report = run_wan_test(
+            args.dir, n_tenants=args.tenants,
+            partitions=args.partitions, duration_s=args.duration,
+            n_replica=args.nodes, seed=args.seed)
+    else:
+        report = run_scale_test(
+            args.dir, n_tenants=args.tenants, partitions=args.partitions,
+            duration_s=args.duration, n_replica=args.nodes, seed=args.seed,
+            chaos_mode=None if args.chaos == "none" else args.chaos,
+            disk_faults=args.disk_faults)
     print(json.dumps(report, indent=1, default=str))
     sys.exit(1 if report["violations"] else 0)
 

@@ -20,7 +20,7 @@ verbs, parity: the linenoise REPL + `use`). Command families:
                flush_log, backup, restore, start/query_backup,
                restore_app, *_backup_policy, start/query/pause/restart/
                cancel/clear_bulk_load, add/query/remove/pause/start_dup,
-               set_dup_fail_mode
+               set_dup_fail_mode, dup_stats, dup_failover [--status]
   cluster    : cluster_info, nodes, server_info, server_stat, app_stat,
                app_disk, ddd_diagnose, propose, rebalance, offline_node,
                get/set_meta_level, detect_hotkey, remote_command,
@@ -404,6 +404,20 @@ def main(argv=None) -> int:
     p = sub.add_parser("flush_log")
     p.add_argument("node")
     sub.add_parser("dups")
+    p = sub.add_parser("dup_stats",
+                       help="cluster-wide duplication health: per-dup "
+                            "lag (decrees+ms), inflight decree, "
+                            "fail_mode, shipped bytes, last error")
+    p.add_argument("table", nargs="?", default="")
+    p = sub.add_parser("dup_failover",
+                       help="controlled failover drill: fence the "
+                            "source table (writes get retryable "
+                            "ERR_DUP_FENCED), drain confirmed decrees, "
+                            "flip the follower writable")
+    p.add_argument("table")
+    p.add_argument("--status", action="store_true",
+                   help="report the in-flight drill instead of "
+                        "starting one")
     sub.add_parser("recover")
     p = sub.add_parser("query_restore_status")
     p.add_argument("table", nargs="?", default="")
@@ -1301,6 +1315,20 @@ def _dispatch(args, box, out) -> int:
         print("OK", file=out)
     elif args.cmd == "dups":
         print(json.dumps(box.admin.call("list_dups")), file=out)
+    elif args.cmd == "dup_stats":
+        # meta-aggregated dup health (the config-sync dup block), plus
+        # each node's live session/governor view from the dup.stats verb
+        rows = box.admin.call("dup_stats", app_name=args.table)
+        print(json.dumps(rows, indent=1), file=out)
+        for n in box.admin.call("list_nodes"):
+            node_stats = box.remote_command(n, "dup.stats", [])
+            if node_stats and node_stats.get("sessions"):
+                print(json.dumps(node_stats, indent=1), file=out)
+    elif args.cmd == "dup_failover":
+        verb = ("dup_failover_status" if args.status
+                else "dup_failover")
+        print(json.dumps(box.admin.call(verb, app_name=args.table),
+                         indent=1), file=out)
     elif args.cmd == "recover":
         print(json.dumps(box.admin.call("recover")), file=out)
     elif args.cmd == "query_restore_status":

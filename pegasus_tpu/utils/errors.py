@@ -80,6 +80,11 @@ class ErrorCode(enum.IntEnum):
     ERR_ACL_DENY = 61
     ERR_DUP_EXIST = 62
     ERR_CHECKSUM_FAILED = 63
+    # duplication failover drill: the table is fenced while its dup
+    # drains to the follower before the flip. RETRYABLE — the client's
+    # backoff rides out the drain and lands on the flipped follower
+    # (or surfaces the fence to the operator at its op deadline)
+    ERR_DUP_FENCED = 64
 
 
 class StorageStatus(enum.IntEnum):

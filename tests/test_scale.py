@@ -1,11 +1,14 @@
-"""Scale harness: the million-user elasticity proof artifact.
+"""Scale harness: the million-user elasticity proof artifact + the
+two-cluster geo-replication soak.
 
-The @slow soak is the ROADMAP deliverable — a multi-process onebox with
-≥128 partitions, multi-tenant zipfian load with per-tenant CU QoS,
-chaos kills, one online split, and one rebalance, all while the
-DataVerifier invariant (zero acked-write loss) holds. The fast tests
-pin the harness's seeded determinism so tier-1 exercises the workload
-shape on every run (the sim twin of the closed loop itself lives in
+The @slow soaks are the ROADMAP deliverables — a multi-process onebox
+with ≥128 partitions under chaos through a split and a rebalance, and
+the WAN topology: two oneboxes, A geo-replicating to B across a faulted
+link with kill chaos on both sides, ending in the controlled failover
+drill with the DataVerifier invariant (zero acked-write loss) replayed
+against B. The fast tests pin seeded determinism so tier-1 exercises
+the workload shape — and a full seeded-sim twin of the WAN drill — on
+every run (the sim twin of the elasticity loop itself lives in
 tests/test_elasticity.py).
 """
 
@@ -58,3 +61,115 @@ def test_scale_soak_split_and_rebalance_under_chaos(tmp_path):
     # the controller's signal surface was live during the run
     hp = report["hot_partitions"]
     assert hp and len(hp["partitions"]) >= 128
+
+
+def test_wan_sim_twin_chaos_and_failover_drill(tmp_path):
+    """Fast seeded-sim twin of the WAN soak (tier-1): two SimClusters
+    on one wire with delay+loss on the inter-cluster links, a kill on
+    EACH side mid-stream, then the controlled failover drill — fence
+    (typed ERR_DUP_FENCED to clients), drain confirmed==last_committed,
+    flip — and every write A ever acked reads back on B."""
+    from pegasus_tpu.runtime.sim import SimLoop, SimNetwork
+    from pegasus_tpu.tools.cluster import SimCluster
+    from pegasus_tpu.utils.errors import ErrorCode, PegasusError
+
+    loop = SimLoop(seed=21)
+    net = SimNetwork(loop)
+    a = SimCluster(str(tmp_path / "A"), n_nodes=3, name_prefix="a-",
+                   loop=loop, net=net, cluster_id=1)
+    b = SimCluster(str(tmp_path / "B"), n_nodes=3, name_prefix="b-",
+                   loop=loop, net=net, cluster_id=2)
+
+    def step_both(rounds=1):
+        for _ in range(rounds):
+            a.step()
+            b.step(advance=False)
+
+    try:
+        step_both(2)
+        a.create_table("t", partition_count=2, replica_count=3)
+        b.create_table("t", partition_count=2, replica_count=3)
+        a.meta.duplication.add_duplication("t", "b-meta", "t")
+        # WAN shape on every inter-cluster link, both directions
+        for s in list(a.stubs) + [m.name for m in a.metas]:
+            for d in list(b.stubs) + [m.name for m in b.metas]:
+                net.set_delay(0.08, src=s, dst=d)
+                net.set_delay(0.08, src=d, dst=s)
+                net.set_drop(0.1, src=s, dst=d)
+                net.set_drop(0.1, src=d, dst=s)
+        ca = a.client("t")
+        acked = {}
+        seq = 0
+        for burst in range(4):
+            for _ in range(10):
+                seq += 1
+                hk = b"w%04d" % seq
+                if ca.set(hk, b"s", b"v%d" % seq) == 0:
+                    acked[hk] = b"v%d" % seq
+            if burst == 1:
+                # kill one node on each side mid-stream; guardians cure
+                a.kill(sorted(a.stubs)[1])
+                b.kill(sorted(b.stubs)[1])
+            if burst == 2:
+                a.revive(sorted(a.stubs)[1])
+                b.revive(sorted(b.stubs)[1])
+            step_both(3)
+        assert len(acked) >= 30
+        # ---- the drill ----------------------------------------------
+        a.meta.duplication.start_failover("t")
+        step_both(1)
+        # fenced: a client write surfaces the typed retryable error
+        c2 = a.client("t", name="a-fence-probe")
+        c2.max_retries = 1
+        with pytest.raises(PegasusError) as ei:
+            if c2.set(b"fenced", b"s", b"x") != 0:
+                raise PegasusError(ErrorCode.ERR_DUP_FENCED, "gated")
+        assert "DUP_FENCED" in str(ei.value)
+        done = False
+        for _ in range(25):
+            step_both(1)
+            st = a.meta.duplication.failover_status("t")
+            if st["phase"] == "done":
+                done = True
+                break
+        assert done, st
+        assert st["drained"] or st["phase"] == "done"
+        # ---- the invariant: zero acked-write loss on B --------------
+        cb = b.client("t")
+        lost = [hk for hk, v in acked.items()
+                if cb.get(hk, b"s") != (0, v)]
+        assert lost == [], f"{len(lost)} acked writes missing on B"
+        # fence rejections were actually observed by A's nodes
+        from pegasus_tpu.utils.metrics import METRICS
+
+        fence = sum(ent["metrics"].get("dup_fence_reject_count",
+                                       {}).get("value", 0)
+                    for ent in METRICS.snapshot("storage"))
+        assert fence >= 1
+    finally:
+        a.close()
+        b.close()
+
+
+@pytest.mark.slow
+def test_wan_soak_two_oneboxes_failover_drill(tmp_path):
+    """The WAN topology soak (multi-process, real TCP): A duplicates 2
+    tenant tables to B across a delayed+lossy link with a mid-run full
+    blackout, kill chaos alternating across BOTH clusters, ending in
+    the failover drill — fence, drain, flip — after which the
+    DataVerifier ledger replays every acked write against B. Zero
+    violations = zero acked-write loss."""
+    from pegasus_tpu.tools.scale_test import run_wan_test
+
+    report = run_wan_test(
+        str(tmp_path / "wan"), n_tenants=2, partitions=4,
+        duration_s=40, n_replica=2, seed=5, kill_every_s=14)
+    assert report["violations"] == [], report["violations"]
+    assert report["drill_done"], report.get("drill")
+    assert report["kills_a"] >= 1 and report["kills_b"] >= 1
+    assert report["blackout_done"]
+    total_acked = sum(t["writes_acked"]
+                      for t in report["tenants"].values())
+    assert total_acked > 40
+    stats = report.get("dup_stats") or []
+    assert stats and sum(s["shipped_bytes"] for s in stats) > 0
