@@ -1977,8 +1977,10 @@ def main() -> None:
                                                seed + 23)
                 id_miss, id_hot = miss_stream[:512], hot_stream[:512]
 
-                def _mode(bloom: bool, rc_bytes: int) -> None:
+                def _mode(bloom: bool, rc_bytes: int,
+                          phash: bool = False) -> None:
                     _F8.set("pegasus.server", "bloom_probe", bloom)
+                    _F8.set("pegasus.server", "phash_probe", phash)
                     _F8.set("pegasus.server", "row_cache_bytes",
                             rc_bytes)
                     for s in bc.servers:
@@ -2018,31 +2020,90 @@ def main() -> None:
                     bc, id_miss, fb) == base_miss_id
                 flt_miss_s, m_hits_f = _measure(miss_stream,
                                                 fresh_loc=True)
-                _mode(True, 33_554_432)  # production: filters + row cache
+                _mode(True, 33_554_432)  # PR-8 production: bloom + rc
                 hot_ident = collect_point_results(
                     bc, id_hot, fb) == base_hot_id
                 flt_hot_s, h_hits_f = _measure(hot_stream)
+
+                # round-15: the perfect-hash index against the PR 4
+                # bloom+bisect pair — SAME run, same store, same
+                # streams, byte-identity gated against the same
+                # unfiltered baseline results. Indexed runs answer
+                # candidacy AND location in one hash pass: misses die
+                # with zero block touches, hits skip both bisects.
+                _mode(True, 0, phash=True)
+                ph_miss_ident = collect_point_results(
+                    bc, id_miss, fb) == base_miss_id
+                ph_miss_s, m_hits_p = _measure(miss_stream,
+                                               fresh_loc=True)
+                _mode(True, 33_554_432, phash=True)  # new production
+                ph_hot_ident = collect_point_results(
+                    bc, id_hot, fb) == base_hot_id
+                ph_hot_s, h_hits_p = _measure(hot_stream)
+
+                # resident index memory, same-store: what the bloom
+                # bits cost vs what the phash costs, per key (the
+                # bisect path ALSO lazily materializes ~key_width+64
+                # bytes/row of key lists / probe tables on hot blocks
+                # — memory the phash never allocates; not counted
+                # here, so the phash column is its worst case)
+                total_keys = bloom_b = phash_b = 0
+                runs_all = runs_ph = 0
+                for s in bc.servers:
+                    _lsm = s.engine.lsm
+                    for t in list(_lsm.l0) + list(_lsm.l1_runs):
+                        total_keys += t.total_count
+                        im = t.index_memory()
+                        bloom_b += im["bloom"]
+                        phash_b += im["phash"]
+                        runs_all += 1
+                        runs_ph += t.phash is not None
+                index_memory = {
+                    "total_keys": total_keys, "runs": runs_all,
+                    "runs_with_phash": runs_ph,
+                    "bloom_bytes": bloom_b, "phash_bytes": phash_b,
+                    "bloom_bytes_per_key": round(
+                        bloom_b / max(1, total_keys), 3),
+                    "phash_bytes_per_key": round(
+                        phash_b / max(1, total_keys), 3),
+                }
+                details["phases"]["index_memory"] = index_memory
+
                 miss_x = base_miss_s / flt_miss_s
                 hot_x = base_hot_s / flt_hot_s
+                ph_miss_x = base_miss_s / ph_miss_s
+                ph_hot_x = base_hot_s / ph_hot_s
                 details["phases"]["point_get_miss"] = {
                     "ops": f_ops, "batch": fb,
                     "hit_rate": round(m_hits_f / f_ops, 4),
                     "unfiltered_qps": round(f_ops / base_miss_s, 2),
                     "filtered_qps": round(f_ops / flt_miss_s, 2),
+                    "phash_qps": round(f_ops / ph_miss_s, 2),
                     "speedup": round(miss_x, 3),
+                    "phash_speedup": round(ph_miss_x, 3),
+                    "phash_vs_bloom": round(flt_miss_s / ph_miss_s, 3),
                     "meets_2x": miss_x >= 2.0,
+                    "beats_bloom": ph_miss_x > miss_x,
                     "identical_to_unfiltered": bool(
                         miss_ident and m_hits == m_hits_f),
+                    "phash_identical": bool(
+                        ph_miss_ident and m_hits == m_hits_p),
                 }
                 details["phases"]["point_get_hot"] = {
                     "ops": f_ops, "batch": fb,
                     "hit_rate": round(h_hits_f / f_ops, 4),
                     "unfiltered_qps": round(f_ops / base_hot_s, 2),
                     "row_cache_qps": round(f_ops / flt_hot_s, 2),
+                    "phash_qps": round(f_ops / ph_hot_s, 2),
                     "speedup": round(hot_x, 3),
+                    "phash_speedup": round(ph_hot_x, 3),
+                    "phash_vs_bloom": round(flt_hot_s / ph_hot_s, 3),
                     "meets_1_5x": hot_x >= 1.5,
+                    "beats_bloom": ph_hot_x > hot_x,
                     "identical_to_uncached": bool(
                         hot_ident and h_hits == h_hits_f),
+                    "phash_identical": bool(
+                        ph_hot_ident and h_hits == h_hits_p),
                 }
                 save_details()
                 with open(os.path.join(here, "BENCH_r08.json"), "w") as f:
@@ -2052,12 +2113,26 @@ def main() -> None:
                         "point_get_hot":
                             details["phases"]["point_get_hot"],
                     }, "accel_platform": accel.platform}, f, indent=1)
+                with open(os.path.join(here, "BENCH_r15.json"), "w") as f:
+                    json.dump({"phases": {
+                        "point_get_miss":
+                            details["phases"]["point_get_miss"],
+                        "point_get_hot":
+                            details["phases"]["point_get_hot"],
+                        "index_memory": index_memory,
+                    }, "accel_platform": accel.platform}, f, indent=1)
                 _log(f"point-get-miss: {f_ops / base_miss_s:.0f} -> "
-                     f"{f_ops / flt_miss_s:.0f} q/s ({miss_x:.2f}x, "
-                     f"identical={miss_ident}); point-get-hot: "
-                     f"{f_ops / base_hot_s:.0f} -> "
-                     f"{f_ops / flt_hot_s:.0f} q/s ({hot_x:.2f}x, "
-                     f"identical={hot_ident})")
+                     f"{f_ops / flt_miss_s:.0f} (bloom, {miss_x:.2f}x)"
+                     f" -> {f_ops / ph_miss_s:.0f} q/s (phash, "
+                     f"{ph_miss_x:.2f}x, identical={ph_miss_ident}); "
+                     f"point-get-hot: {f_ops / base_hot_s:.0f} -> "
+                     f"{f_ops / flt_hot_s:.0f} (bloom+rc, {hot_x:.2f}x)"
+                     f" -> {f_ops / ph_hot_s:.0f} q/s (phash+rc, "
+                     f"{ph_hot_x:.2f}x, identical={ph_hot_ident}); "
+                     f"index_memory: bloom "
+                     f"{index_memory['bloom_bytes_per_key']} B/key vs "
+                     f"phash {index_memory['phash_bytes_per_key']} "
+                     f"B/key over {total_keys} keys")
 
                 if do_compact:
                     gb = float(os.environ.get("PEGBENCH_COMPACT_GB", "1.0"))

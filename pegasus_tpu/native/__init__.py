@@ -66,6 +66,17 @@ def _load() -> Optional[ctypes.CDLL]:
                 ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
                 ctypes.c_int64, ctypes.c_void_p, ctypes.c_int64,
                 ctypes.c_void_p]
+            lib.pegasus_phash_build.restype = ctypes.c_int32
+            lib.pegasus_phash_build.argtypes = [
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+                ctypes.c_uint64, ctypes.c_int64, ctypes.c_int64,
+                ctypes.c_void_p, ctypes.c_void_p]
+            lib.pegasus_phash_probe_multi.restype = None
+            lib.pegasus_phash_probe_multi.argtypes = [
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+                ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,
+                ctypes.c_void_p]
             lib.pegasus_pack_records.restype = ctypes.c_int32
             lib.pegasus_pack_records.argtypes = [
                 ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
@@ -167,6 +178,56 @@ def bloom_probe_multi_fn():
         lib.pegasus_bloom_probe_multi(
             addrs.ctypes.data, masks.ctypes.data, ks.ctypes.data,
             n_filters, hashes.ctypes.data, n_keys, out.ctypes.data)
+
+    return probe
+
+
+def phash_build_fn():
+    """The CHD perfect-hash index build (see packer.cpp
+    pegasus_phash_build), or None when the native library is
+    unavailable (storage.phash falls back to the Python CHD loop —
+    bit-identical output, per-bucket interpreter cost)."""
+    import numpy as np
+
+    lib = _load()
+    if lib is None:
+        return None
+
+    def build(hashes, locs, seed: int, ts: int, nb: int):
+        """(slots uint32[ts], disp uint16[nb]) or None when this seed
+        cannot place every bucket (the caller reseeds)."""
+        slots = np.empty(ts, dtype=np.uint32)
+        disp = np.empty(nb, dtype=np.uint16)
+        rc = lib.pegasus_phash_build(
+            hashes.ctypes.data, locs.ctypes.data, hashes.shape[0],
+            seed, ts, nb, disp.ctypes.data, slots.ctypes.data)
+        if rc != 0:
+            return None
+        return slots, disp
+
+    return build
+
+
+def phash_probe_multi_fn():
+    """The multi-index perfect-hash probe (the bloom multi-probe's
+    sibling), or None when the native library is unavailable
+    (storage.phash.PHashMultiProbe falls back to per-index vectorized
+    numpy probes)."""
+    lib = _load()
+    if lib is None:
+        return None
+
+    def probe(fixed_ptrs, n_tables, hashes, n_keys, out,
+              hit_out) -> None:
+        # fixed_ptrs: the five per-table geometry pointers
+        # (slots_addrs/disp_addrs/ts/nb/seeds uint64[n_tables]),
+        # pre-resolved by the caller — .ctypes.data costs ~0.4 us per
+        # access and the probe runs once per read flush; hashes
+        # uint64[n_keys], out uint32[n_keys * n_tables], hit_out
+        # uint8[n_keys * n_tables]
+        lib.pegasus_phash_probe_multi(
+            *fixed_ptrs, n_tables, hashes.ctypes.data, n_keys,
+            out.ctypes.data, hit_out.ctypes.data)
 
     return probe
 

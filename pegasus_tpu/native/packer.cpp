@@ -14,6 +14,7 @@
 // Build: g++ -O3 -shared -fPIC packer.cpp -o libpegasus_native.so
 // ABI: plain C, consumed via ctypes.
 
+#include <algorithm>
 #include <cstdint>
 #include <cstdlib>
 #include <cstring>
@@ -138,6 +139,206 @@ void pegasus_bloom_probe_multi(const uint64_t* bits_addrs,
         idx = (idx + delta) & mask;
       }
       row[t] = ok;
+    }
+  }
+}
+
+// ---- perfect-hash (CHD) two-level SST index -------------------------
+//
+// The build/probe twins of storage/phash.py (which documents the
+// layout: mix -> bucket/p0/delta, entry = fp(10) | loc(22), EMPTY =
+// 0xFFFFFFFF). Both sides MUST stay bit-identical to the Python
+// fallback — the mixer, geometry, bucket order and displacement search
+// are part of the on-disk format (the seed is stored in the index
+// header and a file built by either path must probe identically under
+// the other).
+
+namespace {
+
+constexpr uint64_t kPhashGolden = 0x9E3779B97F4A7C15ULL;
+constexpr uint64_t kPhashMixK = 0xFF51AFD7ED558CCDULL;
+constexpr uint32_t kPhashEmpty = 0xFFFFFFFFu;
+constexpr int kPhashFpBits = 10;
+constexpr int kPhashLocBits = 22;
+
+inline uint64_t phash_mix(uint64_t h, uint64_t seed) {
+  uint64_t x = h ^ (kPhashGolden * (seed + 1));
+  x ^= x >> 33;
+  x *= kPhashMixK;
+  return x ^ (x >> 29);
+}
+
+// Lemire multiply-shift reduction of a 32-bit value onto [0, range):
+// one multiply where a `%` costs a 20-40-cycle divide — the probe
+// pays three of these per (key, table) pair, so divisions were the
+// measured kernel bottleneck. range < 2^32, v32 < 2^32: exact in u64.
+inline uint64_t phash_r32(uint64_t v32, uint64_t range) {
+  return (v32 * range) >> 32;
+}
+
+// the (bucket, base position, step) triple of one mixed hash — shared
+// verbatim by build and probe (and mirrored bit-for-bit by the Python
+// fallback in storage/phash.py: these formulas are FORMAT, the stored
+// seed/ts/nb only mean anything under them). With a PRIME ts every
+// delta in [1, ts-1] is coprime, so (p0 + d*delta) % ts walks the
+// whole table — the one remaining division is the modular step the
+// displacement search exploits.
+inline void phash_bpd(uint64_t x, uint64_t ts, uint64_t nb,
+                      uint64_t* bucket, uint64_t* p0, uint64_t* delta) {
+  *bucket = phash_r32(x >> 32, nb);
+  *p0 = phash_r32(x & 0xFFFFFFFFull, ts);
+  *delta = 1 + phash_r32((x >> 17) & 0xFFFFFFFFull, ts - 1);
+}
+
+}  // namespace
+
+// CHD construction: bucket the n (hash, loc) pairs, place buckets in
+// decreasing-size order (ties by bucket id), and for each bucket find
+// the smallest displacement d (uint16) whose positions are distinct
+// and empty. Returns 0 on success (-1: some bucket unplaceable or an
+// entry collided with the empty sentinel — the caller reseeds, then
+// stamps the run "no phash"). One call builds a whole run's index —
+// the writer-side "one vectorized pass" contract (the Python loop
+// form pays ~n/4 interpreter iterations; this pays none).
+int32_t pegasus_phash_build(const uint64_t* hashes, const uint32_t* locs,
+                            int64_t n, uint64_t seed, int64_t ts,
+                            int64_t nb, uint16_t* disp_out,
+                            uint32_t* slots_out) {
+  if (n <= 0 || ts < 3 || nb < 1) return -1;
+  int64_t* bucket = static_cast<int64_t*>(malloc(sizeof(int64_t) * n));
+  int64_t* p0 = static_cast<int64_t*>(malloc(sizeof(int64_t) * n));
+  int64_t* delta = static_cast<int64_t*>(malloc(sizeof(int64_t) * n));
+  uint32_t* entry = static_cast<uint32_t*>(malloc(sizeof(uint32_t) * n));
+  int64_t* counts = static_cast<int64_t*>(calloc(nb + 1, sizeof(int64_t)));
+  int64_t* starts = static_cast<int64_t*>(malloc(sizeof(int64_t) * (nb + 1)));
+  int64_t* order = static_cast<int64_t*>(malloc(sizeof(int64_t) * n));
+  int64_t* border = static_cast<int64_t*>(malloc(sizeof(int64_t) * nb));
+  bool ok = bucket && p0 && delta && entry && counts && starts && order &&
+            border;
+  int32_t rc = -1;
+  if (ok) {
+    ok = true;
+    for (int64_t i = 0; i < n; ++i) {
+      const uint64_t x = phash_mix(hashes[i], seed);
+      uint64_t b_, p_, d_;
+      phash_bpd(x, static_cast<uint64_t>(ts), static_cast<uint64_t>(nb),
+                &b_, &p_, &d_);
+      bucket[i] = static_cast<int64_t>(b_);
+      p0[i] = static_cast<int64_t>(p_);
+      delta[i] = static_cast<int64_t>(d_);
+      const uint32_t fp =
+          static_cast<uint32_t>(x >> (64 - kPhashFpBits));
+      entry[i] = (fp << kPhashLocBits) | locs[i];
+      if (entry[i] == kPhashEmpty) ok = false;  // sentinel clash: reseed
+      counts[bucket[i]]++;
+    }
+    if (ok) {
+      // counting sort: keys grouped by bucket, stable in file order
+      starts[0] = 0;
+      for (int64_t b = 0; b < nb; ++b) starts[b + 1] = starts[b] + counts[b];
+      {
+        int64_t* cur = static_cast<int64_t*>(
+            malloc(sizeof(int64_t) * nb));
+        if (cur == nullptr) {
+          ok = false;
+        } else {
+          std::memcpy(cur, starts, sizeof(int64_t) * nb);
+          for (int64_t i = 0; i < n; ++i) order[cur[bucket[i]]++] = i;
+          free(cur);
+        }
+      }
+    }
+    if (ok) {
+      for (int64_t b = 0; b < nb; ++b) border[b] = b;
+      std::sort(border, border + nb, [&](int64_t a, int64_t b2) {
+        if (counts[a] != counts[b2]) return counts[a] > counts[b2];
+        return a < b2;
+      });
+      for (int64_t s = 0; s < ts; ++s) slots_out[s] = kPhashEmpty;
+      std::memset(disp_out, 0, sizeof(uint16_t) * nb);
+      int64_t pos[64];  // bucket sizes are ~4 at the default geometry
+      for (int64_t bi = 0; bi < nb && ok; ++bi) {
+        const int64_t b = border[bi];
+        const int64_t c = counts[b];
+        if (c == 0) continue;
+        if (c > 64) {
+          ok = false;  // pathological bucket: reseed / fall back
+          break;
+        }
+        const int64_t* ks = order + starts[b];
+        bool placed = false;
+        for (int64_t d = 0; d < 65536 && !placed; ++d) {
+          bool fits = true;
+          for (int64_t j = 0; j < c && fits; ++j) {
+            const int64_t k = ks[j];
+            pos[j] = (p0[k] + d * delta[k]) % ts;
+            if (slots_out[pos[j]] != kPhashEmpty) fits = false;
+            for (int64_t j2 = 0; j2 < j && fits; ++j2)
+              if (pos[j2] == pos[j]) fits = false;
+          }
+          if (!fits) continue;
+          for (int64_t j = 0; j < c; ++j)
+            slots_out[pos[j]] = entry[ks[j]];
+          disp_out[b] = static_cast<uint16_t>(d);
+          placed = true;
+        }
+        if (!placed) ok = false;
+      }
+      if (ok) rc = 0;
+    }
+  }
+  free(bucket);
+  free(p0);
+  free(delta);
+  free(entry);
+  free(counts);
+  free(starts);
+  free(order);
+  free(border);
+  return rc;
+}
+
+// Multi-index perfect-hash probe: out[i * n_tables + t] is the packed
+// loc of hash i in index t, or 0xFFFFFFFF for a definitive absent.
+// The sibling of pegasus_bloom_probe_multi: one call answers a whole
+// point-read flush's candidacy AND location matrix against every
+// indexed run of a partition — ONE slot gather per (key, run) pair
+// where the bloom pays up to k=7 bit probes and still leaves the
+// block bisect to do.
+// `hit_out` (uint8[n_keys * n_tables]) carries the candidacy verdict
+// separately from the loc matrix: the planner's per-cell consumption
+// indexes it as python BYTES (the exact C-speed read shape the bloom
+// matrix uses — numpy scalar boxing or memoryview unpacking per cell
+// measurably lost to it at L0 depth 16), touching the loc matrix only
+// for the rare located cells.
+void pegasus_phash_probe_multi(const uint64_t* slots_addrs,
+                               const uint64_t* disp_addrs,
+                               const uint64_t* ts_arr,
+                               const uint64_t* nb_arr,
+                               const uint64_t* seeds, int64_t n_tables,
+                               const uint64_t* hashes, int64_t n_keys,
+                               uint32_t* out, uint8_t* hit_out) {
+  for (int64_t i = 0; i < n_keys; ++i) {
+    const uint64_t h = hashes[i];
+    uint32_t* row = out + i * n_tables;
+    uint8_t* hrow = hit_out + i * n_tables;
+    for (int64_t t = 0; t < n_tables; ++t) {
+      const uint32_t* slots = reinterpret_cast<const uint32_t*>(
+          static_cast<uintptr_t>(slots_addrs[t]));
+      const uint16_t* disp = reinterpret_cast<const uint16_t*>(
+          static_cast<uintptr_t>(disp_addrs[t]));
+      const uint64_t ts = ts_arr[t];
+      const uint64_t x = phash_mix(h, seeds[t]);
+      uint64_t b_, p0, delta;
+      phash_bpd(x, ts, nb_arr[t], &b_, &p0, &delta);
+      const uint64_t pos = (p0 + disp[b_] * delta) % ts;
+      const uint32_t e = slots[pos];
+      const bool hit =
+          e != kPhashEmpty &&
+          (e >> kPhashLocBits) ==
+              static_cast<uint32_t>(x >> (64 - kPhashFpBits));
+      row[t] = hit ? (e & ((1u << kPhashLocBits) - 1)) : kPhashEmpty;
+      hrow[t] = hit ? 1 : 0;
     }
   }
 }

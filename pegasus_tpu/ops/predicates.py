@@ -436,10 +436,43 @@ def point_probe_rows(keys_matrix: np.ndarray, key_len: np.ndarray,
     return rows
 
 
+def phash_verify_rows(keys_matrix: np.ndarray, key_len: np.ndarray,
+                      rows: np.ndarray, probe_keys) -> np.ndarray:
+    """bool[P]: does block row rows[i] hold EXACTLY probe_keys[i]?
+
+    The perfect-hash probe's fingerprint-collision rejector: the index
+    (storage/phash.py) maps a batched flush straight to (block, slot)
+    rows, and this one vectorized compare per touched block confirms
+    each located row before it serves — a collision (~0.08% of absent
+    keys) must read as "absent", never as another row's value. Scalar
+    fast path below the same threshold as point_probe_rows (the 1-4
+    key flush shape)."""
+    p = len(probe_keys)
+    if p == 0:
+        return np.zeros(0, dtype=bool)
+    n, w = keys_matrix.shape
+    kl = np.asarray(key_len)
+    if p <= 4:
+        out = np.zeros(p, dtype=bool)
+        for i, k in enumerate(probe_keys):
+            r = int(rows[i])
+            lk = len(k)
+            out[i] = (lk <= w and int(kl[r]) == lk
+                      and keys_matrix[r, :lk].tobytes() == k)
+        return out
+    pm, lens = pad_probe_keys(probe_keys, w)
+    fits = lens <= w
+    rows = np.asarray(rows, dtype=np.int64)
+    same = (keys_matrix[rows] == pm).all(axis=1)
+    return same & fits & (kl[rows] == lens)
+
+
 def bloom_key_hashes(keys) -> np.ndarray:
-    """uint64[B] full-key crc64 for a batch of probe keys — the bloom
-    filter's hash input, evaluated once per read flush and shared by
-    every table/run the flush's candidates touch.
+    """uint64[B] full-key crc64 for a batch of probe keys — the hash
+    input EVERY sidecar structure shares (bloom filters and the
+    perfect-hash index probe the same column), evaluated once per read
+    flush and consumed by every table/run the flush's candidates
+    touch.
 
     Placement: compute-trivial per byte (the "probe" workload class in
     ops/placement.py — a table lookup per byte), so this always runs on

@@ -79,6 +79,7 @@ from pegasus_tpu.server.types import (
 from pegasus_tpu.server.write_service import WriteService
 
 from pegasus_tpu.storage.bloom import bloom_probe_enabled
+from pegasus_tpu.storage.phash import phash_probe_enabled
 from pegasus_tpu.storage.engine import StorageEngine
 from pegasus_tpu.utils.errors import (
     ErrorCode,
@@ -214,10 +215,13 @@ class PartitionServer:
         # invalidation discipline as _plan_cache (replaced wholesale on
         # generation change).
         self._point_cache = None
-        # (store, generation, MultiProbe, {id(table) -> filter col}):
-        # the run set's filters prepared for the one-call batched
-        # probe; pure over the immutable run set
-        self._bloom_probe_cache = None
+        # (store, generation, phash-flag, MultiProbe, {id(table) ->
+        # filter col}, PHashMultiProbe, {id(table) -> index col}): the
+        # run set's sidecar structures prepared for the one-call
+        # batched probes; pure over the immutable run set (+ the
+        # mutable phash kill switch, which decides whether indexed
+        # tables still need bloom columns)
+        self._index_probe_cache = None
         self.metrics = METRICS.entity(
             "replica", f"{app_id}.{pidx}",
             {"table": str(app_id), "partition": str(pidx)})
@@ -227,8 +231,15 @@ class PartitionServer:
         # twins live on the "storage" entity): incremented BATCHED, once
         # per read flush
         self._bloom_useful = self.metrics.counter("bloom_useful_count")
+        self._phash_useful = self.metrics.counter("phash_useful_count")
         self._row_cache_hits = self.metrics.counter("row_cache_hit")
         self._row_cache_misses = self.metrics.counter("row_cache_miss")
+        # resident index memory as a first-class signal: per-table
+        # bloom-vs-phash byte split, refreshed whenever the probe
+        # structures rebuild (exactly when the run set changes) and
+        # scraped by tools/collector.py
+        self._index_bloom_bytes = self.metrics.gauge("index_bloom_bytes")
+        self._index_phash_bytes = self.metrics.gauge("index_phash_bytes")
         # slow-read dumps (parity: slow-query threshold app-env +
         # latency_tracer dumps); threshold configurable per table via
         # replica.slow_query_threshold_ms
@@ -958,28 +969,52 @@ class PartitionServer:
             uniq[key] = None  # placeholder until base resolution
             base_pending.append(key)
 
-        # disk-bound residue: ONE vectorized full-key hash pass + ONE
-        # native multi-filter probe answer every (key x L0-table /
-        # L1-run) candidacy of the flush before any block is decoded —
-        # definitive "absent" cells skip the decode + bisect entirely,
-        # which is where miss-heavy and deep-L0 traffic spends its time
+        # disk-bound residue: ONE vectorized full-key hash pass feeds
+        # BOTH sidecar probes — one native multi-filter bloom call for
+        # filter-only tables, one native multi-index perfect-hash call
+        # (`pegasus_phash_probe_multi`) for indexed tables — answering
+        # the whole (key x L0-table / L1-run) candidacy AND location
+        # matrix of the flush before any block is decoded. Definitive
+        # "absent" cells skip the decode + bisect entirely; located
+        # cells go straight to their (block, slot) row with no fence
+        # bisect and no in-block search
         probe = None  # (matrix bytes, {id(table)->col}, {key->row base})
+        pprobe = None  # (loc memoryview, hit-mask bytes, cols, mp, rows)
         bloom_useful = 0
-        if base_pending and bloom_probe_enabled():
-            mp, cols = self._filter_probe(lsm, gen)
-            if mp is not None:
+        phash_useful = 0
+        useful_box = [0, 0]  # [phash-pruned, phash-located]
+        want_phash = phash_probe_enabled()
+        if base_pending and (bloom_probe_enabled() or want_phash):
+            mp, cols, pp, pcols = self._index_probes(lsm, gen,
+                                                     want_phash)
+            # ONE shared hash pass, and only when a probe will consume
+            # it (bloom filters present with probing on, or any
+            # indexed run) — a store with probing killed or no
+            # structures must not pay the vectorized crc per flush
+            if (mp is not None and bloom_probe_enabled()) \
+                    or pp is not None:
                 from pegasus_tpu.ops.predicates import bloom_key_hashes
 
-                mat = mp.probe(bloom_key_hashes(base_pending))
+                hashes = bloom_key_hashes(base_pending)
+                key_row = {k: i for i, k in enumerate(base_pending)}
+            if mp is not None and bloom_probe_enabled():
+                mat = mp.probe(hashes)
                 nfil = mp.n
                 probe = (mat, cols,
-                         {k: i * nfil
-                          for i, k in enumerate(base_pending)})
-        tracer.add_point("bloom")
+                         {k: i * nfil for i, k in enumerate(
+                             base_pending)})
+            tracer.add_point("bloom")
+            if pp is not None:
+                pmat, pmask = pp.probe(hashes)
+                pprobe = (pmat, pmask, pcols, pp, key_row)
+            tracer.add_point("phash_probe")
+        else:
+            tracer.add_point("bloom")
+            tracer.add_point("phash_probe")
         pending = base_pending
         if pending and l0:
             pending, bloom_useful = self._probe_l0(
-                l0, pending, probe, uniq)
+                l0, pending, probe, uniq, pprobe, useful_box)
         if pending:
             still = []
             for key in pending:
@@ -991,7 +1026,9 @@ class PartitionServer:
             pending = still
         if pending:
             bloom_useful += self._locate_points(runs, pending, uniq,
-                                                probe)
+                                                probe, pprobe,
+                                                useful_box)
+        phash_useful = useful_box[0]
         if lsm.generation != gen:
             # a compaction/flush published mid-plan: the overlay misses
             # above may have raced the cut-over (key consumed from the
@@ -1014,6 +1051,15 @@ class PartitionServer:
         if bloom_useful:
             self._bloom_useful.increment(bloom_useful)
             _STORAGE_BLOOM_USEFUL.increment(bloom_useful)
+        if phash_useful:
+            from pegasus_tpu.storage.phash import PHASH_USEFUL
+
+            self._phash_useful.increment(phash_useful)
+            PHASH_USEFUL.increment(phash_useful)
+        if useful_box[1]:
+            from pegasus_tpu.storage.phash import PHASH_HIT
+
+            PHASH_HIT.increment(useful_box[1])
         if rc_hits:
             self._row_cache_hits.increment(rc_hits)
         if rc_misses:
@@ -1023,59 +1069,122 @@ class PartitionServer:
                 "uniq": uniq, "now": now, "t0": t0, "wide": wide,
                 "tracer": tracer}
 
-    def _filter_probe(self, lsm, gen: int):
-        """(MultiProbe over every filtered table of the current run
-        set, {id(table) -> filter column}); (None, {}) when no table
-        carries a filter. Pure over the immutable run set — rebuilt
-        once per store generation, so the plan hot path pays one
-        identity compare."""
-        c = self._bloom_probe_cache
-        if c is not None and c[0] is lsm and c[1] == gen:
-            return c[2], c[3]
+    def _index_probes(self, lsm, gen: int, want_phash: bool):
+        """The run set's sidecar structures prepared for the one-call
+        batched probes: (bloom MultiProbe, {id(table) -> filter col},
+        PHashMultiProbe, {id(table) -> index col}). When phash probing
+        is ON, indexed tables are EXCLUDED from the bloom probe — the
+        perfect hash already answers candidacy (definitive absent) and
+        location in one gather, so probing both structures would just
+        double the per-pair work ("retiring the bloom+bisect pair" at
+        probe time). Pure over the immutable run set (+ the phash
+        flag) — rebuilt once per store generation, so the plan hot
+        path pays one identity compare; the rebuild also refreshes the
+        per-table resident-index-memory gauges."""
+        c = self._index_probe_cache
+        if c is not None and c[0] is lsm and c[1] == gen \
+                and c[2] == want_phash:
+            return c[3], c[4], c[5], c[6]
         from pegasus_tpu.storage.bloom import MultiProbe
+        from pegasus_tpu.storage.phash import PHashMultiProbe
 
         filters = []
         cols: dict = {}
+        indexes = []
+        pcols: dict = {}
+        bloom_bytes = phash_bytes = 0
         for t in list(lsm.l0) + list(lsm.l1_runs):
             if t.bloom is not None:
+                bloom_bytes += t.bloom.bits.nbytes
+            if t.phash is not None:
+                phash_bytes += t.phash.mem_bytes()
+            if want_phash and t.phash is not None:
+                pcols[id(t)] = len(indexes)
+                indexes.append(t.phash)
+            elif t.bloom is not None:
                 cols[id(t)] = len(filters)
                 filters.append(t.bloom)
         mp = MultiProbe(filters) if filters else None
-        self._bloom_probe_cache = (lsm, gen, mp, cols)
-        return mp, cols
+        pp = PHashMultiProbe(indexes) if indexes else None
+        self._index_bloom_bytes.set(bloom_bytes)
+        self._index_phash_bytes.set(phash_bytes)
+        self._index_probe_cache = (lsm, gen, want_phash, mp, cols, pp,
+                                   pcols)
+        return mp, cols, pp, pcols
 
-    def _probe_l0(self, l0, keys: list, probe, uniq: dict
-                  ) -> Tuple[list, int]:
+    def _probe_l0(self, l0, keys: list, probe, uniq: dict,
+                  pprobe=None, useful_box=None) -> Tuple[list, int]:
         """Resolve `keys` through the L0 overlay newest-first (first
         table hit wins, the solo-get order). `probe` is the flush's
         precomputed bloom answer (matrix bytes, {id(table) -> column},
         {key -> row base}): a 0 cell is a definitive absent — no block
-        is touched. Filterless tables (pre-filter files) gate on their
-        first/last-key fences instead, a compare per key. Returns
-        (unresolved keys, bloom-pruned probe count)."""
+        is touched. `pprobe` is the perfect-hash LOCATION answer (u32
+        loc memoryview, hit-mask bytes, {id(table) -> index column},
+        multiprobe, {key -> row}): a 0 mask cell is definitive with
+        zero block touches, and a hit cell's loc reads its (block,
+        slot) row directly — one row compare (against a fingerprint
+        collision) replaces the whole table bisect. Filterless,
+        index-less tables (pre-filter files) gate on their
+        first/last-key fences instead. Returns (unresolved keys,
+        bloom-pruned count); phash-pruned probes accumulate into
+        `useful_box[0]`."""
         useful = 0
+        p_useful = 0
+        p_hits = 0
         if probe is not None:
             mat, cols, key_row = probe
-            # (table, filter column | None) resolved once per flush —
-            # id()+dict per (key, table) pair was measurable at depth 16
-            pairs = [(t, cols.get(id(t))) for t in l0]
         else:
-            mat = key_row = None
-            pairs = [(t, None) for t in l0]
+            mat = cols = key_row = None
+        if pprobe is not None:
+            pmat, pmask, pcols, pp, pkey_row = pprobe
+            npt = pp.n
+        else:
+            pmat = pmask = pcols = pp = pkey_row = None
+            npt = 0
+        # (table, filter column | None, index column | None, index
+        # geometry) resolved once per flush — id()+dict (and per-hit
+        # attribute walks) per (key, table) pair was measurable at
+        # depth 16
+        pairs = [(t, cols.get(id(t)) if cols is not None else None,
+                  pcols.get(id(t)) if pcols is not None else None,
+                  t.phash.slot_bits if t.phash is not None else 0)
+                 for t in l0]
         out_keys = []
         for k in keys:
             row = key_row[k] if key_row is not None else 0
+            prow = pkey_row[k] * npt if pkey_row is not None else 0
             resolved = False
-            for table, col in pairs:
-                if col is not None:
+            for table, col, pcol, sb in pairs:
+                if pcol is not None:
+                    cell = prow + pcol
+                    if not pmask[cell]:
+                        p_useful += 1
+                        continue
+                    loc = pmat[cell]
+                    bi = loc >> sb
+                    slot = loc & ((1 << sb) - 1)
+                    if bi >= len(table.blocks) \
+                            or slot >= table.blocks[bi].count:
+                        h = table.get(k)  # corrupt loc: bisect path
+                    else:
+                        blk = table.read_block(bi)
+                        if blk.key_at(slot) != k:
+                            p_useful += 1  # fp collision: absent here
+                            continue
+                        p_hits += 1
+                        h = ((None, 0) if blk.is_tombstone(slot)
+                             else (blk.value_at(slot),
+                                   int(blk.expire_ts[slot])))
+                elif col is not None:
                     if not mat[row + col]:
                         useful += 1
                         continue
+                    h = table.get(k)
                 else:
                     fk = table.first_key
                     if fk is None or k < fk or k > table.last_key:
                         continue
-                h = table.get(k)
+                    h = table.get(k)
                 if h is not None:
                     uniq[k] = (None if h[0] is None
                                else ("ov", h[0], h[1]))
@@ -1083,6 +1192,9 @@ class PartitionServer:
                     break
             if not resolved:
                 out_keys.append(k)
+        if useful_box is not None:
+            useful_box[0] += p_useful
+            useful_box[1] += p_hits
         return out_keys, useful
 
     def _maybe_admit_rows(self, rc, gid, suid: int, gen: int, epoch: int,
@@ -1117,15 +1229,21 @@ class PartitionServer:
         rc.admit_many(gid, suid, gen, items, epoch=epoch)
 
     def _locate_points(self, runs, keys: list, out: dict,
-                       probe=None) -> int:
+                       probe=None, pprobe=None, useful_box=None) -> int:
         """Batch-locate keys in the non-overlapping L1 runs: bisect each
-        key to its run, answer each candidacy from the flush's
-        precomputed bloom matrix (`probe` — a 0 cell is definitively
-        absent, no block is decoded), then probe every surviving
-        block's sorted key matrix with ONE vectorized searchsorted
-        (page.probe_rows). out[key] = ("l1", blk, row) | None (absent
-        or tombstone — L1 is the last level). Returns the bloom-pruned
-        probe count."""
+        key to its run, then answer each candidacy from the flush's
+        precomputed sidecar matrices. An INDEXED run (`pprobe`, the
+        perfect-hash location matrix) answers candidacy and location
+        in the same cell: ABSENT is definitive with zero block
+        touches, a located cell goes straight to its (block, slot) row
+        — no block-fence bisect, no searchsorted — and the row's key
+        is verified in one vectorized compare per touched block
+        (ops.predicates.phash_verify_rows) to reject fingerprint
+        collisions. Filter-only runs keep the bloom cell + bisect +
+        probe_rows path; structure-less runs bisect unconditionally.
+        out[key] = ("l1", blk, row) | None (absent or tombstone — L1
+        is the last level). Returns the bloom-pruned count;
+        phash-pruned probes accumulate into `useful_box[0]`."""
         import bisect as _b
 
         from pegasus_tpu.server.page import probe_rows
@@ -1138,6 +1256,12 @@ class PartitionServer:
             mat, cols, key_row = probe
         else:
             mat = cols = key_row = None
+        if pprobe is not None:
+            pmat, pmask, pcols, pp, pkey_row = pprobe
+            npt = pp.n
+        else:
+            pmat = pmask = pcols = pp = pkey_row = None
+            npt = 0
         run_last = [r.last_key or b"" for r in runs]
         by_run: "OrderedDict[int, list]" = OrderedDict()
         for key in keys:
@@ -1147,9 +1271,37 @@ class PartitionServer:
                 continue
             by_run.setdefault(ri, []).append(key)
         useful = 0
+        p_useful = 0
         by_block: "OrderedDict[tuple, list]" = OrderedDict()
+        by_slot: "OrderedDict[tuple, list]" = OrderedDict()
         for ri, ks in by_run.items():
             run = runs[ri]
+            pcol = pcols.get(id(run)) if pcols is not None else None
+            if pcol is not None:
+                sb = run.phash.slot_bits
+                sm = (1 << sb) - 1
+                nblocks = len(run.blocks)
+                blocks = run.blocks
+                for k in ks:
+                    cell = pkey_row[k] * npt + pcol
+                    if not pmask[cell]:
+                        p_useful += 1
+                        out[k] = None
+                        continue
+                    loc = pmat[cell]
+                    bi = loc >> sb
+                    slot = loc & sm
+                    if bi >= nblocks or slot >= blocks[bi].count:
+                        # corrupt loc: this key takes the bisect path
+                        bj = run._block_for_key(k)
+                        if bj is None:
+                            out[k] = None
+                        else:
+                            by_block.setdefault((ri, bj),
+                                                []).append(k)
+                        continue
+                    by_slot.setdefault((ri, bi), []).append((k, slot))
+                continue
             col = cols.get(id(run)) if cols is not None else None
             if col is not None:
                 kept = []
@@ -1166,6 +1318,30 @@ class PartitionServer:
                     out[key] = None
                     continue
                 by_block.setdefault((ri, bi), []).append(key)
+        if by_slot:
+            from pegasus_tpu.ops.predicates import phash_verify_rows
+        for (ri, bi), pairs in by_slot.items():
+            # located rows: ONE vectorized key-verify per touched
+            # block (the fingerprint-collision rejector); hits were
+            # going to read this block for their values anyway
+            blk = runs[ri].read_block(bi)
+            rows = np.fromiter((s for _k, s in pairs), dtype=np.int64,
+                               count=len(pairs))
+            ok = phash_verify_rows(blk.keys, blk.key_len, rows,
+                                   [k for k, _s in pairs])
+            verified = 0
+            for (key, slot), good in zip(pairs, ok):
+                if not good:
+                    p_useful += 1  # fp collision: definitively absent
+                    out[key] = None
+                    continue
+                verified += 1
+                if blk.is_tombstone(slot):
+                    out[key] = None
+                else:
+                    out[key] = ("l1", blk, slot)
+            if useful_box is not None:
+                useful_box[1] += verified
         for (ri, bi), ks in by_block.items():
             blk = runs[ri].read_block(bi)
             for key, row in zip(ks, probe_rows(blk, ks)):
@@ -1174,6 +1350,8 @@ class PartitionServer:
                     out[key] = None
                 else:
                     out[key] = ("l1", blk, row)
+        if useful_box is not None:
+            useful_box[0] += p_useful
         return useful
 
     def point_chunks(self, state) -> list:

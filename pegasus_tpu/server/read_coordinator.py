@@ -24,11 +24,18 @@ tunneled accelerator; what batching buys instead is
 - one native gather per block for co-located keys (hot hash keys cluster
   in the same SST block) with per-second TTL masks read straight off the
   host-resident expire_ts column;
-- batched bloom pruning: each partition's plan hashes its disk-bound
-  residue ONCE (ops.predicates.bloom_key_hashes) and answers every
-  (key x L0-table / L1-run) candidacy from the per-SSTable filters
-  (storage/bloom.py) before any block is decoded — misses and deep-L0
-  states stop paying a decode + bisect per table;
+- batched sidecar pruning AND location: each partition's plan hashes
+  its disk-bound residue ONCE (ops.predicates.bloom_key_hashes — the
+  crc64 column every sidecar shares) and answers every (key x
+  L0-table / L1-run) candidacy from the per-SSTable structures before
+  any block is decoded. Indexed runs (storage/phash.py, the
+  CompassDB-style perfect-hash index) answer candidacy and LOCATION
+  in the same `pegasus_phash_probe_multi` cell: misses die with zero
+  block touches and hits go straight to their (block, slot) row — no
+  index bisect at all; filter-only runs keep the bloom+bisect path
+  (storage/bloom.py), so mixed-format stores serve correctly. The
+  plan's stage chain (plan/bloom/phash_probe/block_probe/decode/
+  finish) shows which structure answered on slow logs and traces;
 - the node row cache (server/row_cache.py): hot rows admitted by repeat
   traffic (or a hotkey-detection fast-admit) serve before the engine is
   touched at all, write-through-invalidated on the mutation apply path

@@ -231,38 +231,56 @@ class LSMStore:
 
         L0 tables short-circuit on their first/last-key fences (an
         out-of-range table costs two compares, not a block lookup) and
-        then on their bloom filters — the key is hashed ONCE when any
-        overlapping L0 table exists, and the same hash feeds the
-        candidate L1 run's filter, so a deep-L0 miss costs one crc plus
-        a bit probe per table instead of a decode + bisect per table.
-        Steady-state stores (empty L0) skip the hash entirely: a
-        present-key L1 get pays nothing new."""
+        then on their sidecar structures — the key is hashed ONCE (the
+        crc64 every sidecar shares) when any candidate table carries a
+        bloom or a perfect-hash index, and the same hash feeds every
+        structure this get consults. Indexed runs answer through
+        SSTable.get's scalar phash probe (the batched kernel's hash,
+        solo form): a miss costs one slot gather with zero block
+        touches, a hit goes straight to its (block, slot) row — the
+        non-batched client path never silently regresses to the
+        bisect. Steady-state stores (empty L0, filterless runs) skip
+        the hash entirely."""
         hit = self.memtable.get(key)
         if hit is not None:
             value, ets = hit
             return None if value is TOMBSTONE else (value, ets)
-        key_hash = ...  # unhashed; None = probing off for this get
+        from pegasus_tpu.storage.phash import phash_probe_enabled
+
+        bloom_on = bloom_probe_enabled()
+        phash_on = phash_probe_enabled()
+        key_hash: Optional[int] = None  # computed at most once
+
+        def lookup(table):
+            """One table's sidecar-gated probe, matching the batched
+            planner's structure selection exactly: an indexed table
+            (phash probing on) answers through the perfect hash ALONE
+            — consulting its bloom too would double the per-pair work
+            — and each kill switch disables ONLY its own structure
+            (a bloom_probe=False escape hatch must not keep pruning
+            through a suspect filter just because phash hashing ran)."""
+            nonlocal key_hash
+            use_phash = phash_on and table.phash is not None
+            use_bloom = bloom_on and not use_phash \
+                and table.bloom is not None
+            if (use_phash or use_bloom) and key_hash is None:
+                key_hash = crc64(key)
+            if use_bloom and not table.may_contain(key, key_hash):
+                return None  # definitively absent from this table
+            return table.get(key, key_hash=key_hash
+                             if use_phash else None)
+
         for table in self.l0:
             fk = table.first_key
             if fk is None or key < fk or key > table.last_key:
                 continue
-            if table.bloom is not None:
-                if key_hash is ...:
-                    key_hash = (crc64(key) if bloom_probe_enabled()
-                                else None)
-                if key_hash is not None \
-                        and not table.may_contain(key, key_hash):
-                    continue
-            hit = table.get(key)
+            hit = lookup(table)
             if hit is not None:
                 value, ets = hit
                 return None if value is None else (value, ets)
         run = self._run_for(key)
         if run is not None:
-            if key_hash is not ... and key_hash is not None \
-                    and not run.may_contain(key, key_hash):
-                return None
-            hit = run.get(key)
+            hit = lookup(run)
             if hit is not None:
                 value, ets = hit
                 return None if value is None else (value, ets)
@@ -624,9 +642,13 @@ class LSMStore:
 
         # writer-independent state the TRANSFORM latches once, so the
         # same decisions compute on any thread: every writer this
-        # rewrite rolls latches the identical flag values at creation
+        # rewrite rolls latches the identical flag values at creation.
+        # `sidecar_now` (bloom OR phash) decides whether the subset
+        # kernel must emit per-row hashes — either sidecar needs them
         codec_now = block_codec()
-        bloom_now = bloom_build_bits() > 0
+        from pegasus_tpu.storage.phash import phash_build_enabled
+
+        sidecar_now = bloom_build_bits() > 0 or phash_build_enabled()
 
         def transform(item):
             """Stateless per-block transform -> (kind, payload). The
@@ -668,7 +690,7 @@ class LSMStore:
                         blk.raw, blk.raw_heap_len, blk.key_width,
                         keep, new_ets if ets_changed else None,
                         ets_changed and patch_headers,
-                        want_hashes=bloom_now)
+                        want_hashes=sidecar_now)
                     if res is not None:
                         return "raw", (res, blk.key_width)
                 # native kernel unavailable (or codec flipped off

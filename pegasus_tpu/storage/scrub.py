@@ -8,9 +8,12 @@ without a scrub its flipped bit survives until the replica is promoted
 and starts returning garbage. The scrubber walks every hosted store's
 runs re-reading raw block bytes against their index CRCs
 (`SSTable.verify_block` — no decode, no block-cache pollution) plus a
-structural pass (fence ordering, bloom-answers-resident-keys) per
-table, a bounded number of blocks per tick so a multi-GB store never
-monopolizes the dispatcher.
+structural pass (fence ordering, bloom-answers-resident-keys, and
+phash-locates-resident-keys: every block's first key must map to
+exactly (that block, slot 0) through the perfect-hash index — a
+corrupt or stale index would turn into silent NotFound under probe
+pruning) per table, a bounded number of blocks per tick so a multi-GB
+store never monopolizes the dispatcher.
 
 Compaction awareness: a scrub position is keyed to the store's
 `(store_uid, generation)`; any publish (flush / compaction / ingest /

@@ -39,7 +39,7 @@ import numpy as np
 
 from pegasus_tpu.storage.vfs import fsync_dir, fsync_file, open_data_file
 
-from pegasus_tpu.base.crc import crc32, crc64_batch, crc64_rows
+from pegasus_tpu.base.crc import crc32, crc64, crc64_batch, crc64_rows
 from pegasus_tpu.ops.record_block import next_bucket
 from pegasus_tpu.storage.block_codec import (
     CODEC_DCZ2,
@@ -55,6 +55,15 @@ from pegasus_tpu.storage.bloom import (
     BloomFilter,
     bloom_build_bits,
     bloom_probe_enabled,
+)
+from pegasus_tpu.storage.phash import (
+    KNOWN_PHASH_VERSIONS,
+    PHASH_BUILD_FAIL,
+    PHASH_HIT,
+    PHASH_USEFUL,
+    PHashIndex,
+    phash_build_enabled,
+    phash_probe_enabled,
 )
 from pegasus_tpu.utils.errors import StorageCorruptionError
 from pegasus_tpu.utils.flags import FLAGS, define_flag
@@ -252,11 +261,18 @@ class SSTableWriter:
         self._io_q = None
         self._io_thread = None
         self._io_err: List[BaseException] = []
-        # full-key crc64 per block, accumulated for the bloom filter
-        # built at finish(); bits-per-key is latched HERE so a mutable
-        # flag flip mid-write cannot tear one table's filter
+        # SIDECAR structures (bloom filter + perfect-hash index) both
+        # consume the same full-key crc64 hash columns, accumulated
+        # per block by ONE shared helper (_sidecar_note) at every add
+        # path — flush, merge-compact, bulk-compact and ingest all
+        # route through these four adds, so the accumulation cannot
+        # drift across writer-finish sites. Both build knobs are
+        # latched HERE so a mutable flag flip mid-write cannot tear
+        # one table's sidecars
         self._bloom_bits_per_key = bloom_build_bits()
         self.bloom_enabled = self._bloom_bits_per_key > 0
+        self.phash_enabled = phash_build_enabled()
+        self.sidecar_hashes = self.bloom_enabled or self.phash_enabled
         # block-checksum latch, same reasoning: one table is either
         # fully checksummed or fully legacy, never mixed
         self._block_crc = block_crc_enabled()
@@ -305,6 +321,19 @@ class SSTableWriter:
             if self._io_err:
                 raise self._io_err[0]
 
+    def _sidecar_note(self, keys: np.ndarray, key_len: np.ndarray,
+                      hashes: Optional[np.ndarray] = None) -> None:
+        """Record one block's full-key crc64 column for the sidecar
+        structures built at finish() (bloom + phash share the ONE
+        vectorized hash pass). `hashes` lets callers that already
+        derived the column (the native subset kernel) skip the
+        crc64_rows pass. The per-block arrays stay segmented — their
+        boundaries ARE the (block, slot) numbering the phash maps to."""
+        if not self.sidecar_hashes:
+            return
+        self._key_hashes.append(hashes if hashes is not None
+                                else crc64_rows(keys, key_len))
+
     def add(self, key: bytes, value: bytes, expire_ts: int = 0,
             tombstone: bool = False) -> None:
         if self._last_key is not None and key <= self._last_key:
@@ -348,10 +377,9 @@ class SSTableWriter:
         region_len = np.where(hkl > 0, hkl, key_len.astype(np.int64) - 2)
         hash_lo = (crc64_batch(keys, region_len, start=2)
                    & np.uint64(0xFFFFFFFF)).astype(np.uint32)
-        if self._bloom_bits_per_key > 0:
-            # full-key hash column for the table bloom filter: one
-            # vectorized pass per block, folded into the filter at finish
-            self._key_hashes.append(crc64_rows(keys, key_len))
+        # full-key hash column for the sidecars (bloom + phash): one
+        # vectorized pass per block, folded into both at finish
+        self._sidecar_note(keys, key_len)
 
         offset = self._offset
         # ONE buffer per block: a single kernel copy + syscall instead of
@@ -390,8 +418,7 @@ class SSTableWriter:
         if self._last_key is not None and first_key <= self._last_key:
             raise ValueError("blocks must be added in key order")
         width = int(keys.shape[1])
-        if self._bloom_bits_per_key > 0:
-            self._key_hashes.append(crc64_rows(keys, key_len))
+        self._sidecar_note(keys, key_len)
         offset = self._offset
         if self.codec == CODEC_NONE:
             buf = b"".join((
@@ -449,7 +476,7 @@ class SSTableWriter:
             raise ValueError("blocks must be added in key order")
         buf = enc.raw if isinstance(enc.raw, bytes) else bytes(enc.raw)
         hashes = (crc64_rows(enc.key_matrix(), enc.key_len)
-                  if self._bloom_bits_per_key > 0 else None)
+                  if self.sidecar_hashes else None)
         self.add_block_encoded_raw(buf, n, enc.key_width,
                                    enc.raw_heap_len, first_key,
                                    last_key, hashes)
@@ -478,10 +505,10 @@ class SSTableWriter:
         self._flush_block()
         if self._last_key is not None and first_key <= self._last_key:
             raise ValueError("blocks must be added in key order")
-        if self._bloom_bits_per_key > 0:
+        if self.sidecar_hashes:
             if key_hashes is None:
-                raise ValueError("bloom build needs key hashes")
-            self._key_hashes.append(key_hashes)
+                raise ValueError("sidecar build needs key hashes")
+            self._sidecar_note(None, None, hashes=key_hashes)
         offset = self._offset
         self._write(buf)
         self._blocks.append(BlockMeta(
@@ -519,17 +546,7 @@ class SSTableWriter:
                 "raw_bytes": self._codec_raw_bytes,
                 "stored_bytes": self._codec_stored_bytes,
             }
-        if self._key_hashes:
-            # bloom section sits between the data blocks and the index;
-            # the index names its offset/geometry, so pre-filter readers
-            # (and pre-filter FILES under new readers) stay compatible
-            bf = BloomFilter.build(np.concatenate(self._key_hashes),
-                                   self._bloom_bits_per_key)
-            bloom_off = self._f.tell()
-            blob = bf.to_bytes()
-            self._f.write(blob)
-            index["bloom"] = {"off": bloom_off, "size": len(blob),
-                              "m": bf.m, "k": bf.k}
+        self._build_sidecars(index)
         blob = json.dumps(index).encode()
         index_offset = self._f.tell()
         self._f.write(blob)
@@ -542,6 +559,52 @@ class SSTableWriter:
         # WAL, or a power failure can lose the SST while the WAL is already
         # empty — fsync the containing directory
         fsync_dir(os.path.dirname(self.path))
+
+    def _build_sidecars(self, index: dict) -> None:
+        """Build + persist the run's sidecar structures from the
+        accumulated per-block hash columns — the ONE place every
+        writer-finish site (flush / merge-compact / bulk-compact /
+        ingest) derives them, so a new sidecar cannot drift across
+        paths. Sections sit between the data blocks and the index; the
+        index names offsets/geometry, so sidecar-less files (and
+        sidecar-less READERS of the bloom) stay compatible. The phash
+        entry carries a format VERSION: readers refuse versions they
+        do not know at open (never misparse), exactly like the block
+        codec key."""
+        if not self._key_hashes:
+            return
+        if self.bloom_enabled:
+            bf = BloomFilter.build(np.concatenate(self._key_hashes),
+                                   self._bloom_bits_per_key)
+            bloom_off = self._f.tell()
+            blob = bf.to_bytes()
+            self._f.write(blob)
+            index["bloom"] = {"off": bloom_off, "size": len(blob),
+                              "m": bf.m, "k": bf.k}
+        if self.phash_enabled:
+            # construction can fail (adversarial keys, hash
+            # collisions, oversized geometry, the forced fail point):
+            # a perf event — the run serves via bloom + bisect
+            ph = PHashIndex.build(
+                np.concatenate(self._key_hashes)
+                if len(self._key_hashes) > 1 else self._key_hashes[0],
+                [b.count for b in self._blocks])
+            if ph is None:
+                PHASH_BUILD_FAIL.increment()
+            else:
+                # pad the blob start to a 4-byte boundary: the mmap
+                # read path hands the native probe raw u32/u16
+                # pointers into the file, and the mmap base is
+                # page-aligned, so an aligned file offset IS an
+                # aligned address (misaligned loads are UB)
+                pad = (-self._f.tell()) % 4
+                if pad:
+                    self._f.write(b"\x00" * pad)
+                ph_off = self._f.tell()
+                blob = ph.to_bytes()
+                self._f.write(blob)
+                index["phash"] = {"off": ph_off, "size": len(blob),
+                                  **ph.meta()}
 
     def abandon(self) -> None:
         try:
@@ -634,6 +697,26 @@ class SSTable:
                 self._f.seek(bl["off"])
                 raw = self._f.read(bl["size"])
             self.bloom = BloomFilter.from_bytes(raw, bl["m"], bl["k"])
+        # perfect-hash (block, slot) index: pre-index files miss the
+        # entry and keep serving via bloom + bisect; an index VERSION
+        # this build does not know is refused at open (a misparse
+        # would locate the wrong rows), mirroring the codec rule
+        self.phash: Optional[PHashIndex] = None
+        ph = index.get("phash")
+        if ph:
+            if ph.get("version") not in KNOWN_PHASH_VERSIONS:
+                raise StorageCorruptionError(
+                    path, f"unsupported phash index version "
+                          f"{ph.get('version')!r} (known: "
+                          f"{', '.join(map(str, KNOWN_PHASH_VERSIONS))})")
+            if self._mv is not None:
+                raw = self._mv[ph["off"]:ph["off"] + ph["size"]]
+            else:
+                self._f.seek(ph["off"])
+                raw = self._f.read(ph["size"])
+            # torn/mismatched blob: from_bytes returns None and the
+            # file degrades to the bisect path (like a torn bloom)
+            self.phash = PHashIndex.from_bytes(raw, ph)
         from collections import OrderedDict as _OD
 
         import threading
@@ -830,11 +913,15 @@ class SSTable:
 
     def verify_index_consistency(self) -> None:
         """Scrub's structural pass: block fences must be internally
-        ordered and monotonic across the file, and (when a filter
-        exists) every block's first key must answer 'maybe' from the
-        bloom filter — a filter that denies a present key would turn
+        ordered and monotonic across the file; (when a filter exists)
+        every block's first key must answer 'maybe' from the bloom
+        filter; and (when a perfect-hash index exists) every block's
+        first key must locate to exactly (that block, slot 0) — a
+        sidecar that denies or mislocates a present key would turn
         into silent NotFound under probe pruning, which is data loss
-        without a single flipped data byte."""
+        without a single flipped data byte. A corrupt/stale phash is
+        therefore caught by the same quarantine/re-learn loop the
+        block CRCs feed."""
         prev_last: Optional[bytes] = None
         for i, bm in enumerate(self.blocks):
             if bm.first_key > bm.last_key:
@@ -850,9 +937,54 @@ class SSTable:
                     self.path,
                     f"scrub: bloom filter denies resident key "
                     f"(block {i} first key)")
+            if self.phash is not None:
+                loc = self.phash.lookup_hash(crc64(bm.first_key))
+                if loc < 0 or self.phash.unpack(loc) != (i, 0):
+                    raise StorageCorruptionError(
+                        self.path,
+                        f"scrub: phash index denies or mislocates "
+                        f"resident key (block {i} first key)")
 
-    def get(self, key: bytes) -> Optional[Tuple[Optional[bytes], int]]:
-        """Returns (value|None-for-tombstone, expire_ts), or None if absent."""
+    def index_memory(self) -> dict:
+        """Resident sidecar bytes: {"bloom": ..., "phash": ...} — the
+        per-structure split behind the node's index-memory signal."""
+        return {
+            "bloom": (self.bloom.bits.nbytes
+                      if self.bloom is not None else 0),
+            "phash": (self.phash.mem_bytes()
+                      if self.phash is not None else 0),
+        }
+
+    def get(self, key: bytes, key_hash: Optional[int] = None
+            ) -> Optional[Tuple[Optional[bytes], int]]:
+        """Returns (value|None-for-tombstone, expire_ts), or None if absent.
+
+        `key_hash` (crc64 of the full key, the same hash every sidecar
+        shares) lets callers that already hashed skip the crc. Indexed
+        files answer via the perfect-hash index: a miss costs one slot
+        gather and ZERO block touches; a hit reads its (block, slot)
+        row directly — no fence bisect, no in-block bisect — and one
+        row compare rejects the rare fingerprint collision."""
+        ph = self.phash
+        if ph is not None and phash_probe_enabled():
+            h = key_hash if key_hash is not None else crc64(key)
+            loc = ph.lookup_hash(h)
+            if loc < 0:
+                PHASH_USEFUL.increment()
+                return None
+            bi, slot = ph.unpack(loc)
+            if bi < len(self.blocks) and slot < self.blocks[bi].count:
+                blk = self.read_block(bi)
+                if blk.key_at(slot) == key:
+                    PHASH_HIT.increment()
+                    if blk.is_tombstone(slot):
+                        return (None, 0)
+                    return (blk.value_at(slot),
+                            int(blk.expire_ts[slot]))
+                PHASH_USEFUL.increment()
+                return None  # fp collision: definitively absent
+            # out-of-range loc (corrupt index): serve via the bisect
+            # below; the scrub structural pass flags the file
         idx = self._block_for_key(key)
         if idx is None:
             return None

@@ -84,9 +84,17 @@ class InfoCollector:
                     "partitions": 0, "read_cu": 0, "write_cu": 0,
                     "abnormal_reads": 0,
                     "read_p50_ms": 0.0, "read_p99_ms": 0.0,
-                    "write_p50_ms": 0.0, "write_p99_ms": 0.0})
+                    "write_p50_ms": 0.0, "write_p99_ms": 0.0,
+                    "index_bloom_bytes": 0, "index_phash_bytes": 0})
                 agg["partitions"] += 1
                 metrics = entity.get("metrics", {})
+                # resident index memory (round 15): per-partition
+                # bloom-vs-phash gauge split summed per table — the
+                # thousands-of-partitions elasticity scenario's
+                # memory signal
+                for key in ("index_bloom_bytes", "index_phash_bytes"):
+                    agg[key] += int(
+                        metrics.get(key, {}).get("value", 0))
                 agg["read_cu"] += int(
                     metrics.get("recent_read_cu", {}).get("value", 0))
                 agg["write_cu"] += int(
@@ -109,6 +117,7 @@ class InfoCollector:
                                        snap.get("p99", 0.0))
         node_traces = self.collect_traces()
         dup_rows = self.collect_dups()
+        storage_rows = self.collect_storage()
         if per_table:
             if self._stat_client is None:
                 self._stat_client = self.client_factory(STAT_TABLE)
@@ -122,7 +131,31 @@ class InfoCollector:
             if dup_rows:
                 self._stat_client.set(b"_dups", ts,
                                       json.dumps(dup_rows).encode())
+            if storage_rows:
+                self._stat_client.set(b"_storage", ts,
+                                      json.dumps(storage_rows).encode())
         return per_table
+
+    def collect_storage(self) -> Dict[str, dict]:
+        """Per-node point-read index health off the `storage` metric
+        entity: perfect-hash usefulness (probes that skipped every
+        block touch), located hits, and build failures (runs stamped
+        "no phash" — a perf event worth alerting on if it trends), next
+        to the bloom twin — one `_storage` stat row per round."""
+        wanted = ("phash_useful_count", "phash_hit_count",
+                  "phash_build_fail_count", "bloom_useful_count")
+        out: Dict[str, dict] = {}
+        for node in self.nodes:
+            snapshot = self._command(node, "metrics", ["storage"])
+            if not snapshot:
+                continue
+            for entity in snapshot:
+                metrics = entity.get("metrics", {})
+                row = {k: int(metrics.get(k, {}).get("value", 0))
+                       for k in wanted if k in metrics}
+                if row:
+                    out[node] = row
+        return out
 
     def collect_dups(self) -> Dict[str, dict]:
         """Per-table duplication lag rows off every node's `dup.stats`

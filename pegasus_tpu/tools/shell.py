@@ -318,9 +318,10 @@ def main(argv=None) -> int:
     p.add_argument("node", nargs="?", default=None)
     p = sub.add_parser("storage_stats")
     p.add_argument("table",
-                   help="dump cache/bloom/codec counters per partition "
-                        "(block codec, compression ratio, decode and "
-                        "encoded-probe counts)")
+                   help="dump cache/bloom/phash/codec counters per "
+                        "partition (block codec, compression ratio, "
+                        "decode and encoded-probe counts, resident "
+                        "index memory bloom-vs-phash split)")
     p = sub.add_parser("disk_health")
     p.add_argument("node", nargs="?", default=None,
                    help="one node, or all replica nodes when omitted")
@@ -1245,11 +1246,24 @@ def _dispatch(args, box, out) -> int:
                     1 for x in tables if x.bloom is not None),
                 "bloom_bits": sum(
                     x.bloom.m for x in tables if x.bloom is not None),
+                # resident index memory, bloom-vs-phash split (round
+                # 15): the perfect-hash index's bytes against the
+                # filter bytes it retires at probe time, plus how many
+                # runs actually carry one (a build-failure or pre-index
+                # file shows partial coverage here)
+                "runs_with_phash": sum(
+                    1 for x in tables if x.phash is not None),
+                "index_bloom_bytes": sum(
+                    x.index_memory()["bloom"] for x in tables),
+                "index_phash_bytes": sum(
+                    x.index_memory()["phash"] for x in tables),
                 "cached_blocks": sum(len(x._cache) for x in tables),
                 "cached_block_bytes": sum(x._cache_bytes
                                           for x in tables),
                 "bloom_useful_count": snap.get(
                     "bloom_useful_count", {}).get("value", 0),
+                "phash_useful_count": snap.get(
+                    "phash_useful_count", {}).get("value", 0),
                 "row_cache_hit": snap.get(
                     "row_cache_hit", {}).get("value", 0),
                 "row_cache_miss": snap.get(

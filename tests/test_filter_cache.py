@@ -176,9 +176,13 @@ def test_batched_identity_filtered_vs_unfiltered(server, no_row_cache):
     for i in range(0, 300, 3):  # past 200: misses
         ops.append(("get", generate_key(b"hk%04d" % i, b"s"), None))
         ops.append(("get", generate_key(b"hk%04d" % i, b"x1"), None))
-    useful0 = server._bloom_useful.value()
+    # indexed runs answer through the perfect-hash index (which prunes
+    # AND locates); filter-only runs keep the bloom — either way the
+    # sidecar layer must have pruned probes
+    useful0 = server._bloom_useful.value() + server._phash_useful.value()
     on = server.on_point_read_batch(list(ops))
-    assert server._bloom_useful.value() > useful0  # filters did work
+    assert server._bloom_useful.value() \
+        + server._phash_useful.value() > useful0  # sidecars did work
     FLAGS.set("pegasus.server", "bloom_probe", False)
     try:
         server._point_cache = None  # drop locations learned with filters
@@ -199,7 +203,7 @@ def test_l0_fence_short_circuit(tmp_path, no_row_cache):
     store.flush()
     calls = []
     orig = store.l0[0].get
-    store.l0[0].get = lambda k: calls.append(k) or orig(k)
+    store.l0[0].get = lambda k, **kw: calls.append(k) or orig(k, **kw)
     assert store.get(b"zz0001") is None  # above the fence
     assert store.get(b"a") is None       # below the fence
     assert not calls

@@ -1,0 +1,495 @@
+"""Perfect-hash two-level SST index tests (storage/phash.py).
+
+The load-bearing regressions: the index may never produce a WRONG
+location (a fingerprint collision must read as "absent", never as
+another row's value), probing through it must stay byte-identical to
+the bisect path across every block codec and every store mix, a miss
+on an indexed run must touch ZERO blocks, construction failure must
+degrade (deterministically) to bloom+bisect rather than error, and a
+corrupt or version-unknown index must be refused/flagged loudly.
+"""
+
+import json
+import os
+import struct
+
+import numpy as np
+import pytest
+
+from pegasus_tpu.base.crc import crc32, crc64
+from pegasus_tpu.base.key_schema import generate_key
+from pegasus_tpu.server import PartitionServer
+from pegasus_tpu.storage.lsm import LSMStore
+from pegasus_tpu.storage.phash import (
+    ABSENT,
+    PHASH_BUILD_FAIL,
+    PHASH_USEFUL,
+    PHashIndex,
+    PHashMultiProbe,
+    _build_once_py,
+    _geometry,
+)
+from pegasus_tpu.storage.sstable import (
+    _BLOCK_CACHE_HIT,
+    _BLOCK_CACHE_MISS,
+    FOOTER,
+    SSTable,
+    SSTableWriter,
+)
+from pegasus_tpu.utils.errors import StorageCorruptionError, StorageStatus
+from pegasus_tpu.utils.flags import FLAGS
+
+OK = int(StorageStatus.OK)
+NOT_FOUND = int(StorageStatus.NOT_FOUND)
+
+
+@pytest.fixture
+def no_row_cache():
+    old = FLAGS.get("pegasus.server", "row_cache_bytes")
+    FLAGS.set("pegasus.server", "row_cache_bytes", 0)
+    yield
+    FLAGS.set("pegasus.server", "row_cache_bytes", old)
+
+
+@pytest.fixture
+def codec_flag():
+    old = FLAGS.get("pegasus.storage", "block_codec")
+    yield
+    FLAGS.set("pegasus.storage", "block_codec", old)
+
+
+def _write_sst(path, keys_vals, block_capacity=64):
+    w = SSTableWriter(str(path), block_capacity=block_capacity)
+    for k, v, ets, tomb in keys_vals:
+        w.add(k, v, ets, tombstone=tomb)
+    w.finish()
+    return SSTable(str(path))
+
+
+def _key_set(n, with_odd_rows=True):
+    """Sorted key set spanning the interesting shapes: normal
+    hashkey+sortkey rows, empty-hashkey rows (dcz2 hash overflow), and
+    malformed short/bad-header rows (codec sentinel rows) — slot
+    numbering must survive all of them."""
+    keys = set()
+    for i in range(n):
+        keys.add(b"\x00\x04hk%02d" % (i % 23) + b"s%06d" % i)
+    if with_odd_rows:
+        keys.add(b"\x00")                       # malformed: 1 byte
+        keys.add(b"\x00\x00nosortkeyhash")      # empty hashkey
+        keys.add(b"\x7f\xffclaims-huge-hashkey")  # header > body
+    out = sorted(keys)
+    return [(k, b"v-%d" % i, 0, i % 89 == 0) for i, k in enumerate(out)]
+
+
+# ---- index core -------------------------------------------------------
+
+
+def test_phash_build_probe_roundtrip():
+    """Every present hash probes to its EXACT packed loc (scalar and
+    vectorized agree); absent hashes answer ABSENT at ~the 10-bit
+    fingerprint rate — and an fp collision can only ever point at a
+    real row (the caller's key-verify rejects it)."""
+    rng = np.random.default_rng(11)
+    n = 30_000
+    hashes = rng.integers(1, 2**63, size=n, dtype=np.uint64)
+    counts = [1024] * (n // 1024) + [n % 1024]
+    ix = PHashIndex.build(hashes.astype(np.uint64), counts)
+    assert ix is not None
+    # ~5.2 resident bytes/key at the default geometry
+    assert ix.mem_bytes() / n < 6.0
+    out = ix.probe_hashes(hashes.astype(np.uint64))
+    starts = np.zeros(len(counts) + 1, dtype=np.int64)
+    np.cumsum(counts, out=starts[1:])
+    bids = np.repeat(np.arange(len(counts)), counts)
+    slots = np.arange(n) - np.repeat(starts[:-1], counts)
+    locs = ((bids << ix.slot_bits) | slots).astype(np.uint32)
+    assert (out == locs).all()
+    for i in range(0, n, 2999):
+        assert ix.lookup_hash(int(hashes[i])) == int(locs[i])
+    absent = rng.integers(1, 2**63, size=10_000, dtype=np.uint64)
+    aout = ix.probe_hashes(absent.astype(np.uint64))
+    assert float((aout != ABSENT).mean()) < 0.01
+    for i in range(0, 10_000, 997):
+        assert ix.lookup_hash(int(absent[i])) == \
+            (int(aout[i]) if aout[i] != ABSENT else -1)
+
+
+def test_phash_native_and_python_builds_identical():
+    """The Python CHD fallback and the native kernel are the same
+    on-disk format: identical slots/disp for identical inputs (the
+    mixer/geometry/bucket-order are format, not implementation)."""
+    from pegasus_tpu import native
+
+    if native.phash_build_fn() is None:
+        pytest.skip("native library unavailable")
+    rng = np.random.default_rng(5)
+    n = 5000
+    hashes = rng.integers(1, 2**63, size=n, dtype=np.uint64).astype(
+        np.uint64)
+    ix = PHashIndex.build(hashes, [512] * 9 + [n - 9 * 512])
+    assert ix is not None
+    ts, nb = _geometry(n)
+    bids = np.repeat(np.arange(10, dtype=np.int64),
+                     [512] * 9 + [n - 9 * 512])
+    starts = np.zeros(11, dtype=np.int64)
+    np.cumsum([512] * 9 + [n - 9 * 512], out=starts[1:])
+    slots = np.arange(n, dtype=np.int64) - np.repeat(starts[:-1],
+                                                     [512] * 9
+                                                     + [n - 9 * 512])
+    locs = ((bids << ix.slot_bits) | slots).astype(np.uint32)
+    res = _build_once_py(hashes, locs, ix.seed, ts, nb)
+    assert res is not None
+    slots_py, disp_py = res
+    assert (slots_py == ix.slots).all()
+    assert (disp_py == ix.disp).all()
+
+
+def test_multi_probe_matches_scalar_and_fallback():
+    rng = np.random.default_rng(3)
+    ixs = []
+    for t in range(4):
+        hs = rng.integers(1, 2**63, size=700 + 131 * t,
+                          dtype=np.uint64).astype(np.uint64)
+        ix = PHashIndex.build(hs, [256] * (len(hs) // 256)
+                              + [len(hs) % 256])
+        assert ix is not None
+        ixs.append((ix, hs))
+    mp = PHashMultiProbe([ix for ix, _ in ixs])
+    probes = np.concatenate(
+        [hs[:16] for _ix, hs in ixs]
+        + [rng.integers(1, 2**63, size=64,
+                        dtype=np.uint64).astype(np.uint64)])
+    mat, mask = mp.probe(probes)
+    mp2 = PHashMultiProbe([ix for ix, _ in ixs])
+    mp2._native = None
+    mat2, mask2 = mp2.probe(probes)
+    assert bytes(mat2) == bytes(mat) and mask2 == mask
+    for i, h in enumerate(probes):
+        for t, (ix, _hs) in enumerate(ixs):
+            loc = ix.lookup_hash(int(h))
+            cell = i * 4 + t
+            assert bool(mask[cell]) == (loc >= 0)
+            assert int(mat[cell]) == (loc if loc >= 0 else ABSENT)
+
+
+# ---- SST integration: byte-identity across codecs ---------------------
+
+
+@pytest.mark.parametrize("codec", ["none", "dcz", "dcz2"])
+def test_probe_identical_to_bisect_across_codecs(tmp_path, codec,
+                                                 codec_flag):
+    """Batched probe == scalar probe == bisect, byte-identical, over
+    randomized keys spanning all three block codecs — including
+    malformed and empty-hashkey rows (dcz2's hash overflow slots), so
+    (block, slot) provably means the same row under every layout."""
+    FLAGS.set("pegasus.storage", "block_codec", codec)
+    recs = _key_set(1500)
+    t = _write_sst(tmp_path / f"{codec}.sst", recs, block_capacity=128)
+    assert t.phash is not None and t.bloom is not None
+    t.verify_index_consistency()
+    present = [k for k, *_ in recs]
+    absent = [b"\x00\x04zz%02d" % (i % 9) + b"a%06d" % i
+              for i in range(400)]
+    sample = present[::7] + absent
+    hashes = np.array([crc64(k) for k in sample], dtype=np.uint64)
+    # batched locate == scalar locate
+    mp = PHashMultiProbe([t.phash])
+    mat, mask = mp.probe(hashes)
+    for i, k in enumerate(sample):
+        loc = t.phash.lookup_hash(int(hashes[i]))
+        assert bool(mask[i]) == (loc >= 0)
+        assert int(mat[i]) == (loc if loc >= 0 else ABSENT)
+    # phash get == bisect get, byte for byte
+    for k in sample:
+        FLAGS.set("pegasus.server", "phash_probe", True)
+        a = t.get(k)
+        FLAGS.set("pegasus.server", "phash_probe", False)
+        b = t.get(k)
+        FLAGS.set("pegasus.server", "phash_probe", True)
+        assert a == b, (codec, k)
+    # every present key locates to a row holding exactly that key
+    for k in present[::13]:
+        loc = t.phash.lookup_hash(crc64(k))
+        assert loc >= 0
+        bi, slot = t.phash.unpack(loc)
+        assert t.read_block(bi).key_at(slot) == k
+    t.close()
+
+
+def test_slot_stability_through_compaction_paths(tmp_path, codec_flag,
+                                                 no_row_cache):
+    """The verbatim-copy and native-subset compaction paths must not
+    invalidate stamped indexes: after a bulk rewrite that drops rows
+    from a dcz2 store, every output run's fresh phash locates every
+    survivor (scrub-verified), and gets stay identical to bisect."""
+    FLAGS.set("pegasus.storage", "block_codec", "dcz2")
+    store = LSMStore(str(tmp_path / "s"), block_capacity=64,
+                     l1_run_capacity=400)
+    vals = {}
+    for i in range(1200):
+        k = b"\x00\x04hk%02d" % (i % 17) + b"s%05d" % i
+        store.put(k, b"val-%06d" % i, 0)
+        vals[k] = b"val-%06d" % i
+    store.flush()
+    store.compact()
+    assert store.bulk_compact_eligible()
+    # drop every 5th row through the encoded-domain subset kernel
+    per_block = []
+    drop_keys = set()
+    for run, idx, _bm in store.bulk_compact_entries():
+        enc = run.read_block_encoded(idx)
+        blk = enc if enc is not None else run.read_block(idx)
+        n = blk.count
+        drop = np.zeros(n, dtype=bool)
+        drop[::5] = True
+        for j in np.flatnonzero(drop):
+            drop_keys.add(blk.key_at(int(j)))
+        per_block.append((run, idx, blk, drop,
+                          np.asarray(blk.expire_ts)))
+    store.bulk_compact_rewrite(per_block, meta=None,
+                               ttl_may_change=False)
+    for run in store.l1_runs:
+        assert run.phash is not None
+        run.verify_index_consistency()
+    for k, v in vals.items():
+        expect = None if k in drop_keys else (v, 0)
+        FLAGS.set("pegasus.server", "phash_probe", True)
+        a = store.get(k)
+        FLAGS.set("pegasus.server", "phash_probe", False)
+        b = store.get(k)
+        FLAGS.set("pegasus.server", "phash_probe", True)
+        assert a == b == expect, (k, a, b, expect)
+    store.close()
+
+
+# ---- mixed stores, fallback, format versioning ------------------------
+
+
+def test_mixed_store_serving(tmp_path, no_row_cache):
+    """One LSM mixing a pre-index file (phash build off), a bloom-only
+    run (forced build failure), and indexed runs serves byte-identical
+    results through the batched plan path and the solo path."""
+    server = PartitionServer(str(tmp_path / "p0"))
+    vals = {}
+
+    def put_group(tag, n):
+        for i in range(n):
+            hk, sk = b"%s%02d" % (tag, i % 5), b"s%04d" % i
+            server.on_put(generate_key(hk, sk), b"%s-%d" % (tag, i))
+            vals[(hk, sk)] = b"%s-%d" % (tag, i)
+
+    put_group(b"pre", 300)
+    FLAGS.set("pegasus.server", "phash_index", False)
+    server.flush()          # pre-index file: no phash entry at all
+    FLAGS.set("pegasus.server", "phash_index", True)
+    put_group(b"blm", 300)
+    FLAGS.set("pegasus.server", "phash_force_fail", True)
+    fails0 = PHASH_BUILD_FAIL.value()
+    server.flush()          # bloom-only run (deterministic build fail)
+    FLAGS.set("pegasus.server", "phash_force_fail", False)
+    assert PHASH_BUILD_FAIL.value() == fails0 + 1
+    put_group(b"idx", 300)
+    server.flush()          # indexed run
+    lsm = server.engine.lsm
+    kinds = {(t.bloom is not None, t.phash is not None)
+             for t in lsm.l0}
+    assert kinds == {(True, False), (True, True)}
+    assert sum(1 for t in lsm.l0 if t.phash is None) == 2
+
+    keys = list(vals)
+    absent = [(b"pre%02d" % (i % 5), b"s%04dx" % i) for i in range(64)]
+    ops = [("get", generate_key(hk, sk), 0) for hk, sk in keys + absent]
+    FLAGS.set("pegasus.server", "phash_probe", True)
+    server._point_cache = None
+    on = server.on_point_read_batch(ops)
+    FLAGS.set("pegasus.server", "phash_probe", False)
+    server._point_cache = None
+    off = server.on_point_read_batch(ops)
+    FLAGS.set("pegasus.server", "phash_probe", True)
+    assert on == off
+    for (hk, sk), r in zip(keys, on):
+        assert r == (OK, vals[(hk, sk)])
+    for r in on[len(keys):]:
+        assert r == (NOT_FOUND, b"")
+    # solo path agrees too (engine values carry the encoded header)
+    for hk, sk in keys[::31]:
+        hit = lsm.get(generate_key(hk, sk))
+        assert hit is not None and hit[0].endswith(vals[(hk, sk)])
+    server.close()
+
+
+def test_build_failure_fallback_deterministic(tmp_path):
+    """Under the seeded fail point every build fails the same way: the
+    run is stamped "no phash" (a counter tick, never an exception),
+    keeps its bloom, and serves correctly; the next finish builds."""
+    FLAGS.set("pegasus.server", "phash_force_fail", True)
+    try:
+        fails0 = PHASH_BUILD_FAIL.value()
+        t1 = _write_sst(tmp_path / "f1.sst", _key_set(200, False))
+        t2 = _write_sst(tmp_path / "f2.sst", _key_set(200, False))
+        assert PHASH_BUILD_FAIL.value() == fails0 + 2
+        assert t1.phash is None and t2.phash is None
+        assert t1.bloom is not None
+        k = _key_set(200, False)[3][0]
+        assert t1.get(k) == t2.get(k) != None  # noqa: E711
+        t1.close(), t2.close()
+    finally:
+        FLAGS.set("pegasus.server", "phash_force_fail", False)
+    t3 = _write_sst(tmp_path / "f3.sst", _key_set(200, False))
+    assert t3.phash is not None
+    t3.close()
+
+
+def test_unknown_phash_version_refused_at_open(tmp_path):
+    """A file stamping a phash version this build does not know is
+    refused at open (never misparsed), exactly like an unknown codec;
+    pre-index files (no entry) keep serving."""
+    t = _write_sst(tmp_path / "v.sst", _key_set(100, False))
+    t.close()
+    path = str(tmp_path / "v.sst")
+    with open(path, "rb") as f:
+        raw = f.read()
+    index_offset, index_size, _crc, magic = FOOTER.unpack(
+        raw[-FOOTER.size:])
+    index = json.loads(raw[index_offset:index_offset + index_size])
+    assert index["phash"]["version"] == 1
+    index["phash"]["version"] = 99
+    blob = json.dumps(index).encode()
+    with open(path, "wb") as f:
+        f.write(raw[:index_offset] + blob
+                + FOOTER.pack(index_offset, len(blob), crc32(blob),
+                              magic))
+    with pytest.raises(StorageCorruptionError, match="phash"):
+        SSTable(path)
+
+
+def test_scrub_catches_phash_corruption(tmp_path):
+    """Planted corruption in the index blob: the structural pass
+    (phash-locates-resident-keys) must flag the file — feeding the
+    quarantine/re-learn loop — because a silently wrong index is
+    NotFound-shaped data loss."""
+    t = _write_sst(tmp_path / "c.sst", _key_set(400, False))
+    t.verify_index_consistency()  # clean file passes
+    t.close()
+    path = str(tmp_path / "c.sst")
+    with open(path, "rb") as f:
+        raw = f.read()
+    index_offset, index_size, _crc, _magic = FOOTER.unpack(
+        raw[-FOOTER.size:])
+    ph = json.loads(raw[index_offset:index_offset + index_size])["phash"]
+    with open(path, "r+b") as f:
+        f.seek(ph["off"])
+        f.write(b"\xab" * ph["size"])  # trash disp + slots wholesale
+    t2 = SSTable(path)
+    with pytest.raises(StorageCorruptionError, match="phash"):
+        t2.verify_index_consistency()
+    t2.close()
+
+
+# ---- the acceptance property: misses touch zero blocks ----------------
+
+
+def test_miss_on_indexed_run_reads_zero_blocks(tmp_path, no_row_cache):
+    """A miss flush against indexed runs (bloom probing OFF, so only
+    the phash answers) performs ZERO block reads — asserted on the
+    block-cache hit/miss counters, not the bench."""
+    server = PartitionServer(str(tmp_path / "p0"))
+    for i in range(2000):
+        hk, sk = b"hk%03d" % (i % 31), b"s%05d" % i
+        server.on_put(generate_key(hk, sk), b"v%d" % i)
+    server.flush()
+    for i in range(400):  # deep-ish overlay: a second indexed L0 table
+        hk, sk = b"hk%03d" % (i % 31), b"t%05d" % i
+        server.on_put(generate_key(hk, sk), b"w%d" % i)
+    server.flush()
+    assert all(t.phash is not None for t in server.engine.lsm.l0)
+    FLAGS.set("pegasus.server", "bloom_probe", False)
+    try:
+        server._point_cache = None
+        absent = [("hk%03d" % (i % 31)).encode() for i in range(256)]
+        ops = [("get", generate_key(hk, b"zz%05d" % i), 0)
+               for i, hk in enumerate(absent)]
+        h0, m0 = _BLOCK_CACHE_HIT.value(), _BLOCK_CACHE_MISS.value()
+        u0 = PHASH_USEFUL.value()
+        res = server.on_point_read_batch(ops)
+        assert all(r == (NOT_FOUND, b"") for r in res)
+        assert _BLOCK_CACHE_HIT.value() == h0
+        assert _BLOCK_CACHE_MISS.value() == m0
+        assert PHASH_USEFUL.value() > u0
+        assert server._phash_useful.value() > 0
+    finally:
+        FLAGS.set("pegasus.server", "bloom_probe", True)
+    server.close()
+
+
+def test_solo_path_structure_selection(tmp_path, no_row_cache):
+    """The solo path selects sidecars exactly like the batched planner:
+    an indexed table answers through the phash ALONE (its bloom is
+    never consulted — no double per-pair work), and bloom_probe=False
+    really kills the bloom (a suspect filter must not keep pruning
+    just because the phash hash was computed)."""
+    store = LSMStore(str(tmp_path / "s"), block_capacity=32)
+    for i in range(300):
+        store.put(b"k%05d" % i, b"v%d" % i)
+    store.flush()
+    t = store.l0[0]
+    assert t.phash is not None and t.bloom is not None
+
+    class _Boom:
+        def may_contain_hash(self, h):
+            raise AssertionError("bloom consulted")
+
+        def may_contain(self, k):
+            raise AssertionError("bloom consulted")
+
+    t.bloom = _Boom()
+    # phash on: the bloom must never be touched on an indexed table
+    assert store.get(b"k%05d" % 7) == (b"v7", 0)
+    assert store.get(b"zz") is None
+    # bloom kill switch with phash off: neither structure consulted,
+    # the get serves through the bisect
+    FLAGS.set("pegasus.server", "phash_probe", False)
+    FLAGS.set("pegasus.server", "bloom_probe", False)
+    try:
+        assert store.get(b"k%05d" % 7) == (b"v7", 0)
+        assert store.get(b"zz") is None
+    finally:
+        FLAGS.set("pegasus.server", "bloom_probe", True)
+        FLAGS.set("pegasus.server", "phash_probe", True)
+    store.close()
+
+
+# ---- writer-finish dedupe: every site builds both sidecars ------------
+
+
+def test_all_writer_finish_sites_build_sidecars(tmp_path, no_row_cache):
+    """Flush, merge-compact, and ingest all route through the shared
+    sidecar helper: every produced file carries bloom AND phash (the
+    bulk-compact site is covered by
+    test_slot_stability_through_compaction_paths)."""
+    store = LSMStore(str(tmp_path / "s"), block_capacity=32,
+                     l1_run_capacity=300)
+    for i in range(500):
+        store.put(b"k%05d" % i, b"v%d" % i)
+    store.flush()                      # site 1: flush
+    assert store.l0[0].phash is not None
+    assert store.l0[0].bloom is not None
+    store.compact()                    # site 2: merge-compact
+    assert store.l1_runs and all(
+        r.phash is not None and r.bloom is not None
+        for r in store.l1_runs)
+
+    def build(dest, meta):             # site 3: ingest
+        w = SSTableWriter(dest, meta=meta)
+        for i in range(200):
+            w.add(b"z%05d" % i, b"in%d" % i)
+        w.finish()
+
+    t = store.ingest(build)
+    assert t.phash is not None and t.bloom is not None
+    # index memory split is visible per table
+    im = t.index_memory()
+    assert im["phash"] > 0 and im["bloom"] > 0
+    assert store.get(b"z%05d" % 7) == (b"in7", 0)
+    store.close()
