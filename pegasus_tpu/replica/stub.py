@@ -166,6 +166,34 @@ class ReplicaStub:
         self.scrubber = ReplicaScrubber(
             lambda: self.replicas, self._on_scrub_corruption,
             clock=self.sim_clock)
+        # node-scoped foreground-pressure twins of the transport's
+        # process-wide "rpc"/"dispatch" counters: the stub's own gates
+        # (deadline fast-fail, injected shedding) count HERE, so sim
+        # clusters sharing one process registry still attribute
+        # pressure to the node that felt it
+        self.node_rpc_metrics = METRICS.entity("rpc", name,
+                                               {"node": name})
+        self._node_read_shed = self.node_rpc_metrics.counter(
+            "read_shed_count")
+        self._node_deadline_expired = self.node_rpc_metrics.counter(
+            "deadline_expired_count")
+        self._beacon_age_gauge = self.node_rpc_metrics.gauge(
+            "beacon_ack_age_s")
+        # sustained-shed injection point for incident drills (the PR 2
+        # chaos surface): `FAIL_POINTS.cfg("stub_read_shed:<node>", ...)`
+        # makes THIS node's read gate shed with ERR_BUSY
+        self._shed_fp_name = f"stub_read_shed:{name}"
+        # flight recorder + health watchdog (utils/timeseries, utils/
+        # health): fixed-cadence ring capture over this node's metric
+        # entities, rules journaling typed events, digest riding
+        # config-sync to the meta ClusterHealth machine
+        from pegasus_tpu.utils.health import HealthEngine
+        from pegasus_tpu.utils.timeseries import FlightRecorder
+
+        self.recorder = FlightRecorder(
+            name, clock=self.clock or self.sim_clock,
+            owns=self._owns_entity)
+        self.health = HealthEngine(name, self.recorder)
         net.register(name, self.on_message)
         batch_reg = getattr(net, "register_batch", None)
         if batch_reg is not None:
@@ -470,7 +498,40 @@ class ReplicaStub:
             "fault.set <drop|delay|duplicate> <value> [src] [dst] — "
             "live chaos-plan adjustment")
 
+        def timeseries_dump(args):
+            """timeseries-dump [entity_type [entity_id [metric
+            [window_s]]]] — this node's flight-recorder ring slices
+            ('' wildcards a position); the `shell timeline` fan-out
+            target."""
+            sel = [a if a else None for a in args[:3]]
+            sel += [None] * (3 - len(sel))
+            window = float(args[3]) if len(args) > 3 and args[3] else None
+            return self.recorder.dump(sel[0], sel[1], sel[2], window)
+
+        def health_status(_args):
+            return self.health.status()
+
+        def health_events(args):
+            limit = int(args[0]) if args else 64
+            entity_id = args[1] if len(args) > 1 and args[1] else None
+            return self.health.events(limit, entity_id)
+
+        self.commands.register(
+            "timeseries-dump", timeseries_dump,
+            "flight-recorder ring slices [entity_type [entity_id "
+            "[metric [window_s]]]]")
+        self.commands.register(
+            "health.status", health_status,
+            "this node's watchdog verdict: status + firing rules + "
+            "ring memory")
+        self.commands.register(
+            "health.events", health_events,
+            "this node's health-event journal [limit [entity_id]]")
+
     def close(self) -> None:
+        # release outstanding capture pins: a node closing mid-incident
+        # must not leave the process's trace/profiler settings raised
+        self.health.close()
         for r in self.replicas.values():
             r.close()
         if getattr(self, "_encryption_dirs", None):
@@ -529,6 +590,67 @@ class ReplicaStub:
         blocks found here quarantine their replica exactly like a
         corrupt client read would."""
         self.scrubber.tick()
+
+    # ---- flight recorder + health watchdog ----------------------------
+
+    def _owns_entity(self, ent) -> bool:
+        """Which registry entities this node's recorder captures. In a
+        real deployment the process IS the node, but in-process sim
+        clusters share ONE registry, so ownership must be explicit:
+        this node's named entities, the per-process singletons (which
+        are node-local once deployed), the replicas it hosts, and its
+        duplication sessions."""
+        et, ei = ent.entity_type, ent.entity_id
+        if ei == self.name:
+            return True  # write / tracing / rpc:<node> / dup governor
+        if (et, ei) in (("rpc", "dispatch"), ("storage", "node")):
+            # KNOWN sim artifact: these singletons are shared by every
+            # in-process stub, so one node's scrub/quarantine signal
+            # fires the rule on ALL sim nodes (and meta folds them all
+            # as degraded). Deployed, process == node and attribution
+            # is exact; node-attributable signals use the per-node rpc
+            # twins above instead
+            return True
+        if et == "task":
+            return True  # profiler codes (process == node deployed)
+        if et == "replica":
+            try:
+                a, p = ei.split(".")
+                return (int(a), int(p)) in self.replicas
+            except ValueError:
+                return False
+        if et == "duplication":
+            return ent.attrs.get("node") == self.name
+        return False
+
+    def health_tick(self) -> None:
+        """Timer: one flight-recorder pass + one watchdog evaluation.
+        The WHOLE body coalesces to the recorder cadence (the timer may
+        fire far faster — sim schedules compress hours of virtual time
+        into milliseconds, so per-call work here must be one clock
+        read on the off-cadence path). Firing rules auto-pin deeper
+        capture (trace sample ratio + profiler) until clear."""
+        from pegasus_tpu.utils.profiler import PROFILER
+
+        if not self.recorder.due():
+            return
+        now = self.sim_clock()
+        # before the first ack the node is still joining — 0, not inf
+        age = (0.0 if self._last_beacon_ack == float("-inf")
+               else now - self._last_beacon_ack)
+        self._beacon_age_gauge.set(round(max(age, 0.0), 3))
+        if PROFILER.enabled and (
+                now - getattr(self, "_profiler_published_at", -1e18)
+                >= 30.0):
+            # keep the per-code "task" entities fresh so the recorder
+            # rings (and Prometheus scrapes) see profiler stats — on
+            # its OWN slower cadence: a publish re-reads every per-code
+            # window, and paying that on every recorder tick made
+            # compressed sim schedules (hours of virtual time) crawl
+            self._profiler_published_at = now
+            PROFILER.publish()
+        if self.recorder.tick() is not None:
+            self.health.evaluate()
 
     def _on_scrub_corruption(self, gpid: Gpid, exc: Exception) -> None:
         self._on_storage_error(gpid, exc)
@@ -1226,7 +1348,16 @@ class ReplicaStub:
         if self._deadline_expired(payload):
             # abandoned work: the client's end-to-end deadline lapsed,
             # so the cheapest correct answer is a typed fast-fail
+            self._node_deadline_expired.increment()
             return int(ErrorCode.ERR_TIMEOUT), None
+        from pegasus_tpu.utils.fail_point import fail_point
+
+        if fail_point(self._shed_fp_name) is not None:
+            # injected sustained shedding (incident drills / the seeded
+            # flight-recorder scenario): same typed ERR_BUSY the real
+            # dispatcher shed returns, counted on the node's rpc entity
+            self._node_read_shed.increment()
+            return int(ErrorCode.ERR_BUSY), None
         gpid = tuple(payload["gpid"])
         r = self.replicas.get(gpid)
         if not self._client_allowed(r, payload, access="r", src=src):
@@ -2108,11 +2239,17 @@ class ReplicaStub:
             if dr is None or dr.status != PartitionStatus.PRIMARY:
                 continue
             dup_report.append(sess.stats())
+        # health digest + the watchdog events since the last report ride
+        # the SAME channel into the meta-side ClusterHealth machine —
+        # drained ONCE, outside the target loop (every meta-group member
+        # gets the identical block; only the leader acts)
+        health_report = self.health.drain_report()
         for meta in self._meta_targets():
             self.net.send(self.name, meta, "config_sync", {
                 "node": self.name, "stored": stored,
                 "pressure": pressure, "compaction": compaction,
                 "dup": dup_report,
+                "health": health_report,
                 # NB: key must not be "trace" — that's the wire slot
                 # for the distributed-tracing context
                 "trace_report": trace_report})
@@ -2124,6 +2261,10 @@ class ReplicaStub:
             from pegasus_tpu.storage.compact_governor import GOVERNOR
 
             GOVERNOR.set_cluster_grant(bool(payload["compact_grant"]))
+        if "health_ack" in payload:
+            # meta journaled our shipped health events up to this seq:
+            # stop re-shipping them
+            self.health.ack_report(int(payload["health_ack"]))
         for entry in payload["configs"]:
             gpid = tuple(entry["gpid"])
             r = self._open_replica(gpid, entry["partition_count"])

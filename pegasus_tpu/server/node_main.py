@@ -113,6 +113,9 @@ def run_node(cfg: dict, name: str) -> None:
         # corruption on a non-serving replica is found and repaired
         # (quarantine + re-learn) before a promotion serves it
         transport.run_timer(1.0, stub.scrub_tick)
+        # flight recorder + health watchdog (rings, rules, auto-pin);
+        # the tick coalesces itself to the configured cadence
+        transport.run_timer(2.0, stub.health_tick)
         # keep device predicate masks warm across TTL-seconds so scans
         # never block on an accelerator round-trip (scan_coordinator)
         from pegasus_tpu.server.scan_coordinator import MaskPrefresher

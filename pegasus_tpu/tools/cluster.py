@@ -142,6 +142,10 @@ class SimCluster:
                     # background scrub timer: latent at-rest corruption
                     # on non-serving replicas is detected here
                     stub.scrub_tick()
+                    # flight-recorder timer: drain metrics into the
+                    # node's rings + one watchdog pass (coalesced to
+                    # the recorder cadence internally)
+                    stub.health_tick()
             if advance:
                 self.loop.run_for(self.beacon_interval)
             else:

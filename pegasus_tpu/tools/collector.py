@@ -118,6 +118,8 @@ class InfoCollector:
         node_traces = self.collect_traces()
         dup_rows = self.collect_dups()
         storage_rows = self.collect_storage()
+        health_rows = self.collect_health()
+        alert_rows = self.collect_alerts()
         if per_table:
             if self._stat_client is None:
                 self._stat_client = self.client_factory(STAT_TABLE)
@@ -134,7 +136,47 @@ class InfoCollector:
             if storage_rows:
                 self._stat_client.set(b"_storage", ts,
                                       json.dumps(storage_rows).encode())
+            if health_rows:
+                self._stat_client.set(b"_health", ts,
+                                      json.dumps(health_rows).encode())
+            if alert_rows:
+                self._stat_client.set(b"_alerts", ts,
+                                      json.dumps(alert_rows).encode())
         return per_table
+
+    def collect_health(self) -> Dict[str, dict]:
+        """Per-node watchdog verdict off the `health.status` verb:
+        status, firing rules, and the flight recorder's ring-memory
+        cost — one `_health` stat row per round, so soaks/SLO checks
+        can assert 'nothing fired' from table history alone."""
+        out: Dict[str, dict] = {}
+        for node in self.nodes:
+            st = self._command(node, "health.status")
+            if not st:
+                continue
+            out[node] = {
+                "status": st.get("status", "?"),
+                "firing": [f.get("rule") for f in st.get("firing", [])],
+                "events_total": st.get("events_total", 0),
+                "ring_bytes": st.get("ring_bytes", 0),
+            }
+        return out
+
+    def collect_alerts(self) -> Dict[str, list]:
+        """Recent typed health events per node (the `health.events`
+        journal) — the `_alerts` stat row: severity, rule, firing/
+        cleared, reason, compacted to the essentials."""
+        out: Dict[str, list] = {}
+        for node in self.nodes:
+            events = self._command(node, "health.events", ["16"])
+            if not events:
+                continue
+            out[node] = [{
+                "rule": ev.get("rule"), "severity": ev.get("severity"),
+                "firing": ev.get("firing"), "ts": ev.get("ts"),
+                "entity": ev.get("entity"), "reason": ev.get("reason"),
+            } for ev in events]
+        return out
 
     def collect_storage(self) -> Dict[str, dict]:
         """Per-node point-read index health off the `storage` metric

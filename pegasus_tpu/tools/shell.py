@@ -309,6 +309,22 @@ def main(argv=None) -> int:
     p.add_argument("--slow", action="store_true",
                    help="kept slow traces only (the default view)")
     p.add_argument("--limit", type=int, default=16)
+    # cluster flight recorder: watchdog status + incident timelines
+    p = sub.add_parser("health",
+                       help="cluster health: damped per-node/per-table "
+                            "status + firing watchdog rules (one meta "
+                            "call off the config-sync digests)")
+    p.add_argument("--json", action="store_true")
+    p = sub.add_parser("timeline",
+                       help="one-command incident report for a node or "
+                            "table: flight-recorder ring slices, typed "
+                            "health events, and kept slow traces "
+                            "stitched into one rendered timeline")
+    p.add_argument("target", help="node name or table name")
+    p.add_argument("--window", default="5m",
+                   help="lookback window, e.g. 90s / 5m / 1h")
+    p.add_argument("--json", action="store_true",
+                   help="print the raw bundle instead of the rendering")
     # cluster/node admin breadth (parity: shell admin commands)
     sub.add_parser("cluster_info")
     p = sub.add_parser("server_info")
@@ -863,6 +879,76 @@ def _full_scan_records(box, table, limit, with_ttl=False):
                     return
         finally:
             sc.close()
+
+
+def _build_timeline(box, target: str, window_s: float) -> dict:
+    """Assemble ONE incident bundle for a node or table: the meta's
+    damped status + event ledger, the implicated flight-recorder ring
+    slices fetched from the reporting nodes via `timeseries-dump`, and
+    the tail-kept slow-trace roots from the config-sync trace reports.
+    The time window anchors on the newest evidence (node clocks, not
+    the shell's), so it renders correctly over sim and wall clocks."""
+    nodes = box.admin.call("list_nodes")
+    status = box.admin.call("cluster_health")
+    if target in nodes or target in status.get("nodes", {}):
+        node, table = target, None
+        events = box.admin.call("health_events", node=target, limit=256)
+        tstat = status["nodes"].get(target, {}).get("status", "?")
+    else:
+        apps = {a["app_name"]: str(a["app_id"])
+                for a in box.admin.call("list_apps")}
+        app_id = apps.get(target)
+        if app_id is None:
+            raise ValueError(
+                f"{target!r} is neither a live node nor a table")
+        node, table = None, app_id
+        events = box.admin.call("health_events", table=app_id, limit=256)
+        tstat = status.get("tables", {}).get(app_id,
+                                             {}).get("status", "ok")
+    # ring slices: every series the events implicate, fetched from the
+    # node that reported it; a node timeline adds the pressure pair so
+    # a quiet incident still shows its load context
+    wanted = {(ev.get("node"), tuple(ev["entity"]), ev["metric"])
+              for ev in events if ev.get("node")}
+    if node is not None:
+        wanted.add((node, ("rpc", node), "read_shed_count"))
+        wanted.add((node, ("rpc", node), "deadline_expired_count"))
+    series = []
+    for n, (et, ei), metric in sorted(wanted):
+        try:
+            rows = box.remote_command(
+                n, "timeseries-dump", [et, ei, metric, str(window_s)])
+        except (ValueError, KeyError):
+            rows = None  # node gone mid-incident: render what we have
+        for row in rows or []:
+            row["node"] = n
+            series.append(row)
+    # anchor the window on the newest evidence timestamp
+    t1 = None
+    for ev in events:
+        t1 = ev["ts"] if t1 is None else max(t1, ev["ts"])
+    for row in series:
+        if row["points"]:
+            ts = row["points"][-1][0]
+            t1 = ts if t1 is None else max(t1, ts)
+    bundle = {"target": target, "status": tstat,
+              "events": events, "series": series, "traces": []}
+    if t1 is not None:
+        t0 = t1 - window_s
+        bundle["window"] = [t0, t1]
+        bundle["events"] = [ev for ev in events if ev["ts"] >= t0]
+        for row in series:
+            row["points"] = [p for p in row["points"] if p[0] >= t0]
+    reports = box.admin.call("slow_traces") or {}
+    for rep_node, rep in sorted(reports.items()):
+        if node is not None and rep_node != node:
+            continue
+        for root in rep.get("roots", []):
+            if t1 is not None and not (
+                    t1 - window_s <= root.get("start", 0.0) <= t1 + 1.0):
+                continue
+            bundle["traces"].append(root)
+    return bundle
 
 
 def _dispatch(args, box, out) -> int:
@@ -1545,6 +1631,34 @@ def _dispatch(args, box, out) -> int:
         else:
             print(json.dumps(tracing.slow_roots_all(args.limit),
                              indent=1), file=out)
+    elif args.cmd == "health":
+        status = box.admin.call("cluster_health")
+        if args.json:
+            print(json.dumps(status, indent=1), file=out)
+        else:
+            print(f"cluster: {status['cluster']}", file=out)
+            for node, st in sorted(status["nodes"].items()):
+                firing = ", ".join(
+                    f"{f['rule']}[{f['entity'][0]}/{f['entity'][1]}]"
+                    for f in st["firing"]) or "-"
+                print(f"  {node:<12} {st['status']:<9} "
+                      f"rings={st['ring_bytes']}B "
+                      f"events={st['events_total']}  {firing}",
+                      file=out)
+            for table, st in sorted(status["tables"].items()):
+                rules = ", ".join(f"{f['rule']}@{f['node']}"
+                                  for f in st["firing"])
+                print(f"  table {table:<6} {st['status']:<9} {rules}",
+                      file=out)
+    elif args.cmd == "timeline":
+        from pegasus_tpu.utils.health import parse_window, render_timeline
+
+        bundle = _build_timeline(box, args.target,
+                                 parse_window(args.window))
+        if args.json:
+            print(json.dumps(bundle, indent=1), file=out)
+        else:
+            print(render_timeline(bundle), file=out)
     elif args.cmd == "nodes":
         for n in box.admin.call("list_nodes"):
             print(n, file=out)

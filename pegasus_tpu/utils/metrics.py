@@ -59,16 +59,44 @@ class RelaxedCounter(Counter):
 
 
 class VolatileCounter(Counter):
-    """Counter reset on read (reference: metrics.h volatile counter)."""
+    """Delta-readable counter (reference: metrics.h volatile counter).
 
-    def fetch_and_reset(self) -> int:
+    The reference resets on read — safe there because exactly one
+    scraper owns each counter. Here the flight recorder, the info
+    collector, and `/metrics` scrapes all read concurrently, and
+    reset-on-read made them silently steal each other's deltas: a
+    delta consumed by one reader was a delta the others never saw.
+    The counter is now CUMULATIVE with a per-reader cursor:
+    `delta_since(reader_id)` returns the increments since that
+    reader's previous call, so every reader observes the full sum.
+    """
+
+    __slots__ = ("_cursors",)
+
+    def __init__(self) -> None:
+        super().__init__()
+        self._cursors: Dict[str, int] = {}
+
+    def delta_since(self, reader_id: str) -> int:
+        """Increments since this reader's last call (first call: since
+        creation). Each reader's cursor is independent."""
         with self._lock:
             v = self._value
-            self._value = 0
-            return v
+            delta = v - self._cursors.get(reader_id, 0)
+            self._cursors[reader_id] = v
+            return delta
+
+    def fetch_and_reset(self) -> int:
+        """Deprecated shim for the old reset-on-read surface: one
+        implicit shared reader. `value()` keeps reporting the
+        cumulative sum (it no longer resets underneath anyone)."""
+        return self.delta_since("__legacy_reset__")
 
     def snapshot(self) -> Dict[str, Any]:
-        return {"type": "volatile_counter", "value": self.fetch_and_reset()}
+        # cumulative, like a plain counter: a snapshot (JSON /metrics or
+        # Prometheus scrape) must never consume another reader's delta —
+        # and Prometheus counters are cumulative by contract anyway
+        return {"type": "volatile_counter", "value": self._value}
 
 
 class Gauge:
@@ -89,12 +117,20 @@ class Gauge:
 
 class Percentile:
     """Bounded-window percentile metric (reference: metrics.h:104 percentile
-    via nth-element over a 4096-sample window)."""
+    via nth-element over a 4096-sample window).
+
+    The sorted view is version-cached: readers that poll faster than
+    writers feed (the flight recorder each tick, the profiler publish,
+    repeated snapshots) sort once per window CHANGE, not once per read
+    — without it a sim schedule that compresses hours of virtual time
+    re-sorted every window thousands of times."""
 
     def __init__(self, window: int = 4096) -> None:
         self._window = window
         self._samples: List[float] = []
         self._idx = 0
+        self._version = 0
+        self._sorted: Optional[Tuple[int, List[float]]] = None
         self._lock = threading.Lock()
 
     def set(self, sample: float) -> None:
@@ -104,20 +140,37 @@ class Percentile:
             else:
                 self._samples[self._idx] = sample
                 self._idx = (self._idx + 1) % self._window
+            self._version += 1
+
+    @property
+    def version(self) -> int:
+        """Bumps on every sample: lets pollers skip unchanged windows."""
+        return self._version
+
+    def _sorted_view(self) -> List[float]:
+        # caller holds self._lock
+        if self._sorted is None or self._sorted[0] != self._version:
+            self._sorted = (self._version, sorted(self._samples))
+        return self._sorted[1]
 
     def percentile(self, p: float) -> float:
+        return self.quantiles((p,))[0]
+
+    def quantiles(self, ps) -> List[float]:
+        """Several percentile levels off ONE (cached) sort."""
         with self._lock:
             if not self._samples:
-                return 0.0
-            s = sorted(self._samples)
-            k = min(len(s) - 1, int(len(s) * p / 100.0))
-            return s[k]
+                return [0.0] * len(ps)
+            s = self._sorted_view()
+            return [s[min(len(s) - 1, int(len(s) * p / 100.0))]
+                    for p in ps]
 
     def snapshot(self) -> Dict[str, Any]:
+        vals = self.quantiles(_PERCENTILES)
         return {
             "type": "percentile",
-            **{f"p{str(p).rstrip('0').rstrip('.')}": self.percentile(p)
-               for p in _PERCENTILES},
+            **{f"p{str(p).rstrip('0').rstrip('.')}": v
+               for p, v in zip(_PERCENTILES, vals)},
         }
 
 
@@ -185,6 +238,14 @@ class MetricRegistry:
                 ent = MetricEntity(entity_type, entity_id, attrs)
                 self._entities[key] = ent
             return ent
+
+    def entities(self) -> List[MetricEntity]:
+        """Live entity objects (the flight recorder walks these directly
+        each tick: cheaper than snapshot(), which computes every
+        percentile level, and it needs the metric OBJECTS to take
+        per-reader cursors on volatile counters)."""
+        with self._lock:
+            return list(self._entities.values())
 
     def snapshot(self, entity_type: Optional[str] = None,
                  metric_names: Optional[List[str]] = None) -> List[Dict[str, Any]]:

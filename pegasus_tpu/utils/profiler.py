@@ -70,16 +70,42 @@ class TaskProfiler:
             items = list(self._stats.items())
         for code, st in items:
             window = max(now - st.started, 1e-9)
+            q50, q99 = st.queue_ms.quantiles((50, 99))
+            e50, e99 = st.exec_ms.quantiles((50, 99))
             out.append({
                 "code": code,
                 "count": st.count,
                 "qps": round(st.count / window, 1),
-                "queue_ms_p50": round(st.queue_ms.percentile(50), 3),
-                "queue_ms_p99": round(st.queue_ms.percentile(99), 3),
-                "exec_ms_p50": round(st.exec_ms.percentile(50), 3),
-                "exec_ms_p99": round(st.exec_ms.percentile(99), 3),
+                "queue_ms_p50": round(q50, 3),
+                "queue_ms_p99": round(q99, 3),
+                "exec_ms_p50": round(e50, 3),
+                "exec_ms_p99": round(e99, 3),
             })
         return sorted(out, key=lambda d: -d["count"])
+
+    def publish(self, registry=None) -> int:
+        """Mirror the per-code profile onto the metrics spine: one
+        "task" entity per code with count / qps / queue-p99 / exec-p99,
+        so enabled-profiler stats appear in Prometheus exposition and
+        the flight recorder's rings instead of living only behind the
+        text `remote_command ... dump`. Idempotent per call; returns
+        the number of codes published."""
+        if registry is None:
+            from pegasus_tpu.utils.metrics import METRICS as registry
+        rows = self.dump()
+        for row in rows:
+            ent = registry.entity("task", row["code"],
+                                  {"code": row["code"]})
+            c = ent.counter("task_dispatch_count")
+            delta = row["count"] - c.value()
+            if delta > 0:
+                c.increment(delta)
+            ent.gauge("task_qps").set(row["qps"])
+            ent.gauge("task_queue_ms_p50").set(row["queue_ms_p50"])
+            ent.gauge("task_queue_ms_p99").set(row["queue_ms_p99"])
+            ent.gauge("task_exec_ms_p50").set(row["exec_ms_p50"])
+            ent.gauge("task_exec_ms_p99").set(row["exec_ms_p99"])
+        return len(rows)
 
     def control(self, args: List[str]):
         """The `task-profiler` command verb body."""
@@ -93,6 +119,7 @@ class TaskProfiler:
         if verb == "clear":
             self.clear()
             return "task profiler cleared"
+        self.publish()  # a dump is also a publish: scrapes see it too
         return self.dump()
 
 

@@ -92,3 +92,81 @@ def test_collect_dups_aggregates_per_table_lag_rows(cluster):
     assert err == 0 and kvs
     persisted = json.loads(sorted(kvs.items())[-1][1])
     assert persisted[app_id]["shipped_bytes"] > 0
+
+
+def test_probe_round_healthy_then_partitioned_node_degrades(cluster):
+    """The availability detector under SimCluster: a healthy cluster
+    probes at 1.0; partitioning ONE node (not killing it — its
+    partitions stay assigned until the FD cures them) degrades the
+    fraction below 1.0; healing and re-probing raises it again."""
+    col = make_collector(cluster)
+    assert col.probe_round(probes=6) == 1.0
+    assert col.probe_total == 6 and col.probe_failed == 0
+    victim = next(iter(cluster.stubs))
+    cluster.net.partition(victim)
+    col._detect_client._max_retries = 1
+    col._detect_client._pump_rounds = 3
+    av = col.probe_round(probes=6)
+    assert av < 1.0
+    assert col.probe_failed >= 1
+    cluster.net.heal(victim)
+    cluster.step(rounds=2)
+    col._detect_client._max_retries = 3
+    col._detect_client._pump_rounds = 100
+    assert col.probe_round(probes=6) > av
+
+
+def test_collect_round_persists_health_and_alert_rows(cluster):
+    """The flight-recorder rows: `_health` lands per-node watchdog
+    status in table history each round; `_alerts` appears once a node
+    journals a typed event."""
+    import json as _json
+
+    from pegasus_tpu.utils.fail_point import FAIL_POINTS
+    from pegasus_tpu.utils.flags import FLAGS
+
+    cluster.create_table("traffic2", partition_count=2)
+    c = cluster.client("traffic2")
+    for i in range(10):
+        assert c.set(b"h%02d" % i, b"s", b"v") == 0
+    cluster.step(rounds=3)
+    col = make_collector(cluster)
+    col.collect_round()
+    err, kvs = col._stat_client.multi_get(b"_health")
+    assert err == 0 and kvs
+    rows = _json.loads(sorted(kvs.items())[-1][1])
+    assert set(rows) == set(cluster.stubs)
+    for node, row in rows.items():
+        assert row["status"] == "ok" and row["firing"] == []
+        assert row["ring_bytes"] > 0
+    # fire an incident on one node -> its `_alerts` row appears
+    victim = "node0"
+    FLAGS.set("pegasus.health", "recorder_interval_s", 1.0)
+    FAIL_POINTS.setup()
+    FAIL_POINTS.cfg(f"stub_read_shed:{victim}", "return(busy)")
+    try:
+        for _ in range(4):
+            for i in range(10):
+                try:
+                    c.get(b"h%02d" % i, b"s")
+                except Exception:  # noqa: BLE001 - shed IS the scenario
+                    pass
+            cluster.step()
+        col.collect_round()
+    finally:
+        FAIL_POINTS.teardown()
+        from pegasus_tpu.utils import health as health_mod
+
+        health_mod.reset_capture()
+        FLAGS.set("pegasus.health", "recorder_interval_s", 10.0)
+        FLAGS.set("pegasus.tracing", "sample_ratio", 0.0)
+    err, kvs = col._stat_client.multi_get(b"_health")
+    assert err == 0
+    rows = _json.loads(sorted(kvs.items())[-1][1])
+    assert rows[victim]["status"] == "degraded"
+    assert "read_shed_growth" in rows[victim]["firing"]
+    err, kvs = col._stat_client.multi_get(b"_alerts")
+    assert err == 0 and kvs
+    alerts = _json.loads(sorted(kvs.items())[-1][1])
+    assert any(ev["rule"] == "read_shed_growth" and ev["firing"]
+               for ev in alerts.get(victim, []))

@@ -1,0 +1,609 @@
+"""Cluster flight recorder tests: time-series rings, the health-rules
+watchdog (threshold / burn-rate / z-score, flap damping, typed events,
+auto-pinned capture), the meta ClusterHealth fold, and the seeded-sim
+incident scenario behind `shell health` / `shell timeline`."""
+
+import argparse
+import io
+import itertools
+import json
+
+import pytest
+
+from pegasus_tpu.tools.cluster import SimCluster
+from pegasus_tpu.utils import health as health_mod
+from pegasus_tpu.utils import tracing
+from pegasus_tpu.utils.fail_point import FAIL_POINTS
+from pegasus_tpu.utils.flags import FLAGS
+from pegasus_tpu.utils.health import (
+    HealthEngine,
+    HealthRule,
+    default_rules,
+    parse_window,
+    render_timeline,
+)
+from pegasus_tpu.utils.metrics import MetricRegistry
+from pegasus_tpu.utils.profiler import PROFILER
+from pegasus_tpu.utils.timeseries import FlightRecorder
+
+
+@pytest.fixture(autouse=True)
+def _isolation():
+    """Every test: clean rings/flags/fail-points/capture pins."""
+    tracing.reset()
+    tracing.seed(7)
+    FLAGS.set("pegasus.tracing", "sample_ratio", 0.0)
+    FLAGS.set("pegasus.health", "recorder_enabled", True)
+    yield
+    FAIL_POINTS.teardown()
+    health_mod.reset_capture()
+    PROFILER.disable()
+    PROFILER.clear()
+    FLAGS.set("pegasus.tracing", "sample_ratio", 0.0)
+    FLAGS.set("pegasus.health", "recorder_enabled", True)
+    FLAGS.set("pegasus.health", "recorder_interval_s", 10.0)
+    FLAGS.set("pegasus.health", "recorder_window_s", 600.0)
+    FLAGS.set("pegasus.health", "recorder_byte_cap", 262144)
+    tracing.reset()
+
+
+# ---- recorder unit tests -------------------------------------------------
+
+
+class _Clock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def _recorder(reg, clock):
+    return FlightRecorder("n0", clock=clock, registry=reg)
+
+
+def test_recorder_counters_become_rates_gauges_sampled():
+    reg = MetricRegistry()
+    clock = _Clock()
+    ent = reg.entity("rpc", "n0")
+    c = ent.counter("read_shed_count")
+    g = ent.gauge("queue_depth")
+    p = ent.percentile("lat_ms")
+    rec = _recorder(reg, clock)
+    c.increment(10)
+    g.set(3.0)
+    for v in range(100):
+        p.set(float(v))
+    rec.tick()  # first sight: cursors only, no rate points yet
+    assert rec.series("rpc", "n0", "read_shed_count") is None
+    clock.t += 10.0
+    c.increment(50)
+    rec.tick()
+    ring = rec.series("rpc", "n0", "read_shed_count")
+    assert ring.kind == "rate"
+    assert ring.latest()[1] == pytest.approx(5.0)  # 50 over 10s
+    assert rec.series("rpc", "n0", "queue_depth").latest()[1] == 3.0
+    p50 = rec.series("rpc", "n0", "lat_ms.p50")
+    assert p50 is not None and p50.kind == "value"
+    # volatile counters drain through the per-reader cursor: the
+    # recorder's reads never steal another reader's delta
+    v = ent.volatile_counter("qps")
+    v.increment(30)
+    clock.t += 10.0
+    rec.tick()
+    assert rec.series("rpc", "n0", "qps").latest()[1] == pytest.approx(3.0)
+    assert v.delta_since("other_reader") == 30  # full sum still there
+
+
+def test_recorder_coalesces_below_interval_and_respects_master_switch():
+    reg = MetricRegistry()
+    clock = _Clock()
+    ent = reg.entity("rpc", "n0")
+    ent.gauge("g").set(1.0)
+    rec = _recorder(reg, clock)
+    assert rec.tick() is not None
+    clock.t += 1.0  # below the 5s cadence: coalesced
+    assert rec.tick() is None
+    clock.t += 10.0
+    FLAGS.set("pegasus.health", "recorder_enabled", False)
+    assert rec.tick() is None
+    FLAGS.set("pegasus.health", "recorder_enabled", True)
+    assert rec.tick() is not None
+
+
+def test_recorder_window_trim_and_byte_cap():
+    reg = MetricRegistry()
+    clock = _Clock()
+    ent = reg.entity("rpc", "n0")
+    g = ent.gauge("g")
+    rec = _recorder(reg, clock)
+    FLAGS.set("pegasus.health", "recorder_window_s", 100.0)
+    for i in range(30):
+        g.set(float(i + 1))
+        rec.tick(force=True)
+        clock.t += 10.0
+    ring = rec.series("rpc", "n0", "g")
+    # 100s window over 10s spacing: ~10 newest points retained
+    assert len(ring.points) <= 11
+    assert ring.points[0][0] >= clock.t - 110.0
+    # hard byte cap: overflow evicts oldest points, never grows
+    FLAGS.set("pegasus.health", "recorder_window_s", 1e9)
+    FLAGS.set("pegasus.health", "recorder_byte_cap", 600)
+    for i in range(200):
+        g.set(float(i))
+        rec.tick(force=True)
+        clock.t += 10.0
+    assert rec.nbytes() <= 600 + 200  # one series' overhead slack
+    assert rec.evicted_points > 0
+
+
+def test_recorder_ownership_predicate():
+    reg = MetricRegistry()
+    clock = _Clock()
+    reg.entity("rpc", "n0").gauge("g").set(1.0)
+    reg.entity("rpc", "n1").gauge("g").set(2.0)
+    rec = FlightRecorder("n0", clock=clock, registry=reg,
+                         owns=lambda e: e.entity_id == "n0")
+    rec.tick()
+    assert rec.series("rpc", "n0", "g") is not None
+    assert rec.series("rpc", "n1", "g") is None
+
+
+# ---- rules engine unit tests ---------------------------------------------
+
+
+def _engine_with_series(rule, points, clock, kind="rate"):
+    """Engine over a hand-built ring (no registry round trip)."""
+    from pegasus_tpu.utils.timeseries import SeriesRing
+
+    reg = MetricRegistry()
+    rec = FlightRecorder("n0", clock=clock, registry=reg)
+    ring = SeriesRing(kind)
+    for ts, v in points:
+        ring.append(ts, v)
+        rec._total_points += 1
+    rec._series[(rule.entity_type, "n0", rule.metric)] = ring
+    eng = HealthEngine("n0", rec, rules=[rule], clock=clock)
+    return eng, ring
+
+
+def test_threshold_rule_fires_and_clears_with_hysteresis():
+    clock = _Clock()
+    rule = HealthRule("hot", "rpc", "m", kind="threshold", threshold=5.0,
+                      clear_hold=2)
+    eng, ring = _engine_with_series(rule, [(999.0, 9.0)], clock)
+    evs = eng.evaluate()
+    assert len(evs) == 1 and evs[0].firing and evs[0].rule == "hot"
+    assert evs[0].severity == "degraded" and evs[0].evidence
+    assert eng.status()["status"] == "degraded"
+    # one calm eval is NOT enough to clear (clear_hold=2)
+    ring.append(1001.0, 0.0)
+    assert eng.evaluate() == []
+    ring.append(1002.0, 0.0)
+    evs = eng.evaluate()
+    assert len(evs) == 1 and not evs[0].firing
+    assert eng.status()["status"] == "ok"
+    # journal holds the full fired/cleared ledger
+    assert [d["firing"] for d in eng.journal] == [True, False]
+
+
+def test_burn_rate_needs_sustained_violation_not_one_blip():
+    clock = _Clock()
+    rule = HealthRule("burn", "rpc", "m", kind="burn_rate",
+                      threshold=1.0, window_s=30.0, min_points=2)
+    # a single blip: huge spike then silence — the windowed mean stays
+    # high but the LATEST sample is calm, so it must never fire
+    eng, ring = _engine_with_series(
+        rule, [(980.0, 50.0), (990.0, 0.0)], clock)
+    assert eng.evaluate() == []
+    # blip AFTER a quiet stretch (run-length compression leaves one
+    # trailing zero): huge latest sample, but the previous sample is
+    # calm — "burn" means consecutive hot ticks, so still no fire
+    ring.append(992.0, 30.0)
+    assert eng.evaluate() == []
+    # sustained: consecutive hot samples -> fires
+    ring.append(995.0, 4.0)
+    ring.append(999.0, 4.0)
+    evs = eng.evaluate()
+    assert len(evs) == 1 and evs[0].firing
+
+
+def test_zscore_rule_detects_spike_over_history():
+    clock = _Clock()
+    pts = [(900.0 + i * 10, 10.0 + (i % 2)) for i in range(9)]
+    pts.append((995.0, 60.0))  # the spike
+    rule = HealthRule("spike", "rpc", "m", kind="zscore", threshold=4.0,
+                      window_s=120.0, min_points=5)
+    eng, _ring = _engine_with_series(rule, pts, clock)
+    evs = eng.evaluate()
+    assert len(evs) == 1 and evs[0].firing
+    assert "σ" in evs[0].reason
+
+
+def test_hold_delays_firing_until_consecutive_violations():
+    clock = _Clock()
+    rule = HealthRule("flappy", "rpc", "m", kind="threshold",
+                      threshold=1.0, hold=3)
+    eng, ring = _engine_with_series(rule, [(999.0, 5.0)], clock)
+    assert eng.evaluate() == []  # 1st violation
+    assert eng.evaluate() == []  # 2nd
+    evs = eng.evaluate()  # 3rd consecutive -> fire
+    assert len(evs) == 1 and evs[0].firing
+
+
+def test_firing_pins_capture_and_clear_restores_it():
+    clock = _Clock()
+    FLAGS.set("pegasus.tracing", "sample_ratio", 0.01)
+    rule = HealthRule("hot", "rpc", "m", kind="threshold", threshold=1.0,
+                      clear_hold=1)
+    eng, ring = _engine_with_series(rule, [(999.0, 9.0)], clock)
+    assert not PROFILER.enabled
+    eng.evaluate()
+    assert FLAGS.get("pegasus.tracing", "sample_ratio") == \
+        FLAGS.get("pegasus.health", "pin_sample_ratio")
+    assert PROFILER.enabled  # incident-window profiling is on
+    ring.append(1001.0, 0.0)
+    evs = eng.evaluate()
+    assert evs and not evs[0].firing
+    assert FLAGS.get("pegasus.tracing", "sample_ratio") == 0.01
+    assert not PROFILER.enabled
+
+
+def test_unpin_preserves_operator_ratio_change():
+    """An operator who re-tunes the sample ratio mid-incident keeps
+    their value: unpin restores only if the ratio is still the boost
+    it set."""
+    clock = _Clock()
+    rule = HealthRule("hot", "rpc", "m", kind="threshold", threshold=1.0,
+                      clear_hold=1)
+    eng, ring = _engine_with_series(rule, [(999.0, 9.0)], clock)
+    eng.evaluate()  # fires -> pinned to pin_sample_ratio
+    FLAGS.set("pegasus.tracing", "sample_ratio", 0.9)  # operator tune
+    ring.append(1001.0, 0.0)
+    evs = eng.evaluate()  # clears -> unpin
+    assert evs and not evs[0].firing
+    assert FLAGS.get("pegasus.tracing", "sample_ratio") == 0.9
+
+
+def test_cluster_health_stale_node_stops_asserting_tables():
+    """A node that stops reporting goes stale and its frozen firing
+    list must stop escalating table/cluster status — the meta refuses
+    to claim health it can no longer see."""
+    from pegasus_tpu.meta.cluster_health import STALE_S, ClusterHealth
+
+    class _Meta:
+        t = 0.0
+
+        def clock(self):
+            return self.t
+
+    meta = _Meta()
+    ch = ClusterHealth(meta)
+    ch.on_report("n0", {"health": {
+        "status": "critical",
+        "firing": [{"rule": "replica_quarantine",
+                    "entity": ["replica", "3.1"],
+                    "metric": "replica_quarantine_count",
+                    "severity": "critical", "since": 0.0}],
+        "events": []}})
+    st = ch.status()
+    assert st["tables"]["3"]["status"] == "critical"
+    assert st["cluster"] == "critical"
+    meta.t = STALE_S + 1.0  # the node never reports again
+    st = ch.status()
+    assert st["nodes"]["n0"]["status"] == "stale"
+    assert "3" not in st["tables"]
+    assert st["cluster"] == "ok"
+
+
+def test_engine_close_releases_outstanding_pins():
+    clock = _Clock()
+    base = FLAGS.get("pegasus.tracing", "sample_ratio")
+    rule = HealthRule("hot", "rpc", "m", kind="threshold", threshold=1.0)
+    eng, _ring = _engine_with_series(rule, [(999.0, 9.0)], clock)
+    eng.evaluate()
+    assert FLAGS.get("pegasus.tracing", "sample_ratio") != base
+    eng.close()
+    assert FLAGS.get("pegasus.tracing", "sample_ratio") == base
+
+
+def test_drain_report_is_bounded_and_counts_drops():
+    clock = _Clock()
+    FLAGS_cap = FLAGS.get("pegasus.health", "report_max_events")
+    rule = HealthRule("hot", "rpc", "m", kind="threshold", threshold=1.0,
+                      clear_hold=1)
+    eng, ring = _engine_with_series(rule, [(999.0, 9.0)], clock)
+    # flip fire/clear far past the report cap
+    for i in range(FLAGS_cap + 10):
+        ring.append(1000.0 + i, 9.0 if i % 2 == 0 else 0.0)
+        eng.evaluate()
+    rep = eng.drain_report()
+    assert len(rep["events"]) == FLAGS_cap
+    assert rep["dropped"] > 0
+    assert rep["events_total"] == len(eng.journal)
+    # unacked events RE-SHIP (a report lost on a broken meta link —
+    # the incident itself — must lose nothing) ...
+    rep2 = eng.drain_report()
+    assert [e["seq"] for e in rep2["events"]] == \
+        [e["seq"] for e in rep["events"]]
+    # ... until the config_sync_reply ack covers their seq
+    eng.ack_report(max(e["seq"] for e in rep2["events"]))
+    assert eng.drain_report()["events"] == []
+
+
+def test_meta_journal_dedupes_reshipped_events():
+    """Re-shipped (reply-lost) events must not duplicate in the meta
+    journal: dedupe by per-node seq, acked via on_report's return."""
+    from pegasus_tpu.meta.cluster_health import ClusterHealth
+
+    class _Meta:
+        t = 0.0
+
+        def clock(self):
+            return self.t
+
+    ch = ClusterHealth(_Meta())
+    block = {"health": {"status": "degraded", "firing": [], "events": [
+        {"rule": "r", "entity": ["rpc", "n0"], "metric": "m",
+         "severity": "degraded", "firing": True, "ts": 1.0,
+         "reason": "x", "evidence": [], "seq": 1},
+        {"rule": "r", "entity": ["rpc", "n0"], "metric": "m",
+         "severity": "degraded", "firing": False, "ts": 2.0,
+         "reason": "y", "evidence": [], "seq": 2}]}}
+    block["health"]["seq_hw"] = 2
+    assert ch.on_report("n0", block) == 2  # the ack high-water mark
+    assert ch.on_report("n0", block) == 2  # re-shipped: deduped
+    assert len(ch.journal) == 2
+    # node restart: a fresh engine's seq starts over — the backward
+    # seq_hw resets the dedupe cursor so post-restart events are NOT
+    # silently skipped-and-acked
+    restarted = {"health": {"status": "degraded", "firing": [],
+                            "seq_hw": 1, "events": [
+        {"rule": "r2", "entity": ["rpc", "n0"], "metric": "m",
+         "severity": "degraded", "firing": True, "ts": 9.0,
+         "reason": "z", "evidence": [], "seq": 1}]}}
+    assert ch.on_report("n0", restarted) == 1
+    assert len(ch.journal) == 3
+
+
+# ---- seeded-sim incident scenario (the acceptance gate) ------------------
+
+
+class _SimAdmin:
+    """OneboxAdmin's wire protocol over the sim network: the shell's
+    admin surface against a SimCluster, exercising meta _on_admin."""
+
+    def __init__(self, cluster):
+        self.c = cluster
+        self._rids = itertools.count(77_000_000)
+        self._replies = {}
+        cluster.net.register("shelladmin", self._on_msg)
+
+    def _on_msg(self, _src, msg_type, payload):
+        if msg_type == "admin_reply":
+            self._replies[payload["rid"]] = payload
+
+    def call(self, cmd, **args):
+        rid = next(self._rids)
+        self.c.net.send("shelladmin", self.c.meta.name, "admin",
+                        {"rid": rid, "cmd": cmd, "args": args})
+        for _ in range(50):
+            self.c.loop.run_until_idle()
+            if rid in self._replies:
+                rep = self._replies.pop(rid)
+                assert rep["err"] == 0, rep
+                return rep["result"]
+        raise RuntimeError(f"no admin reply for {cmd}")
+
+
+class _SimBox:
+    """The minimum shell-box surface `health`/`timeline` dispatch on."""
+
+    def __init__(self, cluster):
+        self.c = cluster
+        self.admin = _SimAdmin(cluster)
+
+    def remote_command(self, node, verb, args):
+        return self.c.stubs[node].commands.call(verb, args)
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    # every step records: maps "recorder tick" 1:1 onto SimCluster.step
+    FLAGS.set("pegasus.health", "recorder_interval_s", 1.0)
+    c = SimCluster(str(tmp_path / "c"), n_nodes=3, seed=11)
+    yield c
+    c.close()
+
+
+def _load(client, n=24, write=True, read=True):
+    ok = 0
+    for i in range(n):
+        try:
+            if write:
+                client.set(b"k%03d" % i, b"s", b"v%d" % i)
+            if read:
+                client.get(b"k%03d" % i, b"s")
+            ok += 1
+        except Exception:  # noqa: BLE001 - shed errors ARE the scenario
+            pass
+    return ok
+
+
+def test_incident_shed_fires_pins_capture_and_renders_timeline(cluster):
+    """The flight-recorder acceptance scenario: sustained read shedding
+    injected on one node -> its shed-rate rule fires within 3 recorder
+    ticks; meta health shows THAT node degraded (others ok); the trace
+    sample ratio is provably raised while firing and restored after
+    clear; `shell timeline` renders ring slice + events + >=1 kept
+    slow trace in one report."""
+    cluster.create_table("t", partition_count=4)
+    client = cluster.client("t")
+    _load(client)
+    cluster.step(rounds=2)
+    victim = "node0"
+    stub = cluster.stubs[victim]
+    base_ratio = FLAGS.get("pegasus.tracing", "sample_ratio")
+    # keep-threshold low so retry-stretched ops tail-keep in sim time
+    FLAGS.set("pegasus.tracing", "slow_trace_ms", 5.0)
+    FAIL_POINTS.setup()
+    FAIL_POINTS.cfg(f"stub_read_shed:{victim}", "return(busy)")
+    fired_at = None
+    for tick in range(1, 4):
+        _load(client, write=False)
+        cluster.step()
+        if stub.health.status()["status"] != "ok":
+            fired_at = tick
+            break
+    assert fired_at is not None and fired_at <= 3, \
+        "shed rule must fire within 3 recorder ticks"
+    firing = stub.health.firing()
+    assert any(f["rule"] == "read_shed_growth" for f in firing)
+    # auto-pin: sample ratio provably raised while firing
+    assert FLAGS.get("pegasus.tracing", "sample_ratio") == \
+        FLAGS.get("pegasus.health", "pin_sample_ratio") > base_ratio
+    assert PROFILER.enabled
+    # pinned capture + shed retries -> tail-kept slow traces exist
+    _load(client, write=False)
+    cluster.step(rounds=2)  # config-sync carries digest + traces up
+    box = _SimBox(cluster)
+    status = box.admin.call("cluster_health")
+    assert status["cluster"] == "degraded"
+    assert status["nodes"][victim]["status"] == "degraded"
+    assert any(f["rule"] == "read_shed_growth"
+               for f in status["nodes"][victim]["firing"])
+    for other in ("node1", "node2"):
+        assert status["nodes"][other]["status"] == "ok"
+    # the shell surfaces: `health` ...
+    from pegasus_tpu.tools.shell import _dispatch
+
+    out = io.StringIO()
+    _dispatch(argparse.Namespace(cmd="health", json=False), box, out)
+    text = out.getvalue()
+    assert "cluster: degraded" in text
+    assert "read_shed_growth" in text
+    # ... then clear: stop the injection, rule clears, ratio restores
+    FAIL_POINTS.teardown()
+    for _ in range(6):
+        cluster.step()
+        if not stub.health.firing():
+            break
+    assert not stub.health.firing()
+    assert FLAGS.get("pegasus.tracing", "sample_ratio") == base_ratio
+    assert not PROFILER.enabled
+    kinds = [d["firing"] for d in stub.health.journal
+             if d["rule"] == "read_shed_growth"]
+    assert kinds == [True, False]
+    # cleared event carries the incident-window profiler snapshot
+    cleared = [d for d in stub.health.journal if not d["firing"]][-1]
+    assert cleared.get("profile"), \
+        "auto-pinned TaskProfiler dump must ride the cleared event"
+    cluster.step(rounds=3)  # ship the cleared event + damped recovery
+    assert box.admin.call("cluster_health")["nodes"][victim][
+        "status"] == "ok"
+    # ONE rendered incident report: ring slice + events + kept trace
+    out = io.StringIO()
+    _dispatch(argparse.Namespace(cmd="timeline", target=victim,
+                                 window="5m", json=False), box, out)
+    report = out.getvalue()
+    assert "FIRING" in report and "CLEARED" in report
+    assert "read_shed_growth" in report
+    assert "read_shed_count" in report and "|" in report  # sparkline
+    assert "trace " in report, "timeline must include a kept slow trace"
+    # and the bundle is JSON-able for tooling
+    out = io.StringIO()
+    _dispatch(argparse.Namespace(cmd="timeline", target=victim,
+                                 window="5m", json=True), box, out)
+    bundle = json.loads(out.getvalue())
+    assert bundle["events"] and bundle["series"] and bundle["traces"]
+
+
+def test_healthy_soak_fires_zero_events_and_blips_are_damped(cluster):
+    """Steady healthy load over a full soak: zero events anywhere.
+    Then a sub-sustained one-tick shed blip: flap damping (burn-rate's
+    latest-sample gate) keeps the watchdog quiet through it too."""
+    cluster.create_table("s", partition_count=4)
+    client = cluster.client("s")
+    for _ in range(10):
+        assert _load(client) > 0
+        cluster.step()
+    for name, stub in cluster.stubs.items():
+        assert stub.health.events_total == 0, \
+            f"{name} fired during a healthy soak"
+        assert stub.health.status()["status"] == "ok"
+    status = cluster.meta.health.status()
+    assert status["cluster"] == "ok"
+    # one-tick blip: a burst of shed inside a single recorder tick
+    FAIL_POINTS.setup()
+    FAIL_POINTS.cfg("stub_read_shed:node1", "return(busy)")
+    _load(client, write=False)
+    FAIL_POINTS.teardown()  # gone before the next tick
+    for _ in range(4):
+        _load(client)
+        cluster.step()
+    assert cluster.stubs["node1"].health.events_total == 0, \
+        "a one-tick blip must be flap-damped, not fired"
+
+
+def test_table_timeline_folds_replica_entities(cluster):
+    """A rule firing on a table's replica entity shows on the TABLE
+    timeline: per-table status + filtered events."""
+    cluster.create_table("tt", partition_count=2)
+    client = cluster.client("tt")
+    _load(client)
+    # synthetic per-table rule so the fold is deterministic
+    for stub in cluster.stubs.values():
+        stub.health.rules.append(HealthRule(
+            "table_write_p99", "replica", "write_latency_ms.p99",
+            kind="threshold", threshold=-1.0))  # always fires once seen
+    for _ in range(3):
+        _load(client)
+        cluster.step()
+    box = _SimBox(cluster)
+    status = box.admin.call("cluster_health")
+    app_id = str(client.app_id)
+    assert status["tables"].get(app_id, {}).get("status") == "degraded"
+    events = box.admin.call("health_events", table=app_id)
+    assert events and all(e["entity"][0] == "replica" for e in events)
+    out = io.StringIO()
+    from pegasus_tpu.tools.shell import _dispatch
+
+    _dispatch(argparse.Namespace(cmd="timeline", target="tt",
+                                 window="10m", json=False), box, out)
+    assert "table_write_p99" in out.getvalue()
+
+
+def test_timeseries_dump_verb_and_health_status_verb(cluster):
+    cluster.create_table("d", partition_count=2)
+    client = cluster.client("d")
+    for _ in range(3):
+        _load(client)
+        cluster.step()
+    stub = cluster.stubs["node0"]
+    rows = stub.commands.call("timeseries-dump", ["write", "node0"])
+    assert rows and all(r["entity"] == "write" for r in rows)
+    assert all(r["points"] for r in rows)
+    # wildcarded positions + window arg
+    rows = stub.commands.call("timeseries-dump", ["", "", "", "60"])
+    assert rows
+    st = stub.commands.call("health.status", [])
+    assert st["status"] == "ok" and st["ring_bytes"] > 0
+    assert stub.commands.call("health.events", []) == []
+
+
+def test_parse_window_and_render_smoke():
+    assert parse_window("90s") == 90.0
+    assert parse_window("5m") == 300.0
+    assert parse_window("2h") == 7200.0
+    assert parse_window("42") == 42.0
+    text = render_timeline({
+        "target": "node0", "window": [0.0, 60.0], "status": "degraded",
+        "events": [{"ts": 30.0, "firing": True, "severity": "degraded",
+                    "rule": "r", "entity": ["rpc", "node0"],
+                    "metric": "m", "reason": "m=2 > 1"}],
+        "series": [{"entity": "rpc", "id": "node0", "metric": "m",
+                    "kind": "rate",
+                    "points": [[10.0, 0.0], [30.0, 2.0], [50.0, 1.0]]}],
+        "traces": [{"trace": "ab", "name": "client_read",
+                    "node": "node0", "total_ms": 42.0}]})
+    assert "FIRING" in text and "client_read" in text and "|" in text

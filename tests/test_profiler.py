@@ -61,3 +61,45 @@ def test_profiler_remote_command_surface(cluster):
     assert stub.commands.call("task-profiler", ["dump"]) == []
     assert "disabled" in stub.commands.call("task-profiler",
                                             ["disable"])
+
+
+def test_profiler_publishes_task_entities_to_metrics_spine(cluster):
+    """Enabled-profiler stats surface on "task" metric entities (count /
+    qps / queue-p99 / exec-p99), so Prometheus exposition and the
+    flight recorder see them — not just the text dump verb."""
+    from pegasus_tpu.utils.metrics import METRICS, to_prometheus
+
+    cluster.create_table("pm", partition_count=2)
+    client = cluster.client("pm")
+    PROFILER.enable()
+    for i in range(20):
+        assert client.set(b"m%d" % i, b"s", b"v") == 0
+        assert client.get(b"m%d" % i, b"s") == (0, b"v")
+    n = PROFILER.publish()
+    assert n > 0
+    snap = {e["id"]: e["metrics"] for e in METRICS.snapshot("task")}
+    assert "client_write" in snap
+    w = snap["client_write"]
+    assert w["task_dispatch_count"]["value"] >= 20
+    assert w["task_exec_ms_p99"]["value"] >= w["task_exec_ms_p50"]["value"]
+    assert "task_queue_ms_p99" in w and "task_qps" in w
+    # publish is idempotent on the cumulative count (no double counting)
+    before = w["task_dispatch_count"]["value"]
+    PROFILER.publish()
+    snap2 = {e["id"]: e["metrics"] for e in METRICS.snapshot("task")}
+    assert snap2["client_write"]["task_dispatch_count"]["value"] == before
+    # and the rows render through the Prometheus exposition
+    prom = to_prometheus(METRICS.snapshot("task"))
+    assert "pegasus_task_dispatch_count" in prom
+    assert 'code="client_write"' in prom
+    # the flight recorder records them: a stub's health tick owns the
+    # task entities (process == node once deployed)
+    stub = next(iter(cluster.stubs.values()))
+    stub.recorder.tick(force=True)
+    for _ in range(2):
+        for i in range(20):
+            client.set(b"m%d" % i, b"s", b"w")
+        cluster.step()
+        stub.recorder.tick(force=True)
+    assert stub.recorder.match("task"), \
+        "task entities must land in the flight recorder rings"

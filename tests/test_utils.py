@@ -50,12 +50,52 @@ def test_metrics_entities_and_percentile():
     assert reg.snapshot(entity_type="table") == []
 
 
-def test_volatile_counter_resets():
+def test_volatile_counter_legacy_shim_still_reads_deltas():
     reg = MetricRegistry()
     c = reg.entity("server", "s1").volatile_counter("qps")
     c.increment(10)
+    # the deprecated reset-on-read surface keeps its delta semantics
+    # through one implicit shared cursor...
     assert c.fetch_and_reset() == 10
-    assert c.value() == 0
+    assert c.fetch_and_reset() == 0
+    c.increment(3)
+    assert c.fetch_and_reset() == 3
+    # ...but the stored value is now CUMULATIVE: nothing resets under
+    # other readers, and snapshots report the sum
+    assert c.value() == 13
+    assert c.snapshot() == {"type": "volatile_counter", "value": 13}
+
+
+def test_volatile_counter_concurrent_readers_each_see_full_sum():
+    """The multi-reader race regression: the recorder, the collector,
+    and /metrics used to steal each other's deltas through
+    reset-on-read. With per-reader cursors, two interleaved readers
+    each observe the complete sum."""
+    import threading
+
+    reg = MetricRegistry()
+    c = reg.entity("server", "s1").volatile_counter("ops")
+    totals = {"a": 0, "b": 0}
+    stop = threading.Event()
+
+    def reader(rid):
+        while not stop.is_set():
+            totals[rid] += c.delta_since(rid)
+        totals[rid] += c.delta_since(rid)
+
+    threads = [threading.Thread(target=reader, args=(rid,))
+               for rid in totals]
+    for t in threads:
+        t.start()
+    n = 20_000
+    for _ in range(n):
+        c.increment()
+    stop.set()
+    for t in threads:
+        t.join()
+    assert totals["a"] == n
+    assert totals["b"] == n
+    assert c.value() == n
 
 
 def test_fail_point_lifecycle():
