@@ -1450,6 +1450,130 @@ def measure_health_overhead(tmpdir, seed: int):
         shutil.rmtree(cdir, ignore_errors=True)
 
 
+def measure_perfctx_overhead(tmpdir, seed: int):
+    """PerfContext overhead phase (round 18): the SAME batched
+    point-get and ranged multi_get streams through a SimCluster with
+    per-op cost-vector collection hard-OFF vs ON — same-run,
+    identity-gated (per-mode result digests must match). The gate:
+    contexts-enabled read AND scan paths within 2% of hard-off (median
+    of 3 reps, modes interleaved), per the trace_overhead /
+    health_overhead convention."""
+    import hashlib
+    import shutil
+
+    import numpy as np
+
+    from pegasus_tpu.base.key_schema import generate_key, key_hash_parts
+    from pegasus_tpu.base.value_schema import expire_ts_from_ttl
+    from pegasus_tpu.rpc.codec import OP_PUT
+    from pegasus_tpu.tools.cluster import SimCluster
+    from pegasus_tpu.utils.flags import FLAGS
+
+    n_hks = int(os.environ.get("PEGBENCH_PERFCTX_KEYS", 256))
+    n_sks = 8  # sort keys per hashkey: the ranged leg reads real pages
+    # enough rounds that each leg's median is hundreds of ms — 30-round
+    # legs measured ~16 ms and the A/B was pure scheduler noise (±5%)
+    n_rounds = int(os.environ.get("PEGBENCH_PERFCTX_ROUNDS", 240))
+    reps = 3
+    batch = 32
+    cdir = os.path.join(tmpdir, "perfctx_overhead")
+    cluster = SimCluster(cdir, n_nodes=3, seed=seed)
+    try:
+        cluster.create_table("pc", partition_count=4, replica_count=3)
+        client = cluster.client("pc")
+        hks = [b"phk%05d" % i for i in range(n_hks)]
+        for start in range(0, n_hks, batch):
+            groups = {}
+            for hk in hks[start:start + batch]:
+                ph = key_hash_parts(hk, b"")
+                for j in range(n_sks):
+                    groups.setdefault(ph % 4, []).append(
+                        (OP_PUT, (generate_key(hk, b"s%02d" % j),
+                                  b"v" * 64, expire_ts_from_ttl(0)),
+                         ph))
+            client.write_multi(groups)
+        # compact so the ranged leg rides the columnar scan path (the
+        # instrumented mask/kernel pipeline, not the overlay merge)
+        for stub in cluster.stubs.values():
+            for r in stub.replicas.values():
+                r.server.engine.flush()
+                r.server.engine.manual_compact()
+
+        # ONE fixed op order for every pass (write fixed point: the
+        # data is read-only here, so every pass reads identical state)
+        order = np.random.default_rng(seed + 1).integers(
+            0, n_hks, size=n_rounds * batch)
+
+        def one_pass(digest):
+            t0 = time.perf_counter()
+            for r in range(n_rounds):
+                groups = {}
+                for j in order[r * batch:(r + 1) * batch]:
+                    hk = hks[int(j)]
+                    ph = key_hash_parts(hk, b"")
+                    groups.setdefault(ph % 4, []).append(
+                        ("get", generate_key(hk, b"s00"), ph))
+                res = client.point_read_multi(groups)
+                for pidx in sorted(res):
+                    for st, val in res[pidx]:
+                        digest.update(b"%d" % st)
+                        digest.update(val)
+            t_read = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            for r in range(n_rounds):
+                for j in order[r * batch:(r + 1) * batch:4]:
+                    hk = hks[int(j)]
+                    err, kvs = client.multi_get(hk)
+                    digest.update(b"%d%d" % (err, len(kvs)))
+                    for sk in sorted(kvs):
+                        digest.update(sk)
+                        digest.update(kvs[sk])
+            t_scan = time.perf_counter() - t0
+            return t_read, t_scan
+
+        FLAGS.set("pegasus.perfctx", "enabled", False)
+        one_pass(hashlib.sha256())  # unmeasured warm-up
+        modes = [("perfctx_off", False), ("perfctx_on", True)]
+        ops_read = n_rounds * batch
+        ops_scan = n_rounds * (batch // 4)
+        out = {"hashkeys": n_hks, "sortkeys_per_hk": n_sks,
+               "ops_per_mode": (ops_read + ops_scan) * reps}
+        times = {name: ([], []) for name, _e in modes}
+        hashes = {name: hashlib.sha256() for name, _e in modes}
+        # modes interleave across reps so slow drift hits both equally
+        for _rep in range(reps):
+            for name, enabled in modes:
+                FLAGS.set("pegasus.perfctx", "enabled", enabled)
+                tr, ts = one_pass(hashes[name])
+                times[name][0].append(tr)
+                times[name][1].append(ts)
+        digests = {}
+        for name, _e in modes:
+            reads, scans = times[name]
+            digests[name] = hashes[name].hexdigest()
+            out[name] = {
+                "read_qps": round(ops_read * reps / sum(reads), 1),
+                "scan_qps": round(ops_scan * reps / sum(scans), 1),
+                "read_s_median": round(sorted(reads)[1], 4),
+                "scan_s_median": round(sorted(scans)[1], 4),
+            }
+        base, on = out["perfctx_off"], out["perfctx_on"]
+        out["read_overhead"] = round(
+            on["read_s_median"] / base["read_s_median"] - 1.0, 4)
+        out["scan_overhead"] = round(
+            on["scan_s_median"] / base["scan_s_median"] - 1.0, 4)
+        out["identity_ok"] = len(set(digests.values())) == 1
+        out["gate_ok"] = bool(
+            out["identity_ok"]
+            and out["read_overhead"] <= 0.02
+            and out["scan_overhead"] <= 0.02)
+        return out
+    finally:
+        FLAGS.set("pegasus.perfctx", "enabled", True)
+        cluster.close()
+        shutil.rmtree(cdir, ignore_errors=True)
+
+
 def measure_dup_catchup(tmpdir, seed: int):
     """Geo-replication catch-up phase (round 14): batched+compressed
     dup_apply_batch envelope shipping vs the legacy solo-mutation
@@ -1880,6 +2004,7 @@ def main() -> None:
     do_trace = os.environ.get("PEGBENCH_TRACE", "1") != "0"
     do_dup = os.environ.get("PEGBENCH_DUP", "1") != "0"
     do_health = os.environ.get("PEGBENCH_HEALTH", "1") != "0"
+    do_perfctx = os.environ.get("PEGBENCH_PERFCTX", "1") != "0"
 
     details = {"phases": {}}
     here = os.path.dirname(os.path.abspath(__file__))
@@ -2420,6 +2545,16 @@ def main() -> None:
                          f"events={ho['events_fired']}, gate<=2%: "
                          f"{ho['gate_ok']}, "
                          f"identical={ho['identity_ok']})")
+
+                if do_perfctx:
+                    po = measure_perfctx_overhead(tmpdir, seed)
+                    details["phases"]["perfctx_overhead"] = po
+                    save_details()
+                    _log(f"perfctx_overhead: contexts-on read "
+                         f"{po['read_overhead']:+.2%} / scan "
+                         f"{po['scan_overhead']:+.2%} vs hard-off "
+                         f"(gate<=2%: {po['gate_ok']}, "
+                         f"identical={po['identity_ok']})")
 
                 if do_dup:
                     dc = measure_dup_catchup(tmpdir, seed)

@@ -63,6 +63,10 @@ class MetaService:
         # config_sync report): `shell traces --slow` reads the whole
         # cluster's kept roots with ONE meta admin call
         self._trace_reports: Dict[str, dict] = {}
+        # latest per-partition workload shape digest (rides the stored
+        # entries of config_sync like the CU load signals): `shell
+        # workload <table>` folds these per table with ONE admin call
+        self._workload_reports: Dict[tuple, dict] = {}
         # in-flight learner adds: gpid -> (learner, started_at); prevents
         # every guardian tick from restarting a slow learn from scratch
         self._pending_learns: Dict[Gpid, Tuple[str, float]] = {}
@@ -417,6 +421,24 @@ class MetaService:
                     node=args.get("node"), table=args.get("table"),
                     since=args.get("since"),
                     limit=int(args.get("limit", 128)))
+            elif cmd == "partition_primary":
+                # routing-hash -> hosting primary (one meta call: the
+                # shell's wire-mode `explain` routes straight to the
+                # serving node instead of probing the fleet)
+                app = self.state.find_app(args["app_name"])
+                if app is None:
+                    raise PegasusError(ErrorCode.ERR_APP_NOT_EXIST,
+                                       args["app_name"])
+                pidx = (int(args.get("partition_hash") or 0)
+                        % app.partition_count)
+                pc_ = self.state.get_partition(app.app_id, pidx)
+                result = {"app_id": app.app_id, "pidx": pidx,
+                          "primary": pc_.primary}
+            elif cmd == "workload":
+                # the `shell workload <table>` surface: per-partition
+                # shape digests (off the config-sync stored entries)
+                # folded into one table rollup
+                result = self.workload_status(args.get("app_name", ""))
             elif cmd == "slow_traces":
                 # per-node tail-kept trace roots, newest last (the
                 # `shell traces --slow` surface; full spans fan out on
@@ -537,6 +559,20 @@ class MetaService:
         self._stored_reports[node] = list(payload.get("stored", []))
         if "trace_report" in payload:
             self._trace_reports[node] = payload["trace_report"]
+        # per-partition workload digests (primaries stamp them onto
+        # their stored entries, exactly like the CU load signals);
+        # digests of apps meta no longer knows AT ALL are pruned each
+        # report — without this, per-job temp-table churn grows the map
+        # forever (dropped-but-recallable apps keep their profile)
+        for entry in payload.get("stored", []):
+            wl = entry.get("workload")
+            if wl is not None:
+                self._workload_reports[tuple(entry["gpid"])] = dict(
+                    wl, node=node, at=self.clock())
+        if self._workload_reports:
+            self._workload_reports = {
+                g: w for g, w in self._workload_reports.items()
+                if g[0] in self.state.apps}
         # elasticity detect phase: the same report carries per-partition
         # capacity units + hotkey results and the node's pressure counts
         self.elasticity.on_report(node, payload)
@@ -592,6 +628,28 @@ class MetaService:
         if health_ack is not None:
             reply["health_ack"] = health_ack
         self.net.send(self.name, src, "config_sync_reply", reply)
+
+    def workload_status(self, app_name: str = "") -> dict:
+        """Per-table workload shape rollup from the config-sync
+        digests: partition rows + one folded table row (counts sum,
+        percentile-ish stats take the worst partition)."""
+        from pegasus_tpu.server.workload import fold_summaries
+
+        apps = {}
+        for app in self.list_apps():
+            if app_name and app.app_name != app_name:
+                continue
+            apps[app.app_id] = app.app_name
+        out: dict = {}
+        for gpid, wl in sorted(self._workload_reports.items()):
+            name = apps.get(gpid[0])
+            if name is None:
+                continue
+            tbl = out.setdefault(name, {"partitions": []})
+            tbl["partitions"].append(dict(wl, gpid=list(gpid)))
+        for name, tbl in out.items():
+            tbl["table"] = fold_summaries(tbl["partitions"])
+        return out
 
     # ---- DDL surface (parity: meta_service.cpp:480-571) ---------------
 

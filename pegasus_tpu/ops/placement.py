@@ -112,6 +112,36 @@ H2D_GBPS_EST = 0.5      # host->device marginal bandwidth
 ROUND_FIXED_S_EST = 0.070  # fixed cost per program round over the tunnel
 HOST_FILTER_GBPS_EST = 2.0  # host-side TTL/hash compare streams near
 #                             memory speed (no movement at all)
+HOST_DISPATCH_S_EST = 0.002  # fixed per-program dispatch cost on the
+#                              host backend (jit call + mask fetch) —
+#                              part of the PREDICTION so the drift
+#                              gauge compares model vs measurement on
+#                              the same footing for small batches
+
+
+def placement_verdict(workload: str = "rules") -> str:
+    """The compute class the policy routes `workload` to, as the
+    PerfContext `placement` string: "device" (ambient accelerator) or
+    "host-XLA" (host backend — either because the ambient default IS
+    the host or because the policy re-routed there)."""
+    rtt, _dev = _probe_rtt()
+    if rtt is None or choose_eval_device(workload) is not None:
+        return "host-XLA"
+    return "device"
+
+
+def predict_kernel_seconds(workload: str, batch_bytes: int) -> float:
+    """The cost model's prediction for one mask-evaluation batch on the
+    device the policy actually routes it to — what the workload
+    profiler's drift gauge compares the measured wall time against.
+    Mirrors offload_breakdown's estimates plus the fixed host dispatch
+    cost (a prediction of 3µs for a 6KB batch would make every
+    measurement look like 1000x drift; the model's claim includes the
+    per-call floor)."""
+    if placement_verdict(workload) == "device":
+        return ROUND_FIXED_S_EST + batch_bytes / (H2D_GBPS_EST * 1e9)
+    return (HOST_DISPATCH_S_EST
+            + batch_bytes / (HOST_FILTER_GBPS_EST * 1e9))
 
 
 def offload_breakdown(workload: str, batch_bytes: int) -> dict:

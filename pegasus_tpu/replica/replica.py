@@ -839,6 +839,26 @@ class Replica:
                 callback(override if override is not None else responses)
         if tracer is not None:
             tracer.add_point("replied")
+            from pegasus_tpu.utils import perf_context as perf
+
+            if perf.enabled():
+                # the write's cost vector: rows applied and the
+                # group-commit wait (append_plog -> plog_durable is
+                # exactly the shared-fsync flush-window interval) —
+                # rides the slow-log entry and the 2PC span like the
+                # read paths' contexts
+                pc = perf.PerfContext("write")
+                pc.ops = 1
+                pc.rows_evaluated = len(mu.ops)
+                pc.rows_survived = len(mu.ops)
+                stages = dict((s, t) for s, t in tracer.points)
+                if "append_plog" in stages and "plog_durable" in stages:
+                    pc.queue_wait_ms = max(
+                        0.0, (stages["plog_durable"]
+                              - stages["append_plog"]) * 1000.0)
+                tracer.perf = pc
+                if wspan is not None:
+                    perf.merge_span_perf(wspan.tags, pc)
             self.slow_log.observe(tracer)
             if self._write_latency is None:
                 self._write_latency = self.server.metrics.percentile(

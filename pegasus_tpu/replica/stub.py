@@ -516,6 +516,85 @@ class ReplicaStub:
             entity_id = args[1] if len(args) > 1 and args[1] else None
             return self.health.events(limit, entity_id)
 
+        def placement(args):
+            """placement [workload [batch_bytes]] — the quantified
+            pays/doesn't-pay offload verdict (ops/placement.py
+            offload_breakdown) plus the live cost-model drift audit,
+            operator-visible instead of PERF.md-only."""
+            from pegasus_tpu.ops.placement import offload_breakdown
+            from pegasus_tpu.server.workload import DRIFT
+
+            workload = args[0] if args else "rules"
+            batch_bytes = int(args[1]) if len(args) > 1 else 1 << 20
+            return {"breakdown": offload_breakdown(workload,
+                                                   batch_bytes),
+                    "drift": DRIFT.status()}
+
+        self.commands.register(
+            "placement", placement,
+            "offload pays/doesn't-pay verdict + cost-model drift "
+            "[workload [batch_bytes]]")
+
+        def workload_stats(args):
+            """Per-hosted-replica workload shape summaries + the node
+            cost-model drift (shell `workload` wire-mode fan-out)."""
+            from pegasus_tpu.replica.replica import PartitionStatus
+            from pegasus_tpu.server.workload import DRIFT
+
+            app_id = int(args[0]) if args else None
+            rows = []
+            for gpid, r in sorted(self.replicas.items()):
+                if app_id is not None and gpid[0] != app_id:
+                    continue
+                if r.status != PartitionStatus.PRIMARY:
+                    continue
+                rows.append(dict(r.server.workload.summary(),
+                                 gpid=list(gpid)))
+            return {"node": self.name, "partitions": rows,
+                    "drift": DRIFT.status()}
+
+        self.commands.register(
+            "workload.stats", workload_stats,
+            "per-replica workload shape stats + drift [app_id]")
+
+        def perf_explain(args):
+            """perf.explain <json-spec> — run one captured op on a
+            hosted PRIMARY and return the explain report.
+            spec: {app_id, op, hash_key, sort_key?|sort_keys?,
+            batch_size?} (keys utf-8)."""
+            import json as _json
+
+            from pegasus_tpu.base.key_schema import key_hash_parts
+            from pegasus_tpu.replica.replica import PartitionStatus
+            from pegasus_tpu.server.explain import explain_op, op_from_spec
+
+            spec = _json.loads(args[0])
+            app_id = int(spec["app_id"])
+            hk = spec.get("hash_key", "").encode()
+            candidates = [
+                (gpid, r) for gpid, r in sorted(self.replicas.items())
+                if gpid[0] == app_id
+                and r.status == PartitionStatus.PRIMARY]
+            if not candidates:
+                raise ValueError(f"no primary of app {app_id} here")
+            if hk:
+                want = (key_hash_parts(hk, b"")
+                        % candidates[0][1].server.partition_count)
+                owned = [(g, r) for g, r in candidates if g[1] == want]
+                if not owned:
+                    raise ValueError(
+                        f"partition {want} of app {app_id} not here")
+                _gpid, r = owned[0]
+            else:
+                _gpid, r = candidates[0]
+            op, op_args, ph = op_from_spec(spec)
+            return explain_op(r.server, op, op_args, partition_hash=ph)
+
+        self.commands.register(
+            "perf.explain", perf_explain,
+            "run one captured op with a forced PerfContext and return "
+            "the explain report (json spec)")
+
         self.commands.register(
             "timeseries-dump", timeseries_dump,
             "flight-recorder ring slices [entity_type [entity_id "
@@ -603,17 +682,22 @@ class ReplicaStub:
         et, ei = ent.entity_type, ent.entity_id
         if ei == self.name:
             return True  # write / tracing / rpc:<node> / dup governor
-        if (et, ei) in (("rpc", "dispatch"), ("storage", "node")):
+        if (et, ei) in (("rpc", "dispatch"), ("storage", "node"),
+                        ("workload", "node")):
             # KNOWN sim artifact: these singletons are shared by every
             # in-process stub, so one node's scrub/quarantine signal
             # fires the rule on ALL sim nodes (and meta folds them all
             # as degraded). Deployed, process == node and attribution
             # is exact; node-attributable signals use the per-node rpc
-            # twins above instead
+            # twins above instead. ("workload", "node") carries the
+            # cost-model drift gauge — per-process like the placement
+            # probe it audits.
             return True
         if et == "task":
             return True  # profiler codes (process == node deployed)
-        if et == "replica":
+        if et in ("replica", "workload"):
+            # per-partition entities share the replica id shape
+            # (app.pidx): owned when this node hosts the partition
             try:
                 a, p = ei.split(".")
                 return (int(a), int(p)) in self.replicas
@@ -649,6 +733,11 @@ class ReplicaStub:
             # compressed sim schedules (hours of virtual time) crawl
             self._profiler_published_at = now
             PROFILER.publish()
+        # decay the cost-model drift gauge: a class whose kernel waves
+        # stopped must age out instead of pinning the rule firing
+        from pegasus_tpu.server.workload import DRIFT
+
+        DRIFT.refresh()
         if self.recorder.tick() is not None:
             self.health.evaluate()
 
@@ -2201,6 +2290,10 @@ class ReplicaStub:
                         for k, hc in srv.hotkey_collectors.items()},
                     "at": now,
                 }
+                # workload shape digest rides the same report (op mix,
+                # batch/value sizes, scan selectivity, hot share) —
+                # meta folds per table for `shell workload`
+                entry["workload"] = srv.workload.summary()
             stored.append(entry)
         # foreground-pressure counters (PR 2 shed/deadline machinery):
         # the controller backs its move pacing off when these grow

@@ -59,6 +59,23 @@ class HotkeyCollector:
         touch instead of waiting out the repeat-hit gate."""
         return self.result if self.state is HotkeyState.FINISHED else None
 
+    def hot_share(self) -> float:
+        """Share (0..1) of fine-phase traffic owned by the detected-hot
+        hashkey; 0 before a detection FINISHES. Owned here (not read
+        through the private counter from outside) because a concurrent
+        `start()` clears the counter mid-iteration — callers on other
+        threads (the config-sync workload digest) get 0 for that racy
+        instant instead of a RuntimeError."""
+        hot = self.hot_hash_key()
+        if hot is None:
+            return 0.0
+        try:
+            total = sum(self._fine.values())
+            top = self._fine.get(hot, 0)
+        except RuntimeError:  # restart cleared the counter mid-sum
+            return 0.0
+        return top / total if total else 0.0
+
     def capture(self, hash_keys: Sequence[bytes]) -> None:
         """Feed a batch of request hashkeys (called from read/write
         dispatch paths while a detection is running)."""

@@ -261,6 +261,15 @@ class PartitionServer:
 
         self.hotkey_collectors = {"read": HotkeyCollector(),
                                   "write": HotkeyCollector()}
+        # per-table workload shape stats (server/workload.py): op mix,
+        # batch/value-size distributions, scan selectivity, hot-hashkey
+        # share — recorded on a "workload" metric entity so the flight
+        # recorder rings them and config-sync ships the summary to meta
+        from pegasus_tpu.server.workload import WorkloadStats
+
+        self.workload = WorkloadStats(app_id, pidx,
+                                      self.hotkey_collectors)
+        self.write_service.workload = self.workload
         # device-resident block cache: hot SST blocks stay in device memory
         # across scans (the HBM analogue of RocksDB's block cache), keyed by
         # (sst path, block offset) which is immutable per file
@@ -710,7 +719,14 @@ class PartitionServer:
     def on_get(self, key: bytes,
                partition_hash: Optional[int] = None) -> Tuple[int, bytes]:
         """Parity: on_get (pegasus_server_impl.cpp:418): expired records are
-        NotFound and counted as abnormal reads."""
+        NotFound and counted as abnormal reads.
+
+        The solo fallback populates the SAME PerfContext fields as the
+        batched path (LSMStore.get / SSTable.get tick the ambient
+        context), so a solo slow-log entry stays field-comparable with
+        a batched one — the observe_simple fallback attaches it."""
+        from pegasus_tpu.utils import perf_context as perf
+
         hc = self.hotkey_collectors["read"]
         if hc.state.value != "stopped":
             from pegasus_tpu.base.key_schema import restore_key
@@ -719,17 +735,48 @@ class PartitionServer:
         gate = self._read_gate() or self._hash_gate(partition_hash)
         if gate:
             return gate, b""
-        now = epoch_now()
-        hit = self.engine.get(key)
-        if hit is None:
-            return int(StorageStatus.NOT_FOUND), b""
-        value, ets = hit
-        if check_if_ts_expired(now, ets):
-            self._abnormal_reads.increment()
-            return int(StorageStatus.NOT_FOUND), b""
-        data = extract_user_data(self.data_version, value)
-        self.cu.add_read(len(key) + len(data))
-        return int(StorageStatus.OK), data
+        pc = perf.current()
+        if pc is None:
+            pc = perf.start("point_get")
+        t0 = time.perf_counter()
+        with perf.activate(pc):
+            now = epoch_now()
+            hit = self.engine.get(key)
+            status = int(StorageStatus.OK)
+            data = b""
+            if hit is None:
+                status = int(StorageStatus.NOT_FOUND)
+            else:
+                value, ets = hit
+                if check_if_ts_expired(now, ets):
+                    self._abnormal_reads.increment()
+                    if pc is not None:
+                        pc.expired_rows += 1
+                    status = int(StorageStatus.NOT_FOUND)
+                else:
+                    data = extract_user_data(self.data_version, value)
+                    self.cu.add_read(len(key) + len(data))
+            if pc is not None:
+                pc.ops += 1
+                pc.keys_resolved += 1
+                pc.rows_evaluated += 1
+                pc.placement = pc.placement or "native"
+                if status == int(StorageStatus.OK):
+                    pc.rows_survived += 1
+                    pc.bytes_returned += len(key) + len(data)
+                from pegasus_tpu.utils.tracing import current_span
+
+                sp = current_span()
+                if sp is not None:
+                    # the solo op's cost vector rides its dispatch
+                    # span, same as the batched paths — `shell
+                    # explain --from-trace` reads both shapes
+                    perf.merge_span_perf(sp.tags, pc)
+            self.workload.note_point(1, 1, [len(data)] if data else ())
+            self.slow_log.observe_simple(
+                f"point_get.{self.app_id}.{self.pidx}",
+                (time.perf_counter() - t0) * 1000.0)
+        return status, data
 
     def on_ttl(self, key: bytes,
                partition_hash: Optional[int] = None) -> Tuple[int, int]:
@@ -833,7 +880,21 @@ class PartitionServer:
         then batched run/block bisects + vectorized block probes for
         the misses. A publish racing the plan (generation moved) makes
         the batch re-resolve every key through the per-key safe order
-        instead of trusting the possibly-torn snapshot."""
+        instead of trusting the possibly-torn snapshot.
+
+        A PerfContext (utils/perf_context.py) rides the flush: ambient
+        while planning so the storage layer's block/sidecar hooks tick
+        it, stashed in the state so finish_get_batch can complete it —
+        an outer ambient context (shell explain) is reused instead."""
+        from pegasus_tpu.utils import perf_context as perf
+
+        pc = perf.current()
+        if pc is None:
+            pc = perf.start("point_get_batch")
+        with perf.activate(pc):
+            return self._plan_get_batch_inner(ops, now, pc)
+
+    def _plan_get_batch_inner(self, ops, now, ppc) -> dict:
         from pegasus_tpu.storage.memtable import TOMBSTONE
         from pegasus_tpu.utils.latency_tracer import LatencyTracer
 
@@ -843,6 +904,7 @@ class PartitionServer:
         # WHERE a read stalled, and the stages double as annotations on
         # the active distributed-tracing span
         tracer = LatencyTracer(self._get_log_key)
+        tracer.perf = ppc
         now = epoch_now() if now is None else now
         lsm = self.engine.lsm
         gen = lsm.generation  # read BEFORE the overlay/run snapshots
@@ -950,6 +1012,7 @@ class PartitionServer:
             rc_misses = len(ukeys) - rc_hits
         uniq: dict = {}
         base_pending: list = []  # missed the row cache AND the overlay
+        ov_hits = 0
         for key, _nv in probes:
             if key in uniq:
                 continue
@@ -963,6 +1026,7 @@ class PartitionServer:
                     continue
             hit = memget(key)
             if hit is not None:
+                ov_hits += 1
                 uniq[key] = (None if hit[0] is TOMBSTONE
                              else ("ov", hit[0], hit[1]))
                 continue
@@ -1064,10 +1128,27 @@ class PartitionServer:
             self._row_cache_hits.increment(rc_hits)
         if rc_misses:
             self._row_cache_misses.increment(rc_misses)
+        if ppc is not None:
+            # the flush's cost vector, batched like the counters it
+            # mirrors: ONE attribute pass per plan, never per key.
+            # (blocks_decoded / block_cache_hit / bytes ticked ambient
+            # by the storage layer during the probes above.)
+            ppc.ops += len(ops)
+            ppc.keys_resolved += len(uniq)
+            ppc.overlay_hits += ov_hits
+            ppc.runs_considered += len(l0) + len(runs)
+            ppc.bloom_pruned += bloom_useful
+            ppc.phash_pruned += phash_useful
+            ppc.phash_located += useful_box[1]
+            ppc.row_cache_hit += rc_hits
+            ppc.row_cache_miss += rc_misses
+            # point predicates are the "probe" workload class: host
+            # native kernels, never a device round-trip
+            ppc.placement = ppc.placement or "native"
         tracer.add_point("block_probe")
         return {"ops": ops, "results": results, "op_keys": op_keys,
                 "uniq": uniq, "now": now, "t0": t0, "wide": wide,
-                "tracer": tracer}
+                "tracer": tracer, "perf": ppc}
 
     def _index_probes(self, lsm, gen: int, want_phash: bool):
         """The run set's sidecar structures prepared for the one-call
@@ -1420,6 +1501,12 @@ class PartitionServer:
         solo handlers, with batched expired/CU accounting (one counter
         touch per flush). `page`/`base`: the (possibly cross-partition)
         build_page result and this state's first row in it."""
+        from pegasus_tpu.utils import perf_context as perf
+
+        with perf.activate(state.get("perf")):
+            return self._finish_get_batch_inner(state, page, base)
+
+    def _finish_get_batch_inner(self, state, page, base: int) -> list:
         ops = state["ops"]
         results = state["results"]
         op_keys = state["op_keys"]
@@ -1435,10 +1522,15 @@ class PartitionServer:
         hdr = header_length(dv)
         expired_total = 0
         cu_total = 0
+        looked = 0
+        survived = 0
+        bytes_out = 0
+        vsizes: list = []  # bounded value-size sample (workload stats)
 
         def lookup(key, want_value):
             """(found, data, ets) with solo-handler TTL semantics."""
-            nonlocal expired_total
+            nonlocal expired_total, looked
+            looked += 1
             ent = uniq.get(key)
             if ent is None:
                 return False, b"", 0
@@ -1490,6 +1582,10 @@ class PartitionServer:
                 if not found:
                     out.append((int(StorageStatus.NOT_FOUND), b""))
                 else:
+                    survived += 1
+                    bytes_out += len(key) + len(data)
+                    if len(vsizes) < 8:
+                        vsizes.append(len(data))
                     cu_total += cu_units(len(key) + len(data))
                     out.append((int(StorageStatus.OK), data))
             elif op == "ttl":
@@ -1497,6 +1593,7 @@ class PartitionServer:
                 if not found:
                     out.append((int(StorageStatus.NOT_FOUND), 0))
                 else:
+                    survived += 1
                     out.append((int(StorageStatus.OK),
                                 (ets - now) if ets > 0 else -1))
             elif op == "multi_get":
@@ -1507,9 +1604,13 @@ class PartitionServer:
                     found, data, _ets = lookup(key, want)
                     if not found:
                         continue
+                    survived += 1
+                    if len(vsizes) < 8:
+                        vsizes.append(len(data))
                     resp.kvs.append(KeyValue(sk, data))
                     size += len(sk) + len(data)
                 cu_total += cu_units(size)
+                bytes_out += size
                 resp.error = int(StorageStatus.OK)
                 out.append(resp)
             else:  # batch_get
@@ -1519,14 +1620,34 @@ class PartitionServer:
                     found, data, _ets = lookup(key, True)
                     if not found:
                         continue
+                    survived += 1
+                    if len(vsizes) < 8:
+                        vsizes.append(len(data))
                     resp.data.append(FullData(fk.hash_key, fk.sort_key,
                                               data))
                     size += len(key) + len(data)
                 cu_total += cu_units(size)
+                bytes_out += size
                 out.append(resp)
         if expired_total:
             self._abnormal_reads.increment(expired_total)
         self.cu.add_read_units(cu_total)
+        self.workload.note_point(len(ops), len(uniq), vsizes)
+        pc = state.get("perf")
+        if pc is not None:
+            pc.rows_evaluated += looked
+            pc.rows_survived += survived
+            pc.expired_rows += expired_total
+            pc.bytes_returned += bytes_out
+            sp = tracer.span if tracer is not None else None
+            if sp is not None:
+                # the cost vector rides the op's span: `shell trace`
+                # (and explain --from-trace) shows counts, not just
+                # durations. MERGED, not assigned — a batched carrier
+                # span collects every partition's flush vector
+                from pegasus_tpu.utils import perf_context as perf
+
+                perf.merge_span_perf(sp.tags, pc)
         elapsed_ms = (time.perf_counter() - state["t0"]) * 1000.0
         self._read_latency.set(elapsed_ms)
         if tracer is not None:
@@ -1747,16 +1868,33 @@ class PartitionServer:
 
     def on_multi_get(self, req: MultiGetRequest) -> MultiGetResponse:
         """Parity: on_multi_get (pegasus_server_impl.cpp:496)."""
+        from pegasus_tpu.utils import perf_context as perf
+
         self.hotkey_collectors["read"].capture([req.hash_key])
         t0 = time.perf_counter()
+        pc = perf.current()
+        if pc is None:
+            pc = perf.start("multi_get")
         try:
-            return self._on_multi_get(req)
+            with perf.activate(pc):
+                resp = self._on_multi_get(req)
+                if pc is not None:
+                    pc.ops += 1
+                    pc.rows_survived += len(resp.kvs)
+                    pc.placement = pc.placement or "native"
+                    from pegasus_tpu.utils.tracing import current_span
+
+                    sp = current_span()
+                    if sp is not None:
+                        perf.merge_span_perf(sp.tags, pc)
+                return resp
         finally:
             elapsed_ms = (time.perf_counter() - t0) * 1000.0
             self._read_latency.set(elapsed_ms)
-            self.slow_log.observe_simple(
-                f"multi_get.{self.app_id}.{self.pidx}", elapsed_ms,
-                {"hash_key": req.hash_key.decode(errors="replace")})
+            with perf.activate(pc):
+                self.slow_log.observe_simple(
+                    f"multi_get.{self.app_id}.{self.pidx}", elapsed_ms,
+                    {"hash_key": req.hash_key.decode(errors="replace")})
 
     def _on_multi_get(self, req: MultiGetRequest) -> MultiGetResponse:
         gate = self._read_gate()
@@ -1787,6 +1925,9 @@ class PartitionServer:
                 resp.kvs.append(KeyValue(sk, data))
                 size += len(sk) + len(data)
             self.cu.add_read(size)
+            self.workload.note_point(1, len(req.sort_keys),
+                                     [len(kv.value)
+                                      for kv in resp.kvs[:8]])
             resp.error = int(StorageStatus.OK)
             return resp
 
@@ -1821,6 +1962,11 @@ class PartitionServer:
         if req.reverse:
             resp.kvs.reverse()  # response is ascending by sort key
         self.cu.add_read(size)
+        # range-mode multi_get is the dominant ranged-read shape: its
+        # examined-vs-returned ratio feeds the table's selectivity
+        # profile like every other scan
+        self.workload.note_scan(1, limiter.iteration_count,
+                                len(records))
         resp.error = (int(StorageStatus.OK) if exhausted
                       else int(StorageStatus.INCOMPLETE))
         if (not exhausted and not req.reverse
@@ -1885,6 +2031,7 @@ class PartitionServer:
 
     def _serve_scan_batch(self, req: GetScannerRequest, start_key: bytes,
                           stop_key: bytes) -> ScanResponse:
+        from pegasus_tpu.utils import perf_context as perf
         from pegasus_tpu.utils.latency_tracer import LatencyTracer
 
         t0 = time.perf_counter()
@@ -1892,12 +2039,20 @@ class PartitionServer:
         # assemble): a slow page shows WHERE it stalled, and the stages
         # annotate the active distributed-tracing span
         tracer = LatencyTracer(f"scan.{self.app_id}.{self.pidx}")
+        pc = perf.current()
+        if pc is None:
+            pc = perf.start("scan_page")
+        tracer.perf = pc
         try:
-            return self._serve_scan_batch_inner(req, start_key, stop_key,
-                                                tracer)
+            with perf.activate(pc):
+                return self._serve_scan_batch_inner(req, start_key,
+                                                    stop_key, tracer)
         finally:
             elapsed_ms = (time.perf_counter() - t0) * 1000.0
             self._read_latency.set(elapsed_ms)
+            sp = tracer.span
+            if pc is not None and sp is not None:
+                perf.merge_span_perf(sp.tags, pc)
             self.slow_log.observe(tracer)
 
     def _serve_scan_batch_inner(self, req: GetScannerRequest,
@@ -1940,6 +2095,16 @@ class PartitionServer:
             self.cu.add_read(size)
         if tracer is not None:
             tracer.add_point("assemble")
+        pc = tracer.perf if tracer is not None else None
+        if pc is not None:
+            pc.ops += 1
+            pc.rows_evaluated += limiter.iteration_count
+            pc.rows_survived += len(records)
+            pc.keys_resolved += len(records)
+            pc.bytes_returned += sum(len(k) + len(d)
+                                     for k, d, _e in records)
+        self.workload.note_scan(1, limiter.iteration_count,
+                                len(records))
         resp.error = int(StorageStatus.OK)
         if exhausted or req.one_page:
             # one_page: the client promised not to page further — no
@@ -1982,11 +2147,24 @@ class PartitionServer:
         """Phase 1: qualify + block planning. None = caller must serve
         per-request. `flavor` = the (validate, filter_key) the caller
         already grouped by (scan_coordinator) — passing it skips the
-        per-request re-derivation."""
+        per-request re-derivation. The flush's PerfContext is created
+        (or adopted from an ambient one — shell explain) here and rides
+        the state through the mask-eval and finish phases."""
+        from pegasus_tpu.utils import perf_context as perf
+
+        pc = perf.current()
+        if pc is None:
+            pc = perf.start("scan_batch")
+        with perf.activate(pc):
+            return self._plan_scan_batch_inner(reqs, now, flavor, pc)
+
+    def _plan_scan_batch_inner(self, reqs: List[GetScannerRequest],
+                               now, flavor, ppc):
         from pegasus_tpu.utils.latency_tracer import LatencyTracer
 
         t0 = time.perf_counter()
         tracer = LatencyTracer(self._scan_log_key)
+        tracer.perf = ppc
         gate = self._read_gate()
         if gate:
             out = []
@@ -2120,9 +2298,14 @@ class PartitionServer:
             # — serve per-request instead (safe read order)
             return None
         tracer.add_point("plan")
+        if ppc is not None:
+            ppc.ops += len(reqs)
+            ppc.blocks_planned += len(unique)
+            ppc.runs_considered += len(runs)
         return {"reqs": reqs, "req_plans": req_plans, "unique": unique,
                 "validate": validate, "now": now, "overlay": overlay,
-                "filter_key": filter_key, "t0": t0, "tracer": tracer}
+                "filter_key": filter_key, "t0": t0, "tracer": tracer,
+                "perf": ppc}
 
     def planned_misses(self, state) -> "OrderedDict[tuple, object]":
         """Unique planned blocks whose STATIC masks are NOT cached (the
@@ -2168,6 +2351,11 @@ class PartitionServer:
         for ckey, keep in encoded_resolved:
             self.store_mask_for(ckey, validate, filter_key, keep,
                                 computed_pv=pv)
+        pc = state.get("perf")
+        if pc is not None and encoded_resolved:
+            # encoded-domain host probes (no decode, no device): the
+            # "numpy" compute class; a later device wave overwrites
+            pc.placement = "numpy"
         state["cached_keep"] = keep_masks
         return misses
 
@@ -2306,13 +2494,19 @@ class PartitionServer:
         return out
 
     def eval_planned_masks(self, state):
-        """Phase 2 (solo-node form): evaluate this partition's misses."""
-        misses = self.planned_misses(state)
-        keep_masks = state["cached_keep"]
-        for ckey, keep in self._eval_blocks_stacked(
-                misses, state["filter_key"], state["validate"]):
-            keep_masks[ckey] = keep
-            self.store_mask(state, ckey, keep)
+        """Phase 2 (solo-node form): evaluate this partition's misses.
+        Runs under the state's PerfContext so the stacked device eval
+        records its placement verdict + predicted/measured kernel time
+        on the flush's cost vector."""
+        from pegasus_tpu.utils import perf_context as perf
+
+        with perf.activate(state.get("perf")):
+            misses = self.planned_misses(state)
+            keep_masks = state["cached_keep"]
+            for ckey, keep in self._eval_blocks_stacked(
+                    misses, state["filter_key"], state["validate"]):
+                keep_masks[ckey] = keep
+                self.store_mask(state, ckey, keep)
         tracer = state.get("tracer")
         if tracer is not None:
             tracer.add_point("block_probe")
@@ -2452,6 +2646,8 @@ class PartitionServer:
         pec = self._plan_expired_cache[1]
         total_expired = 0
         total_read_cu = 0
+        total_rows = 0
+        total_bytes = 0
 
         out = []
         for (req, start_key, stop_key, want, plan, _geom, _nat, _pf), \
@@ -2604,6 +2800,8 @@ class PartitionServer:
                 resume_key = frontier
                 exhausted = False
             total_expired += req_expired
+            total_rows += len(kvs)
+            total_bytes += size
             # per-request CU floor preserved: units() per request,
             # summed, one counter touch per batch
             total_read_cu += cu_units(size)
@@ -2622,6 +2820,24 @@ class PartitionServer:
         if total_expired:
             self._abnormal_reads.increment(total_expired)
         self.cu.add_read_units(total_read_cu)
+        # mask-evaluated rows = every row of every unique planned block
+        # (the kernels see whole blocks); survivors vs evaluated is the
+        # table's scan SELECTIVITY — what a server-side pushdown saves
+        rows_eval = sum(b.count for _r, _bm, b in unique.values())
+        self.workload.note_scan(len(reqs), rows_eval, total_rows)
+        pc = state.get("perf")
+        if pc is not None:
+            pc.rows_evaluated += rows_eval
+            pc.rows_survived += total_rows
+            pc.expired_rows += total_expired
+            pc.bytes_returned += total_bytes
+            pc.keys_resolved += total_rows
+            sp = (state["tracer"].span
+                  if state.get("tracer") is not None else None)
+            if sp is not None:
+                from pegasus_tpu.utils import perf_context as perf
+
+                perf.merge_span_perf(sp.tags, pc)
         elapsed_ms = (time.perf_counter() - t0) * 1000.0
         self._read_latency.set(elapsed_ms)
         tracer = state.get("tracer")

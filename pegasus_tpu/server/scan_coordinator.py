@@ -155,7 +155,7 @@ def scan_multi(servers_and_reqs: List[Tuple[object, list]],
 
 
 def stacked_block_eval(blocks, validate: bool, pv: int,
-                       filter_key=None):
+                       filter_key=None, perf_ctxs=()):
     """The ONE stacking implementation both the per-partition and the
     cross-partition paths use. `blocks`: [(tag, dev_block, pidx)] —
     yields (tag, static_keep).
@@ -167,10 +167,25 @@ def stacked_block_eval(blocks, validate: bool, pv: int,
     starting all copies before the first wait overlaps compute and
     transfer across chunks instead of serializing round-trips. Masks
     come back bit-packed (8x smaller on the link) and unpack host-side.
+
+    Being the one kernel dispatch site, this is also where the
+    placement cost model is AUDITED: the wave's wall time is compared
+    against ops/placement's prediction and fed to the workload
+    profiler's cost-model drift gauge (server/workload.DRIFT), and the
+    ambient PerfContext (when an op is being tracked) records the
+    verdict + predicted/measured kernel ms.
     """
+    import time as _time
+
+    blocks = list(blocks)
+    if not blocks:
+        return
+    t0 = _time.perf_counter()
     submitted = list(stacked_block_submit(blocks, validate, pv,
                                           filter_key))
     fetched = _fetch_wave([o[2] for o in submitted])
+    measured_s = _time.perf_counter() - t0
+    _audit_kernel_wave(blocks, filter_key, measured_s, perf_ctxs)
     for (group, cap, _dev), packed in zip(submitted, fetched):
         keep_all = unpack_masks(packed, len(group) * cap)
         if len(group) == 1:
@@ -178,6 +193,42 @@ def stacked_block_eval(blocks, validate: bool, pv: int,
             continue
         for i, (tag, _d, _p) in enumerate(group):
             yield tag, keep_all[i * cap:(i + 1) * cap]
+
+
+def _audit_kernel_wave(blocks, filter_key, measured_s: float,
+                       perf_ctxs=()) -> None:
+    """One drift sample per evaluated wave: predicted (cost model) vs
+    measured (wall) kernel time, recorded process-wide and on the
+    participating ops' PerfContexts (the ambient one, plus every
+    coordinated state's context passed in `perf_ctxs` — the
+    cross-partition path has no single ambient op). Filter-free masks
+    are the compute-trivial "ttl" class; pattern-matching masks are
+    "rules"."""
+    from pegasus_tpu.ops.placement import (
+        placement_verdict,
+        predict_kernel_seconds,
+    )
+    from pegasus_tpu.server.workload import DRIFT
+    from pegasus_tpu.utils import perf_context as perf
+
+    cls = ("ttl" if filter_key is None
+           or (filter_key[0] == FT_NO_FILTER
+               and filter_key[2] == FT_NO_FILTER) else "rules")
+    batch_bytes = sum(int(dev.keys.size) + 9 * int(dev.expire_ts.size)
+                      for _t, dev, _p in blocks)
+    predicted_s = predict_kernel_seconds(cls, batch_bytes)
+    DRIFT.note(cls, predicted_s, measured_s)
+    pcs = {id(pc): pc for pc in perf_ctxs if pc is not None}
+    amb = perf.current()
+    if amb is not None:
+        pcs[id(amb)] = amb
+    verdict = placement_verdict(cls) if pcs else ""
+    for pc in pcs.values():
+        # every participating op WAITED this wave, so each context
+        # carries the wave's full wall time (not an apportioned share)
+        pc.placement = verdict
+        pc.predicted_kernel_ms += predicted_s * 1000.0
+        pc.measured_kernel_ms += measured_s * 1000.0
 
 
 def stacked_block_submit(blocks, validate: bool, pv: int,
@@ -265,13 +316,30 @@ def _fetch_wave(arrays: list) -> list:
 def _eval_cross_partition(entries, validate: bool,
                           pv: int, filter_key=None) -> None:
     """Stack blocks from MANY partitions; each record carries its owning
-    partition index so one program validates all."""
+    partition index so one program validates all. Every participating
+    state's PerfContext gets the wave's placement/kernel audit."""
     blocks = [((server, state, ckey), dev, server.pidx)
               for server, state, ckey, dev in entries]
+    pcs = _state_perf_ctxs(state for _srv, state, _ck, _d in entries)
     for (server, state, ckey), keep in stacked_block_eval(
-            blocks, validate, pv, filter_key=filter_key):
+            blocks, validate, pv, filter_key=filter_key,
+            perf_ctxs=pcs):
         state["cached_keep"][ckey] = keep
         server.store_mask(state, ckey, keep)
+
+
+def _state_perf_ctxs(states) -> list:
+    """Distinct PerfContexts of the coordinated states (the prefresher
+    passes placeholder states with no dict surface — skip those)."""
+    out = {}
+    for state in states:
+        getter = getattr(state, "get", None)
+        if getter is None:
+            continue
+        pc = getter("perf")
+        if pc is not None:
+            out[id(pc)] = pc
+    return list(out.values())
 
 
 def _flavor_specs(fkeys):
@@ -314,12 +382,25 @@ def _eval_cross_partition_multi(flavors: dict, validate: bool,
 
     blocks = [((server, ckey), dev, server.pidx)
               for server, ckey, dev in union.values()]
+    import time as _time
+
+    t0 = _time.perf_counter()
     submitted = []
     for group, cap, stacked, pidx in _stacked_chunks(blocks):
         packed = multi_static_block_predicate_submit(
             stacked, specs, validate, pidx, pv)
         submitted.append((group, cap, packed))
     fetched = _fetch_wave([p for _g, _c, p in submitted])
+    # the multi-flavor wave audits like the single-flavor one: any
+    # filtered flavor makes it the "rules" class (its compute bound)
+    audit_fkey = next(
+        (fk for fk in fkeys
+         if fk[0] != FT_NO_FILTER or fk[2] != FT_NO_FILTER),
+        fkeys[0])
+    _audit_kernel_wave(
+        blocks, audit_fkey, _time.perf_counter() - t0,
+        _state_perf_ctxs(st for states in wanted.values()
+                         for st in states))
     for (group, cap, _p), packed in zip(submitted, fetched):
         masks = unpack_masks(packed, len(group) * cap)     # [K, S*cap]
         for ki, fkey in enumerate(fkeys):

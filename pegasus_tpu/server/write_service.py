@@ -128,6 +128,11 @@ class WriteService:
         self.engine = engine
         self.data_version = data_version
         self.cluster_id = cluster_id
+        # the owning partition's WorkloadStats (set by PartitionServer):
+        # apply_items is the single funnel every write shape routes
+        # through — standalone AND replicated — so the op-mix/batch-size
+        # profile feeds here exactly once per applied mutation
+        self.workload = None
 
     # -- helpers --------------------------------------------------------
 
@@ -382,6 +387,10 @@ class WriteService:
         no-op write that carries the decree watermark). `wal_flush=False`
         defers the engine-WAL flush into the caller's group-commit
         window."""
+        wl = self.workload
+        if wl is not None and items:
+            wl.note_write(1, len(items),
+                          [len(it.value) for it in items[:8]])
         self.engine.write_batch(items, decree, wal_flush=wal_flush)
 
     # -- fused convenience (standalone mode) ----------------------------

@@ -241,14 +241,23 @@ class LSMStore:
         non-batched client path never silently regresses to the
         bisect. Steady-state stores (empty L0, filterless runs) skip
         the hash entirely."""
+        from pegasus_tpu.utils.perf_context import current as _perf_current
+
+        pc = _perf_current()  # solo-path cost vector (None = untracked)
         hit = self.memtable.get(key)
         if hit is not None:
+            if pc is not None:
+                pc.overlay_hits += 1
             value, ets = hit
             return None if value is TOMBSTONE else (value, ets)
         from pegasus_tpu.storage.phash import phash_probe_enabled
 
         bloom_on = bloom_probe_enabled()
         phash_on = phash_probe_enabled()
+        if pc is not None:
+            # same meaning as the batched planner's field: the sidecar
+            # candidacy matrix width this key was answered against
+            pc.runs_considered += len(self.l0) + len(self.l1_runs)
         key_hash: Optional[int] = None  # computed at most once
 
         def lookup(table):

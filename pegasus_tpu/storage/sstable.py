@@ -136,6 +136,7 @@ _BLOCK_EVICT_BYTES = _STORAGE_METRICS.relaxed_counter(
     "block_cache_evict_bytes")
 
 from pegasus_tpu.utils.tracing import annotate as _trace_annotate  # noqa: E402
+from pegasus_tpu.utils.perf_context import current as _perf_current  # noqa: E402
 
 MAGIC = b"PGT2"
 MAGIC_V1 = b"PGT1"  # pre-hash_lo format, still readable
@@ -764,6 +765,9 @@ class SSTable:
                else bf.may_contain(key))
         if not hit:
             _BLOOM_USEFUL.increment()
+            pc = _perf_current()
+            if pc is not None:
+                pc.bloom_pruned += 1
         return hit
 
     def _read_raw_block(self, idx: int):
@@ -807,6 +811,7 @@ class SSTable:
         return o2i[bm.offset]
 
     def read_block(self, idx: int) -> Block:
+        pc = _perf_current()  # the op's PerfContext (None = untracked)
         hit = self._cache.get(idx)
         if hit is not None:
             # true LRU: a hit refreshes recency (the old FIFO eviction
@@ -819,8 +824,13 @@ class SSTable:
                 # compaction threads share run caches); the decoded
                 # block in hand stays valid
             _BLOCK_CACHE_HIT.increment()
+            if pc is not None:
+                pc.block_cache_hit += 1
             return hit[0]
         _BLOCK_CACHE_MISS.increment()
+        if pc is not None:
+            pc.blocks_decoded += 1
+            pc.bytes_read += self.blocks[idx].size
         raw, bm = self._read_raw_block(idx)
         if self.codec is not None:
             enc = EncodedBlock.parse(raw)
@@ -869,6 +879,12 @@ class SSTable:
             lazy = n * (width + 64)
             nbytes = (512 + lazy if self._mv is not None
                       else bm.size + 512 + lazy)
+        if pc is not None:
+            # materialized bytes after the codec: the decoded size for
+            # compressed blocks, the on-disk (zero-copy view) size for
+            # raw ones — against bytes_read this is the decode ratio
+            pc.bytes_decoded += (nbytes if self.codec is not None
+                                 else bm.size)
         budget = (self._cache_budget if self._cache_budget is not None
                   else block_cache_budget())
         evicted = 0
@@ -967,21 +983,28 @@ class SSTable:
         row compare rejects the rare fingerprint collision."""
         ph = self.phash
         if ph is not None and phash_probe_enabled():
+            pc = _perf_current()
             h = key_hash if key_hash is not None else crc64(key)
             loc = ph.lookup_hash(h)
             if loc < 0:
                 PHASH_USEFUL.increment()
+                if pc is not None:
+                    pc.phash_pruned += 1
                 return None
             bi, slot = ph.unpack(loc)
             if bi < len(self.blocks) and slot < self.blocks[bi].count:
                 blk = self.read_block(bi)
                 if blk.key_at(slot) == key:
                     PHASH_HIT.increment()
+                    if pc is not None:
+                        pc.phash_located += 1
                     if blk.is_tombstone(slot):
                         return (None, 0)
                     return (blk.value_at(slot),
                             int(blk.expire_ts[slot]))
                 PHASH_USEFUL.increment()
+                if pc is not None:
+                    pc.phash_pruned += 1
                 return None  # fp collision: definitively absent
             # out-of-range loc (corrupt index): serve via the bisect
             # below; the scrub structural pass flags the file

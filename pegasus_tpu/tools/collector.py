@@ -120,6 +120,7 @@ class InfoCollector:
         storage_rows = self.collect_storage()
         health_rows = self.collect_health()
         alert_rows = self.collect_alerts()
+        workload_rows = self.collect_workload()
         if per_table:
             if self._stat_client is None:
                 self._stat_client = self.client_factory(STAT_TABLE)
@@ -142,7 +143,90 @@ class InfoCollector:
             if alert_rows:
                 self._stat_client.set(b"_alerts", ts,
                                       json.dumps(alert_rows).encode())
+            if workload_rows:
+                self._stat_client.set(
+                    b"_workload", ts,
+                    json.dumps(workload_rows).encode())
         return per_table
+
+    def collect_workload(self) -> Dict[str, dict]:
+        """Per-table workload shape rows off the nodes' `workload`
+        metric entities (op mix rates ride the flight recorder; this
+        is the cumulative roll-up), plus the node cost-model drift
+        ratio — one `_workload` stat row per round, so a soak can
+        assert shape assumptions from table history alone.
+
+        Entities DEDUPE by id with per-metric max across nodes before
+        folding: every replica of a partition carries the same
+        `app.pidx` workload entity (secondaries tick write applies
+        too, and in-process sims share one registry outright), so a
+        naive per-node sum would multiply op counts by ~replica_count
+        and report replicas as partitions — disagreeing with the
+        primary-only `shell workload` meta fold by 3-8x."""
+        # (table, entity_id) -> per-metric maxima
+        per_part: Dict[tuple, dict] = {}
+        drift = 0.0
+        for node in self.nodes:
+            snapshot = self._command(node, "metrics", ["workload"])
+            if not snapshot:
+                continue
+            for entity in snapshot:
+                metrics = entity.get("metrics", {})
+                if entity.get("id") == "node":
+                    drift = max(drift, float(
+                        metrics.get("cost_model_drift_ratio",
+                                    {}).get("value", 0.0)))
+                    continue
+                table = entity.get("attributes", {}).get("table")
+                if table is None:
+                    continue
+                row = per_part.setdefault(
+                    (table, entity.get("id")), {
+                        "read_ops": 0, "scan_ops": 0, "write_ops": 0,
+                        "read_batch_p99": 0.0, "write_batch_p99": 0.0,
+                        "value_bytes_p99": 0.0,
+                        "scan_selectivity_p50": 0.0, "hot_share": 0.0})
+                for key, metric in (("read_ops", "workload_read_ops"),
+                                    ("scan_ops", "workload_scan_ops"),
+                                    ("write_ops",
+                                     "workload_write_ops")):
+                    row[key] = max(row[key], int(
+                        metrics.get(metric, {}).get("value", 0)))
+                for key, metric, pkey in (
+                        ("read_batch_p99", "workload_read_batch",
+                         "p99"),
+                        ("write_batch_p99", "workload_write_batch",
+                         "p99"),
+                        ("value_bytes_p99", "workload_value_bytes",
+                         "p99"),
+                        ("scan_selectivity_p50",
+                         "workload_scan_selectivity", "p50")):
+                    snap = metrics.get(metric)
+                    if snap:
+                        row[key] = max(row[key],
+                                       float(snap.get(pkey, 0.0)))
+                row["hot_share"] = max(row["hot_share"], float(
+                    metrics.get("workload_hot_share",
+                                {}).get("value", 0.0)))
+        # ONE fold rule: the per-table rollup is workload.fold_summaries
+        # — the same function meta's `shell workload` uses — so the
+        # `_workload` stat row and the shell can never disagree on how
+        # partitions aggregate
+        from pegasus_tpu.server.workload import fold_summaries
+
+        by_table: Dict[str, list] = {}
+        for (table, _eid), row in sorted(per_part.items()):
+            by_table.setdefault(table, []).append(row)
+        tables: Dict[str, dict] = {
+            table: fold_summaries(rows)
+            for table, rows in by_table.items()}
+        if not tables:
+            return {}
+        # uniformly-typed persisted shape: table rows under "tables",
+        # the node drift scalar beside them (a sentinel key mixed into
+        # the table dict made `for t, row in rows.items()` consumers
+        # trip over a float)
+        return {"tables": tables, "drift_ratio": drift}
 
     def collect_health(self) -> Dict[str, dict]:
         """Per-node watchdog verdict off the `health.status` verb:

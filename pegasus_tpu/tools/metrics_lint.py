@@ -36,6 +36,17 @@ _REG_RE = re.compile(
     r"(?:\n\s*)?([\"'])([^\"'\n]+)\2",
     re.MULTILINE)
 
+# PerfContext field registrations (utils/perf_context.py perf_field):
+# context fields ride the same rules — a field named like an existing
+# metric of a DIFFERENT kind, or a name the sanitizer would rewrite,
+# is the same cross-file drift (slow-log perf dicts and explain
+# reports render these names next to real metrics)
+_PERF_RE = re.compile(
+    r"\bperf_field\(\s*(?:\n\s*)?([\"'])([^\"'\n]+)\1\s*"
+    r"(?:,\s*(?:\n\s*)?(?:kind\s*=\s*)?"
+    r"([\"'])(counter|gauge|percentile)\3)?",
+    re.MULTILINE)
+
 _KIND = {"counter": "counter", "relaxed_counter": "counter",
          "volatile_counter": "counter", "gauge": "gauge",
          "percentile": "percentile"}
@@ -49,6 +60,9 @@ def scan_file(path: str) -> List[Tuple[str, str, int]]:
     for m in _REG_RE.finditer(text):
         line = text.count("\n", 0, m.start()) + 1
         out.append((m.group(3), _KIND[m.group(1)], line))
+    for m in _PERF_RE.finditer(text):
+        line = text.count("\n", 0, m.start()) + 1
+        out.append((m.group(2), m.group(4) or "counter", line))
     return out
 
 

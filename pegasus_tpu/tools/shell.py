@@ -28,6 +28,13 @@ verbs, parity: the linenoise REPL + `use`). Command families:
                scrub, hot_partitions, compact_sched
   tracing    : trace <id> (fan out + stitch one cross-node span tree),
                traces --slow (tail-kept slow trace roots, one meta call)
+  query-perf : explain <table> <op-spec> (execute one captured op,
+               render the plan tree with actual per-stage counters),
+               explain --from-trace <id> (same report off a kept slow
+               trace's span perf tags), workload <table> (op mix /
+               batch + value sizes / scan selectivity / hot share),
+               placement [workload] (offload verdict + cost-model
+               drift audit)
   offline    : sst_dump, mlog_dump, local_get, rdb_key_str2hex,
                rdb_key_hex2str, rdb_value_hex2str
 
@@ -325,6 +332,40 @@ def main(argv=None) -> int:
                    help="lookback window, e.g. 90s / 5m / 1h")
     p.add_argument("--json", action="store_true",
                    help="print the raw bundle instead of the rendering")
+    # query-level observability: one-command EXPLAIN + workload shapes
+    p = sub.add_parser(
+        "explain",
+        help="execute ONE captured op and render its plan tree with "
+             "actual per-stage counters and timings (PerfContext), or "
+             "--from-trace to rebuild the report from a kept slow "
+             "trace's span perf tags")
+    p.add_argument("table", nargs="?", default=None)
+    p.add_argument("spec", nargs="*",
+                   help="op spec: get <hash_key> [sort_key] | "
+                        "multi_get <hash_key> <sk> [sk...] | "
+                        "scan [hash_key] [batch_size]")
+    p.add_argument("--from-trace", dest="from_trace", default=None,
+                   help="rebuild the explain report from this trace id "
+                        "instead of executing an op")
+    p.add_argument("--json", action="store_true")
+    p = sub.add_parser(
+        "workload",
+        help="per-table workload shape profile: op mix, batch/value "
+             "size distributions, scan selectivity, hot-hashkey share "
+             "(one meta call off the config-sync digests)")
+    p.add_argument("table")
+    p.add_argument("--json", action="store_true")
+    p = sub.add_parser(
+        "placement",
+        help="the offload pays/doesn't-pay verdict "
+             "(ops/placement.offload_breakdown) + the live cost-model "
+             "drift audit, per node")
+    p.add_argument("workload", nargs="?", default="rules",
+                   help="workload class: ttl|probe|rules|match")
+    p.add_argument("--bytes", type=int, default=1 << 20,
+                   help="batch size for the breakdown estimate")
+    p.add_argument("--node", default=None,
+                   help="one node (wire mode); default = first node")
     # cluster/node admin breadth (parity: shell admin commands)
     sub.add_parser("cluster_info")
     p = sub.add_parser("server_info")
@@ -501,7 +542,7 @@ _TABLE_VERBS = frozenset({
     "multi_get_sortkeys", "hash_scan", "full_scan", "count_data",
     "clear_data", "hash", "set_app_envs", "get_app_envs",
     "manual_compact", "partition_split", "flush", "app_stat",
-    "app_disk", "scrub", "get_replica_count",
+    "app_disk", "scrub", "get_replica_count", "explain", "workload",
     "enable_atomic_idempotent",
     "disable_atomic_idempotent", "get_atomic_idempotent",
 })
@@ -1659,6 +1700,140 @@ def _dispatch(args, box, out) -> int:
             print(json.dumps(bundle, indent=1), file=out)
         else:
             print(render_timeline(bundle), file=out)
+    elif args.cmd == "explain":
+        from pegasus_tpu.server import explain as explain_mod
+
+        if args.from_trace:
+            # rebuild the report from a kept slow trace's span perf
+            # tags: local rings + (wire mode) every node's trace-dump
+            from pegasus_tpu.utils import tracing
+
+            spans = list(tracing.dump_all(args.from_trace))
+            if isinstance(box, _ClusterBox):
+                for n in box.admin.call("list_nodes"):
+                    res = box.remote_command(n, "trace-dump",
+                                             [args.from_trace])
+                    if res:
+                        spans.extend(res)
+            report = explain_mod.from_trace(spans, args.from_trace)
+            if args.json:
+                print(json.dumps(report, indent=1, default=str),
+                      file=out)
+            else:
+                print(explain_mod.render_trace_report(report),
+                      file=out)
+        else:
+            if args.table is None or not args.spec:
+                raise ValueError(
+                    "usage: explain <table> <op-spec>  |  "
+                    "explain --from-trace <trace_id>")
+            spec = explain_mod.spec_from_words(args.spec)
+            if isinstance(box, _ClusterBox):
+                from pegasus_tpu.base.key_schema import key_hash_parts
+
+                ph = key_hash_parts(
+                    spec.get("hash_key", "").encode(), b"")
+                # one meta call resolves the hosting primary; the
+                # probe loop below is only the fallback for a config
+                # racing the resolution
+                info = box.admin.call("partition_primary",
+                                      app_name=args.table,
+                                      partition_hash=ph)
+                spec["app_id"] = info["app_id"]
+                nodes = box.admin.call("list_nodes")
+                if info.get("primary"):
+                    nodes = [info["primary"]] + [
+                        n for n in nodes if n != info["primary"]]
+                report = None
+                last_err = None
+                for n in nodes:
+                    # the hosting primary answers; others raise
+                    try:
+                        res = box.remote_command(n, "perf.explain",
+                                                 [json.dumps(spec)])
+                    except ValueError as e:
+                        last_err = str(e)
+                        continue
+                    if isinstance(res, dict):
+                        report = dict(res, node=n)
+                        break
+                if report is None:
+                    raise ValueError(
+                        f"no node could explain: {last_err}")
+            else:
+                t = box.open_table(args.table)
+                op, op_args, ph = explain_mod.op_from_spec(spec)
+                if ph is not None:
+                    srv = t.partitions[ph % t.partition_count]
+                else:
+                    srv = t.partitions[0]
+                report = explain_mod.explain_op(srv, op, op_args,
+                                                partition_hash=ph)
+            if args.json:
+                print(json.dumps(report, indent=1, default=str),
+                      file=out)
+            else:
+                print(explain_mod.render_report(report), file=out)
+    elif args.cmd == "workload":
+        if isinstance(box, _ClusterBox):
+            # one meta call off the config-sync workload digests
+            status = box.admin.call("workload", app_name=args.table)
+        else:
+            from pegasus_tpu.server.workload import (
+                DRIFT,
+                fold_summaries,
+            )
+
+            t = box.open_table(args.table)
+            rows = [dict(p_.workload.summary(),
+                         gpid=[p_.app_id, p_.pidx])
+                    for p_ in t.all_partitions()]
+            status = {args.table: {"partitions": rows,
+                                   "table": fold_summaries(rows)},
+                      "drift": DRIFT.status()}
+        if args.json:
+            print(json.dumps(status, indent=1), file=out)
+        else:
+            for name, tbl in sorted(status.items()):
+                if name == "drift":
+                    print(f"drift: {json.dumps(tbl)}", file=out)
+                    continue
+                fold = tbl.get("table", {})
+                print(f"table {name}: "
+                      f"{fold.get('partitions', 0)} partitions  "
+                      f"reads={fold.get('read_ops', 0)} "
+                      f"scans={fold.get('scan_ops', 0)} "
+                      f"writes={fold.get('write_ops', 0)}  "
+                      f"selectivity_p50="
+                      f"{fold.get('scan_selectivity_p50', 0.0)}%  "
+                      f"hot_share={fold.get('hot_share', 0.0)}",
+                      file=out)
+                for row in tbl.get("partitions", []):
+                    print(f"  {row.get('gpid')} "
+                          f"r/s/w={row.get('read_ops', 0)}/"
+                          f"{row.get('scan_ops', 0)}/"
+                          f"{row.get('write_ops', 0)} "
+                          f"read_batch_p99={row.get('read_batch_p99')} "
+                          f"value_p99={row.get('value_bytes_p99')}",
+                          file=out)
+    elif args.cmd == "placement":
+        if isinstance(box, _ClusterBox):
+            nodes = box.admin.call("list_nodes")
+            targets = [args.node] if args.node else nodes[:1]
+            for n in targets:
+                print(json.dumps(
+                    {n: box.remote_command(
+                        n, "placement",
+                        [args.workload, str(args.bytes)])},
+                    indent=1), file=out)
+        else:
+            from pegasus_tpu.ops.placement import offload_breakdown
+            from pegasus_tpu.server.workload import DRIFT
+
+            print(json.dumps(
+                {"breakdown": offload_breakdown(args.workload,
+                                                args.bytes),
+                 "drift": DRIFT.status()}, indent=1), file=out)
     elif args.cmd == "nodes":
         for n in box.admin.call("list_nodes"):
             print(n, file=out)

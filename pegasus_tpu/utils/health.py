@@ -151,6 +151,16 @@ def default_rules() -> List[HealthRule]:
                    severity=SEV_DEGRADED,
                    description="compaction write stage stalled > 0.5s "
                    "per wall second: background IO is wedged"),
+        HealthRule("cost_model_drift", "workload",
+                   "cost_model_drift_ratio", kind="threshold",
+                   threshold=16.0, hold=2, severity=SEV_DEGRADED,
+                   description="placement cost model mis-calibrated: "
+                   "measured kernel time sustained > 16x the model's "
+                   "prediction (rolling median, compile-warmup "
+                   "discarded, stale classes age out) — device-vs-host "
+                   "routing is deciding on bad estimates "
+                   "(server/workload.DRIFT audits every stacked "
+                   "mask-eval wave)"),
     ]
 
 

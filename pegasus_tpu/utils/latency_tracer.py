@@ -23,13 +23,17 @@ class LatencyTracer:
     annotation — the per-process stage chain and the cross-process span
     tree share one instrumentation layer (utils/tracing.py)."""
 
-    __slots__ = ("name", "points", "_clock", "span")
+    __slots__ = ("name", "points", "_clock", "span", "perf")
 
     def __init__(self, name: str, clock=time.perf_counter,
                  span=None) -> None:
         self.name = name
         self._clock = clock
         self.span = span if span is not None else _current_span()
+        # the op's PerfContext cost vector (utils/perf_context.py),
+        # bound by the paths that collect one: the slow log attaches it
+        # to the entry so a slow dump shows counts, not just durations
+        self.perf = None
         self.points: List[Tuple[str, float]] = [("start", clock())]
 
     def add_point(self, stage: str) -> None:
@@ -76,18 +80,31 @@ class SlowQueryLog:
         report = tracer.report()
         if extra:
             report.update(extra)
+        if tracer.perf is not None:
+            # the op's cost vector rides the slow entry: WHY it cost
+            # what it cost, next to the stage chain's WHERE
+            report["perf"] = tracer.perf.to_dict()
         with self._lock:
             self._ring.append(report)
         return True
 
     def observe_simple(self, name: str, elapsed_ms: float,
                        extra: Optional[Dict[str, Any]] = None) -> bool:
-        """For paths that only time start->end (reads)."""
+        """For paths that only time start->end (the solo-read
+        fallback). The AMBIENT PerfContext (when the solo path
+        collected one) attaches here so solo and batched slow entries
+        stay field-comparable."""
         if elapsed_ms < self.threshold_ms:
             return False
         report = {"name": name, "total_ms": round(elapsed_ms, 3)}
         if extra:
             report.update(extra)
+        if "perf" not in report:
+            from pegasus_tpu.utils.perf_context import current as _pc
+
+            pc = _pc()
+            if pc is not None:
+                report["perf"] = pc.to_dict()
         with self._lock:
             self._ring.append(report)
         return True

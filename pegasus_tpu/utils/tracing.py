@@ -598,6 +598,19 @@ def render(tree: Optional[dict], width: int = 48) -> str:
             f"{'  ' * depth}{n['name']} @{n['node']}  "
             f"{n['dur_ms']:.3f}ms (self {n['self_ms']:.3f}ms){skew}"
             f"{ann}")
+        pc = (n.get("tags") or {}).get("perf")
+        if pc:
+            # the op's PerfContext rode the span: counts, not just
+            # durations (only the fields that moved; an all-zero
+            # vector — a gate-rejected flush — prints nothing)
+            moved = " ".join(
+                f"{k}={v}" for k, v in pc.items()
+                if k not in ("op", "placement")
+                and v not in (0, 0.0, None))
+            place = (f" [{pc['placement']}]"
+                     if pc.get("placement") else "")
+            if moved or place:
+                lines.append(f"{'  ' * depth}  perf{place}: {moved}")
         lines.append(f"{'  ' * depth}|{bar:<{width}}|")
         for c in n["children"]:
             emit(c, depth + 1)

@@ -1,0 +1,86 @@
+"""bench_report: the perf-trajectory table folded from BENCH_r*.json
+artifacts (phase × round → headline metric, ratio vs the prior round
+that measured the same metric)."""
+
+import json
+import os
+
+from pegasus_tpu.tools.bench_report import (
+    headline,
+    load_rounds,
+    main,
+    render,
+    trajectory,
+)
+
+
+def _write_round(d, n, phases):
+    with open(os.path.join(d, f"BENCH_r{n:02d}.json"), "w") as f:
+        json.dump({"phases": phases}, f)
+
+
+def _fixture(tmp_path):
+    d = str(tmp_path)
+    _write_round(d, 5, {
+        "scan": {"accel_qps": 10000.0, "ops": 5000},
+        "point_get": {"accel_qps": 30000.0},
+    })
+    _write_round(d, 7, {
+        "scan": {"accel_qps": 12000.0, "ops": 5000},
+        "write_put_batch": {"batched_qps": 7900.0, "solo_qps": 2800.0},
+    })
+    _write_round(d, 8, {
+        # headline RENAMED: the ratio chain restarts instead of
+        # comparing a filtered number against an unfiltered one
+        "point_get": {"filtered_qps": 18000.0, "unfiltered_qps": 8000.0},
+        "scan": {"accel_qps": 6000.0},
+    })
+    return d
+
+
+def test_headline_preference_order():
+    assert headline({"ops": 5, "accel_qps": 123.0}) == ("accel_qps",
+                                                        123.0)
+    assert headline({"filtered_qps": 2.0, "accel_qps": 1.0})[0] == \
+        "filtered_qps"
+    assert headline({"meets_2x": True}) is None  # bools never qualify
+    assert headline({"records": 10})[0] == "records"  # fallback
+
+
+def test_trajectory_rounds_ratios_and_rename(tmp_path):
+    d = _fixture(tmp_path)
+    rep = trajectory(d)
+    assert rep["rounds"] == [5, 7, 8]
+    scan = rep["phases"]["scan"]
+    assert [r["round"] for r in scan] == [5, 7, 8]
+    assert scan[0]["ratio"] is None
+    assert scan[1]["ratio"] == 1.2
+    assert scan[2]["ratio"] == 0.5
+    pg = rep["phases"]["point_get"]
+    assert pg[0]["metric"] == "accel_qps" and pg[0]["ratio"] is None
+    # renamed headline: no cross-metric ratio
+    assert pg[1]["metric"] == "filtered_qps" and pg[1]["ratio"] is None
+    # single-round phase still appears
+    assert rep["phases"]["write_put_batch"][0]["value"] == 7900.0
+
+
+def test_torn_artifact_is_skipped_not_fatal(tmp_path):
+    d = _fixture(tmp_path)
+    with open(os.path.join(d, "BENCH_r09.json"), "w") as f:
+        f.write("{torn")
+    rounds = load_rounds(d)
+    assert [r for r, _p in rounds] == [5, 7, 8]
+
+
+def test_render_and_main(tmp_path, capsys):
+    d = _fixture(tmp_path)
+    text = render(trajectory(d))
+    assert "scan:" in text and "(1.200x)" in text
+    assert main(["--dir", d]) == 0
+    assert "perf trajectory" in capsys.readouterr().out
+    assert main([str(tmp_path / "empty")]) == 1 \
+        if os.path.isdir(str(tmp_path / "empty")) else True
+    # real repo artifacts parse too (the tool's actual deployment)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    rep = trajectory(repo)
+    assert rep["phases"], "repo BENCH_r*.json artifacts unreadable"

@@ -29,6 +29,39 @@ def test_scan_finds_known_registrations():
     # the regex rotted and the gate is vacuous
 
 
+def test_scan_sees_perf_context_fields():
+    """PerfContext field registrations (utils/perf_context.perf_field)
+    ride the same drift gate: the scan finds them with their kinds, so
+    a context field named like a metric of another kind fails lint."""
+    found = scan_tree(_PKG_ROOT)
+    assert "bloom_pruned" in found
+    assert set(found["bloom_pruned"]) == {"counter"}
+    assert "queue_wait_ms" in found
+    assert set(found["queue_wait_ms"]) == {"gauge"}
+    # shared names must agree in kind across BOTH registration styles
+    # (block_cache_hit is a storage counter AND a perf field)
+    assert set(found["block_cache_hit"]) == {"counter"}
+    assert len(found["block_cache_hit"]["counter"]) >= 2
+
+
+def test_lint_catches_perf_field_kind_conflict(tmp_path):
+    bad = tmp_path / "pkg"
+    os.makedirs(bad)
+    (bad / "a.py").write_text('ent.counter("drifted_name")\n')
+    (bad / "b.py").write_text(
+        'perf_field("drifted_name", "gauge")\n'
+        'perf_field("plain_default")\n'
+        'perf_field("kw_form", kind="gauge")\n')
+    problems = lint(str(bad))
+    text = "\n".join(problems)
+    assert "drifted_name" in text and "conflicting kinds" in text
+    # the kind-less form defaults to counter and is seen
+    found = scan_tree(str(bad))
+    assert set(found["plain_default"]) == {"counter"}
+    # the keyword form carries its kind (not silently a counter)
+    assert set(found["kw_form"]) == {"gauge"}
+
+
 def test_lint_catches_conflicts_and_bad_names(tmp_path):
     bad = tmp_path / "pkg"
     os.makedirs(bad)

@@ -1,0 +1,480 @@
+"""Query-level observability (PR 15): PerfContext cost vectors,
+one-command EXPLAIN, the workload profiler, and the cost-model drift
+watchdog.
+
+The acceptance pins: (1) an isolated op's explain counters RECONCILE
+with the same-run storage-entity metric deltas (blocks_decoded vs
+block_cache_miss, bloom/phash-pruned vs their node counters); (2) a
+planted mis-prediction (fail-point-scaled kernel time) drives the
+cost-model drift gauge across threshold and fires its health rule;
+(3) solo and batched slow-log entries carry the SAME perf field set.
+"""
+
+import pytest
+
+from pegasus_tpu.base.key_schema import generate_key
+from pegasus_tpu.client.table import Table
+from pegasus_tpu.server.explain import (
+    explain_op,
+    from_trace,
+    op_from_spec,
+    render_report,
+    render_trace_report,
+    spec_from_words,
+)
+from pegasus_tpu.server.workload import DRIFT, WorkloadStats, fold_summaries
+from pegasus_tpu.utils import perf_context as perf
+from pegasus_tpu.utils.flags import FLAGS
+from pegasus_tpu.utils.metrics import METRICS
+
+_STORAGE = METRICS.entity("storage", "node")
+
+
+@pytest.fixture
+def table(tmp_path):
+    t = Table(str(tmp_path / "t"), app_id=9, app_name="perft",
+              partition_count=1)
+    srv = t.partitions[0]
+    for i in range(300):
+        srv.on_put(generate_key(b"hk%04d" % i, b"s"), b"v%06d" % i)
+    srv.flush()
+    srv.engine.manual_compact()
+    yield t
+    t.close()
+
+
+def _counter(name: str) -> int:
+    return _STORAGE.relaxed_counter(name).value()
+
+
+def test_perf_context_vector_and_ambient():
+    pc = perf.PerfContext("unit")
+    d = pc.to_dict()
+    # the FULL fixed vector, zeros included — field-set comparability
+    # between solo and batched entries is structural
+    for f in perf.FIELDS:
+        assert f in d
+    assert d["op"] == "unit" and d["placement"] == ""
+    assert perf.current() is None
+    with perf.activate(pc):
+        assert perf.current() is pc
+        pc.blocks_decoded += 2
+    assert perf.current() is None
+    assert pc.to_dict()["blocks_decoded"] == 2
+    # kill switch: start() hands out nothing when off
+    FLAGS.set("pegasus.perfctx", "enabled", False)
+    try:
+        assert perf.start("x") is None
+    finally:
+        FLAGS.set("pegasus.perfctx", "enabled", True)
+    assert perf.start("x") is not None
+    # every registered field is a declared (name, kind) pair the
+    # metrics linter can check
+    kinds = dict(perf.FIELD_DEFS)
+    assert kinds["blocks_decoded"] == "counter"
+    assert kinds["queue_wait_ms"] == "gauge"
+
+
+def test_explain_counters_reconcile_with_storage_metrics(table):
+    """Acceptance pin 1: for an isolated op, the explain report's
+    blocks_decoded and phash-pruned counts equal the same-run storage-
+    entity counter deltas."""
+    srv = table.partitions[0]
+    # cold present key: the phash-located block decode is the op's one
+    # block touch
+    op, args, _ph = op_from_spec(
+        {"op": "get", "hash_key": "hk0007", "sort_key": "s"})
+    pre_miss = _counter("block_cache_miss")
+    pre_hit = _counter("block_cache_hit")
+    rep = explain_op(srv, op, args)
+    assert rep["result"]["status"] == 0
+    pcd = rep["perf"]
+    assert pcd["blocks_decoded"] == _counter("block_cache_miss") - pre_miss
+    assert pcd["block_cache_hit"] == _counter("block_cache_hit") - pre_hit
+    assert pcd["blocks_decoded"] + pcd["block_cache_hit"] >= 1
+    assert pcd["rows_survived"] == 1 and pcd["bytes_returned"] > 0
+    # absent key INSIDE the run fences: pruned by the perfect hash with
+    # zero block touches, and the counts reconcile
+    op, args, _ph = op_from_spec(
+        {"op": "get", "hash_key": "hk0100", "sort_key": "zz"})
+    pre_ph = _counter("phash_useful_count")
+    pre_miss = _counter("block_cache_miss")
+    rep = explain_op(srv, op, args)
+    assert rep["result"]["status"] != 0
+    pcd = rep["perf"]
+    assert pcd["phash_pruned"] == \
+        _counter("phash_useful_count") - pre_ph == 1
+    assert pcd["blocks_decoded"] == \
+        _counter("block_cache_miss") - pre_miss == 0
+    # bloom path (phash probing off): bloom_pruned reconciles too
+    # (fresh absent key — the per-generation point cache already
+    # remembers the one above, which is itself the layer working)
+    FLAGS.set("pegasus.server", "phash_probe", False)
+    try:
+        pre_bl = _counter("bloom_useful_count")
+        rep = explain_op(srv, "get",
+                         generate_key(b"hk0101", b"zz"))
+        assert rep["perf"]["bloom_pruned"] == \
+            _counter("bloom_useful_count") - pre_bl == 1
+    finally:
+        FLAGS.set("pegasus.server", "phash_probe", True)
+    # rendering: tree + rollup lines
+    text = render_report(rep)
+    assert "EXPLAIN get" in text and "bloom_pruned=1" in text
+
+
+def test_solo_and_batched_slow_entries_field_parity(table):
+    """Acceptance pin 3 / satellite: the solo on_get fallback populates
+    the SAME PerfContext field set as the batched path."""
+    srv = table.partitions[0]
+    srv.slow_log.threshold_ms = -1.0
+    try:
+        key = generate_key(b"hk0005", b"s")
+        st, _v = srv.on_get(key)
+        assert st == 0
+        solo = srv.slow_log.dump()[-1]
+        out = srv.on_point_read_batch([("get", key, None)])
+        assert out[0][0] == 0
+        batched = srv.slow_log.dump()[-1]
+    finally:
+        srv.slow_log.threshold_ms = 20.0
+    assert solo["name"].startswith("point_get.")
+    assert batched["name"].startswith("point_get_batch.")
+    assert "perf" in solo and "perf" in batched
+    # THE regression pin: identical field sets, so dashboards and the
+    # explain renderer read both shapes with one schema
+    assert set(solo["perf"]) == set(batched["perf"])
+    # and the load-bearing fields moved identically for the same op
+    for f in ("ops", "keys_resolved", "rows_evaluated",
+              "rows_survived", "runs_considered"):
+        assert solo["perf"][f] > 0, f
+        assert batched["perf"][f] > 0, f
+    assert solo["perf"]["placement"] == \
+        batched["perf"]["placement"] == "native"
+
+
+def test_explain_scan_reports_selectivity_shape(table):
+    srv = table.partitions[0]
+    op, args, _ph = op_from_spec({"op": "scan", "hash_key": "hk0002"})
+    rep = explain_op(srv, op, args)
+    pcd = rep["perf"]
+    assert rep["result"]["rows"] == 1
+    assert pcd["rows_evaluated"] >= pcd["rows_survived"] >= 1
+    assert pcd["blocks_planned"] >= 1
+    assert [s["stage"] for s in rep["stages"]][0] == "plan"
+    assert "EXPLAIN scan" in render_report(rep)
+    # the workload profiler saw the scan's selectivity
+    summary = srv.workload.summary()
+    assert summary["scan_ops"] >= 1
+    assert 0.0 < summary["scan_selectivity_p50"] <= 100.0
+
+
+def test_explain_from_trace_rebuilds_report(table):
+    """A span that served an instrumented op carries the cost vector in
+    its perf tag; explain --from-trace rebuilds the report from the
+    dump alone."""
+    from pegasus_tpu.utils import tracing
+
+    srv = table.partitions[0]
+    ring = tracing.ring_for("perfnode")
+    span = ring.start("client_read")
+    with tracing.activate(span):
+        out = srv.on_point_read_batch(
+            [("get", generate_key(b"hk0009", b"s"), None)])
+    span.finish()
+    assert out[0][0] == 0
+    spans = ring.dump(span.trace_id)
+    rep = from_trace(spans, span.trace_id)
+    assert len(rep["ops"]) == 1
+    op = rep["ops"][0]
+    assert op["perf"]["rows_survived"] == 1
+    assert any(s["stage"] == "plan" for s in op["stages"])
+    text = render_trace_report(rep)
+    assert span.trace_id in text and "rows:" in text
+
+
+def test_carrier_span_merges_per_partition_vectors(tmp_path):
+    """A batched RPC serving MANY partitions under ONE carrier span:
+    each partition's flush context MERGES into the span's perf tag
+    (counters sum) — assignment would keep only the last partition."""
+    from pegasus_tpu.server.read_coordinator import point_read_multi
+    from pegasus_tpu.utils import tracing
+
+    t = Table(str(tmp_path / "mt"), app_id=13, app_name="merget",
+              partition_count=2)
+    # one key per partition so the flush really spans both
+    from pegasus_tpu.base.key_schema import key_hash_parts
+
+    keys = {}
+    i = 0
+    while len(keys) < 2:
+        hk = b"mk%04d" % i
+        keys.setdefault(key_hash_parts(hk, b"s") % 2,
+                        generate_key(hk, b"s"))
+        i += 1
+    for pidx, key in keys.items():
+        t.partitions[pidx].on_put(key, b"v%d" % pidx)
+    ring = tracing.ring_for("mergenode")
+    span = ring.start("client_read_batch")
+    with tracing.activate(span):
+        out = point_read_multi(
+            [(t.partitions[p], [("get", k, None)])
+             for p, k in sorted(keys.items())])
+    span.finish()
+    assert [r[0][0] for r in out] == [0, 0]
+    pcd = span.tags.get("perf")
+    assert pcd is not None
+    assert pcd["ops"] == 2  # both partitions' flushes, summed
+    assert pcd["rows_survived"] == 2
+    t.close()
+
+
+def test_write_slow_entry_carries_queue_wait(tmp_path):
+    """The write apply path's context: rows + the group-commit window
+    wait (append_plog -> plog_durable), attached to the slow entry."""
+    from pegasus_tpu.tools.cluster import SimCluster
+
+    cluster = SimCluster(str(tmp_path / "wc"), n_nodes=3)
+    try:
+        cluster.create_table("wt", partition_count=2, replica_count=3)
+        for stub in cluster.stubs.values():
+            for r in stub.replicas.values():
+                r.server.slow_log.threshold_ms = -1.0
+        c = cluster.client("wt")
+        assert c.set(b"wk", b"s", b"v" * 100) == 0
+        entries = []
+        for stub in cluster.stubs.values():
+            for r in stub.replicas.values():
+                entries += [e for e in r.server.slow_log.dump()
+                            if e.get("name", "").startswith("write.")]
+        assert entries, "no write slow entries captured"
+        with_perf = [e for e in entries if "perf" in e]
+        assert with_perf, "write entries carry no perf vector"
+        pcd = with_perf[-1]["perf"]
+        assert pcd["op"] == "write"
+        assert pcd["rows_evaluated"] >= 1
+        assert pcd["queue_wait_ms"] >= 0.0
+        assert set(pcd) == set(
+            perf.PerfContext("x").to_dict())  # same schema as reads
+    finally:
+        cluster.close()
+
+
+def test_workload_profiler_shapes_and_fold(table):
+    srv = table.partitions[0]
+    base = srv.workload.summary()
+    srv.on_point_read_batch(
+        [("get", generate_key(b"hk%04d" % i, b"s"), None)
+         for i in range(16)])
+    srv.on_put(generate_key(b"hkw", b"s"), b"x" * 500)
+    s = srv.workload.summary()
+    assert s["read_ops"] >= base["read_ops"] + 16
+    assert s["write_ops"] >= base["write_ops"] + 1
+    assert s["read_batch_p99"] >= 16
+    assert s["value_bytes_p99"] >= 7  # b"v%06d" values
+    fold = fold_summaries([s, dict(s, read_ops=5, hot_share=0.9)])
+    assert fold["partitions"] == 2
+    assert fold["read_ops"] == s["read_ops"] + 5
+    assert fold["hot_share"] == 0.9
+
+
+def test_drift_gauge_crosses_on_planted_misprediction(tmp_path):
+    """Acceptance pin 2a: fail-point-scaled kernel time drives the
+    cost-model drift gauge (EWMA, warmup discarded) over the health
+    rule's threshold."""
+    from pegasus_tpu.server.types import GetScannerRequest
+    from pegasus_tpu.utils.fail_point import FAIL_POINTS
+
+    # raw blocks: the compressed encoded-probe path answers static
+    # masks host-side with no kernel dispatch — the drift audit lives
+    # on the stacked device-eval path, so build an uncompressed store
+    old_codec = FLAGS.get("pegasus.storage", "block_codec")
+    FLAGS.set("pegasus.storage", "block_codec", "none")
+    try:
+        t = Table(str(tmp_path / "dt"), app_id=11, app_name="driftt",
+                  partition_count=1)
+        srv = t.partitions[0]
+        for i in range(200):
+            srv.on_put(generate_key(b"dk%04d" % i, b"s"), b"v%d" % i)
+        srv.flush()
+        srv.engine.manual_compact()
+    finally:
+        FLAGS.set("pegasus.storage", "block_codec", old_codec)
+    DRIFT.reset()
+    gauge = METRICS.entity("workload", "node").gauge(
+        "cost_model_drift_ratio")
+    FAIL_POINTS.setup()
+    FAIL_POINTS.cfg("perf::kernel_time_scale", "return(5000)")
+    try:
+        from pegasus_tpu.ops.predicates import FT_MATCH_PREFIX
+
+        # each DISTINCT filter pattern is a fresh mask flavor -> a real
+        # stacked kernel eval (cached masks never re-dispatch); the
+        # first DRIFT_WARMUP samples are discarded as compile warmup
+        for i in range(8):
+            resp = srv.on_get_scanner_batch([GetScannerRequest(
+                batch_size=50, one_page=True,
+                hash_key_filter_type=FT_MATCH_PREFIX,
+                hash_key_filter_pattern=b"dk%02d" % i)])[0]
+            assert resp.error == 0
+        assert gauge.value() > 16.0, DRIFT.status()
+        assert DRIFT.status()["classes"]["rules"]["samples"] >= 4
+        # ...and the whole chain end-to-end: a recorder ringing the
+        # gauge feeds the shipped rule, which FIRES on the second hot
+        # tick — the mis-calibration became a typed HealthEvent
+        from pegasus_tpu.utils import health as health_mod
+        from pegasus_tpu.utils.health import HealthEngine
+        from pegasus_tpu.utils.timeseries import FlightRecorder
+
+        clock = [5000.0]
+        rec = FlightRecorder(
+            "driftnode", clock=lambda: clock[0],
+            owns=lambda e: (e.entity_type,
+                            e.entity_id) == ("workload", "node"))
+        eng = HealthEngine("driftnode", rec)
+        try:
+            rec.tick(force=True)
+            eng.evaluate()  # arms (hold=2)
+            clock[0] += 10.0
+            rec.tick(force=True)
+            fired = [e for e in eng.evaluate()
+                     if e.rule == "cost_model_drift" and e.firing]
+            assert fired, "planted mis-prediction did not fire"
+            assert fired[0].metric == "cost_model_drift_ratio"
+        finally:
+            eng.close()
+            health_mod.reset_capture()
+    finally:
+        FAIL_POINTS.teardown()
+        DRIFT.reset()
+    t.close()
+
+
+def test_drift_health_rule_fires_and_clears(tmp_path):
+    """Acceptance pin 2b: the shipped cost_model_drift rule turns a
+    sustained over-threshold gauge into a typed HealthEvent (hold=2:
+    one hot tick alone must not fire)."""
+    from pegasus_tpu.utils import health as health_mod
+    from pegasus_tpu.utils.health import HealthEngine
+    from pegasus_tpu.utils.timeseries import FlightRecorder
+
+    clock = [1000.0]
+    rec = FlightRecorder(
+        "dnode", clock=lambda: clock[0],
+        owns=lambda e: (e.entity_type, e.entity_id) == ("workload",
+                                                        "node"))
+    eng = HealthEngine("dnode", rec)
+    assert any(r.name == "cost_model_drift" for r in eng.rules)
+    gauge = METRICS.entity("workload", "node").gauge(
+        "cost_model_drift_ratio")
+    try:
+        gauge.set(40.0)
+        rec.tick(force=True)
+        events = eng.evaluate()
+        assert events == []  # hold=2: first hot tick arms, not fires
+        clock[0] += 10.0
+        rec.tick(force=True)
+        events = eng.evaluate()
+        fired = [e for e in events if e.rule == "cost_model_drift"]
+        assert fired and fired[0].firing
+        assert fired[0].entity == ("workload", "node")
+        # recovery: calm gauge clears it after clear_hold ticks
+        gauge.set(1.0)
+        cleared = []
+        for _ in range(4):
+            clock[0] += 10.0
+            rec.tick(force=True)
+            cleared += [e for e in eng.evaluate()
+                        if e.rule == "cost_model_drift"
+                        and not e.firing]
+        assert cleared
+    finally:
+        gauge.set(0.0)
+        eng.close()
+        health_mod.reset_capture()
+
+
+def test_shell_explain_and_workload_root_mode(tmp_path, capsys):
+    """The operator surface end-to-end in --root mode: explain renders
+    a plan tree; workload prints the table profile; placement prints
+    the offload verdict."""
+    import json as _json
+
+    from pegasus_tpu.tools.onebox import Onebox
+    from pegasus_tpu.tools.shell import main as shell_main
+
+    root = str(tmp_path / "box")
+    box = Onebox(root)
+    t = box.create_table("st", partition_count=2)
+    c = box.client("st")
+    for i in range(150):
+        assert c.set(b"sk%03d" % i, b"s", b"val%d" % i) == 0
+    for p_ in t.all_partitions():
+        p_.flush()
+        p_.engine.manual_compact()
+    box.close()
+    rc = shell_main(["--root", root, "explain", "st",
+                     "get", "sk010", "s"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "EXPLAIN get" in out and "finish" in out
+    rc = shell_main(["--root", root, "explain", "st", "scan", "sk011"])
+    out = capsys.readouterr().out
+    assert rc == 0 and "EXPLAIN scan" in out
+    rc = shell_main(["--root", root, "workload", "st", "--json"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    data = _json.loads(out)
+    assert "st" in data and data["st"]["table"]["partitions"] == 2
+    rc = shell_main(["--root", root, "placement", "probe"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    data = _json.loads(out)
+    assert "breakdown" in data and "drift" in data
+    assert data["breakdown"]["workload"] == "probe"
+
+
+def test_stub_verbs_and_workload_config_sync(tmp_path):
+    """Wire surfaces: the node's placement / workload.stats /
+    perf.explain verbs answer, and the workload digest rides
+    config-sync into the meta `workload` admin fold."""
+    import json as _json
+
+    from pegasus_tpu.tools.cluster import SimCluster
+
+    cluster = SimCluster(str(tmp_path / "vc"), n_nodes=3)
+    try:
+        cluster.create_table("vt", partition_count=2, replica_count=3)
+        c = cluster.client("vt")
+        for i in range(40):
+            assert c.set(b"vk%03d" % i, b"s", b"v%d" % i) == 0
+        for i in range(40):
+            assert c.get(b"vk%03d" % i, b"s")[0] == 0
+        cluster.step(rounds=3)
+        node = next(iter(cluster.stubs))
+        res = cluster.stubs[node].commands.call(
+            "placement", ["probe", "4096"])
+        assert res["breakdown"]["workload"] == "probe"
+        res = cluster.stubs[node].commands.call("workload.stats", [])
+        assert res["node"] == node
+        # perf.explain on whichever node hosts the key's primary
+        spec = _json.dumps({"app_id": c.app_id, "op": "get",
+                            "hash_key": "vk001", "sort_key": "s"})
+        rep = None
+        for n in cluster.stubs:
+            try:
+                rep = cluster.stubs[n].commands.call("perf.explain",
+                                                     [spec])
+                break
+            except Exception:  # noqa: BLE001 - not the primary host
+                continue
+        assert rep is not None and rep["result"]["status"] == 0
+        assert rep["perf"]["ops"] == 1
+        # meta-side fold off the config-sync digests
+        status = cluster.meta.workload_status("vt")
+        assert "vt" in status
+        fold = status["vt"]["table"]
+        assert fold["partitions"] >= 2
+        assert fold["read_ops"] > 0 and fold["write_ops"] > 0
+    finally:
+        cluster.close()
