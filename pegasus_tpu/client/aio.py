@@ -85,20 +85,50 @@ class AsyncPegasusClient:
         return await asyncio.gather(
             *(self.set(hk, sk, v, ttl_seconds) for hk, sk, v in items))
 
-    async def scan_all(self, hash_key: bytes, batch_size: int = 100):
-        """Drain a hashkey scan without blocking the event loop between
-        pages; returns [(hashkey, sortkey, value)]."""
+    @staticmethod
+    def _scan_options(batch_size: int, value_filter: Optional[bytes]):
         from pegasus_tpu.client.client import ScanOptions
+        from pegasus_tpu.ops.predicates import FT_MATCH_ANYWHERE
 
+        if value_filter:
+            # server-side pushdown: only matching rows cross the wire
+            # (old servers stream everything and the scanner filters
+            # locally — same rows either way)
+            return ScanOptions(batch_size=batch_size,
+                               value_filter_type=FT_MATCH_ANYWHERE,
+                               value_filter_pattern=value_filter)
+        return ScanOptions(batch_size=batch_size)
+
+    async def scan_all(self, hash_key: bytes, batch_size: int = 100,
+                       value_filter: Optional[bytes] = None):
+        """Drain a hashkey scan without blocking the event loop between
+        pages; returns [(hashkey, sortkey, value)]. `value_filter`
+        keeps only rows whose value contains the pattern, evaluated
+        server-side when the server supports pushdown."""
         loop = asyncio.get_running_loop()
+        opts = self._scan_options(batch_size, value_filter)
 
         def scan():
             with self._lock:
-                scanner = self._c.get_scanner(
-                    hash_key, options=ScanOptions(batch_size=batch_size))
+                scanner = self._c.get_scanner(hash_key, options=opts)
                 return list(scanner)
 
         return await loop.run_in_executor(self._pool, scan)
+
+    async def scan_count(self, hash_key: bytes,
+                         value_filter: Optional[bytes] = None) -> int:
+        """Count a hashkey's (optionally value-filtered) rows via
+        aggregate pushdown: the server replies with one tiny partial
+        instead of streaming rows (pre-pushdown servers stream and the
+        scanner counts locally)."""
+        loop = asyncio.get_running_loop()
+        opts = self._scan_options(100, value_filter)
+
+        def count():
+            with self._lock:
+                return self._c.get_scanner(hash_key, options=opts).count()
+
+        return await loop.run_in_executor(self._pool, count)
 
     def close(self) -> None:
         self._pool.shutdown(wait=False)

@@ -16,7 +16,9 @@ from typing import Dict, Iterator, List, Optional, Sequence, Tuple
 
 from pegasus_tpu.base.key_schema import generate_key, restore_key
 from pegasus_tpu.client.table import Table
-from pegasus_tpu.ops.predicates import FT_NO_FILTER
+from pegasus_tpu.ops.predicates import FT_NO_FILTER, host_match_filter
+from pegasus_tpu.ops.pushdown import PushdownSpec
+from pegasus_tpu.ops import pushdown as pushdown_ops
 from pegasus_tpu.server.partition_server import PartitionServer
 from pegasus_tpu.server.types import (
     BatchGetRequest,
@@ -109,6 +111,12 @@ class ScanOptions:
     no_value: bool = False
     return_expire_ts: bool = False
     only_return_count: bool = False
+    # server-side pushdown: match against the record's USER value bytes
+    # (same FT_* match types as the key filters). Old servers ignore the
+    # spec; the scanner detects pushdown_applied=False and filters
+    # locally, so the option is safe against any server
+    value_filter_type: int = FT_NO_FILTER
+    value_filter_pattern: bytes = b""
 
 
 class PegasusScanner:
@@ -128,6 +136,7 @@ class PegasusScanner:
         self._buf_pos = 0
         self._last_key: Optional[bytes] = None  # for context-loss restart
         self.kv_count = 0  # accumulated when only_return_count
+        self.shipped_bytes = 0  # wire-size of every response consumed
 
     def __iter__(self) -> Iterator[Tuple[bytes, bytes, bytes]]:
         return self
@@ -177,9 +186,19 @@ class PegasusScanner:
                     resp = server.on_get_scanner(restart)
             if resp.error != int(StorageStatus.OK):
                 raise RuntimeError(f"scan failed: error {resp.error}")
+            self.shipped_bytes += resp.wire_bytes()
             if resp.kv_count >= 0:
                 self.kv_count += resp.kv_count
-            self._buffer = resp.kvs
+            buf = resp.kvs
+            spec = self._request.pushdown
+            vf = spec.value_filter if spec is not None else None
+            if vf is not None and not resp.pushdown_applied:
+                # pre-pushdown server (or pushdown disabled): the spec
+                # was ignored and full pages streamed — same result,
+                # evaluated locally
+                buf = [kv for kv in buf
+                       if host_match_filter(kv.value, vf[0], vf[1])]
+            self._buffer = buf
             self._buf_pos = 0
             if resp.context_id == SCAN_CONTEXT_ID_COMPLETED:
                 self._part_idx += 1
@@ -189,6 +208,75 @@ class PegasusScanner:
             if self._buffer:
                 return True
         return False
+
+    # ---- aggregate pushdown -------------------------------------------
+
+    def count(self) -> int:
+        """Matching-row count over this scanner's range, evaluated
+        server-side where possible (one tiny partial per partition on
+        the wire; pre-pushdown servers stream rows and the count happens
+        here). Respects the scanner's value filter."""
+        return self.aggregate("count")
+
+    def aggregate(self, kind: str, k: int = 0, seed: int = 0):
+        """Run this scanner's range as ONE aggregate — `count`,
+        `sum` (values as u64), `top_k` (by sort key, k required) or
+        `sample` (reservoir, k required) — merged across partitions.
+        Consumes the range independently of iteration (does not touch
+        the paging cursor)."""
+        from dataclasses import replace
+
+        base = self._request.pushdown or PushdownSpec()
+        spec = replace(base, aggregate=kind, k=int(k), seed=int(seed))
+        spec.check()
+        req = replace(self._request, pushdown=spec,
+                      one_page=False, only_return_count=False)
+        parts = [self._aggregate_partition(server, req, spec)
+                 for server in self._partitions]
+        return pushdown_ops.finalize(
+            spec, pushdown_ops.merge_partials(spec, parts))
+
+    def _aggregate_partition(self, server, req, spec):
+        resp = server.on_get_scanner(req)
+        rows: List[Tuple[bytes, bytes]] = []  # fallback accumulation
+        last_key: Optional[bytes] = None
+        while True:
+            if resp.context_id == SCAN_CONTEXT_ID_NOT_EXIST:
+                # server GC'd the context. In aggregate mode the partial
+                # lives SERVER-side, so losing the context lost every
+                # page it folded — restart from the original start with
+                # nothing accumulated: no double count by construction.
+                # The local-fallback path (rows collected here) resumes
+                # past the last collected key like a plain scan.
+                from dataclasses import replace
+
+                if rows and last_key is not None:
+                    resp = server.on_get_scanner(replace(
+                        req, start_key=last_key + b"\x00",
+                        start_inclusive=True))
+                else:
+                    rows.clear()
+                    resp = server.on_get_scanner(req)
+                continue
+            if resp.error != int(StorageStatus.OK):
+                raise RuntimeError(f"scan failed: error {resp.error}")
+            self.shipped_bytes += resp.wire_bytes()
+            for kv in resp.kvs:
+                rows.append((kv.key, kv.value))
+                last_key = kv.key
+            if resp.context_id == SCAN_CONTEXT_ID_COMPLETED:
+                break
+            resp = server.on_scan(resp.context_id)
+        if resp.agg is not None:
+            return resp.agg
+        # pre-pushdown server streamed rows: evaluate the whole spec here
+        vf = spec.value_filter
+        st = pushdown_ops.AggState(spec)
+        for key, value in rows:
+            if vf is not None and not host_match_filter(value, vf[0], vf[1]):
+                continue
+            st.fold_row(key, value)
+        return st.to_wire()
 
     def close(self) -> None:
         if self._context_id is not None and self._part_idx < len(self._partitions):
@@ -456,6 +544,12 @@ class PegasusClient:
     def _make_scan_request(start_key: bytes, stop_key: bytes,
                            opts: ScanOptions,
                            full_scan: bool = False) -> GetScannerRequest:
+        pushdown = None
+        if opts.value_filter_type != FT_NO_FILTER:
+            pushdown = PushdownSpec(
+                value_filter_type=opts.value_filter_type,
+                value_filter_pattern=opts.value_filter_pattern)
+            pushdown.check()
         return GetScannerRequest(
             start_key=start_key, stop_key=stop_key,
             start_inclusive=opts.start_inclusive,
@@ -468,4 +562,5 @@ class PegasusClient:
             validate_partition_hash=True,
             return_expire_ts=opts.return_expire_ts,
             full_scan=full_scan,
-            only_return_count=opts.only_return_count)
+            only_return_count=opts.only_return_count,
+            pushdown=pushdown)

@@ -26,6 +26,7 @@ from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence, Tupl
 
 from pegasus_tpu.base.key_schema import generate_key, key_hash_parts, restore_key
 from pegasus_tpu.client.client import ScanOptions
+from pegasus_tpu.ops.predicates import host_match_filter
 from pegasus_tpu.rpc.codec import (
     OP_CAM,
     OP_CAS,
@@ -891,6 +892,15 @@ class ClusterClient:
     def _make_scan_request(start_key: bytes, stop_key: bytes,
                            opts: ScanOptions,
                            full_scan: bool = False) -> GetScannerRequest:
+        from pegasus_tpu.ops.predicates import FT_NO_FILTER
+        from pegasus_tpu.ops.pushdown import PushdownSpec
+
+        pushdown = None
+        if opts.value_filter_type != FT_NO_FILTER:
+            pushdown = PushdownSpec(
+                value_filter_type=opts.value_filter_type,
+                value_filter_pattern=opts.value_filter_pattern)
+            pushdown.check()
         return GetScannerRequest(
             start_key=start_key, stop_key=stop_key,
             start_inclusive=opts.start_inclusive,
@@ -904,7 +914,8 @@ class ClusterClient:
             return_expire_ts=opts.return_expire_ts,
             only_return_count=opts.only_return_count,
             full_scan=full_scan,
-            validate_partition_hash=True)
+            validate_partition_hash=True,
+            pushdown=pushdown)
 
 
 class ClusterScanner:
@@ -922,6 +933,7 @@ class ClusterScanner:
         self._pos = 0
         self._last_key: Optional[bytes] = None
         self.kv_count = 0
+        self.shipped_bytes = 0  # wire-size of every response consumed
 
     def __iter__(self) -> Iterator[Tuple[bytes, bytes, bytes]]:
         return self
@@ -969,9 +981,18 @@ class ClusterScanner:
                     resp = self._client._read("get_scanner", restart, pidx)
             if resp.error != int(StorageStatus.OK):
                 raise RuntimeError(f"scan failed: error {resp.error}")
+            self.shipped_bytes += resp.wire_bytes()
             if resp.kv_count >= 0:
                 self.kv_count += resp.kv_count
-            self._buffer = resp.kvs
+            buf = resp.kvs
+            spec = base_req.pushdown
+            vf = spec.value_filter if spec is not None else None
+            if vf is not None and not resp.pushdown_applied:
+                # pre-pushdown server (or pushdown disabled): spec was
+                # ignored, full pages streamed — evaluate locally
+                buf = [kv for kv in buf
+                       if host_match_filter(kv.value, vf[0], vf[1])]
+            self._buffer = buf
             self._pos = 0
             if resp.context_id == SCAN_CONTEXT_ID_COMPLETED:
                 self._i += 1
@@ -981,6 +1002,79 @@ class ClusterScanner:
             if self._buffer:
                 return True
         return False
+
+    # ---- aggregate pushdown -------------------------------------------
+
+    def count(self) -> int:
+        """Matching-row count over this scanner's partitions, evaluated
+        server-side where possible — one tiny aggregate partial per
+        partition on the wire instead of every row. Respects the
+        scanner's value filter; pre-pushdown servers stream rows and the
+        count happens here."""
+        return self.aggregate("count")
+
+    def aggregate(self, kind: str, k: int = 0, seed: int = 0):
+        """Run this scanner's range as ONE aggregate — `count`, `sum`
+        (values as u64), `top_k` (by sort key) or `sample` (reservoir) —
+        merged across partitions. Independent of the iteration cursor."""
+        from dataclasses import replace
+
+        from pegasus_tpu.ops import pushdown as pushdown_ops
+
+        base = self._request.pushdown or pushdown_ops.PushdownSpec()
+        spec = replace(base, aggregate=kind, k=int(k), seed=int(seed))
+        spec.check()
+        req = replace(self._request, pushdown=spec,
+                      one_page=False, only_return_count=False)
+        parts = [self._aggregate_partition(pidx, req, spec)
+                 for pidx in self._pidxs]
+        return pushdown_ops.finalize(
+            spec, pushdown_ops.merge_partials(spec, parts))
+
+    def _aggregate_partition(self, pidx: int, req, spec):
+        from dataclasses import replace
+
+        from pegasus_tpu.ops import pushdown as pushdown_ops
+
+        resp = self._client._read("get_scanner", req, pidx)
+        rows: List[Tuple[bytes, bytes]] = []  # fallback accumulation
+        last_key: Optional[bytes] = None
+        while True:
+            if resp.context_id == SCAN_CONTEXT_ID_NOT_EXIST:
+                # context expired server-side (or moved with a failover
+                # / split fence bounce). The aggregate partial lives
+                # SERVER-side, so the lost context lost every page it
+                # folded — restarting from the original start with
+                # nothing accumulated client-side cannot double count.
+                # The local-fallback path (rows collected here) resumes
+                # past the last collected key like a plain scan.
+                if rows and last_key is not None:
+                    resp = self._client._read("get_scanner", replace(
+                        req, start_key=last_key + b"\x00",
+                        start_inclusive=True), pidx)
+                else:
+                    rows.clear()
+                    resp = self._client._read("get_scanner", req, pidx)
+                continue
+            if resp.error != int(StorageStatus.OK):
+                raise RuntimeError(f"scan failed: error {resp.error}")
+            self.shipped_bytes += resp.wire_bytes()
+            for kv in resp.kvs:
+                rows.append((kv.key, kv.value))
+                last_key = kv.key
+            if resp.context_id == SCAN_CONTEXT_ID_COMPLETED:
+                break
+            resp = self._client._read("scan", resp.context_id, pidx)
+        if resp.agg is not None:
+            return resp.agg
+        # pre-pushdown server streamed rows: evaluate the whole spec here
+        vf = spec.value_filter
+        st = pushdown_ops.AggState(spec)
+        for key, value in rows:
+            if vf is not None and not host_match_filter(value, vf[0], vf[1]):
+                continue
+            st.fold_row(key, value)
+        return st.to_wire()
 
     def close(self) -> None:
         if self._context_id is not None and self._i < len(self._pidxs):

@@ -75,16 +75,18 @@ def choose_eval_device(workload: str = "rules"):
     """jax.Device to place a movement-bound program on, or None to keep
     the ambient default.
 
-    workload: "ttl"/"probe" (compute-trivial per byte) or
-    "rules"/"match" (compute-dense). See the module docstring for the
-    policy.
+    workload: "ttl"/"probe"/"scan_pushdown" (compute-trivial per byte —
+    scan-pushdown value filters and aggregate folds stream the value
+    heap once, host-side, because value heaps are never
+    device-resident) or "rules"/"match" (compute-dense). See the module
+    docstring for the policy.
     """
     import jax
 
     rtt, _dev = _probe_rtt()
     if rtt is None:
         return None  # ambient default is already the host
-    if workload in ("ttl", "probe"):
+    if workload in ("ttl", "probe", "scan_pushdown"):
         route_host = rtt > LINK_RTT_COLOCATED_S
     else:
         route_host = rtt > LINK_RTT_BROKEN_S

@@ -21,7 +21,11 @@ Value grammar (little-endian):
 
 Every registered dataclass is flat (primitives / lists / nested
 registered dataclasses), so the grammar closes. Unknown tags or registry
-names raise — a version-skewed peer fails loudly, not silently.
+names raise — a version-skewed peer fails loudly, not silently. The one
+sanctioned evolution is appending defaulted fields: a decoder accepts a
+SHORTER field list when every omitted trailing field has a default
+(thrift optional-field semantics), so older clients — including the
+compiled native one — keep working across additive changes.
 """
 
 from __future__ import annotations
@@ -51,6 +55,7 @@ def register_message_type(cls: type) -> type:
 
 def _register_defaults() -> None:
     from pegasus_tpu.meta.server_state import PartitionConfig
+    from pegasus_tpu.ops.pushdown import PushdownSpec
     from pegasus_tpu.server import types as t
 
     for cls in (t.KeyValue, t.MultiPutRequest, t.MultiRemoveRequest,
@@ -59,7 +64,7 @@ def _register_defaults() -> None:
                 t.IncrRequest, t.IncrResponse, t.CheckAndSetRequest,
                 t.CheckAndSetResponse, t.Mutate, t.CheckAndMutateRequest,
                 t.CheckAndMutateResponse, t.GetScannerRequest,
-                t.ScanRequest, t.ScanResponse, t.ScanPage,
+                t.ScanRequest, t.ScanResponse, t.ScanPage, PushdownSpec,
                 PartitionConfig):
         register_message_type(cls)
 
@@ -183,10 +188,21 @@ class _Dec:
                 raise ValueError(f"unknown message dataclass {name!r}")
             nf = self._u32()
             fields = _FIELDS[name]
-            if nf != len(fields):
+            if nf > len(fields):
                 raise ValueError(
                     f"{name}: field count mismatch ({nf} != {len(fields)})")
             vals = [self.value() for _ in range(nf)]
+            if nf < len(fields):
+                # thrift-style added-field skew: a peer built before a
+                # trailing field was added sends the shorter layout.
+                # Tolerate iff every omitted field has a default (it
+                # was ADDED with one); anything else fails loudly.
+                for fobj in dataclasses.fields(cls)[nf:]:
+                    if (fobj.default is dataclasses.MISSING and
+                            fobj.default_factory is dataclasses.MISSING):
+                        raise ValueError(
+                            f"{name}: field count mismatch "
+                            f"({nf} != {len(fields)})")
             return cls(**dict(zip(fields, vals)))
         raise ValueError(f"unknown value tag {tag!r} at {self.pos - 1}")
 

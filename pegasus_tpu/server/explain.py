@@ -33,6 +33,7 @@ STAGE_FIELDS: Dict[str, tuple] = {
                     "blocks_planned"),
     "block_scan": ("blocks_decoded", "block_cache_hit", "bytes_read",
                    "rows_evaluated"),
+    "pushdown": ("pushdown_rows_pruned", "rows_aggregated"),
     "decode": ("bytes_decoded",),
     "assemble": ("rows_survived", "bytes_returned"),
     "finish": ("rows_evaluated", "rows_survived", "expired_rows",
@@ -52,8 +53,15 @@ def _summarize_result(op: str, result) -> Dict[str, Any]:
     kvs = getattr(result, "kvs", None)
     if kvs is None:
         kvs = getattr(result, "data", None)
-    return {"error": int(getattr(result, "error", 0)),
-            "rows": len(kvs) if kvs is not None else 0}
+    out = {"error": int(getattr(result, "error", 0)),
+           "rows": len(kvs) if kvs is not None else 0}
+    if getattr(result, "pushdown_applied", False):
+        out["pushdown_applied"] = True
+    agg = getattr(result, "agg", None)
+    if agg is not None:
+        out["agg"] = {k: agg[k] for k in ("kind", "count", "total")
+                      if agg.get(k) or k == "kind"}
+    return out
 
 
 def explain_op(server, op: str, args,
@@ -132,11 +140,23 @@ def op_from_spec(spec: Dict[str, Any]):
     if op == "scan":
         from pegasus_tpu.server.types import GetScannerRequest
 
+        pushdown = None
+        if spec.get("filter") or spec.get("agg"):
+            from pegasus_tpu.ops.predicates import FT_MATCH_ANYWHERE
+            from pegasus_tpu.ops.pushdown import PushdownSpec
+
+            pushdown = PushdownSpec(
+                value_filter_type=(FT_MATCH_ANYWHERE if spec.get("filter")
+                                   else 0),
+                value_filter_pattern=spec.get("filter", "").encode(),
+                aggregate=spec.get("agg", ""),
+                k=int(spec.get("k", 0)))
         return op, GetScannerRequest(
             start_key=generate_key(hk, b"") if hk else b"",
             stop_key=(generate_next_bytes(hk) if hk else b""),
             batch_size=int(spec.get("batch_size", 100)),
-            one_page=True), None
+            one_page=True,
+            pushdown=pushdown), None
     raise ValueError(f"explain: unknown op {op!r}")
 
 
@@ -161,10 +181,26 @@ def spec_from_words(words: List[str]) -> Dict[str, Any]:
                 "sort_keys": words[2:]}
     if op == "scan":
         spec: Dict[str, Any] = {"op": op}
-        if len(words) > 1:
-            spec["hash_key"] = words[1]
-        if len(words) > 2:
-            spec["batch_size"] = int(words[2])
+        pos = 1
+        for w in words[1:]:
+            # pushdown spec words: filter=<pattern> pushes an ANYWHERE
+            # value filter; agg=count|sum|top_k|sample (+ k=<n>)
+            if "=" in w:
+                key, _, val = w.partition("=")
+                if key not in ("filter", "agg", "k", "batch_size"):
+                    raise ValueError(f"explain scan: unknown option "
+                                     f"{key!r} (filter|agg|k|batch_size)")
+                spec[key] = int(val) if key in ("k", "batch_size") else val
+                continue
+            if pos == 1:
+                spec["hash_key"] = w
+            elif pos == 2:
+                spec["batch_size"] = int(w)
+            else:
+                raise ValueError("usage: explain <table> scan [hash_key]"
+                                 " [batch_size] [filter=<pat>]"
+                                 " [agg=<kind>] [k=<n>]")
+            pos += 1
         return spec
     raise ValueError(f"explain: unknown op {op!r} "
                      "(get|ttl|multi_get|scan)")

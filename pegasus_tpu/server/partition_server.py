@@ -45,8 +45,10 @@ from pegasus_tpu.ops.predicates import (
     FT_MATCH_PREFIX,
     FT_NO_FILTER,
     FilterSpec,
+    host_match_filter,
     scan_block_predicate,
 )
+from pegasus_tpu.ops import pushdown as pushdown_ops
 
 from pegasus_tpu.ops.record_block import build_record_block
 from pegasus_tpu.server.capacity_units import (
@@ -86,7 +88,15 @@ from pegasus_tpu.utils.errors import (
     StorageCorruptionError,
     StorageStatus,
 )
+from pegasus_tpu.utils.flags import FLAGS, define_flag
 from pegasus_tpu.utils.metrics import METRICS
+
+define_flag("pegasus.server", "scan_pushdown_enabled", True,
+            "evaluate GetScannerRequest.pushdown specs (value filters "
+            "+ aggregates) inside the scan-page path; off simulates a "
+            "pre-pushdown server — specs are ignored, pushdown_applied "
+            "stays False, clients fall back to local evaluation",
+            mutable=True)
 
 # the no-filter flavor's mask key component (and the normal form of any
 # empty-pattern filter, which matches everything)
@@ -285,6 +295,14 @@ class PartitionServer:
         # elapsed seconds
         self._mask_cache: "OrderedDict[tuple, np.ndarray]" = OrderedDict()
         self._mask_cache_cap = 4096
+        # value-filter keep masks keyed by (ckey, (type, pattern)):
+        # the pushdown twin of _mask_cache — a block's value bytes are
+        # immutable, so the vectorized region match runs once per
+        # (block, pattern) lifetime, like the static key masks. Not
+        # part of the device mask flavors: value heaps never ride the
+        # device (placement class "scan_pushdown" routes host)
+        self._vmask_cache: "OrderedDict[tuple, np.ndarray]" = OrderedDict()
+        self._vmask_cache_cap = 8192
         # mask/device caches are shared with the MaskPrefresher thread
         self._mask_lock = threading.Lock()
         # scan flavors (validate, filter_key) seen recently: after a
@@ -353,6 +371,9 @@ class PartitionServer:
             for mkey in [k for k in self._mask_cache
                          if k[0][0] not in live_paths]:
                 del self._mask_cache[mkey]
+            for vkey in [k for k in self._vmask_cache
+                         if k[0][0] not in live_paths]:
+                del self._vmask_cache[vkey]
             for ckey in [k for k in self._device_block_cache
                          if k[0] not in live_paths]:
                 del self._device_block_cache[ckey]
@@ -1678,6 +1699,8 @@ class PartitionServer:
         max_bytes: int,
         reverse: bool = False,
         with_values: bool = True,
+        value_filter=None,
+        pd_stats=None,
     ) -> Tuple[List[Tuple[bytes, bytes, int]], bool, Optional[bytes]]:
         """Core ranged read: iterate candidates, device-validate in batches.
 
@@ -1685,13 +1708,18 @@ class PartitionServer:
         (key, user_data, expire_ts) triples that passed every predicate,
         exhausted means the range completed, and resume_key is where a
         follow-up should continue when not exhausted.
+
+        `value_filter`: normalized (type, pattern) pushdown value
+        predicate ANDed into the keep mask; `pd_stats` accumulates its
+        "pruned" count (rows key-alive but value-rejected).
         """
         sorted_runs = None if reverse else self.engine.lsm.sorted_runs()
         if sorted_runs is not None:
             return self._columnar_scan(sorted_runs, start_key, stop_key,
                                        now, hash_filter, sort_filter,
                                        validate_hash, limiter, max_records,
-                                       max_bytes, with_values)
+                                       max_bytes, with_values,
+                                       value_filter, pd_stats)
 
         out: List[Tuple[bytes, bytes, int]] = []
         out_bytes = 0
@@ -1713,8 +1741,18 @@ class PartitionServer:
             for i, (key, value, ets) in enumerate(batch):
                 if not keep[i]:
                     continue
-                data = (extract_user_data(self.data_version, value)
-                        if with_values else b"")
+                if value_filter is not None:
+                    ud = extract_user_data(self.data_version, value)
+                    if not host_match_filter(ud, value_filter[0],
+                                             value_filter[1]):
+                        if pd_stats is not None:
+                            pd_stats["pruned"] = \
+                                pd_stats.get("pruned", 0) + 1
+                        continue
+                    data = ud if with_values else b""
+                else:
+                    data = (extract_user_data(self.data_version, value)
+                            if with_values else b"")
                 out.append((key, data, ets))
                 out_bytes += len(key) + len(data)
                 if ((max_records > 0 and len(out) >= max_records)
@@ -1747,6 +1785,8 @@ class PartitionServer:
         max_records: int,
         max_bytes: int,
         with_values: bool,
+        value_filter=None,
+        pd_stats=None,
     ) -> Tuple[List[Tuple[bytes, bytes, int]], bool, Optional[bytes]]:
         """Fast path: the store is a sequence of non-overlapping sorted L1
         runs with no overlay, so SST blocks stream columnar through the
@@ -1823,6 +1863,16 @@ class PartitionServer:
                 if expired:
                     self._abnormal_reads.increment(expired)
                 keep = static_keep[:n] & alive
+                if value_filter is not None:
+                    # the pushdown value leg joins the mask algebra:
+                    # cached per (block, pattern) like the static keep
+                    vmask = self._value_mask(ckey, blk, value_filter)
+                    before = int(np.count_nonzero(keep[lo:hi]))
+                    keep = keep & vmask[:n]
+                    if pd_stats is not None:
+                        pd_stats["pruned"] = (
+                            pd_stats.get("pruned", 0) + before
+                            - int(np.count_nonzero(keep[lo:hi])))
                 stop_early = False
                 for i in np.flatnonzero(keep[lo:hi]):
                     idx = lo + int(i)
@@ -1993,6 +2043,61 @@ class PartitionServer:
             return int(StorageStatus.INCOMPLETE), len(records)
         return int(StorageStatus.OK), len(records)
 
+    # ---- scan pushdown (ops/pushdown.py) ------------------------------
+
+    def _pushdown_of(self, req: GetScannerRequest):
+        """The request's PushdownSpec when this server will evaluate it,
+        else None: no spec, an empty spec (nothing to push down), or the
+        kill switch is off — the "pre-pushdown server" case the soft
+        version gate is about (the spec is IGNORED, pushdown_applied
+        stays False, and the client evaluates locally)."""
+        spec = getattr(req, "pushdown", None)
+        if spec is None:
+            return None
+        if not FLAGS.get("pegasus.server", "scan_pushdown_enabled"):
+            return None
+        spec.check()  # ValueError -> ERR_INVALID_PARAMETERS at the stub
+        if spec.value_filter is None and not spec.aggregate:
+            return None
+        return spec
+
+    def _value_mask(self, ckey, blk, vf) -> np.ndarray:
+        """bool[count] value-filter keep mask for one SST block, cached
+        per (block, filter) — the value-side leg of the static/dynamic
+        predicate split. Forcing blk.value_heap materializes a lazy
+        compressed heap, which the filter needs anyway; the mask then
+        outlives the decode. The kernel wave is audited against the
+        placement cost model like the key-mask waves."""
+        vkey = (ckey, vf)
+        with self._mask_lock:
+            hit = self._vmask_cache.get(vkey)
+            if hit is not None:
+                self._vmask_cache.move_to_end(vkey)
+                return hit
+        heap = blk.value_heap
+        t0 = time.perf_counter()
+        mask = pushdown_ops.value_filter_mask(
+            heap, blk.value_offs, header_length(self.data_version),
+            vf[0], vf[1])
+        measured = time.perf_counter() - t0
+        from pegasus_tpu.ops.placement import predict_kernel_seconds
+        from pegasus_tpu.server.workload import DRIFT
+        from pegasus_tpu.utils import perf_context as perf
+
+        predicted = predict_kernel_seconds("scan_pushdown",
+                                           int(np.asarray(heap).size))
+        DRIFT.note("scan_pushdown", predicted, measured)
+        pc = perf.current()
+        if pc is not None:
+            pc.predicted_kernel_ms += predicted * 1000.0
+            pc.measured_kernel_ms += measured * 1000.0
+            pc.placement = pc.placement or "numpy"
+        with self._mask_lock:
+            self._vmask_cache[vkey] = mask
+            while len(self._vmask_cache) > self._vmask_cache_cap:
+                self._vmask_cache.popitem(last=False)
+        return mask
+
     # ---- scanners -----------------------------------------------------
 
     def on_get_scanner(self, req: GetScannerRequest) -> ScanResponse:
@@ -2024,13 +2129,15 @@ class PartitionServer:
             resp.context_id = SCAN_CONTEXT_ID_NOT_EXIST
             return resp
         return self._serve_scan_batch(ctx.request, ctx.resume_key,
-                                      ctx.stop_key)
+                                      ctx.stop_key,
+                                      agg_state=ctx.agg_state)
 
     def on_clear_scanner(self, context_id: int) -> None:
         self._scan_cache.remove(context_id)
 
     def _serve_scan_batch(self, req: GetScannerRequest, start_key: bytes,
-                          stop_key: bytes) -> ScanResponse:
+                          stop_key: bytes,
+                          agg_state=None) -> ScanResponse:
         from pegasus_tpu.utils import perf_context as perf
         from pegasus_tpu.utils.latency_tracer import LatencyTracer
 
@@ -2046,7 +2153,8 @@ class PartitionServer:
         try:
             with perf.activate(pc):
                 return self._serve_scan_batch_inner(req, start_key,
-                                                    stop_key, tracer)
+                                                    stop_key, tracer,
+                                                    agg_state)
         finally:
             elapsed_ms = (time.perf_counter() - t0) * 1000.0
             self._read_latency.set(elapsed_ms)
@@ -2058,7 +2166,15 @@ class PartitionServer:
     def _serve_scan_batch_inner(self, req: GetScannerRequest,
                                 start_key: bytes,
                                 stop_key: bytes,
-                                tracer=None) -> ScanResponse:
+                                tracer=None,
+                                agg_state=None) -> ScanResponse:
+        pd = self._pushdown_of(req)
+        if pd is not None and pd.aggregate:
+            return self._pushdown_aggregate_page(req, pd, start_key,
+                                                 stop_key, tracer,
+                                                 agg_state)
+        vf = pd.value_filter if pd is not None else None
+        pd_stats: dict = {}
         now = epoch_now()
         resp = ScanResponse()
         limiter = RangeReadLimiter()
@@ -2079,9 +2195,12 @@ class PartitionServer:
                            and self.validate_partition_hash),
             limiter=limiter, max_records=batch_size,
             max_bytes=-1 if req.only_return_count else SCAN_BYTES_CAP,
-            with_values=not req.no_value and not req.only_return_count)
+            with_values=not req.no_value and not req.only_return_count,
+            value_filter=vf, pd_stats=pd_stats)
         if tracer is not None:
             tracer.add_point("block_scan")
+            if pd is not None:
+                tracer.add_point("pushdown")
         if req.only_return_count:
             resp.kv_count = len(records)
         else:
@@ -2095,6 +2214,7 @@ class PartitionServer:
             self.cu.add_read(size)
         if tracer is not None:
             tracer.add_point("assemble")
+        pruned = pd_stats.get("pruned", 0)
         pc = tracer.perf if tracer is not None else None
         if pc is not None:
             pc.ops += 1
@@ -2103,8 +2223,12 @@ class PartitionServer:
             pc.keys_resolved += len(records)
             pc.bytes_returned += sum(len(k) + len(d)
                                      for k, d, _e in records)
+            pc.pushdown_rows_pruned += pruned
         self.workload.note_scan(1, limiter.iteration_count,
                                 len(records))
+        if pd is not None:
+            self.workload.note_pushdown(1, pruned, 0)
+            resp.pushdown_applied = True
         resp.error = int(StorageStatus.OK)
         if exhausted or req.one_page:
             # one_page: the client promised not to page further — no
@@ -2114,6 +2238,156 @@ class PartitionServer:
             resp.context_id = self._scan_cache.put(ScanContext(
                 request=req, resume_key=resume_key or start_key,
                 stop_key=stop_key))
+        return resp
+
+    def _pushdown_aggregate_page(self, req: GetScannerRequest, pd,
+                                 start_key: bytes, stop_key: bytes,
+                                 tracer=None,
+                                 agg_state=None) -> ScanResponse:
+        """Aggregate-mode pushdown: fold one (limiter-bounded) slice of
+        the range into the partition's PARTIAL aggregate instead of
+        returning rows. The partial rides server-side in the scan
+        context across pages and ships ONLY on the final page — one agg
+        payload per partition on the wire, and a lost context (expiry,
+        split bounce) loses the partial WITH the pages it counted, so
+        the client's restart-from-original-start never double counts.
+
+        Columnar arm: the same cached static masks + host TTL AND as
+        _columnar_scan, but survivors feed AggState.fold_columnar — a
+        count folds off the mask alone (a lazy compressed value heap
+        stays undecoded unless the value filter already forced it);
+        sum/top_k/sample gather straight from the raw value heap."""
+        now = epoch_now()
+        resp = ScanResponse()
+        limiter = RangeReadLimiter()
+        vf = pd.value_filter
+        pd_stats: dict = {}
+        state = (agg_state if agg_state is not None
+                 else pushdown_ops.AggState(pd))
+        folded0 = state.count
+        hash_filter = FilterSpec.make(req.hash_key_filter_type,
+                                      req.hash_key_filter_pattern)
+        sort_filter = FilterSpec.make(req.sort_key_filter_type,
+                                      req.sort_key_filter_pattern)
+        validate = bool(req.validate_partition_hash
+                        and self.validate_partition_hash)
+        hdr = header_length(self.data_version)
+        stop = stop_key or None
+        if tracer is not None:
+            tracer.add_point("plan")
+        exhausted = True
+        resume_key: Optional[bytes] = None
+        sorted_runs = self.engine.lsm.sorted_runs()
+        if sorted_runs is not None:
+            from pegasus_tpu.ops.predicates import host_alive_mask
+
+            filter_key = hash_filter.key + sort_filter.key
+            with self._mask_lock:
+                self._register_flavor(validate, filter_key,
+                                      time.monotonic())
+
+            def ranged_blocks():
+                for run in sorted_runs:
+                    if stop is not None and (run.first_key or b"") >= stop:
+                        continue
+                    if start_key and (run.last_key or b"") < start_key:
+                        continue
+                    for bm_blk in run.iter_blocks(start_key, stop):
+                        yield run, bm_blk
+
+            LOOKAHEAD = 8
+            blocks_iter = ranged_blocks()
+            done_iter = False
+            stopped = False
+            while not stopped:
+                window = []
+                while not done_iter and len(window) < LOOKAHEAD:
+                    nxt = next(blocks_iter, None)
+                    if nxt is None:
+                        done_iter = True
+                        break
+                    run, (bm, blk) = nxt
+                    lo, hi = 0, blk.count
+                    if start_key and bm.first_key < start_key:
+                        lo = _lower_bound(blk, start_key)
+                    if stop is not None and bm.last_key >= stop:
+                        hi = _lower_bound(blk, stop)
+                    limiter.add_count(hi - lo)
+                    window.append(((run.path, bm.offset), blk, lo, hi))
+                if not window:
+                    break
+                keeps = self._static_keep_window(window, validate,
+                                                 hash_filter, sort_filter,
+                                                 filter_key)
+                for (ckey, blk, lo, hi), static_keep in zip(window,
+                                                            keeps):
+                    n = blk.count
+                    alive = host_alive_mask(blk.expire_ts, now)
+                    expired = int(np.count_nonzero(~alive[lo:hi]))
+                    if expired:
+                        self._abnormal_reads.increment(expired)
+                    keep = static_keep[:n] & alive
+                    if vf is not None:
+                        vmask = self._value_mask(ckey, blk, vf)
+                        before = int(np.count_nonzero(keep[lo:hi]))
+                        keep = keep & vmask[:n]
+                        pd_stats["pruned"] = (
+                            pd_stats.get("pruned", 0) + before
+                            - int(np.count_nonzero(keep[lo:hi])))
+                    sel = np.flatnonzero(keep[lo:hi]) + lo
+                    if sel.size:
+                        if pd.aggregate == "count":
+                            state.fold_columnar(sel)
+                        else:
+                            state.fold_columnar(
+                                sel, heap=blk.value_heap,
+                                value_offs=blk.value_offs, hdr=hdr,
+                                key_at=blk.key_at)
+                    if not limiter.valid():
+                        resume_key = _after(blk.key_at(n - 1))
+                        exhausted = False
+                        stopped = True
+                        break
+        else:
+            # overlay / reverse-free generic arm: the iterator merge
+            # already applies newest-wins shadowing and tombstones, so
+            # scalar folds over its survivors are exact
+            records, exhausted, resume_key = self._batched_scan(
+                start_key, stop, now, hash_filter, sort_filter,
+                validate, limiter, max_records=-1, max_bytes=-1,
+                with_values=(pd.aggregate != "count"),
+                value_filter=vf, pd_stats=pd_stats)
+            for key, data, _ets in records:
+                state.fold_row(key, data)
+        if tracer is not None:
+            tracer.add_point("block_scan")
+            tracer.add_point("pushdown")
+        folded = state.count - folded0
+        pruned = pd_stats.get("pruned", 0)
+        pc = tracer.perf if tracer is not None else None
+        if pc is not None:
+            pc.ops += 1
+            pc.rows_evaluated += limiter.iteration_count
+            pc.rows_survived += folded
+            pc.keys_resolved += folded
+            pc.rows_aggregated += folded
+            pc.pushdown_rows_pruned += pruned
+            pc.placement = pc.placement or "numpy"
+        self.workload.note_scan(1, limiter.iteration_count, folded)
+        self.workload.note_pushdown(1, pruned, folded)
+        resp.pushdown_applied = True
+        resp.error = int(StorageStatus.OK)
+        if exhausted or req.one_page:
+            resp.context_id = SCAN_CONTEXT_ID_COMPLETED
+            resp.agg = state.to_wire()
+        else:
+            # NOT final: no agg on the wire; the partial continues
+            # server-side under a fresh context id
+            resp.context_id = self._scan_cache.put(ScanContext(
+                request=req, resume_key=resume_key or start_key,
+                stop_key=stop_key, agg_state=state))
+        if tracer is not None:
+            tracer.add_point("assemble")
         return resp
 
     # ---- batched multi-scan (the request-batching dispatch unit of
@@ -2208,17 +2482,29 @@ class PartitionServer:
             filters = {_normalize_filter_key(r) for r in reqs}
         known = (FT_NO_FILTER, FT_MATCH_ANYWHERE, FT_MATCH_PREFIX,
                  FT_MATCH_POSTFIX)
+        # pushdown on the batched path: ONE shared value filter rides
+        # the live-mask machinery (it is part of the live-cache key,
+        # like the key filters are part of the mask key); aggregates
+        # serve per-request (their reply shape is a partial, not a
+        # page), as do mixed-filter batches
+        pdl = [self._pushdown_of(r) for r in reqs]
+        vfs = {pd.value_filter if pd is not None else None for pd in pdl}
         simple = (runs and overlay_count <= self.OVERLAY_MERGE_LIMIT
                   and len(validates) == 1 and len(filters) == 1
                   and all(f[0] in known and f[2] in known
                           for f in filters)
-                  and not any(r.only_return_count for r in reqs))
+                  and not any(r.only_return_count for r in reqs)
+                  and len(vfs) == 1
+                  and not any(pd is not None and pd.aggregate
+                              for pd in pdl))
         if not simple:
             return None
         now = epoch_now() if now is None else now
         validate = validates.pop()
         filter_key = filters.pop()
-        overlay = self._overlay_snapshot(now, validate, filter_key) \
+        vf = vfs.pop()
+        overlay = self._overlay_snapshot(now, validate, filter_key,
+                                         value_filter=vf) \
             if overlay_count else ([], {})
         # 1 — per request: the block list + boundary bounds, capped a bit
         # beyond batch_size so expiry/hash drops don't starve the page.
@@ -2304,8 +2590,8 @@ class PartitionServer:
             ppc.runs_considered += len(runs)
         return {"reqs": reqs, "req_plans": req_plans, "unique": unique,
                 "validate": validate, "now": now, "overlay": overlay,
-                "filter_key": filter_key, "t0": t0, "tracer": tracer,
-                "perf": ppc}
+                "filter_key": filter_key, "vf": vf, "pd_list": pdl,
+                "t0": t0, "tracer": tracer, "perf": ppc}
 
     def planned_misses(self, state) -> "OrderedDict[tuple, object]":
         """Unique planned blocks whose STATIC masks are NOT cached (the
@@ -2527,29 +2813,32 @@ class PartitionServer:
 
         unique = state["unique"]
         now = state["now"]
+        vf = state.get("vf")
         live_masks = {}
         live_ptrs = {}
         alive_all = {}
         exp_full = {}
+        pushdown_pruned = 0
         cache = self._live_cache
         for ckey, (_run, _bm, blk) in unique.items():
             ets = blk.expire_ts
             static = keep_masks[ckey]
-            # (block, flavor-mask, second) live-mask cache: TTL validity
-            # is one second, so every batch within the second reuses the
-            # same static AND alive result instead of recomputing it —
-            # zipfian traffic hits the same hot blocks thousands of
-            # times per second
-            lkey = (ckey, id(static))
+            # (block, flavor-mask, value-filter, second) live-mask
+            # cache: TTL validity is one second, so every batch within
+            # the second reuses the same static AND alive (AND value
+            # mask) result instead of recomputing it — zipfian traffic
+            # hits the same hot blocks thousands of times per second
+            lkey = (ckey, id(static), vf)
             hit = cache.get(lkey)
             # the entry pins the static array it was built from (id()
             # alone could be a recycled address after a mask evict)
             if hit is not None and hit[0] == now and hit[1] is static:
-                _now, _st, alive, exp, live, lptr = hit
+                _now, _st, alive, exp, live, lptr, prn = hit
                 alive_all[ckey] = alive
                 exp_full[ckey] = exp
                 live_masks[ckey] = live
                 live_ptrs[ckey] = lptr
+                pushdown_pruned += prn
                 continue
             alive = blk.alive_mask(now)
             alive_all[ckey] = alive
@@ -2559,6 +2848,16 @@ class PartitionServer:
             exp = len(alive) - int(np.count_nonzero(alive))
             exp_full[ckey] = exp
             live = static[:len(ets)] & alive
+            prn = 0
+            if vf is not None:
+                # the shared pushdown value filter joins the live mask
+                # (cached per block+pattern in _value_mask); pruned =
+                # key-alive rows the VALUE predicate dropped
+                before = int(np.count_nonzero(live))
+                live = live & self._value_mask(ckey, blk,
+                                               vf)[:len(ets)]
+                prn = before - int(np.count_nonzero(live))
+            pushdown_pruned += prn
             live_masks[ckey] = live
             # .ctypes.data costs ~a µs: resolve once per (block, flavor,
             # second), not once per request window (page.serve_batch
@@ -2567,7 +2866,8 @@ class PartitionServer:
             live_ptrs[ckey] = lptr
             if len(cache) >= 4096:
                 cache.pop(next(iter(cache)))
-            cache[lkey] = (now, static, alive, exp, live, lptr)
+            cache[lkey] = (now, static, alive, exp, live, lptr, prn)
+        state["pushdown_pruned"] = pushdown_pruned
         overlay_keys, _overlay_map = state["overlay"]
         windows = []
         fast = []
@@ -2596,6 +2896,8 @@ class PartitionServer:
         state["fast"] = fast
         tracer = state.get("tracer")
         if tracer is not None:
+            if vf is not None:
+                tracer.add_point("pushdown")
             tracer.add_point("decode")
         return fast
 
@@ -2807,6 +3109,12 @@ class PartitionServer:
             total_read_cu += cu_units(size)
             resp = ScanResponse()
             resp.kvs = kvs
+            # pd_list aligns with reqs/req_plans order; len(out) is the
+            # current request's index (appends happen once per loop)
+            pd_list = state.get("pd_list")
+            resp.pushdown_applied = bool(pd_list
+                                         and pd_list[len(out)]
+                                         is not None)
             resp.error = int(StorageStatus.OK)
             if exhausted or req.one_page:
                 resp.context_id = SCAN_CONTEXT_ID_COMPLETED
@@ -2825,6 +3133,11 @@ class PartitionServer:
         # table's scan SELECTIVITY — what a server-side pushdown saves
         rows_eval = sum(b.count for _r, _bm, b in unique.values())
         self.workload.note_scan(len(reqs), rows_eval, total_rows)
+        pd_pruned = state.get("pushdown_pruned", 0)
+        n_pushdown = sum(1 for pd in state.get("pd_list") or ()
+                         if pd is not None)
+        if n_pushdown:
+            self.workload.note_pushdown(n_pushdown, pd_pruned, 0)
         pc = state.get("perf")
         if pc is not None:
             pc.rows_evaluated += rows_eval
@@ -2832,6 +3145,7 @@ class PartitionServer:
             pc.expired_rows += total_expired
             pc.bytes_returned += total_bytes
             pc.keys_resolved += total_rows
+            pc.pushdown_rows_pruned += pd_pruned
             sp = (state["tracer"].span
                   if state.get("tracer") is not None else None)
             if sp is not None:
@@ -2857,14 +3171,17 @@ class PartitionServer:
     OVERLAY_MERGE_LIMIT = 4096
 
     def _overlay_snapshot(self, now: int, validate: bool,
-                          filter_key=None):
+                          filter_key=None, value_filter=None):
         """(sorted_keys, key -> None|(user_data, ets)) for the memtable +
         L0 overlay, newest-wins, with the scan predicates (TTL, stale-
         split hash, and the batch's shared key filter) evaluated
         HOST-side — the overlay is tiny by the fast-path qualifier, so a
         device dispatch would cost more than it filters. A key failing
-        the filter is excluded entirely (its base copies fail the same
-        filter in the device mask, so nothing needs shadowing)."""
+        the KEY filter is excluded entirely (its base copies fail the
+        same filter in the device mask, so nothing needs shadowing); a
+        row failing the pushdown VALUE filter must instead stay as a
+        hidden SHADOW (None) — the base may hold an older value for the
+        same key that would pass, and newest-wins must still hide it."""
         from pegasus_tpu.base.key_schema import check_key_hash, restore_key
         from pegasus_tpu.ops.predicates import host_match_filter
         from pegasus_tpu.storage.memtable import TOMBSTONE
@@ -2902,7 +3219,12 @@ class PartitionServer:
                                                self.partition_version):
                 out[key] = None
                 continue
-            out[key] = (extract_user_data(self.data_version, value), ets)
+            data = extract_user_data(self.data_version, value)
+            if value_filter is not None and not host_match_filter(
+                    data, value_filter[0], value_filter[1]):
+                out[key] = None  # value-rejected: hidden, still shadows
+                continue
+            out[key] = (data, ets)
         return list(out), out  # insertion order is already sorted
 
     def _eval_blocks_stacked(self, misses, filter_key, validate):

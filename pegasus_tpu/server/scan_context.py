@@ -27,6 +27,13 @@ class ScanContext:
     resume_key: bytes            # next full key to seek (exclusive of served)
     stop_key: bytes              # effective exclusive upper bound
     last_used: float = field(default_factory=time.monotonic)
+    # aggregate-mode pushdown: the partition's PARTIAL aggregate
+    # (ops/pushdown.AggState) accumulated so far, carried server-side
+    # across pages so the partial ships exactly once (final page). A
+    # lost context loses the partial WITH the pages it counted — the
+    # client restarts the partition from its original start key, so
+    # nothing double counts
+    agg_state: Optional[object] = None
 
 
 class ScanContextCache:

@@ -63,14 +63,19 @@ def scan_multi(servers_and_reqs: List[Tuple[object, list]],
     for server, reqs in servers_and_reqs:
         groups: "OrderedDict[tuple, list]" = OrderedDict()
         for i, r in enumerate(reqs):
+            # pushdown identity joins the GROUP key (one request with a
+            # different value filter or an aggregate must not knock the
+            # whole flavor off the batched path) but not the plan
+            # flavor — the device mask inputs are key-side only
             fl = (bool(r.validate_partition_hash
                        and server.validate_partition_hash),
-                  _normalize_filter_key(r))
+                  _normalize_filter_key(r),
+                  r.pushdown.key if r.pushdown is not None else None)
             groups.setdefault(fl, []).append(i)
         sub = []
         for fl, idxs in groups.items():
             state = server.plan_scan_batch([reqs[i] for i in idxs],
-                                           now=now, flavor=fl)
+                                           now=now, flavor=fl[:2])
             sub.append((idxs, state))
         states.append((server, reqs, sub))
 

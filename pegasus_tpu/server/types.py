@@ -9,7 +9,7 @@ from __future__ import annotations
 
 import enum
 from dataclasses import dataclass, field
-from typing import List, Optional
+from typing import Any, Dict, List, Optional
 
 from pegasus_tpu.ops.predicates import (
     FT_MATCH_ANYWHERE,
@@ -17,6 +17,7 @@ from pegasus_tpu.ops.predicates import (
     FT_MATCH_PREFIX,
     FT_NO_FILTER,
 )
+from pegasus_tpu.ops.pushdown import PushdownSpec
 
 
 class CasCheckType(enum.IntEnum):
@@ -289,6 +290,12 @@ class GetScannerRequest:
     # context — the client promises not to page further, saving it the
     # clear_scanner round-trip (the YCSB-E "scan N records" shape)
     one_page: bool = False
+    # server-side pushdown (ops/pushdown.py): a value-region filter
+    # and/or an aggregate evaluated inside the scan-page path. A server
+    # that predates (or has disabled) pushdown simply ignores this
+    # field and leaves `pushdown_applied` False on its responses — the
+    # soft version gate clients detect to fall back to local evaluation
+    pushdown: Optional[PushdownSpec] = None
 
 
 @dataclass
@@ -302,6 +309,34 @@ class ScanResponse:
     kvs: List[KeyValue] = field(default_factory=list)
     context_id: int = -1
     kv_count: int = -1
+    # True iff the server evaluated the request's PushdownSpec for this
+    # page (False from pre-pushdown / pushdown-disabled servers)
+    pushdown_applied: bool = False
+    # aggregate-mode only: the partition's PARTIAL aggregate in
+    # ops/pushdown wire form (AggState.to_wire), carried ONLY on the
+    # final page of the partition's scan so a lost context / split
+    # bounce can restart from scratch without double counting
+    agg: Optional[Dict[str, Any]] = None
+
+    def wire_bytes(self) -> int:
+        """Approximate serialized size of this response — what the
+        shipped-bytes counters accumulate to assert aggregate-mode
+        replies stay O(partitions), not O(rows), on the wire."""
+        n = 24  # error/context_id/kv_count/flags framing
+        kvs = self.kvs
+        if isinstance(kvs, ScanPage):
+            n += (len(kvs.key_offs) + len(kvs.key_blob)
+                  + len(kvs.val_offs) + len(kvs.val_blob)
+                  + len(kvs.ets))
+        else:
+            for kv in kvs:
+                n += 8 + len(kv.key) + len(kv.value)
+        if self.agg is not None:
+            n += 64
+            for it in self.agg.get("items") or ():
+                n += 16 + sum(len(x) for x in it
+                              if isinstance(x, (bytes, bytearray)))
+        return n
 
 
 # scan context ids (parity: src/base/pegasus_const.h SCAN_CONTEXT_ID_*)

@@ -173,6 +173,16 @@ class WorkloadStats:
         # win is exactly 100 minus this)
         self._selectivity = ent.percentile("workload_scan_selectivity")
         self._hot_share = ent.gauge("workload_hot_share")
+        # pushdown scans (requests carrying a PushdownSpec the server
+        # evaluated) vs plain scans: workload_scan_ops counts BOTH, this
+        # counts the pushdown subset so `shell workload` can label the
+        # mix. The pruned/aggregated counters are the metric twins of
+        # the PerfContext fields of the same names (same kind, so
+        # metrics_lint's conflict rule holds) — EXPLAIN reconciles a
+        # pushdown scan's cost vector against these deltas
+        self._pushdown_ops = ent.counter("workload_pushdown_ops")
+        self._pushdown_pruned = ent.counter("pushdown_rows_pruned")
+        self._rows_aggregated = ent.counter("rows_aggregated")
 
     # -- feed sites (serving paths) -------------------------------------
 
@@ -189,6 +199,16 @@ class WorkloadStats:
         if rows_evaluated > 0:
             self._selectivity.set(
                 100.0 * rows_survived / rows_evaluated)
+
+    def note_pushdown(self, reqs: int, rows_pruned: int,
+                      rows_aggregated: int) -> None:
+        """Pushdown leg of a scan flush (always paired with a
+        note_scan for the same requests — pushdown scans ARE scans)."""
+        self._pushdown_ops.increment(reqs)
+        if rows_pruned > 0:
+            self._pushdown_pruned.increment(rows_pruned)
+        if rows_aggregated > 0:
+            self._rows_aggregated.increment(rows_aggregated)
 
     def note_write(self, ops: int, rows: int, value_sizes=()) -> None:
         self._write_ops.increment(ops)
@@ -220,6 +240,7 @@ class WorkloadStats:
         return {
             "read_ops": self._read_ops.value(),
             "scan_ops": self._scan_ops.value(),
+            "pushdown_ops": self._pushdown_ops.value(),
             "write_ops": self._write_ops.value(),
             "read_batch_p50": rb[0], "read_batch_p99": rb[1],
             "write_batch_p50": wb[0], "write_batch_p99": wb[1],
@@ -236,12 +257,12 @@ def fold_summaries(rows) -> dict:
     (max — the honest aggregate, same rule the collector applies to
     latency percentiles), shares take the max."""
     out = {"partitions": 0, "read_ops": 0, "scan_ops": 0,
-           "write_ops": 0, "read_batch_p99": 0.0,
+           "pushdown_ops": 0, "write_ops": 0, "read_batch_p99": 0.0,
            "write_batch_p99": 0.0, "value_bytes_p99": 0.0,
            "scan_selectivity_p50": 0.0, "hot_share": 0.0}
     for row in rows:
         out["partitions"] += 1
-        for k in ("read_ops", "scan_ops", "write_ops"):
+        for k in ("read_ops", "scan_ops", "pushdown_ops", "write_ops"):
             out[k] += int(row.get(k, 0))
         for k in ("read_batch_p99", "write_batch_p99",
                   "value_bytes_p99", "scan_selectivity_p50",

@@ -31,7 +31,8 @@ _ROUND_RE = re.compile(r"^BENCH_r(\d+)\.json$")
 _HEADLINE_PREFS = (
     "phash_qps", "filtered_qps", "row_cache_qps", "accel_qps",
     "read_qps", "write_qps", "qps", "records_per_s",
-    "accel_records_per_s", "effective_gbps", "speedup", "ratio",
+    "accel_records_per_s", "effective_gbps", "pushdown_speedup",
+    "speedup", "ratio",
 )
 
 

@@ -1803,6 +1803,8 @@ def _dispatch(args, box, out) -> int:
                       f"{fold.get('partitions', 0)} partitions  "
                       f"reads={fold.get('read_ops', 0)} "
                       f"scans={fold.get('scan_ops', 0)} "
+                      f"(pushdown {fold.get('pushdown_ops', 0)}, "
+                      f"plain {max(0, fold.get('scan_ops', 0) - fold.get('pushdown_ops', 0))}) "
                       f"writes={fold.get('write_ops', 0)}  "
                       f"selectivity_p50="
                       f"{fold.get('scan_selectivity_p50', 0.0)}%  "

@@ -82,6 +82,11 @@ _COUNTER_FIELDS = (
     perf_field("expired_rows", "counter"),      # TTL-dropped
     perf_field("overlay_hits", "counter"),      # memtable/L0 answers
     perf_field("bytes_returned", "counter"),    # key+value bytes out
+    # scan pushdown (ops/pushdown.py): rows the server-side value
+    # filter dropped before they could ship, and rows folded into a
+    # server-side partial aggregate instead of being returned
+    perf_field("pushdown_rows_pruned", "counter"),
+    perf_field("rows_aggregated", "counter"),
 )
 # gauges: per-op measurements
 _GAUGE_FIELDS = (

@@ -1,0 +1,484 @@
+"""Scan pushdown acceptance: server-side value filters + aggregates
+must be byte-identical to client-side evaluation over every store shape
+(mixed codecs, overlay rows, empty-hashkey overflow rows, TTL expiry
+mid-scan), ship O(partitions) aggregate bytes on the wire, survive
+context loss without double counting, and reconcile EXPLAIN's cost
+vector against the workload profiler's metric deltas."""
+
+import time
+
+import pytest
+
+from pegasus_tpu.base.key_schema import generate_key
+from pegasus_tpu.client.client import PegasusClient, ScanOptions
+from pegasus_tpu.client.table import Table
+from pegasus_tpu.ops.predicates import (
+    FT_MATCH_ANYWHERE,
+    FT_MATCH_POSTFIX,
+    FT_MATCH_PREFIX,
+    host_match_filter,
+)
+from pegasus_tpu.ops.pushdown import PushdownSpec, value_as_u64
+from pegasus_tpu.server.partition_server import PartitionServer
+from pegasus_tpu.server.types import (
+    GetScannerRequest,
+    SCAN_CONTEXT_ID_COMPLETED,
+)
+from pegasus_tpu.utils.errors import StorageStatus
+from pegasus_tpu.utils.flags import FLAGS
+
+OK = int(StorageStatus.OK)
+
+
+@pytest.fixture
+def flags_guard():
+    saved = [(sec, name, FLAGS.get(sec, name)) for sec, name in (
+        ("pegasus.storage", "block_codec"),
+        ("pegasus.server", "scan_pushdown_enabled"),
+        ("pegasus.server", "rocksdb_max_iteration_count"),
+    )]
+    yield
+    for sec, name, val in saved:
+        FLAGS.set(sec, name, val)
+
+
+def put(s, hk, sk, v, ttl=0):
+    assert s.on_put(generate_key(hk, sk), v, ttl) == OK
+
+
+def drain(s, req):
+    """Page a scan to exhaustion; returns (rows, shipped_bytes, agg)."""
+    rows, shipped = [], 0
+    resp = s.on_get_scanner(req)
+    while True:
+        assert resp.error == OK
+        shipped += resp.wire_bytes()
+        rows.extend((kv.key, kv.value) for kv in resp.kvs)
+        if resp.context_id == SCAN_CONTEXT_ID_COMPLETED:
+            return rows, shipped, resp.agg
+        resp = s.on_scan(resp.context_id)
+
+
+def vf_req(pat, ft=FT_MATCH_ANYWHERE, agg="", k=0, seed=0, **kw):
+    pd = PushdownSpec(value_filter_type=ft, value_filter_pattern=pat,
+                      aggregate=agg, k=k, seed=seed)
+    return GetScannerRequest(pushdown=pd, **kw)
+
+
+def build_mixed_store(tmp_path, flags_guard):
+    """One partition whose range crosses every storage shape: three SST
+    codec generations (none/dcz/dcz2), empty-hashkey overflow rows, and
+    an unflushed overlay generation that SHADOWS some base rows."""
+    s = PartitionServer(str(tmp_path / "p0"))
+    i = 0
+    for codec in ("none", "dcz", "dcz2"):
+        FLAGS.set("pegasus.storage", "block_codec", codec)
+        for _ in range(80):
+            v = b"blue-%04d" % i if i % 5 == 0 else b"red-%04d" % i
+            put(s, b"hk%02d" % (i % 4), b"s%05d" % i, v)
+            i += 1
+        # dcz2 groups rows by hashkey hash; empty hashkeys ride its
+        # overflow slots — the shape that breaks group-constant paths
+        put(s, b"", b"osk%02d" % (i % 7), b"blue-ovf-%d" % i)
+        i += 1
+        s.engine.flush()
+    # overlay generation: newest-wins shadows over flushed base copies,
+    # including a value-REJECTED overwrite of a previously-matching row
+    put(s, b"hk00", b"s%05d" % 0, b"red-shadowed")     # was blue-0000
+    put(s, b"hk01", b"s%05d" % 77, b"blue-promoted")   # was red-0077
+    put(s, b"hknew", b"s0", b"blue-overlay-only")
+    return s
+
+
+def client_filtered(s, pat, ft=FT_MATCH_ANYWHERE, **kw):
+    rows, shipped, _ = drain(s, GetScannerRequest(**kw))
+    return [(k, v) for k, v in rows if host_match_filter(v, ft, pat)], \
+        shipped
+
+
+def test_filter_identity_mixed_codecs(tmp_path, flags_guard):
+    s = build_mixed_store(tmp_path, flags_guard)
+    try:
+        for pat, ft in ((b"blue", FT_MATCH_ANYWHERE),
+                        (b"red-", FT_MATCH_PREFIX),
+                        (b"77", FT_MATCH_POSTFIX)):
+            want, plain_bytes = client_filtered(s, pat, ft, batch_size=17)
+            got, push_bytes, _ = drain(
+                s, vf_req(pat, ft, batch_size=17))
+            assert got == want, (pat, ft)
+            assert want, "degenerate fixture: filter matched nothing"
+            # the win the pushdown exists for: fewer bytes on the wire
+            assert push_bytes < plain_bytes
+        # compacted (pure columnar sorted-runs) state must agree too
+        s.engine.flush()
+        s.engine.manual_compact()
+        for pat in (b"blue", b"red"):
+            want, _ = client_filtered(s, pat, batch_size=23)
+            got, _, _ = drain(s, vf_req(pat, batch_size=23))
+            assert got == want
+    finally:
+        s.close()
+
+
+def test_aggregates_identity_and_wire_o_partitions(tmp_path, flags_guard):
+    s = build_mixed_store(tmp_path, flags_guard)
+    try:
+        s.engine.flush()
+        s.engine.manual_compact()
+        want, plain_bytes = client_filtered(s, b"blue")
+        rows, agg_bytes, agg = drain(s, vf_req(b"blue", agg="count"))
+        assert rows == [], "aggregate replies must carry no rows"
+        assert agg["count"] == len(want)
+        # O(partitions) wire cost: one tiny partial, not pages of rows
+        assert agg_bytes <= 256 < plain_bytes
+        _, _, agg = drain(s, vf_req(b"blue", agg="sum"))
+        assert agg["total"] == sum(value_as_u64(v)
+                                   for _k, v in want) % (1 << 64)
+        _, _, agg = drain(s, vf_req(b"blue", agg="top_k", k=3))
+        assert agg["items"] == sorted(want)[-3:]
+        _, _, s1 = drain(s, vf_req(b"blue", agg="sample", k=5, seed=9))
+        _, _, s2 = drain(s, vf_req(b"blue", agg="sample", k=5, seed=9))
+        assert s1["items"] == s2["items"] and len(s1["items"]) == 5
+        assert set((k, v) for _p, k, v in s1["items"]) <= set(want)
+    finally:
+        s.close()
+
+
+def test_paged_aggregate_ships_partial_once(tmp_path, flags_guard):
+    s = build_mixed_store(tmp_path, flags_guard)
+    try:
+        s.engine.flush()
+        s.engine.manual_compact()
+        want, _ = client_filtered(s, b"blue")
+        # a tiny iteration budget forces the aggregate to page; the
+        # partial must ride server-side and ship ONLY on the final page
+        FLAGS.set("pegasus.server", "rocksdb_max_iteration_count", 40)
+        resp = s.on_get_scanner(vf_req(b"blue", agg="count"))
+        pages, partials = 0, 0
+        while True:
+            assert resp.error == OK and resp.pushdown_applied
+            assert resp.kvs == []
+            pages += 1
+            if resp.agg is not None:
+                partials += 1
+            if resp.context_id == SCAN_CONTEXT_ID_COMPLETED:
+                break
+            assert resp.agg is None, "partial leaked on a non-final page"
+            resp = s.on_scan(resp.context_id)
+        assert pages > 1 and partials == 1
+        assert resp.agg["count"] == len(want)
+    finally:
+        s.close()
+
+
+def test_ttl_expiry_mid_aggregate_and_identity(tmp_path, flags_guard):
+    s = PartitionServer(str(tmp_path / "p0"))
+    try:
+        for i in range(60):
+            put(s, b"hk", b"e%03d" % i, b"blue-%d" % i, ttl=3)
+        for i in range(60):
+            put(s, b"hk", b"k%03d" % i, b"blue-%d" % i)
+        s.engine.flush()
+        s.engine.manual_compact()
+        _, _, agg = drain(s, vf_req(b"blue", agg="count"))
+        assert agg["count"] == 120
+        FLAGS.set("pegasus.server", "rocksdb_max_iteration_count", 40)
+        # page 1 folds while the e* rows are alive; they expire before
+        # the remaining pages — the straddling aggregate must count
+        # every row at most once and never resurrect an expired row
+        resp = s.on_get_scanner(vf_req(b"blue", agg="count"))
+        assert resp.error == OK and resp.agg is None
+        time.sleep(3.2)
+        while resp.context_id != SCAN_CONTEXT_ID_COMPLETED:
+            resp = s.on_scan(resp.context_id)
+            assert resp.error == OK
+        assert 60 <= resp.agg["count"] <= 120
+        # steady state after expiry: exact, on both eval arms
+        FLAGS.set("pegasus.server", "rocksdb_max_iteration_count", 0)
+        want, _ = client_filtered(s, b"blue")
+        assert len(want) == 60
+        got, _, _ = drain(s, vf_req(b"blue"))
+        assert got == want
+        _, _, agg = drain(s, vf_req(b"blue", agg="count"))
+        assert agg["count"] == 60
+    finally:
+        s.close()
+
+
+def test_context_loss_mid_aggregate_never_double_counts(tmp_path,
+                                                        flags_guard):
+    """The split-fence / failover bounce: a scan context vanishes
+    between aggregate pages. The partial is lost WITH the pages it
+    folded, and the scanner restarts the partition from its original
+    start — the merged count must stay exact, never inflated."""
+    table = Table(str(tmp_path), partition_count=1)
+    try:
+        c = PegasusClient(table)
+        for i in range(120):
+            v = b"blue-%03d" % i if i % 3 == 0 else b"red-%03d" % i
+            assert c.set(b"hk", b"s%04d" % i, v) == 0
+        srv = table.partitions[0]
+        srv.engine.flush()
+        srv.engine.manual_compact()
+        FLAGS.set("pegasus.server", "rocksdb_max_iteration_count", 25)
+        orig_on_scan = srv.on_scan
+        dropped = {"n": 0}
+
+        def bouncing_on_scan(cid):
+            if dropped["n"] == 0:
+                dropped["n"] += 1
+                srv.on_clear_scanner(cid)  # the bounce: context gone
+            return orig_on_scan(cid)
+
+        srv.on_scan = bouncing_on_scan
+        try:
+            sc = c.get_scanner(b"hk", options=ScanOptions(
+                value_filter_type=FT_MATCH_ANYWHERE,
+                value_filter_pattern=b"blue"))
+            assert sc.count() == 40
+            assert dropped["n"] == 1, "fixture never exercised the bounce"
+        finally:
+            srv.on_scan = orig_on_scan
+    finally:
+        table.close()
+
+
+def test_soft_fallback_when_pushdown_disabled(tmp_path, flags_guard):
+    """scan_pushdown_enabled=False simulates a pre-pushdown server: the
+    spec is ignored, pushdown_applied stays False, and clients must
+    produce the SAME rows and aggregates by evaluating locally."""
+    table = Table(str(tmp_path), partition_count=2)
+    try:
+        c = PegasusClient(table)
+        for i in range(150):
+            hk = b"hk%d" % (i % 3)
+            v = b"blue-%03d" % i if i % 5 == 0 else b"red-%03d" % i
+            assert c.set(hk, b"s%04d" % i, v) == 0
+        opts = ScanOptions(value_filter_type=FT_MATCH_ANYWHERE,
+                           value_filter_pattern=b"blue")
+        with_push = sorted(c.get_scanner(b"hk0", options=opts))
+        n_push = c.get_scanner(b"hk0", options=opts).count()
+        sum_push = c.get_scanner(b"hk0", options=opts).aggregate("sum")
+        FLAGS.set("pegasus.server", "scan_pushdown_enabled", False)
+        resp = table.partitions[0].on_get_scanner(
+            vf_req(b"blue", one_page=True))
+        assert not resp.pushdown_applied and resp.agg is None
+        assert sorted(c.get_scanner(b"hk0", options=opts)) == with_push
+        assert c.get_scanner(b"hk0", options=opts).count() == n_push
+        assert c.get_scanner(b"hk0",
+                             options=opts).aggregate("sum") == sum_push
+    finally:
+        table.close()
+
+
+def test_batched_scan_multi_mixed_specs(tmp_path, flags_guard):
+    """scan_multi with a plain request, a value-filtered request and an
+    aggregate request in ONE flush: the coordinator groups by pushdown
+    identity, aggregates route to the solo aggregate path, and every
+    response matches its own spec."""
+    table = Table(str(tmp_path), partition_count=1)
+    try:
+        c = PegasusClient(table)
+        for i in range(90):
+            v = b"blue-%03d" % i if i % 3 == 0 else b"red-%03d" % i
+            assert c.set(b"hk", b"s%04d" % i, v) == 0
+        s = table.partitions[0]
+        s.engine.flush()
+        s.engine.manual_compact()
+        plain = GetScannerRequest(one_page=True, batch_size=1000)
+        filt = vf_req(b"blue", one_page=True, batch_size=1000)
+        agg = vf_req(b"blue", agg="count", one_page=True)
+        resps = c.scan_multi({0: [plain, filt, agg]})[0]
+        assert [r.error for r in resps] == [OK] * 3
+        assert len(resps[0].kvs) == 90 and not resps[0].pushdown_applied
+        assert len(resps[1].kvs) == 30 and resps[1].pushdown_applied
+        assert all(b"blue" in kv.value for kv in resps[1].kvs)
+        assert resps[2].kvs == [] and resps[2].agg["count"] == 30
+        assert resps[2].wire_bytes() <= 256
+    finally:
+        table.close()
+
+
+def test_explain_reconciles_with_workload_metrics(tmp_path, flags_guard):
+    """EXPLAIN's pushdown stage + cost vector must reconcile with the
+    same run's workload-profiler metric deltas (the counters are the
+    PerfContext fields' metric twins)."""
+    from pegasus_tpu.server import explain as explain_mod
+
+    s = PartitionServer(str(tmp_path / "p0"))
+    try:
+        for i in range(100):
+            v = b"blue-%03d" % i if i % 4 == 0 else b"red-%03d" % i
+            put(s, b"hk", b"s%04d" % i, v)
+        s.engine.flush()
+        s.engine.manual_compact()
+        spec = explain_mod.spec_from_words(["scan", "hk", "filter=blue"])
+        op, args, ph = explain_mod.op_from_spec(spec)
+        pruned0 = s.workload._pushdown_pruned.value()
+        ops0 = s.workload._pushdown_ops.value()
+        report = explain_mod.explain_op(s, op, args, partition_hash=ph)
+        pruned = report["perf"]["pushdown_rows_pruned"]
+        assert pruned == 75
+        assert report["result"]["rows"] == 25
+        assert report["result"]["pushdown_applied"] is True
+        assert s.workload._pushdown_pruned.value() - pruned0 == pruned
+        assert s.workload._pushdown_ops.value() - ops0 == 1
+        stages = [st["stage"] for st in report["stages"]]
+        assert "pushdown" in stages
+        rendered = explain_mod.render_report(report)
+        assert "pushdown" in rendered and "pushdown_rows_pruned" in rendered
+        # aggregate explain: agg lands in the result summary
+        spec = explain_mod.spec_from_words(
+            ["scan", "hk", "filter=blue", "agg=count"])
+        op, args, ph = explain_mod.op_from_spec(spec)
+        report = explain_mod.explain_op(s, op, args, partition_hash=ph)
+        assert report["result"]["agg"]["count"] == 25
+        assert report["perf"]["rows_aggregated"] == 25
+    finally:
+        s.close()
+
+
+def test_workload_summary_labels_pushdown_mix(tmp_path, flags_guard):
+    from pegasus_tpu.server.workload import fold_summaries
+
+    s = PartitionServer(str(tmp_path / "p0"))
+    try:
+        for i in range(40):
+            put(s, b"hk", b"s%03d" % i, b"blue-%d" % i)
+        # metric entities are process-global (shared by every 1.0
+        # partition this process opened): assert DELTAS, not absolutes
+        scan0 = s.workload._scan_ops.value()
+        push0 = s.workload._pushdown_ops.value()
+        drain(s, GetScannerRequest(batch_size=1000))
+        drain(s, vf_req(b"blue", batch_size=1000))
+        drain(s, vf_req(b"blue", agg="count"))
+        summ = s.workload.summary()
+        assert summ["scan_ops"] - scan0 == 3
+        assert summ["pushdown_ops"] - push0 == 2
+        fold = fold_summaries([summ, summ])
+        assert fold["pushdown_ops"] == 2 * summ["pushdown_ops"]
+    finally:
+        s.close()
+
+
+def test_metrics_lint_stays_clean():
+    from pegasus_tpu.tools.metrics_lint import lint
+
+    assert not [c for c in lint() if "pushdown" in c
+                or "rows_aggregated" in c]
+
+
+def test_spec_check_rejects_malformed(tmp_path, flags_guard):
+    with pytest.raises(ValueError):
+        PushdownSpec(aggregate="median").check()
+    with pytest.raises(ValueError):
+        PushdownSpec(aggregate="top_k").check()  # k missing
+    with pytest.raises(ValueError):
+        PushdownSpec(value_filter_type=99,
+                     value_filter_pattern=b"x").check()
+    s = PartitionServer(str(tmp_path / "p0"))
+    try:
+        put(s, b"hk", b"s", b"v")
+        with pytest.raises(ValueError):
+            s.on_get_scanner(GetScannerRequest(
+                pushdown=PushdownSpec(aggregate="median")))
+    finally:
+        s.close()
+
+
+def test_aio_scan_all_filter_and_scan_count(tmp_path, flags_guard):
+    import asyncio
+
+    from pegasus_tpu.client.aio import AsyncPegasusClient
+
+    table = Table(str(tmp_path), partition_count=1)
+    try:
+        c = PegasusClient(table)
+        for i in range(60):
+            v = b"blue-%02d" % i if i % 6 == 0 else b"red-%02d" % i
+            assert c.set(b"hk", b"s%03d" % i, v) == 0
+
+        async def run():
+            ac = AsyncPegasusClient(c)
+            try:
+                rows = await ac.scan_all(b"hk", value_filter=b"blue")
+                n = await ac.scan_count(b"hk", value_filter=b"blue")
+                n_all = await ac.scan_count(b"hk")
+                return rows, n, n_all
+            finally:
+                ac.close()
+
+        rows, n, n_all = asyncio.run(run())
+        assert len(rows) == n == 10 and n_all == 60
+        assert all(b"blue" in v for _hk, _sk, v in rows)
+    finally:
+        table.close()
+
+
+def test_wire_codec_carries_spec_and_tolerates_old_peers():
+    """PGT1 regression pin: a PushdownSpec-bearing request and an
+    agg-bearing response round-trip the REAL wire codec, and a peer
+    built before the trailing fields were added (the compiled native
+    client sends the 15-field GetScannerRequest layout) still decodes
+    — omitted trailing defaulted fields fill in, anything else raises."""
+    import dataclasses
+    import struct
+
+    from pegasus_tpu.rpc import message as msg
+    from pegasus_tpu.server.types import (
+        GetScannerRequest, KeyValue, ScanResponse)
+
+    spec = PushdownSpec(value_filter_type=FT_MATCH_ANYWHERE,
+                        value_filter_pattern=b"red", aggregate="count")
+    req = GetScannerRequest(start_key=b"a", stop_key=b"z",
+                            batch_size=10, pushdown=spec)
+    frame = msg.encode_message("c", "s", "read", req)
+    _src, _dst, _mt, out = msg.decode_message(frame[12:])
+    assert out == req and out.pushdown == spec
+
+    resp = ScanResponse(error=0, kvs=[KeyValue(b"k", b"v")],
+                        context_id=-1,
+                        agg={"kind": "count", "count": 5},
+                        pushdown_applied=True)
+    frame = msg.encode_message("s", "c", "read_resp", resp)
+    _src, _dst, _mt, out = msg.decode_message(frame[12:])
+    assert out == resp
+
+    # hand-roll the pre-pushdown (shorter) field layout
+    def old_layout(n_drop):
+        body = []
+        for s in ("c", "s", "read"):
+            msg._enc_value(body, s)
+        fields = dataclasses.fields(GetScannerRequest)
+        old = GetScannerRequest(start_key=b"a", stop_key=b"z",
+                                batch_size=10)
+        name = b"GetScannerRequest"
+        body.append(b"D" + struct.pack("<I", len(name)))
+        body.append(name)
+        body.append(struct.pack("<I", len(fields) - n_drop))
+        for f in fields[:len(fields) - n_drop]:
+            msg._enc_value(body, getattr(old, f.name))
+        return b"".join(body), old
+
+    body, old = old_layout(1)
+    _src, _dst, _mt, out = msg.decode_message(body)
+    assert out == old and out.pushdown is None
+
+    # dropping into the non-defaulted head must still fail loudly —
+    # only ADDED-with-default skew is legal (KeyValue.key: no default)
+    body = []
+    for s in ("c", "s", "read"):
+        msg._enc_value(body, s)
+    body.append(b"D" + struct.pack("<I", len(b"KeyValue")))
+    body.append(b"KeyValue")
+    body.append(struct.pack("<I", 0))  # zero of KeyValue's fields
+    with pytest.raises(ValueError, match="field count"):
+        msg.decode_message(b"".join(body))
+
+    # and a LONGER-than-registry layout (a newer peer) stays loud
+    fields = dataclasses.fields(GetScannerRequest)
+    body, _old = old_layout(0)
+    body = body.replace(struct.pack("<I", len(fields)),
+                        struct.pack("<I", len(fields) + 1), 1)
+    with pytest.raises(ValueError, match="field count"):
+        msg.decode_message(body)
