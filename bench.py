@@ -1574,6 +1574,145 @@ def measure_perfctx_overhead(tmpdir, seed: int):
         shutil.rmtree(cdir, ignore_errors=True)
 
 
+def measure_follower_read(tmpdir, seed: int):
+    """Follower-read capacity phase (round 17): the SAME batched
+    point-get stream through a 3-replica SimCluster at linearizable
+    (primary-only) vs bounded_stale (round-robin across all three
+    replicas under the read lease) — same-run, identity-gated on the
+    returned bytes, modes interleaved across 3 reps.
+
+    The table is ONE partition on purpose: a hot partition is the unit
+    whose serving capacity the follower fan-out multiplies (per-table
+    aggregates just sum partitions). The sim runs every replica on one
+    host thread, so wall q/s cannot show the fan-out — the aggregate
+    is modeled the way capacity planning does it: the busiest replica
+    is the bottleneck, so
+        aggregate_read_qps = wall_qps * total_ops / max_per_replica_ops
+    (primary-only: one replica serves 100% -> factor 1; follower
+    reads: three replicas serve ~1/3 each -> factor ~3). The gate:
+    >= 2x aggregate q/s with byte-identical results and ZERO stale
+    bounces (every serve was a real lease-checked, watermark-checked
+    follower answer, not a bounce-and-retry at the primary)."""
+    import hashlib
+    import shutil
+    from collections import Counter as _Counter
+
+    import numpy as np
+
+    from pegasus_tpu.base.key_schema import generate_key, key_hash_parts
+    from pegasus_tpu.base.value_schema import expire_ts_from_ttl
+    from pegasus_tpu.client.cluster_client import bounded_stale
+    from pegasus_tpu.rpc.codec import OP_PUT
+    from pegasus_tpu.tools.cluster import SimCluster
+
+    n_hks = int(os.environ.get("PEGBENCH_FOLLOWER_KEYS", 256))
+    n_rounds = int(os.environ.get("PEGBENCH_FOLLOWER_ROUNDS", 120))
+    reps = 3
+    batch = 32
+    cdir = os.path.join(tmpdir, "follower_read")
+    cluster = SimCluster(cdir, n_nodes=3, seed=seed)
+    try:
+        cluster.create_table("fr", partition_count=1, replica_count=3)
+        client = cluster.client("fr")
+        hks = [b"fhk%05d" % i for i in range(n_hks)]
+        for start in range(0, n_hks, batch):
+            groups = {0: []}
+            for hk in hks[start:start + batch]:
+                ph = key_hash_parts(hk, b"")
+                groups[0].append(
+                    (OP_PUT, (generate_key(hk, b"s"), b"v" * 64,
+                              expire_ts_from_ttl(0)), ph))
+            client.write_multi(groups)
+        for stub in cluster.stubs.values():
+            for r in stub.replicas.values():
+                r.server.engine.flush()
+                r.server.engine.manual_compact()
+        # settle: secondaries commit everything and stamp freshness
+        cluster.step(rounds=2)
+
+        # per-replica serve tally, read off the wire the client sends
+        served = _Counter()
+        orig_send = client._send_request
+
+        def counted_send(dst, method, payload, **kw):
+            if method == "client_read_batch":
+                served[dst] += sum(len(ops)
+                                   for _gpid, ops in payload["groups"])
+            return orig_send(dst, method, payload, **kw)
+
+        client._send_request = counted_send
+
+        order = np.random.default_rng(seed + 3).integers(
+            0, n_hks, size=n_rounds * batch)
+        cons = bounded_stale(
+            float(os.environ.get("PEGBENCH_FOLLOWER_LAG_MS", 60_000)))
+
+        def one_pass(digest, consistency):
+            t0 = time.perf_counter()
+            for r in range(n_rounds):
+                groups = {0: []}
+                for j in order[r * batch:(r + 1) * batch]:
+                    hk = hks[int(j)]
+                    groups[0].append(
+                        ("get", generate_key(hk, b"s"),
+                         key_hash_parts(hk, b"")))
+                res = client.point_read_multi(groups,
+                                              consistency=consistency)
+                for st, val in res[0]:
+                    digest.update(b"%d" % st)
+                    digest.update(val)
+            return time.perf_counter() - t0
+
+        one_pass(hashlib.sha256(), None)  # unmeasured warm-up
+        served.clear()
+        modes = [("linearizable", None), ("follower", cons)]
+        ops_pass = n_rounds * batch
+        out = {"hashkeys": n_hks, "ops_per_mode": ops_pass * reps,
+               "replica_count": 3}
+        times = {name: [] for name, _c in modes}
+        hashes = {name: hashlib.sha256() for name, _c in modes}
+        tallies = {name: _Counter() for name, _c in modes}
+        # modes interleave across reps so slow drift hits both equally
+        for _rep in range(reps):
+            for name, consistency in modes:
+                served.clear()
+                times[name].append(one_pass(hashes[name], consistency))
+                tallies[name] += served
+        bounces = sum(stub._stale_bounces.value()
+                      for stub in cluster.stubs.values())
+        digests = {}
+        for name, _c in modes:
+            tally = tallies[name]
+            total = sum(tally.values())
+            # the busiest replica bounds the group's capacity
+            fanout = total / max(tally.values())
+            wall_qps = ops_pass * reps / sum(times[name])
+            digests[name] = hashes[name].hexdigest()
+            out[name] = {
+                "wall_qps": round(wall_qps, 1),
+                "serving_replicas": len(tally),
+                "max_replica_share": round(max(tally.values()) / total,
+                                           4),
+                "fanout": round(fanout, 3),
+                "aggregate_read_qps": round(wall_qps * fanout, 1),
+                "pass_s_median": round(sorted(times[name])[1], 4),
+            }
+        base = out["linearizable"]["aggregate_read_qps"]
+        # top-level twin of the follower-mode aggregate: the round's
+        # headline metric (bench_report scans a phase's top level)
+        out["aggregate_read_qps"] = out["follower"]["aggregate_read_qps"]
+        out["speedup"] = round(
+            out["follower"]["aggregate_read_qps"] / base, 3)
+        out["stale_bounces"] = bounces
+        out["identity_ok"] = len(set(digests.values())) == 1
+        out["gate_ok"] = bool(out["identity_ok"] and bounces == 0
+                              and out["speedup"] >= 2.0)
+        return out
+    finally:
+        cluster.close()
+        shutil.rmtree(cdir, ignore_errors=True)
+
+
 def measure_dup_catchup(tmpdir, seed: int):
     """Geo-replication catch-up phase (round 14): batched+compressed
     dup_apply_batch envelope shipping vs the legacy solo-mutation
@@ -2173,6 +2312,7 @@ def main() -> None:
     do_dup = os.environ.get("PEGBENCH_DUP", "1") != "0"
     do_health = os.environ.get("PEGBENCH_HEALTH", "1") != "0"
     do_perfctx = os.environ.get("PEGBENCH_PERFCTX", "1") != "0"
+    do_follower = os.environ.get("PEGBENCH_FOLLOWER_READ", "1") != "0"
 
     details = {"phases": {}}
     here = os.path.dirname(os.path.abspath(__file__))
@@ -2741,6 +2881,24 @@ def main() -> None:
                          f"{po['scan_overhead']:+.2%} vs hard-off "
                          f"(gate<=2%: {po['gate_ok']}, "
                          f"identical={po['identity_ok']})")
+
+                if do_follower:
+                    fr = measure_follower_read(tmpdir, seed)
+                    details["phases"]["follower_read"] = fr
+                    save_details()
+                    with open(os.path.join(here, "BENCH_r17.json"),
+                              "w") as f:
+                        json.dump({"phases": {"follower_read": fr},
+                                   "accel_platform": accel.platform},
+                                  f, indent=1)
+                    _log(f"follower_read: aggregate "
+                         f"{fr['linearizable']['aggregate_read_qps']} "
+                         f"-> {fr['follower']['aggregate_read_qps']} "
+                         f"q/s ({fr['speedup']}x, "
+                         f"{fr['follower']['serving_replicas']} serving"
+                         f" replicas, bounces={fr['stale_bounces']}, "
+                         f"identical={fr['identity_ok']}, "
+                         f"gate>=2x: {fr['gate_ok']})")
 
                 if do_dup:
                     dc = measure_dup_catchup(tmpdir, seed)

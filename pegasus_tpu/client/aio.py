@@ -27,6 +27,9 @@ class AsyncPegasusClient:
     see the same typed ERR_TIMEOUT/ERR_BUSY surface as the sync API and
     the event loop never blocks on a backoff sleep."""
 
+    # kwargs forward verbatim, so cluster-backed read ops accept
+    # consistency=bounded_stale(...)/MONOTONIC exactly like the sync
+    # API (await aio.get(hk, sk, consistency=MONOTONIC))
     _FORWARDED = (
         "set", "get", "delete", "exist", "ttl", "incr",
         "multi_set", "multi_get", "multi_get_sortkeys", "multi_del",
@@ -100,17 +103,25 @@ class AsyncPegasusClient:
         return ScanOptions(batch_size=batch_size)
 
     async def scan_all(self, hash_key: bytes, batch_size: int = 100,
-                       value_filter: Optional[bytes] = None):
+                       value_filter: Optional[bytes] = None,
+                       consistency=None):
         """Drain a hashkey scan without blocking the event loop between
         pages; returns [(hashkey, sortkey, value)]. `value_filter`
         keeps only rows whose value contains the pattern, evaluated
-        server-side when the server supports pushdown."""
+        server-side when the server supports pushdown. `consistency`
+        (cluster-backed clients): bounded_stale(...)/MONOTONIC routes
+        the pages to lease-holding secondaries — see
+        ClusterClient.get_scanner."""
         loop = asyncio.get_running_loop()
         opts = self._scan_options(batch_size, value_filter)
 
         def scan():
             with self._lock:
-                scanner = self._c.get_scanner(hash_key, options=opts)
+                if consistency is not None:
+                    scanner = self._c.get_scanner(
+                        hash_key, options=opts, consistency=consistency)
+                else:
+                    scanner = self._c.get_scanner(hash_key, options=opts)
                 return list(scanner)
 
         return await loop.run_in_executor(self._pool, scan)

@@ -75,10 +75,33 @@ _RETRYABLE = {
     # duplication failover drill: fenced-for-drain is transient — the
     # backoff (plus its config refresh) carries the op across the flip
     int(ErrorCode.ERR_DUP_FENCED),
+    # follower-read bounce: the secondary's lease lapsed or its
+    # watermark missed the op's staleness bound. The routing table is
+    # still RIGHT — the retry skips the config refresh and re-sends
+    # only the bounced ops to the primary (misrouted-subset discipline)
+    int(ErrorCode.ERR_STALE_REPLICA),
 }
 
 _OK = int(ErrorCode.ERR_OK)
 _MISROUTED = int(ErrorCode.ERR_PARENT_PARTITION_MISUSED)
+_STALE = int(ErrorCode.ERR_STALE_REPLICA)
+
+
+def bounded_stale(max_lag_ms: float) -> dict:
+    """Consistency level: serve at ANY replica whose committed state is
+    at most `max_lag_ms` behind the primary's advertised commit point
+    (measured on the replica's sync stamps, so the practical floor is
+    the group-check cadence). Pass to any read's `consistency=`."""
+    return {"level": "bounded_stale", "max_lag_ms": float(max_lag_ms)}
+
+
+# Consistency level: reads never observe an older prefix than any read
+# this client already observed for that partition (per-partition
+# high-water committed-decree session tokens carried on every reply).
+MONOTONIC = {"level": "monotonic"}
+
+# Default consistency: primary-only reads, unchanged semantics.
+LINEARIZABLE = None
 
 define_flag("pegasus.client", "client_op_timeout_ms", 3_600_000,
             "end-to-end deadline for one client op, spanning every "
@@ -137,6 +160,18 @@ class ClusterClient:
         self.partition_count = 0
         self._configs: List[dict] = []
         self.auth = tuple(auth) if auth else None
+        # per-op consistency default for THIS client handle: None =
+        # linearizable (primary-only). Set to MONOTONIC or
+        # bounded_stale(ms) to opt every read in; any read's
+        # `consistency=` kwarg overrides per op
+        self.consistency: Optional[dict] = None
+        # monotonic session tokens: pidx -> highest committed decree any
+        # read reply has shown this client for that partition. Carried
+        # as min_decree on monotonic reads so no replica may answer
+        # below what this session already observed
+        self._session_tokens: Dict[int, int] = {}
+        # deterministic round-robin over a partition's secondaries
+        self._replica_rr = 0
         # distributed tracing: the op-level root span (one per client
         # API call; nested helpers — batch_get's per-group _read legs —
         # ride the outer op's trace instead of minting their own)
@@ -269,21 +304,82 @@ class ClusterClient:
     def _primary_of(self, pidx: int) -> str:
         return self._configs[pidx]["primary"]
 
+    def _norm_consistency(self, consistency) -> Optional[dict]:
+        """Resolve one read's effective consistency level: the per-op
+        kwarg wins, else the client-handle default. Returns None for
+        linearizable (primary-only), else the level dict the replica
+        gate consumes."""
+        c = consistency if consistency is not None else self.consistency
+        if c is None or c == "linearizable":
+            return None
+        if c == "monotonic":
+            return MONOTONIC
+        if isinstance(c, dict) and c.get("level") in (
+                "bounded_stale", "monotonic"):
+            return c
+        raise ValueError(f"unknown consistency level: {c!r}")
+
+    def _route_read(self, pidx: int, cons: Optional[dict],
+                    force_primary: bool = False) -> str:
+        """Pick the serving node for one read leg: the primary for
+        linearizable ops and for post-bounce retries, otherwise
+        round-robin across ALL of the partition's replicas — primary
+        included — (meta's routing table already ships the
+        secondaries), so a replica group's aggregate read capacity
+        scales with replica count instead of pinning every read to one
+        node; primary fallback when no secondary exists."""
+        cfg = self._configs[pidx]
+        if cons is None or force_primary:
+            return cfg["primary"]
+        members = [n for n in (cfg["primary"],
+                               *cfg.get("secondaries", ())) if n]
+        if not members:
+            return cfg["primary"]
+        self._replica_rr += 1
+        return members[self._replica_rr % len(members)]
+
+    def _wire_consistency(self, cons: dict, pidx: int) -> dict:
+        """Stamp the monotonic session token onto the wire level: the
+        replica must not answer below the committed decree this client
+        already observed for the partition."""
+        if cons.get("level") == "monotonic":
+            tok = self._session_tokens.get(pidx, 0)
+            if tok:
+                return dict(cons, min_decree=tok)
+        return cons
+
+    def _note_decree(self, pidx: int, decree) -> None:
+        """Fold a reply's committed-decree stamp into the session
+        token (monotonic high-water mark, never regresses)."""
+        if decree is not None and \
+                decree > self._session_tokens.get(pidx, 0):
+            self._session_tokens[pidx] = decree
+
     # ---- request dispatch with refresh-on-error retry ------------------
 
     def _read(self, op: str, args: Any, pidx: int,
               partition_hash: Optional[int] = None,
-              deadline: Optional[float] = None) -> Any:
+              deadline: Optional[float] = None,
+              consistency=None,
+              prefer_node: Optional[str] = None) -> Any:
         return self._traced(f"client.{op}", self._read_impl, op, args,
-                            pidx, partition_hash, deadline)
+                            pidx, partition_hash, deadline, consistency,
+                            prefer_node)
 
     def _read_impl(self, op: str, args: Any, pidx: int,
                    partition_hash: Optional[int] = None,
-                   deadline: Optional[float] = None) -> Any:
+                   deadline: Optional[float] = None,
+                   consistency=None,
+                   prefer_node: Optional[str] = None) -> Any:
         """`deadline`: inherited when this read is one leg of a larger
         op (batch_get) — the outer op's single end-to-end bound governs,
-        never a freshly minted per-leg window."""
+        never a freshly minted per-leg window. `prefer_node`: first-
+        attempt routing override (scanner paging stickiness — a scan
+        context lives on the node that opened it); retries fall back to
+        normal routing."""
         self._ensure_config()
+        cons = self._norm_consistency(consistency)
+        force_primary = False
         last_err = int(ErrorCode.ERR_TIMEOUT)
         if deadline is None:
             deadline = self._deadline()
@@ -296,10 +392,11 @@ class ClusterClient:
                 # retries burn every attempt in microseconds and storm
                 # the meta with refresh_config
                 self.backoff.sleep(attempt)
-                if last_err == int(ErrorCode.ERR_BUSY):
-                    # shed by an overloaded replica, not misrouted: the
-                    # config is still right — re-resolving would only
-                    # convert the read storm into a meta query storm
+                if last_err in (int(ErrorCode.ERR_BUSY), _STALE):
+                    # shed by an overloaded replica (or bounced by a
+                    # stale secondary), not misrouted: the config is
+                    # still right — re-resolving would only convert the
+                    # read storm into a meta query storm
                     pass
                 else:
                     try:
@@ -312,22 +409,34 @@ class ClusterClient:
                         last_err = int(e.code)
             p = pidx if partition_hash is None else (
                 partition_hash % self.partition_count)
-            primary = self._primary_of(p)
-            if not primary:
+            if prefer_node is not None and not attempt \
+                    and not force_primary:
+                dst = prefer_node
+            else:
+                dst = self._route_read(p, cons, force_primary)
+            if not dst:
                 continue  # partition momentarily unowned; refresh + retry
-            rid = self._send_request(primary, "client_read", {
-                "gpid": (self.app_id, p), "op": op, "auth": self.auth,
-                "args": args, "partition_hash": partition_hash},
-                deadline=deadline)
+            wire = {"gpid": (self.app_id, p), "op": op,
+                    "auth": self.auth, "args": args,
+                    "partition_hash": partition_hash}
+            if cons is not None:
+                wire["consistency"] = self._wire_consistency(cons, p)
+            rid = self._send_request(dst, "client_read", wire,
+                                     deadline=deadline)
             reply = self._await(rid, deadline)
             if reply is None:
                 last_err = int(ErrorCode.ERR_TIMEOUT)
                 continue
             if reply["err"] in _RETRYABLE:
                 last_err = reply["err"]
+                if reply["err"] == _STALE:
+                    # bounced by a lapsed-lease / too-stale secondary:
+                    # ONLY this op re-flies, and it goes to the primary
+                    force_primary = True
                 continue
             if reply["err"] != _OK:
                 raise PegasusError(ErrorCode(reply["err"]), op)
+            self._note_decree(p, reply.get("decree"))
             return reply["result"]
         raise PegasusError(ErrorCode(last_err), f"{op} exhausted retries")
 
@@ -396,9 +505,11 @@ class ClusterClient:
             [(OP_PUT, (key, value, expire_ts_from_ttl(ttl_seconds)))], ph)
         return results[0]
 
-    def get(self, hash_key: bytes, sort_key: bytes) -> Tuple[int, bytes]:
+    def get(self, hash_key: bytes, sort_key: bytes,
+            consistency=None) -> Tuple[int, bytes]:
         ph = key_hash_parts(hash_key, sort_key)
-        return self._read("get", generate_key(hash_key, sort_key), -1, ph)
+        return self._read("get", generate_key(hash_key, sort_key), -1,
+                          ph, consistency=consistency)
 
     def delete(self, hash_key: bytes, sort_key: bytes) -> int:
         ph = key_hash_parts(hash_key, sort_key)
@@ -409,9 +520,11 @@ class ClusterClient:
     def exist(self, hash_key: bytes, sort_key: bytes) -> bool:
         return self.get(hash_key, sort_key)[0] == int(StorageStatus.OK)
 
-    def ttl(self, hash_key: bytes, sort_key: bytes) -> Tuple[int, int]:
+    def ttl(self, hash_key: bytes, sort_key: bytes,
+            consistency=None) -> Tuple[int, int]:
         ph = key_hash_parts(hash_key, sort_key)
-        return self._read("ttl", generate_key(hash_key, sort_key), -1, ph)
+        return self._read("ttl", generate_key(hash_key, sort_key), -1,
+                          ph, consistency=consistency)
 
     def incr(self, hash_key: bytes, sort_key: bytes, increment: int,
              ttl_seconds: int = 0):
@@ -434,12 +547,14 @@ class ClusterClient:
 
     def multi_get(self, hash_key: bytes,
                   sort_keys: Optional[Sequence[bytes]] = None,
+                  consistency=None,
                   **kwargs) -> Tuple[int, Dict[bytes, bytes]]:
         if not hash_key:
             return int(StorageStatus.INVALID_ARGUMENT), {}
         req = MultiGetRequest(hash_key, sort_keys=list(sort_keys or []),
                               **kwargs)
-        resp = self._read("multi_get", req, -1, key_hash_parts(hash_key))
+        resp = self._read("multi_get", req, -1, key_hash_parts(hash_key),
+                          consistency=consistency)
         return resp.error, {kv.key: kv.value for kv in resp.kvs}
 
     def multi_del(self, hash_key: bytes, sort_keys: Sequence[bytes]
@@ -465,18 +580,22 @@ class ClusterClient:
 
         return paginate_sortkeys(fetch)
 
-    def sortkey_count(self, hash_key: bytes) -> Tuple[int, int]:
+    def sortkey_count(self, hash_key: bytes,
+                      consistency=None) -> Tuple[int, int]:
         if not hash_key:
             return int(StorageStatus.INVALID_ARGUMENT), 0
         return self._read("sortkey_count", hash_key, -1,
-                          key_hash_parts(hash_key))
+                          key_hash_parts(hash_key),
+                          consistency=consistency)
 
-    def batch_get(self, keys: Sequence[Tuple[bytes, bytes]]
+    def batch_get(self, keys: Sequence[Tuple[bytes, bytes]],
+                  consistency=None
                   ) -> Tuple[int, List[Tuple[bytes, bytes, bytes]]]:
         return self._traced("client.batch_get", self._batch_get_impl,
-                            keys)
+                            keys, consistency)
 
-    def _batch_get_impl(self, keys: Sequence[Tuple[bytes, bytes]]
+    def _batch_get_impl(self, keys: Sequence[Tuple[bytes, bytes]],
+                        consistency=None
                         ) -> Tuple[int, List[Tuple[bytes, bytes, bytes]]]:
         self._ensure_config()
         deadline = self._deadline()
@@ -511,7 +630,8 @@ class ClusterClient:
                 fks = [FullKey(hk, sk) for hk, sk in group]
                 try:
                     resp = self._read("batch_get", BatchGetRequest(fks),
-                                      pidx, deadline=deadline)
+                                      pidx, deadline=deadline,
+                                      consistency=consistency)
                 except PegasusError as e:
                     if int(e.code) in _RETRYABLE:
                         still.extend(group)
@@ -564,58 +684,87 @@ class ClusterClient:
             return_check_value=return_check_value)
         return self._write([(OP_CAM, req)], key_hash_parts(hash_key))[0]
 
-    def scan_multi(self, groups: Dict[int, list]):
+    def scan_multi(self, groups: Dict[int, list], consistency=None):
         """Batched scans for MANY partitions in as few node round-trips
-        as possible: partitions group by their primary node, each node
+        as possible: partitions group by their serving node, each node
         stacks its partitions' blocks into one device evaluation
         (SURVEY §2.6's partitions-as-batch-dimension model). Returns
-        {pidx: [ScanResponse]}."""
+        {pidx: [ScanResponse]}. With a non-linearizable `consistency`,
+        partitions fan out across secondaries under their read leases;
+        a stale-bounced slot re-flies alone to the primary."""
         return self._traced("client.scan_multi", self._scan_multi_impl,
-                            groups)
+                            groups, consistency)
 
-    def _scan_multi_impl(self, groups: Dict[int, list]):
+    def _scan_multi_impl(self, groups: Dict[int, list],
+                         consistency=None):
         self._ensure_config()
+        cons = self._norm_consistency(consistency)
         out: Dict[int, list] = {}
+        force_primary: set = set()  # pidxs bounced ERR_STALE_REPLICA
+        need_refresh = False
         deadline = self._deadline()
         for attempt in range(self._max_retries):
             if attempt:
                 if self._clock() > deadline:
                     break  # surfaced below as the partitions-missing error
                 self.backoff.sleep(attempt)
-                try:
-                    self.refresh_config(deadline)
-                except PegasusError:
-                    pass  # meta momentarily down: cached config may
-                    # still be right, like _read/_write tolerate
+                if need_refresh:
+                    # (stale-replica bounces alone skip this: the
+                    # routing table is right, only the replica choice
+                    # was — the bounced subset re-flies to the primary)
+                    try:
+                        self.refresh_config(deadline)
+                    except PegasusError:
+                        pass  # meta momentarily down: cached config may
+                        # still be right, like _read/_write tolerate
+            need_refresh = False
             by_node: Dict[str, list] = {}
             for pidx, reqs in groups.items():
                 if pidx in out:
                     continue
-                primary = self._primary_of(pidx)
-                if primary:
-                    by_node.setdefault(primary, []).append(
+                node = self._route_read(pidx, cons,
+                                        pidx in force_primary)
+                if node:
+                    by_node.setdefault(node, []).append(
                         ((self.app_id, pidx), reqs))
+                else:
+                    need_refresh = True  # momentarily unowned
             if not by_node:
+                need_refresh = True
                 continue  # mid-failover: refresh and retry, like _read
             # send EVERY node's request first, then await — per-attempt
             # latency is the max of node round-trips, not the sum
             rids = []
             for node, node_groups in by_node.items():
+                payload = {"groups": node_groups, "auth": self.auth}
+                if cons is not None:
+                    payload["consistency"] = cons
+                    payload["min_decrees"] = [
+                        (gp[1], self._session_tokens.get(gp[1], 0))
+                        for gp, _reqs in node_groups]
                 rids.append(self._send_request(
-                    node, "client_scan_multi",
-                    {"groups": node_groups, "auth": self.auth},
+                    node, "client_scan_multi", payload,
                     deadline=deadline))
             for rid in rids:
                 reply = self._await(rid, deadline)
                 if reply is None or reply["err"] != _OK:
+                    need_refresh = True
                     continue  # retried next attempt for missing pidxs
+                for pidx, decree, _role in reply.get("decrees") or []:
+                    self._note_decree(pidx, decree)
                 for pidx, resps in reply["result"]:
                     if resps and resps[0].error == int(
                             ErrorCode.ERR_ACL_DENY):
                         raise PegasusError(ErrorCode.ERR_ACL_DENY,
                                            "scan_multi")
+                    if resps and resps[0].error == _STALE:
+                        # only THIS slot re-flies, straight to the
+                        # primary — the rest of the flush keeps serving
+                        force_primary.add(pidx)
+                        continue
                     if resps and resps[0].error == int(
                             ErrorCode.ERR_INVALID_STATE):
+                        need_refresh = True
                         continue  # stale primary; re-resolve
                     out[pidx] = resps
             if len(out) == len(groups):
@@ -635,7 +784,8 @@ class ClusterClient:
             return result[0]
         return result.error
 
-    def point_read_multi(self, groups: Dict[int, list]):
+    def point_read_multi(self, groups: Dict[int, list],
+                         consistency=None):
         """Batched point reads (get / ttl / multi_get with sort keys /
         batch_get) for MANY partitions in as few node round-trips as
         possible — the point-read twin of scan_multi: partitions group
@@ -650,18 +800,29 @@ class ClusterClient:
         misrouted-split result coming back in-band
         (ERR_PARENT_PARTITION_MISUSED from the per-op gate) re-resolves
         just that op — matching the solo path's transparent re-resolve
-        instead of surfacing the routing error to the application."""
-        return self._traced("client.point_read_multi",
-                            self._point_read_multi_impl, groups)
+        instead of surfacing the routing error to the application.
 
-    def _point_read_multi_impl(self, groups: Dict[int, list]):
+        With a non-linearizable `consistency`, each partition's slot
+        fans out to one of its secondaries under the read lease; a slot
+        bounced ERR_STALE_REPLICA re-flies ONLY its own ops, straight
+        to the primary, with no config refresh (the routing table was
+        right — only the replica choice was stale)."""
+        return self._traced("client.point_read_multi",
+                            self._point_read_multi_impl, groups,
+                            consistency)
+
+    def _point_read_multi_impl(self, groups: Dict[int, list],
+                               consistency=None):
         self._ensure_config()
+        cons = self._norm_consistency(consistency)
         items = [(orig_pidx, i, op)
                  for orig_pidx, ops in groups.items()
                  for i, op in enumerate(ops)]
         out: Dict[int, list] = {pidx: [None] * len(ops)
                                 for pidx, ops in groups.items()}
         unresolved = set(range(len(items)))
+        force_primary: set = set()  # pidxs bounced ERR_STALE_REPLICA
+        need_refresh = False
         deadline = self._deadline()
         for attempt in range(self._max_retries):
             if not unresolved:
@@ -670,36 +831,56 @@ class ClusterClient:
                 if self._clock() > deadline:
                     break  # surfaced below as partitions-unreachable
                 self.backoff.sleep(attempt)
-                try:
-                    self.refresh_config(deadline)
-                except PegasusError:
-                    continue  # meta momentarily down; cached config may
-                    # still be right on the next pass
+                if need_refresh:
+                    # stale-replica bounces alone skip the refresh —
+                    # the bounced subset just re-routes to the primary
+                    try:
+                        self.refresh_config(deadline)
+                    except PegasusError:
+                        continue  # meta momentarily down; cached config
+                        # may still be right on the next pass
+            need_refresh = False
             send: Dict[str, Dict[int, list]] = {}
+            route: Dict[int, str] = {}  # ONE replica per partition per
+            # attempt: splitting a partition's ops across replicas
+            # would trade the coalesced batch for extra round-trips
             for idx in sorted(unresolved):
                 orig_pidx, _i, op = items[idx]
                 ph = op[2] if len(op) > 2 else None
                 pidx = (ph % self.partition_count if ph is not None
                         else orig_pidx)
-                primary = self._primary_of(pidx)
-                if primary:
-                    send.setdefault(primary, {}).setdefault(
+                if pidx not in route:
+                    route[pidx] = self._route_read(
+                        pidx, cons, pidx in force_primary)
+                node = route[pidx]
+                if node:
+                    send.setdefault(node, {}).setdefault(
                         pidx, []).append((idx, op))
+                else:
+                    need_refresh = True  # momentarily unowned
             if not send:
                 continue  # mid-failover: refresh and retry, like _read
             rids = []
             for node, pmap in send.items():
-                node_groups = [((self.app_id, pidx),
-                                [op for _i, op in lst])
-                               for pidx, lst in pmap.items()]
+                payload = {"groups": [((self.app_id, pidx),
+                                       [op for _i, op in lst])
+                                      for pidx, lst in pmap.items()],
+                           "auth": self.auth}
+                if cons is not None:
+                    payload["consistency"] = cons
+                    payload["min_decrees"] = [
+                        (pidx, self._session_tokens.get(pidx, 0))
+                        for pidx in pmap]
                 rids.append((self._send_request(
-                    node, "client_read_batch",
-                    {"groups": node_groups, "auth": self.auth},
+                    node, "client_read_batch", payload,
                     deadline=deadline), pmap))
             for rid, pmap in rids:
                 reply = self._await(rid, deadline)
                 if reply is None or reply["err"] != _OK:
+                    need_refresh = True
                     continue  # retried next attempt
+                for pidx, decree, _role in reply.get("decrees") or []:
+                    self._note_decree(pidx, decree)
                 for pidx, err, results in reply["result"]:
                     sent = pmap.get(pidx)
                     if sent is None:
@@ -707,14 +888,23 @@ class ClusterClient:
                     if err == int(ErrorCode.ERR_ACL_DENY):
                         raise PegasusError(ErrorCode.ERR_ACL_DENY,
                                            "point_read_multi")
+                    if err == _STALE:
+                        # bounced slot: ONLY its ops re-fly, to the
+                        # primary, no refresh (subset discipline)
+                        force_primary.add(pidx)
+                        continue
                     if err in _RETRYABLE:
+                        need_refresh = True
                         continue  # stale primary; re-resolve
                     if err != _OK:
                         raise PegasusError(ErrorCode(err),
                                            "point_read_multi")
                     for (idx, _op), result in zip(sent, results):
                         if self._point_result_err(result) == _MISROUTED:
-                            continue  # split raced: re-route this op
+                            # split raced: refresh the (grown) table map
+                            # and re-route this op by its hash
+                            need_refresh = True
+                            continue
                         orig_pidx, i, _o = items[idx]
                         out[orig_pidx][i] = result
                         unresolved.discard(idx)
@@ -840,13 +1030,23 @@ class ClusterClient:
                 f"write_multi: partitions {stuck} unreachable")
         return out
 
-    def scan_page(self, pidx: int, context_id: int):
-        """Continue a server-held scan context (batched-path paging)."""
-        return self._read("scan", context_id, pidx)
+    def scan_page(self, pidx: int, context_id: int, consistency=None,
+                  prefer_node: Optional[str] = None):
+        """Continue a server-held scan context (batched-path paging).
+        Scan contexts are node-local: a consistency-routed page must
+        come back to the replica that opened the context, so callers
+        pass `prefer_node` to pin it (a lost pin surfaces as
+        SCAN_CONTEXT_ID_NOT_EXIST and the caller restarts)."""
+        return self._read("scan", context_id, pidx,
+                          consistency=consistency,
+                          prefer_node=prefer_node)
 
-    def scan_abort(self, pidx: int, context_id: int) -> None:
+    def scan_abort(self, pidx: int, context_id: int, consistency=None,
+                   prefer_node: Optional[str] = None) -> None:
         try:
-            self._read("clear_scanner", context_id, pidx)
+            self._read("clear_scanner", context_id, pidx,
+                       consistency=consistency,
+                       prefer_node=prefer_node)
         except PegasusError:
             pass
 
@@ -854,8 +1054,8 @@ class ClusterClient:
 
     def get_scanner(self, hash_key: bytes, start_sortkey: bytes = b"",
                     stop_sortkey: bytes = b"",
-                    options: Optional[ScanOptions] = None
-                    ) -> "ClusterScanner":
+                    options: Optional[ScanOptions] = None,
+                    consistency=None) -> "ClusterScanner":
         from dataclasses import replace
 
         from pegasus_tpu.base.key_schema import generate_next_bytes
@@ -872,10 +1072,12 @@ class ClusterClient:
             opts = replace(opts, stop_inclusive=False)
         req = self._make_scan_request(start_key, stop_key, opts)
         pidx = key_hash_parts(hash_key) % self.partition_count
-        return ClusterScanner(self, [pidx], req)
+        return ClusterScanner(self, [pidx], req,
+                              consistency=consistency)
 
     def get_unordered_scanners(self, max_split_count: int,
-                               options: Optional[ScanOptions] = None
+                               options: Optional[ScanOptions] = None,
+                               consistency=None
                                ) -> List["ClusterScanner"]:
         if max_split_count < 1:
             raise ValueError("max_split_count must be >= 1")
@@ -886,7 +1088,8 @@ class ClusterClient:
         groups: List[List[int]] = [[] for _ in range(split)]
         for pidx in range(self.partition_count):
             groups[pidx % split].append(pidx)
-        return [ClusterScanner(self, g, req) for g in groups if g]
+        return [ClusterScanner(self, g, req, consistency=consistency)
+                for g in groups if g]
 
     @staticmethod
     def _make_scan_request(start_key: bytes, stop_key: bytes,
@@ -923,10 +1126,18 @@ class ClusterScanner:
     pegasus_scanner_impl paging via RPC_RRDB_RRDB_SCAN)."""
 
     def __init__(self, client: ClusterClient, pidxs: List[int],
-                 request: GetScannerRequest) -> None:
+                 request: GetScannerRequest,
+                 consistency=None) -> None:
         self._client = client
         self._pidxs = list(pidxs)
         self._request = request
+        self._consistency = client._norm_consistency(consistency)
+        # scan contexts are node-local: a follower-read scanner pins
+        # the replica that opened each partition's context and pages
+        # against it; a lost pin (failover, lease lapse, context
+        # expiry) surfaces as SCAN_CONTEXT_ID_NOT_EXIST and the
+        # restart re-pins
+        self._node: Optional[str] = None
         self._i = 0
         self._context_id: Optional[int] = None
         self._buffer: List[KeyValue] = []
@@ -934,6 +1145,15 @@ class ClusterScanner:
         self._last_key: Optional[bytes] = None
         self.kv_count = 0
         self.shipped_bytes = 0  # wire-size of every response consumed
+
+    def _open(self, req, pidx: int):
+        """Open (or reopen) a scan context: pick this partition's
+        serving replica under the scanner's consistency level, pin it,
+        and issue get_scanner against the pin."""
+        self._node = self._client._route_read(pidx, self._consistency)
+        return self._client._read("get_scanner", req, pidx,
+                                  consistency=self._consistency,
+                                  prefer_node=self._node)
 
     def __iter__(self) -> Iterator[Tuple[bytes, bytes, bytes]]:
         return self
@@ -966,19 +1186,23 @@ class ClusterScanner:
         while self._i < len(self._pidxs):
             pidx = self._pidxs[self._i]
             if self._context_id is None:
-                resp = self._client._read("get_scanner", base_req, pidx)
+                resp = self._open(base_req, pidx)
             else:
-                resp = self._client._read("scan", self._context_id, pidx)
+                resp = self._client.scan_page(
+                    pidx, self._context_id,
+                    consistency=self._consistency,
+                    prefer_node=self._node)
                 if resp.context_id == SCAN_CONTEXT_ID_NOT_EXIST:
                     # context expired server-side (or moved with a
-                    # failover): restart past the last served key
+                    # failover / the pinned follower bounced): restart
+                    # past the last served key on a fresh pin
                     self._context_id = None
                     restart = base_req
                     if self._last_key is not None:
                         restart = replace(base_req,
                                           start_key=self._last_key + b"\x00",
                                           start_inclusive=True)
-                    resp = self._client._read("get_scanner", restart, pidx)
+                    resp = self._open(restart, pidx)
             if resp.error != int(StorageStatus.OK):
                 raise RuntimeError(f"scan failed: error {resp.error}")
             self.shipped_bytes += resp.wire_bytes()
@@ -1036,7 +1260,7 @@ class ClusterScanner:
 
         from pegasus_tpu.ops import pushdown as pushdown_ops
 
-        resp = self._client._read("get_scanner", req, pidx)
+        resp = self._open(req, pidx)
         rows: List[Tuple[bytes, bytes]] = []  # fallback accumulation
         last_key: Optional[bytes] = None
         while True:
@@ -1049,12 +1273,12 @@ class ClusterScanner:
                 # The local-fallback path (rows collected here) resumes
                 # past the last collected key like a plain scan.
                 if rows and last_key is not None:
-                    resp = self._client._read("get_scanner", replace(
+                    resp = self._open(replace(
                         req, start_key=last_key + b"\x00",
                         start_inclusive=True), pidx)
                 else:
                     rows.clear()
-                    resp = self._client._read("get_scanner", req, pidx)
+                    resp = self._open(req, pidx)
                 continue
             if resp.error != int(StorageStatus.OK):
                 raise RuntimeError(f"scan failed: error {resp.error}")
@@ -1064,7 +1288,9 @@ class ClusterScanner:
                 last_key = kv.key
             if resp.context_id == SCAN_CONTEXT_ID_COMPLETED:
                 break
-            resp = self._client._read("scan", resp.context_id, pidx)
+            resp = self._client.scan_page(
+                pidx, resp.context_id, consistency=self._consistency,
+                prefer_node=self._node)
         if resp.agg is not None:
             return resp.agg
         # pre-pushdown server streamed rows: evaluate the whole spec here
@@ -1078,9 +1304,8 @@ class ClusterScanner:
 
     def close(self) -> None:
         if self._context_id is not None and self._i < len(self._pidxs):
-            try:
-                self._client._read("clear_scanner", self._context_id,
-                                   self._pidxs[self._i])
-            except PegasusError:
-                pass
+            self._client.scan_abort(self._pidxs[self._i],
+                                    self._context_id,
+                                    consistency=self._consistency,
+                                    prefer_node=self._node)
             self._context_id = None

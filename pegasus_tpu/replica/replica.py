@@ -172,6 +172,12 @@ class Replica:
         self._learn_ckpt_dirs: Dict[str, str] = {}  # learner -> frozen ckpt
         # reads/checkpoints gate on this after a promotion (replica.cpp:426)
         self._promotion_watermark = 0
+        # follower reads: when this replica last observed itself caught up
+        # to the primary's advertised commit point (stamped in _on_prepare
+        # and _on_group_check on the SECONDARY side). bounded_stale ops
+        # compare `now - _fresh_as_of` against their max_lag_ms bound; a
+        # replica that has never synced is infinitely stale by definition
+        self._fresh_as_of = float("-inf")
         # lazily hydrated from the .ingested_loads marker (bulk load dedup)
         self._ingested_load_ids: Set[int] = set()
         # decree -> responses computed at idempotent translation time
@@ -236,6 +242,17 @@ class Replica:
         window has re-committed (parity: replica.cpp:426 — the gate that
         keeps a fresh primary from serving state missing acked writes)."""
         return self.last_committed_decree >= self._promotion_watermark
+
+    def staleness_s(self, now: float) -> float:
+        """Seconds since this replica last proved itself caught up to the
+        primary's advertised commit point. A PRIMARY is fresh by
+        definition (it IS the commit point); a secondary's freshness is
+        stamped when a prepare/group_check shows it committed everything
+        the primary had committed at send time — so the bound is the
+        primary→secondary sync cadence, not the mutation rate."""
+        if self.status == PartitionStatus.PRIMARY:
+            return 0.0
+        return max(0.0, now - self._fresh_as_of)
 
     # ---- config (driven by meta / tests) ------------------------------
 
@@ -582,6 +599,12 @@ class Replica:
                 if self.status == PartitionStatus.SECONDARY
                 else COMMIT_TO_DECREE_SOFT)
         self.prepare_list.commit(min(mu.last_committed, mu.decree - 1), mode)
+        # follower-read freshness: this prepare proves we now hold every
+        # decree the primary had committed when it sent (the piggy-backed
+        # last_committed), so stamp the staleness clock
+        if (self.status == PartitionStatus.SECONDARY
+                and self.last_committed_decree >= mu.last_committed):
+            self._fresh_as_of = self.clock()
         # the OK ack waits for the group-commit window's shared
         # flush/fsync: "appended before it can be acked" must mean
         # DURABLY appended, or a crash mid-window could lose a
@@ -650,6 +673,11 @@ class Replica:
         target = min(payload["last_committed"], self.last_prepared_decree())
         if target > self.last_committed_decree:
             self.prepare_list.commit(target, COMMIT_TO_DECREE_HARD)
+        # follower-read freshness: caught up to the primary's advertised
+        # commit point as of this heartbeat → reset the staleness clock
+        if (self.status == PartitionStatus.SECONDARY
+                and self.last_committed_decree >= payload["last_committed"]):
+            self._fresh_as_of = self.clock()
         self.transport.send(self.name, src, "group_check_ack", {
             "ballot": payload["ballot"],
             "last_committed": self.last_committed_decree})

@@ -163,6 +163,14 @@ class ReplicaStub:
         # typed ERR_DUP_FENCED while a table drains its duplication
         self._dup_fence_rejects = storage_ent.counter(
             "dup_fence_reject_count")
+        # follower-read observability (per-table twins live on each
+        # partition's "replica" entity): reads answered by a SECONDARY
+        # under its beacon lease, reads bounced typed ERR_STALE_REPLICA,
+        # and the subset of bounces caused by a lapsed lease
+        self._follower_reads = storage_ent.counter("follower_read_count")
+        self._stale_bounces = storage_ent.counter("stale_bounce_count")
+        self._lease_rejects = storage_ent.counter(
+            "read_lease_reject_count")
         self.scrubber = ReplicaScrubber(
             lambda: self.replicas, self._on_scrub_corruption,
             clock=self.sim_clock)
@@ -183,6 +191,11 @@ class ReplicaStub:
         # chaos surface): `FAIL_POINTS.cfg("stub_read_shed:<node>", ...)`
         # makes THIS node's read gate shed with ERR_BUSY
         self._shed_fp_name = f"stub_read_shed:{name}"
+        # chaos surface for lease-expiry fencing:
+        # `FAIL_POINTS.cfg("fd::beacon_drop:<node>", ...)` drops THIS
+        # node's outgoing FD beacons so a test can lapse one secondary's
+        # read lease deterministically (seeded like every fail point)
+        self._beacon_drop_fp_name = f"fd::beacon_drop:{name}"
         # flight recorder + health watchdog (utils/timeseries, utils/
         # health): fixed-cadence ring capture over this node's metric
         # entities, rules journaling typed events, digest riding
@@ -719,10 +732,7 @@ class ReplicaStub:
         if not self.recorder.due():
             return
         now = self.sim_clock()
-        # before the first ack the node is still joining — 0, not inf
-        age = (0.0 if self._last_beacon_ack == float("-inf")
-               else now - self._last_beacon_ack)
-        self._beacon_age_gauge.set(round(max(age, 0.0), 3))
+        self.beacon_ack_age()
         if PROFILER.enabled and (
                 now - getattr(self, "_profiler_published_at", -1e18)
                 >= 30.0):
@@ -1087,10 +1097,26 @@ class ReplicaStub:
         """Worker-side self-fencing: a node whose FD lease lapsed must stop
         serving BEFORE meta's grace expires (failure_detector.h:79-121) —
         otherwise a partitioned primary would serve stale reads after its
-        partition was reassigned."""
+        partition was reassigned. Follower reads lean on the SAME lease:
+        it is what bounds how long a partitioned secondary can keep
+        answering after the world moved on."""
         from pegasus_tpu.meta.failure_detector import worker_lease_valid
 
         return worker_lease_valid(self._last_beacon_ack, self.sim_clock())
+
+    def beacon_ack_age(self) -> float:
+        """Seconds since the last beacon ack, on the node's sim clock —
+        the ONE number both the lease check and the `fd_beacon_miss`
+        health rule consume. Stamped onto the `beacon_ack_age_s` gauge
+        at every call (the recorder-cadence health_tick AND the
+        replica-side lease decisions), so an incident timeline shows the
+        age a read-lease rejection actually read, not a snapshot from up
+        to a recorder period earlier."""
+        # before the first ack the node is still joining — 0, not inf
+        age = (0.0 if self._last_beacon_ack == float("-inf")
+               else max(0.0, self.sim_clock() - self._last_beacon_ack))
+        self._beacon_age_gauge.set(round(age, 3))
+        return age
 
     def _deadline_expired(self, payload: dict) -> bool:
         """True when the request's end-to-end deadline already passed on
@@ -1376,6 +1402,22 @@ class ReplicaStub:
         ph = payload.get("partition_hash")
         args = payload.get("args")
         srv = r.server
+        from pegasus_tpu.replica.replica import PartitionStatus
+        from pegasus_tpu.utils import perf_context as perf
+        from pegasus_tpu.utils import tracing
+
+        served_by = ("primary" if r.status == PartitionStatus.PRIMARY
+                     else "secondary")
+        sp = tracing.current_span()
+        if sp is not None:
+            sp.tags["served_by"] = served_by
+        # activate the op's cost vector HERE with served_by pre-set: the
+        # storage handlers adopt the ambient context (perf.current()),
+        # so explain/trace/slow-log all show which replica role answered
+        pc = perf.start(f"read.{op}")
+        if pc is not None:
+            pc.served_by = served_by
+            perf.push(pc)
         try:
             if op == "get":
                 result = srv.on_get(args, partition_hash=ph)
@@ -1423,8 +1465,15 @@ class ReplicaStub:
                 "rid": rid, "err": int(ErrorCode.ERR_INVALID_STATE),
                 "result": None})
             return
+        finally:
+            if pc is not None:
+                perf.pop(pc)
+        # the committed-decree stamp is the monotonic session token: the
+        # client's next `monotonic` read for this partition carries it
+        # as min_decree, so no later read can observe an older prefix
         self.net.send(self.name, src, "client_read_reply", {
-            "rid": rid, "err": int(ErrorCode.ERR_OK), "result": result})
+            "rid": rid, "err": int(ErrorCode.ERR_OK), "result": result,
+            "decree": r.last_committed_decree, "served_by": served_by})
 
     def _client_read_gate(self, payload: dict, src: str):
         """The read path's framework gates (ACL -> primary/lease ->
@@ -1451,17 +1500,75 @@ class ReplicaStub:
         r = self.replicas.get(gpid)
         if not self._client_allowed(r, payload, access="r", src=src):
             return int(ErrorCode.ERR_ACL_DENY), None
-        if (r is None or r.status != PartitionStatus.PRIMARY
-                or getattr(r, "restoring", False)
-                or not r.ready_to_serve()
-                or not self.lease_valid()):
+        if (r is None or getattr(r, "restoring", False)
+                or not r.ready_to_serve()):
             return int(ErrorCode.ERR_INVALID_STATE), None
+        if r.status == PartitionStatus.PRIMARY:
+            if not self.lease_valid():
+                return int(ErrorCode.ERR_INVALID_STATE), None
+        else:
+            ferr = self._follower_gate(r, payload)
+            if ferr is not None:
+                return ferr, None
         # split staleness gate for EVERY read op (scanner paging ops
-        # carry ph=None — their context was validated at get_scanner)
+        # carry ph=None — their context was validated at get_scanner);
+        # follower-served reads keep it too: a secondary of a split
+        # parent must bounce rows the flip moved, exactly like a primary
         gate = r.server._hash_gate(payload.get("partition_hash"))
         if gate:
             return gate, None
         return None, r
+
+    def _follower_gate(self, r, payload: dict) -> Optional[int]:
+        """Secondary-serving decision for one consistency-levelled read.
+        Returns None when this SECONDARY may answer it, else the typed
+        bounce: ERR_INVALID_STATE for ops secondaries never serve
+        (linearizable — the client misrouted, refresh + go to the
+        primary), ERR_STALE_REPLICA (RETRYABLE, subset-only) when the
+        beacon lease lapsed or the committed watermark misses the op's
+        bound — the routing table is still right, so the client re-sends
+        just the bounced ops to the primary without a config refresh.
+
+        The lease guarantee: a secondary only answers while its
+        beacon-acknowledged lease (worker lease < meta grace) is live,
+        so by the time meta could have reassigned the partition around a
+        partitioned node, that node has ALREADY stopped serving — the
+        same self-fencing clock that gates a partitioned primary."""
+        from pegasus_tpu.replica.replica import PartitionStatus
+        from pegasus_tpu.utils.errors import ErrorCode
+
+        cons = payload.get("consistency")
+        if r.status != PartitionStatus.SECONDARY or not cons:
+            return int(ErrorCode.ERR_INVALID_STATE)
+        level = cons.get("level")
+        if level not in ("bounded_stale", "monotonic"):
+            return int(ErrorCode.ERR_INVALID_STATE)
+        # stamping the gauge HERE is the point: the health rule and this
+        # lease decision read the same age on the same clock
+        self.beacon_ack_age()
+        if not self.lease_valid():
+            self._lease_rejects.increment()
+            self._stale_bounces.increment()
+            r.server._lease_rejects.increment()
+            r.server._stale_bounces.increment()
+            return int(ErrorCode.ERR_STALE_REPLICA)
+        if level == "bounded_stale":
+            max_lag_ms = float(cons.get("max_lag_ms") or 0.0)
+            if r.staleness_s(self.sim_clock()) * 1000.0 > max_lag_ms:
+                self._stale_bounces.increment()
+                r.server._stale_bounces.increment()
+                return int(ErrorCode.ERR_STALE_REPLICA)
+        # the monotonic session token (and any bound a bounded_stale op
+        # chooses to carry): never serve below the decree the client has
+        # already observed for this partition
+        min_decree = int(cons.get("min_decree") or 0)
+        if r.last_committed_decree < min_decree:
+            self._stale_bounces.increment()
+            r.server._stale_bounces.increment()
+            return int(ErrorCode.ERR_STALE_REPLICA)
+        self._follower_reads.increment()
+        r.server._follower_reads.increment()
+        return None
 
     def _on_client_read_batch(self, items) -> None:
         """Transport flush-window delivery: a consecutive run of queued
@@ -1470,6 +1577,7 @@ class ReplicaStub:
         serve through the cross-partition read coordinator in ONE
         flush; everything else falls through to the solo handler in
         arrival order."""
+        from pegasus_tpu.replica.replica import PartitionStatus
         from pegasus_tpu.server.read_coordinator import (
             is_point_read,
             point_read_multi,
@@ -1477,7 +1585,7 @@ class ReplicaStub:
         from pegasus_tpu.utils import tracing
         from pegasus_tpu.utils.errors import ErrorCode
 
-        flush: list = []  # (src, payload, server, span) past the gates
+        flush: list = []  # (src, payload, replica, span) past the gates
         for src, payload in items:
             op = payload.get("op", "get")
             ctx = payload.get("trace")
@@ -1502,14 +1610,17 @@ class ReplicaStub:
             # per-message span parented to its OWN context: a flush
             # coalesces reads from many independent traces — each op
             # keeps its span, the flush never becomes one carrier
-            flush.append((src, payload, r.server,
-                          tracing.start_server_span(
-                              self.name, "client_read", ctx)))
+            span = tracing.start_server_span(self.name, "client_read", ctx)
+            if span is not None:
+                span.tags["served_by"] = (
+                    "primary" if r.status == PartitionStatus.PRIMARY
+                    else "secondary")
+            flush.append((src, payload, r, span))
         if not flush:
             return
         groups: dict = {}
-        for i, (_src, _payload, server, _sp) in enumerate(flush):
-            groups.setdefault(id(server), (server, []))[1].append(i)
+        for i, (_src, _payload, rep, _sp) in enumerate(flush):
+            groups.setdefault(id(rep.server), (rep.server, []))[1].append(i)
         pairs = [(server, [(flush[i][1].get("op", "get"),
                             flush[i][1].get("args"),
                             flush[i][1].get("partition_hash"))
@@ -1536,15 +1647,21 @@ class ReplicaStub:
                 return
             for (_server, idxs), res in zip(groups.values(), results):
                 for i, result in zip(idxs, res):
-                    src, payload, _srv, span = flush[i]
+                    src, payload, rep, span = flush[i]
                     # the reply rides this op's span context (tail-keep
-                    # bit included) back to its client
+                    # bit included) back to its client; the decree stamp
+                    # feeds the client's monotonic session token
                     with tracing.activate(span):
                         self.net.send(
                             self.name, src, "client_read_reply", {
                                 "rid": payload.get("rid"),
                                 "err": int(ErrorCode.ERR_OK),
-                                "result": result})
+                                "result": result,
+                                "decree": rep.last_committed_decree,
+                                "served_by": (
+                                    "primary" if rep.status
+                                    == PartitionStatus.PRIMARY
+                                    else "secondary")})
         finally:
             for _src, _payload, _srv, span in flush:
                 if span is not None:
@@ -1563,10 +1680,17 @@ class ReplicaStub:
         )
         from pegasus_tpu.utils.errors import ErrorCode, PegasusError
 
+        from pegasus_tpu.replica.replica import PartitionStatus
+
         rid = payload.get("rid")
         groups = payload.get("groups") or []
+        # batch-wide consistency level; per-partition monotonic session
+        # tokens ride as (pidx, min_decree) pairs next to it
+        cons = payload.get("consistency")
+        min_decrees = dict(payload.get("min_decrees") or [])
         slots: list = []
-        ok: list = []  # (slot index, server, ops)
+        decrees: list = []  # (pidx, committed decree) for served slots
+        ok: list = []  # (slot index, replica, ops)
         for gpid, ops in groups:
             gpid = tuple(gpid)
             # validate BEFORE planning: one malformed op must fail its
@@ -1577,14 +1701,23 @@ class ReplicaStub:
                               int(ErrorCode.ERR_INVALID_PARAMETERS),
                               None))
                 continue
+            slot_cons = cons
+            if cons is not None:
+                slot_cons = dict(cons, min_decree=max(
+                    int(cons.get("min_decree") or 0),
+                    int(min_decrees.get(gpid[1], 0))))
             err, r = self._client_read_gate(
                 {"gpid": gpid, "auth": payload.get("auth"),
-                 "deadline": payload.get("deadline")}, src)
+                 "deadline": payload.get("deadline"),
+                 "consistency": slot_cons}, src)
             if err is not None:
                 slots.append((gpid[1], err, None))
                 continue
             slots.append((gpid[1], int(ErrorCode.ERR_OK), None))
-            ok.append((len(slots) - 1, r.server, ops))
+            decrees.append((gpid[1], r.last_committed_decree,
+                            "primary" if r.status
+                            == PartitionStatus.PRIMARY else "secondary"))
+            ok.append((len(slots) - 1, r, ops))
         # batching-seam fan-out: each op in the carrier gets its own
         # span parented to the CARRIER's dispatch span — N ops in one
         # carrier yield N child spans, never N carriers
@@ -1593,16 +1726,19 @@ class ReplicaStub:
         carrier = tracing.current_span()
         op_spans: list = []
         if carrier is not None:
-            for _slot_i, srv, ops in ok:
-                op_spans.extend(
-                    tracing.child_of(carrier,
-                                     f"op.{o[0]}.{srv.pidx}")
-                    for o in ops)
+            for _slot_i, rep, ops in ok:
+                role = ("primary" if rep.status == PartitionStatus.PRIMARY
+                        else "secondary")
+                for o in ops:
+                    osp = tracing.child_of(
+                        carrier, f"op.{o[0]}.{rep.server.pidx}")
+                    osp.tags["served_by"] = role
+                    op_spans.append(osp)
         if ok:
             try:
                 results = point_read_multi(
-                    [(srv, [tuple(o) for o in ops])
-                     for _i, srv, ops in ok],
+                    [(rep.server, [tuple(o) for o in ops])
+                     for _i, rep, ops in ok],
                     deadline=payload.get("deadline"), clock=self.clock)
             except PegasusError:
                 # the batch's deadline lapsed mid-flush: typed timeout
@@ -1624,26 +1760,31 @@ class ReplicaStub:
                 bad = (self._replica_for_path(e.path)
                        if isinstance(e, StorageCorruptionError) else None)
                 code = self._on_storage_error(bad, e)
-                for slot_i, srv, _ops in ok:
+                for slot_i, rep, _ops in ok:
                     hit = bad is not None and \
-                        (srv.app_id, srv.pidx) == bad
+                        (rep.server.app_id, rep.server.pidx) == bad
                     slots[slot_i] = (
                         slots[slot_i][0],
                         code if (hit or bad is None)
                         else int(ErrorCode.ERR_INVALID_STATE), None)
             except RuntimeError:
-                for slot_i, _srv, _ops in ok:
+                for slot_i, _rep, _ops in ok:
                     slots[slot_i] = (slots[slot_i][0], int(
                         ErrorCode.ERR_INVALID_STATE), None)
             else:
-                for (slot_i, _srv, _ops), res in zip(ok, results):
+                for (slot_i, _rep, _ops), res in zip(ok, results):
                     slots[slot_i] = (slots[slot_i][0],
                                      int(ErrorCode.ERR_OK), res)
             finally:
                 for sp in op_spans:
                     sp.finish()
+        # `decrees` travels NEXT TO the slots (pidx, decree, served_by):
+        # slot shape stays (pidx, err, results) for every existing
+        # consumer, and the client folds the stamps into its monotonic
+        # session tokens only for slots that actually served
         self.net.send(self.name, src, "client_read_reply", {
-            "rid": rid, "err": int(ErrorCode.ERR_OK), "result": slots})
+            "rid": rid, "err": int(ErrorCode.ERR_OK), "result": slots,
+            "decrees": decrees})
 
     def _on_config_proposal(self, src: str, payload: dict) -> None:
         """Meta assigns a configuration (parity: on_config_proposal,
@@ -1838,9 +1979,12 @@ class ReplicaStub:
 
         rid = payload.get("rid")
         groups = payload.get("groups") or []
+        cons = payload.get("consistency")
+        min_decrees = dict(payload.get("min_decrees") or [])
         now = None
         ok_servers = []
         slots = []
+        decrees = []  # (pidx, committed decree, served_by) per served slot
         for gpid, reqs in groups:
             gpid = tuple(gpid)
             r = self.replicas.get(gpid)
@@ -1854,18 +1998,36 @@ class ReplicaStub:
                     errs.append(resp)
                 slots.append((gpid[1], errs))
                 continue
-            if (r is None or r.status != PartitionStatus.PRIMARY
-                    or getattr(r, "restoring", False)
-                    or not r.ready_to_serve()
-                    or not self.lease_valid()):
+            gerr = None
+            if (r is None or getattr(r, "restoring", False)
+                    or not r.ready_to_serve()):
+                gerr = int(ErrorCode.ERR_INVALID_STATE)
+            elif r.status == PartitionStatus.PRIMARY:
+                if not self.lease_valid():
+                    gerr = int(ErrorCode.ERR_INVALID_STATE)
+            else:
+                # same consistency gate as the point paths: a SECONDARY
+                # serves the scan slot under its lease + watermark, or
+                # bounces it typed so the client re-flies JUST this slot
+                slot_cons = cons
+                if cons is not None:
+                    slot_cons = dict(cons, min_decree=max(
+                        int(cons.get("min_decree") or 0),
+                        int(min_decrees.get(gpid[1], 0))))
+                gerr = self._follower_gate(
+                    r, {"consistency": slot_cons})
+            if gerr is not None:
                 errs = []
                 for _req in reqs:
                     resp = ScanResponse()
-                    resp.error = int(ErrorCode.ERR_INVALID_STATE)
+                    resp.error = gerr
                     errs.append(resp)
                 slots.append((gpid[1], errs))
                 continue
             slots.append((gpid[1], None))
+            decrees.append((gpid[1], r.last_committed_decree,
+                            "primary" if r.status
+                            == PartitionStatus.PRIMARY else "secondary"))
             ok_servers.append((len(slots) - 1, r.server, reqs))
         if ok_servers:
             from pegasus_tpu.base.value_schema import epoch_now
@@ -1909,7 +2071,8 @@ class ReplicaStub:
                                                         results):
                     slots[slot_i] = (slots[slot_i][0], resps)
         self.net.send(self.name, src, "client_read_reply", {
-            "rid": rid, "err": int(ErrorCode.ERR_OK), "result": slots})
+            "rid": rid, "err": int(ErrorCode.ERR_OK), "result": slots,
+            "decrees": decrees})
 
     def _peer_key(self, src: str):
         """Session-scoped peer key for negotiation state: (src,
@@ -2386,5 +2549,13 @@ class ReplicaStub:
     def send_beacon(self) -> None:
         """Parity: the FD beacon ping (failure_detector.h:79) — sent to
         every meta-group member; only the leader's FD acts."""
+        from pegasus_tpu.utils.fail_point import fail_point
+
+        if fail_point(self._beacon_drop_fp_name) is not None:
+            # chaos: this node's beacon dies on the floor — no ack, so
+            # its worker lease (and with it the follower-read lease)
+            # lapses deterministically while meta's grace counts down,
+            # exactly the partitioned-node timeline the lease must fence
+            return
         for meta in self._meta_targets():
             self.net.send(self.name, meta, "beacon", {"node": self.name})

@@ -257,7 +257,9 @@ def render_report(report: Dict[str, Any]) -> str:
     lines = [f"EXPLAIN {report.get('op', '?')}{where} — "
              f"{report.get('total_ms', 0.0):.3f} ms"
              + (f", placement {pc.get('placement')}"
-                if pc.get("placement") else "")]
+                if pc.get("placement") else "")
+             + (f", served_by {pc.get('served_by')}"
+                if pc.get("served_by") else "")]
     stages = report.get("stages") or []
     for i, st in enumerate(stages):
         lines.append("  " + _stage_line(st, pc, i == len(stages) - 1))

@@ -244,6 +244,15 @@ class PartitionServer:
         self._phash_useful = self.metrics.counter("phash_useful_count")
         self._row_cache_hits = self.metrics.counter("row_cache_hit")
         self._row_cache_misses = self.metrics.counter("row_cache_miss")
+        # follower-read observability, per partition (node-wide twins on
+        # the "storage" entity): reads this SECONDARY answered, reads it
+        # bounced ERR_STALE_REPLICA, and the subset of those bounces
+        # caused by a lapsed beacon lease — incremented by the hosting
+        # stub's consistency gate
+        self._follower_reads = self.metrics.counter("follower_read_count")
+        self._stale_bounces = self.metrics.counter("stale_bounce_count")
+        self._lease_rejects = self.metrics.counter(
+            "read_lease_reject_count")
         # resident index memory as a first-class signal: per-table
         # bloom-vs-phash byte split, refreshed whenever the probe
         # structures rebuild (exactly when the run set changes) and

@@ -35,9 +35,22 @@ _REPO_ROOT = os.path.dirname(os.path.dirname(
 
 class DataVerifier:
     """Continuous write->read verification (data_verifier.cpp parity):
-    every acked write must remain readable with its exact value."""
+    every acked write must remain readable with its exact value.
 
-    def __init__(self, client, rng: random.Random) -> None:
+    `monotonic_ledger` adds the follower-read invariant: a small set of
+    REPEATEDLY-OVERWRITTEN ledger keys carries a strictly increasing
+    counter, and every ledger read (issued at `read_consistency`, e.g.
+    MONOTONIC so it fans out to lease-holding secondaries) must never
+    observe a counter below what this session already saw for that key
+    — and never NotFound after a value was observed. The write-once
+    `kt` keys can't catch a time-travelling follower read; the ledger
+    keys exist to."""
+
+    LEDGER_KEYS = 8
+
+    def __init__(self, client, rng: random.Random,
+                 monotonic_ledger: bool = False,
+                 read_consistency=None) -> None:
         self.client = client
         self.rng = rng
         self.acked: Dict[bytes, bytes] = {}
@@ -45,6 +58,11 @@ class DataVerifier:
         self.write_ok = 0
         self.write_rejected = 0
         self.violations: List[str] = []
+        self.monotonic_ledger = monotonic_ledger
+        self.read_consistency = read_consistency
+        self.ledger_next: Dict[bytes, int] = {}   # next counter to write
+        self.ledger_seen: Dict[bytes, int] = {}   # session read floor
+        self.ledger_reads = 0
 
     def step(self) -> None:
         # one write
@@ -73,6 +91,59 @@ class DataVerifier:
                         f"{hk!r}: read {got!r}, acked {want!r}")
                 elif err == 1:  # NotFound: an acked write vanished
                     self.violations.append(f"{hk!r}: acked write lost")
+        if self.monotonic_ledger:
+            self._ledger_step()
+
+    @staticmethod
+    def _ledger_counter(value: bytes) -> Optional[int]:
+        if value[:1] == b"c" and value[1:].isdigit():
+            return int(value[1:])
+        return None
+
+    def _ledger_step(self) -> None:
+        # bump one ledger key. An unacked write may still have
+        # committed — harmless: the floor only ratchets on READS, and
+        # a committed-but-unacked counter that becomes visible simply
+        # raises the floor when first observed.
+        hk = b"ml%02d" % self.rng.randrange(self.LEDGER_KEYS)
+        nxt = self.ledger_next.get(hk, 0) + 1
+        self.ledger_next[hk] = nxt
+        try:
+            self.client.set(hk, b"c", b"c%08d" % nxt)
+        except PegasusError:
+            pass
+        # read a sample back at the session's consistency level: the
+        # observed counter must never regress below this session's floor
+        for hk in self.rng.sample(sorted(self.ledger_next),
+                                  min(2, len(self.ledger_next))):
+            try:
+                if self.read_consistency is not None:
+                    err, got = self.client.get(
+                        hk, b"c", consistency=self.read_consistency)
+                else:  # plain clients lack the kwarg entirely
+                    err, got = self.client.get(hk, b"c")
+            except PegasusError:
+                continue  # unavailable now; not a monotonicity breach
+            self.ledger_reads += 1
+            floor = self.ledger_seen.get(hk, 0)
+            if err == 1:
+                if floor:
+                    self.violations.append(
+                        f"ledger {hk!r}: NotFound after observing "
+                        f"counter {floor} (monotonic-reads breach)")
+                continue
+            if err != 0:
+                continue
+            cur = self._ledger_counter(got)
+            if cur is None:
+                self.violations.append(
+                    f"ledger {hk!r}: unparseable value {got!r}")
+            elif cur < floor:
+                self.violations.append(
+                    f"ledger {hk!r}: read counter {cur} below session "
+                    f"floor {floor} (monotonic-reads breach)")
+            else:
+                self.ledger_seen[hk] = cur
 
     def final_check(self, deadline_s: float = 120.0) -> None:
         """After chaos ends: EVERY acked write must read back."""
@@ -239,10 +310,14 @@ class Killer:
 def run_kill_test(directory: str, duration_s: float = 60.0,
                   kill_every_s: float = 12.0, seed: int = 0,
                   table: str = "killtest", mode: str = "kill",
-                  op_timeout_ms: Optional[float] = None) -> dict:
+                  op_timeout_ms: Optional[float] = None,
+                  monotonic_ledger: bool = False) -> dict:
     """`op_timeout_ms`: verifier-client end-to-end op deadline — under
     chaos every op must either succeed or raise a typed PegasusError
-    within it (no hangs); None keeps the flag default."""
+    within it (no hangs); None keeps the flag default.
+    `monotonic_ledger`: also run the follower-read monotonic-reads
+    ledger, with the ledger reads issued at MONOTONIC consistency so
+    they fan out to secondaries under the read lease while nodes die."""
     from pegasus_tpu.tools import onebox_cluster as ob
 
     rng = random.Random(seed)
@@ -269,7 +344,13 @@ def run_kill_test(directory: str, duration_s: float = 60.0,
                 raise
             time.sleep(1)
     client = ob.connect(table, directory, op_timeout_ms=op_timeout_ms)
-    verifier = DataVerifier(client, rng)
+    if monotonic_ledger:
+        from pegasus_tpu.client.cluster_client import MONOTONIC
+
+        verifier = DataVerifier(client, rng, monotonic_ledger=True,
+                                read_consistency=MONOTONIC)
+    else:
+        verifier = DataVerifier(client, rng)
     killer = Killer(directory, rng, mode=mode,
                     admin=admin if mode == "corrupt" else None)
 
@@ -296,6 +377,8 @@ def run_kill_test(directory: str, duration_s: float = 60.0,
         "writes_rejected": verifier.write_rejected,
         "violations": verifier.violations,
     }
+    if monotonic_ledger:
+        report["ledger_reads"] = verifier.ledger_reads
     if mode == "corrupt":
         # the integrity loop's observability: every planted flip must
         # have been detected (read path or scrub), quarantined, and
@@ -332,9 +415,14 @@ def main() -> None:
                          "pause: SIGSTOP/SIGCONT (hung-node detection); "
                          "corrupt: seeded SST bit-flips (block-crc "
                          "detection -> quarantine -> re-learn)")
+    ap.add_argument("--monotonic-ledger", action="store_true",
+                    help="also run the follower-read monotonic-reads "
+                         "ledger (MONOTONIC-consistency reads against "
+                         "secondaries under chaos)")
     args = ap.parse_args()
     report = run_kill_test(args.dir, args.duration, args.kill_every,
-                           args.seed, mode=args.mode)
+                           args.seed, mode=args.mode,
+                           monotonic_ledger=args.monotonic_ledger)
     print(json.dumps(report, indent=1))
     sys.exit(1 if report["violations"] else 0)
 

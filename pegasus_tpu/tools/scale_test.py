@@ -62,19 +62,31 @@ class TenantWorkload:
     acked-write ledger the final durability check replays."""
 
     def __init__(self, name: str, client, rng: random.Random,
-                 n_keys: int = 2000, skew: float = 1.2) -> None:
+                 n_keys: int = 2000, skew: float = 1.2,
+                 monotonic_ledger: bool = False) -> None:
         self.name = name
         self.client = client
         self.rng = rng
         self.n_keys = n_keys
         self.skew = skew
         self._weights = zipf_weights(n_keys, skew)
-        self.verifier = DataVerifier(client, rng)
+        read_consistency = None
+        if monotonic_ledger:
+            # the ledger reads fan out to lease-holding secondaries —
+            # the monotonic-reads invariant is checked against follower
+            # serving under the same chaos as the durability ledger
+            from pegasus_tpu.client.cluster_client import MONOTONIC
+
+            read_consistency = MONOTONIC
+        self.verifier = DataVerifier(client, rng,
+                                     monotonic_ledger=monotonic_ledger,
+                                     read_consistency=read_consistency)
         self.reads_ok = 0
         self.read_errors = 0
 
     def step(self) -> None:
-        # sequenced verifier write + history re-read (the invariant)
+        # sequenced verifier write + history re-read (the invariant),
+        # plus the monotonic-reads ledger when enabled
         self.verifier.step()
         # plus zipfian reads/writes shaping the per-partition heat the
         # elasticity signals are computed from
@@ -145,7 +157,8 @@ def run_scale_test(directory: str, n_tenants: int = 4,
             client = ob.connect(table, directory,
                                 op_timeout_ms=op_timeout_ms)
             tenants.append(TenantWorkload(
-                table, client, random.Random(seed * 1000 + t)))
+                table, client, random.Random(seed * 1000 + t),
+                monotonic_ledger=True))
         killer = (Killer(directory, rng, mode=chaos_mode, admin=admin)
                   if chaos_mode else None)
 
@@ -203,6 +216,7 @@ def run_scale_test(directory: str, n_tenants: int = 4,
                 "writes_rejected": tw.verifier.write_rejected,
                 "reads_ok": tw.reads_ok,
                 "read_errors": tw.read_errors,
+                "ledger_reads": tw.verifier.ledger_reads,
             }
             report["violations"].extend(
                 f"{tw.name}: {v}" for v in tw.verifier.violations)

@@ -85,6 +85,12 @@ class ErrorCode(enum.IntEnum):
     # backoff rides out the drain and lands on the flipped follower
     # (or surfaces the fence to the operator at its op deadline)
     ERR_DUP_FENCED = 64
+    # follower-read bounce: a secondary declined a consistency-levelled
+    # read because its beacon lease lapsed or its committed decree is
+    # outside the op's staleness bound. RETRYABLE — the client re-sends
+    # ONLY the bounced ops to the primary (the routing table is still
+    # correct, so no config refresh is burned on the retry)
+    ERR_STALE_REPLICA = 65
 
 
 class StorageStatus(enum.IntEnum):

@@ -138,6 +138,15 @@ def default_rules() -> List[HealthRule]:
                    min_points=2, severity=SEV_DEGRADED,
                    description="geo-replication falling behind "
                    "(> 500 decrees sustained)"),
+        HealthRule("stale_bounce_rate", "storage", "stale_bounce_count",
+                   kind="burn_rate", threshold=1.0, window_s=30.0,
+                   min_points=2, severity=SEV_DEGRADED,
+                   description="sustained follower-read bounces (> 1/s "
+                   "ERR_STALE_REPLICA): secondaries keep declining "
+                   "consistency-levelled reads — lease lapses (check "
+                   "fd_beacon_miss) or replication lag beyond the "
+                   "bound, and every bounce is a wasted round-trip "
+                   "re-flown at the primary"),
         HealthRule("fd_beacon_miss", "rpc", "beacon_ack_age_s",
                    kind="threshold", threshold=9.0, hold=2,
                    severity=SEV_DEGRADED,

@@ -104,13 +104,16 @@ FIELDS: Tuple[str, ...] = _COUNTER_FIELDS + _GAUGE_FIELDS
 class PerfContext:
     """One op's (or one batched flush's) cost vector."""
 
-    __slots__ = ("op", "placement") + FIELDS
+    __slots__ = ("op", "placement", "served_by") + FIELDS
 
     def __init__(self, op: str = "") -> None:
         self.op = op
         # device | host-XLA | native | numpy — which compute class the
         # placement policy routed this op's kernels to ("" = no kernel)
         self.placement = ""
+        # primary | secondary — which replica role answered this read
+        # ("" = not a consistency-routed read, e.g. a write flush)
+        self.served_by = ""
         for f in _COUNTER_FIELDS:
             setattr(self, f, 0)
         for f in _GAUGE_FIELDS:
@@ -120,7 +123,8 @@ class PerfContext:
         """The FULL fixed vector (zeros included): solo and batched
         slow-log entries stay field-set-comparable by construction, and
         a field added here reaches every surface at once."""
-        d: Dict[str, Any] = {"op": self.op, "placement": self.placement}
+        d: Dict[str, Any] = {"op": self.op, "placement": self.placement,
+                             "served_by": self.served_by}
         for f in _COUNTER_FIELDS:
             d[f] = getattr(self, f)
         for f in _GAUGE_FIELDS:
@@ -188,6 +192,12 @@ def merge_span_perf(tags: Dict[str, Any], pc: "PerfContext") -> None:
         prev["placement"] = d["placement"]
     elif d["placement"] and d["placement"] != prev["placement"]:
         prev["placement"] = "mixed"
+    # same accumulate-don't-overwrite rule for which replica answered:
+    # a carrier mixing primary- and secondary-served slots says so
+    if not prev.get("served_by"):
+        prev["served_by"] = d["served_by"]
+    elif d["served_by"] and d["served_by"] != prev["served_by"]:
+        prev["served_by"] = "mixed"
 
 
 class activate:

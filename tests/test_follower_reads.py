@@ -1,0 +1,335 @@
+"""Lease-gated follower reads: per-op consistency levels end to end.
+
+The tentpole invariants, each proven on the deterministic SimCluster:
+
+- bounded_stale reads served AT SECONDARIES are byte-identical to
+  linearizable reads at the primary once the group check has advanced
+  the committed watermark (PacificA applies mutations on COMMIT, so a
+  secondary can never expose an uncommitted write by construction).
+- A secondary whose beacon lease lapsed bounces typed
+  ERR_STALE_REPLICA; the client re-flies ONLY the bounced subset, to
+  the primary, without burning a config refresh (the PR 6 misrouted-
+  subset discipline applied to replica choice).
+- The monotonic session token (per-partition observed committed
+  decree) means a client never reads below its own history, even when
+  its reads fan out across replicas mid-failover.
+- A split flip moves rows between partitions; a follower read of a
+  moved row bounces through the SAME split-staleness gate as a primary
+  read and re-resolves — never a stale parent row.
+
+Plus the chaos proof: the DataVerifier monotonic-reads ledger runs
+MONOTONIC-consistency reads through node kills and a beacon-drop lease
+lapse with zero violations (the onebox twin soaks the same invariant
+over real processes under `-m slow`).
+"""
+
+import random
+
+import pytest
+
+from pegasus_tpu.base.key_schema import generate_key, key_hash_parts
+from pegasus_tpu.client.cluster_client import MONOTONIC, bounded_stale
+from pegasus_tpu.tools.cluster import SimCluster
+from pegasus_tpu.tools.kill_test import DataVerifier
+from pegasus_tpu.utils.errors import ErrorCode
+from pegasus_tpu.utils.fail_point import FAIL_POINTS
+
+OK = 0
+STALE = int(ErrorCode.ERR_STALE_REPLICA)
+
+
+def _sum_counter(cluster, attr: str) -> int:
+    return sum(getattr(stub, attr).value()
+               for stub in cluster.stubs.values())
+
+
+def test_bounded_stale_at_secondary_byte_identical(tmp_path):
+    """Caught-up secondaries serve bounded_stale reads with the exact
+    bytes the primary serves, and the follower_read counter proves the
+    answers really came from secondaries."""
+    cluster = SimCluster(str(tmp_path / "c"), n_nodes=3, seed=5)
+    try:
+        cluster.create_table("fr", partition_count=4)
+        client = cluster.client("fr")
+        keys = [b"user_%03d" % i for i in range(24)]
+        for i, hk in enumerate(keys):
+            assert client.set(hk, b"s", b"payload-%03d" % i) == OK
+        # group check piggybacks last_committed: secondaries commit
+        # everything and stamp their freshness watermark
+        cluster.step(rounds=2)
+        lin = {hk: client.get(hk, b"s") for hk in keys}
+        before = _sum_counter(cluster, "_follower_reads")
+        stale = {hk: client.get(hk, b"s",
+                                consistency=bounded_stale(60_000))
+                 for hk in keys}
+        assert stale == lin  # byte-identity, err codes included
+        served = _sum_counter(cluster, "_follower_reads") - before
+        # the rotation spreads over primary + 2 secondaries, so ~2/3
+        # of the reads were answered at secondaries
+        assert served >= len(keys) // 2
+        assert _sum_counter(cluster, "_stale_bounces") == 0
+        # ...and the session tokens ratcheted from the reply decrees
+        assert client._session_tokens
+        assert all(v > 0 for v in client._session_tokens.values())
+    finally:
+        cluster.close()
+
+
+def test_monotonic_bounce_retries_only_the_stale_subset(tmp_path):
+    """A lagging secondary bounces a monotonic read below the session
+    token; the client re-flies ONLY the bounced partition's ops, to the
+    primary — the fresh partition's ops never fly twice."""
+    cluster = SimCluster(str(tmp_path / "c"), n_nodes=3, seed=7)
+    try:
+        app_id = cluster.create_table("mono", partition_count=4)
+        client = cluster.client("mono")
+        # two keys on two distinct partitions
+        by_pidx = {}
+        for i in range(64):
+            hk = b"k%03d" % i
+            ph = key_hash_parts(hk, b"s")
+            by_pidx.setdefault(ph % 4, (hk, ph))
+            if len(by_pidx) >= 2:
+                break
+        (p0, (hk0, ph0)), (p1, (hk1, ph1)) = sorted(by_pidx.items())[:2]
+        assert client.set(hk0, b"s", b"old0") == OK
+        assert client.set(hk1, b"s", b"v1") == OK
+        cluster.step(rounds=2)  # secondaries catch up on both
+        assert client.get(hk1, b"s") == (OK, b"v1")  # token(p1) = tip
+        # now advance ONLY p0 past its secondaries: the prepare commits
+        # decree d at the primary while secondaries sit at d-1, and the
+        # linearizable read ratchets the session token to d
+        assert client.set(hk0, b"s", b"new0") == OK
+        assert client.get(hk0, b"s") == (OK, b"new0")
+        tok0 = client._session_tokens[p0]
+        sent = []
+        orig = client._send_request
+
+        def spy(dst, method, payload, **kw):
+            if method == "client_read_batch":
+                sent.append((dst, payload))
+            return orig(dst, method, payload, **kw)
+
+        client._send_request = spy
+        bounced_before = _sum_counter(cluster, "_stale_bounces")
+        res = client.point_read_multi(
+            {p0: [("get", generate_key(hk0, b"s"), ph0)],
+             p1: [("get", generate_key(hk1, b"s"), ph1)]},
+            consistency=MONOTONIC)
+        assert res[p0][0] == (OK, b"new0")  # never the stale old0
+        assert res[p1][0] == (OK, b"v1")
+        assert _sum_counter(cluster, "_stale_bounces") > bounced_before
+        # the wire discipline: p1 flew exactly once; p0's retry flew
+        # alone, to the primary, carrying the session token
+        def pidxs_of(payload):
+            return {gpid[1] for gpid, _ops in payload["groups"]}
+
+        first = [s for s in sent if p1 in pidxs_of(s[1])]
+        assert len(first) == 1  # the fresh partition never re-flew
+        retries = [s for s in sent if pidxs_of(s[1]) == {p0}]
+        assert retries, sent
+        retry_dst, retry_payload = retries[-1]
+        assert retry_dst == cluster.primaries(app_id)[p0]
+        assert dict(retry_payload["min_decrees"])[p0] >= tok0
+        assert client._session_tokens[p0] >= tok0  # never regressed
+    finally:
+        cluster.close()
+
+
+def test_beacon_drop_lapses_lease_and_fences_follower(tmp_path):
+    """The fd::beacon_drop fail point starves ONE node's beacon acks;
+    its lease lapses, its follower gate bounces ERR_STALE_REPLICA with
+    the lease-reject counters ticked and the beacon_ack_age_s gauge
+    stamped replica-side AT the decision — and client reads stay
+    correct throughout. Healing the fail point restores serving."""
+    cluster = SimCluster(str(tmp_path / "c"), n_nodes=3, seed=9)
+    try:
+        app_id = cluster.create_table("lease", partition_count=1)
+        client = cluster.client("lease")
+        assert client.set(b"hk", b"s", b"v") == OK
+        cluster.step(rounds=2)
+        pc = cluster.meta.state.get_partition(app_id, 0)
+        victim = pc.secondaries[0]
+        stub = cluster.stubs[victim]
+        FAIL_POINTS.setup()
+        try:
+            FAIL_POINTS.cfg(f"fd::beacon_drop:{victim}", "return(x)")
+            acked_at = stub._last_beacon_ack
+            # 4 beacon intervals > the 9s worker lease: the node keeps
+            # "sending" but the fail point eats every beacon
+            cluster.step(rounds=4)
+            assert stub._last_beacon_ack == acked_at  # no ack landed
+            assert not stub.lease_valid()
+            rejects = stub._lease_rejects.value()
+            bounces = stub._stale_bounces.value()
+            err, r = stub._client_read_gate(
+                {"gpid": (app_id, 0), "auth": None,
+                 "consistency": {"level": "bounded_stale",
+                                 "max_lag_ms": 600_000.0}}, "cx")
+            assert err == STALE and r is None
+            assert stub._lease_rejects.value() == rejects + 1
+            assert stub._stale_bounces.value() == bounces + 1
+            # the gauge shows the age the lease decision actually read
+            assert stub._beacon_age_gauge.value() > 9.0
+            # end to end: the op lands correctly anyway (bounce at the
+            # fenced follower -> subset retry -> a serving replica)
+            assert client.get(b"hk", b"s",
+                              consistency=bounded_stale(600_000)) \
+                == (OK, b"v")
+        finally:
+            FAIL_POINTS.teardown()
+        cluster.step(rounds=2)  # beacons flow again: lease recovers
+        assert stub.lease_valid()
+        assert stub.beacon_ack_age() <= 9.0
+    finally:
+        cluster.close()
+
+
+def test_monotonic_ledger_chaos_sim(tmp_path):
+    """The acceptance chaos: seeded kills + a beacon-drop lease lapse
+    while the DataVerifier monotonic ledger reads at MONOTONIC
+    consistency through secondaries — zero regressions observed."""
+    cluster = SimCluster(str(tmp_path / "c"), n_nodes=4, seed=17)
+    try:
+        app_id = cluster.create_table("chaos", partition_count=4)
+        client = cluster.client("chaos")
+        client.op_timeout_ms = 600_000  # sim-seconds, spans failovers
+        verifier = DataVerifier(client, random.Random(17),
+                                monotonic_ledger=True,
+                                read_consistency=MONOTONIC)
+        for _ in range(10):
+            verifier.step()
+        FAIL_POINTS.setup()
+        try:
+            # lease-lapse chaos on one node while the stream continues
+            lame = sorted(cluster.stubs)[-1]
+            FAIL_POINTS.cfg(f"fd::beacon_drop:{lame}", "return(x)")
+            cluster.step(rounds=4)
+            for _ in range(8):
+                verifier.step()
+        finally:
+            FAIL_POINTS.teardown()
+        # crash a primary outright mid-stream
+        victim = next(p for p in cluster.primaries(app_id) if p)
+        cluster.kill(victim)
+        for _ in range(8):
+            verifier.step()
+        cluster.revive(victim)
+        cluster.step(rounds=4)
+        for _ in range(6):
+            verifier.step()
+        assert verifier.violations == [], verifier.violations
+        assert verifier.ledger_reads > 0
+        assert verifier.write_ok > 15
+        # the ledger really exercised follower serving
+        assert _sum_counter(cluster, "_follower_reads") > 0
+    finally:
+        cluster.close()
+
+
+def test_split_flip_never_serves_stale_parent_row(tmp_path):
+    """After an online 2x split, follower reads of moved rows pass the
+    SAME split-staleness gate as primary reads: every key reads back
+    byte-identical at bounded_stale, none from a stale parent half."""
+    cluster = SimCluster(str(tmp_path / "c"), n_nodes=3, seed=23)
+    try:
+        app_id = cluster.create_table("fs", partition_count=2)
+        client = cluster.client("fs")
+        client.op_timeout_ms = 600_000
+        keys = {b"user_%03d" % i: b"val-%03d" % i for i in range(32)}
+        for hk, v in keys.items():
+            assert client.set(hk, b"s", v) == OK
+        cluster.step(rounds=2)
+        assert cluster.meta.split.start_partition_split("fs") == 4
+        for _ in range(30):
+            cluster.step()
+            if not cluster.meta.split.split_status("fs")["splitting"]:
+                break
+        assert not cluster.meta.split.split_status("fs")["splitting"]
+        assert cluster.meta.state.apps[app_id].partition_count == 4
+        cluster.step(rounds=2)
+        for hk, want in keys.items():
+            assert client.get(hk, b"s",
+                              consistency=bounded_stale(600_000)) \
+                == (OK, want), hk
+        # post-split follower serving really happened
+        assert _sum_counter(cluster, "_follower_reads") > 0
+    finally:
+        cluster.close()
+
+
+def test_linearizable_rejected_at_secondary(tmp_path):
+    """A consistency-less read reaching a secondary (stale client
+    routing) still gets ERR_INVALID_STATE — follower serving never
+    silently weakens the default level."""
+    cluster = SimCluster(str(tmp_path / "c"), n_nodes=3, seed=29)
+    try:
+        app_id = cluster.create_table("lin", partition_count=1)
+        client = cluster.client("lin")
+        assert client.set(b"hk", b"s", b"v") == OK
+        cluster.step(rounds=2)
+        pc = cluster.meta.state.get_partition(app_id, 0)
+        stub = cluster.stubs[pc.secondaries[0]]
+        err, r = stub._client_read_gate(
+            {"gpid": (app_id, 0), "auth": None}, "cx")
+        assert err == int(ErrorCode.ERR_INVALID_STATE) and r is None
+        # unknown levels are rejected, not guessed at
+        err, r = stub._client_read_gate(
+            {"gpid": (app_id, 0), "auth": None,
+             "consistency": {"level": "eventual"}}, "cx")
+        assert err == int(ErrorCode.ERR_INVALID_STATE) and r is None
+        with pytest.raises(ValueError):
+            client.get(b"hk", b"s", consistency={"level": "eventual"})
+    finally:
+        cluster.close()
+
+
+def test_scanner_follower_paging_and_aggregate(tmp_path):
+    """A bounded_stale scanner pins a secondary, pages its context
+    there, and drains the same rows a linearizable scan drains —
+    including the aggregate-pushdown path."""
+    cluster = SimCluster(str(tmp_path / "c"), n_nodes=3, seed=31)
+    try:
+        cluster.create_table("scan", partition_count=2)
+        client = cluster.client("scan")
+        hk = b"stream"
+        want = {}
+        for i in range(40):
+            sk = b"s%03d" % i
+            v = b"v%03d" % i
+            assert client.set(hk, sk, v) == OK
+            want[sk] = v
+        cluster.step(rounds=2)
+        before = _sum_counter(cluster, "_follower_reads")
+        sc = client.get_scanner(hk, consistency=bounded_stale(60_000))
+        got = {sk: v for _hk, sk, v in sc}
+        assert got == want
+        assert _sum_counter(cluster, "_follower_reads") > before
+        agg = client.get_scanner(hk, consistency=bounded_stale(60_000))
+        assert agg.count() == len(want)
+        agg.close()
+    finally:
+        cluster.close()
+
+
+@pytest.mark.slow
+def test_onebox_chaos_monotonic_ledger(tmp_path):
+    """Onebox twin of the sim chaos proof: real processes, kill -9
+    chaos, ledger reads at MONOTONIC consistency — zero monotonic-reads
+    violations and zero acked-write loss."""
+    from pegasus_tpu.tools import onebox_cluster as ob
+    from pegasus_tpu.tools.kill_test import run_kill_test
+
+    d = str(tmp_path / "frbox")
+    ob.start(d, n_replica=3)
+    try:
+        report = run_kill_test(d, duration_s=45, kill_every_s=18,
+                               seed=33, mode="kill",
+                               op_timeout_ms=30_000,
+                               monotonic_ledger=True)
+        assert report["violations"] == [], report
+        assert report["kills"] >= 1
+        assert report["ledger_reads"] > 0
+        assert report["writes_acked"] > 10
+    finally:
+        ob.stop(d)
