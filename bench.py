@@ -40,7 +40,11 @@ coordinator flush in the point_get_batch phase),
 PEGBENCH_WRITE_BATCH (default 32: puts coalesced per write_multi flush
 in the write_put_batch phase),
 PEGBENCH_PROBE_TIMEOUT (s, default 120), PEGBENCH_PROBE_RETRIES (default 4),
-PEGBENCH_FORCE_CPU=1 (CPU-only dry run: never dials the TPU tunnel).
+PEGBENCH_FORCE_CPU=1 (CPU-only dry run: never dials the TPU tunnel),
+PEGBENCH_MESH=0 (skip the mesh_scan phase) / PEGBENCH_MESH_RECORDS
+(default 240_000) / PEGBENCH_MESH_PARTITIONS (default 8) — the
+mesh_scan phase always runs on a CPU-device mesh in a subprocess
+(--mesh-phase), so it needs no accelerator.
 """
 
 import json
@@ -2248,6 +2252,250 @@ def measure_scan_pushdown(jax, device, tmpdir, n_records: int,
         shutil.rmtree(bdir, ignore_errors=True)
 
 
+def measure_mesh_scan(here: str) -> dict:
+    """mesh_scan phase (runs in a SUBPROCESS): the resident device-mesh
+    SPMD serving arm vs the host kernel wave, same run, byte-identity
+    gated. A subprocess because the CPU-device mesh needs
+    --xla_force_host_platform_device_count set BEFORE jax initializes,
+    and the parent already brought its backend up."""
+    env = dict(os.environ)
+    env["PEGBENCH_FORCE_CPU"] = "1"
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (flags +
+                            " --xla_force_host_platform_device_count=8"
+                            ).strip()
+    r = subprocess.run(
+        [sys.executable, os.path.join(here, "bench.py"), "--mesh-phase"],
+        capture_output=True, text=True, env=env, cwd=here, timeout=1800)
+    for line in (r.stderr or "").splitlines():
+        _log(f"  [mesh] {line}")
+    if r.returncode != 0:
+        raise RuntimeError(f"mesh phase subprocess rc={r.returncode}: "
+                           f"{(r.stderr or '')[-300:]}")
+    return json.loads((r.stdout or "").strip().splitlines()[-1])
+
+
+def _mesh_phase_main() -> None:
+    """--mesh-phase subprocess body: one JSON dict on stdout.
+
+    Measures the node-level cross-partition wave (scan_multi's shape:
+    every partition's uncached blocks in ONE stacked_block_eval call)
+    with the mesh DETACHED (host chunk programs) vs ATTACHED (one
+    resident SPMD dispatch answers all partitions), under the REAL
+    placement gate — no pinning. Then the whole-range aggregate fold at
+    the same selectivity, then the watchdog leg: every dispatch forced
+    to overrun its deadline must trip the tunnel and degrade to host
+    kernels with identical rows and zero hung scans."""
+    import numpy as np
+
+    from pegasus_tpu.client.client import PegasusClient
+    from pegasus_tpu.client.table import Table
+    from pegasus_tpu.ops.predicates import FT_NO_FILTER, FT_MATCH_ANYWHERE
+    from pegasus_tpu.ops.pushdown import PushdownSpec
+    from pegasus_tpu.parallel.mesh_resident import MESH_SERVING
+    from pegasus_tpu.server.scan_coordinator import stacked_block_eval
+    from pegasus_tpu.server.types import (
+        GetScannerRequest,
+        SCAN_CONTEXT_ID_COMPLETED,
+    )
+    from pegasus_tpu.utils.flags import FLAGS
+    import jax
+
+    n_records = int(os.environ.get("PEGBENCH_MESH_RECORDS", 240_000))
+    n_partitions = int(os.environ.get("PEGBENCH_MESH_PARTITIONS", 8))
+    seed = int(os.environ.get("PEGBENCH_SEED", 7))
+    fkey = (FT_NO_FILTER, b"", FT_NO_FILTER, b"")
+    rng = np.random.default_rng(seed)
+
+    tmpdir = tempfile.mkdtemp(prefix="pegbench_mesh")
+    # codec none: compressed blocks answer their static probes in the
+    # encoded domain host-side and never reach the wave path
+    FLAGS.set("pegasus.storage", "block_codec", "none")
+    FLAGS.set("pegasus.server", "rocksdb_max_iteration_count", 0)
+    table = Table(tmpdir, partition_count=n_partitions)
+    client = PegasusClient(table)
+    t0 = time.perf_counter()
+    for i in range(n_records):
+        tok = b" m10" if rng.random() < 0.1 else b""  # selectivity 0.1
+        assert client.set(b"user%06d" % (i // 10), b"s%02d" % (i % 10),
+                          b"f=%024d%s" % (i, tok)) == 0
+    _log(f"loaded {n_records} records in {time.perf_counter() - t0:.1f}s")
+    for s in table.partitions.values():
+        s.engine.flush()
+        s.engine.manual_compact()  # wave serving is over pure sorted runs
+
+    blocks = []
+    for p, s in sorted(table.partitions.items()):
+        for run in s.engine.lsm.sorted_runs():
+            for bm, blk in run.iter_blocks(b"", None):
+                ckey = (run.path, bm.offset)
+                blocks.append(((p, ckey), s._device_cached_block(ckey, blk),
+                               s.pidx, int(blk.count)))
+    pv = table.partitions[0].partition_version
+
+    def wave_once():
+        masks = {}
+        t0 = time.perf_counter()
+        for tag, keep in stacked_block_eval(
+                [(t, d, p) for t, d, p, _n in blocks], True, pv,
+                filter_key=fkey):
+            masks[tag] = np.asarray(keep)
+        return time.perf_counter() - t0, masks
+
+    def drain_all():
+        rows = {}
+        for p, s in sorted(table.partitions.items()):
+            pd = PushdownSpec(value_filter_type=FT_MATCH_ANYWHERE,
+                              value_filter_pattern=b"m10")
+            resp = s.on_get_scanner(GetScannerRequest(batch_size=1000,
+                                                      pushdown=pd))
+            got = []
+            while True:
+                assert resp.error == 0
+                got.extend((kv.key, kv.value) for kv in resp.kvs)
+                if resp.context_id == SCAN_CONTEXT_ID_COMPLETED:
+                    break
+                resp = s.on_scan(resp.context_id)
+            rows[p] = got
+        return rows
+
+    def drain_all_multi():
+        """Node-level coordinated drain: every partition's first wave of
+        planned misses evaluates in ONE cross-partition scan_multi call
+        — the shape whose program count and byte volume clear the real
+        mesh placement gate (solo drains wave in LOOKAHEAD windows and
+        stay on host kernels honestly)."""
+        def fresh_req():
+            return GetScannerRequest(
+                batch_size=1000,
+                pushdown=PushdownSpec(value_filter_type=FT_MATCH_ANYWHERE,
+                                      value_filter_pattern=b"m10"))
+        first = client.scan_multi({p: [fresh_req()]
+                                   for p in sorted(table.partitions)})
+        rows = {}
+        for p in sorted(table.partitions):
+            s = table.partitions[p]
+            resp = first[p][0]
+            got = []
+            while True:
+                assert resp.error == 0
+                got.extend((kv.key, kv.value) for kv in resp.kvs)
+                if resp.context_id == SCAN_CONTEXT_ID_COMPLETED:
+                    break
+                resp = s.on_scan(resp.context_id)
+            rows[p] = got
+        return rows
+
+    def agg_all():
+        out = {}
+        t0 = time.perf_counter()
+        for p, s in sorted(table.partitions.items()):
+            pd = PushdownSpec(value_filter_type=FT_MATCH_ANYWHERE,
+                              value_filter_pattern=b"m10",
+                              aggregate="count")
+            resp = s.on_get_scanner(GetScannerRequest(batch_size=1000,
+                                                      pushdown=pd))
+            while resp.context_id != SCAN_CONTEXT_ID_COMPLETED:
+                assert resp.error == 0
+                resp = s.on_scan(resp.context_id)
+            out[p] = resp.agg
+        return time.perf_counter() - t0, out
+
+    def clear_masks():
+        for s in table.partitions.values():
+            with s._mask_lock:
+                s._mask_cache.clear()
+
+    # host arm: mesh detached — the chunked host kernel wave
+    MESH_SERVING.reset()
+    wave_once()  # warm compiles + block device cache
+    host_wave = min(wave_once()[0] for _ in range(3))
+    host_masks = wave_once()[1]
+    host_rows = drain_all()
+    agg_all()
+    host_agg_s = min(agg_all()[0] for _ in range(3))
+    host_agg = agg_all()[1]
+
+    # mesh arm: attach every partition; the REAL placement gate routes
+    for s in table.partitions.values():
+        MESH_SERVING.attach(s)
+    w0 = MESH_SERVING.wave_dispatches
+    wave_once()  # warm: builds the resident image + mesh program
+    mesh_served = MESH_SERVING.wave_dispatches > w0
+    mesh_wave = min(wave_once()[0] for _ in range(3))
+    mesh_masks = wave_once()[1]
+    clear_masks()
+    w1 = MESH_SERVING.wave_dispatches
+    mesh_rows = drain_all_multi()
+    mesh_drain_served = MESH_SERVING.wave_dispatches > w1
+    a0 = MESH_SERVING.agg_dispatches
+    agg_all()
+    mesh_agg_served = MESH_SERVING.agg_dispatches > a0
+    mesh_agg_s = min(agg_all()[0] for _ in range(3))
+    mesh_agg = agg_all()[1]
+
+    wave_identity = all(
+        np.array_equal(host_masks[t][:n], mesh_masks[t][:n])
+        for t, _d, _p, n in blocks)
+    rows_identity = host_rows == mesh_rows
+    agg_identity = host_agg == mesh_agg
+
+    # watchdog leg: wedge every dispatch; coordinated serving must
+    # degrade to the host kernels (identical rows, bounded wall, zero
+    # hung scans). Two overrunning dispatches trip the tunnel, the
+    # third drain serves wedged (pure host).
+    MESH_SERVING.watchdog.deadline_s = 1e-9
+    t0 = time.perf_counter()
+    clear_masks()
+    drain_all_multi()  # dispatch 1 overruns -> host fallback
+    clear_masks()
+    drain_all_multi()  # dispatch 2 overruns -> consecutive-failure trip
+    clear_masks()
+    wedged_rows = drain_all_multi()  # tunnel wedged: host serving
+    wedged_wall = time.perf_counter() - t0
+    wd = {
+        "fallback_identity_ok": wedged_rows == host_rows,
+        "wall_s": round(wedged_wall, 3),
+        "trips": MESH_SERVING.watchdog.trips,
+        "wedged": bool(MESH_SERVING.status()["tunnel_wedged"]),
+        "fallbacks": MESH_SERVING.status()["mesh_fallback_count"],
+    }
+    MESH_SERVING.reset()
+    table.close()
+    import shutil
+
+    shutil.rmtree(tmpdir, ignore_errors=True)
+
+    speedup = host_wave / max(mesh_wave, 1e-9)
+    agg_speedup = host_agg_s / max(mesh_agg_s, 1e-9)
+    identity_ok = wave_identity and rows_identity and agg_identity
+    out = {
+        "records": n_records, "partitions": n_partitions,
+        "devices": len(jax.devices()), "blocks": len(blocks),
+        "selectivity": 0.1,
+        "host_wave_ms": round(host_wave * 1e3, 2),
+        "mesh_wave_ms": round(mesh_wave * 1e3, 2),
+        "mesh_speedup": round(speedup, 3) if identity_ok else 0.0,
+        "agg_host_ms": round(host_agg_s * 1e3, 2),
+        "agg_mesh_ms": round(mesh_agg_s * 1e3, 2),
+        "agg_speedup": round(agg_speedup, 3),
+        "mesh_served": mesh_served,
+        "mesh_drain_served": mesh_drain_served,
+        "mesh_agg_served": mesh_agg_served,
+        "wave_identity_ok": wave_identity,
+        "rows_identity_ok": rows_identity,
+        "agg_identity_ok": agg_identity,
+        "watchdog": wd,
+        "gate_ok": bool(identity_ok and mesh_served and mesh_drain_served
+                        and speedup >= 1.5 and len(jax.devices()) >= 4
+                        and wd["trips"] >= 1
+                        and wd["fallback_identity_ok"] and wd["wedged"]),
+    }
+    print(json.dumps(out), flush=True)
+
+
 def measure_geo(jax, device, n_points=20_000, n_searches=150, seed=11):
     """Geo radius-search ops/sec (BASELINE config #5): cell-cover prefix
     scans + one batched device distance predicate per search."""
@@ -2313,6 +2561,7 @@ def main() -> None:
     do_health = os.environ.get("PEGBENCH_HEALTH", "1") != "0"
     do_perfctx = os.environ.get("PEGBENCH_PERFCTX", "1") != "0"
     do_follower = os.environ.get("PEGBENCH_FOLLOWER_READ", "1") != "0"
+    do_mesh = os.environ.get("PEGBENCH_MESH", "1") != "0"
 
     details = {"phases": {}}
     here = os.path.dirname(os.path.abspath(__file__))
@@ -2914,6 +3163,25 @@ def main() -> None:
                          f"identical={dc['identity_ok']}, "
                          f"gate={dc['gate_ok']})")
 
+                if do_mesh:
+                    ms = measure_mesh_scan(here)
+                    details["phases"]["mesh_scan"] = ms
+                    save_details()
+                    with open(os.path.join(here, "BENCH_r18.json"),
+                              "w") as f:
+                        json.dump({"phases": {"mesh_scan": ms},
+                                   "accel_platform": "cpu-mesh"},
+                                  f, indent=1)
+                    _log(f"mesh_scan: wave {ms['host_wave_ms']}ms host "
+                         f"-> {ms['mesh_wave_ms']}ms mesh "
+                         f"({ms['mesh_speedup']}x, agg "
+                         f"{ms['agg_speedup']}x) over "
+                         f"{ms['partitions']} partitions / "
+                         f"{ms['devices']} devices, identical="
+                         f"{ms['rows_identity_ok']}, watchdog fallback "
+                         f"identical={ms['watchdog']['fallback_identity_ok']}"
+                         f", gate>=1.5x: {ms['gate_ok']}")
+
                 if do_geo:
                     g_accel, g_hits = measure_geo(jax, accel)
                     g_cpu, _ = measure_geo(jax, cpu)
@@ -2953,4 +3221,7 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    main()
+    if "--mesh-phase" in sys.argv[1:]:
+        _mesh_phase_main()
+    else:
+        main()

@@ -121,11 +121,34 @@ HOST_DISPATCH_S_EST = 0.002  # fixed per-program dispatch cost on the
 #                              the same footing for small batches
 
 
+# mesh topology constants (the third placement class): a resident-mesh
+# round needs no H2D movement at all — the blocks already live sharded
+# on the mesh — so its cost is the dispatch floor, the cross-device
+# collectives (packbits gather + psum counts travel ICI-neighbor hops,
+# not the tunnel), and the sharded predicate stream
+ICI_NEIGHBOR_S_EST = 0.0002   # per-hop collective cost on the mesh
+MESH_ICI_HOPS_EST = 8         # nominal ring hops per whole-table round
+MESH_EVAL_GBPS_EST = 8.0      # aggregate predicate stream across shards
+
+
+def mesh_round_fixed_s() -> float:
+    """Fixed cost of one whole-table mesh dispatch. Colocated devices
+    (CPU fallback mesh, sub-ms link) pay the same jit-call floor a host
+    program pays; a tunneled mesh pays the full tunnel round."""
+    rtt, _dev = _probe_rtt()
+    if rtt is not None and rtt > LINK_RTT_COLOCATED_S:
+        return ROUND_FIXED_S_EST
+    return HOST_DISPATCH_S_EST
+
+
 def placement_verdict(workload: str = "rules") -> str:
     """The compute class the policy routes `workload` to, as the
-    PerfContext `placement` string: "device" (ambient accelerator) or
+    PerfContext `placement` string: "device" (ambient accelerator),
     "host-XLA" (host backend — either because the ambient default IS
-    the host or because the policy re-routed there)."""
+    the host or because the policy re-routed there), or "mesh" (the
+    resident whole-table SPMD program)."""
+    if workload == "mesh":
+        return "mesh"
     rtt, _dev = _probe_rtt()
     if rtt is None or choose_eval_device(workload) is not None:
         return "host-XLA"
@@ -140,10 +163,25 @@ def predict_kernel_seconds(workload: str, batch_bytes: int) -> float:
     cost (a prediction of 3µs for a 6KB batch would make every
     measurement look like 1000x drift; the model's claim includes the
     per-call floor)."""
+    if workload == "mesh":
+        return (mesh_round_fixed_s()
+                + ICI_NEIGHBOR_S_EST * MESH_ICI_HOPS_EST
+                + batch_bytes / (MESH_EVAL_GBPS_EST * 1e9))
     if placement_verdict(workload) == "device":
         return ROUND_FIXED_S_EST + batch_bytes / (H2D_GBPS_EST * 1e9)
     return (HOST_DISPATCH_S_EST
             + batch_bytes / (HOST_FILTER_GBPS_EST * 1e9))
+
+
+def mesh_wave_pays(n_programs: int, batch_bytes: int) -> bool:
+    """Does ONE resident-mesh round beat the host path's `n_programs`
+    per-chunk dispatches over the same bytes? The mesh routing gate:
+    single-chunk waves stay on the host (same dispatch floor, nothing to
+    amortize); multi-chunk / multi-partition waves collapse to one
+    round and win."""
+    host_s = (HOST_DISPATCH_S_EST * max(1, int(n_programs))
+              + batch_bytes / (HOST_FILTER_GBPS_EST * 1e9))
+    return predict_kernel_seconds("mesh", batch_bytes) < host_s
 
 
 def offload_breakdown(workload: str, batch_bytes: int) -> dict:

@@ -5,3 +5,7 @@ from pegasus_tpu.parallel.partition_mesh import (
     make_mesh,
     sharded_scan_step,
 )
+
+# mesh_resident (the resident SPMD serving layer) is imported lazily by
+# its call sites — importing this package must stay cheap for tools that
+# only want the mesh shapes.

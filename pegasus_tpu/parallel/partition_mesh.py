@@ -23,6 +23,7 @@ with a single SPMD program.
 from __future__ import annotations
 
 import functools
+import warnings
 from typing import NamedTuple, Optional, Sequence
 
 import jax
@@ -40,13 +41,26 @@ class PartitionMesh(NamedTuple):
     sp: int  # record-batch-parallel axis size
 
 
-def make_mesh(n_devices: Optional[int] = None, dp: Optional[int] = None
-              ) -> PartitionMesh:
-    """2D mesh (dp, sp) over the available devices; dp defaults to all."""
-    devices = jax.devices()[:n_devices] if n_devices else jax.devices()
+def make_mesh(n_devices: Optional[int] = None, dp: Optional[int] = None,
+              devices: Optional[Sequence] = None) -> PartitionMesh:
+    """2D mesh (dp, sp) over the available devices; dp defaults to all.
+
+    Pass `devices` to build over an explicit device set (e.g. the
+    host-platform CPU devices the tunnel watchdog falls back to). On a
+    single-device host any requested dp degrades to a (1, 1) mesh with
+    a warning instead of raising — solo-dev boxes must never crash the
+    import path just because dp defaulted to a multi-device shape.
+    """
+    if devices is None:
+        devices = jax.devices()[:n_devices] if n_devices else jax.devices()
+    devices = list(devices)
     n = len(devices)
     if dp is None:
         dp = n
+    if n == 1 and dp != 1:
+        warnings.warn(f"single-device host: degrading mesh dp={dp} to a "
+                      f"(1, 1) mesh", RuntimeWarning, stacklevel=2)
+        dp = 1
     if n % dp:
         raise ValueError(f"{n} devices not divisible by dp={dp}")
     sp = n // dp

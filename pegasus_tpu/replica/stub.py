@@ -533,15 +533,19 @@ class ReplicaStub:
             """placement [workload [batch_bytes]] — the quantified
             pays/doesn't-pay offload verdict (ops/placement.py
             offload_breakdown) plus the live cost-model drift audit,
-            operator-visible instead of PERF.md-only."""
+            operator-visible instead of PERF.md-only. The `mesh` block
+            is the resident SPMD serving layer: verdict share, tunnel
+            health, watchdog state."""
             from pegasus_tpu.ops.placement import offload_breakdown
+            from pegasus_tpu.parallel.mesh_resident import MESH_SERVING
             from pegasus_tpu.server.workload import DRIFT
 
             workload = args[0] if args else "rules"
             batch_bytes = int(args[1]) if len(args) > 1 else 1 << 20
             return {"breakdown": offload_breakdown(workload,
                                                    batch_bytes),
-                    "drift": DRIFT.status()}
+                    "drift": DRIFT.status(),
+                    "mesh": MESH_SERVING.status()}
 
         self.commands.register(
             "placement", placement,

@@ -32,7 +32,7 @@ STAGE_FIELDS: Dict[str, tuple] = {
     "block_probe": ("blocks_decoded", "block_cache_hit", "bytes_read",
                     "blocks_planned"),
     "block_scan": ("blocks_decoded", "block_cache_hit", "bytes_read",
-                   "rows_evaluated"),
+                   "rows_evaluated", "mesh_partitions"),
     "pushdown": ("pushdown_rows_pruned", "rows_aggregated"),
     "decode": ("bytes_decoded",),
     "assemble": ("rows_survived", "bytes_returned"),
@@ -275,6 +275,11 @@ def render_report(report: Dict[str, Any]) -> str:
         lines.append(
             f"  kernel: predicted={pc.get('predicted_kernel_ms', 0.0)} ms "
             f"measured={pc.get('measured_kernel_ms', 0.0)} ms")
+    if pc.get("mesh_partitions") or pc.get("mesh_wave_ms"):
+        lines.append(
+            f"  mesh: partitions={pc.get('mesh_partitions', 0)} "
+            f"wave={pc.get('mesh_wave_ms', 0.0)} ms (resident SPMD "
+            "dispatch answered this scan's waves)")
     if pc.get("queue_wait_ms"):
         lines.append(f"  queue_wait: {pc['queue_wait_ms']} ms")
     res = report.get("result")

@@ -334,6 +334,12 @@ class PartitionServer:
         self._read_throttle = None
         self._default_ttl = 0
         self._compaction_rules = None   # compiled rules_filter
+        # external publish subscribers (e.g. the resident mesh layer):
+        # fanned out from _on_store_publish AFTER the server's own cache
+        # eviction, and rewired for free across engine swaps because the
+        # single lsm.on_publish slot always points at this server's
+        # bound method
+        self.publish_listeners: list = []
         self.install_engine(self.engine)
 
     def install_engine(self, engine: StorageEngine) -> None:
@@ -394,6 +400,11 @@ class PartitionServer:
         self._point_cache = None
         self._plan_expired_cache = (None, {})
         ROW_CACHE.invalidate_gid((self.app_id, self.pidx))
+        for fn in list(self.publish_listeners):
+            try:
+                fn(live_paths)
+            except Exception:  # noqa: BLE001 - a subscriber must never
+                pass           # break the publish path
 
     # env key -> (derived attr, reset-to-default parsed value); used when
     # a FULL env set arrives and a previously-set key is now absent
@@ -2294,6 +2305,52 @@ class PartitionServer:
             with self._mask_lock:
                 self._register_flavor(validate, filter_key,
                                       time.monotonic())
+
+            # resident mesh arm: a fresh whole-range aggregate on an
+            # attached table folds off the table-wide SPMD dispatch —
+            # count/sum directly from the psum-shaped per-partition
+            # counts/lanes, top_k/sample from the all-gathered mask via
+            # the same AggState fold. Any decline (paging limiter, L0
+            # overlay, stale slab, watchdog, cost model) falls through
+            # to the host arm unchanged.
+            if agg_state is None and not start_key and stop is None:
+                from pegasus_tpu.parallel.mesh_resident import MESH_SERVING
+
+                mesh = (MESH_SERVING.try_aggregate(
+                            self, req, pd, validate, filter_key, now)
+                        if MESH_SERVING.enabled else None)
+                if mesh is not None:
+                    state = mesh["agg_state"]
+                    if mesh["expired"]:
+                        self._abnormal_reads.increment(mesh["expired"])
+                    if tracer is not None:
+                        tracer.add_point("block_scan")
+                        tracer.add_point("pushdown")
+                    folded = mesh["folded"]
+                    pruned = mesh["pruned"]
+                    pc = tracer.perf if tracer is not None else None
+                    if pc is not None:
+                        pc.ops += 1
+                        pc.rows_evaluated += mesh["rows_evaluated"]
+                        pc.rows_survived += folded
+                        pc.keys_resolved += folded
+                        pc.rows_aggregated += folded
+                        pc.pushdown_rows_pruned += pruned
+                        pc.placement = "mesh"
+                        pc.mesh_partitions += mesh["partitions"]
+                        pc.mesh_wave_ms += mesh["wave_ms"]
+                        pc.predicted_kernel_ms += mesh["predicted_ms"]
+                        pc.measured_kernel_ms += mesh["measured_ms"]
+                    self.workload.note_scan(1, mesh["rows_evaluated"],
+                                            folded)
+                    self.workload.note_pushdown(1, pruned, folded)
+                    resp.pushdown_applied = True
+                    resp.error = int(StorageStatus.OK)
+                    resp.context_id = SCAN_CONTEXT_ID_COMPLETED
+                    resp.agg = state.to_wire()
+                    if tracer is not None:
+                        tracer.add_point("assemble")
+                    return resp
 
             def ranged_blocks():
                 for run in sorted_runs:

@@ -185,6 +185,21 @@ def stacked_block_eval(blocks, validate: bool, pv: int,
     blocks = list(blocks)
     if not blocks:
         return
+    # resident mesh first: when the whole wave's blocks live in a
+    # table's stacked device image and the cost model says one mesh
+    # round beats the per-chunk host programs, ONE dispatch answers
+    # everything (mesh_resident does its own drift audit under the
+    # "mesh" class). Any decline — unattached, unresolved block, model
+    # says host, watchdog trip — falls through unchanged.
+    from pegasus_tpu.parallel.mesh_resident import MESH_SERVING
+
+    if MESH_SERVING.enabled:
+        served = MESH_SERVING.try_wave(blocks, validate, pv,
+                                       filter_key=filter_key,
+                                       perf_ctxs=perf_ctxs)
+        if served is not None:
+            yield from served
+            return
     t0 = _time.perf_counter()
     submitted = list(stacked_block_submit(blocks, validate, pv,
                                           filter_key))

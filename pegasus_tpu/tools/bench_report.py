@@ -31,8 +31,8 @@ _ROUND_RE = re.compile(r"^BENCH_r(\d+)\.json$")
 _HEADLINE_PREFS = (
     "aggregate_read_qps", "phash_qps", "filtered_qps", "row_cache_qps",
     "accel_qps", "read_qps", "write_qps", "qps", "records_per_s",
-    "accel_records_per_s", "effective_gbps", "pushdown_speedup",
-    "speedup", "ratio",
+    "accel_records_per_s", "effective_gbps", "mesh_speedup",
+    "pushdown_speedup", "speedup", "ratio",
 )
 
 

@@ -1830,12 +1830,14 @@ def _dispatch(args, box, out) -> int:
                     indent=1), file=out)
         else:
             from pegasus_tpu.ops.placement import offload_breakdown
+            from pegasus_tpu.parallel.mesh_resident import MESH_SERVING
             from pegasus_tpu.server.workload import DRIFT
 
             print(json.dumps(
                 {"breakdown": offload_breakdown(args.workload,
                                                 args.bytes),
-                 "drift": DRIFT.status()}, indent=1), file=out)
+                 "drift": DRIFT.status(),
+                 "mesh": MESH_SERVING.status()}, indent=1), file=out)
     elif args.cmd == "nodes":
         for n in box.admin.call("list_nodes"):
             print(n, file=out)

@@ -170,6 +170,15 @@ def default_rules() -> List[HealthRule]:
                    "routing is deciding on bad estimates "
                    "(server/workload.DRIFT audits every stacked "
                    "mask-eval wave)"),
+        HealthRule("tunnel_wedged", "storage", "tunnel_wedged",
+                   kind="threshold", threshold=0.5, hold=2,
+                   severity=SEV_DEGRADED,
+                   description="the mesh dispatch watchdog tripped "
+                   "(consecutive bounded-deadline overruns): serving "
+                   "fell back to the CPU-device mesh or to host "
+                   "kernels — results stay correct but the accelerator "
+                   "leg is out (hold=2: one spurious deadline alone "
+                   "must not fire it)"),
     ]
 
 

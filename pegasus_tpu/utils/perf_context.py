@@ -87,6 +87,9 @@ _COUNTER_FIELDS = (
     # server-side partial aggregate instead of being returned
     perf_field("pushdown_rows_pruned", "counter"),
     perf_field("rows_aggregated", "counter"),
+    # resident mesh serving (parallel/mesh_resident.py): partitions whose
+    # blocks this op's waves answered from the stacked SPMD program
+    perf_field("mesh_partitions", "counter"),
 )
 # gauges: per-op measurements
 _GAUGE_FIELDS = (
@@ -96,6 +99,7 @@ _GAUGE_FIELDS = (
     perf_field("queue_wait_ms", "gauge"),
     perf_field("predicted_kernel_ms", "gauge"),  # placement cost model
     perf_field("measured_kernel_ms", "gauge"),
+    perf_field("mesh_wave_ms", "gauge"),  # resident-mesh dispatch wall
 )
 
 FIELDS: Tuple[str, ...] = _COUNTER_FIELDS + _GAUGE_FIELDS
@@ -108,8 +112,9 @@ class PerfContext:
 
     def __init__(self, op: str = "") -> None:
         self.op = op
-        # device | host-XLA | native | numpy — which compute class the
-        # placement policy routed this op's kernels to ("" = no kernel)
+        # device | host-XLA | native | numpy | mesh — which compute class
+        # the placement policy routed this op's kernels to ("" = no
+        # kernel; "mesh" = the resident whole-table SPMD program)
         self.placement = ""
         # primary | secondary — which replica role answered this read
         # ("" = not a consistency-routed read, e.g. a write flush)

@@ -1,7 +1,42 @@
 """Measure tunnel RTT + concurrency scaling: N threads doing tiny
 device_put+device_get rounds. If aggregate round rate scales with
-threads, the link is latency-bound and pipelinable."""
-import time, threading
+threads, the link is latency-bound and pipelinable.
+
+`--watchdog-selftest` is a fast no-accelerator mode: it exercises the
+mesh-serving TunnelWatchdog (parallel/mesh_resident.py) against the CPU
+backend — two deliberate deadline overruns must trip it, a healthy
+dispatch must recover it — and exits 0 on PASS. CI can run this in
+seconds to prove a wedged tunnel degrades instead of hanging.
+"""
+import os
+import sys
+import time
+import threading
+
+if "--watchdog-selftest" in sys.argv[1:]:
+    # keep the selftest off any real accelerator: force the CPU
+    # platform BEFORE jax initializes so a wedged tunnel can't wedge us
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    from pegasus_tpu.parallel.mesh_resident import (
+        TunnelWatchdog, _TUNNEL_WEDGED)
+
+    wd = TunnelWatchdog(deadline_s=0.05, trip_after=2)
+    # two consecutive overruns: the second must trip
+    for i in (1, 2):
+        out = wd.run(lambda: time.sleep(0.5) or "late")
+        assert out is None, f"overrun {i} returned {out!r}, wanted None"
+    assert wd.trips == 1, f"trips={wd.trips}, wanted 1 after 2 overruns"
+    assert wd.failures == 0, "trip must reset the consecutive streak"
+    assert _TUNNEL_WEDGED.value() == 1.0, "wedged gauge not raised"
+    # a healthy dispatch after recover() must pass through its result
+    wd.recover()
+    assert _TUNNEL_WEDGED.value() == 0.0, "recover left gauge raised"
+    assert wd.run(lambda: 42) == 42, "post-recovery dispatch lost"
+    assert wd.dispatches == 1 and wd.failures == 0
+    print("watchdog selftest: PASS (tripped after 2 overruns, "
+          "recovered, healthy dispatch returned)")
+    sys.exit(0)
+
 import numpy as np
 import jax, jax.numpy as jnp
 
