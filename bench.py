@@ -45,6 +45,10 @@ PEGBENCH_MESH=0 (skip the mesh_scan phase) / PEGBENCH_MESH_RECORDS
 (default 240_000) / PEGBENCH_MESH_PARTITIONS (default 8) — the
 mesh_scan phase always runs on a CPU-device mesh in a subprocess
 (--mesh-phase), so it needs no accelerator.
+PEGBENCH_MESH_COMPACT=0 (skip the mesh_compact phase) /
+PEGBENCH_MESH_COMPACT_RECORDS (default 192_000) — the compaction
+FILTER-stage twin of mesh_scan, same CPU-device-mesh subprocess shape
+(--mesh-compact-phase).
 """
 
 import json
@@ -2496,6 +2500,225 @@ def _mesh_phase_main() -> None:
     print(json.dumps(out), flush=True)
 
 
+def measure_mesh_compact(here: str) -> dict:
+    """mesh_compact phase (runs in a SUBPROCESS): the compaction FILTER
+    stage off the resident device-mesh image vs the host kernels, same
+    run, identity-digest-gated. A subprocess for the same reason as
+    mesh_scan: the CPU-device mesh needs
+    --xla_force_host_platform_device_count BEFORE jax initializes."""
+    env = dict(os.environ)
+    env["PEGBENCH_FORCE_CPU"] = "1"
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (flags +
+                            " --xla_force_host_platform_device_count=8"
+                            ).strip()
+    r = subprocess.run(
+        [sys.executable, os.path.join(here, "bench.py"),
+         "--mesh-compact-phase"],
+        capture_output=True, text=True, env=env, cwd=here, timeout=1800)
+    for line in (r.stderr or "").splitlines():
+        _log(f"  [mesh_compact] {line}")
+    if r.returncode != 0:
+        raise RuntimeError(f"mesh_compact subprocess rc={r.returncode}: "
+                           f"{(r.stderr or '')[-300:]}")
+    return json.loads((r.stdout or "").strip().splitlines()[-1])
+
+
+def _mesh_compact_phase_main() -> None:
+    """--mesh-compact-phase subprocess body: one JSON dict on stdout.
+
+    Measures the bulk-compaction FILTER stage over >=8 partitions with
+    the mesh DETACHED (per-partition submit/drain host programs) vs
+    ATTACHED (ONE whole-table SPMD dispatch + sibling cache serves),
+    under the REAL mesh_compact_pays gate — no pinning. Then three
+    full-compaction arms over copies of the same store — host-pipelined,
+    mesh-filtered, and wedged-watchdog — must publish byte-identical
+    SST files, and the mesh arm's publishes must refresh residency by
+    survivor-gather (reuse counter, zero slab builds)."""
+    import hashlib
+    import shutil
+
+    import numpy as np
+
+    import pegasus_tpu.storage.engine as engine_mod
+    from pegasus_tpu.base.value_schema import epoch_now
+    from pegasus_tpu.client.client import PegasusClient
+    from pegasus_tpu.client.table import Table
+    from pegasus_tpu.ops.compaction import (
+        compaction_eval_drain,
+        compaction_eval_submit,
+    )
+    from pegasus_tpu.parallel.mesh_resident import MESH_SERVING
+    from pegasus_tpu.storage.compact_pipeline import window_count
+    from pegasus_tpu.utils.flags import FLAGS
+    import jax
+
+    n_records = int(os.environ.get("PEGBENCH_MESH_COMPACT_RECORDS",
+                                   192_000))
+    n_partitions = int(os.environ.get("PEGBENCH_MESH_PARTITIONS", 8))
+    seed = int(os.environ.get("PEGBENCH_SEED", 7))
+    rng = np.random.default_rng(seed)
+
+    tmpdir = tempfile.mkdtemp(prefix="pegbench_meshcompact")
+    base = os.path.join(tmpdir, "base")
+    FLAGS.set("pegasus.storage", "block_codec", "none")
+    table = Table(base, partition_count=n_partitions)
+    client = PegasusClient(table)
+    t0 = time.perf_counter()
+    for i in range(n_records):
+        # ~30% of rows carry TTLs that will be expired at the arms'
+        # shared filter timestamp (BASELINE config #3's retention sweep)
+        ttl = 60 if rng.random() < 0.3 else 0
+        assert client.set(b"user%06d" % (i // 10), b"s%02d" % (i % 10),
+                          b"f=%024d" % i, ttl_seconds=ttl) == 0
+    _log(f"loaded {n_records} records in {time.perf_counter() - t0:.1f}s")
+    for s in table.partitions.values():
+        s.engine.flush()
+        s.engine.manual_compact()  # bulk filtering is over pure L1
+    fixed_now = epoch_now() + 3600
+    # the finish-time stamp lands in the SST index; freeze it so arms
+    # can't straddle a second boundary and diverge on non-filter bytes
+    engine_mod.epoch_now = lambda: fixed_now
+    entries_per = {p: s.engine.lsm.bulk_compact_entries()
+                   for p, s in sorted(table.partitions.items())}
+    n_blocks = sum(len(e) for e in entries_per.values())
+    host_windows = sum(window_count(len(e))
+                       for e in entries_per.values())
+
+    def host_filter_once():
+        t0 = time.perf_counter()
+        masks = {}
+        for p, s in sorted(table.partitions.items()):
+            blocks = [((run, i), run.read_block(i), p)
+                      for run, i, _bm in entries_per[p]]
+            pend = compaction_eval_submit(
+                blocks, fixed_now, 0, s.partition_version, False,
+                operations=None, eval_device=None, want_ets=False)
+            for tag, drop, _e in compaction_eval_drain(
+                    pend, want_ets=False):
+                masks[(p,) + tag] = np.asarray(drop, bool)
+        return time.perf_counter() - t0, masks
+
+    def mesh_filter_once():
+        MESH_SERVING._compact_cache.clear()
+        t0 = time.perf_counter()
+        masks = {}
+        for p, s in sorted(table.partitions.items()):
+            got = MESH_SERVING.try_compact_masks(
+                s.engine.lsm, entries_per[p], fixed_now, 0, p,
+                s.partition_version, False, None, want_ets=False,
+                n_windows=window_count(len(entries_per[p])))
+            if got is None:
+                return time.perf_counter() - t0, None
+            for (run, i), (drop, _e) in got.items():
+                masks[(p, run, i)] = np.asarray(drop, bool)
+        return time.perf_counter() - t0, masks
+
+    # host arm first: mesh detached, per-partition window programs
+    MESH_SERVING.reset()
+    host_filter_once()  # warm compiles + OS page cache
+    host_filter_s = min(host_filter_once()[0] for _ in range(3))
+    host_masks = host_filter_once()[1]
+
+    # mesh arm: attach every partition; the REAL gate routes
+    for s in table.partitions.values():
+        MESH_SERVING.attach(s)
+    mesh_filter_once()  # warm: resident image + program compile
+    mesh_filter_s = min(mesh_filter_once()[0] for _ in range(3))
+    _t, mesh_masks = mesh_filter_once()
+    mesh_served = mesh_masks is not None
+    mask_identity = bool(
+        mesh_served and host_masks.keys() == mesh_masks.keys()
+        and all(np.array_equal(host_masks[k], mesh_masks[k])
+                for k in host_masks))
+    dispatches = MESH_SERVING.compact_dispatches
+    serves = MESH_SERVING.compact_mask_serves
+    MESH_SERVING.reset()
+    table.close()
+
+    def digest(d):
+        out = []
+        for root, _dirs, files in os.walk(d):
+            for f in sorted(files):
+                if f.endswith(".sst"):
+                    p = os.path.join(root, f)
+                    with open(p, "rb") as fh:
+                        out.append((os.path.relpath(p, d),
+                                    hashlib.sha256(
+                                        fh.read()).hexdigest()))
+        return sorted(out)
+
+    def compact_arm(name, mesh=False, wedge=False):
+        d = os.path.join(tmpdir, name)
+        shutil.copytree(base, d)
+        MESH_SERVING.reset()
+        t = Table(d, partition_count=n_partitions)
+        try:
+            if mesh:
+                for s in t.partitions.values():
+                    MESH_SERVING.attach(s)
+                assert MESH_SERVING.ensure_current()
+            if wedge:
+                MESH_SERVING.watchdog.deadline_s = 1e-9
+            builds0 = MESH_SERVING.slab_builds
+            t0 = time.perf_counter()
+            for s in t.partitions.values():
+                s.manual_compact(now=fixed_now)
+            wall = time.perf_counter() - t0
+            if mesh and not wedge:
+                MESH_SERVING.ensure_current()  # publish-side refresh
+            st = MESH_SERVING.status()
+            st["slab_builds_during"] = MESH_SERVING.slab_builds - builds0
+            return digest(d), wall, st
+        finally:
+            t.close()
+            MESH_SERVING.reset()
+
+    host_dig, host_wall, _ = compact_arm("host")
+    mesh_dig, mesh_wall, mesh_st = compact_arm("mesh", mesh=True)
+    wedge_dig, wedge_wall, wedge_st = compact_arm("wedged", mesh=True,
+                                                  wedge=True)
+    shutil.rmtree(tmpdir, ignore_errors=True)
+
+    filter_speedup = host_filter_s / max(mesh_filter_s, 1e-9)
+    digest_ok = host_dig == mesh_dig
+    wedged_ok = host_dig == wedge_dig
+    out = {
+        "records": n_records, "partitions": n_partitions,
+        "devices": len(jax.devices()), "blocks": n_blocks,
+        "host_windows": host_windows,
+        "host_filter_ms": round(host_filter_s * 1e3, 2),
+        "mesh_filter_ms": round(mesh_filter_s * 1e3, 2),
+        "filter_speedup": (round(filter_speedup, 3)
+                           if mask_identity else 0.0),
+        "mesh_served": mesh_served,
+        "mask_identity_ok": mask_identity,
+        "compact_host_s": round(host_wall, 3),
+        "compact_mesh_s": round(mesh_wall, 3),
+        "compact_wedged_s": round(wedge_wall, 3),
+        "digest_identity_ok": digest_ok,
+        "wedged_digest_ok": wedged_ok,
+        "dispatches": dispatches,
+        "mask_serves": serves,
+        "arm_dispatches": mesh_st["compact_dispatches"],
+        "refresh_reuses": mesh_st["refresh_reuses"],
+        "refresh_rebuilds": mesh_st["refresh_rebuilds"],
+        "refresh_slab_builds": mesh_st["slab_builds_during"],
+        "wedged_fallbacks": wedge_st["compact_mesh_fallback_count"],
+        "wedged_trips": wedge_st["watchdog"]["trips"],
+        "gate_ok": bool(mask_identity and digest_ok and wedged_ok
+                        and mesh_served and dispatches >= 1
+                        and filter_speedup >= 1.5
+                        and mesh_st["refresh_reuses"] >= n_partitions
+                        and mesh_st["slab_builds_during"] == 0
+                        and wedge_st["watchdog"]["trips"] >= 1
+                        and len(jax.devices()) >= 4),
+    }
+    print(json.dumps(out), flush=True)
+
+
 def measure_geo(jax, device, n_points=20_000, n_searches=150, seed=11):
     """Geo radius-search ops/sec (BASELINE config #5): cell-cover prefix
     scans + one batched device distance predicate per search."""
@@ -2562,6 +2785,7 @@ def main() -> None:
     do_perfctx = os.environ.get("PEGBENCH_PERFCTX", "1") != "0"
     do_follower = os.environ.get("PEGBENCH_FOLLOWER_READ", "1") != "0"
     do_mesh = os.environ.get("PEGBENCH_MESH", "1") != "0"
+    do_mesh_compact = os.environ.get("PEGBENCH_MESH_COMPACT", "1") != "0"
 
     details = {"phases": {}}
     here = os.path.dirname(os.path.abspath(__file__))
@@ -3182,6 +3406,27 @@ def main() -> None:
                          f"identical={ms['watchdog']['fallback_identity_ok']}"
                          f", gate>=1.5x: {ms['gate_ok']}")
 
+                if do_mesh_compact:
+                    mc = measure_mesh_compact(here)
+                    details["phases"]["mesh_compact"] = mc
+                    save_details()
+                    with open(os.path.join(here, "BENCH_r19.json"),
+                              "w") as f:
+                        json.dump({"phases": {"mesh_compact": mc},
+                                   "accel_platform": "cpu-mesh"},
+                                  f, indent=1)
+                    _log(f"mesh_compact: filter "
+                         f"{mc['host_filter_ms']}ms host -> "
+                         f"{mc['mesh_filter_ms']}ms mesh "
+                         f"({mc['filter_speedup']}x over "
+                         f"{mc['partitions']} partitions, "
+                         f"{mc['host_windows']} windows -> "
+                         f"{mc['dispatches']} dispatch), digests "
+                         f"identical={mc['digest_identity_ok']}, wedged "
+                         f"identical={mc['wedged_digest_ok']}, refresh "
+                         f"reuses={mc['refresh_reuses']}, gate>=1.5x: "
+                         f"{mc['gate_ok']}")
+
                 if do_geo:
                     g_accel, g_hits = measure_geo(jax, accel)
                     g_cpu, _ = measure_geo(jax, cpu)
@@ -3223,5 +3468,7 @@ def main() -> None:
 if __name__ == "__main__":
     if "--mesh-phase" in sys.argv[1:]:
         _mesh_phase_main()
+    elif "--mesh-compact-phase" in sys.argv[1:]:
+        _mesh_compact_phase_main()
     else:
         main()

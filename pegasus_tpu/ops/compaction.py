@@ -175,6 +175,59 @@ def encoded_drop_mask(enc, now: int, default_ttl: int, pidx: int,
     return drop, (new_ets if want_ets else None)
 
 
+def mesh_compact_step(keys, key_len, hashkey_len, expire_ts, present,
+                      hash_lo, pidx, allowed, now, default_ttl,
+                      partition_version, *, operations=None,
+                      validate_hash: bool = False,
+                      want_ets: bool = True):
+    """Whole-table [P, B] twin of eval_block over the RESIDENT image
+    (parallel/mesh_resident.py): one SPMD dispatch computes every
+    compacting partition's drop masks instead of per-window host/XLA
+    programs — the LUDA shape.
+
+    Filter ordering is byte-for-byte eval_block's (default-TTL rewrite
+    -> user rules -> expiry + stale-split), flattened [P, B] -> [P*B]
+    with a per-row pidx vector exactly like mesh_resident._mesh_step so
+    the paths cannot drift. `present` plays eval_block's `valid`: the
+    host submit path stamps valid=True for every real SST row
+    (tombstones included — the write stage's flags check drops them
+    either way), and the stack's present mask is exactly that. The
+    stale-split term is additionally gated per-slot by `allowed`
+    (pidx <= partition_version — check_if_stale_split_data's KEEP for
+    mid-split children above the version), so one dispatch serves a
+    table whose partitions straddle a split. Returns
+    (packed_drop uint8[P, B/8], ets2 uint32[P, B] if want_ets)."""
+    from pegasus_tpu.ops.compaction_rules import apply_rules_ops
+
+    p, b = expire_ts.shape
+    k = keys.shape[-1]
+    now = jnp.asarray(now, jnp.uint32)
+    default_ttl = jnp.asarray(default_ttl, jnp.uint32)
+    ets = expire_ts.reshape(p * b)
+    present_f = present.reshape(p * b)
+    ets1 = jnp.where((default_ttl != 0) & (ets == 0),
+                     now + default_ttl, ets)
+    if operations:
+        rule_drop, ets2 = apply_rules_ops(
+            operations, keys.reshape(p * b, k), key_len.reshape(p * b),
+            hashkey_len.reshape(p * b), ets1, present_f, now)
+    else:
+        rule_drop = jnp.zeros_like(present_f)
+        ets2 = ets1
+    expired = ttl_expired(ets2, now)
+    if validate_hash:
+        pv = jnp.asarray(partition_version, jnp.uint32)
+        stale = ((hash_lo.reshape(p * b) & pv) != jnp.repeat(pidx, b)) \
+            & jnp.repeat(allowed, b)
+    else:
+        stale = jnp.zeros_like(present_f)
+    drop = ((expired | stale) & present_f) | rule_drop
+    packed = jnp.packbits(drop.reshape(p, b), axis=1)
+    if want_ets:
+        return packed, ets2.reshape(p, b)
+    return (packed,)
+
+
 COMPACT_CHUNK_ROWS = 1 << 18  # 256k records per stacked program
 
 

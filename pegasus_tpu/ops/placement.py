@@ -27,6 +27,8 @@ placement; nothing is duplicated).
 
 from __future__ import annotations
 
+from typing import Optional
+
 import numpy as np
 
 _PROBE_RTT: object = ...       # ... = unprobed; None = no accelerator
@@ -129,6 +131,17 @@ HOST_DISPATCH_S_EST = 0.002  # fixed per-program dispatch cost on the
 ICI_NEIGHBOR_S_EST = 0.0002   # per-hop collective cost on the mesh
 MESH_ICI_HOPS_EST = 8         # nominal ring hops per whole-table round
 MESH_EVAL_GBPS_EST = 8.0      # aggregate predicate stream across shards
+D2H_GBPS_EST = 0.037          # device->host marginal bandwidth — the
+#                               tunnel's downlink (module docstring);
+#                               what a mesh COMPACTION pays to bring
+#                               the packed drop masks + rewritten-TTL
+#                               column home (scans only fetch masks;
+#                               compaction fetches the ets column too)
+
+# a compaction row's resident predicate bytes: the same accounting the
+# slab/stack builders use (key matrix ~32 B + 9 B of len/expiry
+# columns) — offload_breakdown models window counts from it
+MESH_COMPACT_ROW_BYTES_EST = 41
 
 
 def mesh_round_fixed_s() -> float:
@@ -139,6 +152,51 @@ def mesh_round_fixed_s() -> float:
     if rtt is not None and rtt > LINK_RTT_COLOCATED_S:
         return ROUND_FIXED_S_EST
     return HOST_DISPATCH_S_EST
+
+
+def _mask_download_s(mask_bytes: int) -> float:
+    """Device->host return cost for a mesh result of `mask_bytes`. A
+    colocated mesh (CPU fallback devices, sub-ms link) hands results
+    back at memory speed; a tunneled mesh pays the ~37 MB/s downlink."""
+    rtt, _dev = _probe_rtt()
+    if rtt is not None and rtt > LINK_RTT_COLOCATED_S:
+        return mask_bytes / (D2H_GBPS_EST * 1e9)
+    return mask_bytes / (HOST_FILTER_GBPS_EST * 1e9)
+
+
+def predict_mesh_compact_seconds(batch_bytes: int,
+                                 mask_bytes: Optional[int] = None) -> float:
+    """The model's claim for ONE whole-table mesh compaction-filter
+    dispatch: the mesh round floor + ICI collectives + the sharded
+    predicate stream over the resident bytes + downloading the packed
+    drop masks (and rewritten-TTL column) back to the write stage.
+
+    Unlike the scan shape, compaction's result is not just a bitmask:
+    the rewritten expire_ts column rides home too when TTLs can
+    change, so the downlink term is first-class here. `mask_bytes`
+    defaults to the modeled 1 bit/row + 4 B/row from the row-bytes
+    estimate."""
+    if mask_bytes is None:
+        rows = batch_bytes / MESH_COMPACT_ROW_BYTES_EST
+        mask_bytes = int(rows / 8 + 4 * rows)
+    return (mesh_round_fixed_s()
+            + ICI_NEIGHBOR_S_EST * MESH_ICI_HOPS_EST
+            + batch_bytes / (MESH_EVAL_GBPS_EST * 1e9)
+            + _mask_download_s(int(mask_bytes)))
+
+
+def mesh_compact_pays(n_windows: int, batch_bytes: int,
+                      mask_bytes: Optional[int] = None) -> bool:
+    """Does ONE resident-mesh compaction-filter round beat the host
+    filter stage's `n_windows` per-window dispatches over the same
+    bytes? The compaction twin of mesh_wave_pays: a solo small
+    compaction (one window, one partition) has nothing to amortize the
+    mesh round + mask download against and honestly stays on
+    encoded_drop_mask / the host kernels; a table-wide bulk compaction
+    collapses every partition's windows into one dispatch and wins."""
+    host_s = (HOST_DISPATCH_S_EST * max(1, int(n_windows))
+              + batch_bytes / (HOST_FILTER_GBPS_EST * 1e9))
+    return predict_mesh_compact_seconds(batch_bytes, mask_bytes) < host_s
 
 
 def placement_verdict(workload: str = "rules") -> str:
@@ -167,6 +225,8 @@ def predict_kernel_seconds(workload: str, batch_bytes: int) -> float:
         return (mesh_round_fixed_s()
                 + ICI_NEIGHBOR_S_EST * MESH_ICI_HOPS_EST
                 + batch_bytes / (MESH_EVAL_GBPS_EST * 1e9))
+    if workload == "mesh_compact":
+        return predict_mesh_compact_seconds(batch_bytes)
     if placement_verdict(workload) == "device":
         return ROUND_FIXED_S_EST + batch_bytes / (H2D_GBPS_EST * 1e9)
     return (HOST_DISPATCH_S_EST
@@ -211,4 +271,35 @@ def offload_breakdown(workload: str, batch_bytes: int) -> dict:
             fixed + batch_bytes / (H2D_GBPS_EST * 1e9), 6)
         out["host_batch_s_est"] = round(
             batch_bytes / (HOST_FILTER_GBPS_EST * 1e9), 6)
+    out["compact"] = compact_breakdown(batch_bytes)
     return out
+
+
+def compact_breakdown(batch_bytes: int,
+                      n_windows: Optional[int] = None,
+                      mask_bytes: Optional[int] = None) -> dict:
+    """Quantified verdict for the compaction FILTER stage over
+    `batch_bytes` of resident predicate columns — the mesh-vs-host twin
+    of the scan-wave breakdown, so `shell placement` (and the drift
+    auditor reading the `mesh_compact` class) cover the compaction
+    dispatch site exactly like the wave one. Window count defaults to
+    the modeled pipeline geometry (compact_pipeline_window blocks of
+    BLOCK_CAPACITY rows at ~MESH_COMPACT_ROW_BYTES_EST per row)."""
+    rows = batch_bytes / MESH_COMPACT_ROW_BYTES_EST
+    if n_windows is None:
+        window_rows = 128 * 1024  # pipeline window x block capacity
+        n_windows = max(1, int(-(-rows // window_rows)))
+    if mask_bytes is None:
+        mask_bytes = int(rows / 8 + 4 * rows)
+    host_s = (HOST_DISPATCH_S_EST * max(1, int(n_windows))
+              + batch_bytes / (HOST_FILTER_GBPS_EST * 1e9))
+    mesh_s = predict_mesh_compact_seconds(batch_bytes, mask_bytes)
+    return {
+        "workload": "mesh_compact",
+        "batch_bytes": int(batch_bytes),
+        "n_windows": int(n_windows),
+        "mask_bytes": int(mask_bytes),
+        "mesh_pays": bool(mesh_s < host_s),
+        "mesh_batch_s_est": round(mesh_s, 6),
+        "host_batch_s_est": round(host_s, 6),
+    }

@@ -40,6 +40,7 @@ from __future__ import annotations
 import functools
 import threading
 import time
+from collections import OrderedDict
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -59,6 +60,15 @@ _NODE = METRICS.entity("storage", "node")
 _MESH_DISPATCH = _NODE.counter("mesh_dispatch_count")
 _MESH_FALLBACK = _NODE.counter("mesh_fallback_count")
 _TUNNEL_WEDGED = _NODE.gauge("tunnel_wedged")
+# compaction-filter offload (the LUDA shape): whole-table drop-mask
+# dispatches vs attempts that had to fall back to the host filter
+# stage, plus the publish-refresh split — survivor-gather reuse vs
+# full slab rebuild — that proves a mesh-filtered compaction never
+# pays the predicate work twice
+_COMPACT_MESH_DISPATCH = _NODE.counter("compact_mesh_dispatch_count")
+_COMPACT_MESH_FALLBACK = _NODE.counter("compact_mesh_fallback_count")
+_REFRESH_REUSE = _NODE.counter("mesh_refresh_reuse_count")
+_REFRESH_REBUILD = _NODE.counter("mesh_refresh_rebuild_count")
 
 _MASK64 = (1 << 64) - 1
 
@@ -170,6 +180,40 @@ def _mesh_program(mesh, hash_filter_type: int, sort_filter_type: int,
         out_shardings=(rep, rep, rep))
 
 
+# the compaction-filter twin: one compiled program per (mesh, ruleset
+# CONTENT, statics). Rulesets are config-sync-delivered objects, so the
+# cache keys on ops/compaction._ops_key — re-delivering the same JSON
+# reuses the executable instead of leaking one per delivery. A manual
+# OrderedDict because parsed Operation tuples are not hashable.
+_COMPACT_PROGRAMS: "OrderedDict[tuple, object]" = OrderedDict()
+_COMPACT_PROGRAM_CAP = 16
+
+
+def _mesh_compact_program(mesh, operations, validate_hash: bool,
+                          want_ets: bool):
+    from pegasus_tpu.ops.compaction import _ops_key, mesh_compact_step
+
+    key = (mesh, _ops_key(operations), bool(validate_hash),
+           bool(want_ets))
+    prog = _COMPACT_PROGRAMS.get(key)
+    if prog is not None:
+        _COMPACT_PROGRAMS.move_to_end(key)
+        return prog
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    rep = NamedSharding(mesh, P())
+    prog = jax.jit(
+        functools.partial(mesh_compact_step, operations=operations,
+                          validate_hash=bool(validate_hash),
+                          want_ets=bool(want_ets)),
+        out_shardings=(rep, rep) if want_ets else (rep,))
+    _COMPACT_PROGRAMS[key] = prog
+    while len(_COMPACT_PROGRAMS) > _COMPACT_PROGRAM_CAP:
+        _COMPACT_PROGRAMS.popitem(last=False)
+    return prog
+
+
 # -- watchdog --------------------------------------------------------------
 
 class TunnelWatchdog:
@@ -253,7 +297,7 @@ class _Slab:
 
     __slots__ = ("server", "lsm_id", "generation", "n_rows", "width",
                  "keys", "key_len", "hashkey_len", "expire_ts", "valid",
-                 "hash_lo", "segments", "lanes", "hdr")
+                 "hash_lo", "flags", "segments", "lanes", "hdr")
 
     def __init__(self, server, lsm_id: int, generation: int):
         self.server = server
@@ -267,6 +311,10 @@ class _Slab:
         self.expire_ts = None
         self.valid = None
         self.hash_lo = None
+        self.flags = None  # uint8[n] tombstone flags — host-only
+        #                    column so the survivor-gather refresh can
+        #                    replay the write stage's flags==0 check
+        #                    without re-reading any block
         self.segments: List[tuple] = []  # (ckey, blk, start, n)
         self.lanes = None                # uint32[n, 4] — built on demand
         self.hdr = 0
@@ -316,6 +364,7 @@ def _build_slab(server) -> _Slab:
     slab.expire_ts = np.zeros(total, np.uint32)
     slab.valid = np.zeros(total, bool)
     slab.hash_lo = np.zeros(total, np.uint32)
+    slab.flags = np.zeros(total, np.uint8)
     start = 0
     for ckey, blk, n in entries:
         nb = block_from_columns(blk.keys, blk.key_len, blk.expire_ts)
@@ -333,7 +382,116 @@ def _build_slab(server) -> _Slab:
                 blk.hash_lo, np.uint32)[:n]
         else:
             slab.hash_lo[start:start + n] = _slab_hash_lo(nb, n)
+        if blk.flags is not None:
+            slab.flags[start:start + n] = np.asarray(
+                blk.flags, np.uint8)[:n]
         slab.segments.append((ckey, blk, start, n))
+        start += n
+    return slab
+
+
+class _LazyBlock:
+    """Segment proxy for a survivor-refreshed slab: the slab's columns
+    were gathered host-side, so the underlying block bytes are only
+    needed if a later aggregate fold / value-mask touches this segment
+    — then the run is read once, on demand, exactly like _build_slab
+    would have."""
+
+    __slots__ = ("_run", "_idx", "_blk")
+
+    def __init__(self, run, idx: int):
+        self._run = run
+        self._idx = idx
+        self._blk = None
+
+    def __getattr__(self, name):
+        blk = object.__getattribute__(self, "_blk")
+        if blk is None:
+            run = object.__getattribute__(self, "_run")
+            idx = object.__getattribute__(self, "_idx")
+            blk = run.read_block(idx)
+            object.__setattr__(self, "_blk", blk)
+        return getattr(blk, name)
+
+
+def _survivor_slab(server, slab0: Optional[_Slab],
+                   pending: Optional[tuple]) -> Optional[_Slab]:
+    """Refresh one partition's slab from the drop masks its own
+    mesh-filtered compaction computed: gather the surviving rows out of
+    the OLD slab columns instead of re-reading (and re-hashing) every
+    published block. Returns the new slab, or None when anything about
+    the publish doesn't match the stashed masks — interleaved flush,
+    geometry drift, merge-path compaction — in which case the caller
+    does the full rebuild (always safe).
+
+    Verification is structural, not trusting: the new L1 runs' block
+    metas must align 1:1 — count AND first key — with the nonzero
+    survivor sets the masks predict (bulk_compact_rewrite emits one
+    output block per surviving input block, in order), so a publish
+    produced by anything other than exactly these masks rebuilds."""
+    if pending is None or slab0 is None:
+        return None
+    p_slab, masks, _want_ets = pending
+    lsm = server.engine.lsm
+    if (p_slab is not slab0 or slab0.n_rows is None
+            or slab0.flags is None
+            or slab0.lsm_id != id(lsm)
+            or lsm.generation != slab0.generation + 1
+            or len(lsm.memtable) or lsm.l0):
+        return None
+    # survivors per old segment: THE survivor definition, shared with
+    # bulk_compact_rewrite's transform
+    from pegasus_tpu.storage.lsm import survivor_mask
+
+    surv = []  # (src_rows, ets_rows)
+    for ckey, _blk, start, n in slab0.segments:
+        m = masks.get(ckey)
+        if m is None:
+            return None
+        drop, ets_new = m
+        keep = survivor_mask(drop, slab0.flags[start:start + n])
+        kept = np.flatnonzero(keep)
+        if kept.size == 0:
+            continue
+        src = start + kept
+        ets_rows = (np.asarray(ets_new)[kept] if ets_new is not None
+                    else slab0.expire_ts[src])
+        surv.append((src, ets_rows))
+    new_entries = [(run, idx, bm) for run in list(lsm.l1_runs)
+                   for idx, bm in enumerate(run.blocks)]
+    if len(surv) != len(new_entries):
+        return None
+    slab = _Slab(server, id(lsm), lsm.generation)
+    slab.hdr = slab0.hdr
+    total = sum(int(src.size) for src, _e in surv)
+    slab.n_rows = total
+    slab.width = slab0.width
+    all_src = (np.concatenate([src for src, _e in surv])
+               if surv else np.zeros(0, np.int64))
+    slab.keys = slab0.keys[all_src]
+    slab.key_len = slab0.key_len[all_src]
+    slab.hashkey_len = slab0.hashkey_len[all_src]
+    slab.valid = slab0.valid[all_src]
+    slab.hash_lo = slab0.hash_lo[all_src]
+    slab.flags = slab0.flags[all_src]
+    slab.expire_ts = (np.concatenate([e for _s, e in surv])
+                      if surv else np.zeros(0, np.uint32)
+                      ).astype(np.uint32, copy=False)
+    if slab0.lanes is not None:
+        # value payloads survive a TTL-header patch untouched (the
+        # u64 lanes read past the header), so gathered lanes stay exact
+        slab.lanes = slab0.lanes[all_src]
+    start = 0
+    for (src, _ets), (run, idx, bm) in zip(surv, new_entries):
+        n = int(src.size)
+        if int(bm.count) != n:
+            return None
+        first = src[0]
+        if bytes(slab0.keys[first, :int(slab0.key_len[first])]) \
+                != bm.first_key:
+            return None
+        slab.segments.append(((run.path, bm.offset),
+                              _LazyBlock(run, idx), start, n))
         start += n
     return slab
 
@@ -477,11 +635,20 @@ class _TableResident:
         self.dirty: set = set()
         self.slabs: Dict[int, _Slab] = {}
         self.stack: Optional[_Stack] = None
+        # pidx -> (slab, {ckey: (drop, ets|None)}, want_ets): the drop
+        # masks a mesh-filtered compaction served, stashed until its
+        # publish lands so the refresh can survivor-gather instead of
+        # re-reading every block (the compaction already paid the
+        # predicate work once)
+        self.pending: Dict[int, tuple] = {}
 
     def refresh(self, owner: "MeshServing", pmesh) -> bool:
         """Rebuild ONLY the slabs whose store changed (publish-marked
         dirty, generation bump, or engine swap), restack if anything
-        did. Returns whether the device image changed."""
+        did. A dirty partition whose own mesh-filtered compaction just
+        published reuses the stashed survivor masks (gather, no block
+        reads); everything else takes the full rebuild. Returns whether
+        the device image changed."""
         changed = False
         for pidx in sorted(self.servers):
             server = self.servers[pidx]
@@ -490,8 +657,18 @@ class _TableResident:
             if (slab is None or pidx in self.dirty
                     or slab.lsm_id != id(lsm)
                     or slab.generation != lsm.generation):
-                self.slabs[pidx] = _build_slab(server)
-                owner.slab_builds += 1
+                new_slab = _survivor_slab(server, slab,
+                                          self.pending.pop(pidx, None))
+                if new_slab is not None:
+                    self.slabs[pidx] = new_slab
+                    owner.refresh_reuses += 1
+                    _REFRESH_REUSE.increment()
+                else:
+                    self.slabs[pidx] = _build_slab(server)
+                    owner.slab_builds += 1
+                    if slab is not None:  # a REFRESH, not first attach
+                        owner.refresh_rebuilds += 1
+                        _REFRESH_REBUILD.increment()
                 changed = True
         self.dirty.clear()
         for pidx in list(self.slabs):
@@ -530,7 +707,17 @@ class MeshServing:
         self.host_waves = 0
         self.slab_builds = 0
         self.stack_builds = 0
+        self.compact_dispatches = 0
+        self.compact_mask_serves = 0
+        self.refresh_reuses = 0
+        self.refresh_rebuilds = 0
         self._agg_cache: Dict[tuple, dict] = {}
+        # (params, ckey) -> (drop, ets|None): per-BLOCK mask slices from
+        # whole-table compaction dispatches. Keyed by run path + block
+        # offset (immutable file content), so sibling partitions
+        # compacting in the same epoch second reuse ONE dispatch even
+        # across the restacks their interleaved publishes trigger.
+        self._compact_cache: Dict[tuple, tuple] = {}
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -573,6 +760,7 @@ class MeshServing:
             self._tables.clear()
             self._index.clear()
             self._agg_cache.clear()
+            self._compact_cache.clear()
             self._pmesh = None
             self._mesh_failed = False
             self._force_cpu = False
@@ -581,6 +769,8 @@ class MeshServing:
             self.wave_dispatches = self.agg_dispatches = 0
             self.host_waves = 0
             self.slab_builds = self.stack_builds = 0
+            self.compact_dispatches = self.compact_mask_serves = 0
+            self.refresh_reuses = self.refresh_rebuilds = 0
         _TUNNEL_WEDGED.set(0.0)
 
     def note_host_wave(self) -> None:
@@ -905,6 +1095,166 @@ class MeshServing:
             "measured_ms": measured_ms,
         }
 
+    # -- compaction filter offload (the LUDA shape) ------------------------
+
+    def _compact_params(self, now, default_ttl, partition_version,
+                        validate, operations, want_ets) -> tuple:
+        from pegasus_tpu.ops.compaction import _ops_key
+
+        return (int(now) & 0xFFFFFFFF, int(default_ttl) & 0xFFFFFFFF,
+                int(max(partition_version, 0)) & 0xFFFFFFFF,
+                bool(validate), _ops_key(operations), bool(want_ets))
+
+    def _compact_masks_from_cache(self, params, entries):
+        """{(run, idx): (drop, ets|None)} for every entry, or None if
+        any block's mask isn't cached under these filter params."""
+        out = {}
+        for run, i, bm in entries:
+            m = self._compact_cache.get((params, (run.path, bm.offset)))
+            if m is None:
+                return None
+            out[(run, i)] = m
+        return out
+
+    def _stash_pending(self, tres, pidx: int, lsm, params,
+                       want_ets: bool) -> None:
+        """Record the served masks against the partition's CURRENT slab
+        so the publish this compaction is about to do can refresh
+        residency by survivor-gather instead of a full rebuild."""
+        slab = tres.slabs.get(pidx)
+        if (slab is None or slab.n_rows is None
+                or slab.lsm_id != id(lsm)
+                or slab.generation != lsm.generation):
+            return
+        masks = {}
+        for ckey, _blk, _start, _n in slab.segments:
+            m = self._compact_cache.get((params, ckey))
+            if m is None:
+                return
+            masks[ckey] = m
+        tres.pending[pidx] = (slab, masks, want_ets)
+
+    def try_compact_masks(self, lsm, entries, now, default_ttl, pidx,
+                          partition_version, validate, operations,
+                          want_ets: bool, n_windows: int = 1
+                          ) -> Optional[dict]:
+        """Serve one bulk compaction's FILTER stage from the resident
+        image: ONE whole-table SPMD dispatch computes the drop masks
+        (and rewritten-TTL column) for ALL of the table's partitions,
+        and each sibling partition compacting under the same filter
+        params in the same epoch second reads its blocks' slices from
+        the per-ckey cache — table-wide compaction pays one dispatch,
+        not one per partition per window.
+
+        `entries` is lsm.bulk_compact_entries(); returns
+        {(run, idx): (drop bool[n], new_ets uint32[n]|None)} covering
+        every entry, or None to decline — gate says host wins, blocks
+        not resident, store raced a publish, or the watchdog tripped
+        mid-dispatch (the trip->CPU-mesh->host ladder then applies to
+        the NEXT compaction; this one falls back to the host filter
+        stage, byte-identical by construction)."""
+        if not self.enabled or not entries:
+            return None
+        pidx = int(pidx)
+        params = self._compact_params(now, default_ttl,
+                                      partition_version, validate,
+                                      operations, want_ets)
+        with self._lock:
+            tres = None
+            for t in self._tables.values():
+                srv = t.servers.get(pidx)
+                if srv is not None and srv.engine.lsm is lsm:
+                    tres = t
+                    break
+            if tres is None:
+                return None
+            got = self._compact_masks_from_cache(params, entries)
+            if got is not None:  # a sibling's dispatch covered us
+                self.compact_mask_serves += 1
+                self._stash_pending(tres, pidx, lsm, params, want_ets)
+                return got
+        if not self.ensure_current():
+            _COMPACT_MESH_FALLBACK.increment()
+            return None
+        from pegasus_tpu.ops import placement
+
+        with self._lock:
+            got = self._compact_masks_from_cache(params, entries)
+            if got is not None:  # raced a sibling mid-refresh
+                self.compact_mask_serves += 1
+                self._stash_pending(tres, pidx, lsm, params, want_ets)
+                return got
+            stack = tres.stack
+            slab = tres.slabs.get(pidx)
+            if (stack is None or slab is None or slab.n_rows is None
+                    or slab.lsm_id != id(lsm)
+                    or slab.generation != lsm.generation):
+                _COMPACT_MESH_FALLBACK.increment()
+                return None
+            for run, i, bm in entries:
+                hit = stack.index.get((run.path, bm.offset))
+                if hit is None or int(stack.pidx_np[hit[0]]) != pidx:
+                    _COMPACT_MESH_FALLBACK.increment()
+                    return None
+            n_slots = max(1, len(stack.slots))
+            mask_bytes = stack.P * (stack.B // 8)
+            if want_ets:
+                mask_bytes += 4 * stack.P * stack.B
+            # one whole-table dispatch amortizes over every attached
+            # partition's windows; a solo small compaction (one window,
+            # one partition) honestly stays on the host filter stage
+            if not placement.mesh_compact_pays(
+                    max(1, int(n_windows)) * n_slots,
+                    stack.batch_bytes, mask_bytes):
+                return None
+            prog = _mesh_compact_program(stack.pmesh.mesh, operations,
+                                         bool(validate), bool(want_ets))
+            if validate:
+                allowed = stack.pidx_np <= np.uint32(params[2])
+            else:
+                allowed = np.ones(stack.P, bool)
+            now_op = np.uint32(params[0])
+            ttl_op = np.uint32(params[1])
+            pv_op = np.uint32(params[2])
+
+            def _call():
+                import jax
+
+                return jax.device_get(prog(
+                    stack.keys, stack.key_len, stack.hashkey_len,
+                    stack.expire_ts, stack.present, stack.hash_lo,
+                    stack.pidx, allowed, now_op, ttl_op, pv_op))
+
+            t0 = time.perf_counter()
+            out = self.watchdog.run(_call)
+            if out is None:  # overrun/error: this compaction goes host
+                _COMPACT_MESH_FALLBACK.increment()
+                return None
+            measured_s = time.perf_counter() - t0
+            drop_all = np.unpackbits(np.asarray(out[0]), axis=1,
+                                     count=stack.B).astype(bool)
+            ets_all = np.asarray(out[1]) if want_ets else None
+            if len(self._compact_cache) > 65536:
+                self._compact_cache.clear()
+            for slot, (_part_idx, sl) in enumerate(stack.slots):
+                for ckey, _blk, start, seg_n in sl.segments:
+                    drop = np.ascontiguousarray(
+                        drop_all[slot, start:start + seg_n])
+                    ets = (np.ascontiguousarray(
+                        ets_all[slot, start:start + seg_n])
+                        if want_ets else None)
+                    self._compact_cache[(params, ckey)] = (drop, ets)
+            predicted_s = placement.predict_mesh_compact_seconds(
+                stack.batch_bytes, mask_bytes)
+            from pegasus_tpu.server.workload import DRIFT
+
+            DRIFT.note("mesh_compact", predicted_s, measured_s)
+            _COMPACT_MESH_DISPATCH.increment()
+            self.compact_dispatches += 1
+            self.compact_mask_serves += 1
+            self._stash_pending(tres, pidx, lsm, params, want_ets)
+            return self._compact_masks_from_cache(params, entries)
+
     # -- observability -----------------------------------------------------
 
     def status(self) -> Dict[str, Any]:
@@ -931,6 +1281,17 @@ class MeshServing:
                                        if waves else 0.0),
                 "slab_builds": self.slab_builds,
                 "stack_builds": self.stack_builds,
+                "compact_mesh_dispatch_count":
+                    int(_COMPACT_MESH_DISPATCH.value()),
+                "compact_mesh_fallback_count":
+                    int(_COMPACT_MESH_FALLBACK.value()),
+                "mesh_refresh_reuse_count": int(_REFRESH_REUSE.value()),
+                "mesh_refresh_rebuild_count":
+                    int(_REFRESH_REBUILD.value()),
+                "compact_dispatches": self.compact_dispatches,
+                "compact_mask_serves": self.compact_mask_serves,
+                "refresh_reuses": self.refresh_reuses,
+                "refresh_rebuilds": self.refresh_rebuilds,
                 "watchdog": {
                     "deadline_s": self.watchdog._deadline(),
                     "consecutive_failures": self.watchdog.failures,

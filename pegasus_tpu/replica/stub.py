@@ -530,20 +530,30 @@ class ReplicaStub:
             return self.health.events(limit, entity_id)
 
         def placement(args):
-            """placement [workload [batch_bytes]] — the quantified
-            pays/doesn't-pay offload verdict (ops/placement.py
-            offload_breakdown) plus the live cost-model drift audit,
-            operator-visible instead of PERF.md-only. The `mesh` block
-            is the resident SPMD serving layer: verdict share, tunnel
-            health, watchdog state."""
-            from pegasus_tpu.ops.placement import offload_breakdown
+            """placement [workload [batch_bytes [n_windows]]] — the
+            quantified pays/doesn't-pay offload verdict
+            (ops/placement.py offload_breakdown) plus the live
+            cost-model drift audit, operator-visible instead of
+            PERF.md-only. The `mesh` block is the resident SPMD
+            serving layer: verdict share, tunnel health, watchdog
+            state. The breakdown's `compact` block is the compaction
+            FILTER stage's mesh-vs-host verdict (drift class
+            `mesh_compact`); pass n_windows to model a specific
+            pipeline geometry instead of the default."""
+            from pegasus_tpu.ops.placement import (
+                compact_breakdown,
+                offload_breakdown,
+            )
             from pegasus_tpu.parallel.mesh_resident import MESH_SERVING
             from pegasus_tpu.server.workload import DRIFT
 
             workload = args[0] if args else "rules"
             batch_bytes = int(args[1]) if len(args) > 1 else 1 << 20
-            return {"breakdown": offload_breakdown(workload,
-                                                   batch_bytes),
+            bd = offload_breakdown(workload, batch_bytes)
+            if len(args) > 2 and args[2]:
+                bd["compact"] = compact_breakdown(
+                    batch_bytes, n_windows=int(args[2]))
+            return {"breakdown": bd,
                     "drift": DRIFT.status(),
                     "mesh": MESH_SERVING.status()}
 

@@ -552,7 +552,17 @@ class PartitionServer:
 
         def run() -> None:
             try:
-                self.manual_compact()
+                # a recent trigger doubles as the table-shared filter
+                # timestamp: every partition of the table sees the same
+                # env in the same sync round, so they all filter at
+                # `now=trigger_ts` — identical params let the mesh
+                # filter stage serve the whole table from ONE dispatch
+                # (a stale/future-skewed trigger falls back to each
+                # partition's own clock)
+                shared_now = (trigger_ts
+                              if abs(epoch_now() - trigger_ts) <= 600
+                              else None)
+                self.manual_compact(now=shared_now)
             finally:
                 self._mc_running = False
                 GOVERNOR.end_heavy()
@@ -3416,10 +3426,19 @@ class PartitionServer:
         ROW_CACHE.invalidate_gid((self.app_id, self.pidx))
 
     def manual_compact(self, default_ttl: Optional[int] = None,
-                       rules_filter=None) -> None:
+                       rules_filter=None,
+                       now: Optional[int] = None) -> None:
         """Parity: pegasus_manual_compact_service (manual CompactRange).
         Defaults come from the table's app-envs (`default_ttl`,
         `user_specified_compaction`) unless overridden.
+
+        `now` pins the filter timestamp (defaults to epoch_now() inside
+        the engine). A table-wide trigger passes one shared timestamp
+        so every sibling partition filters under IDENTICAL params —
+        deterministic outputs, and the mesh-resident filter stage
+        (parallel/mesh_resident.py) computes the whole table's drop
+        masks in ONE dispatch that the siblings' compactions then read
+        from cache.
 
         The writer critical section is NARROW: the overlay is frozen
         with one flush under _write_lock, the multi-second merge runs
@@ -3446,5 +3465,5 @@ class PartitionServer:
                 default_ttl=default_ttl, pidx=self.pidx,
                 partition_version=self.partition_version,
                 validate_hash=self.validate_partition_hash,
-                rules_filter=rules_filter,
+                rules_filter=rules_filter, now=now,
                 publish_lock=self._write_lock)

@@ -36,6 +36,14 @@ path would produce — pipelined output is byte-identical by
 construction, and the bench/tests gate on a content digest to prove
 it stays that way.
 
+Mesh-filtered mode: when the table's blocks are resident on the
+device mesh (parallel/mesh_resident.py), the engine pre-computes the
+WHOLE store's drop masks in one SPMD dispatch before the pipeline
+starts; every window then arrives at the filter stage pre-served (no
+in-flight program, eager-forwarded straight to WRITE), so the
+pipeline degrades gracefully to read → write with the governor still
+pacing reads. Same (block, mask) stream, same bytes.
+
 Shutdown: any stage exception travels down the queues and re-raises in
 the consumer; closing the consumer generator (writer failure) sets the
 stop event, unblocks both queues, and joins the threads — no daemon
@@ -78,6 +86,14 @@ def pipeline_window() -> int:
 
 def pipeline_depth() -> int:
     return int(FLAGS.get("pegasus.storage", "compact_pipeline_depth"))
+
+
+def window_count(n_entries: int) -> int:
+    """Windows a compaction over `n_entries` blocks will submit — the
+    host filter stage pays one dispatch per window, which is the unit
+    the mesh gate (ops/placement.mesh_compact_pays) weighs one
+    whole-table SPMD dispatch against."""
+    return max(1, -(-int(n_entries) // max(1, pipeline_window())))
 
 
 def transform_workers() -> int:

@@ -291,7 +291,16 @@ class StorageEngine:
         CompactionGovernor's token bucket so background bandwidth
         answers foreground pressure. Serial (flag off): the original
         windowed loop with one-window device lookahead. Both produce
-        the identical (block, mask) stream, so output bytes match."""
+        the identical (block, mask) stream, so output bytes match.
+
+        Mesh-filtered: when the table's blocks are resident on the
+        device mesh (parallel/mesh_resident.py), the whole store's drop
+        masks come back from ONE SPMD dispatch shared across every
+        sibling partition compacting under the same filter params —
+        submit_window then serves each window from the mask dict with
+        no per-window device program at all. Declines (gate, watchdog
+        trip, non-resident blocks) fall through to the host/XLA stages
+        above, byte-identical by construction."""
         from pegasus_tpu.ops.compaction import (
             choose_eval_device,
             compaction_eval_drain,
@@ -307,12 +316,28 @@ class StorageEngine:
             pipeline_window,
             stage_threads_enabled,
             transform_workers,
+            window_count,
         )
 
         ttl_may_change = bool(default_ttl) or bool(
             operations and any(op.op == "update_ttl" for op in operations))
         eval_device = choose_eval_device(workload=rules_workload(operations))
         entries = self.lsm.bulk_compact_entries()
+        # mesh FILTER pre-pass: one whole-table dispatch (or a sibling's
+        # cached one) hands back every block's drop mask up front; the
+        # READ stage below still pays the governor, the WRITE stage is
+        # untouched
+        mesh_masks = None
+        if entries:
+            try:
+                from pegasus_tpu.parallel.mesh_resident import MESH_SERVING
+                mesh_masks = MESH_SERVING.try_compact_masks(
+                    self.lsm, entries, now_s, default_ttl, pidx,
+                    partition_version, do_validate, operations,
+                    want_ets=ttl_may_change,
+                    n_windows=window_count(len(entries)))
+            except Exception:
+                mesh_masks = None
         meta = {
             # snapshot mode: the output only covers decrees flushed at
             # freeze time — claiming last_committed would make boot skip
@@ -345,6 +370,17 @@ class StorageEngine:
 
         def submit_window(items):
             """FILTER stage phase 1: dispatch without waiting."""
+            if mesh_masks is not None:
+                served = {}
+                for run, i, _blk, _d in items:
+                    m = mesh_masks.get((run, i))
+                    if m is None:
+                        break
+                    served[(run, i)] = m
+                else:
+                    # whole window pre-filtered on the mesh: nothing
+                    # in flight, eager-forward straight to WRITE
+                    return items, [], served
             blocks = [((run, i), blk, pidx)
                       for run, i, blk, is_direct in items
                       if not is_direct]
@@ -371,10 +407,12 @@ class StorageEngine:
                 got[tag] = (drop, new_ets)
             out = []
             for run, i, blk, is_direct in items:
-                if is_direct:
-                    drop, new_ets = host_done[(run, i)]
-                else:
-                    drop, new_ets = got[(run, i)]
+                # host_done holds both direct-on-encoded masks and
+                # mesh-served ones; device programs land in got
+                m = host_done.get((run, i))
+                if m is None:
+                    m = got[(run, i)]
+                drop, new_ets = m
                 out.append((run, i, blk, drop, new_ets))
             return out
 

@@ -62,6 +62,19 @@ L1_RUN_CAPACITY = 262_144
 _STORE_UIDS = itertools.count(1)
 
 
+def survivor_mask(drop: np.ndarray, flags) -> np.ndarray:
+    """Rows a compaction keeps: the filter's drop mask plus the
+    tombstone flags — THE survivor definition. bulk_compact_rewrite's
+    transform applies it to build output blocks, and the mesh residency
+    refresh (parallel/mesh_resident._survivor_slab) replays it to
+    gather the post-compaction slab without re-reading those blocks;
+    both sides calling one function is what keeps them in lockstep."""
+    keep = ~np.asarray(drop, bool)
+    if flags is not None:
+        keep &= np.asarray(flags) == 0  # tombstones never stay
+    return keep
+
+
 class LSMStore:
     def __init__(self, data_dir: str, block_capacity: int = BLOCK_CAPACITY,
                  l0_compaction_trigger: int = 4,
@@ -683,8 +696,7 @@ class LSMStore:
                 # survivor check first: a fully-dropped block must
                 # never roll a writer (an empty L1 run would publish
                 # when every block drops every row)
-                keep = ~drop
-                keep &= np.asarray(blk.flags) == 0
+                keep = survivor_mask(drop, blk.flags)
                 if not keep.any():
                     return "skip", None
                 if codec_now != CODEC_NONE and cblock_subset is not None \
@@ -706,9 +718,7 @@ class LSMStore:
                 # mid-store): materialize once and take the
                 # vectorized gather path below
                 blk = blk.decode()
-            keep = ~drop
-            if blk.flags is not None:
-                keep &= blk.flags == 0  # tombstones never stay
+            keep = survivor_mask(drop, blk.flags)
             kept = np.flatnonzero(keep)
             if kept.size == 0:
                 return "skip", None

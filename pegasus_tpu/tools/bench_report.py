@@ -32,7 +32,7 @@ _HEADLINE_PREFS = (
     "aggregate_read_qps", "phash_qps", "filtered_qps", "row_cache_qps",
     "accel_qps", "read_qps", "write_qps", "qps", "records_per_s",
     "accel_records_per_s", "effective_gbps", "mesh_speedup",
-    "pushdown_speedup", "speedup", "ratio",
+    "pushdown_speedup", "filter_speedup", "speedup", "ratio",
 )
 
 

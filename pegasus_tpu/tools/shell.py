@@ -364,6 +364,10 @@ def main(argv=None) -> int:
                    help="workload class: ttl|probe|rules|match")
     p.add_argument("--bytes", type=int, default=1 << 20,
                    help="batch size for the breakdown estimate")
+    p.add_argument("--windows", type=int, default=0,
+                   help="model the compaction block at this many "
+                        "filter windows (0 = default pipeline "
+                        "geometry)")
     p.add_argument("--node", default=None,
                    help="one node (wire mode); default = first node")
     # cluster/node admin breadth (parity: shell admin commands)
@@ -1826,16 +1830,23 @@ def _dispatch(args, box, out) -> int:
                 print(json.dumps(
                     {n: box.remote_command(
                         n, "placement",
-                        [args.workload, str(args.bytes)])},
+                        [args.workload, str(args.bytes),
+                         str(args.windows or "")])},
                     indent=1), file=out)
         else:
-            from pegasus_tpu.ops.placement import offload_breakdown
+            from pegasus_tpu.ops.placement import (
+                compact_breakdown,
+                offload_breakdown,
+            )
             from pegasus_tpu.parallel.mesh_resident import MESH_SERVING
             from pegasus_tpu.server.workload import DRIFT
 
+            bd = offload_breakdown(args.workload, args.bytes)
+            if args.windows:
+                bd["compact"] = compact_breakdown(
+                    args.bytes, n_windows=args.windows)
             print(json.dumps(
-                {"breakdown": offload_breakdown(args.workload,
-                                                args.bytes),
+                {"breakdown": bd,
                  "drift": DRIFT.status(),
                  "mesh": MESH_SERVING.status()}, indent=1), file=out)
     elif args.cmd == "nodes":
