@@ -1582,6 +1582,304 @@ def measure_perfctx_overhead(tmpdir, seed: int):
         shutil.rmtree(cdir, ignore_errors=True)
 
 
+def measure_qos_isolation(tmpdir, seed: int):
+    """Multi-tenant QoS phase (round 20), two same-run A/Bs.
+
+    Admission overhead: ONE tenant runs the batched point-get and
+    ranged multi_get streams over compacted read-only state with
+    budget enforcement hard-OFF vs ON. The tenant's configured budget
+    sits far above the workload, so the ON mode pays the real
+    per-request resolve + bucket checks without ever gating —
+    identity-gated, modes interleaved, median of 3 reps; the gate: ON
+    within 2% of OFF on both legs (the perfctx convention; reads and
+    scans are the shed-eligible admission classes — writes are
+    shed-exempt and their funnel is exercised by the isolation arm
+    below). Tenant classification and CU charging run in BOTH modes
+    (unconditional data-plane accounting); the A/B isolates what the
+    enforce flag adds.
+
+    Isolation: a compliant tenant's batched point-get rounds, timed
+    per round, with an abusive tenant absent vs flooding oversized
+    writes into a tiny CU budget before every round. Per-tenant
+    budgets (not client courtesy) are the mechanism: the gates are
+    that the compliant tenant's result digest is IDENTICAL in both
+    modes, the abuser actually went over budget, and the compliant
+    per-round p99 stays within the gated bound (<=1.5x its solo p99).
+    """
+    import hashlib
+    import shutil
+
+    import numpy as np
+
+    from pegasus_tpu.base.key_schema import generate_key, key_hash_parts
+    from pegasus_tpu.base.value_schema import expire_ts_from_ttl
+    from pegasus_tpu.rpc.codec import OP_PUT
+    from pegasus_tpu.server.tenancy import TENANTS
+    from pegasus_tpu.tools.cluster import SimCluster
+    from pegasus_tpu.utils.flags import FLAGS
+
+    n_keys = int(os.environ.get("PEGBENCH_QOS_KEYS", 512))
+    n_rounds = int(os.environ.get("PEGBENCH_QOS_ROUNDS", 240))
+    iso_rounds = int(os.environ.get("PEGBENCH_QOS_ISO_ROUNDS", 160))
+    reps = 3
+    batch = 32
+    out = {}
+
+    def pct(xs, q):
+        xs = sorted(xs)
+        return xs[min(len(xs) - 1, int(len(xs) * q))]
+
+    # ---- A/B 1: single-tenant admission-path overhead ---------------
+    cdir = os.path.join(tmpdir, "qos_admission")
+    cluster = SimCluster(cdir, n_nodes=3, seed=seed)
+    try:
+        cluster.create_table(
+            "qa", partition_count=4, replica_count=3,
+            envs={"qos.tenants": "bench:8:100000000",
+                  "qos.default_tenant": "bench"})
+        client = cluster.client("qa")  # adopts qos.default_tenant
+        n_sks = 4  # sort keys per hashkey: the ranged leg reads pages
+        hks = [b"qak%05d" % i for i in range(n_keys)]
+        for start in range(0, n_keys, batch):
+            groups = {}
+            for hk in hks[start:start + batch]:
+                ph = key_hash_parts(hk, b"")
+                for j in range(n_sks):
+                    groups.setdefault(ph % 4, []).append(
+                        (OP_PUT, (generate_key(hk, b"s%02d" % j),
+                                  b"v" * 64, expire_ts_from_ttl(0)),
+                         ph))
+            client.write_multi(groups)
+        # compact so every measured pass reads the SAME frozen state —
+        # a mutating leg would make the A/B measure store drift, not
+        # admission cost
+        for stub in cluster.stubs.values():
+            for r in stub.replicas.values():
+                r.server.engine.flush()
+                r.server.engine.manual_compact()
+
+        order = np.random.default_rng(seed + 1).integers(
+            0, n_keys, size=n_rounds * batch)
+
+        def one_pass(digest):
+            t0 = time.perf_counter()
+            for r in range(n_rounds):
+                groups = {}
+                for j in order[r * batch:(r + 1) * batch]:
+                    hk = hks[int(j)]
+                    ph = key_hash_parts(hk, b"")
+                    groups.setdefault(ph % 4, []).append(
+                        ("get", generate_key(hk, b"s00"), ph))
+                res = client.point_read_multi(groups)
+                for pidx in sorted(res):
+                    for st, val in res[pidx]:
+                        digest.update(b"%d" % st)
+                        digest.update(val)
+            t_read = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            for r in range(n_rounds):
+                for j in order[r * batch:(r + 1) * batch:4]:
+                    hk = hks[int(j)]
+                    err, kvs = client.multi_get(hk)
+                    digest.update(b"%d%d" % (err, len(kvs)))
+                    for sk in sorted(kvs):
+                        digest.update(sk)
+                        digest.update(kvs[sk])
+            t_scan = time.perf_counter() - t0
+            return t_read, t_scan
+
+        FLAGS.set("pegasus.qos", "tenant_enforce", False)
+        one_pass(hashlib.sha256())  # unmeasured warm-up
+        modes = [("enforce_off", False), ("enforce_on", True)]
+        # min-of-reps needs several shots per mode to land on a quiet
+        # slice of a loaded box (observed pass spread up to ±40% wall)
+        admit_reps = int(os.environ.get("PEGBENCH_QOS_REPS", 7))
+        ops_n = n_rounds * batch
+        out["hashkeys"] = n_keys
+        out["admission_ops_per_mode"] = (ops_n + ops_n // 4) * admit_reps
+        times = {name: ([], []) for name, _e in modes}
+        hashes = {name: hashlib.sha256() for name, _e in modes}
+        # modes interleave across reps AND alternate order per rep:
+        # whatever warms within a rep (page cache, allocator) benefits
+        # the second slot, so a fixed order would bias one mode
+        for rep in range(admit_reps):
+            for name, enabled in (modes if rep % 2 == 0
+                                  else modes[::-1]):
+                FLAGS.set("pegasus.qos", "tenant_enforce", enabled)
+                tr, ts = one_pass(hashes[name])
+                times[name][0].append(tr)
+                times[name][1].append(ts)
+        digests = {}
+        for name, _e in modes:
+            reads, scans = times[name]
+            digests[name] = hashes[name].hexdigest()
+            out[name] = {
+                "read_s_median": round(sorted(reads)[admit_reps // 2],
+                                       4),
+                "scan_s_median": round(sorted(scans)[admit_reps // 2],
+                                       4),
+                "read_s_min": round(min(reads), 4),
+                "scan_s_min": round(min(scans), 4),
+            }
+        # the overhead estimator is the per-mode MIN over reps (timeit
+        # discipline): the pass replays deterministically, so host
+        # scheduler/GC noise is strictly additive and the fastest pass
+        # sits closest to the true path cost — per-pass wall noise on
+        # a loaded box (±5-10%) would drown a 2% gate computed from
+        # medians; the medians ride along for the record
+        base, on = out["enforce_off"], out["enforce_on"]
+        out["admission_read_overhead"] = round(
+            on["read_s_min"] / base["read_s_min"] - 1.0, 4)
+        out["admission_scan_overhead"] = round(
+            on["scan_s_min"] / base["scan_s_min"] - 1.0, 4)
+        out["admission_identity_ok"] = len(set(digests.values())) == 1
+    finally:
+        FLAGS.set("pegasus.qos", "tenant_enforce", True)
+        cluster.close()
+        shutil.rmtree(cdir, ignore_errors=True)
+        TENANTS.reset()  # process singleton: drop the sim-pinned clock
+
+    # ---- A/B 2: abuser on/off isolation -----------------------------
+    cdir = os.path.join(tmpdir, "qos_isolation")
+    cluster = SimCluster(cdir, n_nodes=3, seed=seed + 9)
+    try:
+        # weight 8:1 and a ~200 CU/s abuser budget vs 16KB (5 CU)
+        # writes: the abuser outruns its refill every round and lives
+        # in jittered-backoff retry, the compliant tenant never gates
+        cluster.create_table(
+            "qi", partition_count=4, replica_count=3,
+            envs={"qos.tenants": "abuser:1:200,compliant:8:100000000",
+                  "qos.default_tenant": "compliant"})
+        compliant = cluster.client("qi", name="bench-qi-compliant",
+                                   tenant="compliant")
+        abuser = cluster.client("qi", name="bench-qi-abuser",
+                                tenant="abuser")
+        keys = [(b"qik%05d" % i, b"s") for i in range(n_keys)]
+        for start in range(0, n_keys, batch):
+            groups = {}
+            for hk, sk in keys[start:start + batch]:
+                ph = key_hash_parts(hk, sk)
+                groups.setdefault(ph % 4, []).append(
+                    (OP_PUT, (generate_key(hk, sk), b"v" * 64,
+                              expire_ts_from_ttl(0)), ph))
+            compliant.write_multi(groups)
+
+        order = np.random.default_rng(seed + 2).integers(
+            0, n_keys, size=iso_rounds * batch)
+        big = b"A" * 16384  # ~5 CU per write against the 200 CU/s budget
+
+        def iso_pass(with_abuser, digest, round_times):
+            # untimed priming round: the inter-pass run_until_idle
+            # leaves due periodic work (health ticks, lease renewals)
+            # for the next request to pump, and with a few hundred
+            # samples the p99 is the top handful of rounds — one
+            # scheduling artifact must not own it
+            groups = {}
+            for j in order[:batch]:
+                hk, sk = keys[int(j)]
+                ph = key_hash_parts(hk, sk)
+                groups.setdefault(ph % 4, []).append(
+                    ("get", generate_key(hk, sk), ph))
+            compliant.point_read_multi(groups)
+            for r in range(iso_rounds):
+                if with_abuser:
+                    for i in range(3):
+                        # a FIXED 97-key abuser working set, disjoint
+                        # from the compliant keys and overwritten with
+                        # a constant value: the compliant digest stays
+                        # mode-independent and the store reaches an
+                        # overwrite fixed point instead of growing
+                        abuser.set(b"abk%04d" % ((r * 3 + i) % 97),
+                                   b"s", big)
+                groups = {}
+                for j in order[r * batch:(r + 1) * batch]:
+                    hk, sk = keys[int(j)]
+                    ph = key_hash_parts(hk, sk)
+                    groups.setdefault(ph % 4, []).append(
+                        ("get", generate_key(hk, sk), ph))
+                t0 = time.perf_counter()
+                res = compliant.point_read_multi(groups)
+                round_times.append(time.perf_counter() - t0)
+                for pidx in sorted(res):
+                    for st, val in res[pidx]:
+                        digest.update(b"%d" % st)
+                        digest.update(val)
+
+        # warm up WITH the abuser (populates its working set, settles
+        # flush debt), then compact to the steady state every measured
+        # pass starts from — without this, monotonic store growth makes
+        # later modes slower and the solo/abuse ratio measures drift
+        iso_pass(True, hashlib.sha256(), [])
+        for stub in cluster.stubs.values():
+            for r in stub.replicas.values():
+                r.server.engine.flush()
+                r.server.engine.manual_compact()
+        cluster.loop.run_until_idle()
+        # (mode, enforce, abuser present): the unprotected arm shows
+        # what the same abuse does with budget enforcement off
+        iso_modes = [("abuser_off", True, False),
+                     ("abuser_on", True, True),
+                     ("abuser_unprotected", False, True)]
+        iso_times = {name: [] for name, _e, _w in iso_modes}
+        iso_hashes = {name: hashlib.sha256() for name, _e, _w in
+                      iso_modes}
+        for _rep in range(reps):
+            for name, enforce, with_abuser in iso_modes:
+                # the unprotected arm charges CU without gating, so it
+                # leaves a bucket deficit no continuously-enforced
+                # system ever accrues (post-debit deficit is bounded
+                # by ONE op there) — restart the abuser's bucket so
+                # every arm starts from the same burst allowance
+                TENANTS.ensure("abuser", 1.0, 0.0)
+                TENANTS.ensure("abuser", 1.0, 200.0)
+                FLAGS.set("pegasus.qos", "tenant_enforce", enforce)
+                iso_pass(with_abuser, iso_hashes[name],
+                         iso_times[name])
+                # drain in-flight replication so one mode's leftovers
+                # never land inside the next mode's timed rounds
+                cluster.loop.run_until_idle()
+        FLAGS.set("pegasus.qos", "tenant_enforce", True)
+        snap = TENANTS.snapshot()
+        for name, _e, _w in iso_modes:
+            ts = iso_times[name]
+            out[name] = {
+                "compliant_p99_ms": round(pct(ts, 0.99) * 1000, 3),
+                "compliant_median_ms": round(pct(ts, 0.5) * 1000, 3),
+                "rounds": len(ts),
+            }
+        out["abuser_on"].update({
+            "abuser_overbudget": snap.get("abuser", {}).get(
+                "overbudget", 0),
+            "abuser_shed": snap.get("abuser", {}).get("shed", 0),
+            "abuser_cu_total": snap.get("abuser", {}).get("cu_total", 0),
+            "compliant_overbudget": snap.get("compliant", {}).get(
+                "overbudget", 0),
+        })
+        out["compliant_p99_ratio"] = round(
+            out["abuser_on"]["compliant_p99_ms"]
+            / out["abuser_off"]["compliant_p99_ms"], 3)
+        # enforcement's value under identical abuse (reported, not
+        # gated: a sequential sim understates unprotected queueing)
+        out["unprotected_median_ratio"] = round(
+            out["abuser_unprotected"]["compliant_median_ms"]
+            / out["abuser_on"]["compliant_median_ms"], 3)
+        out["identity_ok"] = len(
+            {h.hexdigest() for h in iso_hashes.values()}) == 1
+        out["gate_ok"] = bool(
+            out["admission_identity_ok"]
+            and out["admission_read_overhead"] <= 0.02
+            and out["admission_scan_overhead"] <= 0.02
+            and out["identity_ok"]
+            and out["compliant_p99_ratio"] <= 1.5
+            and out["abuser_on"]["abuser_overbudget"] > 0
+            and out["abuser_on"]["compliant_overbudget"] == 0)
+        return out
+    finally:
+        cluster.close()
+        shutil.rmtree(cdir, ignore_errors=True)
+        TENANTS.reset()
+
+
 def measure_follower_read(tmpdir, seed: int):
     """Follower-read capacity phase (round 17): the SAME batched
     point-get stream through a 3-replica SimCluster at linearizable
@@ -2784,6 +3082,7 @@ def main() -> None:
     do_health = os.environ.get("PEGBENCH_HEALTH", "1") != "0"
     do_perfctx = os.environ.get("PEGBENCH_PERFCTX", "1") != "0"
     do_follower = os.environ.get("PEGBENCH_FOLLOWER_READ", "1") != "0"
+    do_qos = os.environ.get("PEGBENCH_QOS", "1") != "0"
     do_mesh = os.environ.get("PEGBENCH_MESH", "1") != "0"
     do_mesh_compact = os.environ.get("PEGBENCH_MESH_COMPACT", "1") != "0"
 
@@ -3354,6 +3653,27 @@ def main() -> None:
                          f"{po['scan_overhead']:+.2%} vs hard-off "
                          f"(gate<=2%: {po['gate_ok']}, "
                          f"identical={po['identity_ok']})")
+
+                if do_qos:
+                    qi = measure_qos_isolation(tmpdir, seed)
+                    details["phases"]["qos_isolation"] = qi
+                    save_details()
+                    with open(os.path.join(here, "BENCH_r20.json"),
+                              "w") as f:
+                        json.dump({"phases": {"qos_isolation": qi},
+                                   "accel_platform": accel.platform},
+                                  f, indent=1)
+                    _log(f"qos_isolation: admission read "
+                         f"{qi['admission_read_overhead']:+.2%} / scan "
+                         f"{qi['admission_scan_overhead']:+.2%} "
+                         f"enforce-on vs off; compliant p99 "
+                         f"{qi['abuser_off']['compliant_p99_ms']}ms solo"
+                         f" -> {qi['abuser_on']['compliant_p99_ms']}ms "
+                         f"under abuse ({qi['compliant_p99_ratio']}x, "
+                         f"abuser overbudget="
+                         f"{qi['abuser_on']['abuser_overbudget']}, "
+                         f"identical={qi['identity_ok']}, "
+                         f"gate: {qi['gate_ok']})")
 
                 if do_follower:
                     fr = measure_follower_read(tmpdir, seed)

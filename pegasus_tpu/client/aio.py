@@ -18,6 +18,18 @@ import asyncio
 from concurrent.futures import ThreadPoolExecutor
 from typing import Optional, Sequence, Tuple
 
+# the async facade shares the sync client's retryability surface
+# VERBATIM — one definition, two exports, so the sync and async stacks
+# can never disagree on which typed errors retry or which retries are
+# forbidden from burning a config refresh (the tier-1 retryability
+# matrix test asserts this identity)
+from pegasus_tpu.client.cluster_client import (  # noqa: F401
+    DEFAULT_TENANT,
+    NO_REFRESH_CODES,
+    RETRYABLE_CODES,
+    sanitize_tenant,
+)
+
 
 class AsyncPegasusClient:
     """Wraps any sync client (PegasusClient or ClusterClient-backed).
@@ -39,13 +51,27 @@ class AsyncPegasusClient:
     )
 
     def __init__(self, client, max_workers: int = 1,
-                 op_timeout_ms: Optional[float] = None) -> None:
+                 op_timeout_ms: Optional[float] = None,
+                 tenant: Optional[str] = None) -> None:
         """`op_timeout_ms`: per-op end-to-end deadline override applied
         to the wrapped client (ClusterClient.op_timeout_ms); None keeps
-        the client_op_timeout_ms flag default."""
+        the client_op_timeout_ms flag default.
+
+        `tenant`: QoS identity override applied to the wrapped cluster
+        client (ClusterClient.tenant) — every op issued through this
+        facade is billed to it; None keeps the wrapped client's tag."""
         import threading
 
         self._c = client
+        if tenant is not None:
+            if not hasattr(client, "tenant"):
+                # mirror the op_timeout_ms guard: only the cluster
+                # client carries tenant identity on the wire
+                raise TypeError(
+                    f"{type(client).__name__} does not support "
+                    "tenant tags (a ClusterClient feature)")
+            self._c.tenant = sanitize_tenant(tenant)
+            self._c._tenant_explicit = True
         if op_timeout_ms is not None:
             if not hasattr(client, "op_timeout_ms"):
                 # only the cluster client enforces deadlines; silently

@@ -21,6 +21,7 @@ socket).
 from __future__ import annotations
 
 import itertools
+import re
 import time
 from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence, Tuple
 
@@ -80,11 +81,44 @@ _RETRYABLE = {
     # still RIGHT — the retry skips the config refresh and re-sends
     # only the bounced ops to the primary (misrouted-subset discipline)
     int(ErrorCode.ERR_STALE_REPLICA),
+    # multi-tenant QoS: this client's tenant is over its CU budget —
+    # the jittered backoff rides out the bucket refill; like BUSY, no
+    # config refresh (the routing table is right, the tenant is hot)
+    int(ErrorCode.ERR_CU_OVERBUDGET),
 }
 
 _OK = int(ErrorCode.ERR_OK)
 _MISROUTED = int(ErrorCode.ERR_PARENT_PARTITION_MISUSED)
 _STALE = int(ErrorCode.ERR_STALE_REPLICA)
+_OVERBUDGET = int(ErrorCode.ERR_CU_OVERBUDGET)
+
+# codes whose retry must NOT burn a config refresh: the routing table
+# is known-correct, the condition is server-side pressure. Re-resolving
+# would only convert a read/write storm into a meta query storm.
+_NO_REFRESH = {int(ErrorCode.ERR_BUSY), _STALE, _OVERBUDGET}
+
+# the public retryability surface: client/aio.py re-exports these so
+# the sync and async clients can never drift on which codes retry (the
+# tier-1 retryability matrix test asserts the identity)
+RETRYABLE_CODES = frozenset(_RETRYABLE)
+NO_REFRESH_CODES = frozenset(_NO_REFRESH)
+
+# tenant-tag sanitation mirrors server/tenancy.TENANT_RE — the tiny
+# regex is duplicated here rather than imported so the client package
+# never drags the server package (and its storage stack) in. Anything
+# that fails the slug check folds to the shared "default" tenant, the
+# same fold the server registry applies to unknown wire tags
+_TENANT_RE = re.compile(r"^[a-z0-9][a-z0-9_\-]{0,31}$")
+DEFAULT_TENANT = "default"
+
+
+def sanitize_tenant(raw) -> str:
+    """Fold an arbitrary tenant tag to a bounded-cardinality slug."""
+    if isinstance(raw, str):
+        name = raw.strip().lower()
+        if _TENANT_RE.match(name):
+            return name
+    return DEFAULT_TENANT
 
 
 def bounded_stale(max_lag_ms: float) -> dict:
@@ -124,7 +158,8 @@ class ClusterClient:
                  auth=None, op_timeout_ms: Optional[float] = None,
                  clock: Optional[Callable[[], float]] = None,
                  sleep: Optional[Callable[[float], None]] = None,
-                 backoff_seed: Optional[int] = None) -> None:
+                 backoff_seed: Optional[int] = None,
+                 tenant: Optional[str] = None) -> None:
         """`auth`: (user, token) credentials from
         security.make_credentials — required when the cluster enforces
         authentication.
@@ -135,7 +170,13 @@ class ClusterClient:
         `clock` must be the same timebase the serving stubs read (wall
         time.time for the TCP path — the default; the sim cluster
         passes its epoch-anchored virtual clock). `sleep` is the retry
-        backoff's wait (sim passes a virtual-time advance)."""
+        backoff's wait (sim passes a virtual-time advance).
+
+        `tenant`: the QoS identity every request from this handle is
+        billed to (weighted-fair admission + per-tenant CU budgets,
+        server/tenancy.py). When omitted, the table's
+        `qos.default_tenant` env (adopted at config refresh) names the
+        tenant; failing that, the shared "default" tenant."""
         from pegasus_tpu.utils.backoff import Backoff
 
         self.net = net
@@ -160,6 +201,12 @@ class ClusterClient:
         self.partition_count = 0
         self._configs: List[dict] = []
         self.auth = tuple(auth) if auth else None
+        # QoS identity: explicit ctor tag wins and sticks; otherwise
+        # the table's qos.default_tenant env (seen at refresh_config)
+        # may rebind the handle's tenant
+        self._tenant_explicit = tenant is not None
+        self.tenant = sanitize_tenant(tenant) if tenant is not None \
+            else DEFAULT_TENANT
         # per-op consistency default for THIS client handle: None =
         # linearizable (primary-only). Set to MONOTONIC or
         # bounded_stale(ms) to opt every read in; any read's
@@ -212,6 +259,10 @@ class ClusterClient:
                       deadline: Optional[float] = None) -> int:
         rid = next(self._rids)
         payload["rid"] = rid
+        # every request carries its tenant tag: the transport's
+        # weighted-fair admission and the server's CU budgets classify
+        # by this field (untagged traffic folds to "default" serverside)
+        payload["tenant"] = self.tenant
         if deadline is not None:
             # absolute, on the cluster's shared timebase: the transport
             # dispatcher and replica gates fast-fail work past it
@@ -294,6 +345,12 @@ class ClusterClient:
             self.app_id = reply["app_id"]
             self.partition_count = reply["partition_count"]
             self._configs = reply["configs"]
+            if not self._tenant_explicit:
+                # adopt the table's default tenant env; an explicit
+                # ctor tag always wins over the table-wide default
+                env = (reply.get("envs") or {}).get("qos.default_tenant")
+                if env:
+                    self.tenant = sanitize_tenant(env)
             return
         raise last
 
@@ -392,11 +449,11 @@ class ClusterClient:
                 # retries burn every attempt in microseconds and storm
                 # the meta with refresh_config
                 self.backoff.sleep(attempt)
-                if last_err in (int(ErrorCode.ERR_BUSY), _STALE):
-                    # shed by an overloaded replica (or bounced by a
-                    # stale secondary), not misrouted: the config is
-                    # still right — re-resolving would only convert the
-                    # read storm into a meta query storm
+                if last_err in _NO_REFRESH:
+                    # shed by an overloaded replica, bounced by a stale
+                    # secondary, or over CU budget — not misrouted: the
+                    # config is still right, so no refresh (see
+                    # _NO_REFRESH above)
                     pass
                 else:
                     try:
@@ -459,9 +516,9 @@ class ClusterClient:
                     raise PegasusError(ErrorCode.ERR_TIMEOUT,
                                        "write deadline exceeded")
                 self.backoff.sleep(attempt)
-                if last_err != int(ErrorCode.ERR_BUSY):
-                    # (BUSY = overload shed, config still right — see
-                    # _read; back off without re-resolving)
+                if last_err not in _NO_REFRESH:
+                    # (BUSY/over-budget = server pressure, config still
+                    # right — see _read; back off without re-resolving)
                     try:
                         self.refresh_config(deadline)
                     except PegasusError as e:

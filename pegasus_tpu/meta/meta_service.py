@@ -63,6 +63,10 @@ class MetaService:
         # config_sync report): `shell traces --slow` reads the whole
         # cluster's kept roots with ONE meta admin call
         self._trace_reports: Dict[str, dict] = {}
+        # latest per-tenant QoS snapshot per node (same channel): the
+        # shell's `tenants` verb and the collector's `_tenants` row
+        # read the cluster-folded view with ONE admin call
+        self._tenant_reports: Dict[str, dict] = {}
         # latest per-partition workload shape digest (rides the stored
         # entries of config_sync like the CU load signals): `shell
         # workload <table>` folds these per table with ONE admin call
@@ -254,12 +258,17 @@ class MetaService:
             try:
                 app_id, count, configs = self.query_config(
                     payload["app_name"])
+                app = self.state.find_app(payload["app_name"])
                 reply = {
                     "rid": rid, "err": int(ErrorCode.ERR_OK),
                     "app_id": app_id, "partition_count": count,
                     "configs": [{"ballot": pc.ballot, "primary": pc.primary,
                                  "secondaries": list(pc.secondaries)}
                                 for pc in configs],
+                    # table envs ride the config reply so clients can
+                    # adopt table-scoped defaults (qos.default_tenant)
+                    # without a second admin round-trip
+                    "envs": dict(app.envs) if app is not None else {},
                 }
             except PegasusError as e:
                 reply = {"rid": rid, "err": int(e.code), "app_id": 0,
@@ -477,6 +486,8 @@ class MetaService:
             elif cmd == "dup_stats":
                 result = self.duplication.dup_stats(
                     args.get("app_name", ""))
+            elif cmd == "tenant_stats":
+                result = self.tenant_stats()
             elif cmd == "dup_failover":
                 result = self.duplication.start_failover(
                     args["app_name"])
@@ -559,6 +570,9 @@ class MetaService:
         self._stored_reports[node] = list(payload.get("stored", []))
         if "trace_report" in payload:
             self._trace_reports[node] = payload["trace_report"]
+        if "tenants" in payload:
+            self._tenant_reports[node] = {"at": self.clock(),
+                                          "tenants": payload["tenants"]}
         # per-partition workload digests (primaries stamp them onto
         # their stored entries, exactly like the CU load signals);
         # digests of apps meta no longer knows AT ALL are pruned each
@@ -628,6 +642,38 @@ class MetaService:
         if health_ack is not None:
             reply["health_ack"] = health_ack
         self.net.send(self.name, src, "config_sync_reply", reply)
+
+    def tenant_stats(self) -> dict:
+        """Cluster-folded per-tenant QoS view from the config-sync
+        tenant blocks. Counters fold by MAX, not sum: in-process sim
+        stubs share ONE process-global registry, so every node reports
+        the identical snapshot and a sum would multiply by node count
+        (same dedupe rule as the collector's workload fold); deployed,
+        max reports the worst node — the honest aggregate for an SLO
+        check. The burn ratio keeps the worst node's value; brownout
+        is true if ANY node holds the gate (the aggressor is shed
+        wherever it lands)."""
+        tenants: Dict[str, dict] = {}
+        for node, rep in sorted(self._tenant_reports.items()):
+            for name, st in (rep.get("tenants") or {}).items():
+                agg = tenants.setdefault(name, {
+                    "weight": st.get("weight"),
+                    "cu_budget": st.get("cu_budget"),
+                    "cu_total": 0, "cu_ratio": 0.0,
+                    "shed": 0, "overbudget": 0,
+                    "browned": False, "nodes": 0})
+                agg["cu_total"] = max(agg["cu_total"],
+                                      int(st.get("cu_total") or 0))
+                agg["cu_ratio"] = max(agg["cu_ratio"],
+                                      float(st.get("cu_ratio") or 0.0))
+                agg["shed"] = max(agg["shed"],
+                                  int(st.get("shed") or 0))
+                agg["overbudget"] = max(agg["overbudget"],
+                                        int(st.get("overbudget") or 0))
+                agg["browned"] = agg["browned"] or bool(st.get("browned"))
+                agg["nodes"] += 1
+        return {"tenants": tenants,
+                "nodes_reporting": len(self._tenant_reports)}
 
     def workload_status(self, app_name: str = "") -> dict:
         """Per-table workload shape rollup from the config-sync

@@ -138,10 +138,15 @@ class WriteFlushWindow:
         context must travel per item, not per carrier message."""
         if not self.active or msg_type not in _AGGREGATED:
             return False
+        from pegasus_tpu.server.tenancy import current as current_tenant
         from pegasus_tpu.utils.tracing import current_ctx
 
+        # the ambient QoS tenant travels per item too (replica.client_
+        # write re-binds it around the deferred fan-out), so a receiving
+        # node's per-leg spans answer "whose write was this" even though
+        # the carrier coalesces many tenants' 2PC legs
         self._agg.setdefault((dst, msg_type), []).append(
-            (gpid, payload, current_ctx()))
+            (gpid, payload, current_ctx(), current_tenant()))
         return True
 
     # ---- flush ---------------------------------------------------------
@@ -186,7 +191,7 @@ class WriteFlushWindow:
             for (dst, kind), items in agg.items():
                 self._prepare_batch_size.set(len(items))
                 if len(items) == 1:
-                    gpid, payload, ctx = items[0]
+                    gpid, payload, ctx, _tenant = items[0]
                     self.net.send(self.node, dst, "replica", {
                         "gpid": gpid, "type": kind, "payload": payload,
                         "trace": ctx})

@@ -484,12 +484,21 @@ class Replica:
         if targets:
             self._pending_acks[decree] = set(targets)
 
+        # the requesting tenant (bound ambient by the stub's write
+        # handler): re-bound around the deferred prepare fan-out so the
+        # aggregated 2PC legs keep their tenant tag — the window flush
+        # runs them long after this call's binding unwound
+        from pegasus_tpu.server import tenancy
+
+        wtenant = tenancy.current()
+
         def _ship() -> None:
             # runs after the group-commit window hardened the plog (a
             # primary must not send prepares — or ack a zero-member
             # round — before its own log write is durable)
             tracer.add_point("plog_durable")
-            self._send_prepares(mu)
+            with tenancy.bind(wtenant):
+                self._send_prepares(mu)
             tracer.add_point("prepares_sent")
             if not targets:
                 # no members to wait on: ready now. (Never leave an

@@ -21,6 +21,8 @@ from pegasus_tpu.replica.replica import (
     ReplicaBusyError,
     ReplicaConfig,
 )
+from pegasus_tpu.server import tenancy
+from pegasus_tpu.server.tenancy import TENANTS
 from pegasus_tpu.utils.errors import StorageCorruptionError
 
 Gpid = Tuple[int, int]  # (app_id, partition_index)
@@ -94,6 +96,15 @@ class ReplicaStub:
         # FD timeline clock (sim time); defaults to the wall clock
         self.sim_clock = sim_clock or clock or (lambda: 0.0)
         self._start_clock = self.sim_clock()
+        if sim_clock is not None:
+            # the QoS governor's CU buckets must refill in VIRTUAL
+            # seconds under sim — a compressed schedule burns hours of
+            # virtual time in wall milliseconds, so wall-clocked
+            # buckets would never refill. Same timebase threading as
+            # scrub_tick/health_tick; the registry is process-global
+            # (like METRICS) and sim nodes share one loop, so the last
+            # node's clock is everyone's clock.
+            TENANTS.set_clock(self.sim_clock)
         self.replicas: Dict[Gpid, Replica] = {}
         # the meta group (parity: failure_detector_multimaster — workers
         # beacon the whole group; only the leader acts, followers forward)
@@ -634,6 +645,18 @@ class ReplicaStub:
             "health.events", health_events,
             "this node's health-event journal [limit [entity_id]]")
 
+        def qos_tenants(_args):
+            """Per-tenant QoS governor snapshot: weight, CU budget +
+            bucket level, consumed CU, shed/over-budget counts, and
+            whether the brownout gate is holding this tenant (shell
+            `tenants` + the collector's _tenants row read this)."""
+            return TENANTS.snapshot()
+
+        self.commands.register(
+            "qos.tenants", qos_tenants,
+            "per-tenant QoS snapshot: weights, CU budgets/levels, "
+            "shed + over-budget counts, brownout state")
+
     def close(self) -> None:
         # release outstanding capture pins: a node closing mid-incident
         # must not leave the process's trace/profiler settings raised
@@ -670,6 +693,12 @@ class ReplicaStub:
                         cluster_id=self.cluster_id)
             r.plog_sink = self.write_window
             r.write_metrics = self.write_metrics
+            if self.sim_clock is not None:
+                # range-read time budgets must burn VIRTUAL seconds
+                # under sim (read_limiter.py), same threading as
+                # scrub_tick/health_tick
+                sc = self.sim_clock
+                r.server.clock_ns = lambda: int(sc() * 1e9)
             r.on_learn_completed = (
                 lambda learner, g=gpid: self._notify_learn_completed(g, learner))
             r.on_replication_error = (
@@ -722,6 +751,11 @@ class ReplicaStub:
             return True
         if et == "task":
             return True  # profiler codes (process == node deployed)
+        if et == "tenant":
+            # QoS tenant series (server/tenancy.py) — process-global
+            # like the singletons above (same sim-sharing caveat);
+            # deployed, each node journals its own tenants' burn
+            return True
         if et in ("replica", "workload"):
             # per-partition entities share the replica id shape
             # (app.pidx): owned when this node hosts the partition
@@ -762,8 +796,17 @@ class ReplicaStub:
         from pegasus_tpu.server.workload import DRIFT
 
         DRIFT.refresh()
+        # publish each tenant's cu_ratio (consumption vs budget) so the
+        # recorder ring the tenant_brownout burn-rate rule reads is
+        # fresh at every evaluation
+        TENANTS.refresh()
         if self.recorder.tick() is not None:
-            self.health.evaluate()
+            for ev in self.health.evaluate():
+                if ev.rule == "tenant_brownout":
+                    # aggressor-only brownout: the rule fires per
+                    # TENANT entity, so only the outlier tenant's
+                    # reads start shedding — everyone else is served
+                    TENANTS.set_brownout(ev.entity[1], ev.firing)
 
     def _on_scrub_corruption(self, gpid: Gpid, exc: Exception) -> None:
         self._on_storage_error(gpid, exc)
@@ -938,6 +981,7 @@ class ReplicaStub:
             for entry in payload["items"]:
                 gpid, item = entry[0], entry[1]
                 ctx = entry[2] if len(entry) > 2 else None
+                leg_tenant = entry[3] if len(entry) > 3 else None
                 r = self.replicas.get(tuple(gpid))
                 if r is None:
                     continue
@@ -948,6 +992,8 @@ class ReplicaStub:
                     else:
                         span = tracing.start_server_span(
                             self.name, f"replica.{kind}", ctx)
+                        if span is not None and leg_tenant:
+                            span.tags["tenant"] = leg_tenant
                 try:
                     with tracing.activate(span):
                         r.on_message(src, kind, item)
@@ -1161,6 +1207,14 @@ class ReplicaStub:
                 "rid": rid, "err": int(ErrorCode.ERR_ACL_DENY),
                 "results": []})
             return
+        # CU budget gate (writes are NEVER brownout-shed — the mutation
+        # path degrades last — but an over-budget tenant's writes do
+        # bounce typed-retryable until refill pays the debt down)
+        over = TENANTS.admit(payload.get("tenant"), kind="write")
+        if over:
+            self.net.send(self.name, src, "client_write_reply", {
+                "rid": rid, "err": over, "results": []})
+            return
         if r is not None and getattr(r, "splitting", False):
             # write fence during the split's final catch-up (parity: the
             # reference fences the parent before the count flip)
@@ -1209,7 +1263,23 @@ class ReplicaStub:
                 "results": results})
 
         try:
-            r.client_write(ops, reply)
+            # ambient tenant around the 2PC submission: client_write
+            # captures it for the deferred prepare fan-out's span tags
+            with tenancy.bind(TENANTS.resolve(
+                    payload.get("tenant")).name):
+                r.client_write(ops, reply)
+            # bill the tenant ONCE, here at the accepting primary, with
+            # the same per-op math the apply path uses: apply runs at
+            # commit on EVERY member (no client tenant ambient there),
+            # so ambient attribution would miss it — and billing each
+            # member's apply would charge a tenant its replication
+            # factor
+            from pegasus_tpu.server.capacity_units import (
+                client_write_units,
+            )
+
+            TENANTS.charge(payload.get("tenant"),
+                           client_write_units(payload["ops"]))
         except ReplicaBusyError:
             # typed retryable overload: the client backs off WITHOUT a
             # config refresh (the routing is right, the queue is full)
@@ -1261,6 +1331,17 @@ class ReplicaStub:
                 "result": None})
             return
         from pegasus_tpu.utils import tracing
+
+        # CU budget gate, once for the carrier (one client = one
+        # tenant); accepted items bill the tenant per submitted run
+        # below. Writes stay exempt from brownout shedding.
+        over = TENANTS.admit(payload.get("tenant"), kind="write")
+        if over:
+            self.net.send(self.name, src, "client_write_reply", {
+                "rid": rid, "err": over, "result": None})
+            return
+        wtenant = TENANTS.resolve(payload.get("tenant")).name
+        from pegasus_tpu.server.capacity_units import client_write_units
 
         groups = payload.get("groups") or []
         slots: list = []
@@ -1319,7 +1400,13 @@ class ReplicaStub:
 
                 state["outstanding"] += 1
                 try:
-                    replica.client_write(ops_list, cb)
+                    with tenancy.bind(wtenant):
+                        replica.client_write(ops_list, cb)
+                    # accepted: bill the tenant at the primary with the
+                    # apply path's per-op math (same single-billing
+                    # rationale as the solo write handler)
+                    TENANTS.charge(wtenant, client_write_units(
+                        [(wo.op, wo.request) for wo in ops_list]))
                 except ReplicaBusyError:
                     state["outstanding"] -= 1
                     for i, _n in spans:
@@ -1422,16 +1509,23 @@ class ReplicaStub:
 
         served_by = ("primary" if r.status == PartitionStatus.PRIMARY
                      else "secondary")
+        tenant = TENANTS.resolve(payload.get("tenant")).name
         sp = tracing.current_span()
         if sp is not None:
             sp.tags["served_by"] = served_by
+            sp.tags["tenant"] = tenant
         # activate the op's cost vector HERE with served_by pre-set: the
         # storage handlers adopt the ambient context (perf.current()),
         # so explain/trace/slow-log all show which replica role answered
         pc = perf.start(f"read.{op}")
         if pc is not None:
             pc.served_by = served_by
+            pc.tenant = tenant
             perf.push(pc)
+        # bind the requesting tenant for the serving body: every CU the
+        # storage handlers bill below flows to this tenant's budget
+        _tb = tenancy.bind(tenant)
+        _tb.__enter__()
         try:
             if op == "get":
                 result = srv.on_get(args, partition_hash=ph)
@@ -1480,6 +1574,7 @@ class ReplicaStub:
                 "result": None})
             return
         finally:
+            _tb.__exit__(None, None, None)
             if pc is not None:
                 perf.pop(pc)
         # the committed-decree stamp is the monotonic session token: the
@@ -1510,6 +1605,21 @@ class ReplicaStub:
             # dispatcher shed returns, counted on the node's rpc entity
             self._node_read_shed.increment()
             return int(ErrorCode.ERR_BUSY), None
+        tenant = payload.get("tenant")
+        if TENANTS.browned(tenant):
+            # aggressor-only brownout: the health engine flagged THIS
+            # tenant's burn rate as the outlier, so only its reads shed
+            # (typed ERR_BUSY — the client backs off without a config
+            # refresh); every other tenant keeps being served
+            self._node_read_shed.increment()
+            TENANTS.note_shed(tenant)
+            return int(ErrorCode.ERR_BUSY), None
+        over = TENANTS.admit(tenant, kind="read")
+        if over:
+            # over CU budget: typed retryable ERR_CU_OVERBUDGET — the
+            # client jitter-backs-off and re-sends without refreshing
+            # its config (the routing table is right; the budget isn't)
+            return over, None
         gpid = tuple(payload["gpid"])
         r = self.replicas.get(gpid)
         if not self._client_allowed(r, payload, access="r", src=src):
@@ -1629,17 +1739,26 @@ class ReplicaStub:
                 span.tags["served_by"] = (
                     "primary" if r.status == PartitionStatus.PRIMARY
                     else "secondary")
+                span.tags["tenant"] = TENANTS.resolve(
+                    payload.get("tenant")).name
             flush.append((src, payload, r, span))
         if not flush:
             return
+        # group by (server, tenant): the transport's flush window
+        # coalesces MANY clients' reads, so one batch may mix tenants —
+        # splitting the groups keeps each finish pass (where the CU
+        # funnel fires) billed to exactly the tenant that asked
         groups: dict = {}
-        for i, (_src, _payload, rep, _sp) in enumerate(flush):
-            groups.setdefault(id(rep.server), (rep.server, []))[1].append(i)
+        for i, (_src, payload_i, rep, _sp) in enumerate(flush):
+            tname = TENANTS.resolve(payload_i.get("tenant")).name
+            groups.setdefault((id(rep.server), tname),
+                              (rep.server, tname, []))[2].append(i)
         pairs = [(server, [(flush[i][1].get("op", "get"),
                             flush[i][1].get("args"),
                             flush[i][1].get("partition_hash"))
                            for i in idxs])
-                 for server, idxs in groups.values()]
+                 for server, _tname, idxs in groups.values()]
+        tenants = [tname for _server, tname, _idxs in groups.values()]
         # NO flush-wide deadline here: members carry INDEPENDENT
         # deadlines (already gate-checked above, microseconds ago), and
         # bounding the flush by the tightest one would let a single
@@ -1648,7 +1767,7 @@ class ReplicaStub:
         # because there one deadline really does govern the whole batch.
         try:
             try:
-                results = point_read_multi(pairs)
+                results = point_read_multi(pairs, tenants=tenants)
             except (ValueError, RuntimeError, OSError):
                 # malformed op in the flush — or a corrupt block /
                 # failing disk under ONE member: re-serve each solo so
@@ -1659,7 +1778,8 @@ class ReplicaStub:
                     with tracing.activate(span):
                         self._on_client_read(src, payload)
                 return
-            for (_server, idxs), res in zip(groups.values(), results):
+            for (_server, _tname, idxs), res in zip(groups.values(),
+                                                    results):
                 for i, result in zip(idxs, res):
                     src, payload, rep, span = flush[i]
                     # the reply rides this op's span context (tail-keep
@@ -1723,6 +1843,7 @@ class ReplicaStub:
             err, r = self._client_read_gate(
                 {"gpid": gpid, "auth": payload.get("auth"),
                  "deadline": payload.get("deadline"),
+                 "tenant": payload.get("tenant"),
                  "consistency": slot_cons}, src)
             if err is not None:
                 slots.append((gpid[1], err, None))
@@ -1737,9 +1858,14 @@ class ReplicaStub:
         # carrier yield N child spans, never N carriers
         from pegasus_tpu.utils import tracing
 
+        # one carrier = one client = ONE tenant: bind it ambient around
+        # the whole coordinator call so every partition's finish pass
+        # bills this tenant's budget
+        tname = TENANTS.resolve(payload.get("tenant")).name
         carrier = tracing.current_span()
         op_spans: list = []
         if carrier is not None:
+            carrier.tags["tenant"] = tname
             for _slot_i, rep, ops in ok:
                 role = ("primary" if rep.status == PartitionStatus.PRIMARY
                         else "secondary")
@@ -1747,13 +1873,15 @@ class ReplicaStub:
                     osp = tracing.child_of(
                         carrier, f"op.{o[0]}.{rep.server.pidx}")
                     osp.tags["served_by"] = role
+                    osp.tags["tenant"] = tname
                     op_spans.append(osp)
         if ok:
             try:
-                results = point_read_multi(
-                    [(rep.server, [tuple(o) for o in ops])
-                     for _i, rep, ops in ok],
-                    deadline=payload.get("deadline"), clock=self.clock)
+                with tenancy.bind(tname):
+                    results = point_read_multi(
+                        [(rep.server, [tuple(o) for o in ops])
+                         for _i, rep, ops in ok],
+                        deadline=payload.get("deadline"), clock=self.clock)
             except PegasusError:
                 # the batch's deadline lapsed mid-flush: typed timeout
                 # for every slot this node accepted
@@ -1849,6 +1977,10 @@ class ReplicaStub:
                 # meta always sends the table's complete env map, so
                 # absent keys are deletions to un-apply
                 r.server.update_app_envs(payload["envs"], full_set=True)
+        # tenant declarations ride table envs too (``qos.tenants``), so
+        # `shell set_app_envs` re-shapes weights/budgets online without
+        # a restart — the registry ignores envs without the key
+        TENANTS.configure_from_envs(payload.get("envs") or {})
 
     # ---- meta-driven backup / restore (parity: the replica-side cold
     # backup flow, replica/replica_backup.cpp, and restore,
@@ -2030,6 +2162,17 @@ class ReplicaStub:
                         int(min_decrees.get(gpid[1], 0))))
                 gerr = self._follower_gate(
                     r, {"consistency": slot_cons})
+            if gerr is None:
+                # same tenant gates as the point-read path: brownout
+                # sheds only the flagged aggressor, the CU budget
+                # bounces over-budget scans typed-retryable
+                tn = payload.get("tenant")
+                if TENANTS.browned(tn):
+                    self._node_read_shed.increment()
+                    TENANTS.note_shed(tn)
+                    gerr = int(ErrorCode.ERR_BUSY)
+                else:
+                    gerr = TENANTS.admit(tn, kind="read") or None
             if gerr is not None:
                 errs = []
                 for _req in reqs:
@@ -2047,9 +2190,15 @@ class ReplicaStub:
             from pegasus_tpu.base.value_schema import epoch_now
 
             now = epoch_now()
+            # one carrier = one client = one tenant: the whole stacked
+            # evaluation (finish_scan_batch bills the CU there) runs
+            # under the requesting tenant's ambient binding
+            tname = TENANTS.resolve(payload.get("tenant")).name
             try:
-                results = scan_multi(
-                    [(srv, reqs) for _i, srv, reqs in ok_servers], now)
+                with tenancy.bind(tname):
+                    results = scan_multi(
+                        [(srv, reqs) for _i, srv, reqs in ok_servers],
+                        now)
             except (StorageCorruptionError, OSError) as e:
                 # one member's store is corrupt (a scan-path block or
                 # encoded-probe crc failed): its slot gets the typed
@@ -2514,12 +2663,17 @@ class ReplicaStub:
         # drained ONCE, outside the target loop (every meta-group member
         # gets the identical block; only the leader acts)
         health_report = self.health.drain_report()
+        # per-tenant QoS stats ride the same report so meta (and the
+        # collector's cluster view) can fold tenant burn across nodes
+        # without a fan-out
+        tenant_report = TENANTS.snapshot()
         for meta in self._meta_targets():
             self.net.send(self.name, meta, "config_sync", {
                 "node": self.name, "stored": stored,
                 "pressure": pressure, "compaction": compaction,
                 "dup": dup_report,
                 "health": health_report,
+                "tenants": tenant_report,
                 # NB: key must not be "trace" — that's the wire slot
                 # for the distributed-tracing context
                 "trace_report": trace_report})

@@ -30,6 +30,7 @@ import queue
 import socket
 import threading
 import time
+from collections import deque
 from typing import Any, Callable, Dict, Optional, Tuple
 
 from pegasus_tpu.rpc.message import decode_message, encode_message, read_frames
@@ -137,6 +138,22 @@ class TcpTransport:
         self._peer_outboxes: Dict[str, "queue.Queue[Optional[bytes]]"] = {}
         self._outboxes_lock = threading.Lock()
         self._closing = False
+        # weighted-fair admission (dispatch thread ONLY — no locking):
+        # shed-eligible client requests are re-queued per tenant and
+        # drained by deficit-weighted round-robin, so one hot tenant's
+        # backlog cannot head-of-line block everyone else's reads.
+        # Writes/replication/meta take the strict-priority system queue
+        # (the mutation path degrades last, exactly the old shed
+        # exemption — and system traffic was never fair-queue fodder).
+        self._tenant_queues: Dict[str, deque] = {}
+        self._tenant_rr: list = []  # registration-ordered rotation
+        self._rr_i = 0
+        self._rr_fresh = True  # next rotation stop earns its quantum
+        self._deficits: Dict[str, float] = {}
+        self._system_queue: deque = deque()
+        self._last_tenant: Optional[str] = None  # set by _sched_get
+        self._last_queue: Optional[deque] = None
+        self._tenancy = None  # lazily bound server/tenancy registry
         # chaos hook (rpc/fault.py): None = zero-overhead hot path; an
         # installed plan only acts while FAIL_POINTS is enabled
         self.fault_plan = None
@@ -437,6 +454,103 @@ class TcpTransport:
             except Exception:  # noqa: BLE001 - observer must not kill IO
                 pass
 
+    # ---- weighted-fair admission (dispatch thread only) ----------------
+
+    def _registry(self):
+        """The process-global tenant registry, bound lazily: importing
+        server/tenancy at module scope would drag the server package
+        into every transport user (and risk an import cycle through
+        server/__init__); at first dispatch everything is loaded."""
+        if self._tenancy is None:
+            from pegasus_tpu.server.tenancy import TENANTS
+
+            self._tenancy = TENANTS
+        return self._tenancy
+
+    def _classify(self, item: Optional[tuple]) -> None:
+        """File one inbox item into the fair-queue structure.
+        Shed-eligible client work (non-write _CLIENT_REQS) queues per
+        tenant — the tag resolves through the bounded registry, so
+        unknown/forged tags fold into the default queue instead of
+        minting queues; everything else (writes, replication, meta,
+        the shutdown sentinel) takes the strict-priority system queue."""
+        if item is None:
+            self._system_queue.append(item)
+            return
+        msg_type, payload = item[3], item[4]
+        if (msg_type in _CLIENT_REQS and msg_type not in WRITE_REQS
+                and isinstance(payload, dict)):
+            tenant = self._registry().resolve(payload.get("tenant")).name
+            q = self._tenant_queues.get(tenant)
+            if q is None:
+                q = self._tenant_queues[tenant] = deque()
+                self._tenant_rr.append(tenant)
+                self._deficits.setdefault(tenant, 0.0)
+            q.append(item)
+        else:
+            self._system_queue.append(item)
+
+    def _queued_depth(self) -> int:
+        return len(self._system_queue) + sum(
+            len(q) for q in self._tenant_queues.values())
+
+    def _drr_pick(self) -> tuple:
+        """Deficit-weighted round-robin over the non-empty tenant
+        queues (caller guarantees at least one). Each rotation stop
+        earns the tenant ONE quantum (its clamped weight in message
+        units); it then serves until the deficit runs dry, so relative
+        drain rates converge on the weight ratios while every tenant
+        keeps making progress. An observed-empty queue forfeits its
+        banked credit — idle tenants cannot hoard a burst allowance."""
+        reg = self._registry()
+        rr = self._tenant_rr
+        while True:
+            name = rr[self._rr_i % len(rr)]
+            q = self._tenant_queues[name]
+            if not q:
+                self._deficits[name] = 0.0
+                self._rr_i += 1
+                self._rr_fresh = True
+                continue
+            if self._rr_fresh:
+                self._deficits[name] += reg.weight(name)
+                self._rr_fresh = False
+            if self._deficits[name] >= 1.0:
+                self._deficits[name] -= 1.0
+                self._last_tenant = name
+                self._last_queue = q
+                return q.popleft()
+            # quantum spent: the next stop (possibly this same queue,
+            # next rotation) earns a fresh one. min_weight > 0 bounds
+            # the rotations before SOME queue accrues a full unit.
+            self._rr_i += 1
+            self._rr_fresh = True
+
+    def _sched_get(self) -> Optional[tuple]:
+        """The dispatcher's next item: drain whatever the reader
+        threads queued, then serve system work first and tenant work
+        by DRR. Blocks on the raw inbox only when everything is empty
+        (single consumer, so emptiness cannot race)."""
+        while True:
+            try:
+                self._classify(self._inbox.get_nowait())
+            except queue.Empty:
+                break
+        while True:
+            if self._system_queue:
+                self._last_tenant = None
+                self._last_queue = self._system_queue
+                return self._system_queue.popleft()
+            if self._tenant_queues and any(
+                    self._tenant_queues.values()):
+                return self._drr_pick()
+            self._classify(self._inbox.get())
+            while True:
+                try:
+                    self._classify(self._inbox.get_nowait())
+                except queue.Empty:
+                    break
+
     def _dispatch_loop(self) -> None:
         from pegasus_tpu.utils.errors import ErrorCode
         from pegasus_tpu.utils.metrics import METRICS
@@ -451,12 +565,8 @@ class TcpTransport:
         shed_cnt = prof.counter("read_shed_count")
         lat: Dict[str, Any] = {}
         cnt: Dict[str, Any] = {}
-        carry: Optional[tuple] = None
         while True:
-            if carry is not None:
-                item, carry = carry, None
-            else:
-                item = self._inbox.get()
+            item = self._sched_get()
             if item is None:
                 return
             t_enq, src, dst, msg_type, payload, sess = item
@@ -489,41 +599,53 @@ class TcpTransport:
                 # replication traffic are exempt — availability of the
                 # mutation path degrades last.
                 if msg_type not in WRITE_REQS:
-                    depth = self._inbox.qsize()
+                    depth = self._inbox.qsize() + self._queued_depth()
                     age_ms = (time.perf_counter() - t_enq) * 1000.0
+                    tname = self._last_tenant
+                    if tname is not None:
+                        # per-tenant queueing-delay series: the signal
+                        # `shell tenants` (and the QoS isolation gate)
+                        # read to prove a victim stayed fast
+                        self._registry().note_queue_age(tname, age_ms)
                     if (depth > FLAGS.get("pegasus.rpc",
                                           "read_shed_queue_depth")
                             or age_ms > FLAGS.get(
                                 "pegasus.rpc", "read_shed_queue_age_ms")):
                         shed_cnt.increment()
+                        if tname is not None:
+                            # DRR already drained the victims first, so
+                            # whoever queued deep enough to shed IS the
+                            # aggressor — bill the shed to its tenant
+                            self._registry().note_shed(tname)
                         self.send(dst, src, env[0], {
                             "rid": payload.get("rid"),
                             "err": int(ErrorCode.ERR_BUSY),
                             env[1]: env[2]})
                         continue
             batch = None
-            shutdown = False
             bh = self._batch_handlers.get((dst, msg_type))
             if bh is not None:
                 # flush-window coalescing: drain the CONSECUTIVE run of
                 # same-typed queued messages from the SAME connection
                 # into one delivery (the read coordinator's dispatch
                 # unit; session-scoped so negotiated identities keep
-                # binding to the right connection). Stopping at the
-                # first non-matching message preserves ordering exactly.
+                # binding to the right connection). The run comes off
+                # the SAME scheduler queue the head item came from —
+                # for a tenant queue that means one tenant's burst
+                # coalesces, and fairness holds because every extra
+                # item bills the tenant's deficit (it may go negative;
+                # the debt is repaid before the next quantum serves).
+                srcq = self._last_queue
+                tname = self._last_tenant
                 batch = [(src, payload)]
-                while len(batch) < self.BATCH_DRAIN_MAX:
-                    try:
-                        nxt = self._inbox.get_nowait()
-                    except queue.Empty:
+                while srcq and len(batch) < self.BATCH_DRAIN_MAX:
+                    nxt = srcq[0]
+                    if (nxt is None or nxt[2] != dst
+                            or nxt[3] != msg_type or nxt[5] != sess):
                         break
-                    if nxt is None:
-                        shutdown = True  # serve the batch, then exit
-                        break
-                    if (nxt[2] != dst or nxt[3] != msg_type
-                            or nxt[5] != sess):
-                        carry = nxt
-                        break
+                    srcq.popleft()
+                    if tname is not None:
+                        self._deficits[tname] -= 1.0
                     batch.append((nxt[1], nxt[4]))
             # distributed-tracing join point: an inbound request
             # carrying a sampled context opens a dispatch span (replies
@@ -576,5 +698,3 @@ class TcpTransport:
                     # per task code (profiler.cpp:90-198)
                     PROFILER.observe(msg_type, (t0 - t_enq) * 1000.0,
                                      (t1 - t0) * 1000.0)
-            if shutdown:
-                return

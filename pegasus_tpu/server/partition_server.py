@@ -236,6 +236,12 @@ class PartitionServer:
             "replica", f"{app_id}.{pidx}",
             {"table": str(app_id), "partition": str(pidx)})
         self.cu = CapacityUnitCalculator(self.metrics)
+        # nanosecond time source for range-read time budgets: None =
+        # wall perf_counter_ns; sim-hosted partitions get the virtual
+        # clock threaded in by the stub (the scrub_tick/health_tick
+        # discipline) so compressed schedules can't spuriously trip —
+        # or never trip — rocksdb_iteration_threshold_time_ms
+        self.clock_ns = None
         self._abnormal_reads = self.metrics.counter("abnormal_read_count")
         # filter/row-cache observability, per partition (the node-wide
         # twins live on the "storage" entity): incremented BATCHED, once
@@ -2025,7 +2031,7 @@ class PartitionServer:
             resp.error = int(StorageStatus.OK)
             return resp
 
-        limiter = RangeReadLimiter()
+        limiter = RangeReadLimiter(clock_ns=self.clock_ns)
         records, exhausted, resume_key = self._batched_scan(
             start_key, stop_key or None, now,
             FilterSpec.none(),
@@ -2064,7 +2070,7 @@ class PartitionServer:
         now = epoch_now()
         start_key = generate_key(hash_key, b"")
         stop_key = generate_next_bytes(hash_key)
-        limiter = RangeReadLimiter()
+        limiter = RangeReadLimiter(clock_ns=self.clock_ns)
         records, exhausted, _ = self._batched_scan(
             start_key, stop_key or None, now, FilterSpec.none(),
             FilterSpec.none(), validate_hash=False, limiter=limiter,
@@ -2207,7 +2213,7 @@ class PartitionServer:
         pd_stats: dict = {}
         now = epoch_now()
         resp = ScanResponse()
-        limiter = RangeReadLimiter()
+        limiter = RangeReadLimiter(clock_ns=self.clock_ns)
         batch_size = min(req.batch_size if req.batch_size > 0 else 1000,
                          SCAN_BATCH_CAP)
         if req.only_return_count:
@@ -2289,7 +2295,7 @@ class PartitionServer:
         sum/top_k/sample gather straight from the raw value heap."""
         now = epoch_now()
         resp = ScanResponse()
-        limiter = RangeReadLimiter()
+        limiter = RangeReadLimiter(clock_ns=self.clock_ns)
         vf = pd.value_filter
         pd_stats: dict = {}
         state = (agg_state if agg_state is not None

@@ -67,7 +67,8 @@ def is_point_read(op: str, args) -> bool:
 
 
 def point_read_multi(servers_and_ops: List[Tuple[object, list]],
-                     now=None, deadline=None, clock=None) -> List[list]:
+                     now=None, deadline=None, clock=None,
+                     tenants=None) -> List[list]:
     """[(PartitionServer, [(op, args, partition_hash)])] -> [[result]].
 
     Results are byte-identical to the solo handlers (on_get / on_ttl /
@@ -80,6 +81,13 @@ def point_read_multi(servers_and_ops: List[Tuple[object, list]],
     again before the cross-partition gather — the two places a large
     flush spends real time — raising ERR_TIMEOUT instead of finishing
     work every requester already abandoned.
+
+    `tenants`: optional per-pair QoS tenant names aligned with
+    `servers_and_ops`. The finish pass (where the CU funnel fires) runs
+    under that pair's ambient tenant, so a transport flush coalescing
+    several tenants' reads still bills each tenant its own capacity
+    units; None (or a None slot) leaves attribution to whatever tenant
+    the caller already bound.
     """
     from pegasus_tpu.base.value_schema import epoch_now, header_length
     from pegasus_tpu.server.page import build_page
@@ -127,8 +135,13 @@ def point_read_multi(servers_and_ops: List[Tuple[object, list]],
     annotate("coord_gather")
 
     out = []
-    for server, state in states:
+    if tenants is None:
+        tenants = [None] * len(states)
+    from pegasus_tpu.server import tenancy
+
+    for (server, state), tenant in zip(states, tenants):
         page, base = state.pop("_page", (None, 0))
-        out.append(server.finish_get_batch(state, page, base))
+        with tenancy.bind(tenant):
+            out.append(server.finish_get_batch(state, page, base))
     annotate("coord_finish")
     return out

@@ -22,7 +22,14 @@ define_flag("pegasus.server", "rocksdb_iteration_threshold_time_ms", 30_000,
 
 class RangeReadLimiter:
     def __init__(self, max_iteration_count: int | None = None,
-                 threshold_time_ms: int | None = None) -> None:
+                 threshold_time_ms: int | None = None,
+                 clock_ns=None) -> None:
+        """`clock_ns`: nanosecond time source (default wall
+        perf_counter_ns). Sim-hosted partitions thread their virtual
+        clock here — the same threading scrub_tick/health_tick use —
+        because a compressed sim schedule burns thousands of virtual
+        seconds in milliseconds of wall (and vice versa a wall-stalled
+        sim host could trip the budget with zero virtual time spent)."""
         self._max_count = (FLAGS.get("pegasus.server",
                                      "rocksdb_max_iteration_count")
                            if max_iteration_count is None
@@ -30,8 +37,10 @@ class RangeReadLimiter:
         self._threshold_ns = 1_000_000 * (
             FLAGS.get("pegasus.server", "rocksdb_iteration_threshold_time_ms")
             if threshold_time_ms is None else threshold_time_ms)
+        self._clock_ns = (clock_ns if clock_ns is not None
+                          else time.perf_counter_ns)
         self._count = 0
-        self._start_ns = time.perf_counter_ns()
+        self._start_ns = self._clock_ns()
 
     def add_count(self, n: int = 1) -> None:
         self._count += n
@@ -45,7 +54,7 @@ class RangeReadLimiter:
 
     def time_exceeded(self) -> bool:
         return (self._threshold_ns > 0 and
-                time.perf_counter_ns() - self._start_ns > self._threshold_ns)
+                self._clock_ns() - self._start_ns > self._threshold_ns)
 
     def valid(self) -> bool:
         return not self.count_exceeded() and not self.time_exceeded()

@@ -29,7 +29,8 @@ _ROUND_RE = re.compile(r"^BENCH_r(\d+)\.json$")
 # headline-metric preference per phase key suffix: first present+numeric
 # wins. Ordered most-specific first; "qps"-ish generic keys last.
 _HEADLINE_PREFS = (
-    "aggregate_read_qps", "phash_qps", "filtered_qps", "row_cache_qps",
+    "aggregate_read_qps", "compliant_p99_ratio",
+    "phash_qps", "filtered_qps", "row_cache_qps",
     "accel_qps", "read_qps", "write_qps", "qps", "records_per_s",
     "accel_records_per_s", "effective_gbps", "mesh_speedup",
     "pushdown_speedup", "filter_speedup", "speedup", "ratio",

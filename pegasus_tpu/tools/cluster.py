@@ -192,7 +192,8 @@ class SimCluster:
         return app_id
 
     def client(self, app_name: str, name: Optional[str] = None,
-               user: str = "admin") -> ClusterClient:
+               user: str = "admin",
+               tenant: Optional[str] = None) -> ClusterClient:
         auth = None
         if self.auth_secret:
             from pegasus_tpu.security.auth import make_credentials
@@ -217,7 +218,8 @@ class SimCluster:
                           app_name, pump=self.pump, auth=auth,
                           clock=lambda: self._epoch + self.loop.now,
                           sleep=lambda s: self.loop.run_for(s),
-                          backoff_seed=zlib.crc32(cname.encode()))
+                          backoff_seed=zlib.crc32(cname.encode()),
+                          tenant=tenant)
         return c
 
     def primaries(self, app_id: int) -> List[str]:

@@ -121,6 +121,7 @@ class InfoCollector:
         health_rows = self.collect_health()
         alert_rows = self.collect_alerts()
         workload_rows = self.collect_workload()
+        tenant_rows = self.collect_tenants()
         if per_table:
             if self._stat_client is None:
                 self._stat_client = self.client_factory(STAT_TABLE)
@@ -147,7 +148,43 @@ class InfoCollector:
                 self._stat_client.set(
                     b"_workload", ts,
                     json.dumps(workload_rows).encode())
+            if tenant_rows:
+                self._stat_client.set(
+                    b"_tenants", ts,
+                    json.dumps(tenant_rows).encode())
         return per_table
+
+    def collect_tenants(self) -> Dict[str, dict]:
+        """Per-tenant QoS rows off every node's `qos.tenants` verb,
+        folded cluster-wide: counters sum, the burn ratio keeps the
+        worst node's value, brownout is true if ANY node holds the
+        gate — one `_tenants` stat row per round, so a soak can assert
+        'the compliant tenant was never shed' from table history."""
+        out: Dict[str, dict] = {}
+        for node in self.nodes:
+            snap = self._command(node, "qos.tenants")
+            if not snap:
+                continue
+            for name, st in snap.items():
+                agg = out.setdefault(name, {
+                    "weight": st.get("weight"),
+                    "cu_budget": st.get("cu_budget"),
+                    "cu_total": 0, "cu_ratio": 0.0,
+                    "shed": 0, "overbudget": 0, "browned": False})
+                # in-process sims share ONE registry across stubs, so
+                # identical snapshots repeat per node: max (not sum)
+                # keeps the fold honest in both deployments for the
+                # monotonic counters too
+                agg["cu_total"] = max(agg["cu_total"],
+                                      int(st.get("cu_total") or 0))
+                agg["cu_ratio"] = max(agg["cu_ratio"],
+                                      float(st.get("cu_ratio") or 0.0))
+                agg["shed"] = max(agg["shed"], int(st.get("shed") or 0))
+                agg["overbudget"] = max(agg["overbudget"],
+                                        int(st.get("overbudget") or 0))
+                agg["browned"] = (agg["browned"]
+                                  or bool(st.get("browned")))
+        return out
 
     def collect_workload(self) -> Dict[str, dict]:
         """Per-table workload shape rows off the nodes' `workload`

@@ -51,6 +51,16 @@ _KIND = {"counter": "counter", "relaxed_counter": "counter",
          "volatile_counter": "counter", "gauge": "gauge",
          "percentile": "percentile"}
 
+# tenant-labeled metric entities: the per-tenant series are BOUNDED
+# (server/tenancy.py caps the registry at MAX_TENANTS and folds
+# unknown wire tags into "default"). Any other call site minting an
+# .entity("tenant", ...) bypasses that bound — a raw request-supplied
+# string there is an unbounded-cardinality leak into the metric
+# registry and every scrape — so the linter fails it.
+_TENANT_ENTITY_RE = re.compile(
+    r"\.entity\(\s*(?:\n\s*)?([\"'])tenant\1")
+_TENANT_ENTITY_HOME = os.path.join("server", "tenancy.py")
+
 
 def scan_file(path: str) -> List[Tuple[str, str, int]]:
     """(metric_name, kind, line_number) registrations in one file."""
@@ -83,9 +93,41 @@ def scan_tree(root: str = _PKG_ROOT) -> Dict[str, Dict[str, List[str]]]:
     return found
 
 
+def scan_tenant_entities(root: str = _PKG_ROOT) -> List[str]:
+    """\"path:line\" sites minting a tenant-labeled metric entity
+    OUTSIDE server/tenancy.py (the bounded registry's home)."""
+    sites: List[str] = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames
+                       if d not in ("__pycache__", ".git")]
+        for fn in sorted(filenames):
+            if not fn.endswith(".py") or fn == "metrics_lint.py":
+                continue
+            path = os.path.join(dirpath, fn)
+            rel = os.path.relpath(path, root)
+            if rel == _TENANT_ENTITY_HOME:
+                continue
+            with open(path, encoding="utf-8") as f:
+                text = f.read()
+            for m in _TENANT_ENTITY_RE.finditer(text):
+                line = text.count("\n", 0, m.start()) + 1
+                sites.append(f"{rel}:{line}")
+    return sites
+
+
+def lint_tenant_entities(sites: List[str]) -> List[str]:
+    return [
+        f"tenant metric entity minted outside server/tenancy.py at "
+        f"{site} — per-tenant series must come from the bounded "
+        f"registry (MAX_TENANTS cap + unknown-tag folding), or a raw "
+        f"wire tag becomes unbounded metric cardinality"
+        for site in sites]
+
+
 def lint(root: str = _PKG_ROOT) -> List[str]:
     """Problems found (empty = clean)."""
-    return lint_scan(scan_tree(root))
+    return (lint_scan(scan_tree(root))
+            + lint_tenant_entities(scan_tenant_entities(root)))
 
 
 def lint_scan(found: Dict[str, Dict[str, List[str]]]) -> List[str]:
@@ -124,7 +166,8 @@ def main(argv=None) -> int:
     args = list(argv if argv is not None else sys.argv[1:])
     root = args[0] if args else _PKG_ROOT
     found = scan_tree(root)  # ONE walk: lint + the status counts
-    problems = lint_scan(found)
+    problems = (lint_scan(found)
+                + lint_tenant_entities(scan_tenant_entities(root)))
     if problems:
         for p in problems:
             print(f"metrics-lint: {p}")

@@ -290,10 +290,13 @@ class OneboxAdmin:
 
 def connect(app_name: str, directory: str = DEFAULT_DIR,
             client_name: Optional[str] = None, user: str = "admin",
-            op_timeout_ms: Optional[float] = None):
+            op_timeout_ms: Optional[float] = None,
+            tenant: Optional[str] = None):
     """Wire data client for a onebox table. `op_timeout_ms` bounds each
     op end-to-end (all retries included); None keeps the
-    client_op_timeout_ms flag default."""
+    client_op_timeout_ms flag default. `tenant` tags every request for
+    server-side QoS accounting (None adopts the table's
+    qos.default_tenant env, if any)."""
     from pegasus_tpu.client.cluster_client import ClusterClient
     from pegasus_tpu.rpc.transport import TcpTransport
 
@@ -311,7 +314,7 @@ def connect(app_name: str, directory: str = DEFAULT_DIR,
     return ClusterClient(
         net, client_name or f"client-{os.getpid()}", metas, app_name,
         pump=lambda: time.sleep(0.01), max_retries=8, pump_rounds=400,
-        auth=auth, op_timeout_ms=op_timeout_ms)
+        auth=auth, op_timeout_ms=op_timeout_ms, tenant=tenant)
 
 
 def main() -> None:

@@ -143,19 +143,31 @@ def run_scale_test(directory: str, n_tenants: int = 4,
         # ---- topology: tenant tables, premium first ------------------
         _wait_cluster(admin, n_replica)
         tenants: List[TenantWorkload] = []
+        # server-side tenant QoS topology: premium half gets weight 4
+        # and an effectively-unmetered CU budget; background half gets
+        # weight 1 and a tight CU rate, so the weighted-fair dispatcher
+        # and the CU buckets both have something to arbitrate
+        qos_decl = ",".join(
+            f"tenant{t}:4:1000000" if t < n_tenants // 2
+            else f"tenant{t}:1:4000"
+            for t in range(n_tenants))
         for t in range(n_tenants):
             table = f"tenant{t}"
-            envs = None
+            # tenant identity default rides the table envs (clients
+            # that don't pass an explicit tag adopt it on config fetch)
+            envs = {"qos.tenants": qos_decl,
+                    "qos.default_tenant": table}
             if t >= n_tenants // 2:
                 # per-tenant capacity-unit QoS: background tenants get a
                 # write throttle so a noisy neighbor cannot starve the
                 # premium half's capacity (reject mode -> TryAgain,
                 # surfaced in write_rejected, never a violation)
-                envs = {"replica.write_throttling": "200*reject*10"}
+                envs["replica.write_throttling"] = "200*reject*10"
             _create_table_retry(admin, table, partitions,
                                 min(3, n_replica), envs=envs)
             client = ob.connect(table, directory,
-                                op_timeout_ms=op_timeout_ms)
+                                op_timeout_ms=op_timeout_ms,
+                                tenant=table)
             tenants.append(TenantWorkload(
                 table, client, random.Random(seed * 1000 + t),
                 monotonic_ledger=True))
@@ -229,6 +241,13 @@ def run_scale_test(directory: str, n_tenants: int = 4,
                                                   timeout=6)
         except PegasusError:
             report["hot_partitions"] = None
+        # server-side tenant QoS roll-up (meta folds the per-node
+        # config_sync tenant reports): CU totals, shed/overbudget
+        # counts, and any brownout verdicts from the soak
+        try:
+            report["tenant_qos"] = admin.call("tenant_stats", timeout=6)
+        except PegasusError:
+            report["tenant_qos"] = None
         # machinery counters: fences/quarantines prove the guards fired
         fence = quarantine = 0
         for n, c in admin.cfg["nodes"].items():
@@ -337,7 +356,8 @@ def run_wan_test(directory: str, n_tenants: int = 2,
                 _create_table_retry(admin_b, table, partitions, rc)
                 _create_table_retry(admin_a, table, partitions, rc)
                 client = ob.connect(table, da,
-                                    op_timeout_ms=op_timeout_ms)
+                                    op_timeout_ms=op_timeout_ms,
+                                    tenant=table)
                 tenants.append(TenantWorkload(
                     table, client, random.Random(seed * 1000 + t),
                     n_keys=500))
@@ -450,7 +470,8 @@ def run_wan_test(directory: str, n_tenants: int = 2,
             # ---- the invariant: every write A acked reads back on B --
             for tw in tenants:
                 b_client = ob.connect(tw.name, db,
-                                      op_timeout_ms=op_timeout_ms)
+                                      op_timeout_ms=op_timeout_ms,
+                                      tenant=tw.name)
                 tw.verifier.client = b_client
                 tw.verifier.final_check(deadline_s=180.0)
                 report["tenants"][tw.name] = {

@@ -356,6 +356,12 @@ def main(argv=None) -> int:
     p.add_argument("table")
     p.add_argument("--json", action="store_true")
     p = sub.add_parser(
+        "tenants",
+        help="per-tenant QoS view: weights, CU budgets and bucket "
+             "levels, consumed CU, shed/over-budget counts, brownout "
+             "state (one meta call off the config-sync tenant blocks)")
+    p.add_argument("--json", action="store_true")
+    p = sub.add_parser(
         "placement",
         help="the offload pays/doesn't-pay verdict "
              "(ops/placement.offload_breakdown) + the live cost-model "
@@ -1778,6 +1784,31 @@ def _dispatch(args, box, out) -> int:
                       file=out)
             else:
                 print(explain_mod.render_report(report), file=out)
+    elif args.cmd == "tenants":
+        if isinstance(box, _ClusterBox):
+            # one meta call off the config-sync tenant blocks
+            status = box.admin.call("tenant_stats")
+        else:
+            from pegasus_tpu.server.tenancy import TENANTS
+
+            status = {"tenants": TENANTS.snapshot(),
+                      "nodes_reporting": 1}
+        if args.json:
+            print(json.dumps(status, indent=1), file=out)
+        else:
+            print(f"tenants ({status.get('nodes_reporting', 0)} nodes "
+                  f"reporting):", file=out)
+            for name, st in sorted(
+                    (status.get("tenants") or {}).items()):
+                brown = "BROWNOUT" if st.get("browned") else "-"
+                budget = st.get("cu_budget") or 0
+                print(f"  {name:<16} w={st.get('weight')} "
+                      f"budget={budget if budget else 'unlimited'} "
+                      f"cu={st.get('cu_total', 0)} "
+                      f"ratio={st.get('cu_ratio', 0.0)} "
+                      f"shed={st.get('shed', 0)} "
+                      f"overbudget={st.get('overbudget', 0)}  {brown}",
+                      file=out)
     elif args.cmd == "workload":
         if isinstance(box, _ClusterBox):
             # one meta call off the config-sync workload digests

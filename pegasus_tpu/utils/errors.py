@@ -91,6 +91,13 @@ class ErrorCode(enum.IntEnum):
     # ONLY the bounced ops to the primary (the routing table is still
     # correct, so no config refresh is burned on the retry)
     ERR_STALE_REPLICA = 65
+    # multi-tenant QoS: the op's tenant is over its capacity-unit
+    # budget (server/tenancy.py token buckets fed by the CU
+    # accounting) and no idle headroom is available to borrow.
+    # RETRYABLE — the client's jittered backoff rides out the bucket
+    # refill; like ERR_BUSY/ERR_STALE_REPLICA it burns NO config
+    # refresh (the routing table is correct, the tenant is just hot)
+    ERR_CU_OVERBUDGET = 66
 
 
 class StorageStatus(enum.IntEnum):

@@ -179,6 +179,17 @@ def default_rules() -> List[HealthRule]:
                    "kernels — results stay correct but the accelerator "
                    "leg is out (hold=2: one spurious deadline alone "
                    "must not fire it)"),
+        HealthRule("tenant_brownout", "tenant", "tenant_cu_ratio",
+                   kind="burn_rate", threshold=2.0, window_s=30.0,
+                   min_points=2, hold=2, clear_hold=2,
+                   severity=SEV_DEGRADED,
+                   description="one tenant's CU consumption sustained "
+                   "> 2x its budget: the aggressor outlier. The stubs "
+                   "react by shedding ONLY this tenant's reads "
+                   "(server/tenancy.py brownout state) — the series is "
+                   "per-tenant, so a compliant tenant can never trip "
+                   "it; clear_hold releases the gate once shedding "
+                   "pulls the ratio back under budget"),
     ]
 
 

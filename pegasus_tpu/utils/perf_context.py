@@ -108,7 +108,7 @@ FIELDS: Tuple[str, ...] = _COUNTER_FIELDS + _GAUGE_FIELDS
 class PerfContext:
     """One op's (or one batched flush's) cost vector."""
 
-    __slots__ = ("op", "placement", "served_by") + FIELDS
+    __slots__ = ("op", "placement", "served_by", "tenant") + FIELDS
 
     def __init__(self, op: str = "") -> None:
         self.op = op
@@ -119,6 +119,10 @@ class PerfContext:
         # primary | secondary — which replica role answered this read
         # ("" = not a consistency-routed read, e.g. a write flush)
         self.served_by = ""
+        # the QoS tenant this op was billed to ("" = untenanted
+        # background work) — slow-log entries and spans carry it, so
+        # `shell explain`/`shell timeline` answer "which tenant"
+        self.tenant = ""
         for f in _COUNTER_FIELDS:
             setattr(self, f, 0)
         for f in _GAUGE_FIELDS:
@@ -129,7 +133,8 @@ class PerfContext:
         slow-log entries stay field-set-comparable by construction, and
         a field added here reaches every surface at once."""
         d: Dict[str, Any] = {"op": self.op, "placement": self.placement,
-                             "served_by": self.served_by}
+                             "served_by": self.served_by,
+                             "tenant": self.tenant}
         for f in _COUNTER_FIELDS:
             d[f] = getattr(self, f)
         for f in _GAUGE_FIELDS:
@@ -203,6 +208,12 @@ def merge_span_perf(tags: Dict[str, Any], pc: "PerfContext") -> None:
         prev["served_by"] = d["served_by"]
     elif d["served_by"] and d["served_by"] != prev["served_by"]:
         prev["served_by"] = "mixed"
+    # and for the billed tenant — a transport flush coalescing several
+    # tenants' reads reports "mixed", never silently the last one
+    if not prev.get("tenant"):
+        prev["tenant"] = d["tenant"]
+    elif d["tenant"] and d["tenant"] != prev["tenant"]:
+        prev["tenant"] = "mixed"
 
 
 class activate:

@@ -16,21 +16,28 @@ from typing import Optional, Tuple
 class TokenBucket:
     """Classic token bucket: `rate` units/sec with `burst` capacity."""
 
-    def __init__(self, rate: float, burst: Optional[float] = None) -> None:
+    def __init__(self, rate: float, burst: Optional[float] = None,
+                 clock=None) -> None:
+        """`clock`: monotonic-seconds source (default wall
+        time.monotonic). Sim-hosted buckets pass the virtual clock so
+        refill tracks virtual seconds — a compressed sim schedule burns
+        thousands of virtual seconds in milliseconds of wall, and a
+        wall-clocked bucket would never refill under it."""
         self.rate = float(rate)
         self.burst = float(burst if burst is not None else rate)
+        self._clock = clock if clock is not None else time.monotonic
         self._tokens = self.burst
-        self._last = time.monotonic()
+        self._last = self._clock()
         self._lock = threading.Lock()
 
     def _refill(self, now: float) -> None:
-        elapsed = now - self._last
+        elapsed = max(0.0, now - self._last)
         self._last = now
         self._tokens = min(self.burst, self._tokens + elapsed * self.rate)
 
     def try_consume(self, tokens: float = 1.0) -> bool:
         with self._lock:
-            now = time.monotonic()
+            now = self._clock()
             self._refill(now)
             if self._tokens >= tokens:
                 self._tokens -= tokens
@@ -42,12 +49,30 @@ class TokenBucket:
         serving, 0 if within budget. Mirrors the reference's delay-mode
         throttling (delay instead of reject)."""
         with self._lock:
-            now = time.monotonic()
+            now = self._clock()
             self._refill(now)
             self._tokens -= tokens
             if self._tokens >= 0:
                 return 0.0
             return -self._tokens / self.rate
+
+    def debit(self, tokens: float) -> None:
+        """Post-debit charge: subtract unconditionally, allowing the
+        level to go negative. The CU-budget admission model charges the
+        ACTUAL capacity units after serving (they are only known then)
+        and gates the NEXT op on the sign of the level — an op that
+        overshoots pushes the bucket into debt the refill must pay off
+        before the tenant is admitted again."""
+        with self._lock:
+            self._refill(self._clock())
+            self._tokens -= tokens
+
+    def level(self) -> float:
+        """Current token level after refill (may be negative under
+        debit()); admission peeks this without consuming."""
+        with self._lock:
+            self._refill(self._clock())
+            return self._tokens
 
 
 def parse_throttle_env(value: str) -> Tuple[Optional[TokenBucket], Optional[TokenBucket]]:

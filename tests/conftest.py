@@ -65,3 +65,16 @@ def _reset_compaction_governor():
     GOVERNOR._throttle_mbps = 0.0
     GOVERNOR._engaged_at_mbps = 0.0
     GOVERNOR._pressure_last = None
+
+
+@pytest.fixture(autouse=True)
+def _reset_tenant_registry():
+    """The tenant QoS registry is a process singleton too; a SimCluster
+    pins its governor clock to the (dead, frozen) sim loop and a test's
+    tenant budgets / brownout verdicts would leak into the next test."""
+    yield
+    try:
+        from pegasus_tpu.server.tenancy import TENANTS
+    except Exception:  # noqa: BLE001 - package not imported by this test
+        return
+    TENANTS.reset()

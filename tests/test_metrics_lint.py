@@ -4,7 +4,13 @@ caught at PR time, not at the dashboard."""
 
 import os
 
-from pegasus_tpu.tools.metrics_lint import _PKG_ROOT, lint, main, scan_tree
+from pegasus_tpu.tools.metrics_lint import (
+    _PKG_ROOT,
+    lint,
+    main,
+    scan_tenant_entities,
+    scan_tree,
+)
 
 
 def test_package_tree_is_clean():
@@ -81,3 +87,34 @@ def test_lint_catches_conflicts_and_bad_names(tmp_path):
     (bad / "a.py").write_text('ent.counter("worker_load")\n')
     (bad / "b.py").write_text('other.counter("worker_load")\n')
     assert main([str(bad)]) == 0
+
+
+def test_tenant_entity_rule_fails_sites_outside_the_registry(tmp_path):
+    """Per-tenant metric entities may ONLY be minted by the bounded
+    registry (server/tenancy.py): anywhere else, a request-supplied
+    tag becomes unbounded metric cardinality — the linter fails it."""
+    bad = tmp_path / "pkg"
+    os.makedirs(bad / "server")
+    (bad / "rogue.py").write_text(
+        'ent = METRICS.entity("tenant", raw_wire_tag)\n'
+        'ok = METRICS.entity("table", name)\n')
+    (bad / "server" / "tenancy.py").write_text(
+        'ent = METRICS.entity("tenant", name, {"tenant": name})\n')
+    sites = scan_tenant_entities(str(bad))
+    assert sites == ["rogue.py:1"]  # the home file is exempt; other
+    # entity types don't trip the rule
+    problems = lint(str(bad))
+    assert any("unbounded metric cardinality" in p for p in problems)
+    assert main([str(bad)]) == 1
+    (bad / "rogue.py").write_text('ok = METRICS.entity("table", name)\n')
+    assert main([str(bad)]) == 0
+    # the multi-line form is seen too (not a silent scan gap)
+    (bad / "rogue.py").write_text(
+        'ent = METRICS.entity(\n    "tenant", raw)\n')
+    assert scan_tenant_entities(str(bad)) == ["rogue.py:1"]
+
+
+def test_package_tree_mints_tenant_entities_only_in_tenancy():
+    """THE gate: across the whole package, the bounded registry is the
+    single place a tenant-labeled entity comes from."""
+    assert scan_tenant_entities(_PKG_ROOT) == []

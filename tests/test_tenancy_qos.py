@@ -1,0 +1,428 @@
+"""Multi-tenant QoS tier-1 gates: the retryability matrix (sync and
+async clients must agree code-for-code), the bounded tenant registry +
+CU-budget governor, the transport's deficit-weighted round-robin, the
+aggressor-only brownout rule, the read-limiter's virtual-clock
+threading, and the seeded-sim isolation proof — a compliant tenant
+riding next to a zipfian abuser (through a node kill) sees ZERO
+over-budget rejections while the abuser is the only one gated."""
+
+import random
+
+import pytest
+
+from pegasus_tpu.client import aio
+from pegasus_tpu.client import cluster_client as cc
+from pegasus_tpu.server.read_limiter import RangeReadLimiter
+from pegasus_tpu.server.tenancy import (
+    DEFAULT_TENANT,
+    MAX_TENANTS,
+    TENANTS,
+    sanitize_tenant,
+)
+from pegasus_tpu.tools.cluster import SimCluster
+from pegasus_tpu.utils.errors import ErrorCode, PegasusError, StorageStatus
+from pegasus_tpu.utils.flags import FLAGS
+
+OK = int(StorageStatus.OK)
+
+
+@pytest.fixture(autouse=True)
+def _qos_flags():
+    """Restore the mutable QoS flags tests flip (the TENANTS registry
+    itself is reset by the conftest autouse fixture)."""
+    yield
+    from pegasus_tpu.utils import health as health_mod
+
+    health_mod.reset_capture()
+    FLAGS.set("pegasus.qos", "tenant_enforce", True)
+    FLAGS.set("pegasus.qos", "tenant_borrow_when_idle", True)
+    FLAGS.set("pegasus.qos", "tenant_idle_borrow_s", 2.0)
+
+
+class _Clock:
+    def __init__(self, t=100.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+# ---- satellite: the retryability matrix ----------------------------------
+
+
+# the full matrix, spelled out: a code joining either set must be added
+# HERE too, so retry semantics change by explicit decision, not drift
+_EXPECT_RETRYABLE = {
+    ErrorCode.ERR_INVALID_STATE,
+    ErrorCode.ERR_INACTIVE_STATE,
+    ErrorCode.ERR_PARENT_PARTITION_MISUSED,
+    ErrorCode.ERR_OBJECT_NOT_FOUND,
+    ErrorCode.ERR_TIMEOUT,
+    ErrorCode.ERR_SPLITTING,
+    ErrorCode.ERR_BUSY,
+    ErrorCode.ERR_CHECKSUM_FAILED,
+    ErrorCode.ERR_DISK_IO_ERROR,
+    ErrorCode.ERR_DUP_FENCED,
+    ErrorCode.ERR_STALE_REPLICA,
+    ErrorCode.ERR_CU_OVERBUDGET,
+}
+_EXPECT_NO_REFRESH = {
+    ErrorCode.ERR_BUSY,
+    ErrorCode.ERR_STALE_REPLICA,
+    ErrorCode.ERR_CU_OVERBUDGET,
+}
+
+
+def test_retryability_matrix_is_explicit_and_total():
+    assert cc.RETRYABLE_CODES == {int(c) for c in _EXPECT_RETRYABLE}
+    assert cc.NO_REFRESH_CODES == {int(c) for c in _EXPECT_NO_REFRESH}
+    # no-refresh is a refinement of retryable, never a separate policy
+    assert cc.NO_REFRESH_CODES < cc.RETRYABLE_CODES
+    # hard non-retryables stay out (an app-level error must surface,
+    # not spin the backoff loop)
+    for code in (ErrorCode.ERR_OK, ErrorCode.ERR_APP_NOT_EXIST,
+                 ErrorCode.ERR_ACL_DENY, ErrorCode.ERR_INVALID_PARAMETERS):
+        assert int(code) not in cc.RETRYABLE_CODES
+
+
+def test_sync_and_async_clients_share_one_retry_surface():
+    """aio re-exports the SAME frozensets (identity, not copies): the
+    async client can never drift a code from the sync client."""
+    assert aio.RETRYABLE_CODES is cc.RETRYABLE_CODES
+    assert aio.NO_REFRESH_CODES is cc.NO_REFRESH_CODES
+    assert isinstance(cc.RETRYABLE_CODES, frozenset)
+    assert isinstance(cc.NO_REFRESH_CODES, frozenset)
+
+
+# ---- registry: bounded cardinality + sanitation --------------------------
+
+
+def test_wire_tags_fold_into_bounded_registry():
+    assert sanitize_tenant("gold-7") == "gold-7"
+    for raw in (None, "", "UPPER", "a" * 33, "sneaky;drop", 42,
+                "-leading-dash"):
+        assert sanitize_tenant(raw) == DEFAULT_TENANT
+    # resolve() never mints: an unknown (but well-formed) tag answers
+    # as the default tenant until an env/operator registers it
+    assert TENANTS.resolve("unregistered").name == DEFAULT_TENANT
+    TENANTS.ensure("gold", 4.0, 0.0)
+    assert TENANTS.resolve("gold").name == "gold"
+
+
+def test_registry_cap_folds_overflow_to_default():
+    for i in range(MAX_TENANTS + 10):
+        TENANTS.ensure(f"t{i:03d}", 1.0, 0.0)
+    assert len(TENANTS.names()) <= MAX_TENANTS
+    # the overflow registration answered as default, not a fresh entity
+    assert TENANTS.ensure("one-more", 1.0, 0.0).name == DEFAULT_TENANT
+
+
+def test_env_config_parses_weights_and_budgets():
+    TENANTS.configure_from_envs(
+        {"qos.tenants": "gold:4:10000, free:1:500, bare, junk:x:y"})
+    snap = TENANTS.snapshot()
+    assert snap["gold"]["weight"] == 4.0
+    assert snap["gold"]["cu_budget"] == 10000.0
+    assert snap["free"]["cu_budget"] == 500.0
+    assert snap["bare"]["weight"] == 1.0 and snap["bare"]["cu_budget"] == 0
+    assert "junk" not in snap  # malformed fields skip, never crash
+
+
+# ---- CU budgets: post-debit admission + borrow-when-idle -----------------
+
+
+def test_cu_budget_post_debit_gate_and_refill():
+    clk = _Clock()
+    TENANTS.set_clock(clk)
+    TENANTS.ensure("payg", 1.0, 100.0)  # 100 CU/s, 2s burst = 200 CU
+    FLAGS.set("pegasus.qos", "tenant_borrow_when_idle", False)
+    assert TENANTS.admit("payg") == 0  # bucket starts at burst
+    TENANTS.charge("payg", 500)  # post-debit: bill ACTUAL usage
+    err = TENANTS.admit("payg")
+    assert err == int(ErrorCode.ERR_CU_OVERBUDGET)
+    assert TENANTS.snapshot()["payg"]["overbudget"] >= 1
+    # refill pays the debt down; admission resumes without any reset
+    clk.t += 10.0
+    assert TENANTS.admit("payg") == 0
+    # the kill switch bypasses the gate entirely
+    TENANTS.charge("payg", 10_000)
+    FLAGS.set("pegasus.qos", "tenant_enforce", False)
+    assert TENANTS.admit("payg") == 0
+
+
+def test_borrow_when_idle_admits_without_contention():
+    clk = _Clock()
+    TENANTS.set_clock(clk)
+    TENANTS.ensure("payg", 1.0, 100.0)
+    TENANTS.ensure("noisy", 1.0, 0.0)
+    TENANTS.charge("payg", 10_000)  # deep over budget
+    # every OTHER tenant quiet -> soft mode lets it run (budgets cap
+    # contention, not idle throughput)
+    assert TENANTS.admit("payg") == 0
+    # a recent charge by anyone else ends the borrow
+    TENANTS.charge("noisy", 1)
+    assert TENANTS.admit("payg") == int(ErrorCode.ERR_CU_OVERBUDGET)
+    # ... and the borrow returns once they go quiet past the horizon
+    clk.t += FLAGS.get("pegasus.qos", "tenant_idle_borrow_s") + 0.1
+    assert TENANTS.admit("payg") == 0
+
+
+# ---- transport: deficit-weighted round-robin -----------------------------
+
+
+class _NoThreadTransport:
+    """TcpTransport with its IO threads suppressed: the fair-queue
+    structure (_classify/_drr_pick/_sched_get) is dispatch-thread-only
+    state, so with no dispatcher running the test IS the dispatcher."""
+
+    def __new__(cls):
+        from pegasus_tpu.rpc.transport import TcpTransport
+
+        class _T(TcpTransport):
+            def _spawn(self, fn, *args):
+                pass
+
+        return _T(None, {})
+
+
+def _read_item(tenant):
+    return (0.0, "cli", "node0", "client_read",
+            {"tenant": tenant, "rid": 1}, "s1")
+
+
+def test_drr_drains_tenants_by_weight_ratio():
+    TENANTS.ensure("gold", 4.0, 0.0)
+    TENANTS.ensure("free", 1.0, 0.0)
+    tr = _NoThreadTransport()
+    for _ in range(8):
+        tr._classify(_read_item("gold"))
+        tr._classify(_read_item("free"))
+    drained = [tr._drr_pick()[4]["tenant"] for _ in range(10)]
+    # weight 4:1 -> each rotation serves 4 gold then 1 free; over the
+    # first 10 picks the hot-but-heavy tenant gets exactly its share
+    # while the light tenant still makes progress every rotation
+    assert drained.count("gold") == 8
+    assert drained.count("free") == 2
+    assert drained[:5] == ["gold"] * 4 + ["free"]
+
+
+def test_writes_and_system_traffic_bypass_the_fair_queue():
+    TENANTS.ensure("gold", 4.0, 0.0)
+    tr = _NoThreadTransport()
+    tr._classify(_read_item("gold"))
+    tr._classify((0.0, "cli", "node0", "client_write",
+                  {"tenant": "gold", "rid": 2}, "s1"))
+    tr._classify((0.0, "peer", "node0", "prepare_batch", [], "s2"))
+    # mutation + replication drain first, strict priority — the
+    # fair queue arbitrates only shed-eligible reads
+    assert tr._sched_get()[3] == "client_write"
+    assert tr._sched_get()[3] == "prepare_batch"
+    assert tr._sched_get()[3] == "client_read"
+    # forged/unknown tags fold into the default queue, never mint one
+    tr._classify(_read_item("NOT A SLUG ~~~"))
+    assert set(tr._tenant_queues) <= {"gold", DEFAULT_TENANT}
+
+
+# ---- brownout: the aggressor-only rule drives the registry ---------------
+
+
+def test_brownout_rule_fires_on_aggressor_only_and_gates_registry():
+    from pegasus_tpu.utils.health import HealthEngine, default_rules
+    from pegasus_tpu.utils.metrics import MetricRegistry
+    from pegasus_tpu.utils.timeseries import FlightRecorder, SeriesRing
+
+    clock = _Clock(1000.0)
+    reg = MetricRegistry()
+    rec = FlightRecorder("n0", clock=clock, registry=reg)
+    rule = next(r for r in default_rules() if r.name == "tenant_brownout")
+    assert rule.entity_type == "tenant"
+    abuser = SeriesRing("value")
+    victim = SeriesRing("value")
+    rec._series[("tenant", "abuser", "tenant_cu_ratio")] = abuser
+    rec._series[("tenant", "victim", "tenant_cu_ratio")] = victim
+    rec._total_points = 1
+    eng = HealthEngine("n0", rec, rules=[rule], clock=clock)
+    TENANTS.ensure("abuser", 1.0, 100.0)
+    TENANTS.ensure("victim", 4.0, 0.0)
+
+    def drive():
+        for ev in eng.evaluate():
+            if ev.rule == "tenant_brownout":
+                TENANTS.set_brownout(ev.entity[1], ev.firing)
+
+    # sustained 3x-over-budget consumption on the abuser, calm victim
+    for i in range(4):
+        abuser.append(clock.t, 3.0)
+        victim.append(clock.t, 0.2)
+        drive()
+        clock.t += 10.0
+    assert TENANTS.browned("abuser")
+    assert not TENANTS.browned("victim")  # per-tenant series: a
+    # compliant tenant can NEVER trip the aggressor's rule
+    assert TENANTS.snapshot()["abuser"]["browned"] is True
+    # shedding pulls the ratio back under budget -> clear_hold releases
+    for i in range(4):
+        abuser.append(clock.t, 0.1)
+        victim.append(clock.t, 0.2)
+        drive()
+        clock.t += 10.0
+    assert not TENANTS.browned("abuser")
+
+
+# ---- satellite: read-limiter virtual-clock regression --------------------
+
+
+def test_range_read_limiter_burns_the_injected_clock():
+    """Regression: the iteration time budget must follow the clock the
+    host threads in — a compressed sim schedule burns thousands of
+    virtual seconds in milliseconds of wall time (and a wall-stalled
+    host must not trip a budget with zero virtual time spent)."""
+    ns = _Clock(t=0)
+    lim = RangeReadLimiter(max_iteration_count=0, threshold_time_ms=10,
+                           clock_ns=lambda: int(ns.t))
+    assert lim.valid()
+    ns.t = 9 * 1_000_000
+    assert not lim.time_exceeded() and lim.valid()
+    ns.t = 11 * 1_000_000
+    assert lim.time_exceeded() and not lim.valid()
+    # count budget is independent of the clock
+    lim2 = RangeReadLimiter(max_iteration_count=3, threshold_time_ms=0,
+                            clock_ns=lambda: int(ns.t))
+    lim2.add_count(3)
+    assert lim2.count_exceeded() and not lim2.time_exceeded()
+
+
+def test_sim_hosted_partitions_thread_the_virtual_clock(tmp_path):
+    """A SimCluster replica's partition server must hold a clock_ns on
+    the VIRTUAL timebase (stub wiring), not wall perf_counter."""
+    cluster = SimCluster(str(tmp_path / "c"), n_nodes=3)
+    try:
+        cluster.create_table("t", partition_count=2)
+        cluster.step(rounds=2)
+        r = next(iter(next(iter(cluster.stubs.values())).replicas.values()))
+        assert r.server.clock_ns is not None
+        before = r.server.clock_ns()
+        cluster.loop.run_for(5.0)  # 5 virtual seconds, ~0 wall
+        assert r.server.clock_ns() - before >= int(5.0 * 1e9)
+    finally:
+        cluster.close()
+
+
+# ---- satellite: seeded-sim isolation proof -------------------------------
+
+
+_QOS_ENVS = {
+    # abuser: weight 1, 60 CU/s budget (120 CU burst). compliant:
+    # weight 8 and an effectively-unmetered budget.
+    "qos.tenants": "abuser:1:60,compliant:8:1000000",
+    "qos.default_tenant": "compliant",
+}
+
+
+def test_sim_qos_isolation_compliant_never_gated_through_node_kill(tmp_path):
+    """Two tenants on one table: a zipfian abuser hammering well past
+    its CU budget and a compliant tenant doing steady light work, with
+    a node kill mid-run. The gate: the abuser is the ONLY tenant that
+    ever goes over budget — the compliant tenant finishes every op,
+    is never shed, and never sees ERR_CU_OVERBUDGET."""
+    cluster = SimCluster(str(tmp_path / "c"), n_nodes=3, seed=3)
+    try:
+        app_id = cluster.create_table("t", partition_count=4,
+                                      envs=_QOS_ENVS)
+        cluster.step(rounds=2)
+        abuser = cluster.client("t", name="cli-abuser",
+                                tenant="abuser")
+        compliant = cluster.client("t", name="cli-compliant",
+                                   tenant="compliant")
+        rng = random.Random(11)
+        from pegasus_tpu.tools.scale_test import zipf_keys
+
+        compliant_ok = 0
+        # 32KB values: ~8 CU per abuser write, so each iteration's 5
+        # writes (~40 CU) far outrun the 60 CU/s refill — the budget
+        # gates on consumption, not on the op count
+        for i in range(30):
+            for hk in zipf_keys(rng, 200, 1.2, 5):
+                abuser.set(hk, b"s", b"x" * 32768)
+            # compliant: steady light traffic, interleaved so the
+            # borrow-when-idle soft mode never applies to the abuser
+            assert compliant.set(b"ck%d" % i, b"s", b"v%d" % i) == OK
+            assert compliant.get(b"ck%d" % i, b"s") == (OK, b"v%d" % i)
+            compliant_ok += 2
+            if i == 15:
+                victim_node = cluster.primaries(app_id)[0]
+                cluster.kill(victim_node)
+                cluster.step(rounds=4)  # FD expiry + cures
+        snap = TENANTS.snapshot()
+        assert compliant_ok == 60
+        # the abuser was gated (typed retryable rejections it rode out
+        # with jittered backoff — its ops still completed eventually)
+        assert snap["abuser"]["overbudget"] > 0
+        # the compliant tenant NEVER was: zero over-budget rejections,
+        # zero brownout sheds, despite sharing every funnel
+        assert snap["compliant"]["overbudget"] == 0
+        assert snap["compliant"]["shed"] == 0
+        # both tenants' CU consumption was actually metered (the proof
+        # is vacuous if attribution silently broke)
+        assert snap["abuser"]["cu_total"] > 0
+        assert snap["compliant"]["cu_total"] > 0
+    finally:
+        cluster.close()
+
+
+def test_overbudget_retry_skips_config_refresh(tmp_path):
+    """ERR_CU_OVERBUDGET means "the tenant is hot", not "the routing
+    table is stale": the client's backoff retry must NOT burn a config
+    refresh (re-resolving would convert a CU storm into a meta query
+    storm — same discipline as ERR_BUSY)."""
+    cluster = SimCluster(str(tmp_path / "c"), n_nodes=3)
+    try:
+        cluster.create_table(
+            "t", partition_count=2,
+            envs={"qos.tenants": "abuser:1:20,compliant:8:1000000"})
+        cluster.step(rounds=2)
+        FLAGS.set("pegasus.qos", "tenant_borrow_when_idle", False)
+        client = cluster.client("t", tenant="abuser")
+        assert client.set(b"warm", b"s", b"v") == OK  # config cached
+        refreshes = []
+        real_refresh = client.refresh_config
+        client.refresh_config = lambda *a, **k: (
+            refreshes.append(1), real_refresh(*a, **k))
+        # 16KB values = ~5 CU each against a 20 CU/s budget: the tail
+        # of these ops hits the admit gate and retries through the
+        # jittered backoff (virtual sleep refills the bucket, so every
+        # op still completes — the deficit is bounded by one op's CU)
+        for i in range(60):
+            assert client.set(b"k%d" % i, b"s", b"x" * 16384) == OK
+        assert TENANTS.snapshot()["abuser"]["overbudget"] > 0
+        assert refreshes == []  # no-refresh subset held behaviorally
+    finally:
+        cluster.close()
+
+
+def test_brownout_gate_sheds_only_the_browned_tenant(tmp_path):
+    """The stub's read gate honors the registry's brownout verdict:
+    ONLY the browned tenant's reads shed (ERR_BUSY), writes and every
+    other tenant keep flowing; release reopens the tap."""
+    cluster = SimCluster(str(tmp_path / "c"), n_nodes=3)
+    try:
+        cluster.create_table("t", partition_count=2, envs=_QOS_ENVS)
+        cluster.step(rounds=2)
+        abuser = cluster.client("t", name="cli-abuser",
+                                tenant="abuser")
+        compliant = cluster.client("t", name="cli-compliant",
+                                   tenant="compliant")
+        assert abuser.set(b"a", b"s", b"v1") == OK
+        assert compliant.set(b"c", b"s", b"v2") == OK
+        TENANTS.set_brownout("abuser", True)
+        with pytest.raises(PegasusError):
+            abuser.get(b"a", b"s")  # retries exhaust against the gate
+        # writes are NEVER brownout-shed (mutation path degrades last)
+        assert abuser.set(b"a2", b"s", b"v3") == OK
+        assert compliant.get(b"c", b"s") == (OK, b"v2")  # untouched
+        assert TENANTS.snapshot()["abuser"]["shed"] > 0
+        assert TENANTS.snapshot()["compliant"]["shed"] == 0
+        TENANTS.set_brownout("abuser", False)
+        assert abuser.get(b"a", b"s") == (OK, b"v1")
+    finally:
+        cluster.close()
